@@ -1,0 +1,17 @@
+from .types import (  # noqa: F401
+    BIGINT, BOOLEAN, DATE, DOUBLE, INTEGER, REAL, SMALLINT, TIMESTAMP,
+    TINYINT, UNKNOWN, VARBINARY, VARCHAR, ArrayType, BigintType, BooleanType,
+    CharType, DateType, DecimalType, DoubleType, IntegerType, MapType,
+    RealType, RowType, SmallintType, TimestampType, TinyintType, Type,
+    UnknownType, VarbinaryType, VarcharType, parse_type,
+)
+from .block import (  # noqa: F401
+    ArrayBlock, Block, DictionaryBlock, FixedWidthBlock, Int128Block,
+    RowBlock, RunLengthBlock, VariableWidthBlock, block_from_values,
+    block_to_values, byte_array_block, decode_to_flat, double_block,
+    int_array_block, long_array_block, short_array_block,
+)
+from .page import Page, concat_pages  # noqa: F401
+from .serde import (  # noqa: F401
+    deserialize_page, deserialize_pages, serialize_page, serialize_pages,
+)

@@ -1,0 +1,78 @@
+"""Page: a batch of positions across columns (reference presto-common/.../Page.java:45)."""
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import numpy as np
+
+from .block import Block
+
+
+class Page:
+    def __init__(self, blocks: List[Block], position_count: int = None):
+        if position_count is None:
+            if not blocks:
+                raise ValueError("position_count required for zero-channel page")
+            position_count = blocks[0].position_count
+        for b in blocks:
+            if b.position_count != position_count:
+                raise ValueError(
+                    f"block has {b.position_count} positions, expected {position_count}")
+        self.blocks = blocks
+        self.position_count = position_count
+
+    @property
+    def channel_count(self) -> int:
+        return len(self.blocks)
+
+    def block(self, channel: int) -> Block:
+        return self.blocks[channel]
+
+    def take(self, positions: np.ndarray) -> "Page":
+        positions = np.asarray(positions)
+        return Page([b.take(positions) for b in self.blocks], len(positions))
+
+    def region(self, offset: int, length: int) -> "Page":
+        return self.take(np.arange(offset, offset + length))
+
+    def append_column(self, block: Block) -> "Page":
+        return Page(self.blocks + [block], self.position_count)
+
+    def __repr__(self):
+        return f"Page({self.position_count} x {self.channel_count})"
+
+
+def concat_pages(pages: Sequence[Page]) -> Page:
+    """Concatenate pages with identical channel layouts (materializes)."""
+    pages = [p for p in pages if p.position_count > 0]
+    if not pages:
+        raise ValueError("no non-empty pages")
+    if len(pages) == 1:
+        return pages[0]
+    from .block import (FixedWidthBlock, VariableWidthBlock, decode_to_flat)
+    n_channels = pages[0].channel_count
+    out = []
+    total = sum(p.position_count for p in pages)
+    for c in range(n_channels):
+        blocks = [decode_to_flat(p.block(c)) for p in pages]
+        first = blocks[0]
+        nulls = None
+        if any(b.nulls is not None for b in blocks):
+            nulls = np.concatenate([b.null_mask() for b in blocks])
+        if isinstance(first, FixedWidthBlock):
+            out.append(FixedWidthBlock(
+                np.concatenate([b.values for b in blocks]), nulls))
+        elif isinstance(first, VariableWidthBlock):
+            # Slice each block's referenced byte range; offsets may not start
+            # at zero and data may have unreferenced tails.
+            datas = [b.data[b.offsets[0]:b.offsets[-1]] for b in blocks]
+            offs = np.zeros(total + 1, dtype=np.int64)
+            lens = np.concatenate(
+                [(b.offsets[1:] - b.offsets[:-1]) for b in blocks])
+            np.cumsum(lens, out=offs[1:])
+            out.append(VariableWidthBlock(
+                offs.astype(np.int32), np.concatenate(datas), nulls))
+        else:
+            raise NotImplementedError(
+                f"concat of {type(first).__name__} not supported")
+    return Page(out, total)

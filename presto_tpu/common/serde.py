@@ -1,0 +1,343 @@
+"""SerializedPage wire format, byte-compatible with the reference.
+
+Framing (presto-spi/.../spi/page/PagesSerdeUtil.java:64-88):
+    positionCount:int32 | codecMarkers:byte | uncompressedSize:int32 |
+    size:int32 | checksum:int64 | <size bytes of page data>
+Page data (PagesSerdeUtil.writeRawPage:45-51):
+    channelCount:int32 then each block via writeBlock.
+Block framing (BlockEncodingManager.java:79-99): length-prefixed UTF-8 encoding
+name, then the encoding-specific payload.  All integers little-endian (airlift
+Slice).  Codec marker bits (PageCodecMarker.java:27-29): COMPRESSED=1,
+ENCRYPTED=2, CHECKSUMMED=4.  Checksum = CRC32 over (pageData, markers byte,
+positionCount LE32, uncompressedSize LE32) per PagesSerdeUtil.java:102-119.
+
+Null bitmaps (EncoderUtil.java): one boolean byte mayHaveNull; if set, one bit
+per position MSB-first within each byte, 1 == null; fixed-width encodings then
+write values for NON-NULL positions only.
+"""
+from __future__ import annotations
+
+import io
+import struct
+import zlib
+from typing import List, Optional
+
+import numpy as np
+
+from .block import (
+    ArrayBlock, Block, DictionaryBlock, FixedWidthBlock, Int128Block,
+    RowBlock, RunLengthBlock, VariableWidthBlock,
+)
+from .page import Page
+
+COMPRESSED = 0x01
+ENCRYPTED = 0x02
+CHECKSUMMED = 0x04
+
+PAGE_METADATA_SIZE = 21
+
+_WIDTH_BY_NAME = {"BYTE_ARRAY": 1, "SHORT_ARRAY": 2, "INT_ARRAY": 4, "LONG_ARRAY": 8}
+
+
+# ---------------------------------------------------------------------------
+# null bitmap helpers
+# ---------------------------------------------------------------------------
+
+def _encode_nulls(out: io.BytesIO, block: Block) -> Optional[np.ndarray]:
+    if not block.may_have_null:
+        out.write(b"\x00")
+        return None
+    mask = block.null_mask()
+    out.write(b"\x01")
+    out.write(np.packbits(mask).tobytes())  # MSB-first, matches EncoderUtil
+    return mask
+
+
+def _decode_nulls(buf: memoryview, pos: int, n: int):
+    may_have = buf[pos]
+    pos += 1
+    if not may_have:
+        return None, pos
+    nbytes = (n + 7) // 8
+    bits = np.unpackbits(
+        np.frombuffer(buf[pos:pos + nbytes], dtype=np.uint8))[:n].astype(bool)
+    return bits, pos + nbytes
+
+
+# ---------------------------------------------------------------------------
+# block write
+# ---------------------------------------------------------------------------
+
+def write_block(out: io.BytesIO, block: Block) -> None:
+    name = block.encoding
+    nb = name.encode("utf-8")
+    out.write(struct.pack("<i", len(nb)))
+    out.write(nb)
+    _write_block_body(out, block)
+
+
+def _write_block_body(out: io.BytesIO, block: Block) -> None:
+    name = block.encoding
+    if name in _WIDTH_BY_NAME:
+        _write_fixed(out, block)
+    elif name == "INT128_ARRAY":
+        _write_int128(out, block)
+    elif name == "VARIABLE_WIDTH":
+        _write_varwidth(out, block)
+    elif name == "DICTIONARY":
+        _write_dictionary(out, block)
+    elif name == "RLE":
+        out.write(struct.pack("<i", block.position_count))
+        write_block(out, block.value)
+    elif name == "ARRAY":
+        _write_array(out, block)
+    elif name == "ROW":
+        _write_row(out, block)
+    else:
+        raise NotImplementedError(f"encoding {name}")
+
+
+def _write_fixed(out: io.BytesIO, block: FixedWidthBlock) -> None:
+    out.write(struct.pack("<i", block.position_count))
+    mask = _encode_nulls(out, block)
+    values = block.values
+    if mask is not None:
+        values = values[~mask]  # non-null values only
+    out.write(np.ascontiguousarray(values).tobytes())
+
+
+def _write_int128(out: io.BytesIO, block: Int128Block) -> None:
+    out.write(struct.pack("<i", block.position_count))
+    mask = _encode_nulls(out, block)
+    values = block.values
+    if mask is not None:
+        values = values[~mask]
+    out.write(np.ascontiguousarray(values).tobytes())
+
+
+def _write_varwidth(out: io.BytesIO, block: VariableWidthBlock) -> None:
+    n = block.position_count
+    out.write(struct.pack("<i", n))
+    # cumulative end offsets, rebased to zero
+    offs = (block.offsets[1:] - block.offsets[0]).astype(np.int32)
+    out.write(offs.tobytes())
+    _encode_nulls(out, block)
+    total = int(offs[-1]) if n else 0
+    out.write(struct.pack("<i", total))
+    start = int(block.offsets[0])
+    out.write(block.data[start:start + total].tobytes())
+
+
+def _write_dictionary(out: io.BytesIO, block: DictionaryBlock) -> None:
+    block = block.compact()
+    out.write(struct.pack("<i", block.position_count))
+    write_block(out, block.dictionary)
+    out.write(block.ids.tobytes())
+    msb, lsb, seq = block.source_id
+    out.write(struct.pack("<qqq", msb, lsb, seq))
+
+
+def _write_array(out: io.BytesIO, block: ArrayBlock) -> None:
+    start = int(block.offsets[0])
+    end = int(block.offsets[-1])
+    write_block(out, block.elements.region(start, end - start)
+                if (start != 0 or end != block.elements.position_count)
+                else block.elements)
+    out.write(struct.pack("<i", block.position_count))
+    out.write((block.offsets - start).astype(np.int32).tobytes())
+    _encode_nulls(out, block)
+
+
+def _write_row(out: io.BytesIO, block: RowBlock) -> None:
+    out.write(struct.pack("<i", len(block.field_blocks)))
+    start = int(block.offsets[0])
+    end = int(block.offsets[-1])
+    for f in block.field_blocks:
+        write_block(out, f.region(start, end - start)
+                    if (start != 0 or end != f.position_count) else f)
+    out.write(struct.pack("<i", block.position_count))
+    out.write((block.offsets - start).astype(np.int32).tobytes())
+    _encode_nulls(out, block)
+
+
+# ---------------------------------------------------------------------------
+# block read
+# ---------------------------------------------------------------------------
+
+def read_block(buf: memoryview, pos: int = 0):
+    (nlen,) = struct.unpack_from("<i", buf, pos)
+    pos += 4
+    name = bytes(buf[pos:pos + nlen]).decode("utf-8")
+    pos += nlen
+    return _read_block_body(name, buf, pos)
+
+
+def _read_block_body(name: str, buf: memoryview, pos: int):
+    if name in _WIDTH_BY_NAME:
+        return _read_fixed(buf, pos, _WIDTH_BY_NAME[name])
+    if name == "INT128_ARRAY":
+        return _read_int128(buf, pos)
+    if name == "VARIABLE_WIDTH":
+        return _read_varwidth(buf, pos)
+    if name == "DICTIONARY":
+        return _read_dictionary(buf, pos)
+    if name == "RLE":
+        (n,) = struct.unpack_from("<i", buf, pos)
+        value, pos = read_block(buf, pos + 4)
+        return RunLengthBlock(value, n), pos
+    if name == "ARRAY":
+        return _read_array(buf, pos)
+    if name == "ROW":
+        return _read_row(buf, pos)
+    raise NotImplementedError(f"encoding {name}")
+
+
+_DTYPES = {1: np.int8, 2: np.int16, 4: np.int32, 8: np.int64}
+
+
+def _read_fixed(buf, pos, width):
+    (n,) = struct.unpack_from("<i", buf, pos)
+    pos += 4
+    nulls, pos = _decode_nulls(buf, pos, n)
+    dtype = _DTYPES[width]
+    if nulls is None:
+        values = np.frombuffer(buf[pos:pos + n * width], dtype=dtype).copy()
+        pos += n * width
+    else:
+        k = int((~nulls).sum())
+        packed = np.frombuffer(buf[pos:pos + k * width], dtype=dtype)
+        pos += k * width
+        values = np.zeros(n, dtype=dtype)
+        values[~nulls] = packed
+    return FixedWidthBlock(values, nulls), pos
+
+
+def _read_int128(buf, pos):
+    (n,) = struct.unpack_from("<i", buf, pos)
+    pos += 4
+    nulls, pos = _decode_nulls(buf, pos, n)
+    if nulls is None:
+        values = np.frombuffer(buf[pos:pos + n * 16], dtype=np.int64).copy().reshape(n, 2)
+        pos += n * 16
+    else:
+        k = int((~nulls).sum())
+        packed = np.frombuffer(buf[pos:pos + k * 16], dtype=np.int64).reshape(k, 2)
+        pos += k * 16
+        values = np.zeros((n, 2), dtype=np.int64)
+        values[~nulls] = packed
+    return Int128Block(values, nulls), pos
+
+
+def _read_varwidth(buf, pos):
+    (n,) = struct.unpack_from("<i", buf, pos)
+    pos += 4
+    ends = np.frombuffer(buf[pos:pos + 4 * n], dtype=np.int32)
+    pos += 4 * n
+    nulls, pos = _decode_nulls(buf, pos, n)
+    (total,) = struct.unpack_from("<i", buf, pos)
+    pos += 4
+    data = np.frombuffer(buf[pos:pos + total], dtype=np.uint8).copy()
+    pos += total
+    offsets = np.zeros(n + 1, dtype=np.int32)
+    offsets[1:] = ends
+    return VariableWidthBlock(offsets, data, nulls), pos
+
+
+def _read_dictionary(buf, pos):
+    (n,) = struct.unpack_from("<i", buf, pos)
+    pos += 4
+    dictionary, pos = read_block(buf, pos)
+    ids = np.frombuffer(buf[pos:pos + 4 * n], dtype=np.int32).copy()
+    pos += 4 * n
+    msb, lsb, seq = struct.unpack_from("<qqq", buf, pos)
+    pos += 24
+    return DictionaryBlock(ids, dictionary, (msb, lsb, seq)), pos
+
+
+def _read_array(buf, pos):
+    elements, pos = read_block(buf, pos)
+    (n,) = struct.unpack_from("<i", buf, pos)
+    pos += 4
+    offsets = np.frombuffer(buf[pos:pos + 4 * (n + 1)], dtype=np.int32).copy()
+    pos += 4 * (n + 1)
+    nulls, pos = _decode_nulls(buf, pos, n)
+    return ArrayBlock(offsets, elements, nulls), pos
+
+
+def _read_row(buf, pos):
+    (nfields,) = struct.unpack_from("<i", buf, pos)
+    pos += 4
+    fields = []
+    for _ in range(nfields):
+        f, pos = read_block(buf, pos)
+        fields.append(f)
+    (n,) = struct.unpack_from("<i", buf, pos)
+    pos += 4
+    offsets = np.frombuffer(buf[pos:pos + 4 * (n + 1)], dtype=np.int32).copy()
+    pos += 4 * (n + 1)
+    nulls, pos = _decode_nulls(buf, pos, n)
+    return RowBlock(fields, offsets, nulls), pos
+
+
+# ---------------------------------------------------------------------------
+# page-level serde
+# ---------------------------------------------------------------------------
+
+def _checksum(page_data: bytes, markers: int, position_count: int,
+              uncompressed_size: int) -> int:
+    crc = zlib.crc32(page_data)
+    crc = zlib.crc32(bytes([markers & 0xFF]), crc)
+    crc = zlib.crc32(struct.pack("<i", position_count), crc)
+    crc = zlib.crc32(struct.pack("<i", uncompressed_size), crc)
+    return crc & 0xFFFFFFFF
+
+
+def serialize_page(page: Page, checksummed: bool = True) -> bytes:
+    body = io.BytesIO()
+    body.write(struct.pack("<i", page.channel_count))
+    for b in page.blocks:
+        write_block(body, b)
+    data = body.getvalue()
+    markers = CHECKSUMMED if checksummed else 0
+    checksum = (_checksum(data, markers, page.position_count, len(data))
+                if checksummed else 0)
+    header = struct.pack("<ibiiq", page.position_count, markers,
+                         len(data), len(data), checksum)
+    return header + data
+
+
+def deserialize_page(buf: bytes, pos: int = 0):
+    """Returns (Page, next_pos)."""
+    view = memoryview(buf)
+    position_count, markers, uncompressed_size, size, checksum = struct.unpack_from(
+        "<ibiiq", view, pos)
+    pos += PAGE_METADATA_SIZE
+    data = view[pos:pos + size]
+    if markers & COMPRESSED:
+        raise NotImplementedError("compressed pages not supported yet")
+    if markers & ENCRYPTED:
+        raise NotImplementedError("encrypted pages not supported")
+    if markers & CHECKSUMMED:
+        actual = _checksum(bytes(data), markers, position_count, uncompressed_size)
+        if actual != (checksum & 0xFFFFFFFF):
+            raise ValueError(
+                f"page checksum mismatch: {actual:#x} != {checksum:#x}")
+    (channels,) = struct.unpack_from("<i", data, 0)
+    p = 4
+    blocks: List[Block] = []
+    for _ in range(channels):
+        b, p = read_block(data, p)
+        blocks.append(b)
+    return Page(blocks, position_count), pos + size
+
+
+def serialize_pages(pages) -> bytes:
+    return b"".join(serialize_page(p) for p in pages)
+
+
+def deserialize_pages(buf: bytes):
+    pages, pos = [], 0
+    while pos < len(buf):
+        page, pos = deserialize_page(buf, pos)
+        pages.append(page)
+    return pages

@@ -1,0 +1,409 @@
+"""Presto type system, host side.
+
+Re-implements the semantics of the reference type system
+(presto-common/src/main/java/com/facebook/presto/common/type/, 84 files) for the
+subset of types reachable from the TPC-H / TPC-DS vocabulary, plus the structural
+types needed for nested data.  Each type knows its storage class (which Block kind
+holds its values, mirroring Type.getBlockBuilder in the reference) and its device
+representation (the numpy/JAX dtype used by the TPU execution engine).
+
+Storage-class mapping (same as the reference):
+  BOOLEAN, TINYINT          -> BYTE_ARRAY   (int8)
+  SMALLINT                  -> SHORT_ARRAY  (int16)
+  INTEGER, DATE, REAL       -> INT_ARRAY    (int32; REAL stores float bits)
+  BIGINT, DOUBLE, TIMESTAMP,
+  short DECIMAL(p<=18)      -> LONG_ARRAY   (int64; DOUBLE stores float bits,
+                                             short decimal stores unscaled value)
+  long DECIMAL(p>18)        -> INT128_ARRAY
+  VARCHAR, CHAR, VARBINARY  -> VARIABLE_WIDTH
+  ARRAY / MAP / ROW         -> nested blocks
+"""
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Optional, Tuple
+
+import numpy as np
+
+# Wire/storage classes (match the BlockEncoding NAME constants in the reference,
+# presto-common/.../block/*BlockEncoding.java)
+BYTE_ARRAY = "BYTE_ARRAY"
+SHORT_ARRAY = "SHORT_ARRAY"
+INT_ARRAY = "INT_ARRAY"
+LONG_ARRAY = "LONG_ARRAY"
+INT128_ARRAY = "INT128_ARRAY"
+VARIABLE_WIDTH = "VARIABLE_WIDTH"
+ARRAY = "ARRAY"
+MAP = "MAP"
+ROW = "ROW"
+
+_STORAGE_NP_DTYPE = {
+    BYTE_ARRAY: np.int8,
+    SHORT_ARRAY: np.int16,
+    INT_ARRAY: np.int32,
+    LONG_ARRAY: np.int64,
+}
+
+
+@dataclass(frozen=True)
+class Type:
+    """Base class for all Presto types.  `signature` round-trips through the
+    TypeParser below (reference: TypeSignature.java / TypeParser in presto_cpp)."""
+
+    @property
+    def signature(self) -> str:
+        raise NotImplementedError
+
+    # Which block kind stores values of this type.
+    @property
+    def storage(self) -> str:
+        raise NotImplementedError
+
+    @property
+    def fixed_width(self) -> bool:
+        return self.storage in _STORAGE_NP_DTYPE or self.storage == INT128_ARRAY
+
+    @property
+    def np_dtype(self):
+        """dtype of the *storage* array (bit pattern on the wire)."""
+        if self.storage in _STORAGE_NP_DTYPE:
+            return np.dtype(_STORAGE_NP_DTYPE[self.storage])
+        raise TypeError(f"{self.signature} has no fixed-width numpy dtype")
+
+    @property
+    def value_dtype(self):
+        """dtype of the *logical* value array used on device (e.g. float64 for
+        DOUBLE even though the wire stores raw int64 bits)."""
+        return self.np_dtype
+
+    def __str__(self) -> str:
+        return self.signature
+
+
+@dataclass(frozen=True)
+class BooleanType(Type):
+    @property
+    def signature(self):
+        return "boolean"
+
+    @property
+    def storage(self):
+        return BYTE_ARRAY
+
+    @property
+    def value_dtype(self):
+        return np.dtype(np.bool_)
+
+
+@dataclass(frozen=True)
+class TinyintType(Type):
+    @property
+    def signature(self):
+        return "tinyint"
+
+    @property
+    def storage(self):
+        return BYTE_ARRAY
+
+
+@dataclass(frozen=True)
+class SmallintType(Type):
+    @property
+    def signature(self):
+        return "smallint"
+
+    @property
+    def storage(self):
+        return SHORT_ARRAY
+
+
+@dataclass(frozen=True)
+class IntegerType(Type):
+    @property
+    def signature(self):
+        return "integer"
+
+    @property
+    def storage(self):
+        return INT_ARRAY
+
+
+@dataclass(frozen=True)
+class BigintType(Type):
+    @property
+    def signature(self):
+        return "bigint"
+
+    @property
+    def storage(self):
+        return LONG_ARRAY
+
+
+@dataclass(frozen=True)
+class RealType(Type):
+    @property
+    def signature(self):
+        return "real"
+
+    @property
+    def storage(self):
+        return INT_ARRAY
+
+    @property
+    def value_dtype(self):
+        return np.dtype(np.float32)
+
+
+@dataclass(frozen=True)
+class DoubleType(Type):
+    @property
+    def signature(self):
+        return "double"
+
+    @property
+    def storage(self):
+        return LONG_ARRAY
+
+    @property
+    def value_dtype(self):
+        return np.dtype(np.float64)
+
+
+@dataclass(frozen=True)
+class DateType(Type):
+    """Days since 1970-01-01, stored int32 (reference DateType.java)."""
+
+    @property
+    def signature(self):
+        return "date"
+
+    @property
+    def storage(self):
+        return INT_ARRAY
+
+
+@dataclass(frozen=True)
+class TimestampType(Type):
+    """Milliseconds since epoch, stored int64 (reference TimestampType.java)."""
+
+    @property
+    def signature(self):
+        return "timestamp"
+
+    @property
+    def storage(self):
+        return LONG_ARRAY
+
+
+@dataclass(frozen=True)
+class DecimalType(Type):
+    """DECIMAL(precision, scale); unscaled integer storage.  p<=18 is a "short"
+    decimal in int64, larger is an int128 pair (reference DecimalType.java)."""
+
+    precision: int = 38
+    scale: int = 0
+
+    @property
+    def signature(self):
+        return f"decimal({self.precision},{self.scale})"
+
+    @property
+    def is_short(self):
+        return self.precision <= 18
+
+    @property
+    def storage(self):
+        return LONG_ARRAY if self.is_short else INT128_ARRAY
+
+
+@dataclass(frozen=True)
+class VarcharType(Type):
+    # length is a bound, not storage: unbounded signified by None
+    length: Optional[int] = None
+
+    @property
+    def signature(self):
+        if self.length is None:
+            return "varchar"
+        return f"varchar({self.length})"
+
+    @property
+    def storage(self):
+        return VARIABLE_WIDTH
+
+
+@dataclass(frozen=True)
+class CharType(Type):
+    length: int = 1
+
+    @property
+    def signature(self):
+        return f"char({self.length})"
+
+    @property
+    def storage(self):
+        return VARIABLE_WIDTH
+
+
+@dataclass(frozen=True)
+class VarbinaryType(Type):
+    @property
+    def signature(self):
+        return "varbinary"
+
+    @property
+    def storage(self):
+        return VARIABLE_WIDTH
+
+
+@dataclass(frozen=True)
+class UnknownType(Type):
+    """Type of NULL literals (reference UnknownType.java); storage byte."""
+
+    @property
+    def signature(self):
+        return "unknown"
+
+    @property
+    def storage(self):
+        return BYTE_ARRAY
+
+
+@dataclass(frozen=True)
+class ArrayType(Type):
+    element: Type = field(default_factory=lambda: UNKNOWN)
+
+    @property
+    def signature(self):
+        return f"array({self.element.signature})"
+
+    @property
+    def storage(self):
+        return ARRAY
+
+
+@dataclass(frozen=True)
+class MapType(Type):
+    key: Type = field(default_factory=lambda: UNKNOWN)
+    value: Type = field(default_factory=lambda: UNKNOWN)
+
+    @property
+    def signature(self):
+        return f"map({self.key.signature},{self.value.signature})"
+
+    @property
+    def storage(self):
+        return MAP
+
+
+@dataclass(frozen=True)
+class RowType(Type):
+    names: Tuple[Optional[str], ...] = ()
+    types: Tuple[Type, ...] = ()
+
+    @property
+    def signature(self):
+        parts = []
+        for name, typ in zip(self.names, self.types):
+            if name:
+                parts.append(f"{name} {typ.signature}")
+            else:
+                parts.append(typ.signature)
+        return f"row({','.join(parts)})"
+
+    @property
+    def storage(self):
+        return ROW
+
+
+# Singletons
+BOOLEAN = BooleanType()
+TINYINT = TinyintType()
+SMALLINT = SmallintType()
+INTEGER = IntegerType()
+BIGINT = BigintType()
+REAL = RealType()
+DOUBLE = DoubleType()
+DATE = DateType()
+TIMESTAMP = TimestampType()
+VARCHAR = VarcharType()
+VARBINARY = VarbinaryType()
+UNKNOWN = UnknownType()
+
+_SIMPLE = {
+    "boolean": BOOLEAN,
+    "tinyint": TINYINT,
+    "smallint": SMALLINT,
+    "integer": INTEGER,
+    "int": INTEGER,
+    "bigint": BIGINT,
+    "real": REAL,
+    "double": DOUBLE,
+    "date": DATE,
+    "timestamp": TIMESTAMP,
+    "varchar": VARCHAR,
+    "varbinary": VARBINARY,
+    "unknown": UNKNOWN,
+}
+
+_PAREN_RE = re.compile(r"^(\w+)\((.*)\)$")
+
+
+def _split_top_level(s: str) -> list:
+    parts, depth, start = [], 0, 0
+    for i, c in enumerate(s):
+        if c == "(":
+            depth += 1
+        elif c == ")":
+            depth -= 1
+        elif c == "," and depth == 0:
+            parts.append(s[start:i].strip())
+            start = i + 1
+    if s[start:].strip():
+        parts.append(s[start:].strip())
+    return parts
+
+
+def parse_type(sig: str) -> Type:
+    """Parse a type signature string (reference: presto_cpp/main/types/TypeParser)."""
+    s = sig.strip()
+    low = s.lower()
+    if low in _SIMPLE:
+        return _SIMPLE[low]
+    m = _PAREN_RE.match(s)
+    if not m:
+        raise ValueError(f"cannot parse type signature: {sig!r}")
+    base, args = m.group(1).lower(), m.group(2)
+    if base == "decimal":
+        p, sc = [int(x) for x in _split_top_level(args)]
+        return DecimalType(p, sc)
+    if base == "varchar":
+        return VarcharType(int(args))
+    if base == "char":
+        return CharType(int(args))
+    if base == "array":
+        return ArrayType(parse_type(args))
+    if base == "map":
+        k, v = _split_top_level(args)
+        return MapType(parse_type(k), parse_type(v))
+    if base == "row":
+        names, types = [], []
+        for part in _split_top_level(args):
+            tokens = part.split(None, 1)
+            # "name type" when the remainder parses as a type on its own;
+            # handles field names that collide with type keywords (row(date date)).
+            parsed = None
+            if len(tokens) == 2 and "(" not in tokens[0]:
+                try:
+                    parsed = parse_type(tokens[1])
+                except ValueError:
+                    parsed = None
+            if parsed is not None:
+                names.append(tokens[0].strip('"'))
+                types.append(parsed)
+            else:
+                names.append(None)
+                types.append(parse_type(part))
+        return RowType(tuple(names), tuple(types))
+    raise ValueError(f"cannot parse type signature: {sig!r}")
