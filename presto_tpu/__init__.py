@@ -1,0 +1,13 @@
+"""presto-tpu-execution: a TPU-native Presto worker backend.
+
+See SURVEY.md for the structural analysis of the reference (PrestoDB) this
+framework is built against, and README.md for the architecture overview.
+"""
+import jax as _jax
+
+# The engine's value domains are 64-bit (BIGINT, DOUBLE, long decimal
+# accumulators), mirroring the JVM's long/double.  x64 must be on before any
+# array is created.
+_jax.config.update("jax_enable_x64", True)
+
+__version__ = "0.1.0"

@@ -18,8 +18,8 @@ import numpy as np
 
 from .types import (
     BYTE_ARRAY, SHORT_ARRAY, INT_ARRAY, LONG_ARRAY, INT128_ARRAY,
-    VARIABLE_WIDTH, ARRAY, MAP, ROW, Type, DecimalType, DoubleType, RealType,
-    BooleanType, VarcharType, CharType, VarbinaryType,
+    VARIABLE_WIDTH, ARRAY, MAP, ROW, Type, DateType, DecimalType, DoubleType,
+    RealType, BooleanType, VarcharType, CharType, VarbinaryType,
 )
 
 _WIDTH_TO_ENCODING = {1: BYTE_ARRAY, 2: SHORT_ARRAY, 4: INT_ARRAY, 8: LONG_ARRAY}
@@ -448,6 +448,9 @@ def block_to_values(typ: Type, block: Block) -> list:
                 for v, n in zip(vals, block.null_mask())]
     if isinstance(typ, BooleanType):
         return [None if n else bool(v)
+                for v, n in zip(block.values, block.null_mask())]
+    if isinstance(typ, DateType):
+        return [None if n else str(np.datetime64(int(v), "D"))
                 for v, n in zip(block.values, block.null_mask())]
     if isinstance(typ, DecimalType):
         raw = block.to_pylist()  # Int128Block.to_pylist handles sign-magnitude

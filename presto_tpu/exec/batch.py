@@ -1,0 +1,239 @@
+"""Device-side columnar batch model.
+
+The TPU analog of the reference's Page-in-the-Driver-loop (Driver.java:421-451):
+a Batch is a fixed-capacity set of device arrays plus a row-validity mask.
+Everything is static-shaped so XLA compiles each pipeline once per capacity
+class (SURVEY.md §7 hard part 3: padded fixed-size batches + validity masks).
+
+Columns:
+  values      jnp array, logical dtype (int64 / int32 / float64 / bool)
+  nulls       optional bool array (True == SQL NULL)
+  dictionary  optional tuple of python strings: `values` are int32 codes into
+              it.  Static metadata (pytree aux), so string predicates are
+              precomputed host-side into code sets and stay out of the traced
+              computation.
+
+The row mask subsumes both selection (filters clear bits) and padding (the
+tail of a partially-filled batch).  Operators never compact; aggregations and
+outputs read the mask.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..common.block import (DictionaryBlock, FixedWidthBlock, RunLengthBlock,
+                            VariableWidthBlock, decode_to_flat)
+from ..common.page import Page
+from ..common.types import (BooleanType, DateType, DecimalType, DoubleType,
+                            IntegerType, RealType, Type, VarcharType, CharType)
+
+
+class Column:
+    def __init__(self, values, nulls=None,
+                 dictionary: Optional[Tuple[str, ...]] = None,
+                 lazy: Optional[Tuple] = None):
+        self.values = values
+        self.nulls = nulls
+        self.dictionary = dictionary
+        # late materialization: ("tpch", table, column, sf) — `values` are
+        # global row indices; strings realized at output boundaries
+        self.lazy = lazy
+
+    def tree_flatten(self):
+        if self.nulls is None:
+            return (self.values,), ("no_nulls", self.dictionary, self.lazy)
+        return (self.values, self.nulls), ("nulls", self.dictionary, self.lazy)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        tag, dictionary, lazy = aux
+        if tag == "no_nulls":
+            return cls(children[0], None, dictionary, lazy)
+        return cls(children[0], children[1], dictionary, lazy)
+
+    def null_mask(self):
+        if self.nulls is None:
+            return jnp.zeros(self.values.shape, dtype=bool)
+        return self.nulls
+
+    def gather(self, idx) -> "Column":
+        """Row gather preserving dictionary/lazy metadata."""
+        return Column(self.values[idx],
+                      None if self.nulls is None else self.nulls[idx],
+                      self.dictionary, self.lazy)
+
+    def slice_rows(self, lo, hi) -> "Column":
+        return Column(self.values[lo:hi],
+                      None if self.nulls is None else self.nulls[lo:hi],
+                      self.dictionary, self.lazy)
+
+    def __repr__(self):
+        d = f", dict[{len(self.dictionary)}]" if self.dictionary else ""
+        return f"Column({self.values.dtype}{self.values.shape}{d})"
+
+
+jax.tree_util.register_pytree_node_class(Column)
+
+
+class Batch:
+    def __init__(self, columns: Dict[str, Column], mask):
+        self.columns = columns
+        self.mask = mask
+
+    def tree_flatten(self):
+        names = tuple(sorted(self.columns))
+        return tuple(self.columns[n] for n in names) + (self.mask,), names
+
+    @classmethod
+    def tree_unflatten(cls, names, children):
+        return cls(dict(zip(names, children[:-1])), children[-1])
+
+    @property
+    def capacity(self) -> int:
+        return int(self.mask.shape[0])
+
+    def column(self, name: str) -> Column:
+        return self.columns[name]
+
+    def with_columns(self, new: Dict[str, Column]) -> "Batch":
+        cols = dict(self.columns)
+        cols.update(new)
+        return Batch(cols, self.mask)
+
+    def select(self, names) -> "Batch":
+        return Batch({n: self.columns[n] for n in names}, self.mask)
+
+    def with_mask(self, mask) -> "Batch":
+        return Batch(self.columns, mask)
+
+    def row_count(self):
+        return jnp.sum(self.mask)
+
+    def __repr__(self):
+        return f"Batch({list(self.columns)}, capacity={self.capacity})"
+
+
+jax.tree_util.register_pytree_node_class(Batch)
+
+
+# ---------------------------------------------------------------------------
+# host <-> device conversion
+# ---------------------------------------------------------------------------
+
+def _logical_np(typ: Type, values: np.ndarray) -> np.ndarray:
+    """Storage-dtype numpy array -> logical-dtype numpy array."""
+    if isinstance(typ, DoubleType):
+        return values.view(np.float64) if values.dtype != np.float64 else values
+    if isinstance(typ, RealType):
+        return values.view(np.float32) if values.dtype != np.float32 else values
+    if isinstance(typ, BooleanType):
+        return values.astype(bool)
+    return values
+
+
+def block_to_column(typ: Type, block, capacity: int) -> Column:
+    """Host block -> padded device column."""
+    dictionary = None
+    if isinstance(block, DictionaryBlock):
+        flat = decode_to_flat(block.dictionary)
+        if isinstance(flat, VariableWidthBlock):
+            dictionary = tuple(flat.to_pylist())
+            codes = np.zeros(capacity, dtype=np.int32)
+            codes[:block.position_count] = block.ids
+            nulls = None
+            if flat.nulls is not None:
+                nm = np.zeros(capacity, dtype=bool)
+                nm[:block.position_count] = flat.null_mask()[block.ids]
+                nulls = jnp.asarray(nm)
+            return Column(jnp.asarray(codes), nulls, dictionary)
+        block = decode_to_flat(block)
+    else:
+        block = decode_to_flat(block)
+
+    if isinstance(block, VariableWidthBlock):
+        # Dictionary-encode on the host: device sees int32 codes.
+        strings = block.to_pylist()
+        uniq = sorted({s for s in strings if s is not None})
+        index = {s: i for i, s in enumerate(uniq)}
+        codes = np.zeros(capacity, dtype=np.int32)
+        codes[:len(strings)] = [0 if s is None else index[s] for s in strings]
+        nulls = None
+        if block.nulls is not None:
+            nm = np.zeros(capacity, dtype=bool)
+            nm[:len(strings)] = block.null_mask()
+            nulls = jnp.asarray(nm)
+        return Column(jnp.asarray(codes), nulls, tuple(uniq))
+
+    if not isinstance(block, FixedWidthBlock):
+        raise NotImplementedError(
+            f"device column from {type(block).__name__} not supported yet")
+
+    logical = _logical_np(typ, block.values)
+    padded = np.zeros(capacity, dtype=logical.dtype)
+    padded[:len(logical)] = logical
+    nulls = None
+    if block.nulls is not None:
+        nm = np.zeros(capacity, dtype=bool)
+        nm[:block.position_count] = block.nulls
+        nulls = jnp.asarray(nm)
+    return Column(jnp.asarray(padded), nulls)
+
+
+def page_to_batch(page: Page, names, types, capacity: int) -> Batch:
+    """Host page -> device batch (pads to capacity)."""
+    if page.position_count > capacity:
+        raise ValueError(f"page of {page.position_count} rows > capacity {capacity}")
+    cols = {}
+    for name, typ, block in zip(names, types, page.blocks):
+        cols[name] = block_to_column(typ, block, capacity)
+    mask = np.zeros(capacity, dtype=bool)
+    mask[:page.position_count] = True
+    return Batch(cols, jnp.asarray(mask))
+
+
+def batch_to_page(batch: Batch, names, types) -> Page:
+    """Device batch -> host page (drops masked-out rows)."""
+    mask = np.asarray(batch.mask)
+    keep = np.flatnonzero(mask)
+    blocks = []
+    for name, typ in zip(names, types):
+        col = batch.columns[name]
+        values = np.asarray(col.values)[keep]
+        nulls = None if col.nulls is None else np.asarray(col.nulls)[keep]
+        if col.lazy is not None:
+            from ..connectors import tpch as _tpch
+            _, table, column, sf = col.lazy
+            strings = _tpch.generate_values_at(table, column, sf, values)
+            if nulls is not None:
+                strings = [None if n else s for s, n in zip(strings, nulls)]
+            from ..common.block import VariableWidthBlock as VB
+            blocks.append(VB.from_strings(strings))
+            continue
+        if col.dictionary is not None:
+            from ..common.block import DictionaryBlock as HB, VariableWidthBlock as VB
+            dict_block = VB.from_strings(list(col.dictionary))
+            blocks.append(HB(values.astype(np.int32), dict_block))
+            continue
+        if isinstance(typ, (VarcharType, CharType)):
+            raise NotImplementedError("varchar column without dictionary")
+        if isinstance(typ, DecimalType) and not typ.is_short:
+            # device accumulates long decimals in int64; widen on the host
+            from ..common.block import Int128Block
+            ints = [None if (nulls is not None and nulls[i]) else int(v)
+                    for i, v in enumerate(values)]
+            blocks.append(Int128Block.from_ints(ints, nulls))
+            continue
+        if isinstance(typ, BooleanType):
+            values = values.astype(np.int8)
+        elif isinstance(typ, (DoubleType, RealType)):
+            pass  # float bits pass through FixedWidthBlock
+        elif values.dtype not in (np.int8, np.int16, np.int32, np.int64):
+            values = values.astype(typ.np_dtype)
+        if isinstance(typ, (IntegerType, DateType)):
+            values = values.astype(np.int32)
+        blocks.append(FixedWidthBlock(values, nulls))
+    return Page(blocks, len(keep))

@@ -1,0 +1,505 @@
+"""Fragment plan -> executable pipelines.
+
+The TPU analog of the reference LocalExecutionPlanner
+(presto-main-base/.../sql/planner/LocalExecutionPlanner.java:363: visitTableScan
+:1612, visitAggregation :1360, visitJoin :1934) plus the Driver page loop
+(operator/Driver.java:303,421-451).  Differences forced by XLA:
+
+- Linear Filter/Project chains above a leaf are FUSED into one jitted function
+  per batch (XLA fuses the elementwise work into one kernel), instead of an
+  operator chain passing pages.
+- Aggregation is a jitted scatter-update per batch over a persistent device
+  table (operators.agg_update) with host-side salt retry on slot collisions.
+- Joins materialize the build side on device, then stream probe batches
+  through a jitted searchsorted probe with a static output capacity; probe
+  overflow splits the probe batch and retries.
+- All shapes static: (capacity, agg slots, join capacity) come from the
+  ExecutionConfig, and jit caching is keyed by them.
+"""
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Iterator, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..common.page import Page
+from ..common.types import (BIGINT, BOOLEAN, DOUBLE, DecimalType, DoubleType,
+                            RealType, Type, VarcharType, CharType)
+from ..connectors import tpch
+from ..spi.expr import (CallExpression, RowExpression,
+                        VariableReferenceExpression)
+from ..spi import plan as P
+from .batch import Batch, Column, batch_to_page, page_to_batch
+from . import operators as ops
+from .lowering import Lowering, canonical_name
+
+DEFAULT_CAPACITY = 1 << 20
+
+
+@dataclass
+class ExecutionConfig:
+    batch_rows: int = DEFAULT_CAPACITY      # scan page/batch capacity
+    agg_slots: int = 4096                   # initial group table size
+    join_out_capacity: int = 1 << 21        # probe output capacity
+    max_agg_retries: int = 6
+    splits_per_scan: int = 4
+
+
+@dataclass
+class TaskContext:
+    """Execution context for one task: configuration + split assignment."""
+    config: ExecutionConfig = field(default_factory=ExecutionConfig)
+    # table-scan node id -> list of splits this task owns
+    splits: Dict[str, List[tpch.TpchSplit]] = field(default_factory=dict)
+    # remote-source node id -> iterator of host Pages (exchange input)
+    remote_pages: Dict[str, Callable[[], Iterator[Tuple[Page, List[str], List[Type]]]]] = field(default_factory=dict)
+
+
+def _var_types(variables) -> List[Type]:
+    return [v.type for v in variables]
+
+
+def output_schema(node: P.PlanNode) -> Tuple[List[str], List[Type]]:
+    vs = node.output_variables
+    return [v.name for v in vs], [v.type for v in vs]
+
+
+# ---------------------------------------------------------------------------
+# batch-source compilation (recursive)
+# ---------------------------------------------------------------------------
+
+class BatchSource:
+    """A compiled sub-pipeline that can be iterated (possibly repeatedly)."""
+
+    def __init__(self, fn: Callable[[], Iterator[Batch]],
+                 names: List[str], types: List[Type]):
+        self._fn = fn
+        self.names = names
+        self.types = types
+
+    def batches(self) -> Iterator[Batch]:
+        return self._fn()
+
+
+class PlanCompiler:
+    def __init__(self, ctx: TaskContext):
+        self.ctx = ctx
+        self.lowering = Lowering()
+        self._jit_cache: Dict = {}
+
+    # -- public -----------------------------------------------------------
+    def compile(self, root: P.PlanNode) -> BatchSource:
+        return self._compile(root)
+
+    def run_to_pages(self, root: P.PlanNode) -> Iterator[Page]:
+        src = self.compile(root)
+        for batch in src.batches():
+            page = batch_to_page(batch, src.names, src.types)
+            if page.position_count:
+                yield page
+
+    # -- dispatch ---------------------------------------------------------
+    def _compile(self, node: P.PlanNode) -> BatchSource:
+        m = getattr(self, "_compile_" + type(node).__name__, None)
+        if m is None:
+            raise NotImplementedError(f"no compiler for {type(node).__name__}")
+        return m(node)
+
+    # -- leaves -----------------------------------------------------------
+    def _compile_TableScanNode(self, node: P.TableScanNode) -> BatchSource:
+        names = [v.name for v in node.outputs]
+        types = [v.type for v in node.outputs]
+        columns = [node.assignments[v].name for v in node.outputs]
+        th = node.table
+        sf = dict(th.extra).get("scaleFactor", 0.01)
+        splits = self.ctx.splits.get(node.id)
+        if splits is None:
+            splits = tpch.make_splits(th.table_name, sf,
+                                      self.ctx.config.splits_per_scan)
+        cap = self.ctx.config.batch_rows
+        table = th.table_name
+
+        def gen():
+            for split in splits:
+                pos = split.start
+                while pos < split.end:
+                    n = min(cap, split.end - pos)
+                    cols = {}
+                    for name, colname in zip(names, columns):
+                        if (table, colname) in tpch.OPEN_DOMAIN:
+                            # late-materialized: row ids on device
+                            ids = np.zeros(cap, dtype=np.int64)
+                            ids[:n] = np.arange(pos, pos + n)
+                            cols[name] = Column(
+                                jnp.asarray(ids), None, None,
+                                ("tpch", table, colname, split.sf))
+                            continue
+                        raw = tpch.generate_column(table, colname, split.sf,
+                                                   pos, n)
+                        if isinstance(raw, tuple):
+                            codes, values = raw
+                            buf = np.zeros(cap, dtype=np.int32)
+                            buf[:n] = codes
+                            cols[name] = Column(jnp.asarray(buf), None,
+                                                tuple(values))
+                        else:
+                            dtype = (np.int32 if raw.dtype == np.int32 or
+                                     colname.endswith("date") or
+                                     tpch.column_type(table, colname).storage
+                                     == "INT_ARRAY" else np.int64)
+                            buf = np.zeros(cap, dtype=dtype)
+                            buf[:n] = raw
+                            cols[name] = Column(jnp.asarray(buf))
+                    mask = np.zeros(cap, dtype=bool)
+                    mask[:n] = True
+                    yield Batch(cols, jnp.asarray(mask))
+                    pos += n
+        return BatchSource(gen, names, types)
+
+    def _compile_ValuesNode(self, node: P.ValuesNode) -> BatchSource:
+        names = [v.name for v in node.outputs]
+        types = [v.type for v in node.outputs]
+        from ..common.block import block_from_values
+        from .lowering import constant_device_value
+
+        def gen():
+            n = len(node.rows)
+            cap = max(n, 1)
+            cols = {}
+            for i, (name, typ) in enumerate(zip(names, types)):
+                vals = [constant_device_value(r[i].value, typ)
+                        for r in node.rows]
+                blk = block_from_values(
+                    typ, [None if v is None else v for v in vals]
+                    if not isinstance(typ, (VarcharType, CharType))
+                    else [None if v is None else str(v) for v in vals])
+                from .batch import block_to_column
+                cols[name] = block_to_column(typ, blk, cap)
+            mask = np.zeros(cap, dtype=bool)
+            mask[:n] = True
+            yield Batch(cols, jnp.asarray(mask))
+        return BatchSource(gen, names, types)
+
+    def _compile_RemoteSourceNode(self, node: P.RemoteSourceNode) -> BatchSource:
+        names = [v.name for v in node.outputs]
+        types = [v.type for v in node.outputs]
+        source = self.ctx.remote_pages[node.id]
+        cap = self.ctx.config.batch_rows
+
+        def gen():
+            for page in source():
+                yield page_to_batch(page, names, types, cap)
+        return BatchSource(gen, names, types)
+
+    # -- streaming transforms --------------------------------------------
+    def _compile_FilterNode(self, node: P.FilterNode) -> BatchSource:
+        src = self._compile(node.source)
+        pred = node.predicate
+        low = self.lowering
+
+        @jax.jit
+        def step(batch):
+            return ops.apply_filter(batch, low.eval(pred, batch))
+
+        def gen():
+            for b in src.batches():
+                yield step(b)
+        return BatchSource(gen, src.names, src.types)
+
+    def _compile_ProjectNode(self, node: P.ProjectNode) -> BatchSource:
+        src = self._compile(node.source)
+        names = [v.name for v in node.assignments]
+        types = [v.type for v in node.assignments]
+        items = list(node.assignments.items())
+        low = self.lowering
+
+        @jax.jit
+        def step(batch):
+            cols = {v.name: low.eval(e, batch) for v, e in items}
+            return Batch(cols, batch.mask)
+
+        def gen():
+            for b in src.batches():
+                yield step(b)
+        return BatchSource(gen, names, types)
+
+    def _compile_OutputNode(self, node: P.OutputNode) -> BatchSource:
+        src = self._compile(node.source)
+        # OutputNode renames columns positionally
+        inner = [v.name for v in node.source.output_variables]
+        outer = [v.name for v in node.outputs]
+        types = [v.type for v in node.outputs]
+        if inner == outer:
+            return BatchSource(src.batches, outer, types)
+
+        def gen():
+            for b in src.batches():
+                cols = {o: b.columns[i] for i, o in zip(inner, outer)}
+                yield Batch(cols, b.mask)
+        return BatchSource(gen, outer, types)
+
+    # -- limit / topn / sort ---------------------------------------------
+    def _compile_LimitNode(self, node: P.LimitNode) -> BatchSource:
+        src = self._compile(node.source)
+        n = node.count
+
+        @jax.jit
+        def step(batch, consumed):
+            return ops.limit(batch, n, consumed)
+
+        def gen():
+            consumed = jnp.zeros((), dtype=jnp.int64)
+            for b in src.batches():
+                out, consumed = step(b, consumed)
+                yield out
+                if int(consumed) >= n:
+                    break
+        return BatchSource(gen, src.names, src.types)
+
+    def _compile_TopNNode(self, node: P.TopNNode) -> BatchSource:
+        src = self._compile(node.source)
+        keys = [(v.name, order) for v, order in node.ordering_scheme.orderings]
+        n = node.count
+
+        @jax.jit
+        def step(buffer, batch):
+            merged = _concat_batches([buffer, batch])
+            return ops.topn(merged, keys, n)
+
+        @jax.jit
+        def first(batch):
+            return ops.topn(batch, keys, n)
+
+        def gen():
+            buf = None
+            for b in src.batches():
+                buf = first(b) if buf is None else step(buf, b)
+            if buf is not None:
+                yield buf
+        return BatchSource(gen, src.names, src.types)
+
+    def _compile_SortNode(self, node: P.SortNode) -> BatchSource:
+        src = self._compile(node.source)
+        keys = [(v.name, order) for v, order in node.ordering_scheme.orderings]
+
+        def gen():
+            all_batches = list(src.batches())
+            if not all_batches:
+                return
+            merged = jax.jit(_concat_batches)(all_batches) \
+                if len(all_batches) > 1 else all_batches[0]
+            yield jax.jit(ops.sort_batch, static_argnums=1)(merged, tuple(keys))
+        return BatchSource(gen, src.names, src.types)
+
+    def _compile_DistinctLimitNode(self, node: P.DistinctLimitNode) -> BatchSource:
+        agg = P.AggregationNode(node.id + ".agg", node.source, {},
+                                node.distinct_variables, P.SINGLE)
+        lim = P.LimitNode(node.id + ".limit", agg, node.count)
+        return self._compile(lim)
+
+    # -- aggregation ------------------------------------------------------
+    def _compile_AggregationNode(self, node: P.AggregationNode) -> BatchSource:
+        src_node = node.source
+        key_vars = node.grouping_keys
+        key_names = tuple(v.name for v in key_vars)
+        out_names = [v.name for v in key_vars] + [v.name for v in node.aggregations]
+        out_types = ([v.type for v in key_vars]
+                     + [v.type for v in node.aggregations])
+        low = self.lowering
+
+        specs = []
+        input_exprs: Dict[str, Optional[RowExpression]] = {}
+        for v, agg in node.aggregations.items():
+            fname = canonical_name(agg.call.display_name)
+            if fname == "count" and not agg.call.arguments:
+                fname = "count_star"
+            is_float = isinstance(v.type, (DoubleType, RealType)) or (
+                fname == "avg" and isinstance(v.type, (DoubleType, RealType)))
+            specs.append(ops.AggSpec(fname, v.name, is_float))
+            input_exprs[v.name] = (agg.call.arguments[0]
+                                   if agg.call.arguments else None)
+        specs = tuple(specs)
+
+        cfg = self.ctx.config
+
+        def run_once(num_slots: int, salt: int):
+            src = self._compile(src_node)
+            state = None
+            key_dicts: Dict[str, Tuple[str, ...]] = {}
+
+            @jax.jit
+            def update(state, batch):
+                key_cols = [batch.columns[k] for k in key_names]
+                agg_cols = {}
+                for out, expr in input_exprs.items():
+                    agg_cols[out] = (low.eval(expr, batch)
+                                     if expr is not None else None)
+                return ops.agg_update(state, batch, key_cols, agg_cols,
+                                      specs, num_slots, salt, key_names)
+
+            for batch in src.batches():
+                if state is None:
+                    key_cols = [batch.columns[k] for k in key_names]
+                    key_dtypes = [c.values.dtype for c in key_cols]
+                    for k, c in zip(key_names, key_cols):
+                        if c.dictionary is not None:
+                            key_dicts[k] = c.dictionary
+                    state = ops.agg_init(num_slots, specs, key_names,
+                                         key_dtypes)
+                state = update(state, batch)
+            if state is None:
+                key_dtypes = [jnp.int64] * len(key_names)
+                state = ops.agg_init(num_slots, specs, key_names, key_dtypes)
+            return state, key_dicts
+
+        def gen():
+            num_slots, salt = cfg.agg_slots, 0
+            for attempt in range(cfg.max_agg_retries):
+                state, key_dicts = run_once(num_slots, salt)
+                if not bool(state["__collision"]):
+                    break
+                num_slots *= 2
+                salt += 1
+            else:
+                raise RuntimeError("aggregation collision retries exhausted")
+            if not key_names and not bool(jnp.any(state["__occupied"])):
+                # global aggregation over empty input still yields one row
+                state["__occupied"] = state["__occupied"].at[0].set(True)
+            batch = ops.agg_finalize(state, specs, key_names, key_dicts, {})
+            yield batch
+        return BatchSource(gen, out_names, out_types)
+
+    # -- joins ------------------------------------------------------------
+    def _materialize(self, src: BatchSource) -> Optional[Batch]:
+        batches = list(src.batches())
+        if not batches:
+            return None
+        if len(batches) == 1:
+            return batches[0]
+        return jax.jit(_concat_batches)(batches)
+
+    def _compile_JoinNode(self, node: P.JoinNode) -> BatchSource:
+        if node.join_type not in (P.INNER, P.LEFT):
+            raise NotImplementedError(f"join type {node.join_type}")
+        probe_src_node, build_src_node = node.left, node.right
+        probe_keys = [l.name for l, r in node.criteria]
+        build_keys = [r.name for l, r in node.criteria]
+        out_names = [v.name for v in node.outputs]
+        out_types = [v.type for v in node.outputs]
+        build_names = [v.name for v in build_src_node.output_variables]
+        build_out = [n for n in out_names if n in build_names]
+        cfg = self.ctx.config
+        low = self.lowering
+        filter_expr = node.filter
+
+        def gen():
+            build_batch = self._materialize(self._compile(build_src_node))
+            probe = self._compile(probe_src_node)
+            if build_batch is None:
+                if node.join_type == P.INNER:
+                    return
+                raise NotImplementedError("LEFT join with empty build")
+            table = jax.jit(ops.build_table, static_argnums=(1,))(
+                build_batch, tuple(build_keys))
+
+            filter_fn = (None if filter_expr is None
+                         else (lambda pairs: low.eval(filter_expr, pairs)))
+
+            @jax.jit
+            def step(batch, table):
+                joined, overflow, total = ops.probe_join(
+                    batch, table, probe_keys, build_out,
+                    cfg.join_out_capacity, join_type=node.join_type,
+                    filter_fn=filter_fn)
+                return joined, overflow
+
+            for batch in probe.batches():
+                joined, overflow = step(batch, table)
+                if bool(overflow):
+                    # split the probe batch in halves and retry
+                    for half in _split_batch(batch):
+                        j2, ov2 = step(half, table)
+                        if bool(ov2):
+                            raise RuntimeError("join output overflow after split")
+                        yield j2.select(out_names)
+                else:
+                    yield joined.select(out_names)
+        return BatchSource(gen, out_names, out_types)
+
+    def _compile_SemiJoinNode(self, node: P.SemiJoinNode) -> BatchSource:
+        src = self._compile(node.source)
+        names = src.names + [node.semi_join_output.name]
+        types = src.types + [BOOLEAN]
+        key = node.source_join_variable.name
+        fkey = node.filtering_source_join_variable.name
+
+        def gen():
+            build_batch = self._materialize(self._compile(node.filtering_source))
+            if build_batch is None:
+                for b in src.batches():
+                    yield b.with_columns({node.semi_join_output.name: Column(
+                        jnp.zeros(b.capacity, dtype=bool), None)})
+                return
+            table = jax.jit(ops.build_table, static_argnums=(1,))(
+                build_batch, (fkey,))
+
+            @jax.jit
+            def step(batch, table):
+                marker = ops.semi_join_mark(batch, table, [key])
+                return batch.with_columns({node.semi_join_output.name: marker})
+
+            for b in src.batches():
+                yield step(b, table)
+        return BatchSource(gen, names, types)
+
+    # -- local exchange is a no-op in the single-task pipeline ------------
+    def _compile_ExchangeNode(self, node: P.ExchangeNode) -> BatchSource:
+        if len(node.exchange_sources) == 1 and not node.inputs:
+            return self._compile(node.exchange_sources[0])
+        sources = [self._compile(s) for s in node.exchange_sources]
+        out_vars = node.partitioning_scheme.output_layout
+        names = [v.name for v in out_vars]
+        types = [v.type for v in out_vars]
+
+        def gen():
+            for i, s in enumerate(sources):
+                in_names = ([v.name for v in node.inputs[i]]
+                            if node.inputs else s.names)
+                for b in s.batches():
+                    cols = {o: b.columns[n] for o, n in zip(names, in_names)}
+                    yield Batch(cols, b.mask)
+        return BatchSource(gen, names, types)
+
+
+# ---------------------------------------------------------------------------
+# batch utilities
+# ---------------------------------------------------------------------------
+
+def _concat_batches(batches: List[Batch]) -> Batch:
+    names = list(batches[0].columns)
+    cols = {}
+    for n in names:
+        first = batches[0].columns[n]
+        values = jnp.concatenate([b.columns[n].values for b in batches])
+        if any(b.columns[n].nulls is not None for b in batches):
+            nulls = jnp.concatenate([b.columns[n].null_mask() for b in batches])
+        else:
+            nulls = None
+        # dictionaries must agree (scan layer guarantees table-stable dicts)
+        cols[n] = Column(values, nulls, first.dictionary, first.lazy)
+    mask = jnp.concatenate([b.mask for b in batches])
+    return Batch(cols, mask)
+
+
+def _split_batch(batch: Batch) -> List[Batch]:
+    cap = batch.capacity
+    half = cap // 2
+    out = []
+    for lo, hi in ((0, half), (half, cap)):
+        cols = {n: c.slice_rows(lo, hi) for n, c in batch.columns.items()}
+        out.append(Batch(cols, batch.mask[lo:hi]))
+    return out

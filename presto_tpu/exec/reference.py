@@ -1,0 +1,656 @@
+"""Numpy reference executor: interprets the same plan IR on the host.
+
+The differential-testing oracle, playing the role H2 plays in the reference's
+QueryAssertions (presto-tests/.../tests/QueryAssertions.java:52,
+H2QueryRunner.java:105): every conformance test runs a query on the TPU engine
+and on this interpreter over identical generated data and diffs results.
+Implementation is deliberately simple row/column numpy code sharing nothing
+with the device engine (batch.py / operators.py / lowering.py) except the plan
+IR and the data generator.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..common.types import (BooleanType, CharType, DateType, DecimalType,
+                            DoubleType, RealType, Type, VarcharType)
+from ..connectors import tpch
+from ..spi import plan as P
+from ..spi.expr import (CallExpression, ConstantExpression, RowExpression,
+                        SpecialFormExpression, VariableReferenceExpression)
+from .lowering import canonical_name, constant_device_value
+
+Col = Tuple[np.ndarray, Optional[np.ndarray]]  # (values, nulls|None)
+
+
+class Table:
+    """name -> (values, nulls). Strings are object arrays, decimals unscaled
+    int64 (object for >int64), dates int days."""
+
+    def __init__(self, cols: Dict[str, Col], n: int):
+        self.cols = cols
+        self.n = n
+
+    def mask(self, keep: np.ndarray) -> "Table":
+        return Table({k: (v[keep], None if m is None else m[keep])
+                      for k, (v, m) in self.cols.items()}, int(keep.sum()))
+
+    def take(self, idx: np.ndarray) -> "Table":
+        return Table({k: (v[idx], None if m is None else m[idx])
+                      for k, (v, m) in self.cols.items()}, len(idx))
+
+
+def execute_reference(node: P.PlanNode) -> List[List]:
+    """Run a plan, return rows of python values (Decimal for decimals)."""
+    table = _exec(node)
+    names = [v.name for v in node.output_variables]
+    types = [v.type for v in node.output_variables]
+    return _to_rows(table, names, types)
+
+
+def _to_rows(table: Table, names, types) -> List[List]:
+    from decimal import Decimal
+    out = []
+    for i in range(table.n):
+        row = []
+        for name, typ in zip(names, types):
+            v, m = table.cols[name]
+            if m is not None and m[i]:
+                row.append(None)
+            elif isinstance(typ, DecimalType):
+                row.append(Decimal(int(v[i])) / (10 ** typ.scale))
+            elif isinstance(typ, DoubleType):
+                row.append(float(v[i]))
+            elif isinstance(typ, BooleanType):
+                row.append(bool(v[i]))
+            elif isinstance(typ, (VarcharType, CharType)):
+                row.append(str(v[i]))
+            elif isinstance(typ, DateType):
+                row.append(str(np.datetime64(int(v[i]), "D")))
+            else:
+                row.append(int(v[i]))
+        out.append(row)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# node execution
+# ---------------------------------------------------------------------------
+
+def _exec(node: P.PlanNode) -> Table:
+    fn = globals().get("_exec_" + type(node).__name__)
+    if fn is None:
+        raise NotImplementedError(type(node).__name__)
+    return fn(node)
+
+
+def _exec_TableScanNode(node: P.TableScanNode) -> Table:
+    th = node.table
+    sf = dict(th.extra).get("scaleFactor", 0.01)
+    n = tpch.table_row_count(th.table_name, sf)
+    cols = {}
+    for v in node.outputs:
+        cname = node.assignments[v].name
+        raw = tpch.generate_column(th.table_name, cname, sf, 0, n)
+        if isinstance(raw, tuple):
+            codes, values = raw
+            arr = np.array(values, dtype=object)[codes]
+        elif isinstance(raw, list):
+            arr = np.array(raw, dtype=object)
+        else:
+            arr = raw
+        cols[v.name] = (arr, None)
+    return Table(cols, n)
+
+
+def _exec_ValuesNode(node: P.ValuesNode) -> Table:
+    cols = {}
+    for i, v in enumerate(node.outputs):
+        vals, nulls = [], []
+        for row in node.rows:
+            c = row[i]
+            val = constant_device_value(c.value, v.type)
+            nulls.append(val is None)
+            vals.append(0 if val is None else val)
+        cols[v.name] = (np.array(vals, dtype=object),
+                        np.array(nulls) if any(nulls) else None)
+    return Table(cols, len(node.rows))
+
+
+def _exec_FilterNode(node: P.FilterNode) -> Table:
+    t = _exec(node.source)
+    v, m = _eval(node.predicate, t)
+    keep = v.astype(bool)
+    if m is not None:
+        keep = keep & ~m
+    return t.mask(keep)
+
+
+def _exec_ProjectNode(node: P.ProjectNode) -> Table:
+    t = _exec(node.source)
+    cols = {}
+    for var, expr in node.assignments.items():
+        cols[var.name] = _eval(expr, t)
+    return Table(cols, t.n)
+
+
+def _exec_OutputNode(node: P.OutputNode) -> Table:
+    t = _exec(node.source)
+    inner = [v.name for v in node.source.output_variables]
+    cols = {o.name: t.cols[i] for i, o in zip(inner, node.outputs)}
+    return Table(cols, t.n)
+
+
+def _exec_LimitNode(node: P.LimitNode) -> Table:
+    t = _exec(node.source)
+    idx = np.arange(min(node.count, t.n))
+    return t.take(idx)
+
+
+def _exec_ExchangeNode(node: P.ExchangeNode) -> Table:
+    parts = []
+    for i, s in enumerate(node.exchange_sources):
+        t = _exec(s)
+        if node.inputs:
+            mapping = {o.name: iv.name for o, iv in
+                       zip(node.partitioning_scheme.output_layout,
+                           node.inputs[i])}
+            t = Table({o: t.cols[iv] for o, iv in mapping.items()}, t.n)
+        parts.append(t)
+    if len(parts) == 1:
+        return parts[0]
+    names = list(parts[0].cols)
+    cols = {}
+    for nm in names:
+        vals = np.concatenate([p.cols[nm][0] for p in parts])
+        if any(p.cols[nm][1] is not None for p in parts):
+            nulls = np.concatenate([
+                p.cols[nm][1] if p.cols[nm][1] is not None
+                else np.zeros(p.n, bool) for p in parts])
+        else:
+            nulls = None
+        cols[nm] = (vals, nulls)
+    return Table(cols, sum(p.n for p in parts))
+
+
+def _sort_key_arrays(t: Table, orderings) -> list:
+    arrays = []
+    for var, order in reversed(orderings):
+        v, m = t.cols[var.name]
+        desc = order.startswith("DESC")
+        if v.dtype == object:
+            # rank-encode object values
+            uniq = sorted(set(v.tolist()), key=lambda x: (x is None, x))
+            rank = {u: i for i, u in enumerate(uniq)}
+            v = np.array([rank[x] for x in v.tolist()], dtype=np.int64)
+        vv = v.astype(np.float64) if v.dtype != np.float64 else v.copy()
+        vv = np.where(np.isnan(vv), np.inf, vv)
+        key = -vv if desc else vv
+        if m is not None:
+            nulls_first = order.endswith("NULLS_FIRST")
+            key = np.where(m, -np.inf if nulls_first else np.inf, key)
+        arrays.append(key)
+    return arrays
+
+
+def _exec_SortNode(node: P.SortNode) -> Table:
+    t = _exec(node.source)
+    idx = np.lexsort(tuple(_sort_key_arrays(t, node.ordering_scheme.orderings)))
+    return t.take(idx)
+
+
+def _exec_TopNNode(node: P.TopNNode) -> Table:
+    t = _exec(node.source)
+    idx = np.lexsort(tuple(_sort_key_arrays(t, node.ordering_scheme.orderings)))
+    return t.take(idx[:node.count])
+
+
+def _exec_AggregationNode(node: P.AggregationNode) -> Table:
+    t = _exec(node.source)
+    key_names = [v.name for v in node.grouping_keys]
+    if key_names:
+        key_arrays = [t.cols[k][0] for k in key_names]
+        combo = np.empty(t.n, dtype=object)
+        for i in range(t.n):
+            combo[i] = tuple(a[i] for a in key_arrays)
+        uniq, inverse = np.unique(combo, return_inverse=True)
+        n_groups = len(uniq)
+    else:
+        inverse = np.zeros(t.n, dtype=np.int64)
+        n_groups = 1
+    cols: Dict[str, Col] = {}
+    for k in key_names:
+        src, m = t.cols[k]
+        first = np.zeros(n_groups, dtype=src.dtype) if src.dtype != object \
+            else np.empty(n_groups, dtype=object)
+        firstm = np.zeros(n_groups, dtype=bool)
+        for i in range(t.n - 1, -1, -1):
+            first[inverse[i]] = src[i]
+            if m is not None:
+                firstm[inverse[i]] = m[i]
+        cols[k] = (first, firstm if m is not None and firstm.any() else None)
+
+    # group slices once: rows sorted by group id, reduceat over boundaries
+    order = np.argsort(inverse, kind="stable")
+    sorted_inv = inverse[order]
+    # boundary start index of each present group; absent groups impossible
+    # (inverse comes from np.unique)
+    starts = np.zeros(n_groups, dtype=np.int64)
+    if t.n:
+        boundaries = np.flatnonzero(np.diff(sorted_inv)) + 1
+        starts[sorted_inv[0]] = 0
+        starts = np.concatenate([[0], boundaries]) if n_groups > 1 else starts[:1]
+
+    for var, agg in node.aggregations.items():
+        fname = canonical_name(agg.call.display_name)
+        if agg.call.arguments:
+            av, am = _eval(agg.call.arguments[0], t)
+        else:
+            av, am = np.ones(t.n, dtype=np.int64), None
+        valid = np.ones(t.n, dtype=bool) if am is None else ~am
+        sv = av[order]
+        svalid = valid[order]
+        counts = np.add.reduceat(svalid.astype(np.int64), starts) \
+            if t.n else np.zeros(n_groups, dtype=np.int64)
+        outm = counts == 0
+        if fname == "count":
+            cols[var.name] = (counts.astype(object), None)
+            continue
+        # exact integer sums via object dtype; floats stay float64
+        if sv.dtype != object and not np.issubdtype(sv.dtype, np.floating):
+            sv = sv.astype(object)
+        if fname in ("sum", "avg"):
+            zero = 0.0 if np.issubdtype(np.asarray(sv[:1]).dtype, np.floating) \
+                and sv.dtype != object else 0
+            masked = np.where(svalid, sv, zero)
+            sums = np.add.reduceat(masked, starts) if t.n else \
+                np.zeros(n_groups, dtype=object)
+            if fname == "sum":
+                cols[var.name] = (np.asarray(sums, dtype=object),
+                                  outm if outm.any() else None)
+            else:
+                safe = np.where(outm, 1, counts)
+                if isinstance(var.type, DoubleType):
+                    out = np.array([float(s) / int(c)
+                                    for s, c in zip(sums, safe)])
+                else:
+                    out = np.empty(n_groups, dtype=object)
+                    for g in range(n_groups):
+                        s, c = int(sums[g]), int(safe[g])
+                        q = (abs(s) + c // 2) // c
+                        out[g] = q if s >= 0 else -q
+                cols[var.name] = (out, outm if outm.any() else None)
+        elif fname in ("min", "max"):
+            big = float("inf") if fname == "min" else float("-inf")
+            masked = np.where(svalid, sv, big)
+            red = np.minimum.reduceat if fname == "min" else np.maximum.reduceat
+            vals = red(masked, starts) if t.n else np.full(n_groups, big)
+            cols[var.name] = (np.asarray(vals, dtype=object),
+                              outm if outm.any() else None)
+        else:
+            raise NotImplementedError(fname)
+    return Table(cols, n_groups)
+
+
+def _exec_JoinNode(node: P.JoinNode) -> Table:
+    left = _exec(node.left)
+    right = _exec(node.right)
+    lkeys = [l.name for l, r in node.criteria]
+    rkeys = [r.name for l, r in node.criteria]
+    index: Dict[tuple, list] = {}
+    for i in range(right.n):
+        key = tuple(right.cols[k][0][i] for k in rkeys)
+        if any(right.cols[k][1] is not None and right.cols[k][1][i]
+               for k in rkeys):
+            continue
+        index.setdefault(key, []).append(i)
+    # 1. matched pairs (INNER expansion)
+    li, ri = [], []
+    for i in range(left.n):
+        key = tuple(left.cols[k][0][i] for k in lkeys)
+        matches = index.get(key, [])
+        if any(left.cols[k][1] is not None and left.cols[k][1][i]
+               for k in lkeys):
+            matches = []
+        for j in matches:
+            li.append(i)
+            ri.append(j)
+    li = np.array(li, dtype=np.int64)
+    ri = np.array(ri, dtype=np.int64)
+    cols = {}
+    for name, (v, m) in left.cols.items():
+        cols[name] = (v[li], None if m is None else m[li])
+    for name, (v, m) in right.cols.items():
+        cols[name] = (v[ri], None if m is None else m[ri])
+    out_names = [v.name for v in node.outputs]
+    pairs = Table({n: cols[n] for n in out_names}, len(li))
+
+    # 2. ON filter applies to pairs BEFORE null-extension (SQL semantics)
+    keep = np.ones(pairs.n, dtype=bool)
+    if node.filter is not None and pairs.n:
+        v, m = _eval(node.filter, pairs)
+        keep = v.astype(bool)
+        if m is not None:
+            keep &= ~m
+    pairs = pairs.mask(keep)
+
+    if node.join_type != P.LEFT:
+        return pairs
+
+    # 3. LEFT: null-extend probe rows with no surviving match
+    surviving = set(li[keep].tolist())
+    miss_rows = np.array([i for i in range(left.n) if i not in surviving],
+                         dtype=np.int64)
+    ext_cols = {}
+    for n in out_names:
+        pv, pm = pairs.cols[n]
+        if n in left.cols:
+            v, m = left.cols[n]
+            ev = v[miss_rows]
+            em = None if m is None else m[miss_rows]
+        else:
+            v, _ = right.cols[n]
+            ev = np.zeros(len(miss_rows), dtype=v.dtype) \
+                if v.dtype != object else np.empty(len(miss_rows), dtype=object)
+            em = np.ones(len(miss_rows), dtype=bool)
+        vals = np.concatenate([pv, ev])
+        if pm is None and em is None:
+            nm = None
+        else:
+            nm = np.concatenate([
+                pm if pm is not None else np.zeros(pairs.n, bool),
+                em if em is not None else np.zeros(len(miss_rows), bool)])
+        ext_cols[n] = (vals, nm)
+    return Table(ext_cols, pairs.n + len(miss_rows))
+
+
+def _exec_SemiJoinNode(node: P.SemiJoinNode) -> Table:
+    src = _exec(node.source)
+    filt = _exec(node.filtering_source)
+    fvals = set(filt.cols[node.filtering_source_join_variable.name][0].tolist())
+    sv, sm = src.cols[node.source_join_variable.name]
+    marker = np.array([x in fvals for x in sv.tolist()])
+    cols = dict(src.cols)
+    cols[node.semi_join_output.name] = (marker, None)
+    return Table(cols, src.n)
+
+
+# ---------------------------------------------------------------------------
+# expression interpreter
+# ---------------------------------------------------------------------------
+
+def _eval(expr: RowExpression, t: Table) -> Col:
+    if isinstance(expr, VariableReferenceExpression):
+        return t.cols[expr.name]
+    if isinstance(expr, ConstantExpression):
+        val = constant_device_value(expr.value, expr.type)
+        if val is None:
+            return (np.zeros(t.n, dtype=object), np.ones(t.n, dtype=bool))
+        if isinstance(expr.type, (VarcharType, CharType)):
+            return (np.array([str(val)] * t.n, dtype=object), None)
+        return (np.full(t.n, val, dtype=object
+                        if isinstance(val, int) and abs(val) > 2**62
+                        else np.int64
+                        if isinstance(val, (int, np.integer)) else np.float64),
+                None)
+    if isinstance(expr, CallExpression):
+        return _eval_call(expr, t)
+    if isinstance(expr, SpecialFormExpression):
+        return _eval_special(expr, t)
+    raise NotImplementedError(type(expr).__name__)
+
+
+def _both(a: Col, b: Col):
+    m = None
+    if a[1] is not None or b[1] is not None:
+        m = (a[1] if a[1] is not None else np.zeros(len(a[0]), bool)) | \
+            (b[1] if b[1] is not None else np.zeros(len(b[0]), bool))
+    return a[0], b[0], m
+
+
+def _scale_factor(expr: RowExpression) -> int:
+    return expr.type.scale if isinstance(expr.type, DecimalType) else 0
+
+
+def _to_scale(values: np.ndarray, frm: int, to: int):
+    if to == frm:
+        return values
+    if to > frm:
+        return values * (10 ** (to - frm))
+    den = 10 ** (frm - to)
+    out = np.empty(len(values), dtype=object)
+    for i, x in enumerate(values.tolist()):
+        q = (abs(int(x)) + den // 2) // den
+        out[i] = q if x >= 0 else -q
+    return out
+
+
+def _numeric_domain(expr: RowExpression, col: Col, target_float: bool,
+                    target_scale: int) -> np.ndarray:
+    v = col[0]
+    if target_float:
+        s = _scale_factor(expr)
+        return np.array([float(x) / 10**s for x in v.tolist()], dtype=np.float64) \
+            if s else v.astype(np.float64)
+    return _to_scale(v, _scale_factor(expr), target_scale)
+
+
+def _eval_call(expr: CallExpression, t: Table) -> Col:
+    name = canonical_name(expr.display_name)
+    args = expr.arguments
+    if name in ("add", "subtract", "multiply", "divide", "modulus"):
+        a = _eval(args[0], t)
+        b = _eval(args[1], t)
+        av, bv, m = _both(a, b)
+        is_float = isinstance(expr.type, (DoubleType, RealType))
+        if is_float:
+            af = _numeric_domain(args[0], a, True, 0)
+            bf = _numeric_domain(args[1], b, True, 0)
+            op = {"add": np.add, "subtract": np.subtract,
+                  "multiply": np.multiply, "divide": np.divide,
+                  "modulus": np.mod}[name]
+            return (op(af, bf), m)
+        rs = _scale_factor(expr)
+        sa, sb = _scale_factor(args[0]), _scale_factor(args[1])
+        ai = [int(x) for x in av.tolist()]
+        bi = [int(x) for x in bv.tolist()]
+        out = np.empty(len(ai), dtype=object)
+        for i in range(len(ai)):
+            x, y = ai[i], bi[i]
+            if name == "add":
+                out[i] = x * 10**(rs - sa) + y * 10**(rs - sb)
+            elif name == "subtract":
+                out[i] = x * 10**(rs - sa) - y * 10**(rs - sb)
+            elif name == "multiply":
+                p = x * y  # scale sa+sb
+                out[i] = _round_to(p, sa + sb, rs)
+            elif name == "divide":
+                num = x * 10**(rs + sb - sa)
+                q = (abs(num) + abs(y) // 2) // abs(y) if y != 0 else 0
+                out[i] = q * (1 if (num >= 0) == (y >= 0) else -1)
+            elif name == "modulus":
+                xs, ys = x * 10**(rs - sa), y * 10**(rs - sb)
+                out[i] = int(np.sign(xs)) * (abs(xs) % abs(ys)) if ys else 0
+        return (out, m)
+    if name in ("eq", "neq", "lt", "lte", "gt", "gte"):
+        a, b = _eval(args[0], t), _eval(args[1], t)
+        av, bv, m = _both(a, b)
+        if av.dtype == object and isinstance(av[0] if len(av) else "", str):
+            import operator as op_
+            ops = {"eq": op_.eq, "neq": op_.ne, "lt": op_.lt,
+                   "lte": op_.le, "gt": op_.gt, "gte": op_.ge}
+            return (np.array([ops[name](str(x), str(y))
+                              for x, y in zip(av, bv)]), m)
+        sa, sb = _scale_factor(args[0]), _scale_factor(args[1])
+        s = max(sa, sb)
+        fa = isinstance(args[0].type, (DoubleType, RealType))
+        fb = isinstance(args[1].type, (DoubleType, RealType))
+        if fa or fb:
+            an = _numeric_domain(args[0], a, True, 0)
+            bn = _numeric_domain(args[1], b, True, 0)
+        else:
+            an = _to_scale(av, sa, s)
+            bn = _to_scale(bv, sb, s)
+        ops = {"eq": np.equal, "neq": np.not_equal, "lt": np.less,
+               "lte": np.less_equal, "gt": np.greater,
+               "gte": np.greater_equal}
+        an = np.array([int(x) for x in an.tolist()], dtype=object) \
+            if an.dtype == object else an
+        return (ops[name](an, bn), m)
+    if name == "between":
+        lo = _eval_call(CallExpression("gte", expr.type,
+                                       [args[0], args[1]]), t)
+        hi = _eval_call(CallExpression("lte", expr.type,
+                                       [args[0], args[2]]), t)
+        v = lo[0] & hi[0]
+        m = None
+        if lo[1] is not None or hi[1] is not None:
+            m = (lo[1] if lo[1] is not None else 0) | \
+                (hi[1] if hi[1] is not None else 0)
+        return (v, m)
+    if name == "not":
+        v, m = _eval(args[0], t)
+        return (~v.astype(bool), m)
+    if name == "negate":
+        v, m = _eval(args[0], t)
+        return (np.array([-x for x in v.tolist()], dtype=v.dtype), m)
+    if name == "abs":
+        v, m = _eval(args[0], t)
+        return (np.array([abs(x) for x in v.tolist()], dtype=v.dtype), m)
+    if name in ("year", "month", "day", "quarter"):
+        v, m = _eval(args[0], t)
+        dates = v.astype("datetime64[D]")
+        y = dates.astype("datetime64[Y]").astype(np.int64) + 1970
+        mo = dates.astype("datetime64[M]").astype(np.int64) % 12 + 1
+        d = (dates - dates.astype("datetime64[M]").astype("datetime64[D]")
+             ).astype(np.int64) + 1
+        part = {"year": y, "month": mo, "day": d, "quarter": (mo + 2) // 3}[name]
+        return (part, m)
+    if name == "cast":
+        return _eval_cast(args[0], expr.type, t)
+    if name == "like":
+        from .lowering import like_matcher
+        v, m = _eval(args[0], t)
+        match = like_matcher(str(args[1].value))
+        return (np.array([match(str(x)) for x in v]), m)
+    if name == "substr":
+        v, m = _eval(args[0], t)
+        start = int(args[1].value)
+        length = int(args[2].value) if len(args) > 2 else None
+
+        def sub(s):
+            i = start - 1 if start > 0 else len(s) + start
+            return s[i:i + length] if length is not None else s[i:]
+        return (np.array([sub(str(x)) for x in v], dtype=object), m)
+    if name == "length":
+        v, m = _eval(args[0], t)
+        return (np.array([len(str(x)) for x in v], dtype=np.int64), m)
+    raise NotImplementedError(f"reference fn {name}")
+
+
+def _round_to(value: int, frm: int, to: int) -> int:
+    if to == frm:
+        return value
+    if to > frm:
+        return value * 10**(to - frm)
+    den = 10**(frm - to)
+    q = (abs(value) + den // 2) // den
+    return q if value >= 0 else -q
+
+
+def _eval_cast(arg: RowExpression, to: Type, t: Table) -> Col:
+    v, m = _eval(arg, t)
+    frm = arg.type
+    if isinstance(to, DoubleType):
+        s = _scale_factor(arg)
+        return (np.array([float(x) / 10**s for x in v.tolist()],
+                         dtype=np.float64), m)
+    if isinstance(to, DecimalType):
+        if isinstance(frm, DecimalType):
+            return (_to_scale(v, frm.scale, to.scale), m)
+        if isinstance(frm, (DoubleType, RealType)):
+            return (np.array([_round_to(int(round(float(x) * 10**to.scale)), to.scale, to.scale)
+                              for x in v.tolist()], dtype=object), m)
+        return (np.array([int(x) * 10**to.scale for x in v.tolist()],
+                         dtype=object), m)
+    if to.signature in ("bigint", "integer"):
+        if isinstance(frm, DecimalType):
+            return (_to_scale(v, frm.scale, 0), m)
+        return (v.astype(np.int64), m)
+    if isinstance(to, (VarcharType, CharType)):
+        return (np.array([str(x) for x in v], dtype=object), m)
+    raise NotImplementedError(f"reference cast {frm} -> {to}")
+
+
+def _eval_special(expr: SpecialFormExpression, t: Table) -> Col:
+    form = expr.form
+    args = expr.arguments
+    if form == "AND":
+        va, ma = _eval(args[0], t)
+        vb, mb = _eval(args[1], t)
+        a = va.astype(bool)
+        b = vb.astype(bool)
+        an = ma if ma is not None else np.zeros(t.n, bool)
+        bn = mb if mb is not None else np.zeros(t.n, bool)
+        value = (a | an) & (b | bn)
+        nulls = value & (an | bn)
+        has = ma is not None or mb is not None
+        return ((value & ~nulls) if has else (a & b), nulls if has else None)
+    if form == "OR":
+        va, ma = _eval(args[0], t)
+        vb, mb = _eval(args[1], t)
+        a, b = va.astype(bool), vb.astype(bool)
+        an = ma if ma is not None else np.zeros(t.n, bool)
+        bn = mb if mb is not None else np.zeros(t.n, bool)
+        definite = (a & ~an) | (b & ~bn)
+        nulls = ~definite & (an | bn)
+        has = ma is not None or mb is not None
+        return (definite if has else (a | b), nulls if has else None)
+    if form == "IS_NULL":
+        v, m = _eval(args[0], t)
+        return ((m if m is not None else np.zeros(t.n, bool)).copy(), None)
+    if form == "IN":
+        v, m = _eval(args[0], t)
+        vals = {constant_device_value(a.value, args[0].type) for a in args[1:]}
+        if v.dtype == object and len(v) and isinstance(v[0], str):
+            vals = {str(x) for x in vals}
+            return (np.array([x in vals for x in v]), m)
+        sa = _scale_factor(args[0])
+        return (np.array([x in vals for x in v.tolist()]), m)
+    if form == "IF":
+        c, cm = _eval(args[0], t)
+        tv, tm = _eval(args[1], t)
+        fv, fm = _eval(args[2], t)
+        pred = c.astype(bool)
+        if cm is not None:
+            pred = pred & ~cm
+        out = np.where(pred, tv, fv)
+        m = None
+        if tm is not None or fm is not None:
+            m = np.where(pred,
+                         tm if tm is not None else False,
+                         fm if fm is not None else False)
+        return (out, m)
+    if form == "COALESCE":
+        v, m = _eval(args[0], t)
+        out_v, out_m = v.copy(), (m.copy() if m is not None
+                                  else np.zeros(t.n, bool))
+        for a in args[1:]:
+            av, am = _eval(a, t)
+            take = out_m
+            out_v = np.where(take, av, out_v)
+            out_m = take & (am if am is not None else np.zeros(t.n, bool))
+        return (out_v, out_m if out_m.any() else None)
+    if form == "NULL_IF":
+        av, am = _eval(args[0], t)
+        bv, bm = _eval(args[1], t)
+        eq = av == bv
+        if bm is not None:
+            eq = eq & ~bm
+        if am is not None:
+            eq = eq & ~am
+        m = eq if am is None else (am | eq)
+        return (av, m)
+    raise NotImplementedError(f"reference special {form}")

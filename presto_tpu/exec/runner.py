@@ -1,0 +1,95 @@
+"""LocalQueryRunner: SQL string -> results, single process.
+
+The analog of the reference LocalQueryRunner
+(presto-main-base/.../testing/LocalQueryRunner.java:304): full
+parse -> plan -> execute in one process with no HTTP, used for engine and
+planner correctness tests and as the execution core the worker shell drives.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional
+
+from ..common.block import block_to_values
+from ..common.page import Page
+from ..sql.planner import Planner
+from .pipeline import ExecutionConfig, PlanCompiler, TaskContext
+
+
+@dataclass
+class QueryResult:
+    column_names: List[str]
+    column_types: List
+    rows: List[List]
+
+    def sorted_rows(self):
+        return sorted(self.rows, key=lambda r: tuple(
+            (v is None, str(type(v)), v) for v in r))
+
+
+class LocalQueryRunner:
+    def __init__(self, schema: str = "sf0.01",
+                 config: Optional[ExecutionConfig] = None):
+        self.schema = schema
+        self.config = config or ExecutionConfig(batch_rows=1 << 16,
+                                                join_out_capacity=1 << 18)
+
+    def plan(self, sql: str):
+        return Planner(default_schema=self.schema).plan(sql)
+
+    def execute(self, sql: str) -> QueryResult:
+        output = self.plan(sql)
+        ctx = TaskContext(config=self.config)
+        compiler = PlanCompiler(ctx)
+        names = output.column_names
+        types = [v.type for v in output.outputs]
+        rows: List[List] = []
+        for page in compiler.run_to_pages(output):
+            cols = [block_to_values(t, b) for t, b in zip(types, page.blocks)]
+            for i in range(page.position_count):
+                rows.append([c[i] for c in cols])
+        return QueryResult(names, types, rows)
+
+    def execute_reference(self, sql: str) -> QueryResult:
+        """Same query through the numpy reference interpreter (the oracle)."""
+        from .reference import execute_reference
+        output = self.plan(sql)
+        rows = execute_reference(output)
+        types = [v.type for v in output.outputs]
+        return QueryResult(output.column_names, types, rows)
+
+    def assert_same_as_reference(self, sql: str, ordered: bool = False):
+        got = self.execute(sql)
+        exp = self.execute_reference(sql)
+        _assert_rows_equal(got, exp, ordered)
+        return got
+
+
+def _assert_rows_equal(got: QueryResult, exp: QueryResult, ordered: bool):
+    g = got.rows if ordered else got.sorted_rows()
+    e = exp.rows if ordered else exp.sorted_rows()
+    if len(g) != len(e):
+        raise AssertionError(
+            f"row count mismatch: engine {len(g)} vs reference {len(e)}\n"
+            f"engine head: {g[:5]}\nreference head: {e[:5]}")
+    for i, (rg, re_) in enumerate(zip(g, e)):
+        if len(rg) != len(re_):
+            raise AssertionError(f"column count mismatch at row {i}")
+        for j, (a, b) in enumerate(zip(rg, re_)):
+            if not _value_eq(a, b):
+                raise AssertionError(
+                    f"value mismatch at row {i} col {j} "
+                    f"({got.column_names[j]}): engine {a!r} vs reference {b!r}\n"
+                    f"engine row: {rg}\nreference row: {re_}")
+
+
+def _value_eq(a, b) -> bool:
+    if a is None or b is None:
+        return a is None and b is None
+    if isinstance(a, float) or isinstance(b, float):
+        fa, fb = float(a), float(b)
+        if fa == fb:
+            return True
+        denom = max(abs(fa), abs(fb), 1e-30)
+        return abs(fa - fb) / denom < 1e-9
+    return a == b

@@ -1,0 +1,34 @@
+"""Device mesh management for multi-chip execution.
+
+The TPU worker maps Presto's FIXED_HASH task distribution
+(SystemPartitioningHandle.java:64, NodePartitioningManager bucket->node
+mapping) onto a 1-D `jax.sharding.Mesh` over the pod slice: task partition i
+== mesh position i, and the partitioned exchange between stages rides ICI
+all-to-all instead of the reference's HTTP pull shuffle (SURVEY.md §5.8).
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+WORKER_AXIS = "workers"
+
+
+def make_mesh(n_devices: Optional[int] = None,
+              devices: Optional[Sequence] = None) -> Mesh:
+    devs = list(devices) if devices is not None else jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    return Mesh(np.array(devs), (WORKER_AXIS,))
+
+
+def row_sharding(mesh: Mesh) -> NamedSharding:
+    """Shard dim 0 (rows) across workers."""
+    return NamedSharding(mesh, PartitionSpec(WORKER_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())

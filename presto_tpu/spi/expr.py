@@ -1,0 +1,205 @@
+"""RowExpression IR (reference presto-spi/.../spi/relation/RowExpression.java).
+
+JSON shape follows the reference Jackson bindings: polymorphic on "@type" with
+names "call" / "special" / "lambda" / "input" / "variable" / "constant"
+(RowExpression.java:31-36), types carried as signature strings.
+
+Constant values are held as python objects in their logical form (ints for
+integral/decimal-unscaled, float for double, str for varchar, bool, None).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, List, Optional, Tuple
+
+from ..common.types import Type, parse_type
+
+
+class RowExpression:
+    type: Type
+
+    def to_dict(self) -> dict:
+        raise NotImplementedError
+
+    @staticmethod
+    def from_dict(d: dict) -> "RowExpression":
+        kind = d["@type"]
+        if kind == "constant":
+            typ = parse_type(d["type"])
+            return ConstantExpression(d.get("valueBlock", d.get("value")), typ)
+        if kind == "variable":
+            return VariableReferenceExpression(d["name"], parse_type(d["type"]))
+        if kind == "call":
+            return CallExpression(
+                d.get("displayName", d.get("functionHandle", "?")),
+                parse_type(d["returnType"]),
+                [RowExpression.from_dict(a) for a in d["arguments"]],
+                function_handle=d.get("functionHandle"))
+        if kind == "special":
+            return SpecialFormExpression(
+                d["form"], parse_type(d["returnType"]),
+                [RowExpression.from_dict(a) for a in d["arguments"]])
+        if kind == "lambda":
+            return LambdaExpression(
+                [a for a in d["argumentTypes"]],
+                d["arguments"], RowExpression.from_dict(d["body"]))
+        if kind == "input":
+            return InputReferenceExpression(d["field"], parse_type(d["type"]))
+        raise ValueError(f"unknown RowExpression @type {kind!r}")
+
+
+@dataclass
+class ConstantExpression(RowExpression):
+    value: Any
+    type: Type
+
+    def to_dict(self):
+        return {"@type": "constant", "value": self.value,
+                "type": self.type.signature}
+
+    def __str__(self):
+        return f"{self.value!r}:{self.type}"
+
+
+@dataclass
+class VariableReferenceExpression(RowExpression):
+    name: str
+    type: Type
+
+    def to_dict(self):
+        return {"@type": "variable", "name": self.name,
+                "type": self.type.signature}
+
+    def __hash__(self):
+        return hash((self.name, self.type.signature))
+
+    def __eq__(self, other):
+        return (isinstance(other, VariableReferenceExpression)
+                and self.name == other.name
+                and self.type.signature == other.type.signature)
+
+    def __str__(self):
+        return self.name
+
+
+@dataclass
+class CallExpression(RowExpression):
+    """Function call.  `display_name` is the engine-facing function name (e.g.
+    "$operator$add", "sum", "lower"); lowering resolves it in the registry."""
+
+    display_name: str
+    type: Type
+    arguments: List[RowExpression]
+    function_handle: Optional[str] = None
+
+    def to_dict(self):
+        return {"@type": "call", "displayName": self.display_name,
+                "functionHandle": self.function_handle or self.display_name,
+                "returnType": self.type.signature,
+                "arguments": [a.to_dict() for a in self.arguments]}
+
+    def __str__(self):
+        return f"{self.display_name}({', '.join(map(str, self.arguments))})"
+
+
+# Reference SpecialFormExpression.Form values
+SPECIAL_FORMS = (
+    "IF", "NULL_IF", "SWITCH", "WHEN", "IS_NULL", "COALESCE", "IN",
+    "AND", "OR", "DEREFERENCE", "ROW_CONSTRUCTOR", "BIND",
+)
+
+
+@dataclass
+class SpecialFormExpression(RowExpression):
+    form: str
+    type: Type
+    arguments: List[RowExpression]
+
+    def __post_init__(self):
+        if self.form not in SPECIAL_FORMS:
+            raise ValueError(f"unknown special form {self.form!r}")
+
+    def to_dict(self):
+        return {"@type": "special", "form": self.form,
+                "returnType": self.type.signature,
+                "arguments": [a.to_dict() for a in self.arguments]}
+
+    def __str__(self):
+        return f"{self.form}({', '.join(map(str, self.arguments))})"
+
+
+@dataclass
+class LambdaExpression(RowExpression):
+    argument_types: List[str]
+    arguments: List[str]
+    body: RowExpression
+
+    @property
+    def type(self):  # function type; not used for block layout
+        return self.body.type
+
+    def to_dict(self):
+        return {"@type": "lambda", "argumentTypes": self.argument_types,
+                "arguments": self.arguments, "body": self.body.to_dict()}
+
+
+@dataclass
+class InputReferenceExpression(RowExpression):
+    field: int
+    type: Type
+
+    def to_dict(self):
+        return {"@type": "input", "field": self.field,
+                "type": self.type.signature}
+
+
+# ---------------------------------------------------------------------------
+# convenience builders used by the planner / tests
+# ---------------------------------------------------------------------------
+
+def variable(name: str, typ: Type) -> VariableReferenceExpression:
+    return VariableReferenceExpression(name, typ)
+
+
+def constant(value, typ: Type) -> ConstantExpression:
+    return ConstantExpression(value, typ)
+
+
+def call(name: str, return_type: Type, *args: RowExpression) -> CallExpression:
+    return CallExpression(name, return_type, list(args))
+
+
+def special(form: str, return_type: Type, *args: RowExpression) -> SpecialFormExpression:
+    return SpecialFormExpression(form, return_type, list(args))
+
+
+def and_(*args: RowExpression) -> RowExpression:
+    from ..common.types import BOOLEAN
+    args = [a for a in args if a is not None]
+    if not args:
+        return constant(True, BOOLEAN)
+    if len(args) == 1:
+        return args[0]
+    out = args[0]
+    for a in args[1:]:
+        out = special("AND", BOOLEAN, out, a)
+    return out
+
+
+def free_variables(expr: RowExpression) -> List[VariableReferenceExpression]:
+    out: List[VariableReferenceExpression] = []
+    seen = set()
+
+    def walk(e: RowExpression):
+        if isinstance(e, VariableReferenceExpression):
+            if e.name not in seen:
+                seen.add(e.name)
+                out.append(e)
+        elif isinstance(e, CallExpression) or isinstance(e, SpecialFormExpression):
+            for a in e.arguments:
+                walk(a)
+        elif isinstance(e, LambdaExpression):
+            walk(e.body)
+
+    walk(expr)
+    return out

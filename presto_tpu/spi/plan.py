@@ -1,0 +1,776 @@
+"""Plan IR (reference presto-spi/.../spi/plan/*.java + presto-main-base
+sql/planner/plan/*.java).
+
+Node set covers what the reference fragmenter can send to a leaf/intermediate
+worker for the TPC-H / TPC-DS vocabulary.  JSON uses the reference's Jackson
+MINIMAL_CLASS discriminator style ("@type": ".FilterNode").
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..common.types import Type, parse_type
+from .expr import (CallExpression, RowExpression, VariableReferenceExpression)
+
+Variable = VariableReferenceExpression
+
+
+# ---------------------------------------------------------------------------
+# handles
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class ColumnHandle:
+    """Connector column reference (reference spi/ColumnHandle)."""
+    name: str
+    type: Type
+
+    def to_dict(self):
+        return {"name": self.name, "type": self.type.signature}
+
+    @staticmethod
+    def from_dict(d):
+        return ColumnHandle(d["name"], parse_type(d["type"]))
+
+
+@dataclass(frozen=True)
+class TableHandle:
+    """Connector table reference (reference spi/TableHandle)."""
+    connector_id: str
+    schema_name: str
+    table_name: str
+    # connector-specific payload, e.g. {"scaleFactor": 1.0} for tpch
+    extra: Tuple[Tuple[str, Any], ...] = ()
+
+    def to_dict(self):
+        return {"connectorId": self.connector_id, "schema": self.schema_name,
+                "table": self.table_name, "extra": dict(self.extra)}
+
+    @staticmethod
+    def from_dict(d):
+        return TableHandle(d["connectorId"], d["schema"], d["table"],
+                           tuple(sorted(d.get("extra", {}).items())))
+
+
+# Sort orders (reference spi/block/SortOrder.java)
+ASC_NULLS_FIRST = "ASC_NULLS_FIRST"
+ASC_NULLS_LAST = "ASC_NULLS_LAST"
+DESC_NULLS_FIRST = "DESC_NULLS_FIRST"
+DESC_NULLS_LAST = "DESC_NULLS_LAST"
+
+
+@dataclass
+class OrderingScheme:
+    orderings: List[Tuple[Variable, str]]  # (variable, sort order)
+
+    def to_dict(self):
+        return {"orderBy": [{"variable": v.to_dict(), "sortOrder": o}
+                            for v, o in self.orderings]}
+
+    @staticmethod
+    def from_dict(d):
+        return OrderingScheme([
+            (RowExpression.from_dict(e["variable"]), e["sortOrder"])
+            for e in d["orderBy"]])
+
+
+# Partitioning handles (reference SystemPartitioningHandle.java:62-68)
+SINGLE_DISTRIBUTION = "SINGLE"
+FIXED_HASH_DISTRIBUTION = "FIXED_HASH"
+FIXED_ARBITRARY_DISTRIBUTION = "FIXED_ARBITRARY"
+FIXED_BROADCAST_DISTRIBUTION = "FIXED_BROADCAST"
+SOURCE_DISTRIBUTION = "SOURCE"
+SCALED_WRITER_DISTRIBUTION = "SCALED_WRITER"
+
+
+@dataclass
+class PartitioningScheme:
+    handle: str                      # one of the *_DISTRIBUTION constants
+    arguments: List[Variable]        # partitioning columns (hash)
+    output_layout: List[Variable]
+
+    def to_dict(self):
+        return {"partitioning": {"handle": self.handle,
+                                 "arguments": [a.to_dict() for a in self.arguments]},
+                "outputLayout": [v.to_dict() for v in self.output_layout]}
+
+    @staticmethod
+    def from_dict(d):
+        return PartitioningScheme(
+            d["partitioning"]["handle"],
+            [RowExpression.from_dict(a) for a in d["partitioning"]["arguments"]],
+            [RowExpression.from_dict(v) for v in d["outputLayout"]])
+
+
+# ---------------------------------------------------------------------------
+# plan nodes
+# ---------------------------------------------------------------------------
+
+_NODE_REGISTRY: Dict[str, type] = {}
+
+
+def _node(cls):
+    _NODE_REGISTRY["." + cls.__name__] = cls
+    return cls
+
+
+@dataclass
+class PlanNode:
+    id: str
+
+    @property
+    def sources(self) -> List["PlanNode"]:
+        return []
+
+    @property
+    def output_variables(self) -> List[Variable]:
+        raise NotImplementedError
+
+    def to_dict(self) -> dict:
+        d = self._to_dict()
+        d["@type"] = "." + type(self).__name__
+        d["id"] = self.id
+        return d
+
+    @staticmethod
+    def from_dict(d: dict) -> "PlanNode":
+        cls = _NODE_REGISTRY[d["@type"]]
+        return cls._from_dict(d)
+
+
+def _vars_to_dict(vs):
+    return [v.to_dict() for v in vs]
+
+
+def _vars_from_dict(ds):
+    return [RowExpression.from_dict(x) for x in ds]
+
+
+@_node
+@dataclass
+class TableScanNode(PlanNode):
+    table: TableHandle
+    outputs: List[Variable] = field(default_factory=list)
+    assignments: Dict[Variable, ColumnHandle] = field(default_factory=dict)
+
+    @property
+    def output_variables(self):
+        return self.outputs
+
+    def _to_dict(self):
+        return {"table": self.table.to_dict(),
+                "outputVariables": _vars_to_dict(self.outputs),
+                "assignments": [{"variable": v.to_dict(), "column": c.to_dict()}
+                                for v, c in self.assignments.items()]}
+
+    @classmethod
+    def _from_dict(cls, d):
+        return cls(d["id"], TableHandle.from_dict(d["table"]),
+                   _vars_from_dict(d["outputVariables"]),
+                   {RowExpression.from_dict(e["variable"]): ColumnHandle.from_dict(e["column"])
+                    for e in d["assignments"]})
+
+
+@_node
+@dataclass
+class FilterNode(PlanNode):
+    source: PlanNode
+    predicate: RowExpression
+
+    @property
+    def sources(self):
+        return [self.source]
+
+    @property
+    def output_variables(self):
+        return self.source.output_variables
+
+    def _to_dict(self):
+        return {"source": self.source.to_dict(),
+                "predicate": self.predicate.to_dict()}
+
+    @classmethod
+    def _from_dict(cls, d):
+        return cls(d["id"], PlanNode.from_dict(d["source"]),
+                   RowExpression.from_dict(d["predicate"]))
+
+
+@_node
+@dataclass
+class ProjectNode(PlanNode):
+    source: PlanNode
+    assignments: Dict[Variable, RowExpression]
+
+    @property
+    def sources(self):
+        return [self.source]
+
+    @property
+    def output_variables(self):
+        return list(self.assignments.keys())
+
+    def _to_dict(self):
+        return {"source": self.source.to_dict(),
+                "assignments": [{"variable": v.to_dict(), "expression": e.to_dict()}
+                                for v, e in self.assignments.items()]}
+
+    @classmethod
+    def _from_dict(cls, d):
+        return cls(d["id"], PlanNode.from_dict(d["source"]),
+                   {RowExpression.from_dict(e["variable"]): RowExpression.from_dict(e["expression"])
+                    for e in d["assignments"]})
+
+
+# Aggregation steps (reference AggregationNode.Step)
+PARTIAL = "PARTIAL"
+FINAL = "FINAL"
+INTERMEDIATE = "INTERMEDIATE"
+SINGLE = "SINGLE"
+
+
+@dataclass
+class Aggregation:
+    """One aggregate: call like sum(x), optional filter/mask, distinct flag."""
+    call: CallExpression
+    distinct: bool = False
+    mask: Optional[Variable] = None
+
+    def to_dict(self):
+        return {"call": self.call.to_dict(), "distinct": self.distinct,
+                "mask": self.mask.to_dict() if self.mask else None}
+
+    @staticmethod
+    def from_dict(d):
+        return Aggregation(
+            RowExpression.from_dict(d["call"]), d.get("distinct", False),
+            RowExpression.from_dict(d["mask"]) if d.get("mask") else None)
+
+
+@_node
+@dataclass
+class AggregationNode(PlanNode):
+    source: PlanNode
+    aggregations: Dict[Variable, Aggregation]
+    grouping_keys: List[Variable]
+    step: str = SINGLE
+
+    @property
+    def sources(self):
+        return [self.source]
+
+    @property
+    def output_variables(self):
+        return list(self.grouping_keys) + list(self.aggregations.keys())
+
+    def _to_dict(self):
+        return {"source": self.source.to_dict(),
+                "aggregations": [{"variable": v.to_dict(), "aggregation": a.to_dict()}
+                                 for v, a in self.aggregations.items()],
+                "groupingKeys": _vars_to_dict(self.grouping_keys),
+                "step": self.step}
+
+    @classmethod
+    def _from_dict(cls, d):
+        return cls(d["id"], PlanNode.from_dict(d["source"]),
+                   {RowExpression.from_dict(e["variable"]): Aggregation.from_dict(e["aggregation"])
+                    for e in d["aggregations"]},
+                   _vars_from_dict(d["groupingKeys"]), d["step"])
+
+
+# Join types (reference spi/plan/JoinType.java)
+INNER = "INNER"
+LEFT = "LEFT"
+RIGHT = "RIGHT"
+FULL = "FULL"
+
+PARTITIONED = "PARTITIONED"
+REPLICATED = "REPLICATED"
+
+
+@_node
+@dataclass
+class JoinNode(PlanNode):
+    join_type: str
+    left: PlanNode
+    right: PlanNode
+    criteria: List[Tuple[Variable, Variable]]  # left var == right var
+    outputs: List[Variable]
+    filter: Optional[RowExpression] = None
+    distribution: Optional[str] = None  # PARTITIONED / REPLICATED
+
+    @property
+    def sources(self):
+        return [self.left, self.right]
+
+    @property
+    def output_variables(self):
+        return self.outputs
+
+    def _to_dict(self):
+        return {"type": self.join_type, "left": self.left.to_dict(),
+                "right": self.right.to_dict(),
+                "criteria": [{"left": l.to_dict(), "right": r.to_dict()}
+                             for l, r in self.criteria],
+                "outputVariables": _vars_to_dict(self.outputs),
+                "filter": self.filter.to_dict() if self.filter else None,
+                "distributionType": self.distribution}
+
+    @classmethod
+    def _from_dict(cls, d):
+        return cls(d["id"], d["type"], PlanNode.from_dict(d["left"]),
+                   PlanNode.from_dict(d["right"]),
+                   [(RowExpression.from_dict(c["left"]), RowExpression.from_dict(c["right"]))
+                    for c in d["criteria"]],
+                   _vars_from_dict(d["outputVariables"]),
+                   RowExpression.from_dict(d["filter"]) if d.get("filter") else None,
+                   d.get("distributionType"))
+
+
+@_node
+@dataclass
+class SemiJoinNode(PlanNode):
+    source: PlanNode
+    filtering_source: PlanNode
+    source_join_variable: Variable
+    filtering_source_join_variable: Variable
+    semi_join_output: Variable
+
+    @property
+    def sources(self):
+        return [self.source, self.filtering_source]
+
+    @property
+    def output_variables(self):
+        return self.source.output_variables + [self.semi_join_output]
+
+    def _to_dict(self):
+        return {"source": self.source.to_dict(),
+                "filteringSource": self.filtering_source.to_dict(),
+                "sourceJoinVariable": self.source_join_variable.to_dict(),
+                "filteringSourceJoinVariable": self.filtering_source_join_variable.to_dict(),
+                "semiJoinOutput": self.semi_join_output.to_dict()}
+
+    @classmethod
+    def _from_dict(cls, d):
+        return cls(d["id"], PlanNode.from_dict(d["source"]),
+                   PlanNode.from_dict(d["filteringSource"]),
+                   RowExpression.from_dict(d["sourceJoinVariable"]),
+                   RowExpression.from_dict(d["filteringSourceJoinVariable"]),
+                   RowExpression.from_dict(d["semiJoinOutput"]))
+
+
+# Exchange (reference sql/planner/plan/ExchangeNode.java)
+GATHER = "GATHER"
+REPARTITION = "REPARTITION"
+REPLICATE = "REPLICATE"
+LOCAL = "LOCAL"
+REMOTE = "REMOTE"
+
+
+@_node
+@dataclass
+class ExchangeNode(PlanNode):
+    exchange_type: str                  # GATHER / REPARTITION / REPLICATE
+    scope: str                          # LOCAL / REMOTE
+    partitioning_scheme: PartitioningScheme
+    exchange_sources: List[PlanNode]
+    # inputs[i][j]: variable of sources[i] feeding output_layout[j]
+    inputs: List[List[Variable]] = field(default_factory=list)
+
+    @property
+    def sources(self):
+        return self.exchange_sources
+
+    @property
+    def output_variables(self):
+        return self.partitioning_scheme.output_layout
+
+    def _to_dict(self):
+        return {"exchangeType": self.exchange_type, "scope": self.scope,
+                "partitioningScheme": self.partitioning_scheme.to_dict(),
+                "sources": [s.to_dict() for s in self.exchange_sources],
+                "inputs": [_vars_to_dict(row) for row in self.inputs]}
+
+    @classmethod
+    def _from_dict(cls, d):
+        return cls(d["id"], d["exchangeType"], d["scope"],
+                   PartitioningScheme.from_dict(d["partitioningScheme"]),
+                   [PlanNode.from_dict(s) for s in d["sources"]],
+                   [_vars_from_dict(row) for row in d.get("inputs", [])])
+
+
+@_node
+@dataclass
+class RemoteSourceNode(PlanNode):
+    """Leaf in a fragment: reads the output of other fragments
+    (reference sql/planner/plan/RemoteSourceNode.java)."""
+    source_fragment_ids: List[str]
+    outputs: List[Variable]
+    ensure_source_ordering: bool = False
+    ordering_scheme: Optional[OrderingScheme] = None
+
+    @property
+    def output_variables(self):
+        return self.outputs
+
+    def _to_dict(self):
+        return {"sourceFragmentIds": self.source_fragment_ids,
+                "outputVariables": _vars_to_dict(self.outputs),
+                "ensureSourceOrdering": self.ensure_source_ordering,
+                "orderingScheme": self.ordering_scheme.to_dict() if self.ordering_scheme else None}
+
+    @classmethod
+    def _from_dict(cls, d):
+        return cls(d["id"], d["sourceFragmentIds"],
+                   _vars_from_dict(d["outputVariables"]),
+                   d.get("ensureSourceOrdering", False),
+                   OrderingScheme.from_dict(d["orderingScheme"]) if d.get("orderingScheme") else None)
+
+
+@_node
+@dataclass
+class SortNode(PlanNode):
+    source: PlanNode
+    ordering_scheme: OrderingScheme
+    is_partial: bool = False
+
+    @property
+    def sources(self):
+        return [self.source]
+
+    @property
+    def output_variables(self):
+        return self.source.output_variables
+
+    def _to_dict(self):
+        return {"source": self.source.to_dict(),
+                "orderingScheme": self.ordering_scheme.to_dict(),
+                "isPartial": self.is_partial}
+
+    @classmethod
+    def _from_dict(cls, d):
+        return cls(d["id"], PlanNode.from_dict(d["source"]),
+                   OrderingScheme.from_dict(d["orderingScheme"]),
+                   d.get("isPartial", False))
+
+
+@_node
+@dataclass
+class TopNNode(PlanNode):
+    source: PlanNode
+    count: int
+    ordering_scheme: OrderingScheme
+    step: str = SINGLE  # SINGLE / PARTIAL / FINAL
+
+    @property
+    def sources(self):
+        return [self.source]
+
+    @property
+    def output_variables(self):
+        return self.source.output_variables
+
+    def _to_dict(self):
+        return {"source": self.source.to_dict(), "count": self.count,
+                "orderingScheme": self.ordering_scheme.to_dict(),
+                "step": self.step}
+
+    @classmethod
+    def _from_dict(cls, d):
+        return cls(d["id"], PlanNode.from_dict(d["source"]), d["count"],
+                   OrderingScheme.from_dict(d["orderingScheme"]),
+                   d.get("step", SINGLE))
+
+
+@_node
+@dataclass
+class LimitNode(PlanNode):
+    source: PlanNode
+    count: int
+    step: str = SINGLE  # PARTIAL / FINAL
+
+    @property
+    def sources(self):
+        return [self.source]
+
+    @property
+    def output_variables(self):
+        return self.source.output_variables
+
+    def _to_dict(self):
+        return {"source": self.source.to_dict(), "count": self.count,
+                "step": self.step}
+
+    @classmethod
+    def _from_dict(cls, d):
+        return cls(d["id"], PlanNode.from_dict(d["source"]), d["count"],
+                   d.get("step", SINGLE))
+
+
+@_node
+@dataclass
+class DistinctLimitNode(PlanNode):
+    source: PlanNode
+    count: int
+    distinct_variables: List[Variable] = field(default_factory=list)
+
+    @property
+    def sources(self):
+        return [self.source]
+
+    @property
+    def output_variables(self):
+        return self.distinct_variables
+
+    def _to_dict(self):
+        return {"source": self.source.to_dict(), "count": self.count,
+                "distinctVariables": _vars_to_dict(self.distinct_variables)}
+
+    @classmethod
+    def _from_dict(cls, d):
+        return cls(d["id"], PlanNode.from_dict(d["source"]), d["count"],
+                   _vars_from_dict(d["distinctVariables"]))
+
+
+@_node
+@dataclass
+class ValuesNode(PlanNode):
+    outputs: List[Variable]
+    rows: List[List[RowExpression]] = field(default_factory=list)
+
+    @property
+    def output_variables(self):
+        return self.outputs
+
+    def _to_dict(self):
+        return {"outputVariables": _vars_to_dict(self.outputs),
+                "rows": [[e.to_dict() for e in row] for row in self.rows]}
+
+    @classmethod
+    def _from_dict(cls, d):
+        return cls(d["id"], _vars_from_dict(d["outputVariables"]),
+                   [[RowExpression.from_dict(e) for e in row] for row in d["rows"]])
+
+
+@_node
+@dataclass
+class OutputNode(PlanNode):
+    source: PlanNode
+    column_names: List[str]
+    outputs: List[Variable] = field(default_factory=list)
+
+    @property
+    def sources(self):
+        return [self.source]
+
+    @property
+    def output_variables(self):
+        return self.outputs
+
+    def _to_dict(self):
+        return {"source": self.source.to_dict(), "columnNames": self.column_names,
+                "outputVariables": _vars_to_dict(self.outputs)}
+
+    @classmethod
+    def _from_dict(cls, d):
+        return cls(d["id"], PlanNode.from_dict(d["source"]), d["columnNames"],
+                   _vars_from_dict(d["outputVariables"]))
+
+
+@_node
+@dataclass
+class MarkDistinctNode(PlanNode):
+    source: PlanNode
+    marker: Variable
+    distinct_variables: List[Variable] = field(default_factory=list)
+
+    @property
+    def sources(self):
+        return [self.source]
+
+    @property
+    def output_variables(self):
+        return self.source.output_variables + [self.marker]
+
+    def _to_dict(self):
+        return {"source": self.source.to_dict(), "marker": self.marker.to_dict(),
+                "distinctVariables": _vars_to_dict(self.distinct_variables)}
+
+    @classmethod
+    def _from_dict(cls, d):
+        return cls(d["id"], PlanNode.from_dict(d["source"]),
+                   RowExpression.from_dict(d["marker"]),
+                   _vars_from_dict(d["distinctVariables"]))
+
+
+@_node
+@dataclass
+class EnforceSingleRowNode(PlanNode):
+    source: PlanNode
+
+    @property
+    def sources(self):
+        return [self.source]
+
+    @property
+    def output_variables(self):
+        return self.source.output_variables
+
+    def _to_dict(self):
+        return {"source": self.source.to_dict()}
+
+    @classmethod
+    def _from_dict(cls, d):
+        return cls(d["id"], PlanNode.from_dict(d["source"]))
+
+
+@_node
+@dataclass
+class AssignUniqueIdNode(PlanNode):
+    source: PlanNode
+    id_variable: Variable = None
+
+    @property
+    def sources(self):
+        return [self.source]
+
+    @property
+    def output_variables(self):
+        return self.source.output_variables + [self.id_variable]
+
+    def _to_dict(self):
+        return {"source": self.source.to_dict(),
+                "idVariable": self.id_variable.to_dict()}
+
+    @classmethod
+    def _from_dict(cls, d):
+        return cls(d["id"], PlanNode.from_dict(d["source"]),
+                   RowExpression.from_dict(d["idVariable"]))
+
+
+@dataclass
+class WindowFunction:
+    call: CallExpression
+    frame: Optional[dict] = None  # frame spec; None == default RANGE UNBOUNDED..CURRENT
+
+    def to_dict(self):
+        return {"call": self.call.to_dict(), "frame": self.frame}
+
+    @staticmethod
+    def from_dict(d):
+        return WindowFunction(RowExpression.from_dict(d["call"]), d.get("frame"))
+
+
+@_node
+@dataclass
+class WindowNode(PlanNode):
+    source: PlanNode
+    partition_by: List[Variable]
+    ordering_scheme: Optional[OrderingScheme]
+    window_functions: Dict[Variable, WindowFunction] = field(default_factory=dict)
+
+    @property
+    def sources(self):
+        return [self.source]
+
+    @property
+    def output_variables(self):
+        return self.source.output_variables + list(self.window_functions.keys())
+
+    def _to_dict(self):
+        return {"source": self.source.to_dict(),
+                "partitionBy": _vars_to_dict(self.partition_by),
+                "orderingScheme": self.ordering_scheme.to_dict() if self.ordering_scheme else None,
+                "windowFunctions": [{"variable": v.to_dict(), "function": f.to_dict()}
+                                    for v, f in self.window_functions.items()]}
+
+    @classmethod
+    def _from_dict(cls, d):
+        return cls(d["id"], PlanNode.from_dict(d["source"]),
+                   _vars_from_dict(d["partitionBy"]),
+                   OrderingScheme.from_dict(d["orderingScheme"]) if d.get("orderingScheme") else None,
+                   {RowExpression.from_dict(e["variable"]): WindowFunction.from_dict(e["function"])
+                    for e in d["windowFunctions"]})
+
+
+@_node
+@dataclass
+class UnnestNode(PlanNode):
+    source: PlanNode
+    replicate_variables: List[Variable]
+    unnest_variables: List[Tuple[Variable, List[Variable]]]  # array var -> element vars
+
+    @property
+    def sources(self):
+        return [self.source]
+
+    @property
+    def output_variables(self):
+        out = list(self.replicate_variables)
+        for _, elems in self.unnest_variables:
+            out.extend(elems)
+        return out
+
+    def _to_dict(self):
+        return {"source": self.source.to_dict(),
+                "replicateVariables": _vars_to_dict(self.replicate_variables),
+                "unnestVariables": [{"variable": v.to_dict(),
+                                     "elements": _vars_to_dict(elems)}
+                                    for v, elems in self.unnest_variables]}
+
+    @classmethod
+    def _from_dict(cls, d):
+        return cls(d["id"], PlanNode.from_dict(d["source"]),
+                   _vars_from_dict(d["replicateVariables"]),
+                   [(RowExpression.from_dict(e["variable"]), _vars_from_dict(e["elements"]))
+                    for e in d["unnestVariables"]])
+
+
+# ---------------------------------------------------------------------------
+# fragments
+# ---------------------------------------------------------------------------
+
+@dataclass
+class PlanFragment:
+    """A scheduling unit cut at exchange boundaries
+    (reference sql/planner/PlanFragment.java:46)."""
+    fragment_id: str
+    root: PlanNode
+    partitioning: str                       # how this fragment's tasks are distributed
+    output_partitioning_scheme: PartitioningScheme
+    # table-scan node ids in this fragment that receive splits
+    partitioned_sources: List[str] = field(default_factory=list)
+
+    def to_dict(self):
+        return {"id": self.fragment_id, "root": self.root.to_dict(),
+                "partitioning": self.partitioning,
+                "outputPartitioningScheme": self.output_partitioning_scheme.to_dict(),
+                "partitionedSources": self.partitioned_sources}
+
+    @staticmethod
+    def from_dict(d):
+        return PlanFragment(
+            d["id"], PlanNode.from_dict(d["root"]), d["partitioning"],
+            PartitioningScheme.from_dict(d["outputPartitioningScheme"]),
+            d.get("partitionedSources", []))
+
+
+@dataclass
+class SubPlan:
+    """Tree of fragments (reference sql/planner/SubPlan.java)."""
+    fragment: PlanFragment
+    children: List["SubPlan"] = field(default_factory=list)
+
+    def all_fragments(self) -> List[PlanFragment]:
+        out = [self.fragment]
+        for c in self.children:
+            out.extend(c.all_fragments())
+        return out
+
+
+def walk_plan(node: PlanNode):
+    """Pre-order traversal."""
+    yield node
+    for s in node.sources:
+        yield from walk_plan(s)

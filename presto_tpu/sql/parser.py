@@ -1,0 +1,641 @@
+"""SQL frontend: lexer + recursive-descent parser for the Presto SQL subset
+reachable from TPC-H / TPC-DS (reference grammar:
+presto-parser/src/main/antlr4/.../SqlBase.g4; this is a hand-written parser for
+the query shapes the engine executes, not a full ANTLR port).
+
+Supported: SELECT [DISTINCT] items FROM relations (comma + [INNER|LEFT|RIGHT]
+JOIN .. ON) WHERE .. GROUP BY .. HAVING .. ORDER BY .. LIMIT ..; subqueries in
+FROM / IN / EXISTS / scalar positions; CASE, CAST, BETWEEN, IN, LIKE, IS NULL,
+EXTRACT, date/interval literals and arithmetic.
+"""
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple, Union
+
+KEYWORDS = {
+    "select", "from", "where", "group", "by", "having", "order", "limit",
+    "as", "and", "or", "not", "in", "exists", "between", "like", "is",
+    "null", "case", "when", "then", "else", "end", "cast", "join", "inner",
+    "left", "right", "full", "outer", "cross", "on", "asc", "desc", "distinct",
+    "date", "interval", "extract", "union", "all",
+    "true", "false", "nulls", "first", "last", "substring", "with",
+}
+# interval units are plain identifiers ("year" etc. must stay callable as
+# functions: year(x))
+
+TOKEN_RE = re.compile(r"""
+    (?P<ws>\s+|--[^\n]*)
+  | (?P<number>\d+\.\d*|\.\d+|\d+)
+  | (?P<string>'(?:[^']|'')*')
+  | (?P<qident>"(?:[^"]|"")*")
+  | (?P<ident>[A-Za-z_][A-Za-z0-9_$]*)
+  | (?P<op><>|!=|>=|<=|\|\||[(),.*/%<>=+\-;])
+""", re.VERBOSE)
+
+
+@dataclass
+class Token:
+    kind: str   # number / string / ident / keyword / op / eof
+    value: str
+    pos: int
+
+
+def tokenize(sql: str) -> List[Token]:
+    out, pos = [], 0
+    while pos < len(sql):
+        m = TOKEN_RE.match(sql, pos)
+        if not m:
+            raise SyntaxError(f"cannot tokenize at {sql[pos:pos+30]!r}")
+        pos = m.end()
+        kind = m.lastgroup
+        if kind == "ws":
+            continue
+        text = m.group()
+        if kind == "ident" and text.lower() in KEYWORDS:
+            out.append(Token("keyword", text.lower(), m.start()))
+        elif kind == "qident":
+            out.append(Token("ident", text[1:-1].replace('""', '"'), m.start()))
+        elif kind == "string":
+            out.append(Token("string", text[1:-1].replace("''", "'"), m.start()))
+        else:
+            out.append(Token(kind, text, m.start()))
+    out.append(Token("eof", "", len(sql)))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# AST
+# ---------------------------------------------------------------------------
+
+@dataclass
+class Node:
+    pass
+
+
+@dataclass
+class Ident(Node):
+    parts: List[str]          # e.g. ["lineitem", "l_quantity"]
+
+
+@dataclass
+class NumberLit(Node):
+    text: str
+
+
+@dataclass
+class StringLit(Node):
+    value: str
+
+
+@dataclass
+class BoolLit(Node):
+    value: bool
+
+
+@dataclass
+class NullLit(Node):
+    pass
+
+
+@dataclass
+class DateLit(Node):
+    value: str
+
+
+@dataclass
+class IntervalLit(Node):
+    value: str
+    unit: str                 # day / month / year
+
+
+@dataclass
+class Star(Node):
+    qualifier: Optional[str] = None
+
+
+@dataclass
+class BinaryOp(Node):
+    op: str                   # + - * / % = <> < <= > >= and or ||
+    left: Node
+    right: Node
+
+
+@dataclass
+class UnaryOp(Node):
+    op: str                   # - / not
+    operand: Node
+
+
+@dataclass
+class FuncCall(Node):
+    name: str
+    args: List[Node]
+    distinct: bool = False
+
+
+@dataclass
+class CastExpr(Node):
+    operand: Node
+    type_name: str
+
+
+@dataclass
+class Between(Node):
+    value: Node
+    low: Node
+    high: Node
+    negated: bool = False
+
+
+@dataclass
+class InList(Node):
+    value: Node
+    items: List[Node]
+    negated: bool = False
+
+
+@dataclass
+class InSubquery(Node):
+    value: Node
+    query: "Query"
+    negated: bool = False
+
+
+@dataclass
+class Exists(Node):
+    query: "Query"
+    negated: bool = False
+
+
+@dataclass
+class ScalarSubquery(Node):
+    query: "Query"
+
+
+@dataclass
+class IsNull(Node):
+    value: Node
+    negated: bool = False
+
+
+@dataclass
+class Like(Node):
+    value: Node
+    pattern: Node
+    negated: bool = False
+
+
+@dataclass
+class Case(Node):
+    operand: Optional[Node]
+    whens: List[Tuple[Node, Node]]
+    default: Optional[Node]
+
+
+@dataclass
+class ExtractExpr(Node):
+    part: str
+    operand: Node
+
+
+# relations
+@dataclass
+class TableRef(Node):
+    name: str
+    alias: Optional[str] = None
+
+
+@dataclass
+class SubqueryRef(Node):
+    query: "Query"
+    alias: str
+
+
+@dataclass
+class JoinRel(Node):
+    join_type: str            # INNER / LEFT / RIGHT / CROSS
+    left: Node
+    right: Node
+    on: Optional[Node]
+
+
+@dataclass
+class SelectItem(Node):
+    expr: Node
+    alias: Optional[str] = None
+
+
+@dataclass
+class OrderItem(Node):
+    expr: Node
+    ascending: bool = True
+    nulls_first: Optional[bool] = None
+
+
+@dataclass
+class Query(Node):
+    select_items: List[SelectItem]
+    relations: List[Node]                  # implicit cross join of these
+    where: Optional[Node] = None
+    group_by: List[Node] = field(default_factory=list)
+    having: Optional[Node] = None
+    order_by: List[OrderItem] = field(default_factory=list)
+    limit: Optional[int] = None
+    distinct: bool = False
+    ctes: List[Tuple[str, "Query"]] = field(default_factory=list)
+
+
+# ---------------------------------------------------------------------------
+# parser
+# ---------------------------------------------------------------------------
+
+class Parser:
+    def __init__(self, sql: str):
+        self.tokens = tokenize(sql)
+        self.i = 0
+
+    # -- token helpers ----------------------------------------------------
+    def peek(self, k=0) -> Token:
+        return self.tokens[min(self.i + k, len(self.tokens) - 1)]
+
+    def next(self) -> Token:
+        t = self.tokens[self.i]
+        self.i += 1
+        return t
+
+    def accept(self, kind, value=None) -> Optional[Token]:
+        t = self.peek()
+        if t.kind == kind and (value is None or t.value == value):
+            return self.next()
+        return None
+
+    def expect(self, kind, value=None) -> Token:
+        t = self.accept(kind, value)
+        if t is None:
+            got = self.peek()
+            raise SyntaxError(
+                f"expected {value or kind}, got {got.value!r} at {got.pos}")
+        return t
+
+    def accept_kw(self, *words) -> bool:
+        save = self.i
+        for w in words:
+            if not self.accept("keyword", w):
+                self.i = save
+                return False
+        return True
+
+    # -- entry ------------------------------------------------------------
+    def parse(self) -> Query:
+        q = self.parse_query()
+        self.accept("op", ";")
+        self.expect("eof")
+        return q
+
+    def parse_query(self) -> Query:
+        ctes = []
+        if self.accept("keyword", "with"):
+            while True:
+                name = self.expect("ident").value
+                self.expect("keyword", "as")
+                self.expect("op", "(")
+                sub = self.parse_query()
+                self.expect("op", ")")
+                ctes.append((name, sub))
+                if not self.accept("op", ","):
+                    break
+        q = self.parse_select()
+        q.ctes = ctes
+        return q
+
+    def parse_select(self) -> Query:
+        self.expect("keyword", "select")
+        distinct = bool(self.accept("keyword", "distinct"))
+        self.accept("keyword", "all")
+        items = [self.parse_select_item()]
+        while self.accept("op", ","):
+            items.append(self.parse_select_item())
+
+        relations: List[Node] = []
+        if self.accept("keyword", "from"):
+            relations.append(self.parse_relation())
+            while self.accept("op", ","):
+                relations.append(self.parse_relation())
+
+        where = self.parse_expr() if self.accept("keyword", "where") else None
+        group_by: List[Node] = []
+        if self.accept_kw("group", "by"):
+            group_by.append(self.parse_expr())
+            while self.accept("op", ","):
+                group_by.append(self.parse_expr())
+        having = self.parse_expr() if self.accept("keyword", "having") else None
+        order_by: List[OrderItem] = []
+        if self.accept_kw("order", "by"):
+            order_by.append(self.parse_order_item())
+            while self.accept("op", ","):
+                order_by.append(self.parse_order_item())
+        limit = None
+        if self.accept("keyword", "limit"):
+            limit = int(self.expect("number").value)
+        return Query(items, relations, where, group_by, having, order_by,
+                     limit, distinct)
+
+    def parse_select_item(self) -> SelectItem:
+        if self.peek().kind == "op" and self.peek().value == "*":
+            self.next()
+            return SelectItem(Star())
+        if (self.peek().kind == "ident" and self.peek(1).value == "."
+                and self.peek(2).value == "*"):
+            q = self.next().value
+            self.next()
+            self.next()
+            return SelectItem(Star(q))
+        expr = self.parse_expr()
+        alias = None
+        if self.accept("keyword", "as"):
+            alias = self.next().value
+        elif self.peek().kind == "ident":
+            alias = self.next().value
+        return SelectItem(expr, alias)
+
+    def parse_order_item(self) -> OrderItem:
+        expr = self.parse_expr()
+        asc = True
+        if self.accept("keyword", "desc"):
+            asc = False
+        else:
+            self.accept("keyword", "asc")
+        nulls_first = None
+        if self.accept("keyword", "nulls"):
+            if self.accept("keyword", "first"):
+                nulls_first = True
+            else:
+                self.expect("keyword", "last")
+                nulls_first = False
+        return OrderItem(expr, asc, nulls_first)
+
+    # -- relations --------------------------------------------------------
+    def parse_relation(self) -> Node:
+        rel = self.parse_relation_primary()
+        while True:
+            jt = None
+            if self.accept("keyword", "join") or self.accept_kw("inner", "join"):
+                jt = "INNER"
+            elif self.accept_kw("left", "outer", "join") or self.accept_kw("left", "join"):
+                jt = "LEFT"
+            elif self.accept_kw("right", "outer", "join") or self.accept_kw("right", "join"):
+                jt = "RIGHT"
+            elif self.accept_kw("cross", "join"):
+                jt = "CROSS"
+            else:
+                return rel
+            right = self.parse_relation_primary()
+            on = None
+            if jt != "CROSS":
+                self.expect("keyword", "on")
+                on = self.parse_expr()
+            rel = JoinRel(jt, rel, right, on)
+
+    def parse_relation_primary(self) -> Node:
+        if self.accept("op", "("):
+            if self.peek().value in ("select", "with"):
+                q = self.parse_query()
+                self.expect("op", ")")
+                self.accept("keyword", "as")
+                alias = self.expect("ident").value
+                return SubqueryRef(q, alias)
+            rel = self.parse_relation()
+            self.expect("op", ")")
+            return rel
+        name = self.expect("ident").value
+        # optional schema qualifier: schema.table
+        while self.accept("op", "."):
+            name = self.expect("ident").value  # keep last part
+        alias = None
+        if self.accept("keyword", "as"):
+            alias = self.expect("ident").value
+        elif self.peek().kind == "ident":
+            alias = self.next().value
+        return TableRef(name, alias)
+
+    # -- expressions (precedence climbing) -------------------------------
+    def parse_expr(self) -> Node:
+        return self.parse_or()
+
+    def parse_or(self) -> Node:
+        left = self.parse_and()
+        while self.accept("keyword", "or"):
+            left = BinaryOp("or", left, self.parse_and())
+        return left
+
+    def parse_and(self) -> Node:
+        left = self.parse_not()
+        while self.accept("keyword", "and"):
+            left = BinaryOp("and", left, self.parse_not())
+        return left
+
+    def parse_not(self) -> Node:
+        if self.accept("keyword", "not"):
+            return UnaryOp("not", self.parse_not())
+        return self.parse_predicate()
+
+    def parse_predicate(self) -> Node:
+        left = self.parse_additive()
+        while True:
+            negated = False
+            save = self.i
+            if self.accept("keyword", "not"):
+                negated = True
+            if self.accept("keyword", "between"):
+                low = self.parse_additive()
+                self.expect("keyword", "and")
+                high = self.parse_additive()
+                left = Between(left, low, high, negated)
+                continue
+            if self.accept("keyword", "in"):
+                self.expect("op", "(")
+                if self.peek().value in ("select", "with"):
+                    q = self.parse_query()
+                    self.expect("op", ")")
+                    left = InSubquery(left, q, negated)
+                else:
+                    items = [self.parse_expr()]
+                    while self.accept("op", ","):
+                        items.append(self.parse_expr())
+                    self.expect("op", ")")
+                    left = InList(left, items, negated)
+                continue
+            if self.accept("keyword", "like"):
+                left = Like(left, self.parse_additive(), negated)
+                continue
+            if negated:
+                self.i = save
+                break
+            if self.accept("keyword", "is"):
+                neg = bool(self.accept("keyword", "not"))
+                self.expect("keyword", "null")
+                left = IsNull(left, neg)
+                continue
+            t = self.peek()
+            if t.kind == "op" and t.value in ("=", "<>", "!=", "<", "<=", ">", ">="):
+                self.next()
+                op = "<>" if t.value == "!=" else t.value
+                left = BinaryOp(op, left, self.parse_additive())
+                continue
+            break
+        return left
+
+    def parse_additive(self) -> Node:
+        left = self.parse_multiplicative()
+        while True:
+            t = self.peek()
+            if t.kind == "op" and t.value in ("+", "-", "||"):
+                self.next()
+                left = BinaryOp(t.value, left, self.parse_multiplicative())
+            else:
+                return left
+
+    def parse_multiplicative(self) -> Node:
+        left = self.parse_unary()
+        while True:
+            t = self.peek()
+            if t.kind == "op" and t.value in ("*", "/", "%"):
+                self.next()
+                left = BinaryOp(t.value, left, self.parse_unary())
+            else:
+                return left
+
+    def parse_unary(self) -> Node:
+        if self.accept("op", "-"):
+            return UnaryOp("-", self.parse_unary())
+        if self.accept("op", "+"):
+            return self.parse_unary()
+        return self.parse_primary()
+
+    def parse_primary(self) -> Node:
+        t = self.peek()
+        if t.kind == "number":
+            self.next()
+            return NumberLit(t.value)
+        if t.kind == "string":
+            self.next()
+            return StringLit(t.value)
+        if t.kind == "keyword":
+            if t.value == "null":
+                self.next()
+                return NullLit()
+            if t.value in ("true", "false"):
+                self.next()
+                return BoolLit(t.value == "true")
+            if t.value == "date":
+                self.next()
+                return DateLit(self.expect("string").value)
+            if t.value == "interval":
+                self.next()
+                v = self.expect("string").value
+                unit = self.next().value.lower()
+                return IntervalLit(v, unit)
+            if t.value == "case":
+                return self.parse_case()
+            if t.value == "cast":
+                self.next()
+                self.expect("op", "(")
+                operand = self.parse_expr()
+                self.expect("keyword", "as")
+                type_name = self.parse_type_name()
+                self.expect("op", ")")
+                return CastExpr(operand, type_name)
+            if t.value == "extract":
+                self.next()
+                self.expect("op", "(")
+                part = self.next().value.lower()
+                self.expect("keyword", "from")
+                operand = self.parse_expr()
+                self.expect("op", ")")
+                return ExtractExpr(part, operand)
+            if t.value == "exists":
+                self.next()
+                self.expect("op", "(")
+                q = self.parse_query()
+                self.expect("op", ")")
+                return Exists(q)
+            if t.value == "substring":
+                self.next()
+                self.expect("op", "(")
+                operand = self.parse_expr()
+                if self.accept("keyword", "from"):
+                    start = self.parse_expr()
+                    length = None
+                    if self.accept("keyword", "from"):
+                        pass
+                    if self.accept("ident", "for") or self.accept("keyword", "for"):
+                        length = self.parse_expr()
+                    args = [operand, start] + ([length] if length else [])
+                else:
+                    args = [operand]
+                    while self.accept("op", ","):
+                        args.append(self.parse_expr())
+                self.expect("op", ")")
+                return FuncCall("substr", args)
+        if t.kind == "op" and t.value == "(":
+            self.next()
+            if self.peek().value in ("select", "with"):
+                q = self.parse_query()
+                self.expect("op", ")")
+                return ScalarSubquery(q)
+            e = self.parse_expr()
+            self.expect("op", ")")
+            return e
+        if t.kind == "ident":
+            # function call?
+            if self.peek(1).kind == "op" and self.peek(1).value == "(":
+                name = self.next().value.lower()
+                self.next()  # (
+                distinct = bool(self.accept("keyword", "distinct"))
+                args: List[Node] = []
+                if self.peek().value == "*":
+                    self.next()
+                    args = []
+                elif not (self.peek().kind == "op" and self.peek().value == ")"):
+                    args.append(self.parse_expr())
+                    while self.accept("op", ","):
+                        args.append(self.parse_expr())
+                self.expect("op", ")")
+                return FuncCall(name, args, distinct)
+            parts = [self.next().value]
+            while self.accept("op", "."):
+                parts.append(self.expect("ident").value)
+            return Ident(parts)
+        raise SyntaxError(f"unexpected token {t.value!r} at {t.pos}")
+
+    def parse_type_name(self) -> str:
+        base = self.next().value.lower()
+        if self.accept("op", "("):
+            args = [self.expect("number").value]
+            while self.accept("op", ","):
+                args.append(self.expect("number").value)
+            self.expect("op", ")")
+            return f"{base}({','.join(args)})"
+        return base
+
+    def parse_case(self) -> Node:
+        self.expect("keyword", "case")
+        operand = None
+        if self.peek().value != "when":
+            operand = self.parse_expr()
+        whens = []
+        while self.accept("keyword", "when"):
+            cond = self.parse_expr()
+            self.expect("keyword", "then")
+            whens.append((cond, self.parse_expr()))
+        default = None
+        if self.accept("keyword", "else"):
+            default = self.parse_expr()
+        self.expect("keyword", "end")
+        return Case(operand, whens, default)
+
+
+def parse_sql(sql: str) -> Query:
+    return Parser(sql).parse()

@@ -1,0 +1,865 @@
+"""Analyzer + logical planner: SQL AST -> typed PlanNode IR.
+
+Compresses the reference's Analyzer -> LogicalPlanner -> optimizer pipeline
+(presto-main-base/.../sql/analyzer/Analyzer.java:101,
+sql/planner/LogicalPlanner.java:142, optimizations/PredicatePushDown.java,
+PushdownSubfields.java) into one pass sized for the TPC-H/TPC-DS query shapes:
+scope-based name resolution, Presto type analysis (decimal precision/scale
+rules from DecimalOperators), column pruning at the scan, single-table
+predicate pushdown below joins, left-deep join tree construction from
+FROM-order with equi-criteria extraction, and aggregation rewrite
+(pre-projection of agg inputs, post-scope re-expression of SELECT items).
+"""
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..common.types import (BIGINT, BOOLEAN, DATE, DOUBLE, INTEGER, Type,
+                            DecimalType, DoubleType, IntegerType, BigintType,
+                            RealType, VarcharType, CharType, DateType,
+                            parse_type)
+from ..connectors import tpch
+from ..spi import plan as P
+from ..spi.expr import (CallExpression, ConstantExpression, RowExpression,
+                        SpecialFormExpression, VariableReferenceExpression,
+                        call, constant, special, variable)
+from . import parser as A
+
+# TPC-H column prefix per table, so canonical query text (l_quantity) resolves
+# against the connector's bare column names (quantity).
+_TPCH_PREFIX = {
+    "lineitem": "l_", "orders": "o_", "customer": "c_", "part": "p_",
+    "partsupp": "ps_", "supplier": "s_", "nation": "n_", "region": "r_",
+}
+
+
+class PlanningError(Exception):
+    pass
+
+
+@dataclass
+class RelationScope:
+    """Columns visible from one relation (alias)."""
+    alias: str
+    # visible name -> (variable, type); includes prefixed + bare names
+    columns: Dict[str, VariableReferenceExpression]
+
+
+@dataclass
+class Scope:
+    relations: List[RelationScope] = field(default_factory=list)
+    # aggregation scope: canonical expr text -> variable
+    expr_vars: Dict[str, VariableReferenceExpression] = field(default_factory=dict)
+
+    def resolve(self, parts: List[str]) -> VariableReferenceExpression:
+        if len(parts) == 1:
+            name = parts[0].lower()
+            hits = [r.columns[name] for r in self.relations if name in r.columns]
+            # de-dup same variable reachable through multiple names
+            uniq = {v.name: v for v in hits}
+            if len(uniq) == 1:
+                return next(iter(uniq.values()))
+            if len(uniq) > 1:
+                raise PlanningError(f"ambiguous column {parts[0]!r}")
+            raise PlanningError(f"column {parts[0]!r} not found")
+        qual, name = parts[-2].lower(), parts[-1].lower()
+        for r in self.relations:
+            if r.alias == qual and name in r.columns:
+                return r.columns[name]
+        raise PlanningError(f"column {'.'.join(parts)!r} not found")
+
+
+class Planner:
+    """Plans one session's queries; allocates globally unique variable names."""
+
+    def __init__(self, default_schema: str = "sf0.01"):
+        self._counter = itertools.count()
+        self.default_sf = _schema_sf(default_schema)
+        # CTEs keep their AST: each reference is planned fresh so two uses of
+        # the same CTE get distinct variables (a shared plan would alias them)
+        self._ctes: Dict[str, A.Query] = {}
+
+    def new_var(self, hint: str, typ: Type) -> VariableReferenceExpression:
+        return variable(f"{hint}_{next(self._counter)}", typ)
+
+    def new_id(self, hint: str) -> str:
+        return f"{hint}.{next(self._counter)}"
+
+    # ------------------------------------------------------------------
+    def plan(self, sql: str) -> P.OutputNode:
+        query = A.parse_sql(sql)
+        return self.plan_query_to_output(query)
+
+    def plan_query_to_output(self, query: A.Query) -> P.OutputNode:
+        node, names, out_vars = self.plan_query(query)
+        return P.OutputNode(self.new_id("output"), node, names, out_vars)
+
+    # ------------------------------------------------------------------
+    def plan_query(self, query: A.Query):
+        """Returns (plan node, column names, output variables)."""
+        for name, cte in query.ctes:
+            self._ctes[name.lower()] = cte
+
+        # 1. FROM: plan relations, collect scopes
+        node, scope = self.plan_from(query)
+
+        # 2. WHERE
+        if query.where is not None:
+            pred = self.plan_expr(query.where, scope)
+            node = P.FilterNode(self.new_id("filter"), node,
+                                _to_boolean(pred))
+
+        # 3. aggregation
+        agg_calls = _collect_agg_calls(query)
+        if query.group_by or agg_calls or query.distinct and False:
+            node, scope = self.plan_aggregation(query, node, scope, agg_calls)
+            if query.having is not None:
+                pred = self.plan_expr(query.having, scope)
+                node = P.FilterNode(self.new_id("having"), node,
+                                    _to_boolean(pred))
+        elif query.having is not None:
+            raise PlanningError("HAVING without aggregation")
+
+        # 4. SELECT projection
+        select_exprs: List[RowExpression] = []
+        names: List[str] = []
+        for item in query.select_items:
+            if isinstance(item.expr, A.Star):
+                for r in scope.relations:
+                    if item.expr.qualifier and r.alias != item.expr.qualifier.lower():
+                        continue
+                    seen = set()
+                    for cname, v in r.columns.items():
+                        if v.name in seen:
+                            continue
+                        seen.add(v.name)
+                        select_exprs.append(v)
+                        names.append(cname)
+                continue
+            e = self.plan_expr(item.expr, scope)
+            select_exprs.append(e)
+            names.append(item.alias or _default_name(item.expr))
+
+        proj_assign: Dict[VariableReferenceExpression, RowExpression] = {}
+        out_vars: List[VariableReferenceExpression] = []
+        alias_vars: Dict[str, VariableReferenceExpression] = {}
+        for name, e in zip(names, select_exprs):
+            if isinstance(e, VariableReferenceExpression) and e not in proj_assign:
+                v = e
+            else:
+                v = self.new_var(name, e.type)
+            proj_assign[v] = e
+            out_vars.append(v)
+            alias_vars[name.lower()] = v
+
+        # ORDER BY may reference select aliases, ordinals, or source columns
+        sort_items: List[Tuple[VariableReferenceExpression, str]] = []
+        extra_assign: Dict[VariableReferenceExpression, RowExpression] = {}
+        for oi in query.order_by:
+            v = self._resolve_order_item(oi, scope, out_vars, alias_vars,
+                                         extra_assign)
+            order = ("ASC" if oi.ascending else "DESC")
+            if oi.nulls_first is None:
+                order += "_NULLS_LAST" if oi.ascending else "_NULLS_FIRST"
+                # Presto default: NULLS LAST for ASC, NULLS FIRST for DESC
+            else:
+                order += "_NULLS_FIRST" if oi.nulls_first else "_NULLS_LAST"
+            sort_items.append((v, order))
+
+        all_assign = dict(proj_assign)
+        all_assign.update(extra_assign)
+        node = P.ProjectNode(self.new_id("project"), node, all_assign)
+
+        if query.distinct:
+            node = P.AggregationNode(self.new_id("distinct"), node, {},
+                                     out_vars, P.SINGLE)
+
+        if sort_items and query.limit is not None:
+            node = P.TopNNode(self.new_id("topn"), node, query.limit,
+                              P.OrderingScheme(sort_items))
+        elif sort_items:
+            node = P.SortNode(self.new_id("sort"), node,
+                              P.OrderingScheme(sort_items))
+        elif query.limit is not None:
+            node = P.LimitNode(self.new_id("limit"), node, query.limit)
+
+        # final pruning projection to the select list
+        if set(v.name for v in node.output_variables) != set(v.name for v in out_vars):
+            node = P.ProjectNode(self.new_id("prune"), node,
+                                 {v: v for v in out_vars})
+        return node, names, out_vars
+
+    # ------------------------------------------------------------------
+    # FROM planning: scans, pushdown, joins
+    # ------------------------------------------------------------------
+    def plan_from(self, query: A.Query):
+        if not query.relations:
+            row = [constant(1, BIGINT)]
+            v = self.new_var("dummy", BIGINT)
+            return P.ValuesNode(self.new_id("values"), [v], [row]), Scope([])
+
+        # flatten JoinRel trees into (relation, join_type, on) sequence
+        flat: List[Tuple[A.Node, str, Optional[A.Node]]] = []
+
+        def flatten(rel, jt="INNER", on=None):
+            if isinstance(rel, A.JoinRel):
+                flatten(rel.left)
+                flatten(rel.right, rel.join_type, rel.on)
+            else:
+                flat.append((rel, jt, on))
+
+        for r in query.relations:
+            flatten(r)
+
+        # plan each base relation
+        planned: List[Tuple[P.PlanNode, RelationScope, str, Optional[A.Node]]] = []
+        for rel, jt, on in flat:
+            node, rscope = self.plan_base_relation(rel, query)
+            planned.append((node, rscope, jt, on))
+
+        # WHERE conjuncts for pushdown / join criteria
+        where_conjuncts = _conjuncts(query.where)
+        on_conjuncts: List[A.Node] = []
+
+        # push single-relation conjuncts to their relation
+        remaining: List[A.Node] = []
+        consumed_where: List[A.Node] = []
+        for i, (node, rscope, jt, on) in enumerate(planned):
+            single_scope = Scope([rscope])
+            preds = []
+            for c in where_conjuncts:
+                if c in consumed_where:
+                    continue
+                if _resolvable(self, c, single_scope):
+                    preds.append(c)
+                    consumed_where.append(c)
+            if preds:
+                exprs = [self.plan_expr(p, single_scope) for p in preds]
+                from ..spi.expr import and_
+                node = P.FilterNode(self.new_id("pushdown"), node,
+                                    and_(*[_to_boolean(e) for e in exprs]))
+                planned[i] = (node, rscope, jt, on)
+        remaining = [c for c in where_conjuncts if c not in consumed_where]
+
+        # build left-deep join tree in FROM order
+        node, rscope, _, _ = planned[0]
+        scopes = [rscope]
+        for next_node, next_scope, jt, on in planned[1:]:
+            left_scope = Scope(scopes)
+            right_scope = Scope([next_scope])
+            conjs = list(_conjuncts(on))
+            if jt == "INNER" or jt == "CROSS":
+                # pull applicable WHERE conjuncts into the join
+                for c in list(remaining):
+                    if _resolvable(self, c, Scope(scopes + [next_scope])):
+                        conjs.append(c)
+                        remaining.remove(c)
+            criteria, leftover = self._extract_criteria(
+                conjs, left_scope, right_scope)
+            join_scope = Scope(scopes + [next_scope])
+            outputs = _scope_vars(join_scope)
+            jf = None
+            if leftover:
+                from ..spi.expr import and_
+                jf_exprs = [
+                    _to_boolean(self.plan_expr(c, join_scope)) for c in leftover]
+                jf = and_(*jf_exprs)
+            if not criteria:
+                # cross join via constant-key equi join
+                ck_l = self.new_var("xjoin_l", BIGINT)
+                ck_r = self.new_var("xjoin_r", BIGINT)
+                node = P.ProjectNode(
+                    self.new_id("xl"), node,
+                    {**{v: v for v in _scope_vars(Scope(scopes))},
+                     ck_l: constant(0, BIGINT)})
+                next_node = P.ProjectNode(
+                    self.new_id("xr"), next_node,
+                    {**{v: v for v in _scope_vars(right_scope)},
+                     ck_r: constant(0, BIGINT)})
+                criteria = [(ck_l, ck_r)]
+            node = P.JoinNode(self.new_id("join"),
+                              "INNER" if jt == "CROSS" else jt,
+                              node, next_node, criteria, outputs, jf)
+            scopes.append(next_scope)
+
+        # leftovers that need the whole scope (e.g. cross-relation non-equi)
+        scope = Scope(scopes)
+        if remaining:
+            from ..spi.expr import and_
+            preds = [_to_boolean(self.plan_expr(c, scope)) for c in remaining]
+            node = P.FilterNode(self.new_id("post_join_filter"), node,
+                                and_(*preds))
+        # rebuild query.where consumed marker: all conjuncts were used
+        query.where = None
+        return node, scope
+
+    def plan_base_relation(self, rel: A.Node, query: A.Query):
+        if isinstance(rel, A.SubqueryRef):
+            node, names, out_vars = self.plan_query(rel.query)
+            cols = {}
+            for n, v in zip(names, out_vars):
+                cols[n.lower()] = v
+            return node, RelationScope(rel.alias.lower(), cols)
+        if isinstance(rel, A.TableRef):
+            name = rel.name.lower()
+            alias = (rel.alias or rel.name).lower()
+            if name in self._ctes:
+                node, names, out_vars = self.plan_query(self._ctes[name])
+                cols = {n.lower(): v for n, v in zip(names, out_vars)}
+                return node, RelationScope(alias, cols)
+            if name not in tpch.SCHEMAS:
+                raise PlanningError(f"unknown table {rel.name!r}")
+            used = _used_columns(query, name, alias)
+            prefix = _TPCH_PREFIX[name]
+            outputs, assignments, cols = [], {}, {}
+            for col, typ in tpch.SCHEMAS[name]:
+                visible = {col, prefix + col}
+                if used is not None and not (visible & used):
+                    continue
+                v = self.new_var(prefix + col, typ)
+                outputs.append(v)
+                assignments[v] = P.ColumnHandle(col, typ)
+                cols[col] = v
+                cols[prefix + col] = v
+            if not outputs:  # count(*)-style: keep the narrowest column
+                col, typ = tpch.SCHEMAS[name][0]
+                v = self.new_var(prefix + col, typ)
+                outputs, assignments = [v], {v: P.ColumnHandle(col, typ)}
+                cols = {col: v, prefix + col: v}
+            table = P.TableHandle("tpch", "tpch", name,
+                                  (("scaleFactor", self.default_sf),))
+            node = P.TableScanNode(self.new_id("scan"), table, outputs,
+                                   assignments)
+            return node, RelationScope(alias, cols)
+        raise PlanningError(f"unsupported relation {type(rel).__name__}")
+
+    def _extract_criteria(self, conjuncts, left_scope: Scope,
+                          right_scope: Scope):
+        criteria, leftover = [], []
+        for c in conjuncts:
+            pair = self._as_equi(c, left_scope, right_scope)
+            if pair is not None:
+                criteria.append(pair)
+            else:
+                leftover.append(c)
+        return criteria, leftover
+
+    def _as_equi(self, c, left_scope, right_scope):
+        if not (isinstance(c, A.BinaryOp) and c.op == "="):
+            return None
+        for a, b in ((c.left, c.right), (c.right, c.left)):
+            if (_resolvable(self, a, left_scope)
+                    and _resolvable(self, b, right_scope)):
+                le = self.plan_expr(a, left_scope)
+                re_ = self.plan_expr(b, right_scope)
+                if (isinstance(le, VariableReferenceExpression)
+                        and isinstance(re_, VariableReferenceExpression)):
+                    return (le, re_)
+        return None
+
+    # ------------------------------------------------------------------
+    # aggregation planning
+    # ------------------------------------------------------------------
+    def plan_aggregation(self, query: A.Query, node: P.PlanNode,
+                         scope: Scope, agg_calls: List[A.FuncCall]):
+        # group keys: resolve ordinals / aliases / expressions
+        key_asts: List[A.Node] = []
+        for g in query.group_by:
+            if isinstance(g, A.NumberLit):
+                idx = int(g.text) - 1
+                key_asts.append(query.select_items[idx].expr)
+            elif isinstance(g, A.Ident) and len(g.parts) == 1:
+                alias_hit = None
+                for item in query.select_items:
+                    if item.alias and item.alias.lower() == g.parts[0].lower():
+                        alias_hit = item.expr
+                        break
+                key_asts.append(alias_hit if alias_hit is not None else g)
+            else:
+                key_asts.append(g)
+
+        pre_assign: Dict[VariableReferenceExpression, RowExpression] = {}
+        key_vars: List[VariableReferenceExpression] = []
+        expr_vars: Dict[str, VariableReferenceExpression] = {}
+        for ast in key_asts:
+            e = self.plan_expr(ast, scope)
+            if isinstance(e, VariableReferenceExpression):
+                v = e
+            else:
+                v = self.new_var("groupkey", e.type)
+            pre_assign[v] = e
+            key_vars.append(v)
+            expr_vars[_canon(ast)] = v
+
+        aggregations: Dict[VariableReferenceExpression, P.Aggregation] = {}
+        for fc in agg_calls:
+            key = _canon(fc)
+            if key in expr_vars:
+                continue
+            fname = fc.name
+            if fc.distinct:
+                raise PlanningError("DISTINCT aggregates not supported yet")
+            if fc.args:
+                arg = self.plan_expr(fc.args[0], scope)
+                if isinstance(arg, VariableReferenceExpression):
+                    av = arg
+                else:
+                    av = self.new_var("agginput", arg.type)
+                pre_assign[av] = arg
+                out_type = _agg_output_type(fname, arg.type)
+                acall = call(fname, out_type, av)
+            else:
+                out_type = BIGINT
+                acall = CallExpression("count", out_type, [])
+            v = self.new_var(fname, out_type)
+            aggregations[v] = P.Aggregation(acall)
+            expr_vars[key] = v
+
+        pre = P.ProjectNode(self.new_id("preagg"), node, pre_assign)
+        agg = P.AggregationNode(self.new_id("agg"), pre, aggregations,
+                                key_vars, P.SINGLE)
+        post_scope = Scope(scope.relations, expr_vars)
+        return agg, post_scope
+
+    def _resolve_order_item(self, oi: A.OrderItem, scope, out_vars,
+                            alias_vars, extra_assign):
+        e = oi.expr
+        if isinstance(e, A.NumberLit):
+            return out_vars[int(e.text) - 1]
+        if isinstance(e, A.Ident) and len(e.parts) == 1 \
+                and e.parts[0].lower() in alias_vars:
+            return alias_vars[e.parts[0].lower()]
+        expr = self.plan_expr(e, scope)
+        if isinstance(expr, VariableReferenceExpression):
+            # must be carried through the projection
+            extra_assign[expr] = expr
+            return expr
+        v = self.new_var("sortkey", expr.type)
+        extra_assign[v] = expr
+        return v
+
+    # ------------------------------------------------------------------
+    # expression planning (with type analysis)
+    # ------------------------------------------------------------------
+    def plan_expr(self, e: A.Node, scope: Scope) -> RowExpression:
+        if scope.expr_vars:
+            key = _canon(e)
+            if key in scope.expr_vars:
+                return scope.expr_vars[key]
+        if isinstance(e, A.Ident):
+            return scope.resolve(e.parts)
+        if isinstance(e, A.NumberLit):
+            return _number_literal(e.text)
+        if isinstance(e, A.StringLit):
+            return constant(e.value, VarcharType(len(e.value)))
+        if isinstance(e, A.BoolLit):
+            return constant(e.value, BOOLEAN)
+        if isinstance(e, A.NullLit):
+            from ..common.types import UNKNOWN
+            return constant(None, UNKNOWN)
+        if isinstance(e, A.DateLit):
+            return constant(e.value, DATE)
+        if isinstance(e, A.BinaryOp):
+            return self._plan_binary(e, scope)
+        if isinstance(e, A.UnaryOp):
+            arg = self.plan_expr(e.operand, scope)
+            if e.op == "not":
+                return call("not", BOOLEAN, _to_boolean(arg))
+            if isinstance(arg, ConstantExpression) and arg.value is not None:
+                return _negate_const(arg)
+            return call("negate", arg.type, arg)
+        if isinstance(e, A.Between):
+            v = self.plan_expr(e.value, scope)
+            lo = self.plan_expr(e.low, scope)
+            hi = self.plan_expr(e.high, scope)
+            b = call("between", BOOLEAN, v, lo, hi)
+            return call("not", BOOLEAN, b) if e.negated else b
+        if isinstance(e, A.InList):
+            v = self.plan_expr(e.value, scope)
+            items = [self.plan_expr(i, scope) for i in e.items]
+            out = special("IN", BOOLEAN, v, *items)
+            return call("not", BOOLEAN, out) if e.negated else out
+        if isinstance(e, A.IsNull):
+            v = self.plan_expr(e.value, scope)
+            out = special("IS_NULL", BOOLEAN, v)
+            return call("not", BOOLEAN, out) if e.negated else out
+        if isinstance(e, A.Like):
+            v = self.plan_expr(e.value, scope)
+            pat = self.plan_expr(e.pattern, scope)
+            out = call("like", BOOLEAN, v, pat)
+            return call("not", BOOLEAN, out) if e.negated else out
+        if isinstance(e, A.Case):
+            return self._plan_case(e, scope)
+        if isinstance(e, A.CastExpr):
+            arg = self.plan_expr(e.operand, scope)
+            to = parse_type(e.type_name)
+            return call("cast", to, arg)
+        if isinstance(e, A.ExtractExpr):
+            arg = self.plan_expr(e.operand, scope)
+            return call(e.part, BIGINT, arg)
+        if isinstance(e, A.FuncCall):
+            return self._plan_func(e, scope)
+        if isinstance(e, (A.InSubquery, A.Exists, A.ScalarSubquery)):
+            raise PlanningError(
+                "subquery expressions must be rewritten before planning "
+                "(supported positions: FROM; IN/EXISTS rewrites land in a "
+                "later round)")
+        raise PlanningError(f"unsupported expression {type(e).__name__}")
+
+    def _plan_binary(self, e: A.BinaryOp, scope) -> RowExpression:
+        if e.op == "and":
+            return special("AND", BOOLEAN,
+                           _to_boolean(self.plan_expr(e.left, scope)),
+                           _to_boolean(self.plan_expr(e.right, scope)))
+        if e.op == "or":
+            return special("OR", BOOLEAN,
+                           _to_boolean(self.plan_expr(e.left, scope)),
+                           _to_boolean(self.plan_expr(e.right, scope)))
+        left = self.plan_expr(e.left, scope)
+        if isinstance(e.right, A.IntervalLit):
+            return self._fold_interval(e.op, left, e.right)
+        right = self.plan_expr(e.right, scope)
+        cmp = {"=": "eq", "<>": "neq", "<": "lt", "<=": "lte",
+               ">": "gt", ">=": "gte"}
+        if e.op in cmp:
+            left, right = _unify_comparison(left, right)
+            return call(cmp[e.op], BOOLEAN, left, right)
+        arith = {"+": "add", "-": "subtract", "*": "multiply",
+                 "/": "divide", "%": "modulus"}
+        if e.op in arith:
+            out_type = _arith_type(e.op, left.type, right.type)
+            return call(arith[e.op], out_type, left, right)
+        raise PlanningError(f"operator {e.op!r}")
+
+    def _fold_interval(self, op: str, left: RowExpression,
+                       iv: A.IntervalLit) -> ConstantExpression:
+        """date ± interval: constant-fold (intervals appear only on literal
+        dates in the TPC-H/DS suites)."""
+        if not isinstance(left, ConstantExpression) \
+                or not isinstance(left.type, DateType):
+            raise PlanningError("interval arithmetic on non-literal date")
+        d = np.datetime64(left.value, "D")
+        n = int(iv.value)
+        sign = 1 if op == "+" else -1
+        if iv.unit == "day":
+            d2 = d + sign * n
+        elif iv.unit in ("month", "year"):
+            months = sign * n * (12 if iv.unit == "year" else 1)
+            m0 = d.astype("datetime64[M]")
+            day_of_month = (d - m0.astype("datetime64[D]"))
+            m2 = m0 + months
+            # clamp to the target month's length (Presto: Jan 31 + 1 month
+            # == Feb 29/28, not Mar 2/3)
+            month_len = (m2 + 1).astype("datetime64[D]") - m2.astype("datetime64[D]")
+            d2 = m2.astype("datetime64[D]") + min(day_of_month,
+                                                  month_len - np.timedelta64(1, "D"))
+        else:
+            raise PlanningError(f"interval unit {iv.unit}")
+        return constant(str(d2), DATE)
+
+    def _plan_case(self, e: A.Case, scope) -> RowExpression:
+        # CASE -> nested IF
+        whens = e.whens
+        default = (self.plan_expr(e.default, scope)
+                   if e.default is not None else None)
+        planned = []
+        for cond, result in whens:
+            if e.operand is not None:
+                cond = A.BinaryOp("=", e.operand, cond)
+            planned.append((_to_boolean(self.plan_expr(cond, scope)),
+                            self.plan_expr(result, scope)))
+        result_type = planned[0][1].type
+        if default is None:
+            default = constant(None, result_type)
+        out = default
+        for cond, result in reversed(planned):
+            out = special("IF", result_type, cond, result, out)
+        return out
+
+    def _plan_func(self, e: A.FuncCall, scope) -> RowExpression:
+        args = [self.plan_expr(a, scope) for a in e.args]
+        name = e.name
+        if name in ("sum", "avg", "count", "min", "max"):
+            # bare aggregate call (used when planning inside agg rewrite)
+            out = _agg_output_type(name, args[0].type if args else BIGINT)
+            return CallExpression(name, out, args)
+        if name in ("year", "month", "day", "quarter"):
+            return call(name, BIGINT, *args)
+        if name == "substr":
+            return call("substr", args[0].type, *args)
+        if name == "length":
+            return call("length", BIGINT, *args)
+        if name == "abs":
+            return call("abs", args[0].type, *args)
+        if name == "coalesce":
+            t = next((a.type for a in args if a.type.signature != "unknown"),
+                     args[0].type)
+            return special("COALESCE", t, *args)
+        if name == "nullif":
+            return special("NULL_IF", args[0].type, *args)
+        if name == "round":
+            if len(args) == 1:
+                return call("cast", BIGINT, args[0]) if isinstance(
+                    args[0].type, DecimalType) else call("round", args[0].type, *args)
+            return call("round", args[0].type, *args)
+        raise PlanningError(f"unknown function {name!r}")
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+def _schema_sf(schema: str) -> float:
+    s = schema.lower().lstrip("sf")
+    try:
+        return float(s)
+    except ValueError:
+        return {"tiny": 0.01}.get(schema, 1.0)
+
+
+def _used_columns(query: A.Query, table: str, alias: str) -> Optional[set]:
+    """Column names the query may reference on this relation, for scan
+    pruning.  Returns None (= keep all) when a bare/qualified star appears."""
+    used: set = set()
+    star = [False]
+
+    def walk(n):
+        if isinstance(n, A.Star):
+            if n.qualifier is None or n.qualifier.lower() == alias:
+                star[0] = True
+            return
+        if isinstance(n, A.Ident):
+            if len(n.parts) == 1:
+                used.add(n.parts[0].lower())
+            elif n.parts[-2].lower() == alias:
+                used.add(n.parts[-1].lower())
+            return
+        if isinstance(n, A.Query):
+            # subqueries may reference outer columns only when correlated,
+            # which we don't support yet — but be conservative and collect
+            for item in n.select_items:
+                walk(item.expr)
+            for r in n.relations:
+                walk(r)
+            for e in (n.where, n.having):
+                if e is not None:
+                    walk(e)
+            for g in n.group_by:
+                walk(g)
+            for oi in n.order_by:
+                walk(oi.expr)
+            return
+        if isinstance(n, A.Node):
+            for f in vars(n).values():
+                if isinstance(f, A.Node):
+                    walk(f)
+                elif isinstance(f, list):
+                    for x in f:
+                        if isinstance(x, A.Node):
+                            walk(x)
+                        elif isinstance(x, tuple):
+                            for y in x:
+                                if isinstance(y, A.Node):
+                                    walk(y)
+
+    walk(query)
+    return None if star[0] else used
+
+
+def _conjuncts(e: Optional[A.Node]) -> List[A.Node]:
+    if e is None:
+        return []
+    if isinstance(e, A.BinaryOp) and e.op == "and":
+        return _conjuncts(e.left) + _conjuncts(e.right)
+    return [e]
+
+
+def _resolvable(planner: Planner, e: A.Node, scope: Scope) -> bool:
+    try:
+        planner.plan_expr(e, scope)
+        return True
+    except PlanningError:
+        return False
+
+
+def _scope_vars(scope: Scope) -> List[VariableReferenceExpression]:
+    out, seen = [], set()
+    for r in scope.relations:
+        for v in r.columns.values():
+            if v.name not in seen:
+                seen.add(v.name)
+                out.append(v)
+    return out
+
+
+def _collect_agg_calls(query: A.Query) -> List[A.FuncCall]:
+    out: List[A.FuncCall] = []
+    seen = set()
+
+    def walk(n):
+        if isinstance(n, A.FuncCall) and n.name in ("sum", "avg", "count",
+                                                    "min", "max"):
+            key = _canon(n)
+            if key not in seen:
+                seen.add(key)
+                out.append(n)
+            return  # don't descend into agg args
+        for f in vars(n).values() if isinstance(n, A.Node) else []:
+            if isinstance(f, A.Node):
+                walk(f)
+            elif isinstance(f, list):
+                for x in f:
+                    if isinstance(x, A.Node):
+                        walk(x)
+                    elif isinstance(x, tuple):
+                        for y in x:
+                            if isinstance(y, A.Node):
+                                walk(y)
+
+    for item in query.select_items:
+        walk(item.expr)
+    if query.having is not None:
+        walk(query.having)
+    for oi in query.order_by:
+        walk(oi.expr)
+    return out
+
+
+def _canon(e: A.Node) -> str:
+    """Canonical text of an AST expression, for matching group keys/aggs."""
+    if isinstance(e, A.Ident):
+        return ".".join(p.lower() for p in e.parts[-1:])
+    if isinstance(e, A.NumberLit):
+        return e.text
+    if isinstance(e, A.StringLit):
+        return f"'{e.value}'"
+    if isinstance(e, A.BoolLit):
+        return str(e.value).lower()
+    if isinstance(e, A.DateLit):
+        return f"date'{e.value}'"
+    if isinstance(e, A.BinaryOp):
+        return f"({_canon(e.left)}{e.op}{_canon(e.right)})"
+    if isinstance(e, A.UnaryOp):
+        return f"({e.op} {_canon(e.operand)})"
+    if isinstance(e, A.FuncCall):
+        d = "distinct " if e.distinct else ""
+        return f"{e.name}({d}{','.join(_canon(a) for a in e.args)})"
+    if isinstance(e, A.CastExpr):
+        return f"cast({_canon(e.operand)} as {e.type_name})"
+    if isinstance(e, A.Between):
+        return f"({_canon(e.value)} between {_canon(e.low)} and {_canon(e.high)})"
+    if isinstance(e, A.Case):
+        parts = [f"when {_canon(c)} then {_canon(r)}" for c, r in e.whens]
+        base = _canon(e.operand) if e.operand is not None else ""
+        dflt = f" else {_canon(e.default)}" if e.default is not None else ""
+        return f"case {base} {' '.join(parts)}{dflt} end"
+    if isinstance(e, A.ExtractExpr):
+        return f"extract({e.part} from {_canon(e.operand)})"
+    if isinstance(e, A.IsNull):
+        return f"({_canon(e.value)} is {'not ' if e.negated else ''}null)"
+    if isinstance(e, A.Like):
+        return f"({_canon(e.value)} like {_canon(e.pattern)})"
+    if isinstance(e, A.InList):
+        return f"({_canon(e.value)} in ({','.join(_canon(i) for i in e.items)}))"
+    return repr(e)
+
+
+def _default_name(e: A.Node) -> str:
+    if isinstance(e, A.Ident):
+        return e.parts[-1].lower()
+    if isinstance(e, A.FuncCall):
+        return "_col_" + e.name
+    return "_col"
+
+
+def _number_literal(text: str) -> ConstantExpression:
+    if "." in text:
+        digits = text.replace(".", "").lstrip("0") or "0"
+        scale = len(text.split(".")[1])
+        precision = max(len(digits), scale)
+        from decimal import Decimal
+        return constant(Decimal(text), DecimalType(precision, scale))
+    v = int(text)
+    if -2**31 <= v < 2**31:
+        return constant(v, INTEGER)
+    return constant(v, BIGINT)
+
+
+def _negate_const(c: ConstantExpression) -> ConstantExpression:
+    return constant(-c.value, c.type)
+
+
+def _to_boolean(e: RowExpression) -> RowExpression:
+    return e  # type analysis already guarantees boolean predicates
+
+
+def _is_decimal(t):
+    return isinstance(t, DecimalType)
+
+
+def _arith_type(op: str, t1: Type, t2: Type) -> Type:
+    if isinstance(t1, (DoubleType, RealType)) or isinstance(t2, (DoubleType, RealType)):
+        return DOUBLE
+    if isinstance(t1, DateType) or isinstance(t2, DateType):
+        return DATE  # date ± int days
+    if _is_decimal(t1) or _is_decimal(t2):
+        d1 = t1 if _is_decimal(t1) else DecimalType(19, 0)
+        d2 = t2 if _is_decimal(t2) else DecimalType(19, 0)
+        p1, s1 = d1.precision, d1.scale
+        p2, s2 = d2.precision, d2.scale
+        # reference DecimalOperators precision/scale rules
+        if op in ("+", "-"):
+            s = max(s1, s2)
+            p = min(38, max(p1 - s1, p2 - s2) + s + 1)
+            return DecimalType(p, s)
+        if op == "*":
+            return DecimalType(min(38, p1 + p2), s1 + s2)
+        if op == "/":
+            s = max(s1, s2)
+            p = min(38, p1 + s2 + max(0, s2 - s1))
+            return DecimalType(max(p, s + 1), s)
+        if op == "%":
+            return DecimalType(min(p1, p2), max(s1, s2))
+    if isinstance(t1, BigintType) or isinstance(t2, BigintType):
+        return BIGINT
+    return INTEGER if isinstance(t1, IntegerType) and isinstance(t2, IntegerType) else BIGINT
+
+
+def _unify_comparison(left: RowExpression, right: RowExpression):
+    """Coerce literal types toward the column side for comparisons (e.g.
+    decimal column vs integer literal)."""
+    lt, rt = left.type, right.type
+    if isinstance(left, ConstantExpression) and not isinstance(right, ConstantExpression):
+        r, l = _unify_comparison(right, left)
+        return l, r
+    if isinstance(right, ConstantExpression):
+        if _is_decimal(lt) and isinstance(rt, (IntegerType, BigintType)):
+            from decimal import Decimal
+            return left, ConstantExpression(Decimal(right.value),
+                                            DecimalType(38, lt.scale))
+        if _is_decimal(lt) and _is_decimal(rt):
+            return left, right
+        if isinstance(lt, DateType) and isinstance(rt, (VarcharType, CharType)):
+            return left, ConstantExpression(right.value, DATE)
+    return left, right
+
+
+def _agg_output_type(fname: str, input_type: Type) -> Type:
+    if fname == "count":
+        return BIGINT
+    if fname == "sum":
+        if isinstance(input_type, DecimalType):
+            return DecimalType(38, input_type.scale)
+        if isinstance(input_type, (DoubleType, RealType)):
+            return DOUBLE
+        return BIGINT
+    if fname == "avg":
+        if isinstance(input_type, DecimalType):
+            return input_type
+        return DOUBLE
+    # min / max preserve type
+    return input_type

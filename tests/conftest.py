@@ -1,13 +1,22 @@
-"""Test configuration: force an 8-device virtual CPU mesh so multi-chip sharding
-is exercised without TPU hardware (see SURVEY.md §7 / driver dryrun contract)."""
-import os
+"""Test configuration: force an 8-device virtual CPU mesh so multi-chip
+sharding is exercised without TPU hardware (SURVEY.md §7 / driver dryrun
+contract).
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+NOTE: this image's axon TPU plugin ignores JAX_PLATFORMS, so we set
+JAX_PLATFORM_NAME and the jax_platforms config explicitly.
+"""
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["JAX_PLATFORM_NAME"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
-os.environ.setdefault("JAX_ENABLE_X64", "true")
 
-import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
