@@ -1,0 +1,95 @@
+"""Driver benchmark: TPC-H Q1 wall-clock through the full engine.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+value       = lineitem rows/sec through the flagship Q1 pipeline
+              (parse -> plan -> jitted scan/filter/project/grouped-agg), best
+              of BENCH_RUNS timed runs after a compile warmup.
+vs_baseline = speedup vs the single-threaded numpy reference interpreter
+              (exec/reference.py) on the same machine/data — the stand-in for
+              the reference's single-node row-at-a-time engine, measured fresh
+              each round so the ratio tracks engine improvements only.
+
+Env knobs: BENCH_SF (default 1), BENCH_RUNS (default 3), BENCH_QUERY (q1|q6).
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+# Honor JAX_PLATFORMS=cpu even under the axon TPU plugin, which ignores the
+# env var (same dance as tests/conftest.py / __graft_entry__.py).
+if "cpu" in os.environ.get("JAX_PLATFORMS", ""):
+    os.environ.setdefault("JAX_PLATFORM_NAME", "cpu")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+Q1 = """
+SELECT returnflag, linestatus,
+       sum(quantity) AS sum_qty,
+       sum(extendedprice) AS sum_base_price,
+       sum(extendedprice * (1 - discount)) AS sum_disc_price,
+       sum(extendedprice * (1 - discount) * (1 + tax)) AS sum_charge,
+       avg(quantity) AS avg_qty,
+       avg(extendedprice) AS avg_price,
+       avg(discount) AS avg_disc,
+       count(*) AS count_order
+FROM lineitem
+WHERE shipdate <= DATE '1998-12-01' - INTERVAL '90' DAY
+GROUP BY returnflag, linestatus
+ORDER BY returnflag, linestatus
+"""
+
+Q6 = """
+SELECT sum(extendedprice * discount) AS revenue
+FROM lineitem
+WHERE shipdate >= DATE '1994-01-01'
+  AND shipdate < DATE '1995-01-01'
+  AND discount BETWEEN 0.05 AND 0.07
+  AND quantity < 24
+"""
+
+
+def main():
+    sf = float(os.environ.get("BENCH_SF", "1"))
+    runs = int(os.environ.get("BENCH_RUNS", "3"))
+    qname = os.environ.get("BENCH_QUERY", "q1")
+    sql = {"q1": Q1, "q6": Q6}[qname]
+
+    from presto_tpu.connectors import tpch
+    from presto_tpu.exec.runner import LocalQueryRunner
+
+    schema = f"sf{sf:g}"
+    n_rows = tpch._table_rows("lineitem", sf)
+    runner = LocalQueryRunner(schema=schema)
+
+    # Warmup: traces + compiles every pipeline shape bucket and faults the
+    # generated lineitem columns into memory/HBM.
+    runner.execute(sql)
+
+    best = float("inf")
+    for _ in range(runs):
+        t0 = time.perf_counter()
+        result = runner.execute(sql)
+        best = min(best, time.perf_counter() - t0)
+    assert result.rows, "benchmark query returned no rows"
+
+    # Baseline: numpy reference interpreter, same plan + data, one timed run
+    # (it is deterministic and has no compile step).
+    t0 = time.perf_counter()
+    runner.execute_reference(sql)
+    ref_wall = time.perf_counter() - t0
+
+    rows_per_sec = n_rows / best
+    print(json.dumps({
+        "metric": f"tpch_{qname}_sf{sf:g}_rows_per_sec",
+        "value": round(rows_per_sec, 1),
+        "unit": "rows/s",
+        "vs_baseline": round(ref_wall / best, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()
