@@ -215,8 +215,15 @@ def batch_to_page(batch: Batch, names, types) -> Page:
             continue
         if col.dictionary is not None:
             from ..common.block import DictionaryBlock as HB, VariableWidthBlock as VB
-            dict_block = VB.from_strings(list(col.dictionary))
-            blocks.append(HB(values.astype(np.int32), dict_block))
+            ids = values.astype(np.int32)
+            entries = list(col.dictionary)
+            if nulls is not None and nulls.any():
+                # DictionaryBlock carries nulls via its dictionary entries:
+                # route NULL rows to an appended None entry.
+                ids[nulls] = len(entries)
+                entries.append(None)
+            dict_block = VB.from_strings(entries)
+            blocks.append(HB(ids, dict_block))
             continue
         if isinstance(typ, (VarcharType, CharType)):
             raise NotImplementedError("varchar column without dictionary")

@@ -277,8 +277,7 @@ def agg_merge(a: dict, b: dict, specs: Tuple[AggSpec, ...],
 
 def agg_finalize(state: dict, specs: Tuple[AggSpec, ...],
                  key_names: Tuple[str, ...],
-                 key_dicts: Dict[str, Tuple[str, ...]],
-                 avg_decimal_scales: Dict[str, int]) -> Batch:
+                 key_dicts: Dict[str, Tuple[str, ...]]) -> Batch:
     """Accumulator table -> output Batch (capacity == num_slots, mask ==
     occupied).  Runs under jit; host later compacts via batch_to_page."""
     occupied = state["__occupied"]

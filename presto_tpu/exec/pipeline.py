@@ -369,7 +369,7 @@ class PlanCompiler:
             if not key_names and not bool(jnp.any(state["__occupied"])):
                 # global aggregation over empty input still yields one row
                 state["__occupied"] = state["__occupied"].at[0].set(True)
-            batch = ops.agg_finalize(state, specs, key_names, key_dicts, {})
+            batch = ops.agg_finalize(state, specs, key_names, key_dicts)
             yield batch
         return BatchSource(gen, out_names, out_types)
 

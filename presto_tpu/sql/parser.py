@@ -568,8 +568,6 @@ class Parser:
                 if self.accept("keyword", "from"):
                     start = self.parse_expr()
                     length = None
-                    if self.accept("keyword", "from"):
-                        pass
                     if self.accept("ident", "for") or self.accept("keyword", "for"):
                         length = self.parse_expr()
                     args = [operand, start] + ([length] if length else [])

@@ -104,18 +104,19 @@ class Planner:
         for name, cte in query.ctes:
             self._ctes[name.lower()] = cte
 
-        # 1. FROM: plan relations, collect scopes
-        node, scope = self.plan_from(query)
+        # 1. FROM: plan relations, collect scopes (consumes WHERE when it can
+        # push/attach conjuncts; tells us via the returned flag)
+        node, scope, where_done = self.plan_from(query)
 
         # 2. WHERE
-        if query.where is not None:
+        if query.where is not None and not where_done:
             pred = self.plan_expr(query.where, scope)
             node = P.FilterNode(self.new_id("filter"), node,
                                 _to_boolean(pred))
 
         # 3. aggregation
         agg_calls = _collect_agg_calls(query)
-        if query.group_by or agg_calls or query.distinct and False:
+        if query.group_by or agg_calls:
             node, scope = self.plan_aggregation(query, node, scope, agg_calls)
             if query.having is not None:
                 pred = self.plan_expr(query.having, scope)
@@ -197,10 +198,12 @@ class Planner:
     # FROM planning: scans, pushdown, joins
     # ------------------------------------------------------------------
     def plan_from(self, query: A.Query):
+        """Returns (node, scope, where_consumed)."""
         if not query.relations:
             row = [constant(1, BIGINT)]
             v = self.new_var("dummy", BIGINT)
-            return P.ValuesNode(self.new_id("values"), [v], [row]), Scope([])
+            return (P.ValuesNode(self.new_id("values"), [v], [row]),
+                    Scope([]), False)
 
         # flatten JoinRel trees into (relation, join_type, on) sequence
         flat: List[Tuple[A.Node, str, Optional[A.Node]]] = []
@@ -225,10 +228,25 @@ class Planner:
         where_conjuncts = _conjuncts(query.where)
         on_conjuncts: List[A.Node] = []
 
+        # Relations on the null-producing side of an outer join must not have
+        # WHERE conjuncts pushed below the join: WHERE applies after
+        # null-extension, so a pushed filter would let null-extended rows
+        # survive that the post-join filter should eliminate.
+        null_producing = set()
+        for i, (_, _, jt, _) in enumerate(planned):
+            if jt == "LEFT":
+                null_producing.add(i)
+            elif jt == "RIGHT":
+                null_producing.update(range(i))
+            elif jt == "FULL":
+                null_producing.update(range(len(planned)))
+
         # push single-relation conjuncts to their relation
         remaining: List[A.Node] = []
         consumed_where: List[A.Node] = []
         for i, (node, rscope, jt, on) in enumerate(planned):
+            if i in null_producing:
+                continue
             single_scope = Scope([rscope])
             preds = []
             for c in where_conjuncts:
@@ -248,11 +266,17 @@ class Planner:
         # build left-deep join tree in FROM order
         node, rscope, _, _ = planned[0]
         scopes = [rscope]
-        for next_node, next_scope, jt, on in planned[1:]:
+        for j, (next_node, next_scope, jt, on) in enumerate(planned[1:], 1):
             left_scope = Scope(scopes)
             right_scope = Scope([next_scope])
             conjs = list(_conjuncts(on))
-            if jt == "INNER" or jt == "CROSS":
+            # A WHERE conjunct may fold into this INNER join only if no later
+            # join null-extends the rows it sees (a later RIGHT/FULL join
+            # would null-extend this side, and WHERE must run after that).
+            later_extends_left = any(
+                planned[k][2] in ("RIGHT", "FULL")
+                for k in range(j + 1, len(planned)))
+            if jt in ("INNER", "CROSS") and not later_extends_left:
                 # pull applicable WHERE conjuncts into the join
                 for c in list(remaining):
                     if _resolvable(self, c, Scope(scopes + [next_scope])):
@@ -293,9 +317,10 @@ class Planner:
             preds = [_to_boolean(self.plan_expr(c, scope)) for c in remaining]
             node = P.FilterNode(self.new_id("post_join_filter"), node,
                                 and_(*preds))
-        # rebuild query.where consumed marker: all conjuncts were used
-        query.where = None
-        return node, scope
+        # every WHERE conjunct was pushed, folded into a join, or applied in
+        # the post-join filter; signal without mutating the AST (CTEs re-plan
+        # their query AST on each reference)
+        return node, scope, True
 
     def plan_base_relation(self, rel: A.Node, query: A.Query):
         if isinstance(rel, A.SubqueryRef):
@@ -393,11 +418,11 @@ class Planner:
                 v = self.new_var("groupkey", e.type)
             pre_assign[v] = e
             key_vars.append(v)
-            expr_vars[_canon(ast)] = v
+            expr_vars[_canon(ast, scope)] = v
 
         aggregations: Dict[VariableReferenceExpression, P.Aggregation] = {}
         for fc in agg_calls:
-            key = _canon(fc)
+            key = _canon(fc, scope)
             if key in expr_vars:
                 continue
             fname = fc.name
@@ -447,7 +472,7 @@ class Planner:
     # ------------------------------------------------------------------
     def plan_expr(self, e: A.Node, scope: Scope) -> RowExpression:
         if scope.expr_vars:
-            key = _canon(e)
+            key = _canon(e, scope)
             if key in scope.expr_vars:
                 return scope.expr_vars[key]
         if isinstance(e, A.Ident):
@@ -729,10 +754,19 @@ def _collect_agg_calls(query: A.Query) -> List[A.FuncCall]:
     return out
 
 
-def _canon(e: A.Node) -> str:
-    """Canonical text of an AST expression, for matching group keys/aggs."""
+def _canon(e: A.Node, scope: Optional[Scope] = None) -> str:
+    """Canonical text of an AST expression, for matching group keys/aggs.
+
+    With a scope, identifiers canonicalize to their resolved (globally
+    unique) variable, so `l.x` and bare `x` match while `a.x` and `b.x`
+    stay distinct; without one, to their fully qualified text."""
     if isinstance(e, A.Ident):
-        return ".".join(p.lower() for p in e.parts[-1:])
+        if scope is not None:
+            try:
+                return "var:" + scope.resolve(e.parts).name
+            except PlanningError:
+                pass
+        return ".".join(p.lower() for p in e.parts)
     if isinstance(e, A.NumberLit):
         return e.text
     if isinstance(e, A.StringLit):
@@ -741,30 +775,31 @@ def _canon(e: A.Node) -> str:
         return str(e.value).lower()
     if isinstance(e, A.DateLit):
         return f"date'{e.value}'"
+    c = lambda x: _canon(x, scope)  # noqa: E731
     if isinstance(e, A.BinaryOp):
-        return f"({_canon(e.left)}{e.op}{_canon(e.right)})"
+        return f"({c(e.left)}{e.op}{c(e.right)})"
     if isinstance(e, A.UnaryOp):
-        return f"({e.op} {_canon(e.operand)})"
+        return f"({e.op} {c(e.operand)})"
     if isinstance(e, A.FuncCall):
         d = "distinct " if e.distinct else ""
-        return f"{e.name}({d}{','.join(_canon(a) for a in e.args)})"
+        return f"{e.name}({d}{','.join(c(a) for a in e.args)})"
     if isinstance(e, A.CastExpr):
-        return f"cast({_canon(e.operand)} as {e.type_name})"
+        return f"cast({c(e.operand)} as {e.type_name})"
     if isinstance(e, A.Between):
-        return f"({_canon(e.value)} between {_canon(e.low)} and {_canon(e.high)})"
+        return f"({c(e.value)} between {c(e.low)} and {c(e.high)})"
     if isinstance(e, A.Case):
-        parts = [f"when {_canon(c)} then {_canon(r)}" for c, r in e.whens]
-        base = _canon(e.operand) if e.operand is not None else ""
-        dflt = f" else {_canon(e.default)}" if e.default is not None else ""
+        parts = [f"when {c(w)} then {c(r)}" for w, r in e.whens]
+        base = c(e.operand) if e.operand is not None else ""
+        dflt = f" else {c(e.default)}" if e.default is not None else ""
         return f"case {base} {' '.join(parts)}{dflt} end"
     if isinstance(e, A.ExtractExpr):
-        return f"extract({e.part} from {_canon(e.operand)})"
+        return f"extract({e.part} from {c(e.operand)})"
     if isinstance(e, A.IsNull):
-        return f"({_canon(e.value)} is {'not ' if e.negated else ''}null)"
+        return f"({c(e.value)} is {'not ' if e.negated else ''}null)"
     if isinstance(e, A.Like):
-        return f"({_canon(e.value)} like {_canon(e.pattern)})"
+        return f"({c(e.value)} like {c(e.pattern)})"
     if isinstance(e, A.InList):
-        return f"({_canon(e.value)} in ({','.join(_canon(i) for i in e.items)}))"
+        return f"({c(e.value)} in ({','.join(c(i) for i in e.items)}))"
     return repr(e)
 
 

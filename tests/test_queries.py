@@ -252,3 +252,39 @@ def test_generator_process_deterministic():
     outs = {subprocess.run([sys.executable, "-c", code], capture_output=True,
                            text=True, cwd="/root/repo").stdout for _ in range(2)}
     assert len(outs) == 1 and "[" in outs.pop()
+
+
+def test_left_join_where_on_right_side_not_pushed(runner):
+    # WHERE on the null-producing side applies AFTER null-extension: no
+    # null-extended row may survive o_orderkey < 10.
+    res = check(runner, """
+        select c_custkey, o_orderkey from customer
+        left join orders on c_custkey = o_custkey
+        where o_orderkey < 10 and c_custkey < 100""")
+    assert all(r[1] is not None and r[1] < 10 for r in res.rows)
+
+
+def test_cte_where_survives_second_reference(runner):
+    res = check(runner, """
+        with t as (select n_nationkey k from nation where n_nationkey < 3)
+        select a.k, b.k from t a, t b""")
+    assert len(res.rows) == 9
+
+
+def test_left_join_null_string_column(runner):
+    # NULL varchar values must round-trip through the dictionary block.
+    res = check(runner, """
+        select c_custkey, n_name from customer
+        left join nation on c_custkey = n_nationkey
+        where c_custkey between 23 and 27""")
+    by_key = {r[0]: r[1] for r in res.rows}
+    assert by_key[23] is not None
+    assert by_key[25] is None and by_key[26] is None
+
+
+def test_group_by_same_column_name_two_tables(runner):
+    res = check(runner, """
+        select a.n_regionkey, b.n_regionkey, count(*) from nation a, nation b
+        where a.n_nationkey + 1 = b.n_nationkey
+        group by a.n_regionkey, b.n_regionkey""")
+    assert any(r[0] != r[1] for r in res.rows)
