@@ -554,3 +554,18 @@ def split_pages(split: TpchSplit, columns: Optional[Sequence[str]] = None,
         n = min(page_rows, split.end - pos)
         yield generate_page(split.table, split.sf, pos, n, columns)
         pos += n
+
+
+# ---------------------------------------------------------------------------
+# connector stats (feeds the fragmenter's join-distribution choice, the
+# analog of TpchMetadata.getTableStatistics -> StatsCalculator)
+# ---------------------------------------------------------------------------
+
+def _connector_stats(handle) -> float:
+    sf = dict(handle.extra).get("scaleFactor", 0.01)
+    return float(table_row_count(handle.table_name, sf))
+
+
+from ..sql.fragmenter import register_connector_stats as _reg_stats  # noqa: E402
+
+_reg_stats("tpch", _connector_stats)

@@ -168,6 +168,21 @@ def block_to_column(typ: Type, block, capacity: int) -> Column:
             nulls = jnp.asarray(nm)
         return Column(jnp.asarray(codes), nulls, tuple(uniq))
 
+    from ..common.block import Int128Block
+    if isinstance(block, Int128Block):
+        # device holds long decimals narrowed to int64 (batch_to_page widens
+        # on the way back out); values beyond int64 would need Pallas i128
+        ints = block.to_pylist()
+        vals = np.zeros(capacity, dtype=np.int64)
+        nm = np.zeros(capacity, dtype=bool)
+        for i, v in enumerate(ints):
+            if v is None:
+                nm[i] = True
+            else:
+                vals[i] = v
+        nulls = jnp.asarray(nm) if nm.any() else None
+        return Column(jnp.asarray(vals), nulls)
+
     if not isinstance(block, FixedWidthBlock):
         raise NotImplementedError(
             f"device column from {type(block).__name__} not supported yet")
@@ -199,6 +214,9 @@ def batch_to_page(batch: Batch, names, types) -> Page:
     """Device batch -> host page (drops masked-out rows)."""
     mask = np.asarray(batch.mask)
     keep = np.flatnonzero(mask)
+    if keep.size == 0:
+        from ..common.block import block_from_values
+        return Page([block_from_values(t, []) for t in types], 0)
     blocks = []
     for name, typ in zip(names, types):
         col = batch.columns[name]
@@ -244,3 +262,72 @@ def batch_to_page(batch: Batch, names, types) -> Page:
             values = values.astype(np.int32)
         blocks.append(FixedWidthBlock(values, nulls))
     return Page(blocks, len(keep))
+
+
+def pages_to_batches(pages, names, types, capacity):
+    """Host pages (exchange input) -> device batches with STABLE dictionaries.
+
+    Pages arriving from different producer tasks carry independent
+    dictionaries; jitted consumers (agg tables, concat for joins) need one
+    dictionary per column across all batches, so string columns are remapped
+    to a union dictionary first.  Pages larger than `capacity` are chunked.
+    """
+    from ..common.block import block_to_values
+
+    string_cols = [i for i, t in enumerate(types)
+                   if isinstance(t, (VarcharType, CharType))]
+    if not string_cols:
+        # numeric-only schema: stream page by page
+        for page in pages:
+            for lo in range(0, page.position_count, capacity):
+                n = min(capacity, page.position_count - lo)
+                cols = {}
+                for name, typ, block in zip(names, types, page.blocks):
+                    chunk = block if (lo == 0 and n == page.position_count) \
+                        else block.take(np.arange(lo, lo + n))
+                    cols[name] = block_to_column(typ, chunk, capacity)
+                mask = np.zeros(capacity, dtype=bool)
+                mask[:n] = True
+                yield Batch(cols, jnp.asarray(mask))
+        return
+
+    pages = [p for p in pages if p.position_count]
+    if not pages:
+        return
+    # union dictionary per string column; cache the decoded strings for reuse
+    unions = {}
+    decoded = {}  # (page index, col index) -> list of strings
+    for i in string_cols:
+        seen = set()
+        for pi, page in enumerate(pages):
+            strings = block_to_values(types[i], page.blocks[i])
+            decoded[(pi, i)] = strings
+            seen.update(s for s in strings if s is not None)
+        uniq = tuple(sorted(seen))
+        unions[i] = (uniq, {s: j for j, s in enumerate(uniq)})
+
+    for pi, page in enumerate(pages):
+        for lo in range(0, page.position_count, capacity):
+            n = min(capacity, page.position_count - lo)
+            cols = {}
+            for i, (name, typ) in enumerate(zip(names, types)):
+                block = page.blocks[i]
+                if i in unions:
+                    uniq, index = unions[i]
+                    strings = decoded[(pi, i)][lo:lo + n]
+                    codes = np.zeros(capacity, dtype=np.int32)
+                    nm = np.zeros(capacity, dtype=bool)
+                    for j, s in enumerate(strings):
+                        if s is None:
+                            nm[j] = True
+                        else:
+                            codes[j] = index[s]
+                    nulls = jnp.asarray(nm) if nm.any() else None
+                    cols[name] = Column(jnp.asarray(codes), nulls, uniq)
+                else:
+                    chunk = block if (lo == 0 and n == page.position_count) \
+                        else block.take(np.arange(lo, lo + n))
+                    cols[name] = block_to_column(typ, chunk, capacity)
+            mask = np.zeros(capacity, dtype=bool)
+            mask[:n] = True
+            yield Batch(cols, jnp.asarray(mask))

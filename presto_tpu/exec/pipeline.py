@@ -33,7 +33,8 @@ from ..connectors import tpch
 from ..spi.expr import (CallExpression, RowExpression,
                         VariableReferenceExpression)
 from ..spi import plan as P
-from .batch import Batch, Column, batch_to_page, page_to_batch
+from .batch import (Batch, Column, batch_to_page, page_to_batch,
+                    pages_to_batches)
 from . import operators as ops
 from .lowering import Lowering, canonical_name
 
@@ -191,8 +192,10 @@ class PlanCompiler:
         cap = self.ctx.config.batch_rows
 
         def gen():
-            for page in source():
-                yield page_to_batch(page, names, types, cap)
+            # string columns are materialized + remapped to a union
+            # dictionary (producer tasks ship independent dictionaries;
+            # jitted consumers need one per column); numeric-only streams
+            yield from pages_to_batches(source(), names, types, cap)
         return BatchSource(gen, names, types)
 
     # -- streaming transforms --------------------------------------------
@@ -402,7 +405,25 @@ class PlanCompiler:
             if build_batch is None:
                 if node.join_type == P.INNER:
                     return
-                raise NotImplementedError("LEFT join with empty build")
+                # LEFT join with empty build: every probe row null-extends
+                from .lowering import _jnp_dtype
+                build_types = {v.name: v.type
+                               for v in build_src_node.output_variables}
+                for batch in probe.batches():
+                    cols = dict(batch.columns)
+                    for name in build_out:
+                        t = build_types[name]
+                        if isinstance(t, (VarcharType, CharType)):
+                            col = Column(
+                                jnp.zeros(batch.capacity, dtype=jnp.int32),
+                                jnp.ones(batch.capacity, dtype=bool), ("",))
+                        else:
+                            col = Column(
+                                jnp.zeros(batch.capacity, dtype=_jnp_dtype(t)),
+                                jnp.ones(batch.capacity, dtype=bool))
+                        cols[name] = col
+                    yield Batch(cols, batch.mask).select(out_names)
+                return
             table = jax.jit(ops.build_table, static_argnums=(1,))(
                 build_batch, tuple(build_keys))
 

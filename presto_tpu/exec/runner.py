@@ -27,6 +27,16 @@ class QueryResult:
             (v is None, str(type(v)), v) for v in r))
 
 
+def pages_to_result(pages, names, types) -> "QueryResult":
+    """Decode host pages into a QueryResult row list."""
+    rows: List[List] = []
+    for page in pages:
+        cols = [block_to_values(t, b) for t, b in zip(types, page.blocks)]
+        for i in range(page.position_count):
+            rows.append([c[i] for c in cols])
+    return QueryResult(names, types, rows)
+
+
 class LocalQueryRunner:
     def __init__(self, schema: str = "sf0.01",
                  config: Optional[ExecutionConfig] = None):
@@ -43,12 +53,7 @@ class LocalQueryRunner:
         compiler = PlanCompiler(ctx)
         names = output.column_names
         types = [v.type for v in output.outputs]
-        rows: List[List] = []
-        for page in compiler.run_to_pages(output):
-            cols = [block_to_values(t, b) for t, b in zip(types, page.blocks)]
-            for i in range(page.position_count):
-                rows.append([c[i] for c in cols])
-        return QueryResult(names, types, rows)
+        return pages_to_result(compiler.run_to_pages(output), names, types)
 
     def execute_reference(self, sql: str) -> QueryResult:
         """Same query through the numpy reference interpreter (the oracle)."""
@@ -63,6 +68,36 @@ class LocalQueryRunner:
         exp = self.execute_reference(sql)
         _assert_rows_equal(got, exp, ordered)
         return got
+
+
+class DistributedQueryRunner(LocalQueryRunner):
+    """Plans with exchange insertion + fragmentation and executes the fragment
+    DAG as multi-task stages through the in-process scheduler — the analog of
+    the reference DistributedQueryRunner (presto-tests/.../DistributedQueryRunner.java:108)
+    with in-process "workers"."""
+
+    def __init__(self, schema: str = "sf0.01",
+                 config: Optional[ExecutionConfig] = None,
+                 n_tasks: int = 2, broadcast_threshold: int = 600_000):
+        super().__init__(schema, config)
+        self.n_tasks = n_tasks
+        self.broadcast_threshold = broadcast_threshold
+
+    def plan_subplan(self, sql: str):
+        from ..sql.fragmenter import FragmenterConfig, plan_distributed
+        output = self.plan(sql)
+        names = output.column_names
+        types = [v.type for v in output.outputs]
+        cfg = FragmenterConfig(broadcast_threshold=self.broadcast_threshold)
+        return plan_distributed(output, cfg), names, types
+
+    def execute(self, sql: str) -> QueryResult:
+        from .scheduler import InProcessScheduler, SchedulerConfig
+        subplan, names, types = self.plan_subplan(sql)
+        sched = InProcessScheduler(SchedulerConfig(
+            exec_config=self.config, source_tasks=self.n_tasks,
+            hash_tasks=self.n_tasks))
+        return pages_to_result(sched.execute(subplan), names, types)
 
 
 def _assert_rows_equal(got: QueryResult, exp: QueryResult, ordered: bool):

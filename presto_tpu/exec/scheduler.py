@@ -1,0 +1,245 @@
+"""In-process distributed scheduler: runs a fragmented SubPlan as stages of
+parallel tasks with partitioned / broadcast / gather exchanges between them.
+
+The single-process analog of the reference's SqlQueryScheduler +
+SqlStageExecution + exchange plumbing (SURVEY.md §2.4, §2.5): stages execute
+bottom-up, each stage as N tasks; every task runs the fragment through the
+PlanCompiler and partitions its output pages into per-consumer-task buffers
+(PartitionedOutputOperator.java:58 semantics), which downstream tasks read as
+their RemoteSourceNode input (ExchangeOperator.java:36 pull).  The same
+task/buffer layout maps 1:1 onto the HTTP worker protocol (worker/) and onto
+ICI all-to-all (parallel/exchange.py) when tasks sit on chips of one pod.
+
+Partition routing hashes the LOGICAL value (strings by their bytes, not
+their dictionary codes) so producers with different dictionaries agree.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from ..common.block import (Block, DictionaryBlock, FixedWidthBlock,
+                            VariableWidthBlock, decode_to_flat)
+from ..common.page import Page
+from ..common.types import (CharType, Type, VarcharType)
+from ..connectors import tpch
+from ..spi import plan as P
+from .pipeline import ExecutionConfig, PlanCompiler, TaskContext
+
+
+@dataclass
+class SchedulerConfig:
+    exec_config: ExecutionConfig = field(default_factory=ExecutionConfig)
+    # tasks per source-partitioned (scan) stage — the "worker count"
+    source_tasks: int = 2
+    # tasks per FIXED_HASH intermediate stage
+    hash_tasks: int = 2
+
+
+# ---------------------------------------------------------------------------
+# host-side partition hashing (value-based, dictionary-independent)
+# ---------------------------------------------------------------------------
+
+_M1 = np.uint64(0xBF58476D1CE4E5B9)
+_M2 = np.uint64(0x94D049BB133111EB)
+_NULL_HASH = np.uint64(0x9E3779B97F4A7C15)
+
+
+def _splitmix64(x: np.ndarray) -> np.ndarray:
+    with np.errstate(over="ignore"):
+        x = x + np.uint64(0x9E3779B97F4A7C15)
+        x = (x ^ (x >> np.uint64(30))) * _M1
+        x = (x ^ (x >> np.uint64(27))) * _M2
+        return x ^ (x >> np.uint64(31))
+
+
+def _utf8(s) -> bytes:
+    return s.encode("utf-8") if isinstance(s, str) else bytes(s)
+
+
+def _fnv1a64(data: bytes) -> int:
+    h = 0xCBF29CE484222325
+    for b in data:
+        h = ((h ^ b) * 0x100000001B3) & 0xFFFFFFFFFFFFFFFF
+    return h
+
+
+def _hash_block(typ: Type, block: Block, n: int) -> np.ndarray:
+    """Per-row uint64 value hash of one column."""
+    if isinstance(typ, (VarcharType, CharType)):
+        if isinstance(block, DictionaryBlock):
+            inner = decode_to_flat(block.dictionary)
+            entry_hash = np.array(
+                [_NULL_HASH if s is None
+                 else np.uint64(_fnv1a64(_utf8(s)))
+                 for s in inner.to_pylist()], dtype=np.uint64)
+            return entry_hash[block.ids]
+        strings = decode_to_flat(block).to_pylist()
+        return np.array([_NULL_HASH if s is None
+                         else np.uint64(_fnv1a64(_utf8(s)))
+                         for s in strings], dtype=np.uint64)
+    flat = decode_to_flat(block)
+    values = flat.values
+    if values.dtype.kind == "f":
+        values = values.view(np.uint64 if values.itemsize == 8 else np.uint32)
+    h = _splitmix64(values.astype(np.int64).view(np.uint64))
+    if flat.may_have_null:
+        h = np.where(flat.null_mask(), _NULL_HASH, h)
+    return h
+
+
+def partition_targets(page: Page, types: List[Type], key_indices: List[int],
+                      n_parts: int) -> np.ndarray:
+    """Row -> target partition, combining the key columns' value hashes."""
+    n = page.position_count
+    h = np.full(n, np.uint64(1), dtype=np.uint64)
+    with np.errstate(over="ignore"):
+        for i in key_indices:
+            hv = _hash_block(types[i], page.blocks[i], n)
+            h = _splitmix64(h * np.uint64(31) + hv)
+    return (h % np.uint64(n_parts)).astype(np.int64)
+
+
+def split_page(page: Page, targets: np.ndarray, n_parts: int) -> List[Page]:
+    out = []
+    for p in range(n_parts):
+        idx = np.flatnonzero(targets == p)
+        if len(idx) == 0:
+            out.append(None)
+            continue
+        out.append(Page([b.take(idx) for b in page.blocks], len(idx)))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# stage / buffer model
+# ---------------------------------------------------------------------------
+
+class OutputBuffers:
+    """Per-fragment output: buffers[producer_task][partition] -> [Page].
+
+    Partition semantics by output scheme (reference OutputBuffers):
+      SINGLE            everything in partition 0 (gather consumers)
+      FIXED_HASH        partition = hash(keys) % consumer task count
+      FIXED_BROADCAST   partition 0 holds the full output; every consumer
+                        task reads it (BroadcastOutputBuffer)
+    """
+
+    def __init__(self, n_tasks: int, n_partitions: int, broadcast: bool):
+        self.broadcast = broadcast
+        self.pages: List[Dict[int, List[Page]]] = [
+            {p: [] for p in range(max(1, n_partitions))}
+            for _ in range(n_tasks)]
+
+    def add(self, task: int, partition: int, page: Page) -> None:
+        self.pages[task][partition].append(page)
+
+    def pages_for_consumer(self, consumer_task: int) -> List[Page]:
+        part = 0 if self.broadcast else consumer_task
+        out: List[Page] = []
+        for task_pages in self.pages:
+            out.extend(task_pages.get(part, ()))
+        return out
+
+
+@dataclass
+class StageInfo:
+    fragment: P.PlanFragment
+    children: List["StageInfo"]
+    n_tasks: int = 1
+    n_partitions: int = 1      # consumer task count (output fan-out)
+    buffers: Optional[OutputBuffers] = None
+
+
+class InProcessScheduler:
+    """Executes a SubPlan bottom-up.  Tasks run sequentially here; the HTTP
+    worker runtime (worker/) and the ICI exchange (parallel/) distribute the
+    same stage graph across processes/chips."""
+
+    def __init__(self, config: Optional[SchedulerConfig] = None):
+        self.config = config or SchedulerConfig()
+
+    # -- planning the stage tree -----------------------------------------
+    def _build_stages(self, subplan: P.SubPlan) -> StageInfo:
+        children = [self._build_stages(c) for c in subplan.children]
+        frag = subplan.fragment
+        if frag.partitioning == P.SOURCE_DISTRIBUTION:
+            n_tasks = self.config.source_tasks
+        elif frag.partitioning == P.FIXED_HASH_DISTRIBUTION:
+            n_tasks = self.config.hash_tasks
+        else:
+            n_tasks = 1
+        return StageInfo(frag, children, n_tasks)
+
+    def _assign_partitions(self, stage: StageInfo,
+                           consumer_tasks: int) -> None:
+        stage.n_partitions = consumer_tasks
+        handle = stage.fragment.output_partitioning_scheme.handle
+        broadcast = handle == P.FIXED_BROADCAST_DISTRIBUTION
+        n_parts = 1 if handle in (P.SINGLE_DISTRIBUTION,) or broadcast \
+            else consumer_tasks
+        stage.buffers = OutputBuffers(stage.n_tasks, n_parts, broadcast)
+        for c in stage.children:
+            self._assign_partitions(c, stage.n_tasks)
+
+    # -- execution --------------------------------------------------------
+    def execute(self, subplan: P.SubPlan) -> Iterator[Page]:
+        root = self._build_stages(subplan)
+        self._assign_partitions(root, 1)
+        self._run_stage(root)
+        yield from root.buffers.pages_for_consumer(0)
+
+    def _run_stage(self, stage: StageInfo) -> None:
+        for child in stage.children:
+            self._run_stage(child)
+        frag = stage.fragment
+        scheme = frag.output_partitioning_scheme
+        out_names = [v.name for v in frag.root.output_variables]
+        out_types = [v.type for v in frag.root.output_variables]
+        key_indices = [out_names.index(a.name) for a in scheme.arguments]
+        hashed = scheme.handle == P.FIXED_HASH_DISTRIBUTION
+
+        # split assignment per scan node: task i takes splits[i::n]
+        scan_splits: Dict[str, List] = {}
+        for node in P.walk_plan(frag.root):
+            if isinstance(node, P.TableScanNode):
+                th = node.table
+                sf = dict(th.extra).get("scaleFactor", 0.01)
+                n_splits = max(stage.n_tasks,
+                               self.config.exec_config.splits_per_scan)
+                scan_splits[node.id] = tpch.make_splits(
+                    th.table_name, sf, n_splits)
+
+        remote_nodes = [n for n in P.walk_plan(frag.root)
+                        if isinstance(n, P.RemoteSourceNode)]
+        child_by_fid = {c.fragment.fragment_id: c for c in stage.children}
+
+        for task_index in range(stage.n_tasks):
+            ctx = TaskContext(config=self.config.exec_config)
+            for node_id, splits in scan_splits.items():
+                ctx.splits[node_id] = splits[task_index::stage.n_tasks]
+            for rnode in remote_nodes:
+                sources = [child_by_fid[fid] for fid in
+                           rnode.source_fragment_ids]
+                ctx.remote_pages[rnode.id] = _remote_reader(
+                    sources, task_index)
+            compiler = PlanCompiler(ctx)
+            for page in compiler.run_to_pages(frag.root):
+                if hashed and stage.n_partitions > 1:
+                    targets = partition_targets(
+                        page, out_types, key_indices, stage.n_partitions)
+                    for p, sub in enumerate(
+                            split_page(page, targets, stage.n_partitions)):
+                        if sub is not None:
+                            stage.buffers.add(task_index, p, sub)
+                else:
+                    stage.buffers.add(task_index, 0, page)
+
+
+def _remote_reader(sources: List[StageInfo], consumer_task: int):
+    def read() -> Iterator[Page]:
+        for src in sources:
+            yield from src.buffers.pages_for_consumer(consumer_task)
+    return read

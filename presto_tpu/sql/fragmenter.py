@@ -1,0 +1,477 @@
+"""Distribution planning: exchange insertion + plan fragmentation.
+
+The TPU analog of the reference's distribution passes and fragmenter
+(presto-main-base/.../sql/planner/optimizations/AddExchanges.java:161,
+PlanFragmenter.java:49, createSubPlans :73).  The single-task logical plan the
+planner emits is rewritten so that:
+
+- aggregations split into PARTIAL (runs where the data is) + a REMOTE
+  repartition-by-group-keys exchange (or gather, for global aggs) + FINAL
+  (the reference's PushPartialAggregationThroughExchange rule);
+- joins pick a distribution: REPLICATED (broadcast the build side, the
+  reference's join_distribution_type=BROADCAST) when the build estimate is
+  under the threshold, else PARTITIONED (both sides repartitioned on the
+  join keys, FIXED_HASH_DISTRIBUTION);
+- sort/topN/limit split into partial (distributed) + final (after a gather);
+- the root gets a GATHER exchange (the coordinator's result pump reads a
+  SINGLE-distribution root stage, Query.java:116).
+
+`fragment_plan` then cuts the plan at REMOTE exchanges into a SubPlan tree of
+PlanFragments with RemoteSourceNode leaves, exactly where the reference's
+coordinator would hand each fragment to a stage.
+
+avg() is rewritten at the split (partial sum+count, final sums, then a
+projection dividing them) so the engine only ever executes decomposable
+aggregates — the reference does the same via its intermediate "avg state"
+row type; a projection keeps the TPU pipeline in plain columns instead.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..common.types import BIGINT, DOUBLE, DecimalType, DoubleType, RealType, Type
+from ..spi import plan as P
+from ..spi.expr import (CallExpression, RowExpression,
+                        VariableReferenceExpression)
+
+Variable = VariableReferenceExpression
+
+
+@dataclass
+class FragmenterConfig:
+    # broadcast the join build side when its estimated rows fall below this
+    # (reference: join_distribution_type AUTOMATIC + JoinSwappingRules)
+    broadcast_threshold: int = 600_000
+
+
+# ---------------------------------------------------------------------------
+# cardinality estimation (the skeleton of the reference's StatsCalculator)
+# ---------------------------------------------------------------------------
+
+# connector id -> (TableHandle -> Optional[row count])
+CONNECTOR_STATS: Dict[str, Callable[[P.TableHandle], Optional[float]]] = {}
+
+
+def register_connector_stats(connector_id: str, fn) -> None:
+    CONNECTOR_STATS[connector_id] = fn
+
+
+def _connector_stats_fn(connector_id: str):
+    if connector_id not in CONNECTOR_STATS and connector_id == "tpch":
+        # built-in connector: load on demand so estimates don't silently
+        # depend on unrelated import order
+        from ..connectors import tpch  # noqa: F401  (registers itself)
+    return CONNECTOR_STATS.get(connector_id)
+
+
+def estimate_rows(node: P.PlanNode) -> Optional[float]:
+    """Rough output-cardinality estimate; None = unknown."""
+    if isinstance(node, P.TableScanNode):
+        fn = _connector_stats_fn(node.table.connector_id)
+        return fn(node.table) if fn else None
+    if isinstance(node, P.FilterNode):
+        c = estimate_rows(node.source)
+        return None if c is None else c * 0.5
+    if isinstance(node, (P.ProjectNode, P.OutputNode, P.SortNode,
+                         P.MarkDistinctNode, P.AssignUniqueIdNode,
+                         P.EnforceSingleRowNode, P.WindowNode)):
+        return estimate_rows(node.sources[0])
+    if isinstance(node, (P.LimitNode, P.TopNNode, P.DistinctLimitNode)):
+        c = estimate_rows(node.sources[0])
+        return node.count if c is None else min(float(node.count), c)
+    if isinstance(node, P.AggregationNode):
+        c = estimate_rows(node.source)
+        if not node.grouping_keys:
+            return 1.0
+        return None if c is None else max(1.0, c * 0.1)
+    if isinstance(node, P.JoinNode):
+        l, r = estimate_rows(node.left), estimate_rows(node.right)
+        if l is None or r is None:
+            return None
+        return max(l, r)
+    if isinstance(node, P.SemiJoinNode):
+        return estimate_rows(node.source)
+    if isinstance(node, P.ValuesNode):
+        return float(len(node.rows))
+    if isinstance(node, P.ExchangeNode):
+        ests = [estimate_rows(s) for s in node.exchange_sources]
+        if any(e is None for e in ests):
+            return None
+        return sum(ests)
+    if isinstance(node, P.RemoteSourceNode):
+        return None
+    srcs = node.sources
+    return estimate_rows(srcs[0]) if srcs else None
+
+
+# ---------------------------------------------------------------------------
+# exchange insertion
+# ---------------------------------------------------------------------------
+
+SINGLE = "single"          # all rows on one task
+SOURCE = "source"          # split-partitioned leaf (scan-driven)
+HASHED = "hashed"          # hash-partitioned on keys
+
+
+@dataclass
+class _Placed:
+    node: P.PlanNode
+    dist: str                       # SINGLE / SOURCE / HASHED
+    hash_keys: Tuple[str, ...] = ()
+
+
+class ExchangeInserter:
+    def __init__(self, config: Optional[FragmenterConfig] = None):
+        self.config = config or FragmenterConfig()
+        self._counter = 0
+
+    # -- helpers ----------------------------------------------------------
+    def _id(self, hint: str) -> str:
+        self._counter += 1
+        return f"x_{hint}_{self._counter}"
+
+    def _var(self, hint: str, typ: Type) -> Variable:
+        self._counter += 1
+        return Variable(f"{hint}_x{self._counter}", typ)
+
+    def _gather(self, child: P.PlanNode) -> P.PlanNode:
+        layout = list(child.output_variables)
+        return P.ExchangeNode(
+            self._id("gather"), P.GATHER, P.REMOTE,
+            P.PartitioningScheme(P.SINGLE_DISTRIBUTION, [], layout),
+            [child], [layout])
+
+    def _repartition(self, child: P.PlanNode, keys: List[Variable]) -> P.PlanNode:
+        layout = list(child.output_variables)
+        return P.ExchangeNode(
+            self._id("repart"), P.REPARTITION, P.REMOTE,
+            P.PartitioningScheme(P.FIXED_HASH_DISTRIBUTION, list(keys), layout),
+            [child], [layout])
+
+    def _broadcast(self, child: P.PlanNode) -> P.PlanNode:
+        layout = list(child.output_variables)
+        return P.ExchangeNode(
+            self._id("bcast"), P.REPLICATE, P.REMOTE,
+            P.PartitioningScheme(P.FIXED_BROADCAST_DISTRIBUTION, [], layout),
+            [child], [layout])
+
+    # -- entry ------------------------------------------------------------
+    def rewrite(self, root: P.PlanNode) -> P.PlanNode:
+        placed = self._visit(root)
+        return placed.node
+
+    # -- dispatch ---------------------------------------------------------
+    def _visit(self, node: P.PlanNode) -> _Placed:
+        m = getattr(self, "_visit_" + type(node).__name__, None)
+        if m is not None:
+            return m(node)
+        # default: single-source passthrough keeps the child's distribution
+        srcs = node.sources
+        if len(srcs) == 1:
+            child = self._visit(srcs[0])
+            _set_source(node, child.node)
+            return _Placed(node, child.dist, child.hash_keys)
+        if not srcs:
+            return _Placed(node, SINGLE)
+        raise NotImplementedError(
+            f"exchange insertion for {type(node).__name__}")
+
+    # -- leaves -----------------------------------------------------------
+    def _visit_TableScanNode(self, node: P.TableScanNode) -> _Placed:
+        return _Placed(node, SOURCE)
+
+    def _visit_ValuesNode(self, node: P.ValuesNode) -> _Placed:
+        return _Placed(node, SINGLE)
+
+    # -- structural -------------------------------------------------------
+    def _visit_OutputNode(self, node: P.OutputNode) -> _Placed:
+        child = self._visit(node.source)
+        if child.dist != SINGLE:
+            node.source = self._gather(child.node)
+        else:
+            node.source = child.node
+        return _Placed(node, SINGLE)
+
+    def _visit_AggregationNode(self, node: P.AggregationNode) -> _Placed:
+        child = self._visit(node.source)
+        node.source = child.node
+        if child.dist == SINGLE:
+            return _Placed(node, SINGLE)
+        # distributed input: already partitioned on a subset of the grouping
+        # keys -> grouping is partition-local, run SINGLE-step in place
+        key_names = tuple(v.name for v in node.grouping_keys)
+        if child.dist == HASHED and child.hash_keys and \
+                set(child.hash_keys) <= set(key_names):
+            return _Placed(node, HASHED, child.hash_keys)
+        if any(a.distinct or a.mask for a in node.aggregations.values()):
+            # non-decomposable: gather everything to one task
+            node.source = self._gather(child.node)
+            return _Placed(node, SINGLE)
+        return self._split_aggregation(node, child)
+
+    def _split_aggregation(self, node: P.AggregationNode,
+                           child: _Placed) -> _Placed:
+        """SINGLE agg -> PARTIAL + exchange + FINAL (+ avg projection)."""
+        partial_aggs: Dict[Variable, P.Aggregation] = {}
+        final_aggs: Dict[Variable, P.Aggregation] = {}
+        # final output var -> expression over final agg outputs (avg division)
+        post: Dict[Variable, RowExpression] = {}
+        needs_post = False
+
+        for v, agg in node.aggregations.items():
+            fname = agg.call.display_name.lower().split(".")[-1]
+            args = agg.call.arguments
+            if fname == "avg":
+                arg = args[0]
+                sum_t = _sum_type(arg.type)
+                psum = self._var(v.name + "_psum", sum_t)
+                pcnt = self._var(v.name + "_pcnt", BIGINT)
+                partial_aggs[psum] = P.Aggregation(
+                    CallExpression("sum", sum_t, [arg]))
+                partial_aggs[pcnt] = P.Aggregation(
+                    CallExpression("count", BIGINT, [arg]))
+                fsum = self._var(v.name + "_fsum", sum_t)
+                fcnt = self._var(v.name + "_fcnt", BIGINT)
+                final_aggs[fsum] = P.Aggregation(
+                    CallExpression("sum", sum_t, [psum]))
+                final_aggs[fcnt] = P.Aggregation(
+                    CallExpression("sum", BIGINT, [pcnt]))
+                post[v] = CallExpression("$operator$divide", v.type,
+                                         [fsum, fcnt])
+                needs_post = True
+            elif fname in ("count",):
+                pv = self._var(v.name + "_p", BIGINT)
+                partial_aggs[pv] = agg
+                final_aggs[v] = P.Aggregation(
+                    CallExpression("sum", BIGINT, [pv]))
+                post[v] = v
+            elif fname in ("sum", "min", "max"):
+                pv = self._var(v.name + "_p", v.type)
+                partial_aggs[pv] = agg
+                final_aggs[v] = P.Aggregation(
+                    CallExpression(fname, v.type, [pv]))
+                post[v] = v
+            else:
+                # unknown aggregate: bail out to single-node execution
+                node.source = self._gather(child.node)
+                return _Placed(node, SINGLE)
+
+        keys = list(node.grouping_keys)
+        partial = P.AggregationNode(node.id + "_partial", child.node,
+                                    partial_aggs, keys, P.PARTIAL)
+        if keys:
+            ex = self._repartition(partial, keys)
+            dist, hkeys = HASHED, tuple(v.name for v in keys)
+        else:
+            ex = self._gather(partial)
+            dist, hkeys = SINGLE, ()
+        final = P.AggregationNode(node.id, ex, final_aggs, keys, P.FINAL)
+        out: P.PlanNode = final
+        if needs_post:
+            assignments: Dict[Variable, RowExpression] = {}
+            for k in keys:
+                assignments[k] = k
+            for v in node.aggregations:
+                assignments[v] = post[v]
+            out = P.ProjectNode(node.id + "_avgdiv", final, assignments)
+        return _Placed(out, dist, hkeys)
+
+    def _visit_JoinNode(self, node: P.JoinNode) -> _Placed:
+        left = self._visit(node.left)
+        right = self._visit(node.right)
+        node.left, node.right = left.node, right.node
+        if left.dist == SINGLE and right.dist == SINGLE:
+            return _Placed(node, SINGLE)
+
+        lest = estimate_rows(node.left)
+        rest = estimate_rows(node.right)
+        # INNER joins may swap sides so the smaller relation is built
+        if node.join_type == P.INNER and lest is not None and rest is not None \
+                and lest < rest:
+            node.left, node.right = node.right, node.left
+            node.criteria = [(r, l) for l, r in node.criteria]
+            left, right = right, left
+            lest, rest = rest, lest
+
+        broadcast = (rest is not None
+                     and rest <= self.config.broadcast_threshold
+                     and node.join_type in (P.INNER, P.LEFT))
+        if broadcast:
+            node.distribution = P.REPLICATED
+            if right.dist != SINGLE or left.dist != SINGLE:
+                node.right = self._broadcast(node.right)
+            return _Placed(node, left.dist, left.hash_keys)
+
+        node.distribution = P.PARTITIONED
+        lkeys = [l for l, _ in node.criteria]
+        rkeys = [r for _, r in node.criteria]
+        lnames = tuple(v.name for v in lkeys)
+        rnames = tuple(v.name for v in rkeys)
+        if not (left.dist == HASHED and left.hash_keys == lnames):
+            node.left = self._repartition(node.left, lkeys)
+        if not (right.dist == HASHED and right.hash_keys == rnames):
+            node.right = self._repartition(node.right, rkeys)
+        return _Placed(node, HASHED, lnames)
+
+    def _visit_SemiJoinNode(self, node: P.SemiJoinNode) -> _Placed:
+        src = self._visit(node.source)
+        filt = self._visit(node.filtering_source)
+        node.source, node.filtering_source = src.node, filt.node
+        if src.dist == SINGLE and filt.dist == SINGLE:
+            return _Placed(node, SINGLE)
+        fest = estimate_rows(node.filtering_source)
+        if fest is not None and fest <= self.config.broadcast_threshold:
+            if filt.dist != SINGLE or src.dist != SINGLE:
+                node.filtering_source = self._broadcast(node.filtering_source)
+            return _Placed(node, src.dist, src.hash_keys)
+        skey, fkey = node.source_join_variable, node.filtering_source_join_variable
+        if not (src.dist == HASHED and src.hash_keys == (skey.name,)):
+            node.source = self._repartition(node.source, [skey])
+        if not (filt.dist == HASHED and filt.hash_keys == (fkey.name,)):
+            node.filtering_source = self._repartition(
+                node.filtering_source, [fkey])
+        return _Placed(node, HASHED, (skey.name,))
+
+    def _visit_SortNode(self, node: P.SortNode) -> _Placed:
+        child = self._visit(node.source)
+        if child.dist == SINGLE:
+            node.source = child.node
+        else:
+            node.source = self._gather(child.node)
+        return _Placed(node, SINGLE)
+
+    def _visit_TopNNode(self, node: P.TopNNode) -> _Placed:
+        child = self._visit(node.source)
+        if child.dist == SINGLE:
+            node.source = child.node
+            return _Placed(node, SINGLE)
+        partial = P.TopNNode(node.id + "_partial", child.node, node.count,
+                             node.ordering_scheme, P.PARTIAL)
+        node.source = self._gather(partial)
+        node.step = P.FINAL
+        return _Placed(node, SINGLE)
+
+    def _visit_LimitNode(self, node: P.LimitNode) -> _Placed:
+        child = self._visit(node.source)
+        if child.dist == SINGLE:
+            node.source = child.node
+            return _Placed(node, SINGLE)
+        partial = P.LimitNode(node.id + "_partial", child.node, node.count,
+                              P.PARTIAL)
+        node.source = self._gather(partial)
+        node.step = P.FINAL
+        return _Placed(node, SINGLE)
+
+    def _visit_DistinctLimitNode(self, node: P.DistinctLimitNode) -> _Placed:
+        child = self._visit(node.source)
+        if child.dist == SINGLE:
+            node.source = child.node
+            return _Placed(node, SINGLE)
+        partial = P.DistinctLimitNode(node.id + "_partial", child.node,
+                                      node.count, node.distinct_variables)
+        node.source = self._gather(partial)
+        return _Placed(node, SINGLE)
+
+    def _visit_WindowNode(self, node: P.WindowNode) -> _Placed:
+        child = self._visit(node.source)
+        if child.dist == SINGLE:
+            node.source = child.node
+            return _Placed(node, SINGLE)
+        if node.partition_by:
+            node.source = self._repartition(child.node,
+                                            list(node.partition_by))
+            return _Placed(node, HASHED,
+                           tuple(v.name for v in node.partition_by))
+        node.source = self._gather(child.node)
+        return _Placed(node, SINGLE)
+
+    def _visit_EnforceSingleRowNode(self, node) -> _Placed:
+        child = self._visit(node.source)
+        if child.dist == SINGLE:
+            node.source = child.node
+        else:
+            node.source = self._gather(child.node)
+        return _Placed(node, SINGLE)
+
+
+def _set_source(node: P.PlanNode, new_source: P.PlanNode) -> None:
+    if hasattr(node, "source"):
+        node.source = new_source
+    else:
+        raise NotImplementedError(
+            f"cannot replace source of {type(node).__name__}")
+
+
+def _sum_type(input_type: Type) -> Type:
+    if isinstance(input_type, (DoubleType, RealType)):
+        return DOUBLE
+    if isinstance(input_type, DecimalType):
+        return DecimalType(38, input_type.scale)
+    return BIGINT
+
+
+# ---------------------------------------------------------------------------
+# fragmentation
+# ---------------------------------------------------------------------------
+
+class Fragmenter:
+    """Cuts a plan with REMOTE exchanges into a SubPlan tree
+    (reference PlanFragmenter.createSubPlans :73)."""
+
+    def __init__(self):
+        self._next_id = 0
+
+    def fragment(self, root: P.PlanNode) -> P.SubPlan:
+        root_scheme = P.PartitioningScheme(
+            P.SINGLE_DISTRIBUTION, [], list(root.output_variables))
+        return self._make_fragment(root, root_scheme)
+
+    def _make_fragment(self, root: P.PlanNode,
+                       output_scheme: P.PartitioningScheme) -> P.SubPlan:
+        fid = str(self._next_id)
+        self._next_id += 1
+        children: List[P.SubPlan] = []
+        props = {"has_scan": False, "scan_ids": [], "consumed": []}
+        new_root = self._rewrite(root, children, props)
+        if props["has_scan"]:
+            partitioning = P.SOURCE_DISTRIBUTION
+        elif P.REPARTITION in props["consumed"]:
+            partitioning = P.FIXED_HASH_DISTRIBUTION
+        else:
+            partitioning = P.SINGLE_DISTRIBUTION
+        fragment = P.PlanFragment(fid, new_root, partitioning, output_scheme,
+                                  props["scan_ids"])
+        return P.SubPlan(fragment, children)
+
+    def _rewrite(self, node: P.PlanNode, children: List[P.SubPlan],
+                 props: dict) -> P.PlanNode:
+        if isinstance(node, P.ExchangeNode) and node.scope == P.REMOTE:
+            props["consumed"].append(node.exchange_type)
+            ids = []
+            for src in node.exchange_sources:
+                sub = self._make_fragment(src, node.partitioning_scheme)
+                children.append(sub)
+                ids.append(sub.fragment.fragment_id)
+            return P.RemoteSourceNode(
+                node.id, ids, list(node.partitioning_scheme.output_layout))
+        if isinstance(node, P.TableScanNode):
+            props["has_scan"] = True
+            props["scan_ids"].append(node.id)
+            return node
+        for attr in ("source", "left", "right", "filtering_source"):
+            if hasattr(node, attr):
+                setattr(node, attr,
+                        self._rewrite(getattr(node, attr), children, props))
+        if isinstance(node, P.ExchangeNode):  # LOCAL exchange
+            node.exchange_sources = [
+                self._rewrite(s, children, props)
+                for s in node.exchange_sources]
+        return node
+
+
+def plan_distributed(root: P.OutputNode,
+                     config: Optional[FragmenterConfig] = None) -> P.SubPlan:
+    """Full distribution pipeline: exchange insertion then fragmentation."""
+    rewritten = ExchangeInserter(config).rewrite(root)
+    return Fragmenter().fragment(rewritten)
