@@ -470,6 +470,23 @@ def table_row_count(table: str, sf: float) -> int:
 # string columns with open (unbounded) value domains: these are produced
 # lazily on device as row-id columns and materialized on output
 # (late materialization — see exec/batch.py Column.lazy)
+# open-domain columns whose generated values sort identically to their row
+# ids ("Supplier#000000001"-style zero-padded sequence numbers): ORDER BY on
+# these late-materialized columns can sort the row ids directly
+ROWID_ORDERED = {("supplier", "name"), ("customer", "name")}
+
+# open-domain columns whose generated values are distinct per row (key-derived
+# names/phones, long random text): GROUP BY may use the row id as the group
+# key.  Columns drawn from small pools (orders.clerk: sf*1000 values) are NOT
+# here — grouping them requires materializing a real dictionary first.
+ROWID_DISTINCT = {
+    ("customer", "name"), ("customer", "address"), ("customer", "phone"),
+    ("customer", "comment"), ("supplier", "name"), ("supplier", "address"),
+    ("supplier", "phone"), ("supplier", "comment"), ("part", "name"),
+    ("part", "comment"), ("partsupp", "comment"), ("orders", "comment"),
+    ("lineitem", "comment"), ("nation", "comment"), ("region", "comment"),
+}
+
 OPEN_DOMAIN = {
     ("lineitem", "comment"), ("orders", "comment"), ("orders", "clerk"),
     ("customer", "name"), ("customer", "address"), ("customer", "phone"),

@@ -277,15 +277,22 @@ def agg_merge(a: dict, b: dict, specs: Tuple[AggSpec, ...],
 
 def agg_finalize(state: dict, specs: Tuple[AggSpec, ...],
                  key_names: Tuple[str, ...],
-                 key_dicts: Dict[str, Tuple[str, ...]]) -> Batch:
+                 key_dicts: Dict[str, Tuple[str, ...]],
+                 key_lazy: Optional[Dict[str, Tuple]] = None) -> Batch:
     """Accumulator table -> output Batch (capacity == num_slots, mask ==
-    occupied).  Runs under jit; host later compacts via batch_to_page."""
+    occupied).  Runs under jit; host later compacts via batch_to_page.
+
+    key_lazy carries late-materialization tags for open-domain string keys:
+    such keys group by row identity (their values are source row ids), which
+    is exact whenever a unique key is also in the grouping set (the TPC-H
+    Q10 shape: c_custkey determines c_address/c_comment)."""
     occupied = state["__occupied"]
     cols: Dict[str, Column] = {}
     for name in key_names:
         cols[name] = Column(state[f"__key_{name}"],
                             state.get(f"__keynull_{name}"),
-                            key_dicts.get(name))
+                            key_dicts.get(name),
+                            (key_lazy or {}).get(name))
     for spec in specs:
         if spec.name in ("count", "count_star"):
             cols[spec.output] = Column(state[spec.output], None)
@@ -449,8 +456,12 @@ def sort_indices(batch: Batch, keys: List[Tuple[str, str]]):
         v = col.values
         desc = order.startswith("DESC")
         if col.lazy is not None:
-            raise NotImplementedError(
-                "ORDER BY on a late-materialized string column")
+            from ..connectors import tpch as _tpch
+            _, table, column, _sf = col.lazy
+            if (table, column) not in _tpch.ROWID_ORDERED:
+                raise NotImplementedError(
+                    "ORDER BY on a late-materialized string column")
+            # values are row ids; generator guarantees id order == lex order
         if col.dictionary is not None:
             # codes -> lexical ranks (host-precomputed, static)
             rank = np.argsort(np.argsort(np.array(col.dictionary)))

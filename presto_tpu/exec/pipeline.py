@@ -19,6 +19,8 @@ The TPU analog of the reference LocalExecutionPlanner
 from __future__ import annotations
 
 import dataclasses
+import itertools
+import json
 from dataclasses import dataclass, field
 from typing import Callable, Dict, Iterator, List, Optional, Tuple
 
@@ -58,6 +60,8 @@ class TaskContext:
     splits: Dict[str, List[tpch.TpchSplit]] = field(default_factory=dict)
     # remote-source node id -> iterator of host Pages (exchange input)
     remote_pages: Dict[str, Callable[[], Iterator[Tuple[Page, List[str], List[Type]]]]] = field(default_factory=dict)
+    # this task's index in its stage: namespaces AssignUniqueId across tasks
+    task_index: int = 0
 
 
 def _var_types(variables) -> List[Type]:
@@ -201,16 +205,26 @@ class PlanCompiler:
     # -- streaming transforms --------------------------------------------
     def _compile_FilterNode(self, node: P.FilterNode) -> BatchSource:
         src = self._compile(node.source)
-        pred = node.predicate
         low = self.lowering
-
-        @jax.jit
-        def step(batch):
-            return ops.apply_filter(batch, low.eval(pred, batch))
+        hoister = _StringHoister([node.predicate])
+        cache: dict = {}  # resolution is laziness-dependent only: jit once
 
         def gen():
-            for b in src.batches():
-                yield step(b)
+            it = iter(src.batches())
+            first = next(it, None)
+            if first is None:
+                return
+            if "step" not in cache:
+                (pred,), hoisted = hoister.resolve(first)
+
+                @jax.jit
+                def step(batch):
+                    return ops.apply_filter(batch, low.eval(pred, batch))
+
+                cache["step"], cache["hoisted"] = step, hoisted
+            step, hoisted = cache["step"], cache["hoisted"]
+            for b in itertools.chain([first], it):
+                yield step(_add_hoisted(b, hoisted))
         return BatchSource(gen, src.names, src.types)
 
     def _compile_ProjectNode(self, node: P.ProjectNode) -> BatchSource:
@@ -219,15 +233,27 @@ class PlanCompiler:
         types = [v.type for v in node.assignments]
         items = list(node.assignments.items())
         low = self.lowering
-
-        @jax.jit
-        def step(batch):
-            cols = {v.name: low.eval(e, batch) for v, e in items}
-            return Batch(cols, batch.mask)
+        hoister = _StringHoister([e for _, e in items])
+        cache: dict = {}
 
         def gen():
-            for b in src.batches():
-                yield step(b)
+            it = iter(src.batches())
+            first = next(it, None)
+            if first is None:
+                return
+            if "step" not in cache:
+                exprs, hoisted = hoister.resolve(first)
+
+                @jax.jit
+                def step(batch):
+                    cols = {v.name: low.eval(e, batch)
+                            for (v, _), e in zip(items, exprs)}
+                    return Batch(cols, batch.mask)
+
+                cache["step"], cache["hoisted"] = step, hoisted
+            step, hoisted = cache["step"], cache["hoisted"]
+            for b in itertools.chain([first], it):
+                yield step(_add_hoisted(b, hoisted))
         return BatchSource(gen, names, types)
 
     def _compile_OutputNode(self, node: P.OutputNode) -> BatchSource:
@@ -333,6 +359,8 @@ class PlanCompiler:
             src = self._compile(src_node)
             state = None
             key_dicts: Dict[str, Tuple[str, ...]] = {}
+            key_lazy: Dict[str, Tuple] = {}
+            encode_keys: List[str] = []
 
             @jax.jit
             def update(state, batch):
@@ -346,6 +374,20 @@ class PlanCompiler:
 
             for batch in src.batches():
                 if state is None:
+                    for k in key_names:
+                        col = batch.columns[k]
+                        if col.lazy is not None:
+                            _, tbl, coln, _sf = col.lazy
+                            if (tbl, coln) in tpch.ROWID_DISTINCT:
+                                # row id IS the group identity; keep lazy tag
+                                key_lazy[k] = col.lazy
+                            else:
+                                # small-pool column (orders.clerk): grouping
+                                # by row id would split groups — encode to a
+                                # real whole-column dictionary on the host
+                                encode_keys.append(k)
+                    if encode_keys:
+                        batch = _encode_lazy_keys(batch, encode_keys)
                     key_cols = [batch.columns[k] for k in key_names]
                     key_dtypes = [c.values.dtype for c in key_cols]
                     for k, c in zip(key_names, key_cols):
@@ -353,16 +395,18 @@ class PlanCompiler:
                             key_dicts[k] = c.dictionary
                     state = ops.agg_init(num_slots, specs, key_names,
                                          key_dtypes)
+                elif encode_keys:
+                    batch = _encode_lazy_keys(batch, encode_keys)
                 state = update(state, batch)
             if state is None:
                 key_dtypes = [jnp.int64] * len(key_names)
                 state = ops.agg_init(num_slots, specs, key_names, key_dtypes)
-            return state, key_dicts
+            return state, key_dicts, key_lazy
 
         def gen():
             num_slots, salt = cfg.agg_slots, 0
             for attempt in range(cfg.max_agg_retries):
-                state, key_dicts = run_once(num_slots, salt)
+                state, key_dicts, key_lazy = run_once(num_slots, salt)
                 if not bool(state["__collision"]):
                     break
                 num_slots *= 2
@@ -372,7 +416,8 @@ class PlanCompiler:
             if not key_names and not bool(jnp.any(state["__occupied"])):
                 # global aggregation over empty input still yields one row
                 state["__occupied"] = state["__occupied"].at[0].set(True)
-            batch = ops.agg_finalize(state, specs, key_names, key_dicts)
+            batch = ops.agg_finalize(state, specs, key_names, key_dicts,
+                                     key_lazy)
             yield batch
         return BatchSource(gen, out_names, out_types)
 
@@ -477,6 +522,39 @@ class PlanCompiler:
                 yield step(b, table)
         return BatchSource(gen, names, types)
 
+    def _compile_AssignUniqueIdNode(self, node: P.AssignUniqueIdNode) -> BatchSource:
+        """Row ids unique within the query (reference
+        AssignUniqueIdOperator): task index in the high bits, a running
+        per-task offset below.  Deterministic for a fixed split assignment,
+        so a deep-copied subtree replays identical ids (the decorrelated
+        EXISTS plan relies on this)."""
+        src = self._compile(node.source)
+        names = src.names + [node.id_variable.name]
+        types = src.types + [v.type for v in [node.id_variable]]
+        base = self.ctx.task_index << 40
+        id_name = node.id_variable.name
+
+        def gen():
+            offset = 0
+            for b in src.batches():
+                ids = jnp.arange(b.capacity, dtype=jnp.int64) + (base + offset)
+                offset += b.capacity
+                yield b.with_columns({id_name: Column(ids)})
+        return BatchSource(gen, names, types)
+
+    def _compile_EnforceSingleRowNode(self, node) -> BatchSource:
+        src = self._compile(node.source)
+
+        def gen():
+            seen = 0
+            for b in src.batches():
+                seen += int(b.mask.sum())
+                if seen > 1:
+                    raise RuntimeError(
+                        "scalar subquery produced more than one row")
+                yield b
+        return BatchSource(gen, src.names, src.types)
+
     # -- local exchange is a no-op in the single-task pipeline ------------
     def _compile_ExchangeNode(self, node: P.ExchangeNode) -> BatchSource:
         if len(node.exchange_sources) == 1 and not node.inputs:
@@ -494,6 +572,149 @@ class PlanCompiler:
                     cols = {o: b.columns[n] for o, n in zip(names, in_names)}
                     yield Batch(cols, b.mask)
         return BatchSource(gen, names, types)
+
+
+# ---------------------------------------------------------------------------
+# host hoisting of string functions over late-materialized columns
+#
+# like()/substr() over open-domain columns (tpch.OPEN_DOMAIN) cannot run
+# inside jit: the column holds row ids, the strings exist only in the
+# generator.  The compiler rewrites such calls into synthetic variables and
+# computes them per batch on the host before the jitted step — the TPU
+# analog of the reference's ScanFilterAndProjectOperator evaluating
+# non-vectorizable functions row-wise during the scan.
+# ---------------------------------------------------------------------------
+
+
+class _StringHoister:
+    """Finds like/substr calls rooted at a variable, and — once the first
+    batch shows which of those variables are late-materialized — rewrites
+    them into host-computed columns."""
+
+    def __init__(self, exprs):
+        self.exprs = list(exprs)
+        self.candidates: Dict[str, CallExpression] = {}
+        for e in self.exprs:
+            _find_string_calls(e, self.candidates)
+
+    def resolve(self, first_batch: Batch):
+        active: Dict[str, Tuple] = {}
+        for key, c in self.candidates.items():
+            col = first_batch.columns.get(c.arguments[0].name)
+            if col is not None and col.lazy is not None:
+                var = VariableReferenceExpression(
+                    f"__hoist_{len(active)}_{abs(hash(key)) % 10**8}", c.type)
+                active[key] = (var, c)
+        if not active:
+            return self.exprs, {}
+        table = {k: v for k, (v, _) in active.items()}
+        rewritten = [_rewrite_expr(e, table) for e in self.exprs]
+        hoisted = {v.name: c for v, c in active.values()}
+        return rewritten, hoisted
+
+
+def _hoist_key(e: RowExpression) -> str:
+    return json.dumps(e.to_dict(), sort_keys=True, default=str)
+
+
+def _find_string_calls(e: RowExpression, out: Dict[str, CallExpression]):
+    if isinstance(e, CallExpression):
+        name = canonical_name(e.display_name)
+        if name in ("like", "substr") and e.arguments and isinstance(
+                e.arguments[0], VariableReferenceExpression):
+            out[_hoist_key(e)] = e
+            return
+    for a in getattr(e, "arguments", None) or []:
+        _find_string_calls(a, out)
+
+
+def _rewrite_expr(e: RowExpression, table: Dict[str, RowExpression]):
+    if isinstance(e, CallExpression):
+        k = _hoist_key(e)
+        if k in table:
+            return table[k]
+        return CallExpression(e.display_name, e.type,
+                              [_rewrite_expr(a, table) for a in e.arguments])
+    from ..spi.expr import SpecialFormExpression
+    if isinstance(e, SpecialFormExpression):
+        return SpecialFormExpression(
+            e.form, e.type, [_rewrite_expr(a, table) for a in e.arguments])
+    return e
+
+
+_SUBSTR_DICT_CACHE: Dict[Tuple, Tuple[str, ...]] = {}
+
+
+def _canonical_substr_dict(table: str, column: str, sf: float,
+                           start: int, length) -> Tuple[str, ...]:
+    """Batch-independent (whole-column) dictionary for substr over an
+    open-domain column, so codes are stable across batches and sorted-rank
+    ordering holds for ORDER BY / GROUP BY consumers."""
+    key = (table, column, sf, start, length)
+    if key not in _SUBSTR_DICT_CACHE:
+        n = tpch.table_row_count(table, sf)
+        uniq = set()
+        for pos in range(0, n, 1 << 18):
+            cnt = min(1 << 18, n - pos)
+            strings = tpch.generate_values_at(
+                table, column, sf, np.arange(pos, pos + cnt, dtype=np.int64))
+            uniq.update(_py_substr(s, start, length) for s in strings)
+        _SUBSTR_DICT_CACHE[key] = tuple(sorted(uniq))
+    return _SUBSTR_DICT_CACHE[key]
+
+
+def _py_substr(s: str, start: int, length) -> str:
+    i = start - 1 if start > 0 else len(s) + start
+    return s[i:i + length] if length is not None else s[i:]
+
+
+def _host_string_column(call_expr: CallExpression, batch: Batch) -> Column:
+    from .lowering import like_matcher
+    arg = call_expr.arguments[0]
+    col = batch.columns[arg.name]
+    ids = np.asarray(col.values)
+    _, table, column, sf = col.lazy
+    strings = tpch.generate_values_at(table, column, sf, ids)
+    name = canonical_name(call_expr.display_name)
+    if name == "like":
+        match = like_matcher(str(call_expr.arguments[1].value))
+        vals = np.fromiter((match(s) for s in strings), dtype=bool,
+                           count=len(strings))
+        return Column(jnp.asarray(vals), col.nulls)
+    start = int(call_expr.arguments[1].value)
+    length = (int(call_expr.arguments[2].value)
+              if len(call_expr.arguments) > 2 else None)
+    cdict = _canonical_substr_dict(table, column, sf, start, length)
+    index = {s: i for i, s in enumerate(cdict)}
+    codes = np.fromiter((index[_py_substr(s, start, length)]
+                         for s in strings), dtype=np.int32,
+                        count=len(strings))
+    return Column(jnp.asarray(codes), col.nulls, cdict)
+
+
+def _add_hoisted(batch: Batch, hoisted: Dict[str, CallExpression]) -> Batch:
+    if not hoisted:
+        return batch
+    return batch.with_columns({name: _host_string_column(c, batch)
+                               for name, c in hoisted.items()})
+
+
+def _encode_lazy_keys(batch: Batch, keys: List[str]) -> Batch:
+    """Replace late-materialized key columns by whole-column dictionary
+    codes (for GROUP BY on small-pool open-domain columns, where row ids
+    would split value groups)."""
+    new_cols = {}
+    for k in keys:
+        col = batch.columns[k]
+        _, table, column, sf = col.lazy
+        cdict = _canonical_substr_dict(table, column, sf, 1, None)
+        index = {s: i for i, s in enumerate(cdict)}
+        strings = tpch.generate_values_at(
+            table, column, sf, np.asarray(col.values))
+        codes = np.fromiter((index[s] for s in strings), dtype=np.int32,
+                            count=len(strings))
+        new_cols[k] = Column(jnp.asarray(codes), col.nulls, cdict)
+    return batch.with_columns(new_cols)
 
 
 # ---------------------------------------------------------------------------

@@ -366,6 +366,20 @@ def _exec_JoinNode(node: P.JoinNode) -> Table:
     return Table(ext_cols, pairs.n + len(miss_rows))
 
 
+def _exec_AssignUniqueIdNode(node: P.AssignUniqueIdNode) -> Table:
+    t = _exec(node.source)
+    cols = dict(t.cols)
+    cols[node.id_variable.name] = (np.arange(t.n, dtype=np.int64), None)
+    return Table(cols, t.n)
+
+
+def _exec_EnforceSingleRowNode(node: P.EnforceSingleRowNode) -> Table:
+    t = _exec(node.source)
+    if t.n > 1:
+        raise RuntimeError("scalar subquery produced more than one row")
+    return t
+
+
 def _exec_SemiJoinNode(node: P.SemiJoinNode) -> Table:
     src = _exec(node.source)
     filt = _exec(node.filtering_source)

@@ -217,7 +217,8 @@ class InProcessScheduler:
         child_by_fid = {c.fragment.fragment_id: c for c in stage.children}
 
         for task_index in range(stage.n_tasks):
-            ctx = TaskContext(config=self.config.exec_config)
+            ctx = TaskContext(config=self.config.exec_config,
+                              task_index=task_index)
             for node_id, splits in scan_splits.items():
                 ctx.splits[node_id] = splits[task_index::stage.n_tasks]
             for rnode in remote_nodes:

@@ -119,9 +119,17 @@ class Planner:
         if query.group_by or agg_calls:
             node, scope = self.plan_aggregation(query, node, scope, agg_calls)
             if query.having is not None:
-                pred = self.plan_expr(query.having, scope)
-                node = P.FilterNode(self.new_id("having"), node,
-                                    _to_boolean(pred))
+                conjs = _conjuncts(query.having)
+                plain = [c for c in conjs if not _has_subquery(c)]
+                subq = [c for c in conjs if _has_subquery(c)]
+                if plain:
+                    from ..spi.expr import and_
+                    preds = [_to_boolean(self.plan_expr(c, scope))
+                             for c in plain]
+                    node = P.FilterNode(self.new_id("having"), node,
+                                        and_(*preds))
+                for c in subq:
+                    node = self._apply_subquery_conjunct(node, scope, c)
         elif query.having is not None:
             raise PlanningError("HAVING without aggregation")
 
@@ -224,8 +232,15 @@ class Planner:
             node, rscope = self.plan_base_relation(rel, query)
             planned.append((node, rscope, jt, on))
 
-        # WHERE conjuncts for pushdown / join criteria
-        where_conjuncts = _conjuncts(query.where)
+        # WHERE conjuncts for pushdown / join criteria.  Conjuncts holding
+        # subqueries (EXISTS / IN / scalar comparisons) are set aside and
+        # applied as semi joins / correlated joins after the join tree is
+        # built (the reference's TransformExistsApplyToLateralNode /
+        # TransformCorrelated* iterative rules, compressed to the TPC-H/DS
+        # decorrelation shapes).
+        all_conjuncts = _normalize_conjuncts(_conjuncts(query.where))
+        subq_conjuncts = [c for c in all_conjuncts if _has_subquery(c)]
+        where_conjuncts = [c for c in all_conjuncts if not _has_subquery(c)]
         on_conjuncts: List[A.Node] = []
 
         # Relations on the null-producing side of an outer join must not have
@@ -270,6 +285,21 @@ class Planner:
             left_scope = Scope(scopes)
             right_scope = Scope([next_scope])
             conjs = list(_conjuncts(on))
+            # ON conjuncts touching only the right relation filter it BEFORE
+            # the join: for LEFT joins this is required (they must not be
+            # applied post null-extension like WHERE would be), for INNER
+            # it is the reference's PredicatePushDown through the join.
+            if jt in ("INNER", "LEFT"):
+                right_only = [c for c in conjs
+                              if not _has_subquery(c)
+                              and _resolvable(self, c, right_scope)]
+                if right_only:
+                    from ..spi.expr import and_
+                    preds = [_to_boolean(self.plan_expr(c, right_scope))
+                             for c in right_only]
+                    next_node = P.FilterNode(self.new_id("on_push"),
+                                             next_node, and_(*preds))
+                    conjs = [c for c in conjs if c not in right_only]
             # A WHERE conjunct may fold into this INNER join only if no later
             # join null-extends the rows it sees (a later RIGHT/FULL join
             # would null-extend this side, and WHERE must run after that).
@@ -317,6 +347,10 @@ class Planner:
             preds = [_to_boolean(self.plan_expr(c, scope)) for c in remaining]
             node = P.FilterNode(self.new_id("post_join_filter"), node,
                                 and_(*preds))
+        # subquery conjuncts last: each becomes a semi join / correlated join
+        # over the assembled relation tree
+        for c in subq_conjuncts:
+            node = self._apply_subquery_conjunct(node, scope, c)
         # every WHERE conjunct was pushed, folded into a join, or applied in
         # the post-join filter; signal without mutating the AST (CTEs re-plan
         # their query AST on each reference)
@@ -387,6 +421,281 @@ class Planner:
         return None
 
     # ------------------------------------------------------------------
+    # subquery conjuncts: decorrelation to semi joins / correlated joins
+    # (reference iterative rules TransformExistsApplyToLateralNode,
+    # TransformCorrelatedScalarAggregationToJoin,
+    # TransformUncorrelatedInPredicateSubqueryToSemiJoin in
+    # presto-main-base/.../planner/iterative/rule/)
+    # ------------------------------------------------------------------
+
+    def _apply_subquery_conjunct(self, node: P.PlanNode, scope: Scope,
+                                 c: A.Node) -> P.PlanNode:
+        neg = False
+        while isinstance(c, A.UnaryOp) and c.op == "not":
+            neg = not neg
+            c = c.operand
+        if isinstance(c, A.Exists):
+            return self._apply_exists(node, scope, c.query, c.negated ^ neg)
+        if isinstance(c, A.InSubquery):
+            return self._apply_in_subquery(node, scope, c.value, c.query,
+                                           c.negated ^ neg)
+        cmps = {"=", "<>", "<", "<=", ">", ">="}
+        if isinstance(c, A.BinaryOp) and c.op in cmps:
+            flip = {"<": ">", ">": "<", "<=": ">=", ">=": "<=",
+                    "=": "=", "<>": "<>"}
+            if isinstance(c.right, A.ScalarSubquery):
+                return self._apply_scalar_compare(node, scope, c.op, c.left,
+                                                  c.right.query, neg)
+            if isinstance(c.left, A.ScalarSubquery):
+                return self._apply_scalar_compare(node, scope, flip[c.op],
+                                                  c.right, c.left.query, neg)
+        raise PlanningError(
+            f"unsupported subquery conjunct {type(c).__name__}")
+
+    def _subquery_parts(self, subq: A.Query, outer_scope: Scope):
+        """Classify the subquery's WHERE conjuncts against its own FROM.
+
+        Returns (inner_conjs, corr_pairs, mixed_conjs, inner_map) where
+        corr_pairs are (outer_ast, inner_ast) equality correlations, and
+        mixed_conjs reference both sides non-equi (Q21's l2.l_suppkey <>
+        l1.l_suppkey).  inner_map: alias -> visible column-name set."""
+        inner_map: Dict[str, set] = {}
+        for rel in _flatten_relations(subq.relations):
+            if isinstance(rel, A.TableRef):
+                name = rel.name.lower()
+                alias = (rel.alias or rel.name).lower()
+                if name in self._ctes:
+                    cols = {n.lower()
+                            for n in _select_names(self._ctes[name])}
+                elif name in tpch.SCHEMAS:
+                    prefix = _TPCH_PREFIX[name]
+                    cols = set()
+                    for coln, _ in tpch.SCHEMAS[name]:
+                        cols.add(coln)
+                        cols.add(prefix + coln)
+                else:
+                    raise PlanningError(f"unknown table {rel.name!r}")
+            elif isinstance(rel, A.SubqueryRef):
+                alias = rel.alias.lower()
+                cols = {n.lower() for n in _select_names(rel.query)}
+            else:
+                raise PlanningError("unsupported subquery relation")
+            inner_map[alias] = cols
+
+        def ident_is_inner(ident: A.Ident) -> bool:
+            parts = ident.parts
+            if len(parts) >= 2:
+                qual, name = parts[-2].lower(), parts[-1].lower()
+                return qual in inner_map and name in inner_map[qual]
+            return any(parts[0].lower() in s for s in inner_map.values())
+
+        inner_conjs: List[A.Node] = []
+        corr_pairs: List[Tuple[A.Node, A.Node]] = []
+        mixed: List[A.Node] = []
+        for conj in _conjuncts(subq.where):
+            ids = _idents(conj)
+            if all(ident_is_inner(i) for i in ids):
+                inner_conjs.append(conj)
+                continue
+            if isinstance(conj, A.BinaryOp) and conj.op == "=":
+                for a, b in ((conj.left, conj.right),
+                             (conj.right, conj.left)):
+                    a_ids, b_ids = _idents(a), _idents(b)
+                    if a_ids and b_ids \
+                            and all(ident_is_inner(i) for i in a_ids) \
+                            and not any(ident_is_inner(i) for i in b_ids):
+                        corr_pairs.append((b, a))
+                        break
+                else:
+                    mixed.append(conj)
+                continue
+            mixed.append(conj)
+        return inner_conjs, corr_pairs, mixed, inner_map
+
+    def _ensure_var(self, node: P.PlanNode, expr: RowExpression,
+                    hint: str) -> Tuple[P.PlanNode, VariableReferenceExpression]:
+        """Make `expr` available as an output variable of `node`."""
+        if isinstance(expr, VariableReferenceExpression) \
+                and any(v.name == expr.name for v in node.output_variables):
+            return node, expr
+        v = self.new_var(hint, expr.type)
+        assigns: Dict[VariableReferenceExpression, RowExpression] = {
+            u: u for u in node.output_variables}
+        assigns[v] = expr
+        return P.ProjectNode(self.new_id("ensure"), node, assigns), v
+
+    def _apply_exists(self, node: P.PlanNode, scope: Scope, subq: A.Query,
+                      negated: bool) -> P.PlanNode:
+        if subq.group_by or subq.having:
+            raise PlanningError("EXISTS over grouped subquery")
+        inner_conjs, corr, mixed, inner_map = self._subquery_parts(subq, scope)
+        if not corr:
+            raise PlanningError("uncorrelated EXISTS not supported")
+
+        # modified subquery: project the correlated inner expressions (and any
+        # inner columns the mixed conjuncts need); the original select list of
+        # an EXISTS is irrelevant
+        sel_items = [A.SelectItem(inner_ast, f"__corr{i}")
+                     for i, (_, inner_ast) in enumerate(corr)]
+        mixed_pos: Dict[Tuple[Optional[str], str], int] = {}
+        for m in mixed:
+            for ident in _idents(m):
+                parts = ident.parts
+                if len(parts) >= 2:
+                    qual, name = parts[-2].lower(), parts[-1].lower()
+                    if qual in inner_map and name in inner_map[qual]:
+                        key = (qual, name)
+                    else:
+                        continue
+                else:
+                    name = parts[0].lower()
+                    if not any(name in s for s in inner_map.values()):
+                        continue
+                    key = (None, name)
+                if key not in mixed_pos:
+                    mixed_pos[key] = len(sel_items)
+                    sel_items.append(A.SelectItem(ident, f"__m{len(mixed_pos)}"))
+        mod = A.Query(select_items=sel_items, relations=subq.relations,
+                      where=_and_ast(inner_conjs), ctes=subq.ctes)
+        sub_node, _, sub_vars = self.plan_query(mod)
+
+        if not mixed and len(corr) == 1:
+            # pure equality correlation: direct semi join on the key
+            outer_e = self.plan_expr(corr[0][0], scope)
+            node, outer_v = self._ensure_var(node, outer_e, "semikey")
+            mark = self.new_var("mark", BOOLEAN)
+            node = P.SemiJoinNode(self.new_id("semijoin"), node, sub_node,
+                                  outer_v, sub_vars[0], mark)
+            pred: RowExpression = mark if not negated \
+                else call("not", BOOLEAN, mark)
+            return P.FilterNode(self.new_id("semifilter"), node, pred)
+
+        # general path (mixed non-equi correlation, Q21): tag outer rows with
+        # unique ids, inner-join against the subquery with the non-equi
+        # conjuncts as join filter, reduce to the distinct matched ids, then
+        # semi-join the tagged outer rows against those ids.  The outer
+        # subtree appears twice (once under the join, once as semi-join
+        # probe): a deepcopy keeps the plan a tree; node ids are shared so
+        # split assignment and AssignUniqueId are deterministic replays.
+        id_var = self.new_var("unique", BIGINT)
+        cur: P.PlanNode = P.AssignUniqueIdNode(self.new_id("uid"), node,
+                                               id_var)
+        criteria = []
+        for (outer_ast, _), sv in zip(corr, sub_vars):
+            e = self.plan_expr(outer_ast, scope)
+            cur, ov = self._ensure_var(cur, e, "corrkey")
+            criteria.append((ov, sv))
+        syn: Dict[str, Dict[str, VariableReferenceExpression]] = {}
+        for (alias, colname), pos in mixed_pos.items():
+            syn.setdefault(alias or "__inner", {})[colname] = sub_vars[pos]
+        mixed_scope = Scope(scope.relations
+                            + [RelationScope(a, cols)
+                               for a, cols in syn.items()])
+        from ..spi.expr import and_
+        jf = and_(*[_to_boolean(self.plan_expr(m, mixed_scope))
+                    for m in mixed]) if mixed else None
+        # the join must output every variable its filter reads
+        jf_vars = set()
+        if jf is not None:
+            _collect_vars(jf, jf_vars)
+        join_out = [id_var] + [v for v in cur.output_variables
+                               if v.name in jf_vars and v.name != id_var.name] \
+                            + [v for v in sub_vars if v.name in jf_vars]
+        import copy
+        probe_copy = copy.deepcopy(cur)
+        joined = P.JoinNode(self.new_id("existsjoin"), P.INNER, cur, sub_node,
+                            criteria, join_out, jf)
+        matched = P.AggregationNode(self.new_id("matched"), joined, {},
+                                    [id_var], P.SINGLE)
+        mark = self.new_var("mark", BOOLEAN)
+        node = P.SemiJoinNode(self.new_id("semijoin"), probe_copy, matched,
+                              id_var, id_var, mark)
+        pred = mark if not negated else call("not", BOOLEAN, mark)
+        return P.FilterNode(self.new_id("semifilter"), node, pred)
+
+    def _apply_in_subquery(self, node: P.PlanNode, scope: Scope,
+                           value_ast: A.Node, subq: A.Query,
+                           negated: bool) -> P.PlanNode:
+        inner_conjs, corr, mixed, _ = self._subquery_parts(subq, scope)
+        if corr or mixed:
+            raise PlanningError("correlated IN subquery not supported")
+        # NOTE: NOT IN over a build side containing NULLs should yield no
+        # rows (SQL three-valued semantics); TPC-H/DS key columns are
+        # non-null so the anti-join mark is exact here.
+        sub_node, _, sub_vars = self.plan_query(subq)
+        if len(sub_vars) != 1:
+            raise PlanningError("IN subquery must produce one column")
+        e = self.plan_expr(value_ast, scope)
+        node, v = self._ensure_var(node, e, "inkey")
+        mark = self.new_var("mark", BOOLEAN)
+        node = P.SemiJoinNode(self.new_id("semijoin"), node, sub_node,
+                              v, sub_vars[0], mark)
+        pred: RowExpression = mark if not negated \
+            else call("not", BOOLEAN, mark)
+        return P.FilterNode(self.new_id("semifilter"), node, pred)
+
+    def _apply_scalar_compare(self, node: P.PlanNode, scope: Scope, op: str,
+                              lhs_ast: A.Node, subq: A.Query,
+                              negated: bool) -> P.PlanNode:
+        inner_conjs, corr, mixed, _ = self._subquery_parts(subq, scope)
+        if mixed:
+            raise PlanningError("non-equi correlated scalar subquery")
+        if len(subq.select_items) != 1:
+            raise PlanningError("scalar subquery must select one column")
+        if corr:
+            if subq.group_by or subq.having:
+                raise PlanningError("correlated grouped scalar subquery")
+            # decorrelate: group the aggregate by the correlation columns and
+            # join back on them (reference
+            # TransformCorrelatedScalarAggregationToJoin).  An INNER join is
+            # exact here because the comparison that follows rejects the NULL
+            # a missing group would produce.
+            sel = [A.SelectItem(subq.select_items[0].expr, "__val")]
+            group = []
+            for i, (_, inner_ast) in enumerate(corr):
+                sel.append(A.SelectItem(inner_ast, f"__corr{i}"))
+                group.append(inner_ast)
+            mod = A.Query(select_items=sel, relations=subq.relations,
+                          where=_and_ast(inner_conjs), group_by=group,
+                          ctes=subq.ctes)
+            sub_node, _, sub_vars = self.plan_query(mod)
+            val_var, corr_vars = sub_vars[0], sub_vars[1:]
+            cur = node
+            criteria = []
+            for (outer_ast, _), sv in zip(corr, corr_vars):
+                e = self.plan_expr(outer_ast, scope)
+                cur, ov = self._ensure_var(cur, e, "corrkey")
+                criteria.append((ov, sv))
+            outputs = list(cur.output_variables) + [val_var]
+            node = P.JoinNode(self.new_id("corrjoin"), P.INNER, cur, sub_node,
+                              criteria, outputs)
+        else:
+            # uncorrelated scalar: enforce the one-row contract at runtime,
+            # then cross join the row in via a constant-key equi join
+            sub_node, _, sub_vars = self.plan_query(subq)
+            sub_node = P.EnforceSingleRowNode(self.new_id("single"), sub_node)
+            val_var = sub_vars[0]
+            ck_l = self.new_var("sjoin_l", BIGINT)
+            ck_r = self.new_var("sjoin_r", BIGINT)
+            left = P.ProjectNode(
+                self.new_id("sjl"), node,
+                {**{v: v for v in node.output_variables},
+                 ck_l: constant(0, BIGINT)})
+            right = P.ProjectNode(
+                self.new_id("sjr"), sub_node,
+                {val_var: val_var, ck_r: constant(0, BIGINT)})
+            node = P.JoinNode(self.new_id("scalarjoin"), P.INNER, left, right,
+                              [(ck_l, ck_r)],
+                              list(node.output_variables) + [val_var])
+        cmp = {"=": "eq", "<>": "neq", "<": "lt", "<=": "lte",
+               ">": "gt", ">=": "gte"}[op]
+        lhs = self.plan_expr(lhs_ast, scope)
+        pred: RowExpression = call(cmp, BOOLEAN, lhs, val_var)
+        if negated:
+            pred = call("not", BOOLEAN, pred)
+        return P.FilterNode(self.new_id("scalarfilter"), node, pred)
+
+    # ------------------------------------------------------------------
     # aggregation planning
     # ------------------------------------------------------------------
     def plan_aggregation(self, query: A.Query, node: P.PlanNode,
@@ -420,14 +729,18 @@ class Planner:
             key_vars.append(v)
             expr_vars[_canon(ast, scope)] = v
 
+        distinct_calls = [fc for fc in agg_calls if fc.distinct]
+        if distinct_calls:
+            return self._plan_distinct_aggregation(
+                query, node, scope, agg_calls, key_asts, pre_assign,
+                key_vars, expr_vars)
+
         aggregations: Dict[VariableReferenceExpression, P.Aggregation] = {}
         for fc in agg_calls:
             key = _canon(fc, scope)
             if key in expr_vars:
                 continue
             fname = fc.name
-            if fc.distinct:
-                raise PlanningError("DISTINCT aggregates not supported yet")
             if fc.args:
                 arg = self.plan_expr(fc.args[0], scope)
                 if isinstance(arg, VariableReferenceExpression):
@@ -449,6 +762,39 @@ class Planner:
                                 key_vars, P.SINGLE)
         post_scope = Scope(scope.relations, expr_vars)
         return agg, post_scope
+
+    def _plan_distinct_aggregation(self, query, node, scope, agg_calls,
+                                   key_asts, pre_assign, key_vars, expr_vars):
+        """Single-distinct rewrite (the planner-level equivalent of the
+        reference's SingleDistinctAggregationToGroupBy rule): every aggregate
+        must be DISTINCT over the same argument; dedup with an inner group-by
+        on (keys, arg), then aggregate normally on top."""
+        distinct_calls = [fc for fc in agg_calls if fc.distinct]
+        if len(distinct_calls) != len(agg_calls):
+            raise PlanningError(
+                "mixing DISTINCT and plain aggregates not supported")
+        arg_keys = {_canon(fc.args[0], scope) for fc in agg_calls}
+        if len(arg_keys) != 1:
+            raise PlanningError(
+                "multiple distinct-aggregate arguments not supported")
+        arg = self.plan_expr(agg_calls[0].args[0], scope)
+        if isinstance(arg, VariableReferenceExpression):
+            av = arg
+        else:
+            av = self.new_var("distinctarg", arg.type)
+        pre_assign[av] = arg
+        pre = P.ProjectNode(self.new_id("preagg"), node, pre_assign)
+        dedup = P.AggregationNode(self.new_id("dedup"), pre, {},
+                                  key_vars + [av], P.SINGLE)
+        aggregations: Dict[VariableReferenceExpression, P.Aggregation] = {}
+        for fc in agg_calls:
+            out_type = _agg_output_type(fc.name, av.type)
+            v = self.new_var(fc.name, out_type)
+            aggregations[v] = P.Aggregation(call(fc.name, out_type, av))
+            expr_vars[_canon(fc, scope)] = v
+        agg = P.AggregationNode(self.new_id("agg"), dedup, aggregations,
+                                key_vars, P.SINGLE)
+        return agg, Scope(scope.relations, expr_vars)
 
     def _resolve_order_item(self, oi: A.OrderItem, scope, out_vars,
                             alias_vars, extra_assign):
@@ -597,9 +943,18 @@ class Planner:
                 cond = A.BinaryOp("=", e.operand, cond)
             planned.append((_to_boolean(self.plan_expr(cond, scope)),
                             self.plan_expr(result, scope)))
+        # unify branch result types (Presto coerces CASE branches to a common
+        # super type: `when ... then volume else 0` is decimal, not int)
         result_type = planned[0][1].type
+        for _, r in planned[1:]:
+            result_type = _common_result_type(result_type, r.type)
+        if default is not None:
+            result_type = _common_result_type(result_type, default.type)
+        planned = [(c, _coerce_to(r, result_type)) for c, r in planned]
         if default is None:
             default = constant(None, result_type)
+        else:
+            default = _coerce_to(default, result_type)
         out = default
         for cond, result in reversed(planned):
             out = special("IF", result_type, cond, result, out)
@@ -703,6 +1058,142 @@ def _conjuncts(e: Optional[A.Node]) -> List[A.Node]:
     return [e]
 
 
+def _disjuncts(e: A.Node) -> List[A.Node]:
+    if isinstance(e, A.BinaryOp) and e.op == "or":
+        return _disjuncts(e.left) + _disjuncts(e.right)
+    return [e]
+
+
+def _and_ast(conjs: List[A.Node]) -> Optional[A.Node]:
+    if not conjs:
+        return None
+    out = conjs[0]
+    for c in conjs[1:]:
+        out = A.BinaryOp("and", out, c)
+    return out
+
+
+def _or_ast(disjs: List[A.Node]) -> A.Node:
+    out = disjs[0]
+    for d in disjs[1:]:
+        out = A.BinaryOp("or", out, d)
+    return out
+
+
+def _normalize_conjuncts(conjs: List[A.Node]) -> List[A.Node]:
+    """Hoist conjuncts common to every OR branch (reference
+    PredicatePushDown tryExtractCommonPredicates): Q19's
+    `(p=l and A) or (p=l and B)` exposes its join criterion `p=l` to the
+    equi-join extractor instead of forcing a cross join."""
+    out: List[A.Node] = []
+    for c in conjs:
+        if not (isinstance(c, A.BinaryOp) and c.op == "or"):
+            out.append(c)
+            continue
+        branch_lists = [_conjuncts(d) for d in _disjuncts(c)]
+        canon_maps = [{_canon(x): x for x in l} for l in branch_lists]
+        common = set(canon_maps[0])
+        for m in canon_maps[1:]:
+            common &= set(m)
+        if not common:
+            out.append(c)
+            continue
+        for k in sorted(common):
+            out.append(canon_maps[0][k])
+        rests = []
+        degenerate = False
+        for l in branch_lists:
+            rest = [x for x in l if _canon(x) not in common]
+            if not rest:
+                degenerate = True  # one branch reduced to TRUE: OR is TRUE
+                break
+            rests.append(_and_ast(rest))
+        if not degenerate:
+            out.append(_or_ast(rests))
+    return out
+
+
+def _has_subquery(n: A.Node) -> bool:
+    if isinstance(n, (A.InSubquery, A.Exists, A.ScalarSubquery)):
+        return True
+    for f in vars(n).values() if isinstance(n, A.Node) else []:
+        if isinstance(f, A.Node) and _has_subquery(f):
+            return True
+        if isinstance(f, list):
+            for x in f:
+                if isinstance(x, A.Node) and _has_subquery(x):
+                    return True
+                if isinstance(x, tuple) and any(
+                        isinstance(y, A.Node) and _has_subquery(y)
+                        for y in x):
+                    return True
+    return False
+
+
+def _idents(n: A.Node) -> List[A.Ident]:
+    """Identifiers in an expression, not descending into nested subqueries
+    (their names belong to the nested scope)."""
+    out: List[A.Ident] = []
+
+    def walk(x):
+        if isinstance(x, A.Ident):
+            out.append(x)
+            return
+        if isinstance(x, (A.InSubquery, A.Exists, A.ScalarSubquery)):
+            if isinstance(x, A.InSubquery):
+                walk(x.value)
+            return
+        if isinstance(x, A.Query):
+            return
+        for f in vars(x).values() if isinstance(x, A.Node) else []:
+            if isinstance(f, A.Node):
+                walk(f)
+            elif isinstance(f, list):
+                for y in f:
+                    if isinstance(y, A.Node):
+                        walk(y)
+                    elif isinstance(y, tuple):
+                        for z in y:
+                            if isinstance(z, A.Node):
+                                walk(z)
+
+    walk(n)
+    return out
+
+
+def _flatten_relations(relations: List[A.Node]) -> List[A.Node]:
+    flat: List[A.Node] = []
+
+    def rec(rel):
+        if isinstance(rel, A.JoinRel):
+            rec(rel.left)
+            rec(rel.right)
+        else:
+            flat.append(rel)
+
+    for r in relations:
+        rec(r)
+    return flat
+
+
+def _select_names(q: A.Query) -> List[str]:
+    out = []
+    for item in q.select_items:
+        if isinstance(item.expr, A.Star):
+            continue
+        out.append(item.alias or _default_name(item.expr))
+    return out
+
+
+def _collect_vars(e: RowExpression, out: set) -> None:
+    if isinstance(e, VariableReferenceExpression):
+        out.add(e.name)
+    args = getattr(e, "arguments", None)
+    if args:
+        for a in args:
+            _collect_vars(a, out)
+
+
 def _resolvable(planner: Planner, e: A.Node, scope: Scope) -> bool:
     try:
         planner.plan_expr(e, scope)
@@ -726,6 +1217,8 @@ def _collect_agg_calls(query: A.Query) -> List[A.FuncCall]:
     seen = set()
 
     def walk(n):
+        if isinstance(n, (A.InSubquery, A.Exists, A.ScalarSubquery)):
+            return  # subquery aggregates belong to the subquery's own scope
         if isinstance(n, A.FuncCall) and n.name in ("sum", "avg", "count",
                                                     "min", "max"):
             key = _canon(n)
@@ -881,6 +1374,47 @@ def _unify_comparison(left: RowExpression, right: RowExpression):
         if isinstance(lt, DateType) and isinstance(rt, (VarcharType, CharType)):
             return left, ConstantExpression(right.value, DATE)
     return left, right
+
+
+def _common_result_type(t1: Type, t2: Type) -> Type:
+    """Common super type for CASE/COALESCE branch unification."""
+    if t1.signature == t2.signature:
+        return t1
+    if t1.signature == "unknown":
+        return t2
+    if t2.signature == "unknown":
+        return t1
+    numeric = (DoubleType, RealType, DecimalType, IntegerType, BigintType)
+    if isinstance(t1, numeric) and isinstance(t2, numeric):
+        if isinstance(t1, (DoubleType, RealType)) \
+                or isinstance(t2, (DoubleType, RealType)):
+            return DOUBLE
+        if _is_decimal(t1) or _is_decimal(t2):
+            d1 = t1 if _is_decimal(t1) else DecimalType(19, 0)
+            d2 = t2 if _is_decimal(t2) else DecimalType(19, 0)
+            s = max(d1.scale, d2.scale)
+            p = min(38, max(d1.precision - d1.scale,
+                            d2.precision - d2.scale) + s)
+            return DecimalType(max(p, s + 1), s)
+        return BIGINT
+    if isinstance(t1, (VarcharType, CharType)) \
+            and isinstance(t2, (VarcharType, CharType)):
+        return VarcharType(max(getattr(t1, "length", 0) or 0,
+                               getattr(t2, "length", 0) or 0))
+    raise PlanningError(f"no common type for {t1.signature}/{t2.signature}")
+
+
+def _coerce_to(e: RowExpression, target: Type) -> RowExpression:
+    if e.type.signature == target.signature:
+        return e
+    if isinstance(e, ConstantExpression):
+        if e.value is None:
+            return constant(None, target)
+        if isinstance(target, DecimalType) and isinstance(
+                e.value, int) and not isinstance(e.value, bool):
+            from decimal import Decimal
+            return constant(Decimal(e.value), target)
+    return call("cast", target, e)
 
 
 def _agg_output_type(fname: str, input_type: Type) -> Type:

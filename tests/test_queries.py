@@ -288,3 +288,17 @@ def test_group_by_same_column_name_two_tables(runner):
         where a.n_nationkey + 1 = b.n_nationkey
         group by a.n_regionkey, b.n_regionkey""")
     assert any(r[0] != r[1] for r in res.rows)
+
+
+def test_group_by_small_pool_lazy_column(runner):
+    # orders.clerk is open-domain but drawn from a small pool (sf*1000
+    # values): grouping must be by value, not by row identity
+    res = check(runner, "select o_clerk, count(*) from orders group by o_clerk")
+    assert len(res.rows) <= 10 * 3  # sf0.01 -> 10 clerks
+
+
+def test_scalar_subquery_multi_row_raises(runner):
+    import pytest as _pytest
+    with _pytest.raises(Exception, match="more than one row"):
+        runner.execute("select count(*) from region where r_regionkey = "
+                       "(select n_regionkey from nation where n_regionkey < 2)")
