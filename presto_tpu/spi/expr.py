@@ -4,15 +4,18 @@ JSON shape follows the reference Jackson bindings: polymorphic on "@type" with
 names "call" / "special" / "lambda" / "input" / "variable" / "constant"
 (RowExpression.java:31-36), types carried as signature strings.
 
-Constant values are held as python objects in their logical form (ints for
-integral/decimal-unscaled, float for double, str for varchar, bool, None).
+Constant values are held as python objects in their logical form (int for
+integral, decimal.Decimal for decimals, float for double, str for varchar,
+bool, None).  JSON has no decimal type, so Decimal constants serialize as
+strings and from_dict re-parses them by the carried type signature.
 """
 from __future__ import annotations
 
 from dataclasses import dataclass, field
+from decimal import Decimal
 from typing import Any, List, Optional, Tuple
 
-from ..common.types import Type, parse_type
+from ..common.types import DecimalType, Type, parse_type
 
 
 class RowExpression:
@@ -26,7 +29,14 @@ class RowExpression:
         kind = d["@type"]
         if kind == "constant":
             typ = parse_type(d["type"])
-            return ConstantExpression(d.get("valueBlock", d.get("value")), typ)
+            if "valueBlock" in d:
+                value = d["valueBlock"]
+            else:
+                value = d.get("value")
+                # JSON has no decimal: decimals travel as strings (to_dict)
+                if isinstance(typ, DecimalType) and isinstance(value, str):
+                    value = Decimal(value)
+            return ConstantExpression(value, typ)
         if kind == "variable":
             return VariableReferenceExpression(d["name"], parse_type(d["type"]))
         if kind == "call":
@@ -54,7 +64,10 @@ class ConstantExpression(RowExpression):
     type: Type
 
     def to_dict(self):
-        return {"@type": "constant", "value": self.value,
+        value = self.value
+        if isinstance(value, Decimal):
+            value = str(value)  # JSON-safe; from_dict re-parses by type
+        return {"@type": "constant", "value": value,
                 "type": self.type.signature}
 
     def __str__(self):

@@ -1,0 +1,5 @@
+"""presto-tpu-execution worker: the HTTP protocol shell around the TPU
+pipeline engine (the analog of presto-native-execution/presto_cpp — see
+SURVEY.md §2.6, §3.3)."""
+from .server import WorkerServer              # noqa: F401
+from .coordinator import HttpQueryRunner      # noqa: F401

@@ -1,0 +1,48 @@
+"""Worker process entry point.
+
+    python -m presto_tpu.worker --http-port 8080 \
+        --discovery-uri http://coordinator:8080 [--coordinator]
+
+The analog of the native worker main (presto_cpp/main/PrestoMain.cpp /
+PrestoServer::run, presto_cpp/main/PrestoServer.cpp:197): start the HTTP
+task server, announce to discovery, serve until interrupted.
+"""
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="presto-tpu-worker")
+    parser.add_argument("--http-port", type=int, default=0)
+    parser.add_argument("--node-id", default=None)
+    parser.add_argument("--discovery-uri", default=None)
+    parser.add_argument("--coordinator", action="store_true",
+                        help="also host the embedded discovery service")
+    parser.add_argument("--environment", default="production")
+    args = parser.parse_args(argv)
+
+    from .server import WorkerServer
+    server = WorkerServer(port=args.http_port, node_id=args.node_id,
+                          coordinator=args.coordinator,
+                          discovery_uri=args.discovery_uri,
+                          environment=args.environment)
+    print(f"presto-tpu worker {server.node_id} listening on {server.uri}",
+          flush=True)
+
+    stop = []
+    signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
+    signal.signal(signal.SIGINT, lambda *a: stop.append(1))
+    try:
+        while not stop:
+            signal.pause()
+    except (KeyboardInterrupt, AttributeError):
+        pass  # AttributeError: signal.pause missing on some platforms
+    server.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

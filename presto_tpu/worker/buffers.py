@@ -1,0 +1,112 @@
+"""Task output buffers with the token-acknowledge pull protocol.
+
+The analog of the reference's OutputBuffer family
+(presto-main-base/.../execution/buffer/PartitionedOutputBuffer.java,
+BroadcastOutputBuffer.java) and the results endpoint semantics of
+TaskResource (presto-main/.../server/TaskResource.java:256-308): a consumer
+GETs /results/{bufferId}/{token}, pages at sequence numbers >= token are
+returned, an acknowledge GET frees everything below the new token, and a
+complete flag tells the consumer the stream is finished.
+"""
+from __future__ import annotations
+
+import threading
+from typing import List, Optional, Tuple
+
+
+class PageBuffer:
+    """One buffer id: an append-only sequence of serialized pages with
+    client-driven compaction."""
+
+    def __init__(self):
+        self._pages: List[bytes] = []
+        self._base = 0                    # sequence number of _pages[0]
+        self._complete = False
+        self._error: Optional[str] = None
+        self._cond = threading.Condition()
+
+    def add(self, page_bytes: bytes) -> None:
+        with self._cond:
+            self._pages.append(page_bytes)
+            self._cond.notify_all()
+
+    def set_complete(self) -> None:
+        with self._cond:
+            self._complete = True
+            self._cond.notify_all()
+
+    def set_error(self, message: str) -> None:
+        with self._cond:
+            self._error = message
+            self._complete = True
+            self._cond.notify_all()
+
+    def get(self, token: int, max_wait_s: float
+            ) -> Tuple[List[bytes], int, bool]:
+        """Pages from `token` on; blocks up to max_wait_s for data.
+        Returns (pages, next_token, buffer_complete).  Raises on task
+        failure (propagates the producer's error to the consumer)."""
+        deadline = None
+        with self._cond:
+            while True:
+                if self._error is not None:
+                    raise BufferError(self._error)
+                end = self._base + len(self._pages)
+                if token < end or self._complete:
+                    pages = self._pages[max(0, token - self._base):]
+                    next_token = max(token, self._base) + len(pages)
+                    at_end = self._complete and next_token >= end
+                    return pages, next_token, at_end
+                import time
+                if deadline is None:
+                    deadline = time.monotonic() + max_wait_s
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return [], token, False
+                self._cond.wait(remaining)
+
+    def acknowledge(self, token: int) -> None:
+        with self._cond:
+            drop = max(0, min(token - self._base, len(self._pages)))
+            if drop:
+                self._pages = self._pages[drop:]
+                self._base += drop
+
+    def destroy(self) -> None:
+        with self._cond:
+            self._pages = []
+            self._complete = True
+            self._cond.notify_all()
+
+
+class OutputBufferManager:
+    """All buffers of one task.  PARTITIONED routes page partition p to
+    buffer p; BROADCAST replicates every page into each consumer's buffer."""
+
+    def __init__(self, buffer_type: str, n_buffers: int):
+        self.buffer_type = buffer_type
+        self.buffers = [PageBuffer() for _ in range(max(1, n_buffers))]
+
+    def add(self, partition: int, page_bytes: bytes) -> None:
+        if self.buffer_type == "BROADCAST":
+            for b in self.buffers:
+                b.add(page_bytes)
+        else:
+            self.buffers[partition].add(page_bytes)
+
+    def set_complete(self) -> None:
+        for b in self.buffers:
+            b.set_complete()
+
+    def set_error(self, message: str) -> None:
+        for b in self.buffers:
+            b.set_error(message)
+
+    def get(self, buffer_id: int, token: int, max_wait_s: float):
+        return self.buffers[buffer_id].get(token, max_wait_s)
+
+    def acknowledge(self, buffer_id: int, token: int) -> None:
+        self.buffers[buffer_id].acknowledge(token)
+
+    def destroy(self, buffer_id: int) -> None:
+        self.buffers[buffer_id].destroy()

@@ -1,0 +1,80 @@
+"""HTTP exchange source: pulls SerializedPages from upstream task buffers.
+
+The analog of the reference's ExchangeClient/PageBufferClient
+(presto-main-base/.../operator/ExchangeClient.java:72) and the native
+PrestoExchangeSource (presto_cpp/main/PrestoExchangeSource.cpp:171): loop
+GET {location}/{token} -> acknowledge -> repeat until the complete flag,
+then DELETE the buffer.
+"""
+from __future__ import annotations
+
+import time
+import urllib.error
+import urllib.request
+from typing import Iterator, List
+
+from ..common.page import Page
+from ..common.serde import deserialize_pages
+
+DEFAULT_MAX_WAIT_S = 1.0
+REQUEST_TIMEOUT_S = 30.0
+RETRY_LIMIT = 5
+
+
+def _request(url: str, method: str = "GET",
+             timeout: float = REQUEST_TIMEOUT_S):
+    req = urllib.request.Request(url, method=method)
+    return urllib.request.urlopen(req, timeout=timeout)
+
+
+def pull_pages(location: str) -> Iterator[Page]:
+    """Stream every page from one upstream buffer location
+    (http://host:port/v1/task/{taskId}/results/{bufferId})."""
+    token = 0
+    retries = 0
+    while True:
+        url = f"{location}/{token}?maxWaitMs={int(DEFAULT_MAX_WAIT_S * 1000)}"
+        try:
+            with _request(url) as resp:
+                complete = resp.headers.get(
+                    "X-Presto-Buffer-Complete", "false") == "true"
+                next_token = int(resp.headers.get(
+                    "X-Presto-Page-Next-Token", token))
+                body = resp.read()
+            retries = 0
+        except urllib.error.HTTPError as e:
+            # 500 carries a producer-side failure: propagate, don't retry
+            detail = e.read().decode(errors="replace")
+            raise RuntimeError(
+                f"exchange source {location} failed: {detail}") from e
+        except (urllib.error.URLError, TimeoutError) as e:
+            retries += 1
+            if retries > RETRY_LIMIT:
+                raise RuntimeError(
+                    f"exchange source {location} unreachable") from e
+            time.sleep(min(2.0, 0.1 * (2 ** retries)))
+            continue
+        if body:
+            for page in deserialize_pages(body):
+                yield page
+        if next_token != token:
+            try:
+                _request(f"{location}/{next_token}/acknowledge").close()
+            except (urllib.error.URLError, TimeoutError):
+                pass  # acknowledge is an optimization; the pull re-fetches
+            token = next_token
+        if complete:
+            try:
+                _request(location, method="DELETE").close()
+            except (urllib.error.URLError, TimeoutError):
+                pass
+            return
+
+
+def remote_page_reader(locations: List[str]):
+    """A TaskContext.remote_pages callable: pages from every upstream task
+    feeding one RemoteSourceNode."""
+    def read() -> Iterator[Page]:
+        for loc in locations:
+            yield from pull_pages(loc)
+    return read

@@ -1,0 +1,154 @@
+"""Coordinator<->worker protocol DTOs (JSON).
+
+Mirrors the reference task protocol surface (presto-main-base/.../server/
+TaskUpdateRequest.java:37, TaskStatus/TaskInfo; native codegen mirror
+presto-native-execution/presto_cpp/presto_protocol/) scoped to the fields the
+TPU worker consumes: the plan fragment rides base64-encoded inside the update
+request exactly like HttpRemoteTask.sendUpdate builds it
+(presto-main/.../server/remotetask/HttpRemoteTask.java:883-889).
+"""
+from __future__ import annotations
+
+import base64
+import json
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..spi import plan as P
+
+# Task states (reference TaskState.java)
+PLANNED = "PLANNED"
+RUNNING = "RUNNING"
+FINISHED = "FINISHED"
+CANCELED = "CANCELED"
+ABORTED = "ABORTED"
+FAILED = "FAILED"
+
+DONE_STATES = {FINISHED, CANCELED, ABORTED, FAILED}
+
+
+@dataclass
+class TaskSource:
+    """Splits for one plan node (reference TaskSource.java).  A split is
+    either a connector split dict or a remote-location dict
+    ({"remote": true, "location": ".../results/<buffer>"}) feeding a
+    RemoteSourceNode, matching how the reference ships remote splits to the
+    ExchangeOperator."""
+    plan_node_id: str
+    splits: List[dict] = field(default_factory=list)
+    no_more_splits: bool = True
+
+    def to_dict(self):
+        return {"planNodeId": self.plan_node_id, "splits": self.splits,
+                "noMoreSplits": self.no_more_splits}
+
+    @staticmethod
+    def from_dict(d):
+        return TaskSource(d["planNodeId"], d.get("splits", []),
+                          d.get("noMoreSplits", True))
+
+
+@dataclass
+class OutputBuffersSpec:
+    """Which output buffers a task must expose (reference OutputBuffers):
+    PARTITIONED -> buffer i holds hash partition i; BROADCAST -> every buffer
+    holds the full output; one buffer per consumer task either way."""
+    type: str                      # "PARTITIONED" | "BROADCAST"
+    n_buffers: int = 1
+    partition_keys: List[str] = field(default_factory=list)
+
+    def to_dict(self):
+        return {"type": self.type, "nBuffers": self.n_buffers,
+                "partitionKeys": self.partition_keys}
+
+    @staticmethod
+    def from_dict(d):
+        return OutputBuffersSpec(d["type"], d.get("nBuffers", 1),
+                                 d.get("partitionKeys", []))
+
+
+@dataclass
+class TaskUpdateRequest:
+    task_id: str
+    task_index: int
+    fragment_b64: Optional[str]    # base64(json(PlanFragment))
+    sources: List[TaskSource]
+    output_buffers: OutputBuffersSpec
+    session: Dict[str, str] = field(default_factory=dict)
+
+    @staticmethod
+    def make(task_id: str, task_index: int, fragment: P.PlanFragment,
+             sources: List[TaskSource], output_buffers: OutputBuffersSpec,
+             session: Optional[Dict[str, str]] = None) -> "TaskUpdateRequest":
+        raw = json.dumps(fragment.to_dict()).encode()
+        return TaskUpdateRequest(task_id, task_index,
+                                 base64.b64encode(raw).decode(),
+                                 sources, output_buffers, session or {})
+
+    def fragment(self) -> P.PlanFragment:
+        raw = base64.b64decode(self.fragment_b64)
+        return P.PlanFragment.from_dict(json.loads(raw))
+
+    def to_dict(self):
+        return {"taskId": self.task_id, "taskIndex": self.task_index,
+                "fragment": self.fragment_b64,
+                "sources": [s.to_dict() for s in self.sources],
+                "outputBuffers": self.output_buffers.to_dict(),
+                "session": self.session}
+
+    @staticmethod
+    def from_dict(d):
+        return TaskUpdateRequest(
+            d["taskId"], d.get("taskIndex", 0), d.get("fragment"),
+            [TaskSource.from_dict(s) for s in d.get("sources", [])],
+            OutputBuffersSpec.from_dict(d["outputBuffers"]),
+            d.get("session", {}))
+
+
+@dataclass
+class TaskStatus:
+    task_id: str
+    state: str
+    version: int
+    self_uri: str
+    failures: List[str] = field(default_factory=list)
+    memory_reservation: int = 0
+    completed_drivers: int = 0
+
+    def to_dict(self):
+        return {"taskId": self.task_id, "state": self.state,
+                "version": self.version, "self": self.self_uri,
+                "failures": self.failures,
+                "memoryReservationInBytes": self.memory_reservation,
+                "completedDrivers": self.completed_drivers}
+
+    @staticmethod
+    def from_dict(d):
+        return TaskStatus(d["taskId"], d["state"], d["version"], d["self"],
+                          d.get("failures", []),
+                          d.get("memoryReservationInBytes", 0),
+                          d.get("completedDrivers", 0))
+
+
+def make_announcement(node_id: str, uri: str, environment: str = "test",
+                      pool_type: str = "TPU") -> dict:
+    """Worker service announcement body (reference
+    presto_cpp/main/Announcer.cpp:26-57)."""
+    return {
+        "environment": environment,
+        "pool": "general",
+        "location": f"/{node_id}",
+        "services": [{
+            "id": node_id,
+            "type": "presto",
+            "properties": {
+                "node_version": "presto-tpu-0.1",
+                "coordinator": "false",
+                "pool_type": pool_type,
+                "connectorIds": "tpch",
+                "http": uri,
+            },
+        }],
+        "announced_at": time.time(),
+    }

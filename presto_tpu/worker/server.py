@@ -1,0 +1,255 @@
+"""Worker HTTP server: the task REST protocol + node info + discovery.
+
+The analog of the native worker shell's HTTP surface
+(presto_cpp/main/TaskResource.cpp:59-129 registerUris, PrestoServer.cpp:327-390
+endpoint setup) on Python's stdlib threading HTTP server:
+
+  POST   /v1/task/{taskId}                      create/update task
+  GET    /v1/task/{taskId}                      task info
+  GET    /v1/task/{taskId}/status               long-poll task status
+  DELETE /v1/task/{taskId}                      cancel
+  GET    /v1/task/{taskId}/results/{b}/{token}  pull pages (SerializedPage)
+  GET    /v1/task/{taskId}/results/{b}/{token}/acknowledge
+  DELETE /v1/task/{taskId}/results/{b}
+  GET    /v1/info, /v1/info/state
+  PUT    /v1/announcement/{nodeId}              (coordinator role: discovery)
+  GET    /v1/service                            (coordinator role: node list)
+"""
+from __future__ import annotations
+
+import json
+import re
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional
+from urllib.parse import parse_qs, urlparse
+
+from ..exec.pipeline import ExecutionConfig
+from .protocol import TaskUpdateRequest, make_announcement
+from .task import TaskManager
+
+_ROUTES = [
+    ("GET", re.compile(r"^/v1/info/state$"), "info_state"),
+    ("GET", re.compile(r"^/v1/info$"), "info"),
+    ("GET", re.compile(r"^/v1/service$"), "service"),
+    ("PUT", re.compile(r"^/v1/announcement/(?P<node>[^/]+)$"), "announce"),
+    ("POST", re.compile(r"^/v1/task/(?P<task>[^/]+)$"), "task_update"),
+    ("GET", re.compile(r"^/v1/task/(?P<task>[^/]+)/status$"), "task_status"),
+    ("GET", re.compile(
+        r"^/v1/task/(?P<task>[^/]+)/results/(?P<buffer>\d+)/(?P<token>\d+)"
+        r"/acknowledge$"), "results_ack"),
+    ("GET", re.compile(
+        r"^/v1/task/(?P<task>[^/]+)/results/(?P<buffer>\d+)/(?P<token>\d+)$"),
+     "results"),
+    ("DELETE", re.compile(
+        r"^/v1/task/(?P<task>[^/]+)/results/(?P<buffer>\d+)$"),
+     "results_destroy"),
+    ("GET", re.compile(r"^/v1/task/(?P<task>[^/]+)$"), "task_info"),
+    ("DELETE", re.compile(r"^/v1/task/(?P<task>[^/]+)$"), "task_delete"),
+]
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server_ref: "WorkerServer" = None  # set by subclassing in WorkerServer
+
+    # quiet request logging
+    def log_message(self, fmt, *args):  # noqa: D102
+        pass
+
+    def _dispatch(self, method: str):
+        parsed = urlparse(self.path)
+        for m, rx, name in _ROUTES:
+            if m != method:
+                continue
+            match = rx.match(parsed.path)
+            if match:
+                try:
+                    getattr(self, "do_" + name)(
+                        match.groupdict(), parse_qs(parsed.query))
+                except KeyError:
+                    self._send(404, {"error": "unknown task"})
+                except BufferError as e:
+                    self._send(500, {"error": str(e)})
+                except BrokenPipeError:
+                    pass
+                return
+        self._send(404, {"error": f"no route {method} {parsed.path}"})
+
+    def do_GET(self):
+        self._dispatch("GET")
+
+    def do_POST(self):
+        self._dispatch("POST")
+
+    def do_PUT(self):
+        self._dispatch("PUT")
+
+    def do_DELETE(self):
+        self._dispatch("DELETE")
+
+    # -- helpers ----------------------------------------------------------
+    def _send(self, code: int, obj=None, body: bytes = b"",
+              headers: Optional[Dict[str, str]] = None):
+        if obj is not None:
+            body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type",
+                         "application/json" if obj is not None
+                         else "application/x-presto-pages")
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _body(self) -> bytes:
+        length = int(self.headers.get("Content-Length", 0))
+        return self.rfile.read(length)
+
+    # -- endpoints --------------------------------------------------------
+    def do_info(self, groups, query):
+        s = self.server_ref
+        self._send(200, {"nodeVersion": {"version": "presto-tpu-0.1"},
+                         "environment": s.environment,
+                         "coordinator": s.coordinator,
+                         "uptime": f"{time.time() - s.started_at:.0f}s"})
+
+    def do_info_state(self, groups, query):
+        self._send(200, "ACTIVE")
+
+    def do_service(self, groups, query):
+        s = self.server_ref
+        if s.discovery is None:
+            self._send(404, {"error": "not a coordinator"})
+            return
+        with s.discovery_lock:
+            services = [a["services"][0] for a in s.discovery.values()]
+        self._send(200, {"services": services})
+
+    def do_announce(self, groups, query):
+        s = self.server_ref
+        if s.discovery is None:
+            self._send(404, {"error": "not a coordinator"})
+            return
+        body = json.loads(self._body())
+        with s.discovery_lock:
+            s.discovery[groups["node"]] = body
+        self._send(202, {"ok": True})
+
+    def do_task_update(self, groups, query):
+        update = TaskUpdateRequest.from_dict(json.loads(self._body()))
+        status = self.server_ref.task_manager.create_or_update(update)
+        self._send(200, status.to_dict())
+
+    def do_task_status(self, groups, query):
+        task = self.server_ref.task_manager.get(groups["task"])
+        current = self.headers.get("X-Presto-Current-State") or \
+            (query.get("currentState", [None])[0])
+        max_wait = float(query.get("maxWaitMs", ["1000"])[0]) / 1000.0
+        status = task.wait_status(current, max_wait)
+        self._send(200, status.to_dict())
+
+    def do_task_info(self, groups, query):
+        task = self.server_ref.task_manager.get(groups["task"])
+        status = task.status()
+        self._send(200, {"taskId": task.task_id,
+                         "taskStatus": status.to_dict(),
+                         "noMoreSplits": True})
+
+    def do_task_delete(self, groups, query):
+        task = self.server_ref.task_manager.get(groups["task"])
+        task.cancel()
+        self._send(200, task.status().to_dict())
+
+    def do_results(self, groups, query):
+        task = self.server_ref.task_manager.get(groups["task"])
+        max_wait = float(query.get("maxWaitMs", ["1000"])[0]) / 1000.0
+        pages, next_token, complete = task.buffers.get(
+            int(groups["buffer"]), int(groups["token"]), max_wait)
+        body = b"".join(pages)
+        self._send(200, None, body, headers={
+            "X-Presto-Page-Token": groups["token"],
+            "X-Presto-Page-Next-Token": str(next_token),
+            "X-Presto-Buffer-Complete": "true" if complete else "false",
+            "X-Presto-Task-Instance-Id": task.task_id,
+        })
+
+    def do_results_ack(self, groups, query):
+        task = self.server_ref.task_manager.get(groups["task"])
+        task.buffers.acknowledge(int(groups["buffer"]), int(groups["token"]))
+        self._send(200, {"acknowledged": True})
+
+    def do_results_destroy(self, groups, query):
+        task = self.server_ref.task_manager.get(groups["task"])
+        task.buffers.destroy(int(groups["buffer"]))
+        self._send(200, {"destroyed": True})
+
+
+class WorkerServer:
+    """One worker (or coordinator) process node.  With coordinator=True the
+    server also hosts the embedded discovery service, like the reference
+    coordinator embeds Airlift discovery (PrestoServer.java:122)."""
+
+    def __init__(self, port: int = 0, node_id: Optional[str] = None,
+                 coordinator: bool = False,
+                 discovery_uri: Optional[str] = None,
+                 environment: str = "test",
+                 config: Optional[ExecutionConfig] = None,
+                 announce_interval_s: float = 1.0):
+        self.environment = environment
+        self.coordinator = coordinator
+        self.discovery: Optional[Dict[str, dict]] = {} if coordinator else None
+        self.discovery_lock = threading.Lock()
+        self.started_at = time.time()
+
+        handler = type("Handler", (_Handler,), {"server_ref": self})
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
+        self.port = self.httpd.server_port
+        self.uri = f"http://127.0.0.1:{self.port}"
+        self.node_id = node_id or f"node-{self.port}"
+        self.task_manager = TaskManager(self.uri, config)
+
+        self._serve_thread = threading.Thread(
+            target=self.httpd.serve_forever, name=f"http-{self.port}",
+            daemon=True)
+        self._serve_thread.start()
+
+        self._announcer: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        if discovery_uri:
+            self._announcer = threading.Thread(
+                target=self._announce_loop,
+                args=(discovery_uri, announce_interval_s),
+                name=f"announcer-{self.node_id}", daemon=True)
+            self._announcer.start()
+
+    def _announce_loop(self, discovery_uri: str, interval_s: float) -> None:
+        """PUT /v1/announcement/{nodeId} periodically (reference
+        presto_cpp/main/Announcer.cpp:59-74)."""
+        import urllib.request
+        body = json.dumps(make_announcement(
+            self.node_id, self.uri, self.environment)).encode()
+        url = f"{discovery_uri}/v1/announcement/{self.node_id}"
+        while not self._stop.is_set():
+            try:
+                req = urllib.request.Request(
+                    url, data=body, method="PUT",
+                    headers={"Content-Type": "application/json"})
+                urllib.request.urlopen(req, timeout=5).close()
+            except OSError:
+                pass  # coordinator not up yet; retry next tick
+            self._stop.wait(interval_s)
+
+    def worker_uris(self) -> list:
+        """Discovered worker URIs (coordinator role)."""
+        with self.discovery_lock:
+            return [a["services"][0]["properties"]["http"]
+                    for a in (self.discovery or {}).values()]
+
+    def close(self) -> None:
+        self._stop.set()
+        self.task_manager.cancel_all()
+        self.httpd.shutdown()
+        self.httpd.server_close()

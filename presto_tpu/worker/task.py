@@ -1,0 +1,166 @@
+"""Worker-side task manager: TaskUpdateRequest -> running pipeline.
+
+The analog of the reference SqlTaskManager/SqlTaskExecution
+(presto-main-base/.../execution/SqlTaskManager.java:103,
+SqlTaskExecution.java:83) and the native TaskManager
+(presto_cpp/main/TaskManager.cpp:493): decode the base64 plan fragment,
+build a TaskContext from the shipped splits and remote-source locations,
+run the compiled pipeline on an executor thread, and stream output pages
+into token-acknowledged output buffers, hash-partitioned per the fragment's
+output partitioning scheme.
+"""
+from __future__ import annotations
+
+import threading
+import traceback
+from typing import Dict, List, Optional
+
+from ..common.serde import serialize_page
+from ..connectors import tpch
+from ..exec.pipeline import ExecutionConfig, PlanCompiler, TaskContext
+from ..exec.scheduler import partition_targets, split_page
+from ..spi import plan as P
+from .buffers import OutputBufferManager
+from .exchange import remote_page_reader
+from .protocol import (DONE_STATES, FAILED, FINISHED, PLANNED, RUNNING,
+                       CANCELED, TaskStatus, TaskUpdateRequest)
+
+
+class TpuTask:
+    """One task: state machine + executor thread + output buffers."""
+
+    def __init__(self, task_id: str, self_uri: str, config: ExecutionConfig):
+        self.task_id = task_id
+        self.self_uri = self_uri
+        self.config = config
+        self.state = PLANNED
+        self.version = 0
+        self.failures: List[str] = []
+        self.buffers: Optional[OutputBufferManager] = None
+        self._cond = threading.Condition()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- state ------------------------------------------------------------
+    def _set_state(self, state: str, failure: Optional[str] = None) -> None:
+        with self._cond:
+            if self.state in DONE_STATES:
+                return
+            self.state = state
+            self.version += 1
+            if failure:
+                self.failures.append(failure)
+            self._cond.notify_all()
+
+    def status(self) -> TaskStatus:
+        with self._cond:
+            return TaskStatus(self.task_id, self.state, self.version,
+                              self.self_uri, list(self.failures))
+
+    def wait_status(self, current_state: Optional[str],
+                    max_wait_s: float) -> TaskStatus:
+        """Long-poll: return when state differs from current_state or the
+        wait expires (reference TaskResource.getTaskStatus :189)."""
+        import time
+        deadline = time.monotonic() + max_wait_s
+        with self._cond:
+            while (current_state is not None
+                   and self.state == current_state
+                   and self.state not in DONE_STATES):
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._cond.wait(remaining)
+        return self.status()
+
+    def cancel(self) -> None:
+        self._set_state(CANCELED)
+        if self.buffers:
+            self.buffers.set_complete()
+
+    # -- execution ----------------------------------------------------------
+    def start(self, update: TaskUpdateRequest) -> None:
+        fragment = update.fragment()
+        spec = update.output_buffers
+        self.buffers = OutputBufferManager(spec.type, spec.n_buffers)
+        ctx = TaskContext(config=self.config, task_index=update.task_index)
+        for source in update.sources:
+            remote = [s["location"] for s in source.splits if s.get("remote")]
+            conn = [s for s in source.splits if not s.get("remote")]
+            if remote:
+                ctx.remote_pages[source.plan_node_id] = \
+                    remote_page_reader(remote)
+            if conn:
+                ctx.splits[source.plan_node_id] = [
+                    tpch.TpchSplit.from_dict(s) for s in conn]
+
+        self._set_state(RUNNING)
+        self._thread = threading.Thread(
+            target=self._run, args=(fragment, spec, ctx),
+            name=f"task-{self.task_id}", daemon=True)
+        self._thread.start()
+
+    def _run(self, fragment: P.PlanFragment, spec, ctx: TaskContext) -> None:
+        try:
+            out_vars = fragment.root.output_variables
+            out_types = [v.type for v in out_vars]
+            out_names = [v.name for v in out_vars]
+            key_indices = [out_names.index(k) for k in spec.partition_keys]
+            n_parts = len(self.buffers.buffers)
+            partitioned = (spec.type == "PARTITIONED" and n_parts > 1
+                           and key_indices)
+            compiler = PlanCompiler(ctx)
+            for page in compiler.run_to_pages(fragment.root):
+                if self.state in DONE_STATES:
+                    return
+                if partitioned:
+                    targets = partition_targets(page, out_types, key_indices,
+                                                n_parts)
+                    for p, sub in enumerate(
+                            split_page(page, targets, n_parts)):
+                        if sub is not None:
+                            self.buffers.add(p, serialize_page(sub))
+                else:
+                    self.buffers.add(0, serialize_page(page))
+            self.buffers.set_complete()
+            self._set_state(FINISHED)
+        except Exception:
+            message = traceback.format_exc()
+            self.buffers.set_error(f"task {self.task_id} failed:\n{message}")
+            self._set_state(FAILED, message)
+
+
+class TaskManager:
+    """Task registry (reference SqlTaskManager.java:103)."""
+
+    def __init__(self, base_uri: str = "",
+                 config: Optional[ExecutionConfig] = None):
+        self.base_uri = base_uri
+        self.config = config or ExecutionConfig(batch_rows=1 << 16,
+                                                join_out_capacity=1 << 18)
+        self.tasks: Dict[str, TpuTask] = {}
+        self._lock = threading.Lock()
+
+    def create_or_update(self, update: TaskUpdateRequest) -> TaskStatus:
+        with self._lock:
+            task = self.tasks.get(update.task_id)
+            if task is None:
+                task = TpuTask(update.task_id,
+                               f"{self.base_uri}/v1/task/{update.task_id}",
+                               self.config)
+                self.tasks[update.task_id] = task
+                fresh = True
+            else:
+                fresh = False
+        if fresh and update.fragment_b64:
+            task.start(update)
+        return task.status()
+
+    def get(self, task_id: str) -> TpuTask:
+        task = self.tasks.get(task_id)
+        if task is None:
+            raise KeyError(task_id)
+        return task
+
+    def cancel_all(self) -> None:
+        for t in list(self.tasks.values()):
+            t.cancel()

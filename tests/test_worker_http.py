@@ -1,0 +1,166 @@
+"""HTTP worker protocol tests: real loopback HTTP between a coordinator-side
+runner and N worker servers hosted in one process — the analog of the
+reference's DistributedQueryRunner booting N TestingPrestoServers in one JVM
+with embedded discovery (presto-tests/.../DistributedQueryRunner.java:108,
+TestingPrestoServer.java:143)."""
+import json
+import time
+import urllib.request
+
+import pytest
+
+from presto_tpu.exec.runner import LocalQueryRunner
+from presto_tpu.worker import HttpQueryRunner, WorkerServer
+
+from test_queries import TPCH_Q1, TPCH_Q3, TPCH_Q6
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    coordinator = WorkerServer(coordinator=True, environment="test")
+    workers = [WorkerServer(discovery_uri=coordinator.uri,
+                            announce_interval_s=0.1,
+                            environment="test") for _ in range(2)]
+    deadline = time.time() + 10
+    while len(coordinator.worker_uris()) < 2 and time.time() < deadline:
+        time.sleep(0.05)
+    yield coordinator, workers
+    for w in workers:
+        w.close()
+    coordinator.close()
+
+
+@pytest.fixture(scope="module")
+def runner(cluster):
+    coordinator, _ = cluster
+    uris = coordinator.worker_uris()
+    assert len(uris) == 2, "workers failed to announce"
+    return HttpQueryRunner(uris, "sf0.01", n_tasks=2)
+
+
+def _get_json(url):
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return json.loads(resp.read())
+
+
+# ---------------------------------------------------------------------------
+# discovery / announcement protocol
+# ---------------------------------------------------------------------------
+
+def test_announcement_discovery(cluster):
+    coordinator, workers = cluster
+    services = _get_json(f"{coordinator.uri}/v1/service")["services"]
+    uris = {s["properties"]["http"] for s in services}
+    assert {w.uri for w in workers} <= uris
+    assert all(s["properties"]["pool_type"] == "TPU" for s in services)
+
+
+def test_node_info(cluster):
+    _, workers = cluster
+    info = _get_json(f"{workers[0].uri}/v1/info")
+    assert info["coordinator"] is False
+    state = _get_json(f"{workers[0].uri}/v1/info/state")
+    assert state == "ACTIVE"
+
+
+def test_unknown_task_404(cluster):
+    _, workers = cluster
+    with pytest.raises(urllib.error.HTTPError) as e:
+        urllib.request.urlopen(f"{workers[0].uri}/v1/task/nope/status",
+                               timeout=10)
+    assert e.value.code == 404
+
+
+# ---------------------------------------------------------------------------
+# end-to-end queries over HTTP exchange
+# ---------------------------------------------------------------------------
+
+def _check(runner, sql, ordered=False):
+    got = runner.execute(sql)
+    exp = LocalQueryRunner("sf0.01").execute_reference(sql)
+    from presto_tpu.exec.runner import _assert_rows_equal
+    _assert_rows_equal(got, exp, ordered)
+    return got
+
+
+def test_http_scan_filter(runner):
+    res = _check(runner, "select n_name, n_regionkey from nation "
+                         "where n_regionkey = 2", ordered=False)
+    assert len(res.rows) == 5
+
+
+def test_http_q6(runner):
+    _check(runner, TPCH_Q6)
+
+
+def test_http_q1(runner):
+    _check(runner, TPCH_Q1, ordered=True)
+
+
+def test_http_q3_partitioned_exchange(runner):
+    _check(runner, TPCH_Q3, ordered=True)
+
+
+def test_http_join_group(runner):
+    _check(runner, """
+        select o_orderstatus, count(*), sum(o_totalprice)
+        from orders, customer where c_custkey = o_custkey
+          and c_mktsegment = 'BUILDING'
+        group by o_orderstatus order by o_orderstatus""", ordered=True)
+
+
+def test_http_failure_propagates(runner):
+    with pytest.raises(Exception):
+        runner.execute("select unknown_column from nation")
+
+
+def test_task_status_long_poll(cluster, runner):
+    """The status endpoint blocks while the state is unchanged and returns
+    promptly once the task reaches a terminal state."""
+    _, workers = cluster
+    runner.execute("select count(*) from region")
+    tm = workers[0].task_manager
+    if not tm.tasks:
+        tm = workers[1].task_manager
+    task_id = next(iter(tm.tasks))
+    t0 = time.time()
+    status = _get_json(
+        f"{tm.tasks[task_id].self_uri}/status?maxWaitMs=2000")
+    assert time.time() - t0 < 1.5  # terminal state: no full wait
+    assert status["state"] in ("FINISHED", "CANCELED")
+
+
+def test_external_worker_process(cluster):
+    """Spawn a real worker subprocess via `python -m presto_tpu.worker` (the
+    reference's external-worker-launcher pattern,
+    PrestoNativeQueryRunnerUtils.java:253-267) and run a query on it."""
+    import os
+    import re
+    import subprocess
+    import sys
+
+    coordinator, _ = cluster
+    env = dict(os.environ, JAX_PLATFORMS="cpu", JAX_PLATFORM_NAME="cpu",
+               PYTHONPATH=os.path.dirname(os.path.dirname(
+                   os.path.abspath(__file__))))
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "presto_tpu.worker", "--environment", "test",
+         "--discovery-uri", coordinator.uri],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env)
+    try:
+        line = proc.stdout.readline()
+        m = re.search(r"listening on (http://[\d.:]+)", line)
+        assert m, f"no startup line: {line!r}"
+        uri = m.group(1)
+        r = HttpQueryRunner([uri], "sf0.01", n_tasks=1)
+        res = r.execute("select r_name from region order by r_name")
+        assert [row[0] for row in res.rows] == [
+            "AFRICA", "AMERICA", "ASIA", "EUROPE", "MIDDLE EAST"]
+        # it must also have announced itself to the coordinator's discovery
+        deadline = time.time() + 10
+        while uri not in coordinator.worker_uris() and time.time() < deadline:
+            time.sleep(0.05)
+        assert uri in coordinator.worker_uris()
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
