@@ -670,6 +670,7 @@ def _py_substr(s: str, start: int, length) -> str:
 
 def _host_string_column(call_expr: CallExpression, batch: Batch) -> Column:
     from .lowering import like_matcher
+    from .. import native
     arg = call_expr.arguments[0]
     col = batch.columns[arg.name]
     ids = np.asarray(col.values)
@@ -677,18 +678,23 @@ def _host_string_column(call_expr: CallExpression, batch: Batch) -> Column:
     strings = tpch.generate_values_at(table, column, sf, ids)
     name = canonical_name(call_expr.display_name)
     if name == "like":
-        match = like_matcher(str(call_expr.arguments[1].value))
-        vals = np.fromiter((match(s) for s in strings), dtype=bool,
-                           count=len(strings))
+        pattern = str(call_expr.arguments[1].value)
+        vals = native.like_match(strings, pattern)
+        if vals is None:  # no native lib / non-ASCII: python matcher
+            match = like_matcher(pattern)
+            vals = np.fromiter((match(s) for s in strings), dtype=bool,
+                               count=len(strings))
         return Column(jnp.asarray(vals), col.nulls)
     start = int(call_expr.arguments[1].value)
     length = (int(call_expr.arguments[2].value)
               if len(call_expr.arguments) > 2 else None)
     cdict = _canonical_substr_dict(table, column, sf, start, length)
-    index = {s: i for i, s in enumerate(cdict)}
-    codes = np.fromiter((index[_py_substr(s, start, length)]
-                         for s in strings), dtype=np.int32,
-                        count=len(strings))
+    codes = native.substr_dict_encode(strings, start, length, cdict)
+    if codes is None:
+        index = {s: i for i, s in enumerate(cdict)}
+        codes = np.fromiter((index[_py_substr(s, start, length)]
+                             for s in strings), dtype=np.int32,
+                            count=len(strings))
     return Column(jnp.asarray(codes), col.nulls, cdict)
 
 
@@ -703,16 +709,19 @@ def _encode_lazy_keys(batch: Batch, keys: List[str]) -> Batch:
     """Replace late-materialized key columns by whole-column dictionary
     codes (for GROUP BY on small-pool open-domain columns, where row ids
     would split value groups)."""
+    from .. import native
     new_cols = {}
     for k in keys:
         col = batch.columns[k]
         _, table, column, sf = col.lazy
         cdict = _canonical_substr_dict(table, column, sf, 1, None)
-        index = {s: i for i, s in enumerate(cdict)}
         strings = tpch.generate_values_at(
             table, column, sf, np.asarray(col.values))
-        codes = np.fromiter((index[s] for s in strings), dtype=np.int32,
-                            count=len(strings))
+        codes = native.substr_dict_encode(strings, 1, None, cdict)
+        if codes is None:
+            index = {s: i for i, s in enumerate(cdict)}
+            codes = np.fromiter((index[s] for s in strings), dtype=np.int32,
+                                count=len(strings))
         new_cols[k] = Column(jnp.asarray(codes), col.nulls, cdict)
     return batch.with_columns(new_cols)
 

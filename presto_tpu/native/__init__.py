@@ -1,0 +1,145 @@
+"""Native (C++) host-side kernels, loaded via ctypes.
+
+Build-on-first-use with g++ (the image's native toolchain); every entry point
+has a pure-Python fallback, so the package works — just slower — when no
+compiler is available.  The C++ side mirrors the role of the reference's
+native worker shell (presto-native-execution/presto_cpp): host data-plane
+loops stay native while device compute stays in XLA.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+import threading
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "kernels.cpp")
+_SO = os.path.join(_HERE, "_kernels.so")
+
+_lib = None
+_lib_lock = threading.Lock()
+_build_failed = False
+
+
+def _build() -> Optional[str]:
+    """Compile kernels.cpp -> _kernels.so (atomic replace; safe under
+    concurrent builders)."""
+    fd, tmp = tempfile.mkstemp(suffix=".so", dir=_HERE)
+    os.close(fd)
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-o", tmp, _SRC],
+            check=True, capture_output=True, timeout=120)
+        os.replace(tmp, _SO)
+        return _SO
+    except (subprocess.SubprocessError, OSError):
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return None
+
+
+def load():
+    """The loaded library, or None when native kernels are unavailable."""
+    global _lib, _build_failed
+    if _lib is not None or _build_failed:
+        return _lib
+    with _lib_lock:
+        if _lib is not None or _build_failed:
+            return _lib
+        path = _SO
+        if not os.path.exists(path) or \
+                os.path.getmtime(path) < os.path.getmtime(_SRC):
+            path = _build()
+        if path is None:
+            _build_failed = True
+            return None
+        lib = ctypes.CDLL(path)
+        lib.ptn_like.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_uint8)]
+        lib.ptn_like.restype = None
+        lib.ptn_substr_dict_encode.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64), ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int32)]
+        lib.ptn_substr_dict_encode.restype = ctypes.c_int64
+        lib.ptn_hash_combine.argtypes = [
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_uint8),
+            ctypes.c_int64, ctypes.POINTER(ctypes.c_uint64)]
+        lib.ptn_hash_combine.restype = None
+        _lib = lib
+        return _lib
+
+
+def pack_strings(strings: List[str]
+                 ) -> Optional[Tuple[bytes, np.ndarray]]:
+    """list[str] -> (utf-8 buffer, int64 offsets[n+1]), or None when any
+    string is non-ASCII (byte-wise kernels would miscount characters)."""
+    n = len(strings)
+    offsets = np.zeros(n + 1, dtype=np.int64)
+    lens = np.fromiter((len(s) for s in strings), dtype=np.int64, count=n)
+    np.cumsum(lens, out=offsets[1:])
+    data = "".join(strings).encode("utf-8")
+    if len(data) != int(offsets[-1]):
+        return None  # non-ASCII: char count != byte count
+    return data, offsets
+
+
+def _i64p(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+
+
+def like_match(strings: List[str], pattern: str,
+               escape: Optional[str] = None) -> Optional[np.ndarray]:
+    """Vectorized SQL LIKE over a string list; None -> caller falls back to
+    the Python matcher (no native lib, or non-ASCII input)."""
+    lib = load()
+    if lib is None:
+        return None
+    packed = pack_strings(strings)
+    if packed is None:
+        return None
+    try:
+        pat = pattern.encode("ascii")
+    except UnicodeEncodeError:
+        return None
+    data, offsets = packed
+    out = np.zeros(len(strings), dtype=np.uint8)
+    esc = ord(escape) if escape else -1
+    lib.ptn_like(data, _i64p(offsets), len(strings), pat, len(pat), esc,
+                 out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
+    return out.astype(bool)
+
+
+def substr_dict_encode(strings: List[str], start: int, length: Optional[int],
+                       dictionary: Tuple[str, ...]) -> Optional[np.ndarray]:
+    """codes[i] = index of substr(strings[i], start, length) in the sorted
+    dictionary.  None -> fall back to Python.  Raises KeyError when a value
+    is missing from the dictionary (callers build exhaustive dictionaries)."""
+    lib = load()
+    if lib is None:
+        return None
+    packed = pack_strings(strings)
+    dpacked = pack_strings(list(dictionary))
+    if packed is None or dpacked is None:
+        return None
+    data, offsets = packed
+    ddata, doffsets = dpacked
+    out = np.zeros(len(strings), dtype=np.int32)
+    missing = lib.ptn_substr_dict_encode(
+        data, _i64p(offsets), len(strings), start,
+        -1 if length is None else length,
+        ddata, _i64p(doffsets), len(dictionary),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+    if missing:
+        raise KeyError(f"{missing} values missing from dictionary")
+    return out

@@ -32,14 +32,14 @@ def main(argv=None) -> int:
     print(f"presto-tpu worker {server.node_id} listening on {server.uri}",
           flush=True)
 
-    stop = []
-    signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
-    signal.signal(signal.SIGINT, lambda *a: stop.append(1))
+    import threading
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
     try:
-        while not stop:
-            signal.pause()
-    except (KeyboardInterrupt, AttributeError):
-        pass  # AttributeError: signal.pause missing on some platforms
+        stop.wait()
+    except KeyboardInterrupt:
+        pass
     server.close()
     return 0
 

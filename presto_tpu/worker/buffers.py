@@ -14,20 +14,34 @@ import threading
 from typing import List, Optional, Tuple
 
 
+DEFAULT_MAX_BUFFERED_BYTES = 64 << 20
+
+
 class PageBuffer:
     """One buffer id: an append-only sequence of serialized pages with
-    client-driven compaction."""
+    client-driven compaction and producer backpressure (the reference's
+    OutputBufferMemoryManager bounds buffered bytes and blocks the
+    producer; acknowledges free memory and unblock it)."""
 
-    def __init__(self):
+    def __init__(self, max_buffered_bytes: int = DEFAULT_MAX_BUFFERED_BYTES):
         self._pages: List[bytes] = []
         self._base = 0                    # sequence number of _pages[0]
+        self._bytes = 0                   # bytes currently retained
+        self._max_bytes = max_buffered_bytes
         self._complete = False
+        self._destroyed = False
         self._error: Optional[str] = None
         self._cond = threading.Condition()
 
     def add(self, page_bytes: bytes) -> None:
         with self._cond:
+            while (self._bytes >= self._max_bytes
+                   and not self._destroyed and self._error is None):
+                self._cond.wait(1.0)
+            if self._destroyed:
+                return
             self._pages.append(page_bytes)
+            self._bytes += len(page_bytes)
             self._cond.notify_all()
 
     def set_complete(self) -> None:
@@ -69,13 +83,17 @@ class PageBuffer:
         with self._cond:
             drop = max(0, min(token - self._base, len(self._pages)))
             if drop:
+                self._bytes -= sum(len(p) for p in self._pages[:drop])
                 self._pages = self._pages[drop:]
                 self._base += drop
+                self._cond.notify_all()  # unblock a backpressured producer
 
     def destroy(self) -> None:
         with self._cond:
             self._pages = []
+            self._bytes = 0
             self._complete = True
+            self._destroyed = True
             self._cond.notify_all()
 
 
@@ -110,3 +128,7 @@ class OutputBufferManager:
 
     def destroy(self, buffer_id: int) -> None:
         self.buffers[buffer_id].destroy()
+
+    def destroy_all(self) -> None:
+        for b in self.buffers:
+            b.destroy()

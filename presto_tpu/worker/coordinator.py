@@ -148,7 +148,7 @@ class HttpQueryRunner(LocalQueryRunner):
             if isinstance(node, P.TableScanNode):
                 th = node.table
                 sf = dict(th.extra).get("scaleFactor", 0.01)
-                n_splits = max(stage.n_tasks, 4)
+                n_splits = max(stage.n_tasks, self.config.splits_per_scan)
                 scan_splits[node.id] = tpch.make_splits(
                     th.table_name, sf, n_splits)
         remote_nodes = [n for n in P.walk_plan(frag.root)

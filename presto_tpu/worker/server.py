@@ -72,8 +72,11 @@ class _Handler(BaseHTTPRequestHandler):
                     self._send(404, {"error": "unknown task"})
                 except BufferError as e:
                     self._send(500, {"error": str(e)})
-                except BrokenPipeError:
+                except (BrokenPipeError, ConnectionResetError):
                     pass
+                except Exception:  # noqa: BLE001 — surface, don't drop conn
+                    import traceback
+                    self._send(500, {"error": traceback.format_exc()})
                 return
         self._send(404, {"error": f"no route {method} {parsed.path}"})
 

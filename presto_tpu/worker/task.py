@@ -37,11 +37,13 @@ class TpuTask:
         self.version = 0
         self.failures: List[str] = []
         self.buffers: Optional[OutputBufferManager] = None
+        self.done_at: Optional[float] = None
         self._cond = threading.Condition()
         self._thread: Optional[threading.Thread] = None
 
     # -- state ------------------------------------------------------------
     def _set_state(self, state: str, failure: Optional[str] = None) -> None:
+        import time
         with self._cond:
             if self.state in DONE_STATES:
                 return
@@ -49,6 +51,8 @@ class TpuTask:
             self.version += 1
             if failure:
                 self.failures.append(failure)
+            if state in DONE_STATES:
+                self.done_at = time.monotonic()
             self._cond.notify_all()
 
     def status(self) -> TaskStatus:
@@ -75,7 +79,8 @@ class TpuTask:
     def cancel(self) -> None:
         self._set_state(CANCELED)
         if self.buffers:
-            self.buffers.set_complete()
+            # drop undelivered pages and unblock a backpressured producer
+            self.buffers.destroy_all()
 
     # -- execution ----------------------------------------------------------
     def start(self, update: TaskUpdateRequest) -> None:
@@ -130,7 +135,11 @@ class TpuTask:
 
 
 class TaskManager:
-    """Task registry (reference SqlTaskManager.java:103)."""
+    """Task registry (reference SqlTaskManager.java:103).  Terminal tasks
+    are evicted after a grace period (the reference's task info cleanup in
+    PeriodicTaskManager) so a long-lived worker does not leak memory."""
+
+    TASK_TTL_S = 300.0
 
     def __init__(self, base_uri: str = "",
                  config: Optional[ExecutionConfig] = None):
@@ -140,8 +149,19 @@ class TaskManager:
         self.tasks: Dict[str, TpuTask] = {}
         self._lock = threading.Lock()
 
+    def _evict_locked(self) -> None:
+        import time
+        now = time.monotonic()
+        dead = [tid for tid, t in self.tasks.items()
+                if t.done_at is not None and now - t.done_at > self.TASK_TTL_S]
+        for tid in dead:
+            if self.tasks[tid].buffers is not None:
+                self.tasks[tid].buffers.destroy_all()
+            del self.tasks[tid]
+
     def create_or_update(self, update: TaskUpdateRequest) -> TaskStatus:
         with self._lock:
+            self._evict_locked()
             task = self.tasks.get(update.task_id)
             if task is None:
                 task = TpuTask(update.task_id,
