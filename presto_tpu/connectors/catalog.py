@@ -1,0 +1,118 @@
+"""Connector catalog: registry + dispatch over connector modules.
+
+The slim analog of the reference's connector SPI surface
+(presto-spi/.../spi/connector/ConnectorMetadata.java:73 for table/column
+metadata, ConnectorSplitManager.java:23 for splits): the engine layers
+(planner, pipeline compiler, scheduler, reference interpreter) call this
+module instead of a concrete connector.  Connector modules are duck-typed —
+they expose SCHEMAS / PREFIXES / OPEN_DOMAIN / ROWID_* / table_row_count /
+generate_column / generate_values_at / column_type (see tpch.py, tpcds.py).
+
+Table names are resolved with a session-preferred connector first (the
+reference's session catalog), then any other registered connector — the two
+built-ins overlap only on `customer`.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from . import tpch as _tpch
+from . import tpcds as _tpcds
+
+_CONNECTORS = {"tpch": _tpch, "tpcds": _tpcds}
+
+# merged (table, column) property sets; cross-connector collisions are
+# impossible in practice (tpcds columns carry their table prefix)
+OPEN_DOMAIN = set(_tpch.OPEN_DOMAIN) | set(_tpcds.OPEN_DOMAIN)
+ROWID_ORDERED = set(_tpch.ROWID_ORDERED) | set(_tpcds.ROWID_ORDERED)
+ROWID_DISTINCT = set(_tpch.ROWID_DISTINCT) | set(_tpcds.ROWID_DISTINCT)
+
+
+def module(connector_id: str):
+    return _CONNECTORS[connector_id]
+
+
+def resolve_table(name: str, preferred: str = "tpch") -> Optional[str]:
+    """Table name -> connector id (session-preferred connector wins)."""
+    order = [preferred] + [c for c in _CONNECTORS if c != preferred]
+    for cid in order:
+        if name in _CONNECTORS[cid].SCHEMAS:
+            return cid
+    return None
+
+
+def _module_for_table(table: str):
+    cid = resolve_table(table)
+    if cid is None:
+        raise KeyError(f"unknown table {table!r}")
+    return _CONNECTORS[cid]
+
+
+# ---------------------------------------------------------------------------
+# dispatching mirrors of the connector API (by table name; the two built-in
+# catalogs agree on `customer`'s generator module only via resolve order, so
+# engine code that may see either passes the connector id explicitly where
+# it has one — the lazy-column tag and TableHandle carry it)
+# ---------------------------------------------------------------------------
+
+def schema(table: str, connector_id: Optional[str] = None):
+    m = _CONNECTORS[connector_id] if connector_id else _module_for_table(table)
+    return m.SCHEMAS[table]
+
+def prefix(table: str, connector_id: Optional[str] = None) -> str:
+    m = _CONNECTORS[connector_id] if connector_id else _module_for_table(table)
+    return m.PREFIXES[table]
+
+def column_type(table: str, column: str, connector_id: Optional[str] = None):
+    m = _CONNECTORS[connector_id] if connector_id else _module_for_table(table)
+    return m.column_type(table, column)
+
+def table_row_count(table: str, sf: float,
+                    connector_id: Optional[str] = None) -> int:
+    m = _CONNECTORS[connector_id] if connector_id else _module_for_table(table)
+    return m.table_row_count(table, sf)
+
+def generate_column(table: str, column: str, sf: float, start: int,
+                    count: int, connector_id: Optional[str] = None):
+    m = _CONNECTORS[connector_id] if connector_id else _module_for_table(table)
+    return m.generate_column(table, column, sf, start, count)
+
+def generate_values_at(table: str, column: str, sf: float, ids,
+                       connector_id: Optional[str] = None) -> list:
+    m = _CONNECTORS[connector_id] if connector_id else _module_for_table(table)
+    return m.generate_values_at(table, column, sf, ids)
+
+
+# ---------------------------------------------------------------------------
+# splits (reference ConnectorSplitManager / TpchSplitManager)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class TableSplit:
+    """A row-range shard of one generated table."""
+    connector: str
+    table: str
+    sf: float
+    start: int
+    end: int
+
+    def to_dict(self):
+        return {"connectorId": self.connector, "table": self.table,
+                "sf": self.sf, "start": self.start, "end": self.end}
+
+    @staticmethod
+    def from_dict(d):
+        return TableSplit(d.get("connectorId", "tpch"), d["table"], d["sf"],
+                          d["start"], d["end"])
+
+
+def make_splits(table: str, sf: float, splits: int,
+                connector_id: Optional[str] = None) -> List[TableSplit]:
+    cid = connector_id or resolve_table(table)
+    total = table_row_count(table, sf, cid)
+    per = (total + splits - 1) // splits
+    return [TableSplit(cid, table, sf, i * per, min((i + 1) * per, total))
+            for i in range(splits) if i * per < total]

@@ -1,0 +1,574 @@
+"""TPC-DS connector: deterministic in-memory columnar data generator.
+
+The analog of the reference's presto-tpcds connector (presto-tpcds/
+src/main/java/com/facebook/presto/tpcds/TpcdsConnectorFactory.java, backed by
+the teradata dsdgen port) built on the same counter-hash scheme as the tpch
+module: every cell is a pure function of (table, column, row index, scale
+factor), so splits are stateless and workers generate their own shards.
+
+Covers the dimensional core of the TPC-DS schema (date_dim, item, customer,
+customer_address, store, web_site, warehouse, promotion) and the two biggest
+fact-table families exercised by the BASELINE queries (store_sales,
+web_sales + web_returns — TPC-DS Q95 is baseline config 5).  Row counts
+follow the spec's SF1 values scaled linearly (dimension tables fixed or
+floored); value distributions are self-consistent rather than dsdgen
+bit-exact — correctness testing is differential (TPU engine vs the numpy
+reference interpreter over identical generated data), as for tpch.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+from ..common.types import (BIGINT, DATE, INTEGER, Type, DecimalType,
+                            VarcharType)
+# hashing core shared with tpch; seeds are namespaced "tpcds.<table>" so the
+# two connectors' value streams stay independent
+from .tpch import _splitmix64, _stream_seed
+
+
+def _hash(table: str, column: str, idx: np.ndarray) -> np.ndarray:
+    seed = _stream_seed("tpcds." + table, column)
+    with np.errstate(over="ignore"):
+        return _splitmix64(idx.astype(np.uint64)
+                           * np.uint64(0x9E3779B97F4A7C15) + seed)
+
+
+def _uniform(table, column, idx, lo, hi):
+    h = _hash(table, column, idx)
+    span = np.uint64(hi - lo + 1)
+    return (h % span).astype(np.int64) + lo
+
+
+def _days(datestr: str) -> int:
+    return int(np.datetime64(datestr, "D").astype(np.int64))
+
+
+# d_date_sk convention: Julian day number, 2415022 == 1900-01-02 (spec);
+# date_dim row i is calendar day 1900-01-02 + i
+JULIAN_BASE = 2415022
+EPOCH_1900 = _days("1900-01-02")          # days since unix epoch (negative)
+DATE_DIM_ROWS = 73049                     # 1900-01-02 .. 2100-01-01
+
+# fact sales window (spec: 5 years ending 2003-01-02)
+SALES_MIN = _days("1998-01-02") - EPOCH_1900
+SALES_MAX = _days("2002-11-02") - EPOCH_1900
+
+STATES = ["AL", "CA", "CO", "FL", "GA", "IA", "IL", "IN", "KS", "KY", "LA",
+          "MI", "MN", "MO", "NC", "ND", "NE", "NY", "OH", "OK", "PA", "SD",
+          "TN", "TX", "VA"]
+CITIES = [f"{a} {b}" for a in ("Pleasant", "Oak", "Spring", "Center",
+                               "Fair", "Green", "Union", "Walnut", "Cedar",
+                               "Liberty")
+          for b in ("Hill", "Grove", "Valley", "Ridge", "Creek", "Point")]
+COUNTIES = [f"{c} County" for c in ("Williamson", "Walker", "Barrow",
+                                    "Franklin", "Bronx", "Orange", "Jackson",
+                                    "Mobile", "Salem", "Ziebach")]
+DAY_NAMES = ["Sunday", "Monday", "Tuesday", "Wednesday", "Thursday",
+             "Friday", "Saturday"]
+CATEGORIES = ["Books", "Children", "Electronics", "Home", "Jewelry", "Men",
+              "Music", "Shoes", "Sports", "Women"]
+CLASSES = [f"{c} class {i}" for c in ("value", "economy", "standard",
+                                      "premium", "luxury") for i in range(1, 5)]
+COLORS = ["almond", "azure", "beige", "black", "blue", "brown", "coral",
+          "cream", "cyan", "gold", "green", "grey", "indigo", "ivory",
+          "khaki", "lime", "maroon", "navy", "olive", "orange", "peach",
+          "pink", "plum", "purple", "red"]
+BRANDS = [f"{m}brand #{i}" for m in ("amalg", "edu pack", "expo", "scholar",
+                                     "import", "corp", "brand", "univ",
+                                     "name", "max")
+          for i in range(1, 11)]
+FIRST_NAMES = ["James", "John", "Robert", "Michael", "William", "David",
+               "Mary", "Patricia", "Linda", "Barbara", "Elizabeth", "Susan",
+               "Jose", "Carlos", "Anna", "Laura", "Kevin", "Brian", "Sarah",
+               "Emily", "Daniel", "Matthew", "Nancy", "Karen", "Paul"]
+LAST_NAMES = ["Smith", "Johnson", "Williams", "Brown", "Jones", "Garcia",
+              "Miller", "Davis", "Rodriguez", "Martinez", "Hernandez",
+              "Lopez", "Gonzalez", "Wilson", "Anderson", "Thomas", "Taylor",
+              "Moore", "Jackson", "Martin", "Lee", "Perez", "Thompson",
+              "White", "Harris"]
+COMPANY_NAMES = ["pri", "able", "ought", "ation", "eing", "bar"]
+WAREHOUSE_NAMES = ["Conventional childr", "Important issues liv",
+                   "Doors canno", "Bad cards must make.", "Rooms cook "]
+YN = ["N", "Y"]
+
+LINES_PER_ORDER = 3
+
+
+def _table_rows(table: str, sf: float) -> int:
+    fixed = {"date_dim": DATE_DIM_ROWS, "web_site": 30, "warehouse": 5,
+             "promotion": 300}
+    if table in fixed:
+        return fixed[table]
+    if table == "store":
+        return max(2, int(12 * sf))
+    base = {
+        "item": 18_000, "customer": 100_000, "customer_address": 50_000,
+        "store_sales": 2_880_000, "web_sales": 720_000,
+        "web_returns": 72_000,
+    }
+    floor = {"item": 200, "customer": 1_000, "customer_address": 500,
+             "store_sales": 10_000, "web_sales": 7_200, "web_returns": 720}
+    return max(floor[table], int(base[table] * sf))
+
+
+D7_2 = DecimalType(7, 2)
+D5_2 = DecimalType(5, 2)
+
+SCHEMAS: Dict[str, List[Tuple[str, Type]]] = {
+    "date_dim": [
+        ("d_date_sk", BIGINT), ("d_date_id", VarcharType(16)),
+        ("d_date", DATE), ("d_month_seq", INTEGER), ("d_week_seq", INTEGER),
+        ("d_quarter_seq", INTEGER), ("d_year", INTEGER), ("d_dow", INTEGER),
+        ("d_moy", INTEGER), ("d_dom", INTEGER), ("d_qoy", INTEGER),
+        ("d_day_name", VarcharType(9)),
+    ],
+    "item": [
+        ("i_item_sk", BIGINT), ("i_item_id", VarcharType(16)),
+        ("i_current_price", D7_2), ("i_brand_id", INTEGER),
+        ("i_brand", VarcharType(50)), ("i_class_id", INTEGER),
+        ("i_class", VarcharType(50)), ("i_category_id", INTEGER),
+        ("i_category", VarcharType(50)), ("i_manufact_id", INTEGER),
+        ("i_color", VarcharType(20)), ("i_manager_id", INTEGER),
+    ],
+    "customer": [
+        ("c_customer_sk", BIGINT), ("c_customer_id", VarcharType(16)),
+        ("c_current_addr_sk", BIGINT), ("c_first_name", VarcharType(20)),
+        ("c_last_name", VarcharType(30)), ("c_birth_year", INTEGER),
+        ("c_birth_month", INTEGER), ("c_birth_country", VarcharType(20)),
+        ("c_email_address", VarcharType(50)),
+    ],
+    "customer_address": [
+        ("ca_address_sk", BIGINT), ("ca_address_id", VarcharType(16)),
+        ("ca_city", VarcharType(60)), ("ca_county", VarcharType(30)),
+        ("ca_state", VarcharType(2)), ("ca_zip", VarcharType(10)),
+        ("ca_country", VarcharType(20)), ("ca_gmt_offset", D5_2),
+    ],
+    "store": [
+        ("s_store_sk", BIGINT), ("s_store_id", VarcharType(16)),
+        ("s_store_name", VarcharType(50)), ("s_number_employees", INTEGER),
+        ("s_floor_space", INTEGER), ("s_market_id", INTEGER),
+        ("s_state", VarcharType(2)), ("s_company_id", INTEGER),
+    ],
+    "web_site": [
+        ("web_site_sk", BIGINT), ("web_site_id", VarcharType(16)),
+        ("web_name", VarcharType(50)), ("web_company_id", INTEGER),
+        ("web_company_name", VarcharType(50)),
+    ],
+    "warehouse": [
+        ("w_warehouse_sk", BIGINT), ("w_warehouse_name", VarcharType(20)),
+        ("w_warehouse_sq_ft", INTEGER), ("w_state", VarcharType(2)),
+    ],
+    "promotion": [
+        ("p_promo_sk", BIGINT), ("p_promo_id", VarcharType(16)),
+        ("p_channel_dmail", VarcharType(1)), ("p_channel_email", VarcharType(1)),
+        ("p_channel_tv", VarcharType(1)),
+    ],
+    "store_sales": [
+        ("ss_sold_date_sk", BIGINT), ("ss_item_sk", BIGINT),
+        ("ss_customer_sk", BIGINT), ("ss_store_sk", BIGINT),
+        ("ss_promo_sk", BIGINT), ("ss_ticket_number", BIGINT),
+        ("ss_quantity", INTEGER), ("ss_wholesale_cost", D7_2),
+        ("ss_list_price", D7_2), ("ss_sales_price", D7_2),
+        ("ss_ext_discount_amt", D7_2), ("ss_ext_sales_price", D7_2),
+        ("ss_net_paid", D7_2), ("ss_net_profit", D7_2),
+    ],
+    "web_sales": [
+        ("ws_sold_date_sk", BIGINT), ("ws_ship_date_sk", BIGINT),
+        ("ws_item_sk", BIGINT), ("ws_bill_customer_sk", BIGINT),
+        ("ws_ship_addr_sk", BIGINT), ("ws_web_site_sk", BIGINT),
+        ("ws_warehouse_sk", BIGINT), ("ws_promo_sk", BIGINT),
+        ("ws_order_number", BIGINT), ("ws_quantity", INTEGER),
+        ("ws_sales_price", D7_2), ("ws_ext_sales_price", D7_2),
+        ("ws_ext_ship_cost", D7_2), ("ws_net_paid", D7_2),
+        ("ws_net_profit", D7_2),
+    ],
+    "web_returns": [
+        ("wr_returned_date_sk", BIGINT), ("wr_item_sk", BIGINT),
+        ("wr_refunded_customer_sk", BIGINT), ("wr_order_number", BIGINT),
+        ("wr_return_quantity", INTEGER), ("wr_return_amt", D7_2),
+        ("wr_net_loss", D7_2),
+    ],
+}
+
+# every table already carries its spec prefix in the column names
+PREFIXES: Dict[str, str] = {t: "" for t in SCHEMAS}
+
+
+def column_type(table: str, column: str) -> Type:
+    for name, typ in SCHEMAS[table]:
+        if name == column:
+            return typ
+    raise KeyError(f"{table}.{column}")
+
+
+# open-domain (late-materialized) string columns, and which of them have
+# row-id-compatible order / identity (see tpch.py for the rules)
+OPEN_DOMAIN = {
+    ("item", "i_item_id"), ("customer", "c_customer_id"),
+    ("customer", "c_email_address"), ("customer_address", "ca_address_id"),
+    ("customer_address", "ca_zip"), ("store", "s_store_id"),
+    ("web_site", "web_site_id"), ("promotion", "p_promo_id"),
+}
+ROWID_ORDERED = {
+    ("item", "i_item_id"), ("customer", "c_customer_id"),
+    ("customer_address", "ca_address_id"), ("store", "s_store_id"),
+    ("web_site", "web_site_id"), ("promotion", "p_promo_id"),
+}
+ROWID_DISTINCT = {
+    ("item", "i_item_id"), ("customer", "c_customer_id"),
+    ("customer", "c_email_address"), ("customer_address", "ca_address_id"),
+    ("store", "s_store_id"), ("web_site", "web_site_id"),
+    ("promotion", "p_promo_id"),
+}
+
+
+# ---------------------------------------------------------------------------
+# per-table generators (same contract as tpch: numeric ndarray, or
+# (codes, values) dictionary, or list[str] for OPEN_DOMAIN columns)
+# ---------------------------------------------------------------------------
+
+def _gen_date_dim(column: str, idx: np.ndarray, sf: float):
+    days = EPOCH_1900 + idx                       # days since unix epoch
+    dt = days.astype("datetime64[D]")
+    if column == "d_date_sk":
+        return JULIAN_BASE + idx
+    if column == "d_date_id":
+        return [f"AAAAAAAA{int(v):08d}" for v in JULIAN_BASE + idx]
+    if column == "d_date":
+        return days
+    if column == "d_year":
+        return dt.astype("datetime64[Y]").astype(np.int64) + 1970
+    if column == "d_moy":
+        return (dt.astype("datetime64[M]")
+                - dt.astype("datetime64[Y]")).astype(np.int64) + 1
+    if column == "d_dom":
+        return (dt - dt.astype("datetime64[M]")).astype(np.int64) + 1
+    if column == "d_qoy":
+        moy = _gen_date_dim("d_moy", idx, sf)
+        return (moy - 1) // 3 + 1
+    if column == "d_dow":
+        return (days + 4) % 7                     # 1970-01-01 was a Thursday
+    if column == "d_day_name":
+        return (((days + 4) % 7).astype(np.int32), DAY_NAMES)
+    if column == "d_month_seq":
+        y = _gen_date_dim("d_year", idx, sf)
+        m = _gen_date_dim("d_moy", idx, sf)
+        return (y - 1900) * 12 + m - 1
+    if column == "d_week_seq":
+        return idx // 7 + 1
+    if column == "d_quarter_seq":
+        y = _gen_date_dim("d_year", idx, sf)
+        q = _gen_date_dim("d_qoy", idx, sf)
+        return (y - 1900) * 4 + q - 1
+    raise KeyError(column)
+
+
+def _gen_item(column: str, idx: np.ndarray, sf: float):
+    sk = idx + 1
+    if column == "i_item_sk":
+        return sk
+    if column == "i_item_id":
+        return [f"AAAAAAAA{int(v):08d}" for v in sk]
+    if column == "i_current_price":
+        return _uniform("item", "price", idx, 99, 9999)
+    if column == "i_brand_id":
+        return _uniform("item", "brand", idx, 0, len(BRANDS) - 1) + 1001
+    if column == "i_brand":
+        return (_uniform("item", "brand", idx, 0,
+                         len(BRANDS) - 1).astype(np.int32), BRANDS)
+    if column == "i_class_id":
+        return _uniform("item", "class", idx, 0, len(CLASSES) - 1) + 1
+    if column == "i_class":
+        return (_uniform("item", "class", idx, 0,
+                         len(CLASSES) - 1).astype(np.int32), CLASSES)
+    if column == "i_category_id":
+        return _uniform("item", "category", idx, 0, len(CATEGORIES) - 1) + 1
+    if column == "i_category":
+        return (_uniform("item", "category", idx, 0,
+                         len(CATEGORIES) - 1).astype(np.int32), CATEGORIES)
+    if column == "i_manufact_id":
+        return _uniform("item", "manufact", idx, 1, 1000)
+    if column == "i_color":
+        return (_uniform("item", "color", idx, 0,
+                         len(COLORS) - 1).astype(np.int32), COLORS)
+    if column == "i_manager_id":
+        return _uniform("item", "manager", idx, 1, 100)
+    raise KeyError(column)
+
+
+def _gen_customer(column: str, idx: np.ndarray, sf: float):
+    sk = idx + 1
+    if column == "c_customer_sk":
+        return sk
+    if column == "c_customer_id":
+        return [f"AAAAAAAA{int(v):08d}" for v in sk]
+    if column == "c_current_addr_sk":
+        return _uniform("customer", "addr", idx, 1,
+                        _table_rows("customer_address", sf))
+    if column == "c_first_name":
+        return (_uniform("customer", "first", idx, 0,
+                         len(FIRST_NAMES) - 1).astype(np.int32), FIRST_NAMES)
+    if column == "c_last_name":
+        return (_uniform("customer", "last", idx, 0,
+                         len(LAST_NAMES) - 1).astype(np.int32), LAST_NAMES)
+    if column == "c_birth_year":
+        return _uniform("customer", "byear", idx, 1924, 1992)
+    if column == "c_birth_month":
+        return _uniform("customer", "bmonth", idx, 1, 12)
+    if column == "c_birth_country":
+        return (_uniform("customer", "bcountry", idx, 0, 4).astype(np.int32),
+                ["UNITED STATES", "CANADA", "MEXICO", "GERMANY", "JAPAN"])
+    if column == "c_email_address":
+        h = _hash("customer", "email", idx)
+        return [f"user{int(v):016x}@example.com" for v in h]
+    raise KeyError(column)
+
+
+def _gen_customer_address(column: str, idx: np.ndarray, sf: float):
+    sk = idx + 1
+    if column == "ca_address_sk":
+        return sk
+    if column == "ca_address_id":
+        return [f"AAAAAAAA{int(v):08d}" for v in sk]
+    if column == "ca_city":
+        return (_uniform("customer_address", "city", idx, 0,
+                         len(CITIES) - 1).astype(np.int32), CITIES)
+    if column == "ca_county":
+        return (_uniform("customer_address", "county", idx, 0,
+                         len(COUNTIES) - 1).astype(np.int32), COUNTIES)
+    if column == "ca_state":
+        return (_uniform("customer_address", "state", idx, 0,
+                         len(STATES) - 1).astype(np.int32), STATES)
+    if column == "ca_zip":
+        z = _uniform("customer_address", "zip", idx, 10000, 99999)
+        return [f"{int(v):05d}" for v in z]
+    if column == "ca_country":
+        return (np.zeros(len(idx), dtype=np.int32), ["United States"])
+    if column == "ca_gmt_offset":
+        return -100 * _uniform("customer_address", "gmt", idx, 5, 8)
+    raise KeyError(column)
+
+
+def _gen_store(column: str, idx: np.ndarray, sf: float):
+    sk = idx + 1
+    if column == "s_store_sk":
+        return sk
+    if column == "s_store_id":
+        return [f"AAAAAAAA{int(v):08d}" for v in sk]
+    if column == "s_store_name":
+        return (_uniform("store", "name", idx, 0, 9).astype(np.int32),
+                ["ought", "able", "pri", "ese", "anti", "cally", "ation",
+                 "eing", "n st", "bar"])
+    if column == "s_number_employees":
+        return _uniform("store", "employees", idx, 200, 300)
+    if column == "s_floor_space":
+        return _uniform("store", "floor", idx, 5_000_000, 10_000_000)
+    if column == "s_market_id":
+        return _uniform("store", "market", idx, 1, 10)
+    if column == "s_state":
+        return (_uniform("store", "state", idx, 0,
+                         len(STATES) - 1).astype(np.int32), STATES)
+    if column == "s_company_id":
+        return np.ones(len(idx), dtype=np.int64)
+    raise KeyError(column)
+
+
+def _gen_web_site(column: str, idx: np.ndarray, sf: float):
+    sk = idx + 1
+    if column == "web_site_sk":
+        return sk
+    if column == "web_site_id":
+        return [f"AAAAAAAA{int(v):08d}" for v in sk]
+    if column == "web_name":
+        return ((idx % 15).astype(np.int32),
+                [f"site_{i}" for i in range(15)])
+    if column == "web_company_id":
+        return idx % 6 + 1
+    if column == "web_company_name":
+        return ((idx % 6).astype(np.int32), COMPANY_NAMES)
+    raise KeyError(column)
+
+
+def _gen_warehouse(column: str, idx: np.ndarray, sf: float):
+    sk = idx + 1
+    if column == "w_warehouse_sk":
+        return sk
+    if column == "w_warehouse_name":
+        return ((idx % 5).astype(np.int32), WAREHOUSE_NAMES)
+    if column == "w_warehouse_sq_ft":
+        return _uniform("warehouse", "sqft", idx, 50_000, 1_000_000)
+    if column == "w_state":
+        return ((idx % len(STATES)).astype(np.int32), STATES)
+    raise KeyError(column)
+
+
+def _gen_promotion(column: str, idx: np.ndarray, sf: float):
+    sk = idx + 1
+    if column == "p_promo_sk":
+        return sk
+    if column == "p_promo_id":
+        return [f"AAAAAAAA{int(v):08d}" for v in sk]
+    if column in ("p_channel_dmail", "p_channel_email", "p_channel_tv"):
+        return (_uniform("promotion", column, idx, 0, 1).astype(np.int32), YN)
+    raise KeyError(column)
+
+
+def _date_sk_from_offset(off: np.ndarray) -> np.ndarray:
+    """days-since-1900 offset -> d_date_sk (date_dim row i == offset i)."""
+    return JULIAN_BASE + off
+
+
+def _gen_store_sales(column: str, idx: np.ndarray, sf: float):
+    if column == "ss_sold_date_sk":
+        return _date_sk_from_offset(
+            _uniform("store_sales", "sold", idx // LINES_PER_ORDER,
+                     SALES_MIN, SALES_MAX))
+    if column == "ss_item_sk":
+        return _uniform("store_sales", "item", idx, 1, _table_rows("item", sf))
+    if column == "ss_customer_sk":
+        return _uniform("store_sales", "cust", idx // LINES_PER_ORDER, 1,
+                        _table_rows("customer", sf))
+    if column == "ss_store_sk":
+        return _uniform("store_sales", "store", idx // LINES_PER_ORDER, 1,
+                        _table_rows("store", sf))
+    if column == "ss_promo_sk":
+        return _uniform("store_sales", "promo", idx, 1,
+                        _table_rows("promotion", sf))
+    if column == "ss_ticket_number":
+        return idx // LINES_PER_ORDER + 1
+    if column == "ss_quantity":
+        return _uniform("store_sales", "qty", idx, 1, 100)
+    if column == "ss_wholesale_cost":
+        return _uniform("store_sales", "wholesale", idx, 100, 10000)
+    if column == "ss_list_price":
+        w = _gen_store_sales("ss_wholesale_cost", idx, sf)
+        return w + w * _uniform("store_sales", "markup", idx, 0, 200) // 100
+    if column == "ss_sales_price":
+        lp = _gen_store_sales("ss_list_price", idx, sf)
+        return lp * _uniform("store_sales", "dscnt", idx, 20, 100) // 100
+    if column == "ss_ext_sales_price":
+        return (_gen_store_sales("ss_sales_price", idx, sf)
+                * _gen_store_sales("ss_quantity", idx, sf))
+    if column == "ss_ext_discount_amt":
+        lp = _gen_store_sales("ss_list_price", idx, sf)
+        sp = _gen_store_sales("ss_sales_price", idx, sf)
+        return (lp - sp) * _gen_store_sales("ss_quantity", idx, sf)
+    if column == "ss_net_paid":
+        return _gen_store_sales("ss_ext_sales_price", idx, sf)
+    if column == "ss_net_profit":
+        q = _gen_store_sales("ss_quantity", idx, sf)
+        w = _gen_store_sales("ss_wholesale_cost", idx, sf)
+        return _gen_store_sales("ss_net_paid", idx, sf) - q * w
+    raise KeyError(column)
+
+
+def _gen_web_sales(column: str, idx: np.ndarray, sf: float):
+    order = idx // LINES_PER_ORDER
+    if column == "ws_sold_date_sk":
+        return _date_sk_from_offset(
+            _uniform("web_sales", "sold", order, SALES_MIN, SALES_MAX))
+    if column == "ws_ship_date_sk":
+        sold = _uniform("web_sales", "sold", order, SALES_MIN, SALES_MAX)
+        return _date_sk_from_offset(
+            sold + _uniform("web_sales", "lag", idx, 1, 120))
+    if column == "ws_item_sk":
+        return _uniform("web_sales", "item", idx, 1, _table_rows("item", sf))
+    if column == "ws_bill_customer_sk":
+        return _uniform("web_sales", "cust", order, 1,
+                        _table_rows("customer", sf))
+    if column == "ws_ship_addr_sk":
+        return _uniform("web_sales", "addr", order, 1,
+                        _table_rows("customer_address", sf))
+    if column == "ws_web_site_sk":
+        return _uniform("web_sales", "site", order, 1,
+                        _table_rows("web_site", sf))
+    if column == "ws_warehouse_sk":
+        return _uniform("web_sales", "wh", idx, 1,
+                        _table_rows("warehouse", sf))
+    if column == "ws_promo_sk":
+        return _uniform("web_sales", "promo", idx, 1,
+                        _table_rows("promotion", sf))
+    if column == "ws_order_number":
+        return order + 1
+    if column == "ws_quantity":
+        return _uniform("web_sales", "qty", idx, 1, 100)
+    if column == "ws_sales_price":
+        return _uniform("web_sales", "price", idx, 100, 30000)
+    if column == "ws_ext_sales_price":
+        return (_gen_web_sales("ws_sales_price", idx, sf)
+                * _gen_web_sales("ws_quantity", idx, sf))
+    if column == "ws_ext_ship_cost":
+        return _uniform("web_sales", "shipcost", idx, 0, 50000)
+    if column == "ws_net_paid":
+        return _gen_web_sales("ws_ext_sales_price", idx, sf)
+    if column == "ws_net_profit":
+        return (_gen_web_sales("ws_net_paid", idx, sf)
+                - _uniform("web_sales", "cost", idx, 50, 40000)
+                * _gen_web_sales("ws_quantity", idx, sf))
+    raise KeyError(column)
+
+
+def _gen_web_returns(column: str, idx: np.ndarray, sf: float):
+    n_orders = _table_rows("web_sales", sf) // LINES_PER_ORDER
+    if column == "wr_order_number":
+        return _uniform("web_returns", "order", idx, 1, max(1, n_orders))
+    if column == "wr_returned_date_sk":
+        return _date_sk_from_offset(
+            _uniform("web_returns", "ret", idx, SALES_MIN, SALES_MAX + 60))
+    if column == "wr_item_sk":
+        return _uniform("web_returns", "item", idx, 1,
+                        _table_rows("item", sf))
+    if column == "wr_refunded_customer_sk":
+        return _uniform("web_returns", "cust", idx, 1,
+                        _table_rows("customer", sf))
+    if column == "wr_return_quantity":
+        return _uniform("web_returns", "qty", idx, 1, 50)
+    if column == "wr_return_amt":
+        return _uniform("web_returns", "amt", idx, 100, 500000)
+    if column == "wr_net_loss":
+        return _uniform("web_returns", "loss", idx, 50, 100000)
+    raise KeyError(column)
+
+
+_GENERATORS = {
+    "date_dim": _gen_date_dim, "item": _gen_item, "customer": _gen_customer,
+    "customer_address": _gen_customer_address, "store": _gen_store,
+    "web_site": _gen_web_site, "warehouse": _gen_warehouse,
+    "promotion": _gen_promotion, "store_sales": _gen_store_sales,
+    "web_sales": _gen_web_sales, "web_returns": _gen_web_returns,
+}
+
+
+# ---------------------------------------------------------------------------
+# public connector API (same shape as tpch's)
+# ---------------------------------------------------------------------------
+
+def table_row_count(table: str, sf: float) -> int:
+    return _table_rows(table, sf)
+
+
+def generate_column(table: str, column: str, sf: float,
+                    start: int, count: int):
+    idx = np.arange(start, start + count, dtype=np.int64)
+    return _GENERATORS[table](column, idx, sf)
+
+
+def generate_values_at(table: str, column: str, sf: float,
+                       ids: np.ndarray) -> list:
+    out = _GENERATORS[table](column, np.asarray(ids, dtype=np.int64), sf)
+    if isinstance(out, tuple):
+        codes, values = out
+        return [values[int(c)] for c in codes]
+    return out
+
+
+def _connector_stats(handle) -> float:
+    sf = dict(handle.extra).get("scaleFactor", 0.01)
+    return float(table_row_count(handle.table_name, sf))
+
+
+from ..sql.fragmenter import register_connector_stats as _reg_stats  # noqa: E402
+
+_reg_stats("tpcds", _connector_stats)

@@ -194,6 +194,13 @@ SCHEMAS: Dict[str, List[Tuple[str, Type]]] = {
 }
 
 
+# query-text column prefix per table (canonical l_quantity -> quantity)
+PREFIXES: Dict[str, str] = {
+    "lineitem": "l_", "orders": "o_", "customer": "c_", "part": "p_",
+    "partsupp": "ps_", "supplier": "s_", "nation": "n_", "region": "r_",
+}
+
+
 def column_type(table: str, column: str) -> Type:
     for name, typ in SCHEMAS[table]:
         if name == column:

@@ -223,9 +223,10 @@ def batch_to_page(batch: Batch, names, types) -> Page:
         values = np.asarray(col.values)[keep]
         nulls = None if col.nulls is None else np.asarray(col.nulls)[keep]
         if col.lazy is not None:
-            from ..connectors import tpch as _tpch
-            _, table, column, sf = col.lazy
-            strings = _tpch.generate_values_at(table, column, sf, values)
+            from ..connectors import catalog as _catalog
+            cid, table, column, sf = col.lazy
+            strings = _catalog.generate_values_at(table, column, sf, values,
+                                                  cid)
             if nulls is not None:
                 strings = [None if n else s for s, n in zip(strings, nulls)]
             from ..common.block import VariableWidthBlock as VB

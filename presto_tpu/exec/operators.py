@@ -456,9 +456,9 @@ def sort_indices(batch: Batch, keys: List[Tuple[str, str]]):
         v = col.values
         desc = order.startswith("DESC")
         if col.lazy is not None:
-            from ..connectors import tpch as _tpch
+            from ..connectors import catalog as _catalog
             _, table, column, _sf = col.lazy
-            if (table, column) not in _tpch.ROWID_ORDERED:
+            if (table, column) not in _catalog.ROWID_ORDERED:
                 raise NotImplementedError(
                     "ORDER BY on a late-materialized string column")
             # values are row ids; generator guarantees id order == lex order
@@ -495,6 +495,167 @@ def sort_batch(batch: Batch, keys: List[Tuple[str, str]]) -> Batch:
     perm = sort_indices(batch, keys)
     cols = {name: c.gather(perm) for name, c in batch.columns.items()}
     return Batch(cols, batch.mask[perm])
+
+
+# ---------------------------------------------------------------------------
+# window functions
+# (reference: presto-main-base/.../operator/WindowOperator.java:69; default
+#  frame RANGE UNBOUNDED PRECEDING .. CURRENT ROW, i.e. running aggregates
+#  include the current row's full peer group)
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class WindowSpec:
+    """One window function over the node's shared (partition, order) spec."""
+    name: str            # row_number|rank|dense_rank|sum|count|count_star|min|max|avg
+    output: str
+    arg: Optional[str] = None   # input column (None for ranking / count(*))
+    is_float: bool = False      # float accumulation (vs int64 / decimal)
+
+
+def _row_change(col: Column) -> jnp.ndarray:
+    """[i] = row i differs from row i-1 (null-aware: two NULLs are equal,
+    NaN equals NaN — grouping semantics, not comparison semantics)."""
+    v = col.values
+    a, b = v[1:], v[:-1]
+    if jnp.issubdtype(v.dtype, jnp.floating):
+        eq = (a == b) | (jnp.isnan(a) & jnp.isnan(b))
+    else:
+        eq = a == b
+    if col.nulls is not None:
+        na, nb = col.nulls[1:], col.nulls[:-1]
+        eq = jnp.where(na | nb, na & nb, eq)
+    return jnp.concatenate([jnp.ones(1, dtype=bool), ~eq])
+
+
+def window_batch(batch: Batch, partition_names: Tuple[str, ...],
+                 orderings: Tuple[Tuple[str, str], ...],
+                 specs: Tuple[WindowSpec, ...]) -> Batch:
+    """Evaluate all window functions sharing one (partition, order) spec.
+
+    Sorts the whole batch by (partition keys, order keys) — padding rows
+    last, forming their own segment — then computes every function with
+    segmented prefix scans: no per-partition loop, so partition count and
+    sizes stay out of the compiled shape.  Output row order is the sorted
+    order (SQL does not guarantee WindowNode output order)."""
+    sort_keys = [(p, "ASC_NULLS_FIRST") for p in partition_names] + list(orderings)
+    perm = sort_indices(batch, sort_keys)   # [] keys still sorts padding last
+    cols = {n: c.gather(perm) for n, c in batch.columns.items()}
+    mask = batch.mask[perm]
+
+    n = batch.capacity
+    idx = jnp.arange(n, dtype=jnp.int64)
+
+    part_start = jnp.zeros(n, dtype=bool).at[0].set(True)
+    # the valid->padding transition starts a segment so padding never joins
+    # (or extends the frame of) the last real partition
+    part_start = part_start | jnp.concatenate(
+        [jnp.zeros(1, dtype=bool), mask[1:] != mask[:-1]])
+    for p in partition_names:
+        part_start = part_start | _row_change(cols[p])
+    peer_start = part_start
+    for o, _ in orderings:
+        peer_start = peer_start | _row_change(cols[o])
+
+    seg_start = jax.lax.cummax(jnp.where(part_start, idx, 0))
+    peer_start_idx = jax.lax.cummax(jnp.where(peer_start, idx, 0))
+    # frame end = last row of the current peer group: one before the next
+    # peer-group start (suffix-min of start indices, shifted left)
+    at_or_after = jnp.flip(jax.lax.cummin(jnp.flip(
+        jnp.where(peer_start, idx, n))))
+    peer_end = jnp.concatenate(
+        [at_or_after[1:], jnp.full(1, n, dtype=jnp.int64)]) - 1
+
+    out = dict(cols)
+    for spec in specs:
+        if spec.name == "row_number":
+            out[spec.output] = Column(idx - seg_start + 1, None)
+            continue
+        if spec.name == "rank":
+            out[spec.output] = Column(peer_start_idx - seg_start + 1, None)
+            continue
+        if spec.name == "dense_rank":
+            cp = jnp.cumsum(peer_start.astype(jnp.int64))
+            out[spec.output] = Column(cp - cp[seg_start] + 1, None)
+            continue
+
+        # frame aggregate over rows [seg_start .. peer_end]
+        if spec.name == "count_star":
+            contrib = mask
+            x = contrib.astype(jnp.int64)
+        else:
+            c = cols[spec.arg]
+            contrib = mask if c.nulls is None else (mask & ~c.nulls)
+            x = c.values
+        cnt0 = jnp.concatenate([jnp.zeros(1, dtype=jnp.int64),
+                                jnp.cumsum(contrib.astype(jnp.int64))])
+        frame_cnt = cnt0[peer_end + 1] - cnt0[seg_start]
+        if spec.name in ("count", "count_star"):
+            out[spec.output] = Column(frame_cnt, None)
+        elif spec.name in ("sum", "avg"):
+            dt = jnp.float64 if spec.is_float else jnp.int64
+            xv = jnp.where(contrib, x, 0).astype(dt)
+            ps0 = jnp.concatenate([jnp.zeros(1, dtype=dt), jnp.cumsum(xv)])
+            frame_sum = ps0[peer_end + 1] - ps0[seg_start]
+            empty = frame_cnt == 0       # SQL: aggregate of no rows is NULL
+            safe = jnp.where(empty, 1, frame_cnt)
+            if spec.name == "sum":
+                out[spec.output] = Column(frame_sum, empty)
+            elif spec.is_float:
+                out[spec.output] = Column(frame_sum / safe, empty)
+            else:
+                # decimal avg: round-half-up integer division at same scale
+                q = jnp.sign(frame_sum) * ((jnp.abs(frame_sum) + safe // 2)
+                                           // safe)
+                out[spec.output] = Column(q.astype(jnp.int64), empty)
+        elif spec.name in ("min", "max"):
+            is_min = spec.name == "min"
+            was_bool = x.dtype == jnp.bool_
+            col = cols[spec.arg]
+            # string columns: dictionary codes compare by LEXICAL rank, not
+            # code value; min/max over lazy row ids is valid only for
+            # ROWID_ORDERED columns (the compiler encodes others first)
+            code_of_rank = None
+            if col.dictionary is not None:
+                d = np.array(col.dictionary)
+                rank_of_code = np.argsort(np.argsort(d)).astype(np.int64)
+                code_of_rank = jnp.asarray(np.argsort(rank_of_code))
+                x = jnp.asarray(rank_of_code)[x]
+            if was_bool:
+                x = x.astype(jnp.int8)
+            if jnp.issubdtype(x.dtype, jnp.floating):
+                ident = jnp.array(jnp.inf if is_min else -jnp.inf, x.dtype)
+            else:
+                ident = jnp.array(jnp.iinfo(x.dtype).max if is_min
+                                  else jnp.iinfo(x.dtype).min, x.dtype)
+            xv = jnp.where(contrib, x, ident)
+
+            def comb(a, b, _min=is_min):
+                fa, va = a
+                fb, vb = b
+                m = jnp.minimum(va, vb) if _min else jnp.maximum(va, vb)
+                return (fa | fb, jnp.where(fb, vb, m))
+
+            # segmented running min/max (reset at partition starts), read
+            # at the frame end to include the current peer group
+            _, run = jax.lax.associative_scan(comb, (part_start, xv))
+            vals = run[peer_end]
+            empty = frame_cnt == 0
+            if was_bool:
+                vals = vals.astype(jnp.bool_)
+            if col.dictionary is not None:
+                # rank -> code; empty frames hold the identity sentinel,
+                # clamp before the gather (result is NULL there anyway)
+                vals = code_of_rank[jnp.where(empty, 0, vals)]
+                out[spec.output] = Column(vals, empty, col.dictionary)
+            elif col.lazy is not None:
+                vals = jnp.where(empty, 0, vals)
+                out[spec.output] = Column(vals, empty, None, col.lazy)
+            else:
+                out[spec.output] = Column(vals, empty)
+        else:
+            raise NotImplementedError(f"window function {spec.name}")
+    return Batch(out, mask)
 
 
 def limit(batch: Batch, n: int, already_consumed) -> Tuple[Batch, jnp.ndarray]:

@@ -31,7 +31,7 @@ import numpy as np
 from ..common.page import Page
 from ..common.types import (BIGINT, BOOLEAN, DOUBLE, DecimalType, DoubleType,
                             RealType, Type, VarcharType, CharType)
-from ..connectors import tpch
+from ..connectors import catalog, tpch
 from ..spi.expr import (CallExpression, RowExpression,
                         VariableReferenceExpression)
 from ..spi import plan as P
@@ -123,8 +123,9 @@ class PlanCompiler:
         sf = dict(th.extra).get("scaleFactor", 0.01)
         splits = self.ctx.splits.get(node.id)
         if splits is None:
-            splits = tpch.make_splits(th.table_name, sf,
-                                      self.ctx.config.splits_per_scan)
+            splits = catalog.make_splits(th.table_name, sf,
+                                         self.ctx.config.splits_per_scan,
+                                         th.connector_id)
         cap = self.ctx.config.batch_rows
         table = th.table_name
 
@@ -135,16 +136,17 @@ class PlanCompiler:
                     n = min(cap, split.end - pos)
                     cols = {}
                     for name, colname in zip(names, columns):
-                        if (table, colname) in tpch.OPEN_DOMAIN:
+                        if (table, colname) in catalog.OPEN_DOMAIN:
                             # late-materialized: row ids on device
                             ids = np.zeros(cap, dtype=np.int64)
                             ids[:n] = np.arange(pos, pos + n)
                             cols[name] = Column(
                                 jnp.asarray(ids), None, None,
-                                ("tpch", table, colname, split.sf))
+                                (split.connector, table, colname, split.sf))
                             continue
-                        raw = tpch.generate_column(table, colname, split.sf,
-                                                   pos, n)
+                        raw = catalog.generate_column(
+                            table, colname, split.sf, pos, n,
+                            split.connector)
                         if isinstance(raw, tuple):
                             codes, values = raw
                             buf = np.zeros(cap, dtype=np.int32)
@@ -154,7 +156,9 @@ class PlanCompiler:
                         else:
                             dtype = (np.int32 if raw.dtype == np.int32 or
                                      colname.endswith("date") or
-                                     tpch.column_type(table, colname).storage
+                                     catalog.column_type(
+                                         table, colname,
+                                         split.connector).storage
                                      == "INT_ARRAY" else np.int64)
                             buf = np.zeros(cap, dtype=dtype)
                             buf[:n] = raw
@@ -324,6 +328,124 @@ class PlanCompiler:
             yield jax.jit(ops.sort_batch, static_argnums=1)(merged, tuple(keys))
         return BatchSource(gen, src.names, src.types)
 
+    def _compile_UnionNode(self, node: P.UnionNode) -> BatchSource:
+        """UNION ALL: concatenate the source streams.  Numeric/date columns
+        stream straight through; string columns must first be re-encoded to
+        one shared dictionary (downstream operators assume a batch-stable
+        dictionary per column), which makes union a materialization point
+        only when strings are involved."""
+        srcs = [self._compile(s) for s in node.inputs]
+        out_names = [v.name for v in node.outputs]
+        out_types = [v.type for v in node.outputs]
+        string_cols = [n for n, t in zip(out_names, out_types)
+                       if isinstance(t, (VarcharType, CharType))]
+
+        def gen():
+            if not string_cols:
+                for s in srcs:
+                    yield from s.batches()
+                return
+            all_b = [b for s in srcs for b in s.batches()]
+            if not all_b:
+                return
+            merged_dicts: Dict[str, list] = {n: [] for n in string_cols}
+            index: Dict[str, dict] = {n: {} for n in string_cols}
+            recoded = []
+            for b in all_b:
+                new_cols = {}
+                for n in string_cols:
+                    col = b.columns[n]
+                    md, idx = merged_dicts[n], index[n]
+                    if col.dictionary is not None:
+                        lut = np.empty(len(col.dictionary), dtype=np.int64)
+                        for i, sv in enumerate(col.dictionary):
+                            if sv not in idx:
+                                idx[sv] = len(md)
+                                md.append(sv)
+                            lut[i] = idx[sv]
+                        newv = lut[np.asarray(col.values)]
+                    elif col.lazy is not None:
+                        cid, tbl, coln, sf = col.lazy
+                        strings = catalog.generate_values_at(
+                            tbl, coln, sf, np.asarray(col.values), cid)
+                        newv = np.empty(len(strings), dtype=np.int64)
+                        for i, sv in enumerate(strings):
+                            if sv not in idx:
+                                idx[sv] = len(md)
+                                md.append(sv)
+                            newv[i] = idx[sv]
+                    else:
+                        raise NotImplementedError(
+                            f"varchar column {n} without dictionary")
+                    new_cols[n] = Column(jnp.asarray(newv), col.nulls, None)
+                recoded.append(b.with_columns(new_cols))
+            final = []
+            for b in recoded:
+                cols = {n: (Column(c.values, c.nulls,
+                                   tuple(merged_dicts[n]))
+                            if n in string_cols else c)
+                        for n, c in b.columns.items()}
+                final.append(Batch(cols, b.mask))
+            yield final[0] if len(final) == 1 \
+                else jax.jit(_concat_batches)(final)
+        return BatchSource(gen, out_names, out_types)
+
+    def _compile_WindowNode(self, node: P.WindowNode) -> BatchSource:
+        """Materialize + one jitted segmented-scan pass (operators.window_batch);
+        the reference streams partition-at-a-time (WindowOperator.java:69) but
+        a single static-shape sort+scan is the XLA-friendly formulation."""
+        src = self._compile(node.source)
+        part_names = tuple(v.name for v in node.partition_by)
+        orderings = tuple((v.name, o) for v, o in
+                          node.ordering_scheme.orderings) \
+            if node.ordering_scheme else ()
+        specs = []
+        for v, wf in node.window_functions.items():
+            fname = canonical_name(wf.call.display_name)
+            arg = None
+            if fname == "count" and not wf.call.arguments:
+                fname = "count_star"
+            elif wf.call.arguments:
+                arg = wf.call.arguments[0].name
+            is_float = isinstance(v.type, (DoubleType, RealType))
+            specs.append(ops.WindowSpec(fname, v.name, arg, is_float))
+        specs = tuple(specs)
+        out_names = src.names + [v.name for v in node.window_functions]
+        out_types = src.types + [v.type for v in node.window_functions]
+
+        def gen():
+            batches = list(src.batches())
+            if not batches:
+                return
+            merged = jax.jit(_concat_batches)(batches) \
+                if len(batches) > 1 else batches[0]
+            # late-materialized string keys: window_batch both SORTS by and
+            # compares (partition identity / peer detection) every key, so a
+            # lazy column's row ids must match the value order AND be
+            # distinct per value; otherwise encode to whole-column
+            # dictionaries on the host
+            encode = []
+            minmax_args = {s.arg for s in specs
+                           if s.name in ("min", "max") and s.arg}
+            key_cols = set(part_names) | {k for k, _ in orderings}
+            for k in sorted(key_cols | minmax_args):
+                col = merged.columns[k]
+                if col.lazy is None:
+                    continue
+                _, tbl, coln, _sf = col.lazy
+                # keys need row ids that sort like values AND are distinct
+                # per value; min/max args only need the sort property
+                ok = (tbl, coln) in catalog.ROWID_ORDERED and (
+                    k not in key_cols
+                    or (tbl, coln) in catalog.ROWID_DISTINCT)
+                if not ok:
+                    encode.append(k)
+            if encode:
+                merged = _encode_lazy_keys(merged, encode)
+            yield jax.jit(ops.window_batch, static_argnums=(1, 2, 3))(
+                merged, part_names, orderings, specs)
+        return BatchSource(gen, out_names, out_types)
+
     def _compile_DistinctLimitNode(self, node: P.DistinctLimitNode) -> BatchSource:
         agg = P.AggregationNode(node.id + ".agg", node.source, {},
                                 node.distinct_variables, P.SINGLE)
@@ -378,7 +500,7 @@ class PlanCompiler:
                         col = batch.columns[k]
                         if col.lazy is not None:
                             _, tbl, coln, _sf = col.lazy
-                            if (tbl, coln) in tpch.ROWID_DISTINCT:
+                            if (tbl, coln) in catalog.ROWID_DISTINCT:
                                 # row id IS the group identity; keep lazy tag
                                 key_lazy[k] = col.lazy
                             else:
@@ -645,19 +767,20 @@ def _rewrite_expr(e: RowExpression, table: Dict[str, RowExpression]):
 _SUBSTR_DICT_CACHE: Dict[Tuple, Tuple[str, ...]] = {}
 
 
-def _canonical_substr_dict(table: str, column: str, sf: float,
+def _canonical_substr_dict(cid: str, table: str, column: str, sf: float,
                            start: int, length) -> Tuple[str, ...]:
     """Batch-independent (whole-column) dictionary for substr over an
     open-domain column, so codes are stable across batches and sorted-rank
     ordering holds for ORDER BY / GROUP BY consumers."""
-    key = (table, column, sf, start, length)
+    key = (cid, table, column, sf, start, length)
     if key not in _SUBSTR_DICT_CACHE:
-        n = tpch.table_row_count(table, sf)
+        n = catalog.table_row_count(table, sf, cid)
         uniq = set()
         for pos in range(0, n, 1 << 18):
             cnt = min(1 << 18, n - pos)
-            strings = tpch.generate_values_at(
-                table, column, sf, np.arange(pos, pos + cnt, dtype=np.int64))
+            strings = catalog.generate_values_at(
+                table, column, sf, np.arange(pos, pos + cnt, dtype=np.int64),
+                cid)
             uniq.update(_py_substr(s, start, length) for s in strings)
         _SUBSTR_DICT_CACHE[key] = tuple(sorted(uniq))
     return _SUBSTR_DICT_CACHE[key]
@@ -674,8 +797,8 @@ def _host_string_column(call_expr: CallExpression, batch: Batch) -> Column:
     arg = call_expr.arguments[0]
     col = batch.columns[arg.name]
     ids = np.asarray(col.values)
-    _, table, column, sf = col.lazy
-    strings = tpch.generate_values_at(table, column, sf, ids)
+    cid, table, column, sf = col.lazy
+    strings = catalog.generate_values_at(table, column, sf, ids, cid)
     name = canonical_name(call_expr.display_name)
     if name == "like":
         pattern = str(call_expr.arguments[1].value)
@@ -688,7 +811,8 @@ def _host_string_column(call_expr: CallExpression, batch: Batch) -> Column:
     start = int(call_expr.arguments[1].value)
     length = (int(call_expr.arguments[2].value)
               if len(call_expr.arguments) > 2 else None)
-    cdict = _canonical_substr_dict(table, column, sf, start, length)
+    cdict = _canonical_substr_dict(cid, table, column, sf, start,
+                                   length)
     codes = native.substr_dict_encode(strings, start, length, cdict)
     if codes is None:
         index = {s: i for i, s in enumerate(cdict)}
@@ -713,10 +837,10 @@ def _encode_lazy_keys(batch: Batch, keys: List[str]) -> Batch:
     new_cols = {}
     for k in keys:
         col = batch.columns[k]
-        _, table, column, sf = col.lazy
-        cdict = _canonical_substr_dict(table, column, sf, 1, None)
-        strings = tpch.generate_values_at(
-            table, column, sf, np.asarray(col.values))
+        cid, table, column, sf = col.lazy
+        cdict = _canonical_substr_dict(cid, table, column, sf, 1, None)
+        strings = catalog.generate_values_at(
+            table, column, sf, np.asarray(col.values), cid)
         codes = native.substr_dict_encode(strings, 1, None, cdict)
         if codes is None:
             index = {s: i for i, s in enumerate(cdict)}

@@ -16,7 +16,7 @@ import numpy as np
 
 from ..common.types import (BooleanType, CharType, DateType, DecimalType,
                             DoubleType, RealType, Type, VarcharType)
-from ..connectors import tpch
+from ..connectors import catalog, tpch
 from ..spi import plan as P
 from ..spi.expr import (CallExpression, ConstantExpression, RowExpression,
                         SpecialFormExpression, VariableReferenceExpression)
@@ -89,11 +89,12 @@ def _exec(node: P.PlanNode) -> Table:
 def _exec_TableScanNode(node: P.TableScanNode) -> Table:
     th = node.table
     sf = dict(th.extra).get("scaleFactor", 0.01)
-    n = tpch.table_row_count(th.table_name, sf)
+    n = catalog.table_row_count(th.table_name, sf, th.connector_id)
     cols = {}
     for v in node.outputs:
         cname = node.assignments[v].name
-        raw = tpch.generate_column(th.table_name, cname, sf, 0, n)
+        raw = catalog.generate_column(th.table_name, cname, sf, 0, n,
+                                      th.connector_id)
         if isinstance(raw, tuple):
             codes, values = raw
             arr = np.array(values, dtype=object)[codes]
@@ -205,6 +206,142 @@ def _exec_TopNNode(node: P.TopNNode) -> Table:
     t = _exec(node.source)
     idx = np.lexsort(tuple(_sort_key_arrays(t, node.ordering_scheme.orderings)))
     return t.take(idx[:node.count])
+
+
+def _exec_UnionNode(node: P.UnionNode) -> Table:
+    tables = [_exec(s) for s in node.inputs]
+    cols: Dict[str, Col] = {}
+    for v in node.outputs:
+        n = v.name
+        vals = [t.cols[n][0] for t in tables]
+        nulls = [t.cols[n][1] for t in tables]
+        if any(x.dtype == object for x in vals):
+            vv = np.concatenate([np.asarray(x, dtype=object) for x in vals])
+        else:
+            vv = np.concatenate(vals)
+        if any(m is not None for m in nulls):
+            mm = np.concatenate([np.zeros(len(x), dtype=bool)
+                                 if m is None else m
+                                 for x, m in zip(vals, nulls)])
+        else:
+            mm = None
+        cols[n] = (vv, mm)
+    return Table(cols, sum(t.n for t in tables))
+
+
+def _exec_WindowNode(node: P.WindowNode) -> Table:
+    """Per-partition python loop (independent of the device engine's
+    segmented-scan formulation).  Default frame only: RANGE UNBOUNDED
+    PRECEDING .. CURRENT ROW — running aggregates include the whole peer
+    group of the current row."""
+    t = _exec(node.source)
+    n = t.n
+    part_vars = node.partition_by
+    orderings = list(node.ordering_scheme.orderings) \
+        if node.ordering_scheme else []
+    sort_specs = [(v, "ASC_NULLS_FIRST") for v in part_vars] + orderings
+    if sort_specs and n:
+        t = t.take(np.lexsort(tuple(_sort_key_arrays(t, sort_specs))))
+
+    def change_flags(names) -> np.ndarray:
+        d = np.zeros(n, dtype=bool)
+        if n:
+            d[0] = True
+        for name in names:
+            v, m = t.cols[name]
+            a, b = v[1:], v[:-1]
+            if v.dtype == np.float64:
+                eq = (a == b) | (np.isnan(a) & np.isnan(b))
+            else:
+                eq = np.asarray(a == b, dtype=bool)
+            if m is not None:
+                eq = np.where(m[1:] | m[:-1], m[1:] & m[:-1], eq)
+            d[1:] |= ~np.asarray(eq, dtype=bool)
+        return d
+
+    part_start = change_flags([v.name for v in part_vars])
+    peer_start = part_start | change_flags([v.name for v, _ in orderings])
+    bounds = np.append(np.flatnonzero(part_start), n)
+
+    new_cols = dict(t.cols)
+    for var, wf in node.window_functions.items():
+        fname = canonical_name(wf.call.display_name)
+        args = wf.call.arguments
+        if fname in ("row_number", "rank", "dense_rank"):
+            out = np.zeros(n, dtype=np.int64)
+            for s, e in zip(bounds[:-1], bounds[1:]):
+                if fname == "row_number":
+                    out[s:e] = np.arange(1, e - s + 1)
+                else:
+                    r = d = 0
+                    for i in range(s, e):
+                        if peer_start[i] or i == s:
+                            r = i - s + 1
+                            d += 1
+                        out[i] = r if fname == "rank" else d
+            new_cols[var.name] = (out, None)
+            continue
+
+        star = fname == "count" and not args
+        if star:
+            vals, nulls = np.ones(n, dtype=np.int64), None
+        else:
+            vals, nulls = t.cols[args[0].name]
+        notnull = np.ones(n, dtype=bool) if nulls is None else ~nulls
+        out_is_float = isinstance(wf.call.type, (DoubleType, RealType))
+        if fname == "count":
+            outv = np.zeros(n, dtype=np.int64)
+        elif fname in ("min", "max") or not out_is_float:
+            outv = np.zeros(n, dtype=vals.dtype)
+        else:
+            outv = np.zeros(n, dtype=np.float64)
+        outn = np.zeros(n, dtype=bool)
+        for s, e in zip(bounds[:-1], bounds[1:]):
+            acc_sum, acc_cnt = 0, 0
+            acc_min = acc_max = None
+            gs = s
+            while gs < e:
+                ge = gs + 1
+                while ge < e and not peer_start[ge]:
+                    ge += 1
+                for i in range(gs, ge):
+                    if star:
+                        acc_cnt += 1
+                    elif notnull[i]:
+                        x = vals[i]
+                        acc_cnt += 1
+                        if fname in ("sum", "avg"):
+                            acc_sum += x
+                        elif fname == "min":
+                            if acc_min is None or x < acc_min:
+                                acc_min = x
+                        elif fname == "max":
+                            if acc_max is None or x > acc_max:
+                                acc_max = x
+                for i in range(gs, ge):
+                    if fname == "count":
+                        outv[i] = acc_cnt
+                    elif acc_cnt == 0:
+                        outn[i] = True       # aggregate of no rows is NULL
+                    elif fname == "sum":
+                        outv[i] = acc_sum
+                    elif fname == "avg":
+                        if out_is_float:
+                            outv[i] = acc_sum / acc_cnt
+                        else:
+                            si = int(acc_sum)   # decimal: round-half-up
+                            sign = -1 if si < 0 else 1
+                            outv[i] = sign * ((abs(si) + acc_cnt // 2)
+                                              // acc_cnt)
+                    elif fname == "min":
+                        outv[i] = acc_min
+                    elif fname == "max":
+                        outv[i] = acc_max
+                    else:
+                        raise NotImplementedError(fname)
+                gs = ge
+        new_cols[var.name] = (outv, outn if outn.any() else None)
+    return Table(new_cols, n)
 
 
 def _exec_AggregationNode(node: P.AggregationNode) -> Table:

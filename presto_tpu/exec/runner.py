@@ -39,13 +39,16 @@ def pages_to_result(pages, names, types) -> "QueryResult":
 
 class LocalQueryRunner:
     def __init__(self, schema: str = "sf0.01",
-                 config: Optional[ExecutionConfig] = None):
+                 config: Optional[ExecutionConfig] = None,
+                 catalog: str = "tpch"):
         self.schema = schema
+        self.catalog = catalog
         self.config = config or ExecutionConfig(batch_rows=1 << 16,
                                                 join_out_capacity=1 << 18)
 
     def plan(self, sql: str):
-        return Planner(default_schema=self.schema).plan(sql)
+        return Planner(default_schema=self.schema,
+                       default_catalog=self.catalog).plan(sql)
 
     def execute(self, sql: str) -> QueryResult:
         output = self.plan(sql)
@@ -78,8 +81,9 @@ class DistributedQueryRunner(LocalQueryRunner):
 
     def __init__(self, schema: str = "sf0.01",
                  config: Optional[ExecutionConfig] = None,
-                 n_tasks: int = 2, broadcast_threshold: int = 600_000):
-        super().__init__(schema, config)
+                 n_tasks: int = 2, broadcast_threshold: int = 600_000,
+                 catalog: str = "tpch"):
+        super().__init__(schema, config, catalog)
         self.n_tasks = n_tasks
         self.broadcast_threshold = broadcast_threshold
 

@@ -24,7 +24,7 @@ from ..common.block import (Block, DictionaryBlock, FixedWidthBlock,
                             VariableWidthBlock, decode_to_flat)
 from ..common.page import Page
 from ..common.types import (CharType, Type, VarcharType)
-from ..connectors import tpch
+from ..connectors import catalog, tpch
 from ..spi import plan as P
 from .pipeline import ExecutionConfig, PlanCompiler, TaskContext
 
@@ -209,8 +209,8 @@ class InProcessScheduler:
                 sf = dict(th.extra).get("scaleFactor", 0.01)
                 n_splits = max(stage.n_tasks,
                                self.config.exec_config.splits_per_scan)
-                scan_splits[node.id] = tpch.make_splits(
-                    th.table_name, sf, n_splits)
+                scan_splits[node.id] = catalog.make_splits(
+                    th.table_name, sf, n_splits, th.connector_id)
 
         remote_nodes = [n for n in P.walk_plan(frag.root)
                         if isinstance(n, P.RemoteSourceNode)]

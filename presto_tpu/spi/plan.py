@@ -696,6 +696,35 @@ class WindowNode(PlanNode):
 
 @_node
 @dataclass
+class UnionNode(PlanNode):
+    """UNION ALL of N sources (reference UnionNode / SetOperationNode).
+    The planner projects every source to the same output variables, so no
+    per-source variable mapping is needed; DISTINCT and INTERSECT/EXCEPT
+    are lowered to UnionNode + aggregation (the reference's
+    ImplementIntersectAsUnion / ImplementExceptAsUnion rules)."""
+    inputs: List[PlanNode]
+    outputs: List[Variable] = field(default_factory=list)
+
+    @property
+    def sources(self):
+        return list(self.inputs)
+
+    @property
+    def output_variables(self):
+        return list(self.outputs)
+
+    def _to_dict(self):
+        return {"sources": [s.to_dict() for s in self.inputs],
+                "outputs": _vars_to_dict(self.outputs)}
+
+    @classmethod
+    def _from_dict(cls, d):
+        return cls(d["id"], [PlanNode.from_dict(s) for s in d["sources"]],
+                   _vars_from_dict(d["outputs"]))
+
+
+@_node
+@dataclass
 class UnnestNode(PlanNode):
     source: PlanNode
     replicate_variables: List[Variable]

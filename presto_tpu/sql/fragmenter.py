@@ -58,10 +58,11 @@ def register_connector_stats(connector_id: str, fn) -> None:
 
 
 def _connector_stats_fn(connector_id: str):
-    if connector_id not in CONNECTOR_STATS and connector_id == "tpch":
-        # built-in connector: load on demand so estimates don't silently
+    if connector_id not in CONNECTOR_STATS \
+            and connector_id in ("tpch", "tpcds"):
+        # built-in connectors: load on demand so estimates don't silently
         # depend on unrelated import order
-        from ..connectors import tpch  # noqa: F401  (registers itself)
+        from ..connectors import tpch, tpcds  # noqa: F401 (self-register)
     return CONNECTOR_STATS.get(connector_id)
 
 
@@ -94,8 +95,8 @@ def estimate_rows(node: P.PlanNode) -> Optional[float]:
         return estimate_rows(node.source)
     if isinstance(node, P.ValuesNode):
         return float(len(node.rows))
-    if isinstance(node, P.ExchangeNode):
-        ests = [estimate_rows(s) for s in node.exchange_sources]
+    if isinstance(node, (P.ExchangeNode, P.UnionNode)):
+        ests = [estimate_rows(s) for s in node.sources]
         if any(e is None for e in ests):
             return None
         return sum(ests)
@@ -371,6 +372,18 @@ class ExchangeInserter:
         partial = P.DistinctLimitNode(node.id + "_partial", child.node,
                                       node.count, node.distinct_variables)
         node.source = self._gather(partial)
+        return _Placed(node, SINGLE)
+
+    def _visit_UnionNode(self, node: P.UnionNode) -> _Placed:
+        """UNION ALL runs on one task; each distributed branch is gathered
+        (the reference instead collapses union into the exchange — same
+        wire shape, one stage per branch)."""
+        new_inputs = []
+        for s in node.inputs:
+            child = self._visit(s)
+            new_inputs.append(child.node if child.dist == SINGLE
+                              else self._gather(child.node))
+        node.inputs = new_inputs
         return _Placed(node, SINGLE)
 
     def _visit_WindowNode(self, node: P.WindowNode) -> _Placed:

@@ -19,7 +19,7 @@ KEYWORDS = {
     "as", "and", "or", "not", "in", "exists", "between", "like", "is",
     "null", "case", "when", "then", "else", "end", "cast", "join", "inner",
     "left", "right", "full", "outer", "cross", "on", "asc", "desc", "distinct",
-    "date", "interval", "extract", "union", "all",
+    "date", "interval", "extract", "union", "intersect", "except", "all",
     "true", "false", "nulls", "first", "last", "substring", "with",
 }
 # interval units are plain identifiers ("year" etc. must stay callable as
@@ -200,6 +200,15 @@ class ExtractExpr(Node):
     operand: Node
 
 
+@dataclass
+class WindowCall(Node):
+    """fn(args) OVER (PARTITION BY ... ORDER BY ...) — default frame only
+    (RANGE UNBOUNDED PRECEDING .. CURRENT ROW)."""
+    func: "FuncCall"
+    partition_by: List[Node]
+    order_by: List["OrderItem"]
+
+
 # relations
 @dataclass
 class TableRef(Node):
@@ -245,6 +254,21 @@ class Query(Node):
     limit: Optional[int] = None
     distinct: bool = False
     ctes: List[Tuple[str, "Query"]] = field(default_factory=list)
+    parenthesized: bool = False            # written as "( query )"
+
+
+@dataclass
+class SetOp(Node):
+    """UNION / INTERSECT / EXCEPT.  ORDER BY / LIMIT apply to the whole
+    set operation (trailing clauses of the last branch are hoisted here)."""
+    op: str                                # union | intersect | except
+    left: Node                             # Query | SetOp
+    right: Node
+    all: bool = False
+    order_by: List[OrderItem] = field(default_factory=list)
+    limit: Optional[int] = None
+    ctes: List[Tuple[str, "Query"]] = field(default_factory=list)
+    parenthesized: bool = False
 
 
 # ---------------------------------------------------------------------------
@@ -294,7 +318,7 @@ class Parser:
         self.expect("eof")
         return q
 
-    def parse_query(self) -> Query:
+    def parse_query(self):
         ctes = []
         if self.accept("keyword", "with"):
             while True:
@@ -306,9 +330,69 @@ class Parser:
                 ctes.append((name, sub))
                 if not self.accept("op", ","):
                     break
-        q = self.parse_select()
+        q = self.parse_set_expr()
         q.ctes = ctes
         return q
+
+    # set-operation grammar (INTERSECT binds tighter than UNION/EXCEPT,
+    # reference SqlBase.g4 queryTerm rules)
+    def parse_set_expr(self):
+        left = self.parse_intersect_term()
+        while True:
+            if self.accept("keyword", "union"):
+                op = "union"
+            elif self.accept("keyword", "except"):
+                op = "except"
+            else:
+                break
+            all_ = bool(self.accept("keyword", "all"))
+            if not all_:
+                self.accept("keyword", "distinct")
+            right = self.parse_intersect_term()
+            left = SetOp(op, left, right, all_)
+        if isinstance(left, SetOp):
+            self._hoist_trailing_clauses(left)
+            # a parenthesized last branch leaves ORDER BY / LIMIT unconsumed
+            if not left.order_by and self.accept_kw("order", "by"):
+                left.order_by.append(self.parse_order_item())
+                while self.accept("op", ","):
+                    left.order_by.append(self.parse_order_item())
+            if left.limit is None and self.accept("keyword", "limit"):
+                left.limit = int(self.expect("number").value)
+        return left
+
+    def parse_intersect_term(self):
+        left = self.parse_query_primary()
+        while self.accept("keyword", "intersect"):
+            all_ = bool(self.accept("keyword", "all"))
+            if not all_:
+                self.accept("keyword", "distinct")
+            right = self.parse_query_primary()
+            left = SetOp("intersect", left, right, all_)
+        return left
+
+    def parse_query_primary(self):
+        if self.peek().kind == "op" and self.peek().value == "(" \
+                and self.peek(1).kind == "keyword" \
+                and self.peek(1).value in ("select", "with"):
+            self.next()
+            q = self.parse_query()
+            self.expect("op", ")")
+            q.parenthesized = True
+            return q
+        return self.parse_select()
+
+    def _hoist_trailing_clauses(self, top: "SetOp"):
+        """Move ORDER BY / LIMIT parsed into the rightmost unparenthesized
+        branch up to the set operation they actually govern."""
+        last = top
+        while isinstance(last.right, SetOp) and not last.right.parenthesized:
+            last = last.right
+        branch = last.right
+        if branch.parenthesized or not isinstance(branch, Query):
+            return
+        top.order_by, branch.order_by = branch.order_by, []
+        top.limit, branch.limit = branch.limit, None
 
     def parse_select(self) -> Query:
         self.expect("keyword", "select")
@@ -601,12 +685,38 @@ class Parser:
                     while self.accept("op", ","):
                         args.append(self.parse_expr())
                 self.expect("op", ")")
-                return FuncCall(name, args, distinct)
+                fc = FuncCall(name, args, distinct)
+                if self.peek().kind == "ident" \
+                        and self.peek().value.lower() == "over":
+                    return self.parse_over(fc)
+                return fc
             parts = [self.next().value]
             while self.accept("op", "."):
                 parts.append(self.expect("ident").value)
             return Ident(parts)
         raise SyntaxError(f"unexpected token {t.value!r} at {t.pos}")
+
+    def parse_over(self, fc: "FuncCall") -> "WindowCall":
+        self.next()  # over
+        self.expect("op", "(")
+        partition_by: List[Node] = []
+        order_by: List[OrderItem] = []
+        if self.peek().kind == "ident" \
+                and self.peek().value.lower() == "partition":
+            self.next()
+            self.expect("keyword", "by")
+            partition_by.append(self.parse_expr())
+            while self.accept("op", ","):
+                partition_by.append(self.parse_expr())
+        if self.accept_kw("order", "by"):
+            order_by.append(self.parse_order_item())
+            while self.accept("op", ","):
+                order_by.append(self.parse_order_item())
+        if self.peek().kind in ("ident", "keyword") \
+                and self.peek().value.lower() in ("rows", "range", "groups"):
+            raise SyntaxError("explicit window frames not supported")
+        self.expect("op", ")")
+        return WindowCall(fc, partition_by, order_by)
 
     def parse_type_name(self) -> str:
         base = self.next().value.lower()

@@ -28,13 +28,7 @@ from ..spi.expr import (CallExpression, ConstantExpression, RowExpression,
                         SpecialFormExpression, VariableReferenceExpression,
                         call, constant, special, variable)
 from . import parser as A
-
-# TPC-H column prefix per table, so canonical query text (l_quantity) resolves
-# against the connector's bare column names (quantity).
-_TPCH_PREFIX = {
-    "lineitem": "l_", "orders": "o_", "customer": "c_", "part": "p_",
-    "partsupp": "ps_", "supplier": "s_", "nation": "n_", "region": "r_",
-}
+from ..connectors import catalog
 
 
 class PlanningError(Exception):
@@ -76,9 +70,11 @@ class Scope:
 class Planner:
     """Plans one session's queries; allocates globally unique variable names."""
 
-    def __init__(self, default_schema: str = "sf0.01"):
+    def __init__(self, default_schema: str = "sf0.01",
+                 default_catalog: str = "tpch"):
         self._counter = itertools.count()
         self.default_sf = _schema_sf(default_schema)
+        self.default_catalog = default_catalog
         # CTEs keep their AST: each reference is planned fresh so two uses of
         # the same CTE get distinct variables (a shared plan would alias them)
         self._ctes: Dict[str, A.Query] = {}
@@ -94,9 +90,110 @@ class Planner:
         query = A.parse_sql(sql)
         return self.plan_query_to_output(query)
 
-    def plan_query_to_output(self, query: A.Query) -> P.OutputNode:
-        node, names, out_vars = self.plan_query(query)
+    def plan_query_to_output(self, query) -> P.OutputNode:
+        node, names, out_vars = self.plan_query_any(query)
         return P.OutputNode(self.new_id("output"), node, names, out_vars)
+
+    def plan_query_any(self, query):
+        """Dispatch: plain SELECT block vs set operation."""
+        if isinstance(query, A.SetOp):
+            return self.plan_setop(query)
+        return self.plan_query(query)
+
+    # ------------------------------------------------------------------
+    # set operations (reference: SetOperationNode + the
+    # ImplementIntersectAsUnion / ImplementExceptAsUnion optimizer rules)
+    # ------------------------------------------------------------------
+    def plan_setop(self, s: A.SetOp):
+        for name, cte in s.ctes:
+            self._ctes[name.lower()] = cte
+        ln, lnames, lvars = self.plan_query_any(s.left)
+        rn, rnames, rvars = self.plan_query_any(s.right)
+        if len(lvars) != len(rvars):
+            raise PlanningError(
+                f"{s.op.upper()} branches have {len(lvars)} vs {len(rvars)} "
+                "columns")
+        if s.op in ("intersect", "except") and s.all:
+            raise PlanningError(f"{s.op.upper()} ALL is not supported")
+
+        # unified output variables; cast branch columns where types differ
+        out_vars: List[VariableReferenceExpression] = []
+        l_assign: Dict[VariableReferenceExpression, RowExpression] = {}
+        r_assign: Dict[VariableReferenceExpression, RowExpression] = {}
+        for cname, lv, rv in zip(lnames, lvars, rvars):
+            t = _common_result_type(lv.type, rv.type)
+            ov = self.new_var(cname, t)
+            l_assign[ov] = lv if lv.type.signature == t.signature \
+                else call("cast", t, lv)
+            r_assign[ov] = rv if rv.type.signature == t.signature \
+                else call("cast", t, rv)
+            out_vars.append(ov)
+
+        marker = s.op in ("intersect", "except")
+        if marker:
+            ml = self.new_var("mark_l", BIGINT)
+            mr = self.new_var("mark_r", BIGINT)
+            l_assign[ml], l_assign[mr] = constant(1, BIGINT), constant(0, BIGINT)
+            r_assign[ml], r_assign[mr] = constant(0, BIGINT), constant(1, BIGINT)
+        lproj = P.ProjectNode(self.new_id("setop_l"), ln, l_assign)
+        rproj = P.ProjectNode(self.new_id("setop_r"), rn, r_assign)
+        union_outs = out_vars + ([ml, mr] if marker else [])
+        node: P.PlanNode = P.UnionNode(self.new_id("union"), [lproj, rproj],
+                                       union_outs)
+
+        if marker:
+            cl = self.new_var("cnt_l", BIGINT)
+            cr = self.new_var("cnt_r", BIGINT)
+            node = P.AggregationNode(
+                self.new_id("setop_agg"), node,
+                {cl: P.Aggregation(call("sum", BIGINT, ml)),
+                 cr: P.Aggregation(call("sum", BIGINT, mr))},
+                out_vars, P.SINGLE)
+            present_l = call("gt", BOOLEAN, cl, constant(0, BIGINT))
+            right_cond = (call("gt", BOOLEAN, cr, constant(0, BIGINT))
+                          if s.op == "intersect"
+                          else call("eq", BOOLEAN, cr, constant(0, BIGINT)))
+            node = P.FilterNode(self.new_id("setop_filter"), node,
+                                special("AND", BOOLEAN, present_l, right_cond))
+            node = P.ProjectNode(self.new_id("setop_prune"), node,
+                                 {v: v for v in out_vars})
+        elif not s.all:
+            node = P.AggregationNode(self.new_id("distinct"), node, {},
+                                     out_vars, P.SINGLE)
+
+        # ORDER BY / LIMIT over the set operation: names and ordinals only
+        sort_items: List[Tuple[VariableReferenceExpression, str]] = []
+        name_to_var = {}
+        for n, v in zip(lnames, out_vars):
+            name_to_var.setdefault(n.lower(), v)
+        for oi in s.order_by:
+            if isinstance(oi.expr, A.NumberLit):
+                pos = int(oi.expr.text)
+                if not 1 <= pos <= len(out_vars):
+                    raise PlanningError(f"ORDER BY position {pos} out of range")
+                v = out_vars[pos - 1]
+            elif isinstance(oi.expr, A.Ident) and len(oi.expr.parts) == 1 \
+                    and oi.expr.parts[0].lower() in name_to_var:
+                v = name_to_var[oi.expr.parts[0].lower()]
+            else:
+                raise PlanningError(
+                    "ORDER BY over a set operation must use output column "
+                    "names or ordinals")
+            order = ("ASC" if oi.ascending else "DESC")
+            if oi.nulls_first is None:
+                order += "_NULLS_LAST" if oi.ascending else "_NULLS_FIRST"
+            else:
+                order += "_NULLS_FIRST" if oi.nulls_first else "_NULLS_LAST"
+            sort_items.append((v, order))
+        if sort_items and s.limit is not None:
+            node = P.TopNNode(self.new_id("topn"), node, s.limit,
+                              P.OrderingScheme(sort_items))
+        elif sort_items:
+            node = P.SortNode(self.new_id("sort"), node,
+                              P.OrderingScheme(sort_items))
+        elif s.limit is not None:
+            node = P.LimitNode(self.new_id("limit"), node, s.limit)
+        return node, lnames, out_vars
 
     # ------------------------------------------------------------------
     def plan_query(self, query: A.Query):
@@ -132,6 +229,12 @@ class Planner:
                     node = self._apply_subquery_conjunct(node, scope, c)
         elif query.having is not None:
             raise PlanningError("HAVING without aggregation")
+
+        # 3b. window functions (evaluated over the grouped/filtered relation,
+        # before the SELECT projection — reference WindowNode placement)
+        window_calls = _collect_window_calls(query)
+        if window_calls:
+            node, scope = self.plan_windows(node, scope, window_calls)
 
         # 4. SELECT projection
         select_exprs: List[RowExpression] = []
@@ -358,7 +461,7 @@ class Planner:
 
     def plan_base_relation(self, rel: A.Node, query: A.Query):
         if isinstance(rel, A.SubqueryRef):
-            node, names, out_vars = self.plan_query(rel.query)
+            node, names, out_vars = self.plan_query_any(rel.query)
             cols = {}
             for n, v in zip(names, out_vars):
                 cols[n.lower()] = v
@@ -367,15 +470,16 @@ class Planner:
             name = rel.name.lower()
             alias = (rel.alias or rel.name).lower()
             if name in self._ctes:
-                node, names, out_vars = self.plan_query(self._ctes[name])
+                node, names, out_vars = self.plan_query_any(self._ctes[name])
                 cols = {n.lower(): v for n, v in zip(names, out_vars)}
                 return node, RelationScope(alias, cols)
-            if name not in tpch.SCHEMAS:
+            cid = catalog.resolve_table(name, self.default_catalog)
+            if cid is None:
                 raise PlanningError(f"unknown table {rel.name!r}")
             used = _used_columns(query, name, alias)
-            prefix = _TPCH_PREFIX[name]
+            prefix = catalog.prefix(name, cid)
             outputs, assignments, cols = [], {}, {}
-            for col, typ in tpch.SCHEMAS[name]:
+            for col, typ in catalog.schema(name, cid):
                 visible = {col, prefix + col}
                 if used is not None and not (visible & used):
                     continue
@@ -385,11 +489,11 @@ class Planner:
                 cols[col] = v
                 cols[prefix + col] = v
             if not outputs:  # count(*)-style: keep the narrowest column
-                col, typ = tpch.SCHEMAS[name][0]
+                col, typ = catalog.schema(name, cid)[0]
                 v = self.new_var(prefix + col, typ)
                 outputs, assignments = [v], {v: P.ColumnHandle(col, typ)}
                 cols = {col: v, prefix + col: v}
-            table = P.TableHandle("tpch", "tpch", name,
+            table = P.TableHandle(cid, cid, name,
                                   (("scaleFactor", self.default_sf),))
             node = P.TableScanNode(self.new_id("scan"), table, outputs,
                                    assignments)
@@ -459,6 +563,9 @@ class Planner:
         corr_pairs are (outer_ast, inner_ast) equality correlations, and
         mixed_conjs reference both sides non-equi (Q21's l2.l_suppkey <>
         l1.l_suppkey).  inner_map: alias -> visible column-name set."""
+        if isinstance(subq, A.SetOp):
+            # set-operation subqueries are planned whole (uncorrelated only)
+            return [], [], [], {}
         inner_map: Dict[str, set] = {}
         for rel in _flatten_relations(subq.relations):
             if isinstance(rel, A.TableRef):
@@ -467,14 +574,15 @@ class Planner:
                 if name in self._ctes:
                     cols = {n.lower()
                             for n in _select_names(self._ctes[name])}
-                elif name in tpch.SCHEMAS:
-                    prefix = _TPCH_PREFIX[name]
+                else:
+                    cid = catalog.resolve_table(name, self.default_catalog)
+                    if cid is None:
+                        raise PlanningError(f"unknown table {rel.name!r}")
+                    prefix = catalog.prefix(name, cid)
                     cols = set()
-                    for coln, _ in tpch.SCHEMAS[name]:
+                    for coln, _ in catalog.schema(name, cid):
                         cols.add(coln)
                         cols.add(prefix + coln)
-                else:
-                    raise PlanningError(f"unknown table {rel.name!r}")
             elif isinstance(rel, A.SubqueryRef):
                 alias = rel.alias.lower()
                 cols = {n.lower() for n in _select_names(rel.query)}
@@ -526,6 +634,8 @@ class Planner:
 
     def _apply_exists(self, node: P.PlanNode, scope: Scope, subq: A.Query,
                       negated: bool) -> P.PlanNode:
+        if isinstance(subq, A.SetOp):
+            raise PlanningError("EXISTS over a set operation not supported")
         if subq.group_by or subq.having:
             raise PlanningError("EXISTS over grouped subquery")
         inner_conjs, corr, mixed, inner_map = self._subquery_parts(subq, scope)
@@ -622,7 +732,7 @@ class Planner:
         # NOTE: NOT IN over a build side containing NULLs should yield no
         # rows (SQL three-valued semantics); TPC-H/DS key columns are
         # non-null so the anti-join mark is exact here.
-        sub_node, _, sub_vars = self.plan_query(subq)
+        sub_node, _, sub_vars = self.plan_query_any(subq)
         if len(sub_vars) != 1:
             raise PlanningError("IN subquery must produce one column")
         e = self.plan_expr(value_ast, scope)
@@ -640,7 +750,7 @@ class Planner:
         inner_conjs, corr, mixed, _ = self._subquery_parts(subq, scope)
         if mixed:
             raise PlanningError("non-equi correlated scalar subquery")
-        if len(subq.select_items) != 1:
+        if not isinstance(subq, A.SetOp) and len(subq.select_items) != 1:
             raise PlanningError("scalar subquery must select one column")
         if corr:
             if subq.group_by or subq.having:
@@ -672,7 +782,9 @@ class Planner:
         else:
             # uncorrelated scalar: enforce the one-row contract at runtime,
             # then cross join the row in via a constant-key equi join
-            sub_node, _, sub_vars = self.plan_query(subq)
+            sub_node, _, sub_vars = self.plan_query_any(subq)
+            if len(sub_vars) != 1:
+                raise PlanningError("scalar subquery must select one column")
             sub_node = P.EnforceSingleRowNode(self.new_id("single"), sub_node)
             val_var = sub_vars[0]
             ck_l = self.new_var("sjoin_l", BIGINT)
@@ -770,31 +882,167 @@ class Planner:
         must be DISTINCT over the same argument; dedup with an inner group-by
         on (keys, arg), then aggregate normally on top."""
         distinct_calls = [fc for fc in agg_calls if fc.distinct]
-        if len(distinct_calls) != len(agg_calls):
-            raise PlanningError(
-                "mixing DISTINCT and plain aggregates not supported")
-        arg_keys = {_canon(fc.args[0], scope) for fc in agg_calls}
+        plain_calls = [fc for fc in agg_calls if not fc.distinct]
+        arg_keys = {_canon(fc.args[0], scope) for fc in distinct_calls}
         if len(arg_keys) != 1:
             raise PlanningError(
                 "multiple distinct-aggregate arguments not supported")
-        arg = self.plan_expr(agg_calls[0].args[0], scope)
+        arg = self.plan_expr(distinct_calls[0].args[0], scope)
         if isinstance(arg, VariableReferenceExpression):
             av = arg
         else:
             av = self.new_var("distinctarg", arg.type)
         pre_assign[av] = arg
-        pre = P.ProjectNode(self.new_id("preagg"), node, pre_assign)
-        dedup = P.AggregationNode(self.new_id("dedup"), pre, {},
-                                  key_vars + [av], P.SINGLE)
-        aggregations: Dict[VariableReferenceExpression, P.Aggregation] = {}
-        for fc in agg_calls:
-            out_type = _agg_output_type(fc.name, av.type)
+
+        # plain aggregates share the pre-projection
+        plain_aggs: Dict[VariableReferenceExpression, P.Aggregation] = {}
+        plain_vars: List[VariableReferenceExpression] = []
+        for fc in plain_calls:
+            if fc.args:
+                parg = self.plan_expr(fc.args[0], scope)
+                if isinstance(parg, VariableReferenceExpression):
+                    pav = parg
+                else:
+                    pav = self.new_var("agginput", parg.type)
+                pre_assign[pav] = parg
+                out_type = _agg_output_type(fc.name, parg.type)
+                acall = call(fc.name, out_type, pav)
+            else:
+                out_type = BIGINT
+                acall = CallExpression("count", out_type, [])
             v = self.new_var(fc.name, out_type)
-            aggregations[v] = P.Aggregation(call(fc.name, out_type, av))
+            plain_aggs[v] = P.Aggregation(acall)
+            plain_vars.append(v)
             expr_vars[_canon(fc, scope)] = v
-        agg = P.AggregationNode(self.new_id("agg"), dedup, aggregations,
-                                key_vars, P.SINGLE)
+
+        pre = P.ProjectNode(self.new_id("preagg"), node, pre_assign)
+
+        def build_distinct(source):
+            """dedup group-by on (keys, arg), then aggregate (reference
+            SingleDistinctAggregationToGroupBy)."""
+            dedup = P.AggregationNode(self.new_id("dedup"), source, {},
+                                      key_vars + [av], P.SINGLE)
+            aggs: Dict[VariableReferenceExpression, P.Aggregation] = {}
+            for fc in distinct_calls:
+                out_type = _agg_output_type(fc.name, av.type)
+                v = self.new_var(fc.name, out_type)
+                aggs[v] = P.Aggregation(call(fc.name, out_type, av))
+                expr_vars[_canon(fc, scope)] = v
+            return P.AggregationNode(self.new_id("agg"), dedup, aggs,
+                                     key_vars, P.SINGLE), aggs
+
+        if not plain_calls:
+            agg, _ = build_distinct(pre)
+            return agg, Scope(scope.relations, expr_vars)
+
+        # mixed DISTINCT + plain (the reference's
+        # OptimizeMixedDistinctAggregations shape, realized as a split:
+        # plain aggregation and deduped distinct aggregation computed
+        # independently over the same input, then equi-joined on the group
+        # keys — a constant key joins the two single rows of a global agg).
+        # NOTE: groups whose key is NULL would not pair across the join;
+        # TPC-H/DS grouping keys are non-null.
+        import copy
+        plain_node = P.AggregationNode(self.new_id("agg"), pre, plain_aggs,
+                                       key_vars, P.SINGLE)
+        distinct_node, dist_aggs = build_distinct(copy.deepcopy(pre))
+        # rename the distinct side's keys so join criteria are distinct vars
+        rmap = {kv: self.new_var("dkey", kv.type) for kv in key_vars}
+        rename = {rmap[kv]: kv for kv in key_vars}
+        rename.update({v: v for v in dist_aggs})
+        if key_vars:
+            distinct_node = P.ProjectNode(self.new_id("drename"),
+                                          distinct_node, rename)
+            criteria = [(kv, rmap[kv]) for kv in key_vars]
+            left, right = plain_node, distinct_node
+        else:
+            ck_l = self.new_var("aggjoin_l", BIGINT)
+            ck_r = self.new_var("aggjoin_r", BIGINT)
+            left = P.ProjectNode(
+                self.new_id("ajl"), plain_node,
+                {**{v: v for v in plain_node.output_variables},
+                 ck_l: constant(0, BIGINT)})
+            right = P.ProjectNode(
+                self.new_id("ajr"), distinct_node,
+                {**{v: v for v in distinct_node.output_variables},
+                 ck_r: constant(0, BIGINT)})
+            criteria = [(ck_l, ck_r)]
+        outputs = list(key_vars) + plain_vars + list(dist_aggs)
+        agg = P.JoinNode(self.new_id("aggjoin"), P.INNER, left, right,
+                         criteria, outputs)
         return agg, Scope(scope.relations, expr_vars)
+
+    # ------------------------------------------------------------------
+    # window planning
+    # ------------------------------------------------------------------
+    _RANKING_FUNCS = {"row_number", "rank", "dense_rank"}
+    _WINDOW_AGGS = {"sum", "avg", "count", "min", "max"}
+
+    def plan_windows(self, node: P.PlanNode, scope: Scope,
+                     wcalls: List[A.WindowCall]):
+        """One WindowNode per distinct (partition, ordering) spec, functions
+        sharing a spec computed together (reference WindowNode)."""
+        expr_vars = dict(scope.expr_vars)
+        pre_assign: Dict[VariableReferenceExpression, RowExpression] = {
+            v: v for v in node.output_variables}
+
+        def ensure(e: RowExpression, hint: str) -> VariableReferenceExpression:
+            if isinstance(e, VariableReferenceExpression):
+                pre_assign.setdefault(e, e)
+                return e
+            v = self.new_var(hint, e.type)
+            pre_assign[v] = e
+            return v
+
+        groups: Dict[str, dict] = {}
+        for wc in wcalls:
+            fname = wc.func.name
+            if wc.func.distinct:
+                raise PlanningError(
+                    "DISTINCT is not supported in window functions")
+            part_vars = [ensure(self.plan_expr(p, scope), "wpart")
+                         for p in wc.partition_by]
+            orderings = []
+            for oi in wc.order_by:
+                v = ensure(self.plan_expr(oi.expr, scope), "wsort")
+                order = "ASC" if oi.ascending else "DESC"
+                if oi.nulls_first is None:
+                    order += "_NULLS_LAST" if oi.ascending else "_NULLS_FIRST"
+                else:
+                    order += "_NULLS_FIRST" if oi.nulls_first \
+                        else "_NULLS_LAST"
+                orderings.append((v, order))
+            if fname in self._RANKING_FUNCS:
+                if not orderings:
+                    raise PlanningError(f"{fname}() requires ORDER BY")
+                out_type: Type = BIGINT
+                fcall = CallExpression(fname, out_type, [])
+            elif fname in self._WINDOW_AGGS:
+                if wc.func.args:
+                    arg = self.plan_expr(wc.func.args[0], scope)
+                    av = ensure(arg, "warg")
+                    out_type = _agg_output_type(fname, arg.type)
+                    fcall = call(fname, out_type, av)
+                else:
+                    out_type = BIGINT
+                    fcall = CallExpression("count", out_type, [])
+            else:
+                raise PlanningError(f"unknown window function {fname!r}")
+            spec_key = ("|".join(v.name for v in part_vars) + "//"
+                        + "|".join(f"{v.name}:{o}" for v, o in orderings))
+            g = groups.setdefault(spec_key, {
+                "partition": part_vars, "orderings": orderings, "funcs": {}})
+            out_var = self.new_var(fname, out_type)
+            g["funcs"][out_var] = P.WindowFunction(fcall)
+            expr_vars[_canon(wc, scope)] = out_var
+
+        node = P.ProjectNode(self.new_id("prewindow"), node, pre_assign)
+        for g in groups.values():
+            scheme = (P.OrderingScheme(g["orderings"])
+                      if g["orderings"] else None)
+            node = P.WindowNode(self.new_id("window"), node, g["partition"],
+                                scheme, g["funcs"])
+        return node, Scope(scope.relations, expr_vars)
 
     def _resolve_order_item(self, oi: A.OrderItem, scope, out_vars,
                             alias_vars, extra_assign):
@@ -1176,8 +1424,10 @@ def _flatten_relations(relations: List[A.Node]) -> List[A.Node]:
     return flat
 
 
-def _select_names(q: A.Query) -> List[str]:
-    out = []
+def _select_names(q) -> List[str]:
+    if isinstance(q, A.SetOp):
+        return _select_names(q.left)   # set-op output names come from the
+    out = []                           # first branch (SQL rule)
     for item in q.select_items:
         if isinstance(item.expr, A.Star):
             continue
@@ -1212,6 +1462,39 @@ def _scope_vars(scope: Scope) -> List[VariableReferenceExpression]:
     return out
 
 
+def _collect_window_calls(query: A.Query) -> List[A.WindowCall]:
+    out: List[A.WindowCall] = []
+    seen = set()
+
+    def walk(n):
+        if isinstance(n, (A.InSubquery, A.Exists, A.ScalarSubquery)):
+            return
+        if isinstance(n, A.WindowCall):
+            key = _canon(n)
+            if key not in seen:
+                seen.add(key)
+                out.append(n)
+            return
+        for f in vars(n).values() if isinstance(n, A.Node) else []:
+            if isinstance(f, A.Node):
+                walk(f)
+            elif isinstance(f, list):
+                for x in f:
+                    if isinstance(x, A.Node):
+                        walk(x)
+                    elif isinstance(x, tuple):
+                        for y in x:
+                            if isinstance(y, A.Node):
+                                walk(y)
+
+    for item in query.select_items:
+        if not isinstance(item.expr, A.Star):
+            walk(item.expr)
+    for oi in query.order_by:
+        walk(oi.expr)
+    return out
+
+
 def _collect_agg_calls(query: A.Query) -> List[A.FuncCall]:
     out: List[A.FuncCall] = []
     seen = set()
@@ -1219,6 +1502,16 @@ def _collect_agg_calls(query: A.Query) -> List[A.FuncCall]:
     def walk(n):
         if isinstance(n, (A.InSubquery, A.Exists, A.ScalarSubquery)):
             return  # subquery aggregates belong to the subquery's own scope
+        if isinstance(n, A.WindowCall):
+            # the window call itself is not a group aggregate, but its
+            # argument / spec may contain ones (sum(sum(x)) over (...))
+            for a in n.func.args:
+                walk(a)
+            for p in n.partition_by:
+                walk(p)
+            for oi in n.order_by:
+                walk(oi.expr)
+            return
         if isinstance(n, A.FuncCall) and n.name in ("sum", "avg", "count",
                                                     "min", "max"):
             key = _canon(n)
@@ -1276,6 +1569,12 @@ def _canon(e: A.Node, scope: Optional[Scope] = None) -> str:
     if isinstance(e, A.FuncCall):
         d = "distinct " if e.distinct else ""
         return f"{e.name}({d}{','.join(c(a) for a in e.args)})"
+    if isinstance(e, A.WindowCall):
+        parts = [c(p) for p in e.partition_by]
+        orders = [f"{c(oi.expr)}:{oi.ascending}:{oi.nulls_first}"
+                  for oi in e.order_by]
+        return (f"{c(e.func)} over (partition by {','.join(parts)} "
+                f"order by {','.join(orders)})")
     if isinstance(e, A.CastExpr):
         return f"cast({c(e.operand)} as {e.type_name})"
     if isinstance(e, A.Between):

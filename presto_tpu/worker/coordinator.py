@@ -17,7 +17,7 @@ import time
 import urllib.request
 from typing import Dict, List, Optional
 
-from ..connectors import tpch
+from ..connectors import catalog, tpch
 from ..exec.pipeline import ExecutionConfig
 from ..exec.runner import LocalQueryRunner, QueryResult, pages_to_result
 from ..spi import plan as P
@@ -143,14 +143,14 @@ class HttpQueryRunner(LocalQueryRunner):
             spec = OutputBuffersSpec("PARTITIONED", 1)
 
         # split assignment (reference SourcePartitionedScheduler)
-        scan_splits: Dict[str, List[tpch.TpchSplit]] = {}
+        scan_splits: Dict[str, List[catalog.TableSplit]] = {}
         for node in P.walk_plan(frag.root):
             if isinstance(node, P.TableScanNode):
                 th = node.table
                 sf = dict(th.extra).get("scaleFactor", 0.01)
                 n_splits = max(stage.n_tasks, self.config.splits_per_scan)
-                scan_splits[node.id] = tpch.make_splits(
-                    th.table_name, sf, n_splits)
+                scan_splits[node.id] = catalog.make_splits(
+                    th.table_name, sf, n_splits, th.connector_id)
         remote_nodes = [n for n in P.walk_plan(frag.root)
                         if isinstance(n, P.RemoteSourceNode)]
         child_by_fid = {c.fragment.fragment_id: c for c in stage.children}

@@ -146,7 +146,7 @@ def make_announcement(node_id: str, uri: str, environment: str = "test",
                 "node_version": "presto-tpu-0.1",
                 "coordinator": "false",
                 "pool_type": pool_type,
-                "connectorIds": "tpch",
+                "connectorIds": "tpch,tpcds",
                 "http": uri,
             },
         }],

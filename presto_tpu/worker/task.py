@@ -16,7 +16,7 @@ import traceback
 from typing import Dict, List, Optional
 
 from ..common.serde import serialize_page
-from ..connectors import tpch
+from ..connectors import catalog, tpch
 from ..exec.pipeline import ExecutionConfig, PlanCompiler, TaskContext
 from ..exec.scheduler import partition_targets, split_page
 from ..spi import plan as P
@@ -96,7 +96,7 @@ class TpuTask:
                     remote_page_reader(remote)
             if conn:
                 ctx.splits[source.plan_node_id] = [
-                    tpch.TpchSplit.from_dict(s) for s in conn]
+                    catalog.TableSplit.from_dict(s) for s in conn]
 
         self._set_state(RUNNING)
         self._thread = threading.Thread(

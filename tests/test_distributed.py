@@ -150,3 +150,39 @@ def test_left_join_empty_build_varchar(part_runner):
         left join (select o_custkey, o_orderstatus from orders
                    where o_totalprice < 0) t
         on c_custkey = o_custkey where c_custkey < 5""")
+
+
+def test_window_repartitioned_by_partition_keys(part_runner):
+    # WindowNode over a distributed source: fragmenter must hash-repartition
+    # on the window partition keys so each task sees whole partitions
+    check(part_runner, """
+        select o_custkey, o_orderkey,
+               row_number() over (partition by o_custkey order by o_orderkey),
+               sum(o_totalprice) over (partition by o_custkey)
+        from orders where o_custkey < 200""")
+
+
+def test_window_no_partition_gathers_single(part_runner):
+    check(part_runner, """
+        select c_custkey,
+               rank() over (order by c_acctbal desc)
+        from customer where c_custkey < 100""")
+
+
+def test_union_all_distributed(part_runner):
+    check(part_runner, """
+        select n_regionkey k from nation
+        union all select r_regionkey from region
+        union all select o_custkey from orders where o_orderkey < 50""")
+
+
+def test_union_distinct_distributed(part_runner):
+    check(part_runner, """
+        select o_orderstatus from orders
+        union select o_orderpriority from orders""")
+
+
+def test_intersect_distributed(part_runner):
+    check(part_runner, """
+        select n_nationkey from nation
+        intersect select c_nationkey from customer where c_custkey < 40""")

@@ -302,3 +302,261 @@ def test_scalar_subquery_multi_row_raises(runner):
     with _pytest.raises(Exception, match="more than one row"):
         runner.execute("select count(*) from region where r_regionkey = "
                        "(select n_regionkey from nation where n_regionkey < 2)")
+
+
+# ---------------------------------------------------------------------------
+# window functions (reference: WindowOperator.java:69, AbstractTestWindowQueries)
+# ---------------------------------------------------------------------------
+
+def test_window_row_number(runner):
+    check(runner, """
+        select o_custkey, o_orderkey,
+               row_number() over (partition by o_custkey order by o_orderkey)
+        from orders where o_custkey < 100""")
+
+
+def test_window_rank_dense_rank_ties(runner):
+    # l_quantity has heavy ties within a partition
+    check(runner, """
+        select l_suppkey, l_quantity,
+               rank() over (partition by l_suppkey order by l_quantity),
+               dense_rank() over (partition by l_suppkey order by l_quantity)
+        from lineitem where l_suppkey < 20""")
+
+
+def test_window_running_sum(runner):
+    check(runner, """
+        select l_orderkey, l_linenumber,
+               sum(l_quantity) over (partition by l_orderkey
+                                     order by l_linenumber)
+        from lineitem where l_orderkey < 200""")
+
+
+def test_window_running_agg_includes_peers(runner):
+    # RANGE default frame: rows tied on the order key share the aggregate
+    check(runner, """
+        select l_suppkey, l_quantity,
+               sum(l_extendedprice) over (partition by l_suppkey
+                                          order by l_quantity),
+               count(l_quantity) over (partition by l_suppkey
+                                       order by l_quantity)
+        from lineitem where l_suppkey < 10""")
+
+
+def test_window_partition_only_aggs(runner):
+    # no ORDER BY -> frame is the whole partition
+    check(runner, """
+        select o_orderkey, o_totalprice,
+               avg(o_totalprice) over (partition by o_orderstatus),
+               count(*) over (partition by o_orderstatus),
+               min(o_totalprice) over (partition by o_orderstatus),
+               max(o_totalprice) over (partition by o_orderstatus)
+        from orders where o_orderkey < 500""")
+
+
+def test_window_no_partition(runner):
+    check(runner, """
+        select n_nationkey,
+               sum(n_nationkey) over (order by n_nationkey),
+               row_number() over (order by n_nationkey desc)
+        from nation""")
+
+
+def test_window_desc_order(runner):
+    check(runner, """
+        select c_nationkey, c_custkey,
+               rank() over (partition by c_nationkey order by c_acctbal desc)
+        from customer where c_custkey < 300""")
+
+
+def test_window_string_partition(runner):
+    # partition key is a lazy open-domain string column (encode path)
+    check(runner, """
+        select o_clerk, o_orderkey,
+               row_number() over (partition by o_clerk order by o_orderkey)
+        from orders where o_orderkey < 300""")
+
+
+def test_window_over_grouped_aggregation(runner):
+    # window over the result of a GROUP BY; sum(count(*)) over (...)
+    check(runner, """
+        select o_orderpriority, count(*) cnt,
+               sum(count(*)) over (order by o_orderpriority)
+        from orders group by o_orderpriority""")
+
+
+def test_window_in_order_by_and_topn(runner):
+    check(runner, """
+        select c_custkey,
+               row_number() over (order by c_acctbal desc) rn
+        from customer
+        order by rn limit 10""", ordered=True)
+
+
+def test_window_two_specs_one_query(runner):
+    check(runner, """
+        select l_orderkey, l_linenumber,
+               row_number() over (partition by l_orderkey
+                                  order by l_linenumber),
+               sum(l_quantity) over (partition by l_suppkey
+                                     order by l_extendedprice)
+        from lineitem where l_orderkey < 100""")
+
+
+def test_window_distinct_rejected(runner):
+    import pytest as _pytest
+    with _pytest.raises(Exception, match="DISTINCT"):
+        runner.execute("select count(distinct o_orderstatus) over "
+                       "(partition by o_custkey) from orders")
+
+
+def test_window_lazy_rowid_distinct_partition_key(runner):
+    # c_phone is ROWID_DISTINCT but not usable as a sort key via row ids:
+    # must be dictionary-encoded before the window sort
+    check(runner, """
+        select c_custkey,
+               row_number() over (partition by c_phone order by c_custkey)
+        from customer where c_custkey < 50""")
+
+
+def test_window_min_varchar_reference(runner):
+    # min/max over strings: reference must not hit the sum accumulator
+    from presto_tpu.exec.reference import execute_reference
+    from presto_tpu.exec.runner import LocalQueryRunner as _R
+    plan = runner.plan("select min(n_name) over (partition by n_regionkey) "
+                       "from nation")
+    rows = execute_reference(plan)
+    assert all(isinstance(r[0], str) for r in rows)
+
+
+# ---------------------------------------------------------------------------
+# set operations (reference: SetOperationNode, ImplementIntersectAsUnion)
+# ---------------------------------------------------------------------------
+
+def test_union_all(runner):
+    res = check(runner, """
+        select n_regionkey from nation where n_nationkey < 5
+        union all select r_regionkey from region""")
+    assert len(res.rows) == 10
+
+
+def test_union_distinct(runner):
+    check(runner, "select n_regionkey from nation "
+                  "union select r_regionkey from region")
+
+
+def test_union_strings_merged_dictionaries(runner):
+    check(runner, """
+        select n_name from nation where n_nationkey < 5
+        union all select r_name from region""")
+
+
+def test_union_type_coercion(runner):
+    # bigint union double -> double on both branches
+    check(runner, """
+        select n_nationkey from nation where n_nationkey < 3
+        union all select c_acctbal from customer where c_custkey < 3""")
+
+
+def test_union_order_limit(runner):
+    check(runner, """
+        select n_name from nation where n_nationkey < 2
+        union select r_name from region order by 1 limit 4""", ordered=True)
+
+
+def test_union_three_way_aggregated(runner):
+    check(runner, """
+        select count(*), sum(k) from (
+          select n_nationkey k from nation
+          union all select r_regionkey from region
+          union all select o_orderkey from orders where o_orderkey < 10) t""")
+
+
+def test_intersect(runner):
+    check(runner, """
+        select n_regionkey from nation
+        intersect select r_regionkey from region where r_regionkey < 3""")
+
+
+def test_except(runner):
+    check(runner, """
+        select n_nationkey from nation
+        except select o_custkey from orders""")
+
+
+def test_intersect_binds_tighter_than_union(runner):
+    # a union (b intersect c): intersect of region 0..4 with 0..2 is 0..2
+    res = check(runner, """
+        select n_regionkey from nation where n_nationkey = 0
+        union select r_regionkey from region
+        intersect select n_regionkey from nation where n_regionkey < 3""")
+    assert sorted(r[0] for r in res.rows) == [0, 1, 2]
+
+
+def test_union_in_subquery(runner):
+    check(runner, """
+        select count(*) from customer where c_nationkey in
+          (select n_nationkey from nation where n_regionkey = 0
+           union select n_nationkey from nation where n_regionkey = 1)""")
+
+
+def test_union_in_cte(runner):
+    check(runner, """
+        with keys as (select n_regionkey k from nation
+                      union select r_regionkey from region)
+        select count(*) from keys""")
+
+
+def test_union_aliased_branch_names(runner):
+    # output names come from the first branch
+    res = runner.execute("select n_nationkey as id from nation where "
+                         "n_nationkey < 2 union all select r_regionkey "
+                         "from region where r_regionkey < 1")
+    assert res.column_names == ["id"]
+
+
+def test_intersect_all_rejected(runner):
+    import pytest as _pytest
+    with _pytest.raises(Exception, match="not supported"):
+        runner.execute("select n_regionkey from nation intersect all "
+                       "select r_regionkey from region")
+
+
+def test_window_min_max_varchar_engine(runner):
+    # dictionary-encoded strings: min/max must compare lexically, not by code
+    check(runner, """
+        select n_regionkey, n_name,
+               min(n_name) over (partition by n_regionkey),
+               max(n_name) over (partition by n_regionkey)
+        from nation""")
+
+
+def test_window_min_lazy_string(runner):
+    # customer.name is ROWID_ORDERED: min over row ids, late-materialized
+    check(runner, """
+        select c_nationkey,
+               min(c_name) over (partition by c_nationkey)
+        from customer where c_custkey < 100""")
+    # clerk is NOT rowid-ordered: must be dictionary-encoded first
+    check(runner, """
+        select o_orderstatus,
+               max(o_clerk) over (partition by o_orderstatus)
+        from orders where o_orderkey < 200""")
+
+
+def test_union_order_by_after_parenthesized_branch(runner):
+    res = check(runner, """
+        select n_regionkey from nation where n_nationkey < 2
+        union (select r_regionkey from region) order by 1 limit 3""",
+        ordered=True)
+    assert len(res.rows) == 3
+
+
+def test_scalar_subquery_union_multi_column_rejected(runner):
+    import pytest as _pytest
+    with _pytest.raises(Exception, match="one column"):
+        runner.execute("""
+            select count(*) from region where r_regionkey =
+              (select n_regionkey, n_nationkey from nation where n_nationkey = 1
+               union select n_regionkey, n_nationkey from nation
+               where n_nationkey = 1)""")
