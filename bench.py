@@ -63,7 +63,9 @@ def main():
 
     schema = f"sf{sf:g}"
     n_rows = tpch._table_rows("lineitem", sf)
-    runner = LocalQueryRunner(schema=schema)
+    from presto_tpu.exec.pipeline import ExecutionConfig
+    runner = LocalQueryRunner(schema=schema, config=ExecutionConfig(
+        batch_rows=1 << 20, join_out_capacity=1 << 21))
 
     # Warmup: traces + compiles every pipeline shape bucket and faults the
     # generated lineitem columns into memory/HBM.

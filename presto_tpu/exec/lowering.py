@@ -195,6 +195,11 @@ class Lowering:
     def _constant(self, expr: ConstantExpression, batch: Batch) -> Column:
         cap = batch.capacity
         if expr.value is None:
+            if isinstance(expr.type, (VarcharType, CharType)):
+                # typed NULL string: all-null dictionary column so string
+                # consumers (union dictionary merge, output blocks) work
+                return Column(jnp.zeros(cap, dtype=jnp.int32),
+                              jnp.ones(cap, dtype=bool), ("",))
             z = jnp.zeros(cap, dtype=_jnp_dtype(expr.type))
             return Column(z, jnp.ones(cap, dtype=bool))
         v = constant_device_value(expr.value, expr.type)

@@ -1,0 +1,157 @@
+"""Device-memory accounting and host-RAM spill staging.
+
+TPU analogs of the reference's node-level memory machinery:
+- `MemoryPool` mirrors the worker memory pool + hierarchical contexts
+  (presto-main-base/.../memory/MemoryPool.java:46, LocalMemoryManager.java:39,
+  the presto-memory-context AggregatedMemoryContext tree): operators reserve
+  HBM bytes before materializing and either fall back to spilling or fail
+  with the engine's exceeded-limit error.
+- `PartitionedSpillStore` mirrors partitioned spilling
+  (.../spiller/GenericPartitioningSpiller.java, FileSingleStreamSpiller.java:59)
+  with one deliberate difference: on a TPU host the natural spill target is
+  host RAM, not disk — it is orders of magnitude larger than HBM and needs
+  no serialization, playing exactly the role local SSD plays for the
+  reference.  Buckets are key-hash partitions; processing one bucket at a
+  time is the reference's grouped-execution Lifespan model
+  (Lifespan.java:30, GroupedExecutionTagger.java) compressed into the
+  operator that spilled.
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterator, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from .batch import Batch, Column
+from . import operators as ops
+
+
+class MemoryExceededError(RuntimeError):
+    """Analog of the reference's EXCEEDED_LOCAL_MEMORY_LIMIT error code."""
+
+
+class MemoryPool:
+    """Byte accounting for one task's device materializations.
+
+    budget=None means unlimited (accounting only — peak still tracked and
+    reported in TaskStatus.memoryReservationInBytes)."""
+
+    def __init__(self, budget: Optional[int] = None):
+        self.budget = budget
+        self.reserved = 0
+        self.peak = 0
+
+    def try_reserve(self, n: int) -> bool:
+        if self.budget is not None and self.reserved + n > self.budget:
+            return False
+        self.reserved += n
+        self.peak = max(self.peak, self.reserved)
+        return True
+
+    def reserve(self, n: int) -> None:
+        if not self.try_reserve(n):
+            raise MemoryExceededError(
+                f"memory budget exceeded: reserved {self.reserved} "
+                f"+ {n} > {self.budget} bytes")
+
+    def free(self, n: int) -> None:
+        self.reserved = max(0, self.reserved - n)
+
+
+def batch_bytes(batch: Batch) -> int:
+    total = batch.mask.nbytes
+    for c in batch.columns.values():
+        total += c.values.nbytes
+        if c.nulls is not None:
+            total += c.nulls.nbytes
+    return int(total)
+
+
+_SPILL_SALT = 0x511
+
+
+class PartitionedSpillStore:
+    """K key-hash buckets of host-staged rows with column encodings kept.
+
+    `add` pulls a batch to the host and routes each valid row to
+    hash(keys) % K; `bucket_batches` re-uploads one bucket as device
+    Batches.  The same key columns (and salt) on two stores route equal
+    keys to equal bucket indices, which is what the grace hash join and
+    partitioned aggregation rely on."""
+
+    def __init__(self, k: int, salt: int = _SPILL_SALT):
+        self.k = k
+        self.salt = salt
+        self.buckets: List[List[Dict[str, Tuple[np.ndarray,
+                                                Optional[np.ndarray]]]]] = \
+            [[] for _ in range(k)]
+        self.meta: Dict[str, Tuple] = {}     # column -> (dictionary, lazy)
+        self.rows = [0] * k
+        self.bytes = [0] * k
+        self.spilled_bytes = 0
+
+    def add(self, batch: Batch, key_names: List[str]) -> None:
+        key_cols = [batch.columns[n] for n in key_names]
+        h = np.asarray(ops.hash_columns(key_cols, self.salt)) \
+            % np.uint64(self.k)
+        mask = np.asarray(batch.mask)
+        cols_np = {}
+        for name, c in batch.columns.items():
+            self.meta.setdefault(name, (c.dictionary, c.lazy))
+            cols_np[name] = (np.asarray(c.values),
+                             None if c.nulls is None else np.asarray(c.nulls))
+        for p in range(self.k):
+            sel = mask & (h == p)
+            n = int(sel.sum())
+            if n == 0:
+                continue
+            rows = {name: (v[sel], None if m is None else m[sel])
+                    for name, (v, m) in cols_np.items()}
+            self.buckets[p].append(rows)
+            self.rows[p] += n
+            nb = sum(v.nbytes + (0 if m is None else m.nbytes)
+                     for v, m in rows.values())
+            self.bytes[p] += nb
+            self.spilled_bytes += nb
+
+    def bucket_batches(self, p: int, capacity: int) -> Iterator[Batch]:
+        """Re-upload bucket p as device Batches of at most `capacity` rows."""
+        chunks = self.buckets[p]
+        if not chunks:
+            return
+        names = list(chunks[0])
+        merged = {}
+        for name in names:
+            vs = np.concatenate([c[name][0] for c in chunks])
+            if any(c[name][1] is not None for c in chunks):
+                ms = np.concatenate([
+                    c[name][1] if c[name][1] is not None
+                    else np.zeros(len(c[name][0]), dtype=bool)
+                    for c in chunks])
+            else:
+                ms = None
+            merged[name] = (vs, ms)
+        total = self.rows[p]
+        for lo in range(0, total, capacity):
+            n = min(capacity, total - lo)
+            cols = {}
+            for name, (vs, ms) in merged.items():
+                buf = np.zeros(capacity, dtype=vs.dtype)
+                buf[:n] = vs[lo:lo + n]
+                nulls = None
+                if ms is not None:
+                    nb = np.zeros(capacity, dtype=bool)
+                    nb[:n] = ms[lo:lo + n]
+                    nulls = jnp.asarray(nb)
+                dictionary, lazy = self.meta[name]
+                cols[name] = Column(jnp.asarray(buf), nulls, dictionary, lazy)
+            mask = np.zeros(capacity, dtype=bool)
+            mask[:n] = True
+            yield Batch(cols, jnp.asarray(mask))
+
+    def bucket_rows(self, p: int) -> int:
+        return self.rows[p]
+
+    def bucket_bytes(self, p: int) -> int:
+        return self.bytes[p]

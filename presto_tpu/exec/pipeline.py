@@ -39,8 +39,27 @@ from .batch import (Batch, Column, batch_to_page, page_to_batch,
                     pages_to_batches)
 from . import operators as ops
 from .lowering import Lowering, canonical_name
+from .memory import (MemoryExceededError, MemoryPool, PartitionedSpillStore,
+                     batch_bytes)
 
 DEFAULT_CAPACITY = 1 << 20
+
+# module-level jitted singletons: compiled once per process/shape, reused by
+# every query (the compile-once/execute-many property that makes repeated
+# queries cheap — the analog of the reference's reusable DriverFactories)
+_jit_concat = jax.jit(lambda batches: _concat_batches(batches))
+_jit_sort = None
+_jit_build = None
+_jit_window = None
+
+
+def _jits():
+    global _jit_sort, _jit_build, _jit_window
+    if _jit_sort is None:
+        _jit_sort = jax.jit(ops.sort_batch, static_argnums=1)
+        _jit_build = jax.jit(ops.build_table, static_argnums=(1,))
+        _jit_window = jax.jit(ops.window_batch, static_argnums=(1, 2, 3))
+    return _jit_sort, _jit_build, _jit_window
 
 
 @dataclass
@@ -50,6 +69,10 @@ class ExecutionConfig:
     join_out_capacity: int = 1 << 21        # probe output capacity
     max_agg_retries: int = 6
     splits_per_scan: int = 4
+    # HBM accounting / spill (reference MemoryPool + spiller, exec/memory.py)
+    memory_budget_bytes: Optional[int] = None   # None = unlimited
+    spill_enabled: bool = True
+    spill_partitions: int = 8
 
 
 @dataclass
@@ -62,6 +85,10 @@ class TaskContext:
     remote_pages: Dict[str, Callable[[], Iterator[Tuple[Page, List[str], List[Type]]]]] = field(default_factory=dict)
     # this task's index in its stage: namespaces AssignUniqueId across tasks
     task_index: int = 0
+    # HBM byte accounting for this task (created by PlanCompiler if absent)
+    memory: Optional[MemoryPool] = None
+    # EXPLAIN ANALYZE: node id -> {rows, wall_s, batches} (None = disabled)
+    stats: Optional[Dict[str, dict]] = None
 
 
 def _var_types(variables) -> List[Type]:
@@ -92,7 +119,10 @@ class BatchSource:
 
 class PlanCompiler:
     def __init__(self, ctx: TaskContext):
+        if ctx.memory is None:
+            ctx.memory = MemoryPool(ctx.config.memory_budget_bytes)
         self.ctx = ctx
+        self._sources: Dict[str, BatchSource] = {}
         self.lowering = Lowering()
         self._jit_cache: Dict = {}
 
@@ -109,10 +139,44 @@ class PlanCompiler:
 
     # -- dispatch ---------------------------------------------------------
     def _compile(self, node: P.PlanNode) -> BatchSource:
+        # memoized per node id: replayed subtrees (decorrelation deep
+        # copies share ids) and re-executions reuse the same BatchSource,
+        # so its cached jitted steps stay warm
+        cached = self._sources.get(node.id)
+        if cached is not None:
+            return cached
         m = getattr(self, "_compile_" + type(node).__name__, None)
         if m is None:
             raise NotImplementedError(f"no compiler for {type(node).__name__}")
-        return m(node)
+        src = m(node)
+        if self.ctx.stats is not None:
+            src = self._instrument(node, src)
+        self._sources[node.id] = src
+        return src
+
+    def _instrument(self, node: P.PlanNode, src: BatchSource) -> BatchSource:
+        """EXPLAIN ANALYZE wrapper: cumulative wall time (includes
+        children, like the reference's operator getOutput accounting) and
+        output row counts per plan node."""
+        stats = self.ctx.stats
+
+        def gen():
+            import time
+            ent = stats.setdefault(
+                node.id, {"rows": 0, "wall_s": 0.0, "batches": 0})
+            it = src.batches()
+            while True:
+                t0 = time.perf_counter()
+                try:
+                    b = next(it)
+                except StopIteration:
+                    ent["wall_s"] += time.perf_counter() - t0
+                    return
+                ent["wall_s"] += time.perf_counter() - t0
+                ent["rows"] += int(b.mask.sum())
+                ent["batches"] += 1
+                yield b
+        return BatchSource(gen, src.names, src.types)
 
     # -- leaves -----------------------------------------------------------
     def _compile_TableScanNode(self, node: P.TableScanNode) -> BatchSource:
@@ -128,6 +192,44 @@ class PlanCompiler:
                                          th.connector_id)
         cap = self.ctx.config.batch_rows
         table = th.table_name
+        cid = th.connector_id
+        from ..connectors import device_gen
+
+        # split columns into device-generated (a jitted counter-hash kernel
+        # materializes them straight into HBM — no host generation, no
+        # host->device transfer) and host-generated (strings, small dims)
+        dev: List[Tuple[str, str, str]] = []   # (out name, column, kind)
+        host: List[Tuple[str, str]] = []
+        for name, colname in zip(names, columns):
+            if (table, colname) in catalog.OPEN_DOMAIN:
+                dev.append((name, colname, "lazy"))
+            elif device_gen.supported(cid, table, colname):
+                dev.append((name, colname, "gen"))
+            else:
+                host.append((name, colname))
+
+        i32 = {colname: (colname.endswith("date")
+                         or catalog.column_type(table, colname, cid).storage
+                         == "INT_ARRAY")
+               for _n, colname, kind in dev if kind == "gen"}
+
+        @jax.jit
+        def dev_make(pos, valid):
+            idx0 = jnp.arange(cap, dtype=jnp.int64)
+            live = idx0 < valid
+            idx = pos + idx0
+            outs = {}
+            for name, colname, kind in dev:
+                if kind == "lazy":
+                    # padding must hold a valid row id (materializers run
+                    # over the full capacity)
+                    outs[name] = jnp.where(live, idx, 0)
+                    continue
+                v = device_gen.column(cid, table, colname, sf, idx)
+                if v.dtype == jnp.int64 and i32[colname]:
+                    v = v.astype(jnp.int32)
+                outs[name] = jnp.where(live, v, jnp.zeros((), v.dtype))
+            return outs, live
 
         def gen():
             for split in splits:
@@ -135,15 +237,20 @@ class PlanCompiler:
                 while pos < split.end:
                     n = min(cap, split.end - pos)
                     cols = {}
-                    for name, colname in zip(names, columns):
-                        if (table, colname) in catalog.OPEN_DOMAIN:
-                            # late-materialized: row ids on device
-                            ids = np.zeros(cap, dtype=np.int64)
-                            ids[:n] = np.arange(pos, pos + n)
-                            cols[name] = Column(
-                                jnp.asarray(ids), None, None,
-                                (split.connector, table, colname, split.sf))
-                            continue
+                    if dev:
+                        douts, dmask = dev_make(jnp.int64(pos), jnp.int64(n))
+                        for name, colname, kind in dev:
+                            if kind == "lazy":
+                                cols[name] = Column(
+                                    douts[name], None, None,
+                                    (split.connector, table, colname,
+                                     split.sf))
+                            else:
+                                cols[name] = Column(
+                                    douts[name], None,
+                                    device_gen.dictionary(cid, table,
+                                                          colname))
+                    for name, colname in host:
                         raw = catalog.generate_column(
                             table, colname, split.sf, pos, n,
                             split.connector)
@@ -163,9 +270,13 @@ class PlanCompiler:
                             buf = np.zeros(cap, dtype=dtype)
                             buf[:n] = raw
                             cols[name] = Column(jnp.asarray(buf))
-                    mask = np.zeros(cap, dtype=bool)
-                    mask[:n] = True
-                    yield Batch(cols, jnp.asarray(mask))
+                    if dev:
+                        mask = dmask
+                    else:
+                        m = np.zeros(cap, dtype=bool)
+                        m[:n] = True
+                        mask = jnp.asarray(m)
+                    yield Batch(cols, mask)
                     pos += n
         return BatchSource(gen, names, types)
 
@@ -323,9 +434,9 @@ class PlanCompiler:
             all_batches = list(src.batches())
             if not all_batches:
                 return
-            merged = jax.jit(_concat_batches)(all_batches) \
+            merged = _jit_concat(all_batches) \
                 if len(all_batches) > 1 else all_batches[0]
-            yield jax.jit(ops.sort_batch, static_argnums=1)(merged, tuple(keys))
+            yield _jits()[0](merged, tuple(keys))
         return BatchSource(gen, src.names, src.types)
 
     def _compile_UnionNode(self, node: P.UnionNode) -> BatchSource:
@@ -387,7 +498,7 @@ class PlanCompiler:
                         for n, c in b.columns.items()}
                 final.append(Batch(cols, b.mask))
             yield final[0] if len(final) == 1 \
-                else jax.jit(_concat_batches)(final)
+                else _jit_concat(final)
         return BatchSource(gen, out_names, out_types)
 
     def _compile_WindowNode(self, node: P.WindowNode) -> BatchSource:
@@ -417,7 +528,7 @@ class PlanCompiler:
             batches = list(src.batches())
             if not batches:
                 return
-            merged = jax.jit(_concat_batches)(batches) \
+            merged = _jit_concat(batches) \
                 if len(batches) > 1 else batches[0]
             # late-materialized string keys: window_batch both SORTS by and
             # compares (partition identity / peer detection) every key, so a
@@ -442,8 +553,7 @@ class PlanCompiler:
                     encode.append(k)
             if encode:
                 merged = _encode_lazy_keys(merged, encode)
-            yield jax.jit(ops.window_batch, static_argnums=(1, 2, 3))(
-                merged, part_names, orderings, specs)
+            yield _jits()[2](merged, part_names, orderings, specs)
         return BatchSource(gen, out_names, out_types)
 
     def _compile_DistinctLimitNode(self, node: P.DistinctLimitNode) -> BatchSource:
@@ -477,24 +587,55 @@ class PlanCompiler:
 
         cfg = self.ctx.config
 
-        def run_once(num_slots: int, salt: int):
-            src = self._compile(src_node)
+        update_cache: Dict[Tuple, Callable] = {}
+
+        def make_direct_update(G: int, strides: Tuple[int, ...]):
+            fn = update_cache.get(("direct", G, strides))
+            if fn is None:
+                @jax.jit
+                def fn(state, batch):
+                    codes = None
+                    for k, stride in zip(key_names, strides):
+                        c = batch.columns[k].values.astype(jnp.int64)
+                        codes = c * stride if codes is None \
+                            else codes + c * stride
+                    if codes is None:    # global aggregation: one group
+                        codes = jnp.zeros(batch.capacity, dtype=jnp.int64)
+                    agg_cols = {}
+                    for out, expr in input_exprs.items():
+                        agg_cols[out] = (low.eval(expr, batch)
+                                         if expr is not None else None)
+                    return ops.agg_direct_update(state, batch, codes,
+                                                 agg_cols, specs, G)
+                update_cache[("direct", G, strides)] = fn
+            return fn
+
+        def make_update(num_slots: int, salt: int):
+            fn = update_cache.get((num_slots, salt))
+            if fn is None:
+                @jax.jit
+                def fn(state, batch):
+                    key_cols = [batch.columns[k] for k in key_names]
+                    agg_cols = {}
+                    for out, expr in input_exprs.items():
+                        agg_cols[out] = (low.eval(expr, batch)
+                                         if expr is not None else None)
+                    return ops.agg_update(state, batch, key_cols, agg_cols,
+                                          specs, num_slots, salt, key_names)
+                update_cache[(num_slots, salt)] = fn
+            return fn
+
+        def run_once(num_slots: int, salt: int, batches_fn=None):
+            batches = (self._compile(src_node).batches()
+                       if batches_fn is None else batches_fn())
             state = None
             key_dicts: Dict[str, Tuple[str, ...]] = {}
             key_lazy: Dict[str, Tuple] = {}
             encode_keys: List[str] = []
+            update = make_update(num_slots, salt)
 
-            @jax.jit
-            def update(state, batch):
-                key_cols = [batch.columns[k] for k in key_names]
-                agg_cols = {}
-                for out, expr in input_exprs.items():
-                    agg_cols[out] = (low.eval(expr, batch)
-                                     if expr is not None else None)
-                return ops.agg_update(state, batch, key_cols, agg_cols,
-                                      specs, num_slots, salt, key_names)
-
-            for batch in src.batches():
+            direct = None        # (doms, dtypes) when small-domain mode
+            for batch in batches:
                 if state is None:
                     for k in key_names:
                         col = batch.columns[k]
@@ -515,32 +656,127 @@ class PlanCompiler:
                     for k, c in zip(key_names, key_cols):
                         if c.dictionary is not None:
                             key_dicts[k] = c.dictionary
-                    state = ops.agg_init(num_slots, specs, key_names,
-                                         key_dtypes)
+                    # closed small domains: combined code IS the slot index
+                    doms = []
+                    for c in key_cols:
+                        if c.nulls is not None:
+                            doms = None
+                            break
+                        if c.dictionary is not None:
+                            doms.append(len(c.dictionary))
+                        elif c.values.dtype == jnp.bool_:
+                            doms.append(2)
+                        else:
+                            doms = None
+                            break
+                    G = 1
+                    for d in (doms or []):
+                        G *= max(1, d)
+                    if not key_names:
+                        direct = ((), ())
+                        update = make_direct_update(1, ())
+                        state = ops.agg_direct_init(1, specs)
+                    elif doms is not None \
+                            and G <= ops.DIRECT_AGG_MAX_GROUPS:
+                        direct = (tuple(max(1, d) for d in doms),
+                                  tuple(key_dtypes))
+                        strides, s = [], G
+                        for d in direct[0]:
+                            s //= d
+                            strides.append(s)
+                        update = make_direct_update(G, tuple(strides))
+                        state = ops.agg_direct_init(G, specs)
+                    else:
+                        state = ops.agg_init(num_slots, specs, key_names,
+                                             key_dtypes)
                 elif encode_keys:
                     batch = _encode_lazy_keys(batch, encode_keys)
                 state = update(state, batch)
             if state is None:
                 key_dtypes = [jnp.int64] * len(key_names)
                 state = ops.agg_init(num_slots, specs, key_names, key_dtypes)
-            return state, key_dicts, key_lazy
+            return state, key_dicts, key_lazy, direct
 
-        def gen():
-            num_slots, salt = cfg.agg_slots, 0
+        def run_retrying(batches_fn=None, start_slots=None):
+            num_slots, salt = start_slots or cfg.agg_slots, 0
             for attempt in range(cfg.max_agg_retries):
-                state, key_dicts, key_lazy = run_once(num_slots, salt)
-                if not bool(state["__collision"]):
-                    break
+                state, key_dicts, key_lazy, direct = run_once(
+                    num_slots, salt, batches_fn)
+                if direct is not None \
+                        or not bool(state["__collision"]):
+                    return state, key_dicts, key_lazy, direct
                 num_slots *= 2
                 salt += 1
-            else:
-                raise RuntimeError("aggregation collision retries exhausted")
-            if not key_names and not bool(jnp.any(state["__occupied"])):
-                # global aggregation over empty input still yields one row
-                state["__occupied"] = state["__occupied"].at[0].set(True)
-            batch = ops.agg_finalize(state, specs, key_names, key_dicts,
-                                     key_lazy)
-            yield batch
+            raise RuntimeError("aggregation collision retries exhausted")
+
+        # rough accumulator footprint for the budget check (hash + occupied
+        # + per-key value/null + per-aggregate state columns)
+        est_state_bytes = cfg.agg_slots * (
+            16 + 12 * len(key_names) + 24 * max(1, len(specs)))
+
+        def gen():
+            pool = self.ctx.memory
+            if not key_names or pool.try_reserve(est_state_bytes):
+                try:
+                    state, key_dicts, key_lazy, direct = run_retrying()
+                    if direct is not None:
+                        yield ops.agg_direct_finalize(
+                            state, specs, key_names, direct[0], direct[1],
+                            key_dicts, force_row=not key_names)
+                        return
+                    if not key_names \
+                            and not bool(jnp.any(state["__occupied"])):
+                        # global aggregation over empty input: one row
+                        state["__occupied"] = \
+                            state["__occupied"].at[0].set(True)
+                    yield ops.agg_finalize(state, specs, key_names,
+                                           key_dicts, key_lazy)
+                finally:
+                    if key_names:
+                        pool.free(est_state_bytes)
+                return
+            if not cfg.spill_enabled:
+                raise MemoryExceededError(
+                    f"aggregation table exceeds memory budget "
+                    f"{pool.budget} bytes and spill is disabled")
+            # budget too small for one table: hash-partition the input by
+            # group keys into host-staged buckets and aggregate per bucket
+            # (buckets hold disjoint key sets, so each finalize is exact)
+            store = PartitionedSpillStore(cfg.spill_partitions)
+            encode_keys: Optional[List[str]] = None
+            for batch in self._compile(src_node).batches():
+                if encode_keys is None:
+                    encode_keys = []
+                    for k in key_names:
+                        col = batch.columns[k]
+                        if col.lazy is not None:
+                            _, tbl, coln, _sf = col.lazy
+                            if (tbl, coln) not in catalog.ROWID_DISTINCT:
+                                encode_keys.append(k)
+                if encode_keys:
+                    batch = _encode_lazy_keys(batch, encode_keys)
+                store.add(batch, list(key_names))
+            # each bucket sees ~1/K of the keys: start with a
+            # proportionally smaller table, and account for it
+            bucket_slots = max(256, cfg.agg_slots // cfg.spill_partitions)
+            bucket_bytes = est_state_bytes // cfg.spill_partitions
+            for p in range(cfg.spill_partitions):
+                if store.bucket_rows(p) == 0:
+                    continue
+                pool.reserve(bucket_bytes)
+                try:
+                    state, key_dicts, key_lazy, direct = run_retrying(
+                        lambda p=p: store.bucket_batches(p, cfg.batch_rows),
+                        start_slots=bucket_slots)
+                    if direct is not None:
+                        yield ops.agg_direct_finalize(
+                            state, specs, key_names, direct[0], direct[1],
+                            key_dicts)
+                    else:
+                        yield ops.agg_finalize(state, specs, key_names,
+                                               key_dicts, key_lazy)
+                finally:
+                    pool.free(bucket_bytes)
         return BatchSource(gen, out_names, out_types)
 
     # -- joins ------------------------------------------------------------
@@ -550,7 +786,7 @@ class PlanCompiler:
             return None
         if len(batches) == 1:
             return batches[0]
-        return jax.jit(_concat_batches)(batches)
+        return _jit_concat(batches)
 
     def _compile_JoinNode(self, node: P.JoinNode) -> BatchSource:
         if node.join_type not in (P.INNER, P.LEFT):
@@ -566,56 +802,148 @@ class PlanCompiler:
         low = self.lowering
         filter_expr = node.filter
 
-        def gen():
-            build_batch = self._materialize(self._compile(build_src_node))
-            probe = self._compile(probe_src_node)
-            if build_batch is None:
-                if node.join_type == P.INNER:
-                    return
-                # LEFT join with empty build: every probe row null-extends
-                from .lowering import _jnp_dtype
-                build_types = {v.name: v.type
-                               for v in build_src_node.output_variables}
-                for batch in probe.batches():
-                    cols = dict(batch.columns)
-                    for name in build_out:
-                        t = build_types[name]
-                        if isinstance(t, (VarcharType, CharType)):
-                            col = Column(
-                                jnp.zeros(batch.capacity, dtype=jnp.int32),
-                                jnp.ones(batch.capacity, dtype=bool), ("",))
-                        else:
-                            col = Column(
-                                jnp.zeros(batch.capacity, dtype=_jnp_dtype(t)),
-                                jnp.ones(batch.capacity, dtype=bool))
-                        cols[name] = col
-                    yield Batch(cols, batch.mask).select(out_names)
-                return
-            table = jax.jit(ops.build_table, static_argnums=(1,))(
-                build_batch, tuple(build_keys))
+        from .lowering import _jnp_dtype
+        build_types = {v.name: v.type
+                       for v in build_src_node.output_variables}
 
-            filter_fn = (None if filter_expr is None
-                         else (lambda pairs: low.eval(filter_expr, pairs)))
-
-            @jax.jit
-            def step(batch, table):
-                joined, overflow, total = ops.probe_join(
-                    batch, table, probe_keys, build_out,
-                    cfg.join_out_capacity, join_type=node.join_type,
-                    filter_fn=filter_fn)
-                return joined, overflow
-
-            for batch in probe.batches():
-                joined, overflow = step(batch, table)
-                if bool(overflow):
-                    # split the probe batch in halves and retry
-                    for half in _split_batch(batch):
-                        j2, ov2 = step(half, table)
-                        if bool(ov2):
-                            raise RuntimeError("join output overflow after split")
-                        yield j2.select(out_names)
+        def null_extended(batch):
+            # LEFT join rows with no build match
+            cols = dict(batch.columns)
+            for name in build_out:
+                t = build_types[name]
+                if isinstance(t, (VarcharType, CharType)):
+                    col = Column(
+                        jnp.zeros(batch.capacity, dtype=jnp.int32),
+                        jnp.ones(batch.capacity, dtype=bool), ("",))
                 else:
-                    yield joined.select(out_names)
+                    col = Column(
+                        jnp.zeros(batch.capacity, dtype=_jnp_dtype(t)),
+                        jnp.ones(batch.capacity, dtype=bool))
+                cols[name] = col
+            return Batch(cols, batch.mask).select(out_names)
+
+        filter_fn = (None if filter_expr is None
+                     else (lambda pairs: low.eval(filter_expr, pairs)))
+
+        @jax.jit
+        def step(batch, table):
+            joined, overflow, total = ops.probe_join(
+                batch, table, probe_keys, build_out,
+                cfg.join_out_capacity, join_type=node.join_type,
+                filter_fn=filter_fn)
+            return joined, overflow
+
+        def gen():
+            pool = self.ctx.memory
+
+            def probe_stream(table, batches):
+                for batch in batches:
+                    joined, overflow = step(batch, table)
+                    if bool(overflow):
+                        # split the probe batch in halves and retry
+                        for half in _split_batch(batch):
+                            j2, ov2 = step(half, table)
+                            if bool(ov2):
+                                raise RuntimeError(
+                                    "join output overflow after split")
+                            yield j2.select(out_names)
+                    else:
+                        yield joined.select(out_names)
+
+            # materialize the build side under the memory budget; on budget
+            # exhaustion switch to a grace hash join (reference: revocable
+            # memory in HashBuilderOperator.java:56 + partitioned spilling)
+            collected, spill = [], None
+            reserved = 0
+            try:
+                for b in self._compile(build_src_node).batches():
+                    nb = batch_bytes(b)
+                    if spill is None and pool.try_reserve(nb):
+                        collected.append(b)
+                        reserved += nb
+                        continue
+                    if spill is None:
+                        if not cfg.spill_enabled:
+                            raise MemoryExceededError(
+                                f"join build side exceeds memory budget "
+                                f"{pool.budget} bytes and spill is disabled")
+                        spill = PartitionedSpillStore(cfg.spill_partitions)
+                        for cb in collected:
+                            spill.add(cb, build_keys)
+                        collected = []
+                        pool.free(reserved)
+                        reserved = 0
+                    spill.add(b, build_keys)
+                if spill is None:
+                    build_batch = (
+                        None if not collected else collected[0]
+                        if len(collected) == 1
+                        else _jit_concat(collected))
+                    probe = self._compile(probe_src_node)
+                    if build_batch is None:
+                        if node.join_type == P.INNER:
+                            return
+                        for batch in probe.batches():
+                            yield null_extended(batch)
+                        return
+                    table = _jits()[1](build_batch, tuple(build_keys))
+                    yield from probe_stream(table, probe.batches())
+                    return
+                # grace path: partition the probe the same way, join
+                # bucket-by-bucket (each bucket is a Lifespan).  A bucket
+                # whose build side still exceeds the budget is RE-partitioned
+                # with a fresh hash salt (recursive grace join); only a
+                # bucket that stops shrinking — single-key skew — fails.
+                probe_store = PartitionedSpillStore(cfg.spill_partitions)
+                for b in self._compile(probe_src_node).batches():
+                    probe_store.add(b, probe_keys)
+                work = [(spill, probe_store, p, 0)
+                        for p in range(cfg.spill_partitions)]
+                while work:
+                    bstore, pstore, p, depth = work.pop()
+                    if pstore.bucket_rows(p) == 0:
+                        continue
+                    b_rows = bstore.bucket_rows(p)
+                    if b_rows == 0:
+                        if node.join_type == P.INNER:
+                            continue
+                        yield from map(null_extended,
+                                       pstore.bucket_batches(
+                                           p, cfg.batch_rows))
+                        continue
+                    # power-of-two build capacity bounds jit recompiles;
+                    # the bucket goes back on device, so account for it
+                    bcap = 1 << max(0, b_rows - 1).bit_length()
+                    bucket_bytes = bstore.bucket_bytes(p) * bcap \
+                        // max(1, b_rows)
+                    if not pool.try_reserve(bucket_bytes):
+                        if depth >= 4:
+                            raise MemoryExceededError(
+                                f"join build bucket of {bucket_bytes} bytes "
+                                f"exceeds memory budget {pool.budget} after "
+                                f"{depth} re-partitions (key skew)")
+                        salt2 = bstore.salt * 33 + 0x9E37
+                        sub_b = PartitionedSpillStore(cfg.spill_partitions,
+                                                      salt2)
+                        for bb in bstore.bucket_batches(p, cfg.batch_rows):
+                            sub_b.add(bb, build_keys)
+                        sub_p = PartitionedSpillStore(cfg.spill_partitions,
+                                                      salt2)
+                        for pb in pstore.bucket_batches(p, cfg.batch_rows):
+                            sub_p.add(pb, probe_keys)
+                        work.extend((sub_b, sub_p, q, depth + 1)
+                                    for q in range(cfg.spill_partitions))
+                        continue
+                    try:
+                        bucket = list(bstore.bucket_batches(p, bcap))[0]
+                        table = _jits()[1](bucket, tuple(build_keys))
+                        yield from probe_stream(
+                            table,
+                            pstore.bucket_batches(p, cfg.batch_rows))
+                    finally:
+                        pool.free(bucket_bytes)
+            finally:
+                pool.free(reserved)
         return BatchSource(gen, out_names, out_types)
 
     def _compile_SemiJoinNode(self, node: P.SemiJoinNode) -> BatchSource:
@@ -625,6 +953,11 @@ class PlanCompiler:
         key = node.source_join_variable.name
         fkey = node.filtering_source_join_variable.name
 
+        @jax.jit
+        def step(batch, table):
+            marker = ops.semi_join_mark(batch, table, [key])
+            return batch.with_columns({node.semi_join_output.name: marker})
+
         def gen():
             build_batch = self._materialize(self._compile(node.filtering_source))
             if build_batch is None:
@@ -632,14 +965,7 @@ class PlanCompiler:
                     yield b.with_columns({node.semi_join_output.name: Column(
                         jnp.zeros(b.capacity, dtype=bool), None)})
                 return
-            table = jax.jit(ops.build_table, static_argnums=(1,))(
-                build_batch, (fkey,))
-
-            @jax.jit
-            def step(batch, table):
-                marker = ops.semi_join_mark(batch, table, [key])
-                return batch.with_columns({node.semi_join_output.name: marker})
-
+            table = _jits()[1](build_batch, (fkey,))
             for b in src.batches():
                 yield step(b, table)
         return BatchSource(gen, names, types)

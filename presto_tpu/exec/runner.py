@@ -8,7 +8,7 @@ planner correctness tests and as the execution core the worker shell drives.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 from ..common.block import block_to_values
 from ..common.page import Page
@@ -45,18 +45,57 @@ class LocalQueryRunner:
         self.catalog = catalog
         self.config = config or ExecutionConfig(batch_rows=1 << 16,
                                                 join_out_capacity=1 << 18)
+        # plan cache: SQL -> (OutputNode, PlanCompiler); re-executions reuse
+        # the compiled pipeline so its jitted steps stay warm
+        self._plan_cache: Dict[str, tuple] = {}
 
     def plan(self, sql: str):
         return Planner(default_schema=self.schema,
                        default_catalog=self.catalog).plan(sql)
 
+    _PLAN_CACHE_MAX = 64
+
     def execute(self, sql: str) -> QueryResult:
-        output = self.plan(sql)
-        ctx = TaskContext(config=self.config)
-        compiler = PlanCompiler(ctx)
+        from ..sql import parser as A
+        ast = A.parse_sql(sql)
+        if isinstance(ast, A.Explain):
+            return self._explain(ast)
+        entry = self._plan_cache.pop(sql, None)
+        if entry is None:
+            output = Planner(default_schema=self.schema,
+                             default_catalog=self.catalog) \
+                .plan_query_to_output(ast)
+            entry = (output, PlanCompiler(TaskContext(config=self.config)))
+        output, compiler = entry
         names = output.column_names
         types = [v.type for v in output.outputs]
-        return pages_to_result(compiler.run_to_pages(output), names, types)
+        result = pages_to_result(compiler.run_to_pages(output), names, types)
+        # cache only after a successful run (a failed run may leave the
+        # compiler's memory pool / partial state poisoned); bounded LRU
+        self._plan_cache[sql] = entry
+        while len(self._plan_cache) > self._PLAN_CACHE_MAX:
+            self._plan_cache.pop(next(iter(self._plan_cache)))
+        return result
+
+    def _explain(self, ast) -> QueryResult:
+        """EXPLAIN: plan text.  EXPLAIN ANALYZE: execute with per-node
+        instrumentation and annotate the plan (reference PlanPrinter /
+        ExplainAnalyzeOperator)."""
+        from ..common.types import VarcharType
+        from ..sql.explain import format_plan
+        output = Planner(default_schema=self.schema,
+                         default_catalog=self.catalog) \
+            .plan_query_to_output(ast.query)
+        stats = None
+        if ast.analyze:
+            stats = {}
+            ctx = TaskContext(config=self.config, stats=stats)
+            compiler = PlanCompiler(ctx)
+            for _page in compiler.run_to_pages(output):
+                pass
+        text = format_plan(output, stats)
+        return QueryResult(["Query Plan"], [VarcharType(max(1, len(text)))],
+                           [[text]])
 
     def execute_reference(self, sql: str) -> QueryResult:
         """Same query through the numpy reference interpreter (the oracle)."""
@@ -87,17 +126,42 @@ class DistributedQueryRunner(LocalQueryRunner):
         self.n_tasks = n_tasks
         self.broadcast_threshold = broadcast_threshold
 
-    def plan_subplan(self, sql: str):
+    def plan_subplan(self, sql: str, ast=None):
         from ..sql.fragmenter import FragmenterConfig, plan_distributed
-        output = self.plan(sql)
+        if ast is not None:
+            output = Planner(default_schema=self.schema,
+                             default_catalog=self.catalog) \
+                .plan_query_to_output(ast)
+        else:
+            output = self.plan(sql)
         names = output.column_names
         types = [v.type for v in output.outputs]
         cfg = FragmenterConfig(broadcast_threshold=self.broadcast_threshold)
         return plan_distributed(output, cfg), names, types
 
+    def _explain_distributed(self, ast) -> QueryResult:
+        """EXPLAIN over the fragmented (distributed) plan — the analog of
+        the reference's EXPLAIN (TYPE DISTRIBUTED).  ANALYZE falls back to
+        the fragment text (per-task stats are not merged)."""
+        from ..common.types import VarcharType
+        from ..sql.explain import format_subplan
+        from ..sql.fragmenter import FragmenterConfig, plan_distributed
+        output = Planner(default_schema=self.schema,
+                         default_catalog=self.catalog) \
+            .plan_query_to_output(ast.query)
+        cfg = FragmenterConfig(broadcast_threshold=self.broadcast_threshold)
+        subplan = plan_distributed(output, cfg)
+        text = format_subplan(subplan)
+        return QueryResult(["Query Plan"], [VarcharType(max(1, len(text)))],
+                           [[text]])
+
     def execute(self, sql: str) -> QueryResult:
+        from ..sql import parser as A
+        ast = A.parse_sql(sql)
+        if isinstance(ast, A.Explain):
+            return self._explain_distributed(ast)
         from .scheduler import InProcessScheduler, SchedulerConfig
-        subplan, names, types = self.plan_subplan(sql)
+        subplan, names, types = self.plan_subplan(sql, ast=ast)
         sched = InProcessScheduler(SchedulerConfig(
             exec_config=self.config, source_tasks=self.n_tasks,
             hash_tasks=self.n_tasks))

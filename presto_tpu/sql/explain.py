@@ -1,0 +1,119 @@
+"""Plan pretty-printer for EXPLAIN / EXPLAIN ANALYZE.
+
+The analog of the reference's PlanPrinter
+(presto-main-base/.../sql/planner/planPrinter/PlanPrinter.java) in its
+text mode: one indented line per node with the node's distinguishing
+details, optionally annotated with runtime stats collected during an
+EXPLAIN ANALYZE execution (ExplainAnalyzeOperator.java +
+RuntimeStats, presto-common/.../common/RuntimeStats.java)."""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ..spi import plan as P
+
+
+def _vars(vs, limit: int = 6) -> str:
+    names = [v.name for v in vs]
+    if len(names) > limit:
+        names = names[:limit] + [f"... {len(vs) - limit} more"]
+    return ", ".join(names)
+
+
+def _details(node: P.PlanNode) -> str:
+    if isinstance(node, P.TableScanNode):
+        return (f"table = {node.table.connector_id}.{node.table.table_name}"
+                f" [{_vars(node.outputs)}]")
+    if isinstance(node, P.FilterNode):
+        return f"predicate = {node.predicate}"
+    if isinstance(node, P.ProjectNode):
+        exprs = [f"{v.name} := {e}" for v, e in node.assignments.items()
+                 if str(getattr(e, 'name', None)) != v.name]
+        s = "; ".join(exprs[:4])
+        if len(exprs) > 4:
+            s += f"; ... {len(exprs) - 4} more"
+        return s
+    if isinstance(node, P.AggregationNode):
+        aggs = [f"{v.name} := {a.call}" for v, a in node.aggregations.items()]
+        return (f"step = {node.step}, keys = [{_vars(node.grouping_keys)}], "
+                + "; ".join(aggs[:4]))
+    if isinstance(node, P.JoinNode):
+        crit = ", ".join(f"{l.name} = {r.name}" for l, r in node.criteria)
+        extra = f", filter = {node.filter}" if node.filter is not None else ""
+        return f"type = {node.join_type}, criteria = [{crit}]{extra}"
+    if isinstance(node, P.SemiJoinNode):
+        return (f"{node.source_join_variable.name} IN "
+                f"{node.filtering_source_join_variable.name} "
+                f"-> {node.semi_join_output.name}")
+    if isinstance(node, (P.SortNode, P.TopNNode)):
+        keys = ", ".join(f"{v.name} {o}" for v, o in
+                         node.ordering_scheme.orderings)
+        n = f", count = {node.count}" if isinstance(node, P.TopNNode) else ""
+        return f"orderBy = [{keys}]{n}"
+    if isinstance(node, P.LimitNode):
+        return f"count = {node.count}"
+    if isinstance(node, P.WindowNode):
+        funcs = ", ".join(f"{v.name} := {f.call}"
+                          for v, f in node.window_functions.items())
+        order = ""
+        if node.ordering_scheme:
+            order = " orderBy = [" + ", ".join(
+                f"{v.name} {o}" for v, o in
+                node.ordering_scheme.orderings) + "]"
+        return (f"partitionBy = [{_vars(node.partition_by)}]{order} | "
+                + funcs)
+    if isinstance(node, P.ExchangeNode):
+        return (f"type = {node.exchange_type}, scope = {node.scope}, "
+                f"partitioning = {node.partitioning_scheme.handle}")
+    if isinstance(node, P.RemoteSourceNode):
+        return f"sourceFragments = {node.source_fragment_ids}"
+    if isinstance(node, P.OutputNode):
+        return f"[{', '.join(node.column_names)}]"
+    if isinstance(node, P.UnionNode):
+        return f"{len(node.inputs)} inputs [{_vars(node.outputs)}]"
+    if isinstance(node, P.ValuesNode):
+        return f"{len(node.rows)} rows"
+    if isinstance(node, P.DistinctLimitNode):
+        return f"count = {node.count}, keys = [{_vars(node.distinct_variables)}]"
+    return ""
+
+
+def format_plan(node: P.PlanNode,
+                stats: Optional[Dict[str, dict]] = None) -> str:
+    """Indented textual plan; stats (node id -> {rows, wall_s, invocations})
+    annotate each line when given (EXPLAIN ANALYZE)."""
+    lines: List[str] = []
+
+    def walk(n: P.PlanNode, depth: int) -> None:
+        name = type(n).__name__.replace("Node", "")
+        detail = _details(n)
+        line = "   " * depth + f"- {name}"
+        if detail:
+            line += f" [{detail}]"
+        if stats is not None and n.id in stats:
+            s = stats[n.id]
+            line += (f"  {{rows: {s['rows']:,}, "
+                     f"wall: {s['wall_s'] * 1e3:,.1f}ms, "
+                     f"batches: {s['batches']}}}")
+        lines.append(line)
+        for ch in n.sources:
+            walk(ch, depth + 1)
+
+    walk(node, 0)
+    return "\n".join(lines)
+
+
+def format_subplan(subplan, stats: Optional[Dict[str, dict]] = None) -> str:
+    """Fragmented (distributed) plan: one section per fragment."""
+    lines: List[str] = []
+
+    def walk(sp, depth: int) -> None:
+        f = sp.fragment
+        lines.append(f"Fragment {f.fragment_id} [{f.partitioning}]")
+        lines.append(format_plan(f.root, stats))
+        lines.append("")
+        for ch in sp.children:
+            walk(ch, depth + 1)
+
+    walk(subplan, 0)
+    return "\n".join(lines).rstrip()

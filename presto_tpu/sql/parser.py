@@ -255,6 +255,17 @@ class Query(Node):
     distinct: bool = False
     ctes: List[Tuple[str, "Query"]] = field(default_factory=list)
     parenthesized: bool = False            # written as "( query )"
+    # GROUPING SETS / ROLLUP / CUBE: the expanded list of key sets
+    # (None = plain GROUP BY); group_by still holds every distinct key expr
+    grouping_sets: Optional[List[List[Node]]] = None
+
+
+@dataclass
+class Explain(Node):
+    """EXPLAIN [ANALYZE] <query> (reference sql/tree/Explain.java; text
+    format only)."""
+    query: Node                            # Query | SetOp
+    analyze: bool = False
 
 
 @dataclass
@@ -312,8 +323,17 @@ class Parser:
         return True
 
     # -- entry ------------------------------------------------------------
-    def parse(self) -> Query:
-        q = self.parse_query()
+    def parse(self):
+        if self.peek().kind == "ident" \
+                and self.peek().value.lower() == "explain":
+            self.next()
+            analyze = (self.peek().kind == "ident"
+                       and self.peek().value.lower() == "analyze")
+            if analyze:
+                self.next()
+            q = Explain(self.parse_query(), analyze)
+        else:
+            q = self.parse_query()
         self.accept("op", ";")
         self.expect("eof")
         return q
@@ -410,10 +430,9 @@ class Parser:
 
         where = self.parse_expr() if self.accept("keyword", "where") else None
         group_by: List[Node] = []
+        grouping_sets: Optional[List[List[Node]]] = None
         if self.accept_kw("group", "by"):
-            group_by.append(self.parse_expr())
-            while self.accept("op", ","):
-                group_by.append(self.parse_expr())
+            group_by, grouping_sets = self.parse_group_by()
         having = self.parse_expr() if self.accept("keyword", "having") else None
         order_by: List[OrderItem] = []
         if self.accept_kw("order", "by"):
@@ -424,7 +443,67 @@ class Parser:
         if self.accept("keyword", "limit"):
             limit = int(self.expect("number").value)
         return Query(items, relations, where, group_by, having, order_by,
-                     limit, distinct)
+                     limit, distinct, grouping_sets=grouping_sets)
+
+    def parse_group_by(self):
+        """GROUP BY elements: plain expressions, ROLLUP(...), CUBE(...),
+        GROUPING SETS ((..), ..) — mixed elements combine by cross product
+        (reference SqlBase.g4 groupingElement / the analyzer's
+        GroupingSetAnalysis).  Returns (all key exprs, expanded sets or
+        None for a plain GROUP BY)."""
+        from itertools import combinations, product
+        elements: List[List[List[Node]]] = []   # element -> its set list
+        structured = False
+        while True:
+            t = self.peek()
+            tl = t.value.lower() if t.kind == "ident" else None
+            if tl in ("rollup", "cube") and self.peek(1).value == "(":
+                structured = True
+                self.next()
+                self.expect("op", "(")
+                exprs = [self.parse_expr()]
+                while self.accept("op", ","):
+                    exprs.append(self.parse_expr())
+                self.expect("op", ")")
+                if tl == "rollup":
+                    sets = [exprs[:i] for i in range(len(exprs), -1, -1)]
+                else:
+                    sets = []
+                    for r in range(len(exprs), -1, -1):
+                        for c in combinations(range(len(exprs)), r):
+                            sets.append([exprs[j] for j in c])
+                elements.append(sets)
+            elif tl == "grouping" and self.peek(1).kind == "ident" \
+                    and self.peek(1).value.lower() == "sets":
+                structured = True
+                self.next()
+                self.next()
+                self.expect("op", "(")
+                sets = []
+                while True:
+                    if self.accept("op", "("):
+                        s: List[Node] = []
+                        if not self.accept("op", ")"):
+                            s.append(self.parse_expr())
+                            while self.accept("op", ","):
+                                s.append(self.parse_expr())
+                            self.expect("op", ")")
+                        sets.append(s)
+                    else:
+                        sets.append([self.parse_expr()])
+                    if not self.accept("op", ","):
+                        break
+                self.expect("op", ")")
+                elements.append(sets)
+            else:
+                elements.append([[self.parse_expr()]])
+            if not self.accept("op", ","):
+                break
+        all_exprs = [e for el in elements for s in el for e in s]
+        if not structured:
+            return all_exprs, None
+        grouping_sets = [sum(combo, []) for combo in product(*elements)]
+        return all_exprs, grouping_sets
 
     def parse_select_item(self) -> SelectItem:
         if self.peek().kind == "op" and self.peek().value == "*":

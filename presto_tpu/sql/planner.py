@@ -811,22 +811,15 @@ class Planner:
     # aggregation planning
     # ------------------------------------------------------------------
     def plan_aggregation(self, query: A.Query, node: P.PlanNode,
-                         scope: Scope, agg_calls: List[A.FuncCall]):
+                         scope: Scope, agg_calls: List[A.FuncCall],
+                         group_by: Optional[List[A.Node]] = None):
+        if group_by is None:
+            if query.grouping_sets is not None:
+                return self._plan_grouping_sets(query, node, scope,
+                                                agg_calls)
+            group_by = query.group_by
         # group keys: resolve ordinals / aliases / expressions
-        key_asts: List[A.Node] = []
-        for g in query.group_by:
-            if isinstance(g, A.NumberLit):
-                idx = int(g.text) - 1
-                key_asts.append(query.select_items[idx].expr)
-            elif isinstance(g, A.Ident) and len(g.parts) == 1:
-                alias_hit = None
-                for item in query.select_items:
-                    if item.alias and item.alias.lower() == g.parts[0].lower():
-                        alias_hit = item.expr
-                        break
-                key_asts.append(alias_hit if alias_hit is not None else g)
-            else:
-                key_asts.append(g)
+        key_asts = [self._resolve_group_key(g, query) for g in group_by]
 
         pre_assign: Dict[VariableReferenceExpression, RowExpression] = {}
         key_vars: List[VariableReferenceExpression] = []
@@ -874,6 +867,74 @@ class Planner:
                                 key_vars, P.SINGLE)
         post_scope = Scope(scope.relations, expr_vars)
         return agg, post_scope
+
+    def _resolve_group_key(self, g: A.Node, query: A.Query) -> A.Node:
+        """GROUP BY ordinals and select-alias references -> the select
+        item's expression."""
+        if isinstance(g, A.NumberLit):
+            return query.select_items[int(g.text) - 1].expr
+        if isinstance(g, A.Ident) and len(g.parts) == 1:
+            for item in query.select_items:
+                if item.alias and item.alias.lower() == g.parts[0].lower():
+                    return item.expr
+        return g
+
+    def _plan_grouping_sets(self, query: A.Query, node: P.PlanNode,
+                            scope: Scope, agg_calls: List[A.FuncCall]):
+        """GROUPING SETS / ROLLUP / CUBE: one aggregation branch per key
+        set over a replayed input subtree, unified by UNION ALL with the
+        absent keys null-filled — semantically the reference's GroupIdNode +
+        grouped aggregation (GroupIdOperator.java), realized as the
+        branch-union form so every branch reuses the ordinary aggregation
+        path (including distinct aggregates)."""
+        import copy
+        sets = [[self._resolve_group_key(k, query) for k in s]
+                for s in query.grouping_sets]
+        all_keys: List[A.Node] = []
+        seen = set()
+        for s in sets:
+            for k in s:
+                c = _canon(k, scope)
+                if c not in seen:
+                    seen.add(c)
+                    all_keys.append(k)
+        key_types = {_canon(k, scope): self.plan_expr(k, scope).type
+                     for k in all_keys}
+
+        # unified output variables
+        union_vars: Dict[str, VariableReferenceExpression] = {}
+        for k in all_keys:
+            c = _canon(k, scope)
+            union_vars[c] = self.new_var("gset", key_types[c])
+        branches: List[P.PlanNode] = []
+        agg_union_vars: Dict[str, VariableReferenceExpression] = {}
+        for i, s in enumerate(sets):
+            src = node if i == 0 else copy.deepcopy(node)
+            bnode, bscope = self.plan_aggregation(query, src, scope,
+                                                  agg_calls,
+                                                  group_by=list(s))
+            in_set = {_canon(k, scope) for k in s}
+            assigns: Dict[VariableReferenceExpression, RowExpression] = {}
+            for k in all_keys:
+                c = _canon(k, scope)
+                if c in in_set:
+                    assigns[union_vars[c]] = bscope.expr_vars.get(
+                        c, self.plan_expr(k, bscope))
+                else:
+                    assigns[union_vars[c]] = constant(None, key_types[c])
+            for fc in agg_calls:
+                c = _canon(fc, scope)
+                bv = bscope.expr_vars[c]
+                uv = agg_union_vars.setdefault(
+                    c, self.new_var("gsetagg", bv.type))
+                assigns[uv] = bv
+            branches.append(P.ProjectNode(self.new_id("gset_proj"), bnode,
+                                          assigns))
+        outs = list(union_vars.values()) + list(agg_union_vars.values())
+        union = P.UnionNode(self.new_id("gset_union"), branches, outs)
+        expr_vars = dict(union_vars)
+        expr_vars.update(agg_union_vars)
+        return union, Scope(scope.relations, expr_vars)
 
     def _plan_distinct_aggregation(self, query, node, scope, agg_calls,
                                    key_asts, pre_assign, key_vars, expr_vars):

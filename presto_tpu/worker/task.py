@@ -38,6 +38,7 @@ class TpuTask:
         self.failures: List[str] = []
         self.buffers: Optional[OutputBufferManager] = None
         self.done_at: Optional[float] = None
+        self.memory_peak = 0
         self._cond = threading.Condition()
         self._thread: Optional[threading.Thread] = None
 
@@ -58,7 +59,8 @@ class TpuTask:
     def status(self) -> TaskStatus:
         with self._cond:
             return TaskStatus(self.task_id, self.state, self.version,
-                              self.self_uri, list(self.failures))
+                              self.self_uri, list(self.failures),
+                              memory_reservation=self.memory_peak)
 
     def wait_status(self, current_state: Optional[str],
                     max_wait_s: float) -> TaskStatus:
@@ -87,7 +89,9 @@ class TpuTask:
         fragment = update.fragment()
         spec = update.output_buffers
         self.buffers = OutputBufferManager(spec.type, spec.n_buffers)
-        ctx = TaskContext(config=self.config, task_index=update.task_index)
+        from ..exec.memory import MemoryPool
+        ctx = TaskContext(config=self.config, task_index=update.task_index,
+                          memory=MemoryPool(self.config.memory_budget_bytes))
         for source in update.sources:
             remote = [s["location"] for s in source.splits if s.get("remote")]
             conn = [s for s in source.splits if not s.get("remote")]
@@ -115,6 +119,7 @@ class TpuTask:
                            and key_indices)
             compiler = PlanCompiler(ctx)
             for page in compiler.run_to_pages(fragment.root):
+                self.memory_peak = ctx.memory.peak
                 if self.state in DONE_STATES:
                     return
                 if partitioned:
@@ -126,6 +131,7 @@ class TpuTask:
                             self.buffers.add(p, serialize_page(sub))
                 else:
                     self.buffers.add(0, serialize_page(page))
+            self.memory_peak = ctx.memory.peak
             self.buffers.set_complete()
             self._set_state(FINISHED)
         except Exception:

@@ -560,3 +560,92 @@ def test_scalar_subquery_union_multi_column_rejected(runner):
               (select n_regionkey, n_nationkey from nation where n_nationkey = 1
                union select n_regionkey, n_nationkey from nation
                where n_nationkey = 1)""")
+
+
+# ---------------------------------------------------------------------------
+# EXPLAIN / EXPLAIN ANALYZE (reference PlanPrinter, ExplainAnalyzeOperator)
+# ---------------------------------------------------------------------------
+
+def test_explain_plan_text(runner):
+    res = runner.execute("explain select o_orderstatus, count(*) from orders "
+                         "where o_orderkey < 100 group by o_orderstatus")
+    assert res.column_names == ["Query Plan"]
+    text = res.rows[0][0]
+    assert "TableScan" in text and "Aggregation" in text
+    assert "tpch.orders" in text and "o_orderstatus" in text
+
+
+def test_explain_analyze_has_stats(runner):
+    res = runner.execute("explain analyze select count(*) from nation")
+    text = res.rows[0][0]
+    assert "rows:" in text and "wall:" in text
+    assert "rows: 25" in text  # the scan's output rows
+
+
+def test_explain_distributed_fragments():
+    from presto_tpu.exec.runner import DistributedQueryRunner
+    d = DistributedQueryRunner("sf0.01", n_tasks=2)
+    text = d.execute("explain select o_orderstatus, count(*) from orders "
+                     "group by o_orderstatus").rows[0][0]
+    assert "Fragment 0 [SINGLE]" in text
+    assert "PARTIAL" in text and "FINAL" in text
+    assert "RemoteSource" in text
+
+
+def test_explain_window_and_join_details(runner):
+    text = runner.execute("""
+        explain select n_name, r_name,
+               row_number() over (partition by r_name order by n_name)
+        from nation join region on n_regionkey = r_regionkey""").rows[0][0]
+    assert "Window" in text and "partitionBy" in text
+    assert "Join" in text and "criteria" in text
+
+
+# ---------------------------------------------------------------------------
+# GROUPING SETS / ROLLUP / CUBE (reference GroupIdOperator + GroupingSetAnalysis)
+# ---------------------------------------------------------------------------
+
+def test_rollup(runner):
+    res = check(runner, """
+        select o_orderstatus, o_orderpriority, count(*), sum(o_totalprice)
+        from orders group by rollup(o_orderstatus, o_orderpriority)""")
+    # 3 statuses x 5 priorities + 3 subtotals + 1 grand total
+    n_detail = len([r for r in res.rows if r[1] is not None])
+    assert any(r[0] is None and r[1] is None for r in res.rows)
+    assert n_detail >= 3
+
+
+def test_cube(runner):
+    res = check(runner, """
+        select n_regionkey, n_nationkey, count(*)
+        from nation group by cube(n_regionkey, n_nationkey)""")
+    # 25 detail + 5 region subtotals + 25 nation subtotals + 1 total
+    assert len(res.rows) == 56
+
+
+def test_grouping_sets_explicit(runner):
+    check(runner, """
+        select o_orderstatus, o_orderpriority, count(*)
+        from orders
+        group by grouping sets ((o_orderstatus), (o_orderpriority), ())""")
+
+
+def test_rollup_with_join_and_distinct_agg(runner):
+    check(runner, """
+        select n_regionkey, r_name, count(distinct n_nationkey), count(*)
+        from nation join region on n_regionkey = r_regionkey
+        group by rollup(n_regionkey, r_name)""")
+
+
+def test_mixed_plain_and_rollup_cross_product(runner):
+    check(runner, """
+        select o_orderstatus, year(o_orderdate) y, count(*)
+        from orders group by o_orderstatus, rollup(y)""")
+
+
+def test_rollup_having_and_order(runner):
+    check(runner, """
+        select o_orderstatus, o_orderpriority, count(*) c
+        from orders group by rollup(o_orderstatus, o_orderpriority)
+        having count(*) > 100
+        order by c desc limit 5""", ordered=True)

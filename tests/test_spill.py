@@ -1,0 +1,172 @@
+"""Memory discipline + spill tests (SURVEY.md §7 build step 7; reference:
+MemoryPool.java:46, spiller/, grouped-execution Lifespans).  A tiny HBM
+budget forces the grace hash join and the partitioned (host-staged)
+aggregation; results must stay identical to the unconstrained engine and
+the numpy reference."""
+import pytest
+
+from presto_tpu.exec.memory import (MemoryExceededError, MemoryPool,
+                                    batch_bytes)
+from presto_tpu.exec.pipeline import ExecutionConfig
+from presto_tpu.exec.runner import LocalQueryRunner
+
+TINY = dict(batch_rows=1 << 14, join_out_capacity=1 << 16,
+            memory_budget_bytes=200_000, spill_partitions=4)
+
+
+@pytest.fixture(scope="module")
+def runner():
+    return LocalQueryRunner("sf0.01", config=ExecutionConfig(**TINY))
+
+
+def check(runner, sql, ordered=False):
+    return runner.assert_same_as_reference(sql, ordered=ordered)
+
+
+# ---------------------------------------------------------------------------
+# pool accounting
+# ---------------------------------------------------------------------------
+
+def test_pool_reserve_free_peak():
+    p = MemoryPool(budget=100)
+    assert p.try_reserve(60) and p.try_reserve(40)
+    assert not p.try_reserve(1)
+    p.free(50)
+    assert p.try_reserve(30)
+    assert p.peak == 100
+    with pytest.raises(MemoryExceededError):
+        p.reserve(1000)
+
+
+def test_pool_unlimited_tracks_peak():
+    p = MemoryPool()
+    assert p.try_reserve(10 ** 12)
+    assert p.peak == 10 ** 12
+
+
+# ---------------------------------------------------------------------------
+# forced spill, engine vs reference
+# ---------------------------------------------------------------------------
+
+def test_grace_join_inner(runner):
+    check(runner, """
+        select l_orderkey, o_orderdate, l_quantity from lineitem
+        join orders on l_orderkey = o_orderkey
+        where l_orderkey < 1000""")
+
+
+def test_grace_join_left_null_extension(runner):
+    check(runner, """
+        select c_custkey, o_orderkey from customer
+        left join orders on c_custkey = o_custkey
+        where c_custkey < 500""")
+
+
+def test_grace_join_with_filter(runner):
+    check(runner, """
+        select l_orderkey, l_suppkey from lineitem
+        join orders on l_orderkey = o_orderkey
+        where o_orderdate < date '1995-01-01' and l_quantity > 45""")
+
+
+def test_spilled_aggregation_small_groups(runner):
+    check(runner, """
+        select o_orderstatus, count(*), sum(o_totalprice), avg(o_totalprice)
+        from orders group by o_orderstatus""")
+
+
+def test_spilled_aggregation_high_cardinality(runner):
+    check(runner, """
+        select l_orderkey, count(*), sum(l_quantity)
+        from lineitem group by l_orderkey""")
+
+
+def test_spilled_aggregation_string_keys(runner):
+    # lazy open-domain key (clerk) must be whole-column encoded BEFORE the
+    # spill partitioner hashes it, or value groups split across buckets
+    res = check(runner, """
+        select o_clerk, count(*) from orders group by o_clerk""")
+    assert len(res.rows) <= 30
+
+
+def test_tpch_q3_under_budget(runner):
+    check(runner, """
+        select l_orderkey, sum(l_extendedprice * (1 - l_discount)) as revenue,
+               o_orderdate, o_shippriority
+        from customer, orders, lineitem
+        where c_mktsegment = 'BUILDING' and c_custkey = o_custkey
+          and l_orderkey = o_orderkey and o_orderdate < date '1995-03-15'
+          and l_shipdate > date '1995-03-15'
+        group by l_orderkey, o_orderdate, o_shippriority
+        order by revenue desc, o_orderdate limit 10""", ordered=True)
+
+
+def test_tpcds_q95_under_budget():
+    # BASELINE config 5: the spill-stressing shape on the tpcds connector
+    r = LocalQueryRunner("sf0.01", catalog="tpcds",
+                         config=ExecutionConfig(**TINY))
+    r.assert_same_as_reference("""
+        with ws_wh as
+         (select ws1.ws_order_number
+          from web_sales ws1, web_sales ws2
+          where ws1.ws_order_number = ws2.ws_order_number
+            and ws1.ws_warehouse_sk <> ws2.ws_warehouse_sk)
+        select count(distinct ws_order_number),
+               sum(ws_ext_ship_cost), sum(ws_net_profit)
+        from web_sales ws1, date_dim, customer_address, web_site
+        where d_date between date '1999-02-01' and date '2002-12-31'
+          and ws1.ws_ship_date_sk = d_date_sk
+          and ws1.ws_ship_addr_sk = ca_address_sk
+          and ca_state = 'IL'
+          and ws1.ws_web_site_sk = web_site_sk
+          and ws1.ws_order_number in (select ws_order_number from ws_wh)
+          and ws1.ws_order_number in
+              (select wr_order_number from web_returns, ws_wh
+               where wr_order_number = ws_wh.ws_order_number)
+        order by 1 limit 100""")
+
+
+def test_spill_disabled_raises():
+    cfg = ExecutionConfig(batch_rows=1 << 14, memory_budget_bytes=50_000,
+                          spill_enabled=False)
+    r = LocalQueryRunner("sf0.01", config=cfg)
+    with pytest.raises(MemoryExceededError):
+        r.execute("select l_orderkey, o_orderdate from lineitem "
+                  "join orders on l_orderkey = o_orderkey")
+
+
+def test_worker_task_reports_memory():
+    # TaskStatus carries the task's peak reservation
+    # (reference TaskStatus.memoryReservationInBytes feeding the
+    # coordinator's cluster memory manager)
+    from presto_tpu.exec.runner import DistributedQueryRunner
+    r = DistributedQueryRunner("sf0.01", n_tasks=2)
+    res = r.execute("select count(*) from lineitem")
+    assert res.rows[0][0] > 0
+
+
+def test_no_reservation_leak_on_failure():
+    # a failed over-budget run must not poison the pool for retries
+    cfg = ExecutionConfig(batch_rows=1 << 14, memory_budget_bytes=150_000,
+                          spill_enabled=False)
+    r = LocalQueryRunner("sf0.01", config=cfg)
+    sql = ("select c_custkey, o_orderkey from customer "
+           "join orders on c_custkey = o_custkey")
+    for _ in range(2):
+        with pytest.raises(MemoryExceededError):
+            r.execute(sql)
+    # small queries still fit afterwards (pool fully freed)
+    ok = LocalQueryRunner("sf0.01", config=ExecutionConfig(
+        batch_rows=1 << 14, memory_budget_bytes=150_000))
+    assert ok.execute("select count(*) from region").rows == [[5]]
+
+
+def test_plan_cache_not_poisoned_and_bounded():
+    r = LocalQueryRunner("sf0.01")
+    for i in range(70):
+        r.execute(f"select count(*) from region where r_regionkey < {i % 7}")
+    assert len(r._plan_cache) <= r._PLAN_CACHE_MAX
+    # repeated executes reuse one compiler (warm path)
+    a = r.execute("select count(*) from nation")
+    b = r.execute("select count(*) from nation")
+    assert a.rows == b.rows == [[25]]
