@@ -469,7 +469,8 @@ def build_table(batch: Batch, key_names: List[str], salt: int = 0) -> BuildTable
 
 def probe_join(batch: Batch, table: BuildTable, probe_keys: List[str],
                build_output: List[str], out_capacity: int,
-               salt: int = 0, join_type: str = "INNER", filter_fn=None):
+               salt: int = 0, join_type: str = "INNER", filter_fn=None,
+               matched=None):
     """Equi-join probe: returns (joined Batch, overflow flag, total).
 
     Output columns = all probe columns + build_output (renamed by caller).
@@ -511,11 +512,14 @@ def probe_join(batch: Batch, table: BuildTable, probe_keys: List[str],
         if pred.nulls is not None:
             keep = keep & ~pred.nulls
         pairs = pairs.with_mask(pairs.mask & keep)
+    if matched is not None:
+        # FULL: record which build rows found a surviving match
+        matched = matched.at[build_idx].max(pairs.mask, mode="drop")
     if join_type == "INNER":
-        return pairs, overflow, total
+        return pairs, overflow, total, matched
 
-    # LEFT: append one null-extended row per probe row without a surviving
-    # match (extra region of batch.capacity rows)
+    # LEFT/FULL: append one null-extended row per probe row without a
+    # surviving match (extra region of batch.capacity rows)
     has_match = jnp.zeros(batch.capacity, dtype=bool).at[row].max(
         pairs.mask, mode="drop")
     extra_mask = batch.mask & ~has_match
@@ -536,7 +540,7 @@ def probe_join(batch: Batch, table: BuildTable, probe_keys: List[str],
                                  jnp.ones(batch.capacity, dtype=bool)])
         final_cols[name] = Column(values, nulls, src.dictionary, src.lazy)
     final_mask = jnp.concatenate([pairs.mask, extra_mask])
-    return Batch(final_cols, final_mask), overflow, total
+    return Batch(final_cols, final_mask), overflow, total, matched
 
 
 def semi_join_mark(batch: Batch, table: BuildTable, probe_keys: List[str],

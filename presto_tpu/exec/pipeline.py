@@ -789,8 +789,9 @@ class PlanCompiler:
         return _jit_concat(batches)
 
     def _compile_JoinNode(self, node: P.JoinNode) -> BatchSource:
-        if node.join_type not in (P.INNER, P.LEFT):
+        if node.join_type not in (P.INNER, P.LEFT, P.FULL):
             raise NotImplementedError(f"join type {node.join_type}")
+        full = node.join_type == P.FULL
         probe_src_node, build_src_node = node.left, node.right
         probe_keys = [l.name for l, r in node.criteria]
         build_keys = [r.name for l, r in node.criteria]
@@ -826,29 +827,58 @@ class PlanCompiler:
                      else (lambda pairs: low.eval(filter_expr, pairs)))
 
         @jax.jit
-        def step(batch, table):
-            joined, overflow, total = ops.probe_join(
+        def step(batch, table, matched=None):
+            joined, overflow, total, matched = ops.probe_join(
                 batch, table, probe_keys, build_out,
-                cfg.join_out_capacity, join_type=node.join_type,
-                filter_fn=filter_fn)
-            return joined, overflow
+                cfg.join_out_capacity,
+                join_type="LEFT" if full else node.join_type,
+                filter_fn=filter_fn, matched=matched)
+            return joined, overflow, matched
+
+        probe_names = [n for n in out_names if n not in build_out]
+
+        def unmatched_build(build_batch, matched):
+            """FULL: build rows no probe row matched, probe side nulled."""
+            from .lowering import _jnp_dtype
+            probe_types = {v.name: v.type
+                           for v in node.left.output_variables}
+            cap = build_batch.capacity
+            cols = {}
+            for name in build_out:
+                cols[name] = build_batch.columns[name]
+            for name in probe_names:
+                t = probe_types[name]
+                if isinstance(t, (VarcharType, CharType)):
+                    cols[name] = Column(jnp.zeros(cap, dtype=jnp.int32),
+                                        jnp.ones(cap, dtype=bool), ("",))
+                else:
+                    cols[name] = Column(jnp.zeros(cap, dtype=_jnp_dtype(t)),
+                                        jnp.ones(cap, dtype=bool))
+            return Batch(cols, build_batch.mask & ~matched) \
+                .select(out_names)
 
         def gen():
             pool = self.ctx.memory
 
-            def probe_stream(table, batches):
+            def probe_stream(table, batches, build_batch=None):
+                # matched is threaded through for FULL joins; the build
+                # rows nobody matched are emitted null-extended at the end
+                matched = (jnp.zeros(build_batch.capacity, dtype=bool)
+                           if full else None)
                 for batch in batches:
-                    joined, overflow = step(batch, table)
+                    joined, overflow, matched = step(batch, table, matched)
                     if bool(overflow):
                         # split the probe batch in halves and retry
                         for half in _split_batch(batch):
-                            j2, ov2 = step(half, table)
+                            j2, ov2, matched = step(half, table, matched)
                             if bool(ov2):
                                 raise RuntimeError(
                                     "join output overflow after split")
                             yield j2.select(out_names)
                     else:
                         yield joined.select(out_names)
+                if full:
+                    yield unmatched_build(build_batch, matched)
 
             # materialize the build side under the memory budget; on budget
             # exhaustion switch to a grace hash join (reference: revocable
@@ -887,7 +917,8 @@ class PlanCompiler:
                             yield null_extended(batch)
                         return
                     table = _jits()[1](build_batch, tuple(build_keys))
-                    yield from probe_stream(table, probe.batches())
+                    yield from probe_stream(table, probe.batches(),
+                                            build_batch)
                     return
                 # grace path: partition the probe the same way, join
                 # bucket-by-bucket (each bucket is a Lifespan).  A bucket
@@ -901,9 +932,12 @@ class PlanCompiler:
                         for p in range(cfg.spill_partitions)]
                 while work:
                     bstore, pstore, p, depth = work.pop()
-                    if pstore.bucket_rows(p) == 0:
-                        continue
+                    p_rows = pstore.bucket_rows(p)
                     b_rows = bstore.bucket_rows(p)
+                    # FULL still visits probe-empty buckets: their build
+                    # rows must be emitted null-extended
+                    if p_rows == 0 and (not full or b_rows == 0):
+                        continue
                     if b_rows == 0:
                         if node.join_type == P.INNER:
                             continue
@@ -939,7 +973,8 @@ class PlanCompiler:
                         table = _jits()[1](bucket, tuple(build_keys))
                         yield from probe_stream(
                             table,
-                            pstore.bucket_batches(p, cfg.batch_rows))
+                            pstore.bucket_batches(p, cfg.batch_rows),
+                            bucket)
                     finally:
                         pool.free(bucket_bytes)
             finally:

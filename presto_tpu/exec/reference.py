@@ -473,34 +473,42 @@ def _exec_JoinNode(node: P.JoinNode) -> Table:
             keep &= ~m
     pairs = pairs.mask(keep)
 
-    if node.join_type != P.LEFT:
+    if node.join_type not in (P.LEFT, P.FULL):
         return pairs
 
-    # 3. LEFT: null-extend probe rows with no surviving match
-    surviving = set(li[keep].tolist())
-    miss_rows = np.array([i for i in range(left.n) if i not in surviving],
-                         dtype=np.int64)
-    ext_cols = {}
+    # 3. LEFT/FULL: null-extend rows of the preserved side(s) with no
+    # surviving match
+    def extend(side: Table, other: Table, kept_idx: np.ndarray) -> Table:
+        surviving = set(kept_idx.tolist())
+        miss = np.array([i for i in range(side.n) if i not in surviving],
+                        dtype=np.int64)
+        cols = {}
+        for n in out_names:
+            if n in side.cols:
+                v, m = side.cols[n]
+                cols[n] = (v[miss], None if m is None else m[miss])
+            else:
+                v, _ = other.cols[n]
+                ev = np.zeros(len(miss), dtype=v.dtype) \
+                    if v.dtype != object \
+                    else np.empty(len(miss), dtype=object)
+                cols[n] = (ev, np.ones(len(miss), dtype=bool))
+        return Table(cols, len(miss))
+
+    parts = [pairs, extend(left, right, li[keep])]
+    if node.join_type == P.FULL:
+        parts.append(extend(right, left, ri[keep]))
+    cols = {}
     for n in out_names:
-        pv, pm = pairs.cols[n]
-        if n in left.cols:
-            v, m = left.cols[n]
-            ev = v[miss_rows]
-            em = None if m is None else m[miss_rows]
+        vals = np.concatenate([p.cols[n][0] for p in parts])
+        if any(p.cols[n][1] is not None for p in parts):
+            nm = np.concatenate([p.cols[n][1] if p.cols[n][1] is not None
+                                 else np.zeros(p.n, dtype=bool)
+                                 for p in parts])
         else:
-            v, _ = right.cols[n]
-            ev = np.zeros(len(miss_rows), dtype=v.dtype) \
-                if v.dtype != object else np.empty(len(miss_rows), dtype=object)
-            em = np.ones(len(miss_rows), dtype=bool)
-        vals = np.concatenate([pv, ev])
-        if pm is None and em is None:
             nm = None
-        else:
-            nm = np.concatenate([
-                pm if pm is not None else np.zeros(pairs.n, bool),
-                em if em is not None else np.zeros(len(miss_rows), bool)])
-        ext_cols[n] = (vals, nm)
-    return Table(ext_cols, pairs.n + len(miss_rows))
+        cols[n] = (vals, nm)
+    return Table(cols, sum(p.n for p in parts))
 
 
 def _exec_AssignUniqueIdNode(node: P.AssignUniqueIdNode) -> Table:

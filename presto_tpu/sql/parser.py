@@ -550,6 +550,8 @@ class Parser:
                 jt = "LEFT"
             elif self.accept_kw("right", "outer", "join") or self.accept_kw("right", "join"):
                 jt = "RIGHT"
+            elif self.accept_kw("full", "outer", "join") or self.accept_kw("full", "join"):
+                jt = "FULL"
             elif self.accept_kw("cross", "join"):
                 jt = "CROSS"
             else:

@@ -438,9 +438,17 @@ class Planner:
                     {**{v: v for v in _scope_vars(right_scope)},
                      ck_r: constant(0, BIGINT)})
                 criteria = [(ck_l, ck_r)]
-            node = P.JoinNode(self.new_id("join"),
-                              "INNER" if jt == "CROSS" else jt,
-                              node, next_node, criteria, outputs, jf)
+            if jt == "RIGHT":
+                # RIGHT = LEFT with sides swapped (reference join-side
+                # normalization); the preserved side becomes the probe
+                node = P.JoinNode(self.new_id("join"), P.LEFT,
+                                  next_node, node,
+                                  [(r, l) for l, r in criteria],
+                                  outputs, jf)
+            else:
+                node = P.JoinNode(self.new_id("join"),
+                                  "INNER" if jt == "CROSS" else jt,
+                                  node, next_node, criteria, outputs, jf)
             scopes.append(next_scope)
 
         # leftovers that need the whole scope (e.g. cross-relation non-equi)

@@ -14,6 +14,7 @@ import itertools
 import json
 import threading
 import time
+import urllib.error
 import urllib.request
 from typing import Dict, List, Optional
 
@@ -26,6 +27,68 @@ from .protocol import (DONE_STATES, FAILED, OutputBuffersSpec, TaskSource,
                        TaskStatus, TaskUpdateRequest)
 
 _query_counter = itertools.count()
+
+
+class HeartbeatFailureDetector:
+    """Coordinator-side liveness probing (reference
+    presto-main/.../failureDetector/HeartbeatFailureDetector.java:77 +
+    DiscoveryNodeManager.refreshNodesInternal): each worker's
+    /v1/info/state is polled on an interval; a node failing `threshold`
+    consecutive probes — or reporting SHUTTING_DOWN — is dropped from
+    scheduling until it responds ACTIVE again."""
+
+    def __init__(self, worker_uris: List[str], interval_s: float = 0.5,
+                 threshold: int = 3):
+        self.worker_uris = list(worker_uris)
+        self.threshold = threshold
+        self._streak = {u: 0 for u in self.worker_uris}
+        self._draining = set()
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        # one prober per worker: a hung node must not delay detection of
+        # the others (the reference probes asynchronously per service)
+        self._threads = [
+            threading.Thread(target=self._loop, args=(uri, interval_s),
+                             name=f"failure-detector-{i}", daemon=True)
+            for i, uri in enumerate(self.worker_uris)]
+        for t in self._threads:
+            t.start()
+
+    def _probe(self, uri: str):
+        try:
+            with urllib.request.urlopen(uri + "/v1/info/state",
+                                        timeout=2.0) as resp:
+                return json.loads(resp.read())
+        except (OSError, ValueError):
+            return None
+
+    def _loop(self, uri: str, interval_s: float) -> None:
+        while not self._stop.is_set():
+            state = self._probe(uri)
+            with self._lock:
+                if state is None:
+                    self._streak[uri] += 1
+                else:
+                    self._streak[uri] = 0
+                    if state == "SHUTTING_DOWN":
+                        self._draining.add(uri)
+                    else:
+                        self._draining.discard(uri)
+            self._stop.wait(interval_s)
+
+    def alive(self) -> List[str]:
+        with self._lock:
+            return [u for u in self.worker_uris
+                    if self._streak[u] < self.threshold
+                    and u not in self._draining]
+
+    def failed(self) -> List[str]:
+        with self._lock:
+            return [u for u in self.worker_uris
+                    if self._streak[u] >= self.threshold]
+
+    def close(self) -> None:
+        self._stop.set()
 
 
 class RemoteTask:
@@ -80,13 +143,25 @@ class HttpQueryRunner(LocalQueryRunner):
     (presto-tests/.../DistributedQueryRunner.java:190-215)."""
 
     def __init__(self, worker_uris: List[str], schema: str = "sf0.01",
+                 failure_detector: Optional[HeartbeatFailureDetector] = None,
                  config: Optional[ExecutionConfig] = None,
                  n_tasks: int = 2, broadcast_threshold: int = 600_000):
         super().__init__(schema, config)
         self.worker_uris = worker_uris
+        self.failure_detector = failure_detector
         self.n_tasks = n_tasks
         self.broadcast_threshold = broadcast_threshold
         self._rr = itertools.count()
+
+    def _live_uris(self) -> List[str]:
+        """Schedulable workers (reference NodeScheduler.createNodeSelector
+        consuming the failure detector's view)."""
+        if self.failure_detector is None:
+            return self.worker_uris
+        live = self.failure_detector.alive()
+        if not live:
+            raise RuntimeError("no live workers")
+        return live
 
     # -- planning ---------------------------------------------------------
     def plan_subplan(self, sql: str):
@@ -155,8 +230,9 @@ class HttpQueryRunner(LocalQueryRunner):
                         if isinstance(n, P.RemoteSourceNode)]
         child_by_fid = {c.fragment.fragment_id: c for c in stage.children}
 
+        live = self._live_uris()
         for ti in range(stage.n_tasks):
-            worker = self.worker_uris[next(self._rr) % len(self.worker_uris)]
+            worker = live[next(self._rr) % len(live)]
             task_id = f"{qid}.{stage_path.replace('.', '_')}.{ti}"
             sources = []
             for node_id, splits in scan_splits.items():
@@ -175,9 +251,26 @@ class HttpQueryRunner(LocalQueryRunner):
                             {"remote": True,
                              "location": ct.result_location(buffer_id)})
                 sources.append(TaskSource(rnode.id, locations))
-            task = RemoteTask(worker, task_id)
             req = TaskUpdateRequest.make(task_id, ti, frag, sources, spec)
-            task.update(req)
+            # a draining worker answers 503 (server.py do_task_update):
+            # reroute the task to the next live worker (reference
+            # SqlStageExecution retrying placement on node refusal)
+            candidates = [worker] + [u for u in live if u != worker]
+            task = None
+            last_err = None
+            for cand in candidates:
+                task = RemoteTask(cand, task_id)
+                try:
+                    task.update(req)
+                    break
+                except urllib.error.HTTPError as e:
+                    if e.code != 503:
+                        raise
+                    last_err = e
+                    task = None
+            if task is None:
+                raise RuntimeError(
+                    f"no worker accepted task {task_id}: {last_err}")
             stage.tasks.append(task)
             all_tasks.append(task)
 

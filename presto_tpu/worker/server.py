@@ -12,6 +12,9 @@ endpoint setup) on Python's stdlib threading HTTP server:
   GET    /v1/task/{taskId}/results/{b}/{token}/acknowledge
   DELETE /v1/task/{taskId}/results/{b}
   GET    /v1/info, /v1/info/state
+  PUT    /v1/info/state                         graceful shutdown (drain)
+  GET    /v1/status                             node status (NodeStatus.java)
+  GET    /v1/metrics                            Prometheus text exposition
   PUT    /v1/announcement/{nodeId}              (coordinator role: discovery)
   GET    /v1/service                            (coordinator role: node list)
 """
@@ -31,6 +34,9 @@ from .task import TaskManager
 
 _ROUTES = [
     ("GET", re.compile(r"^/v1/info/state$"), "info_state"),
+    ("PUT", re.compile(r"^/v1/info/state$"), "info_state_put"),
+    ("GET", re.compile(r"^/v1/status$"), "status"),
+    ("GET", re.compile(r"^/v1/metrics$"), "metrics"),
     ("GET", re.compile(r"^/v1/info$"), "info"),
     ("GET", re.compile(r"^/v1/service$"), "service"),
     ("PUT", re.compile(r"^/v1/announcement/(?P<node>[^/]+)$"), "announce"),
@@ -98,11 +104,13 @@ class _Handler(BaseHTTPRequestHandler):
         if obj is not None:
             body = json.dumps(obj).encode()
         self.send_response(code)
-        self.send_header("Content-Type",
-                         "application/json" if obj is not None
-                         else "application/x-presto-pages")
+        hdrs = dict(headers or {})
+        if "Content-Type" not in hdrs:
+            self.send_header("Content-Type",
+                             "application/json" if obj is not None
+                             else "application/x-presto-pages")
         self.send_header("Content-Length", str(len(body)))
-        for k, v in (headers or {}).items():
+        for k, v in hdrs.items():
             self.send_header(k, v)
         self.end_headers()
         self.wfile.write(body)
@@ -120,7 +128,56 @@ class _Handler(BaseHTTPRequestHandler):
                          "uptime": f"{time.time() - s.started_at:.0f}s"})
 
     def do_info_state(self, groups, query):
-        self._send(200, "ACTIVE")
+        self._send(200, self.server_ref.state)
+
+    def do_info_state_put(self, groups, query):
+        """Graceful shutdown (reference GracefulShutdownHandler /
+        presto_cpp PrestoServer.cpp:648-688): stop accepting tasks, drain
+        running ones, then report SHUTTING_DOWN until the process exits."""
+        body = json.loads(self._body())
+        if body != "SHUTTING_DOWN":
+            self._send(400, {"error": f"unsupported state {body!r}"})
+            return
+        self.server_ref.begin_shutdown()
+        self._send(200, "SHUTTING_DOWN")
+
+    def do_status(self, groups, query):
+        """Node status (reference server/NodeStatus.java: the payload the
+        coordinator's memory manager and UI poll)."""
+        s = self.server_ref
+        c = s.task_manager.counts()
+        self._send(200, {
+            "nodeId": s.node_id,
+            "nodeVersion": {"version": "presto-tpu-0.1"},
+            "environment": s.environment,
+            "coordinator": s.coordinator,
+            "state": s.state,
+            "uptime": f"{time.time() - s.started_at:.0f}s",
+            "tasks": c["by_state"],
+            "totalTasks": c["created"],
+            "heapUsed": c["memory_peak"],   # HBM peak, heap-shaped field
+        })
+
+    def do_metrics(self, groups, query):
+        """Prometheus text exposition (reference
+        presto_cpp/main/runtime-metrics/PrometheusStatsReporter.h:40)."""
+        s = self.server_ref
+        c = s.task_manager.counts()
+        lines = [
+            "# TYPE presto_tpu_uptime_seconds gauge",
+            f"presto_tpu_uptime_seconds {time.time() - s.started_at:.1f}",
+            "# TYPE presto_tpu_tasks_created_total counter",
+            f"presto_tpu_tasks_created_total {c['created']}",
+            "# TYPE presto_tpu_task_memory_peak_bytes gauge",
+            f"presto_tpu_task_memory_peak_bytes {c['memory_peak']}",
+            "# TYPE presto_tpu_tasks gauge",
+        ]
+        for state, n in sorted(c["by_state"].items()):
+            lines.append(
+                'presto_tpu_tasks{state="%s"} %d' % (state.lower(), n))
+        self._send(200, None, ("\n".join(lines) + "\n").encode(),
+                   headers={"Content-Type":
+                            "text/plain; version=0.0.4; charset=utf-8"})
 
     def do_service(self, groups, query):
         s = self.server_ref
@@ -142,6 +199,10 @@ class _Handler(BaseHTTPRequestHandler):
         self._send(202, {"ok": True})
 
     def do_task_update(self, groups, query):
+        if self.server_ref.state != "ACTIVE":
+            # draining node refuses new work; the coordinator reroutes
+            self._send(503, {"error": "node is shutting down"})
+            return
         update = TaskUpdateRequest.from_dict(json.loads(self._body()))
         status = self.server_ref.task_manager.create_or_update(update)
         self._send(200, status.to_dict())
@@ -203,6 +264,7 @@ class WorkerServer:
                  announce_interval_s: float = 1.0):
         self.environment = environment
         self.coordinator = coordinator
+        self.state = "ACTIVE"            # ACTIVE | SHUTTING_DOWN
         self.discovery: Optional[Dict[str, dict]] = {} if coordinator else None
         self.discovery_lock = threading.Lock()
         self.started_at = time.time()
@@ -250,6 +312,29 @@ class WorkerServer:
         with self.discovery_lock:
             return [a["services"][0]["properties"]["http"]
                     for a in (self.discovery or {}).values()]
+
+    def begin_shutdown(self) -> None:
+        """Refuse new tasks, wait for running ones to drain, then stop the
+        server (reference GracefulShutdownHandler / native
+        PrestoServer.cpp:648-688)."""
+        with self.discovery_lock:
+            if self.state != "ACTIVE":
+                return
+            self.state = "SHUTTING_DOWN"
+
+        def drain():
+            # grace period first, so the coordinator observes the drain
+            # state before the endpoints disappear (the reference waits
+            # 2x the announcement interval for the same reason)
+            time.sleep(2.0)
+            deadline = time.time() + 30.0
+            while time.time() < deadline:
+                counts = self.task_manager.counts()["by_state"]
+                if not any(s in ("RUNNING", "PLANNED") for s in counts):
+                    break
+                time.sleep(0.1)
+            self.close()
+        threading.Thread(target=drain, name="drain", daemon=True).start()
 
     def close(self) -> None:
         self._stop.set()

@@ -154,6 +154,18 @@ class TaskManager:
                                                 join_out_capacity=1 << 18)
         self.tasks: Dict[str, TpuTask] = {}
         self._lock = threading.Lock()
+        self.tasks_created = 0
+
+    def counts(self) -> Dict[str, int]:
+        """Live task-state counts + lifetime counters (metrics/status)."""
+        with self._lock:
+            by_state: Dict[str, int] = {}
+            mem_peak = 0
+            for t in self.tasks.values():
+                by_state[t.state] = by_state.get(t.state, 0) + 1
+                mem_peak = max(mem_peak, t.memory_peak)
+            return {"created": self.tasks_created, "by_state": by_state,
+                    "memory_peak": mem_peak}
 
     def _evict_locked(self) -> None:
         import time
@@ -170,6 +182,7 @@ class TaskManager:
             self._evict_locked()
             task = self.tasks.get(update.task_id)
             if task is None:
+                self.tasks_created += 1
                 task = TpuTask(update.task_id,
                                f"{self.base_uri}/v1/task/{update.task_id}",
                                self.config)

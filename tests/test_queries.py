@@ -649,3 +649,53 @@ def test_rollup_having_and_order(runner):
         from orders group by rollup(o_orderstatus, o_orderpriority)
         having count(*) > 100
         order by c desc limit 5""", ordered=True)
+
+
+# ---------------------------------------------------------------------------
+# RIGHT / FULL OUTER joins
+# ---------------------------------------------------------------------------
+
+def test_right_join(runner):
+    check(runner, """
+        select n_name, r_name from region right join nation
+        on n_regionkey = r_regionkey""")
+
+
+def test_right_join_null_extension(runner):
+    # customers without orders survive with null order columns
+    res = check(runner, """
+        select c_custkey, o_orderkey from orders
+        right join customer on c_custkey = o_custkey
+        where c_custkey < 100""")
+    assert any(r[1] is None for r in res.rows)
+
+
+def test_full_outer_join(runner):
+    res = check(runner, """
+        select a.n_nationkey, b.k from nation a
+        full outer join (select n_nationkey + 20 k from nation) b
+        on a.n_nationkey = b.k""")
+    # 25 left rows (5 matched) + 20 unmatched right rows
+    assert len(res.rows) == 45
+    assert any(r[0] is None for r in res.rows)
+    assert any(r[1] is None for r in res.rows)
+
+
+def test_full_join_distributed():
+    from presto_tpu.exec.runner import DistributedQueryRunner
+    d = DistributedQueryRunner("sf0.01", n_tasks=3, broadcast_threshold=0)
+    d.assert_same_as_reference("""
+        select a.n_nationkey, b.k from nation a
+        full outer join (select n_nationkey + 20 k from nation) b
+        on a.n_nationkey = b.k""")
+
+
+def test_full_join_under_spill_budget():
+    from presto_tpu.exec.pipeline import ExecutionConfig
+    r = LocalQueryRunner("sf0.01", config=ExecutionConfig(
+        batch_rows=1 << 14, join_out_capacity=1 << 16,
+        memory_budget_bytes=200_000, spill_partitions=4))
+    r.assert_same_as_reference("""
+        select c_custkey, o_orderkey from customer
+        full outer join orders on c_custkey = o_custkey
+        where c_custkey < 500 or c_custkey is null""")

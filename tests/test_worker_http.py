@@ -164,3 +164,107 @@ def test_external_worker_process(cluster):
     finally:
         proc.terminate()
         proc.wait(timeout=10)
+
+
+def test_status_and_metrics_endpoints():
+    import json as _json
+    import urllib.request
+    from presto_tpu.worker.server import WorkerServer
+    w = WorkerServer()
+    try:
+        with urllib.request.urlopen(w.uri + "/v1/status", timeout=5) as r:
+            st = _json.loads(r.read())
+        assert st["nodeId"] == w.node_id and st["state"] == "ACTIVE"
+        with urllib.request.urlopen(w.uri + "/v1/metrics", timeout=5) as r:
+            text = r.read().decode()
+        assert "presto_tpu_uptime_seconds" in text
+        assert "presto_tpu_tasks_created_total 0" in text
+    finally:
+        w.close()
+
+
+def test_graceful_shutdown_refuses_new_tasks():
+    import json as _json
+    import urllib.request
+    import urllib.error
+    from presto_tpu.worker.server import WorkerServer
+    w = WorkerServer()
+    try:
+        req = urllib.request.Request(
+            w.uri + "/v1/info/state",
+            data=_json.dumps("SHUTTING_DOWN").encode(), method="PUT",
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=5) as r:
+            assert _json.loads(r.read()) == "SHUTTING_DOWN"
+        with urllib.request.urlopen(w.uri + "/v1/info/state", timeout=5) as r:
+            assert _json.loads(r.read()) == "SHUTTING_DOWN"
+        # new task creation now refused with 503
+        try:
+            urllib.request.urlopen(urllib.request.Request(
+                w.uri + "/v1/task/q.0.0", data=b"{}", method="POST"),
+                timeout=5)
+            assert False, "expected 503"
+        except urllib.error.HTTPError as e:
+            assert e.code == 503
+    finally:
+        w.close()
+
+
+def test_failure_detector_drops_dead_and_draining_workers():
+    import json as _json
+    import time
+    import urllib.request
+    from presto_tpu.worker.coordinator import (HeartbeatFailureDetector,
+                                               HttpQueryRunner)
+    from presto_tpu.worker.server import WorkerServer
+    w1, w2, w3 = WorkerServer(), WorkerServer(), WorkerServer()
+    det = HeartbeatFailureDetector(
+        [w1.uri, w2.uri, w3.uri], interval_s=0.1, threshold=2)
+    try:
+        deadline = time.time() + 5
+        while time.time() < deadline and len(det.alive()) != 3:
+            time.sleep(0.1)
+        assert sorted(det.alive()) == sorted([w1.uri, w2.uri, w3.uri])
+        # kill one, drain another
+        w3.close()
+        req = urllib.request.Request(
+            w2.uri + "/v1/info/state",
+            data=_json.dumps("SHUTTING_DOWN").encode(), method="PUT",
+            headers={"Content-Type": "application/json"})
+        urllib.request.urlopen(req, timeout=5).close()
+        deadline = time.time() + 10
+        while time.time() < deadline and det.alive() != [w1.uri]:
+            time.sleep(0.1)
+        assert det.alive() == [w1.uri]
+        assert det.failed() == [w3.uri]
+        # queries keep running on the surviving worker
+        r = HttpQueryRunner([w1.uri, w2.uri, w3.uri], "sf0.01",
+                            failure_detector=det, n_tasks=2)
+        res = r.execute("select count(*) from nation")
+        assert res.rows == [[25]]
+    finally:
+        det.close()
+        w1.close()
+        w2.close()
+
+
+def test_draining_worker_task_rerouted():
+    # a 503 from a draining worker must send the task to a live one
+    import json as _json
+    import urllib.request
+    from presto_tpu.worker.coordinator import HttpQueryRunner
+    from presto_tpu.worker.server import WorkerServer
+    w1, w2 = WorkerServer(), WorkerServer()
+    try:
+        req = urllib.request.Request(
+            w2.uri + "/v1/info/state",
+            data=_json.dumps("SHUTTING_DOWN").encode(), method="PUT",
+            headers={"Content-Type": "application/json"})
+        urllib.request.urlopen(req, timeout=5).close()
+        # no failure detector: scheduler hits the draining worker and must
+        # fall back on the 503
+        r = HttpQueryRunner([w2.uri, w1.uri], "sf0.01", n_tasks=2)
+        assert r.execute("select count(*) from nation").rows == [[25]]
+    finally:
+        w1.close()
+        w2.close()
