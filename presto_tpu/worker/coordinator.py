@@ -145,12 +145,14 @@ class HttpQueryRunner(LocalQueryRunner):
     def __init__(self, worker_uris: List[str], schema: str = "sf0.01",
                  failure_detector: Optional[HeartbeatFailureDetector] = None,
                  config: Optional[ExecutionConfig] = None,
-                 n_tasks: int = 2, broadcast_threshold: int = 600_000):
+                 n_tasks: int = 2, broadcast_threshold: int = 600_000,
+                 session: Optional[Dict[str, str]] = None):
         super().__init__(schema, config)
         self.worker_uris = worker_uris
         self.failure_detector = failure_detector
         self.n_tasks = n_tasks
         self.broadcast_threshold = broadcast_threshold
+        self.session = session or {}
         self._rr = itertools.count()
 
     def _live_uris(self) -> List[str]:
@@ -251,7 +253,8 @@ class HttpQueryRunner(LocalQueryRunner):
                             {"remote": True,
                              "location": ct.result_location(buffer_id)})
                 sources.append(TaskSource(rnode.id, locations))
-            req = TaskUpdateRequest.make(task_id, ti, frag, sources, spec)
+            req = TaskUpdateRequest.make(task_id, ti, frag, sources,
+                                         spec, session=self.session)
             # a draining worker answers 503 (server.py do_task_update):
             # reroute the task to the next live worker (reference
             # SqlStageExecution retrying placement on node refusal)

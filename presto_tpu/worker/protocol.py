@@ -152,3 +152,40 @@ def make_announcement(node_id: str, uri: str, environment: str = "test",
         }],
         "announced_at": time.time(),
     }
+
+
+_SIZE_UNITS = {"B": 1, "kB": 1 << 10, "MB": 1 << 20, "GB": 1 << 30,
+               "TB": 1 << 40}
+
+
+def parse_data_size(s) -> int:
+    """'512MB' / '1GB' / plain int -> bytes (reference DataSize parsing)."""
+    if isinstance(s, int):
+        return s
+    s = str(s).strip()
+    for unit, mult in sorted(_SIZE_UNITS.items(), key=lambda x: -len(x[0])):
+        if s.endswith(unit):
+            return int(float(s[:-len(unit)]) * mult)
+    return int(s)
+
+
+def apply_session_properties(config, session: Dict[str, str]):
+    """Session overrides -> a task-local ExecutionConfig (the analog of
+    presto_cpp QueryContextManager::toVeloxConfigs mapping Presto session
+    properties onto the execution engine's config,
+    QueryContextManager.cpp:224).  Unknown keys are ignored, like the
+    reference does for properties a worker does not understand."""
+    import dataclasses
+    if not session:
+        return config
+    kw = {}
+    if "query_max_memory_per_node" in session:
+        kw["memory_budget_bytes"] = parse_data_size(
+            session["query_max_memory_per_node"])
+    if "spill_enabled" in session:
+        kw["spill_enabled"] = str(session["spill_enabled"]).lower() == "true"
+    if "spill_partitions" in session:
+        kw["spill_partitions"] = int(session["spill_partitions"])
+    if "task_batch_rows" in session:
+        kw["batch_rows"] = int(session["task_batch_rows"])
+    return dataclasses.replace(config, **kw) if kw else config

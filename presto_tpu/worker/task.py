@@ -86,21 +86,36 @@ class TpuTask:
 
     # -- execution ----------------------------------------------------------
     def start(self, update: TaskUpdateRequest) -> None:
-        fragment = update.fragment()
-        spec = update.output_buffers
-        self.buffers = OutputBufferManager(spec.type, spec.n_buffers)
-        from ..exec.memory import MemoryPool
-        ctx = TaskContext(config=self.config, task_index=update.task_index,
-                          memory=MemoryPool(self.config.memory_budget_bytes))
-        for source in update.sources:
-            remote = [s["location"] for s in source.splits if s.get("remote")]
-            conn = [s for s in source.splits if not s.get("remote")]
-            if remote:
-                ctx.remote_pages[source.plan_node_id] = \
-                    remote_page_reader(remote)
-            if conn:
-                ctx.splits[source.plan_node_id] = [
-                    catalog.TableSplit.from_dict(s) for s in conn]
+        try:
+            fragment = update.fragment()
+            spec = update.output_buffers
+            self.buffers = OutputBufferManager(spec.type, spec.n_buffers)
+            from ..exec.memory import MemoryPool
+            from .protocol import apply_session_properties
+            cfg = apply_session_properties(self.config, update.session)
+            ctx = TaskContext(config=cfg, task_index=update.task_index,
+                              memory=MemoryPool(cfg.memory_budget_bytes))
+            for source in update.sources:
+                remote = [s["location"] for s in source.splits
+                          if s.get("remote")]
+                conn = [s for s in source.splits if not s.get("remote")]
+                if remote:
+                    ctx.remote_pages[source.plan_node_id] = \
+                        remote_page_reader(remote)
+                if conn:
+                    ctx.splits[source.plan_node_id] = [
+                        catalog.TableSplit.from_dict(s) for s in conn]
+        except Exception:
+            # a malformed update (bad fragment, bad session property) must
+            # fail the task, not strand it in PLANNED (the coordinator
+            # sees FAILED on its next status poll, TaskResource.cpp:242-255)
+            message = traceback.format_exc()
+            if self.buffers is None:
+                self.buffers = OutputBufferManager("PARTITIONED", 1)
+            self.buffers.set_error(
+                f"task {self.task_id} failed to start:\n{message}")
+            self._set_state(FAILED, message)
+            return
 
         self._set_state(RUNNING)
         self._thread = threading.Thread(

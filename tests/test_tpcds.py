@@ -166,3 +166,34 @@ def test_tpcds_distributed_q3(runner):
         where d_date_sk = ss_sold_date_sk and ss_item_sk = i_item_sk
           and d_moy = 11
         group by d_year, i_brand_id""")
+
+
+def test_q12_shape_window_ratio(runner):
+    # Q12: revenue ratio within class via a window over grouped aggregation
+    check(runner, """
+        select i_item_id, i_category, i_class,
+               sum(ws_ext_sales_price) as itemrevenue,
+               sum(ws_ext_sales_price) * 100 /
+                 sum(sum(ws_ext_sales_price)) over (partition by i_class)
+                 as revenueratio
+        from web_sales, item, date_dim
+        where ws_item_sk = i_item_sk
+          and i_category in ('Sports', 'Books', 'Men')
+          and ws_sold_date_sk = d_date_sk
+          and d_date between date '1999-02-22' and date '1999-06-22'
+        group by i_item_id, i_category, i_class
+        order by i_category, i_class, i_item_id, itemrevenue
+        limit 100""", ordered=True)
+
+
+def test_q51_shape_cumulative_windows(runner):
+    # Q51-like: cumulative sums over date within item partitions
+    check(runner, """
+        select ss_item_sk, d_date, sum(ss_ext_sales_price) day_sales,
+               sum(sum(ss_ext_sales_price))
+                   over (partition by ss_item_sk order by d_date) cume
+        from store_sales, date_dim
+        where ss_sold_date_sk = d_date_sk
+          and d_date between date '2000-01-01' and date '2000-02-01'
+          and ss_item_sk < 50
+        group by ss_item_sk, d_date""")

@@ -268,3 +268,57 @@ def test_draining_worker_task_rerouted():
     finally:
         w1.close()
         w2.close()
+
+
+def test_session_properties_applied():
+    # session overrides reach the task's ExecutionConfig (the analog of the
+    # reference's session property -> QueryConfig mapping)
+    from presto_tpu.exec.pipeline import ExecutionConfig
+    from presto_tpu.worker.protocol import (apply_session_properties,
+                                            parse_data_size)
+    assert parse_data_size("512MB") == 512 << 20
+    assert parse_data_size("1GB") == 1 << 30
+    assert parse_data_size(12345) == 12345
+    cfg = apply_session_properties(ExecutionConfig(), {
+        "query_max_memory_per_node": "64MB",
+        "spill_enabled": "false",
+        "task_batch_rows": "4096",
+        "unknown_property": "ignored",
+    })
+    assert cfg.memory_budget_bytes == 64 << 20
+    assert cfg.spill_enabled is False
+    assert cfg.batch_rows == 4096
+
+
+def test_session_properties_over_http():
+    from presto_tpu.worker.coordinator import HttpQueryRunner
+    from presto_tpu.worker.server import WorkerServer
+    w = WorkerServer()
+    try:
+        r = HttpQueryRunner([w.uri], "sf0.01", n_tasks=1,
+                            session={"task_batch_rows": "8192"})
+        assert r.execute("select count(*) from nation").rows == [[25]]
+    finally:
+        w.close()
+
+
+def test_malformed_session_property_fails_task():
+    import json as _json
+    import time
+    import urllib.request
+    from presto_tpu.worker.coordinator import HttpQueryRunner
+    from presto_tpu.worker.server import WorkerServer
+    w = WorkerServer()
+    try:
+        r = HttpQueryRunner([w.uri], "sf0.01", n_tasks=1,
+                            session={"task_batch_rows": "not-a-number"})
+        try:
+            r.execute("select count(*) from nation")
+            assert False, "expected failure"
+        except RuntimeError as e:
+            assert "failed" in str(e).lower()
+        # task is terminal (FAILED), not stranded in PLANNED
+        counts = w.task_manager.counts()["by_state"]
+        assert counts.get("PLANNED", 0) == 0
+    finally:
+        w.close()
