@@ -313,8 +313,28 @@ def agg_direct_init(G: int, specs: Tuple[AggSpec, ...]) -> dict:
 
 def agg_direct_update(state: dict, batch: Batch, codes,
                       agg_inputs: Dict[str, Optional[Column]],
-                      specs: Tuple[AggSpec, ...], G: int) -> dict:
+                      specs: Tuple[AggSpec, ...], G: int,
+                      use_pallas: bool = False) -> dict:
     """codes: combined group code per row (int, < G)."""
+    if use_pallas:
+        pallas_specs = []
+        for spec in specs:
+            if spec.name == "count_star":
+                continue
+            col = agg_inputs[spec.output]
+            if spec.name == "count" or (
+                    spec.name in ("sum", "avg") and not spec.is_float
+                    and col.values.dtype in (jnp.int64, jnp.int32,
+                                             jnp.int16, jnp.bool_)):
+                pallas_specs.append((spec, col))
+            else:
+                pallas_specs = None
+                break
+        # count_star-only aggregations have no input columns for the kernel;
+        # the XLA path handles them directly
+        if pallas_specs:
+            return _agg_direct_update_pallas(state, batch, codes,
+                                             pallas_specs, specs, G)
     grid = (codes[None, :] == jnp.arange(G, dtype=codes.dtype)[:, None]) \
         & batch.mask[None, :]
     out = dict(state)
@@ -356,6 +376,38 @@ def agg_direct_update(state: dict, batch: Batch, codes,
                 state[spec.output], red)
             out[spec.output + "$count"] = \
                 state[spec.output + "$count"] + nn
+    return out
+
+
+def _agg_direct_update_pallas(state: dict, batch: Batch, codes,
+                              pallas_specs, specs: Tuple[AggSpec, ...],
+                              G: int) -> dict:
+    """Direct-agg update routed through the Pallas MXU kernel
+    (ops/pallas_agg.py): one systolic-array pass computes every integer
+    sum/count for the batch.  Only called when every non-count_star spec is
+    an integer sum/avg/count (checked by agg_direct_update)."""
+    from ..ops import pallas_agg
+    cols = [(c.values.astype(jnp.int64)
+             if c.values.dtype != jnp.int64 else c.values, c.nulls)
+            for _, c in pallas_specs]
+    sums, counts, gcount = pallas_agg.grouped_sums(
+        cols, codes, batch.mask, G)
+    out = dict(state)
+    out["__seen"] = state["__seen"] + gcount
+    for i, (spec, _col) in enumerate(pallas_specs):
+        if spec.name == "count":
+            out[spec.output] = state[spec.output] + counts[i]
+        elif spec.name == "sum":
+            out[spec.output] = state[spec.output] + sums[i]
+            out[spec.output + "$count"] = \
+                state[spec.output + "$count"] + counts[i]
+        else:   # avg (integer input): accumulate exact int sum + count
+            out[spec.output + "$sum"] = state[spec.output + "$sum"] + sums[i]
+            out[spec.output + "$count"] = \
+                state[spec.output + "$count"] + counts[i]
+    for spec in specs:
+        if spec.name == "count_star":
+            out[spec.output] = state[spec.output] + gcount
     return out
 
 

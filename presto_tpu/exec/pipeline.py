@@ -73,6 +73,13 @@ class ExecutionConfig:
     memory_budget_bytes: Optional[int] = None   # None = unlimited
     spill_enabled: bool = True
     spill_partitions: int = 8
+    # compile scan→filter/project→direct-agg chains into ONE XLA program
+    # (fori_loop over split chunks): eliminates per-batch dispatch overhead
+    fuse_pipelines: bool = True
+    # opt-in: route eligible integer direct aggregations (streaming and
+    # fused paths) through the Pallas MXU kernel (ops/pallas_agg.py)
+    # instead of XLA masked reductions
+    pallas_agg: bool = False
 
 
 @dataclass
@@ -213,8 +220,7 @@ class PlanCompiler:
                          == "INT_ARRAY")
                for _n, colname, kind in dev if kind == "gen"}
 
-        @jax.jit
-        def dev_make(pos, valid):
+        def make(pos, valid):
             idx0 = jnp.arange(cap, dtype=jnp.int64)
             live = idx0 < valid
             idx = pos + idx0
@@ -230,6 +236,8 @@ class PlanCompiler:
                     v = v.astype(jnp.int32)
                 outs[name] = jnp.where(live, v, jnp.zeros((), v.dtype))
             return outs, live
+
+        dev_make = jax.jit(make)
 
         def gen():
             for split in splits:
@@ -278,7 +286,19 @@ class PlanCompiler:
                         mask = jnp.asarray(m)
                     yield Batch(cols, mask)
                     pos += n
-        return BatchSource(gen, names, types)
+        src = BatchSource(gen, names, types)
+        if not host and all(kind == "gen" for _n, _c, kind in dev):
+            # whole-pipeline fusion metadata (see _fuse_scan_chain): the scan
+            # is a pure jax function of (pos, valid) — an aggregation above a
+            # Filter/Project chain over this scan can run as ONE compiled
+            # program with a fori_loop over split chunks, eliminating the
+            # per-batch dispatch round-trips that dominate wall-clock
+            src.fused_scan = {
+                "make": make, "splits": splits, "cap": cap,
+                "dicts": {name: device_gen.dictionary(cid, table, colname)
+                          for name, colname, _k in dev},
+            }
+        return src
 
     def _compile_ValuesNode(self, node: P.ValuesNode) -> BatchSource:
         names = [v.name for v in node.outputs]
@@ -606,7 +626,8 @@ class PlanCompiler:
                         agg_cols[out] = (low.eval(expr, batch)
                                          if expr is not None else None)
                     return ops.agg_direct_update(state, batch, codes,
-                                                 agg_cols, specs, G)
+                                                 agg_cols, specs, G,
+                                                 use_pallas=cfg.pallas_agg)
                 update_cache[("direct", G, strides)] = fn
             return fn
 
@@ -657,34 +678,11 @@ class PlanCompiler:
                         if c.dictionary is not None:
                             key_dicts[k] = c.dictionary
                     # closed small domains: combined code IS the slot index
-                    doms = []
-                    for c in key_cols:
-                        if c.nulls is not None:
-                            doms = None
-                            break
-                        if c.dictionary is not None:
-                            doms.append(len(c.dictionary))
-                        elif c.values.dtype == jnp.bool_:
-                            doms.append(2)
-                        else:
-                            doms = None
-                            break
-                    G = 1
-                    for d in (doms or []):
-                        G *= max(1, d)
-                    if not key_names:
-                        direct = ((), ())
-                        update = make_direct_update(1, ())
-                        state = ops.agg_direct_init(1, specs)
-                    elif doms is not None \
-                            and G <= ops.DIRECT_AGG_MAX_GROUPS:
-                        direct = (tuple(max(1, d) for d in doms),
-                                  tuple(key_dtypes))
-                        strides, s = [], G
-                        for d in direct[0]:
-                            s //= d
-                            strides.append(s)
-                        update = make_direct_update(G, tuple(strides))
+                    info = _direct_mode_info(key_names, key_cols)
+                    if info is not None:
+                        doms, G, strides, kdts, _kd = info
+                        direct = (doms, kdts)
+                        update = make_direct_update(G, strides)
                         state = ops.agg_direct_init(G, specs)
                     else:
                         state = ops.agg_init(num_slots, specs, key_names,
@@ -696,6 +694,114 @@ class PlanCompiler:
                 key_dtypes = [jnp.int64] * len(key_names)
                 state = ops.agg_init(num_slots, specs, key_names, key_dtypes)
             return state, key_dicts, key_lazy, direct
+
+        fused_cache: dict = {}
+
+        def get_fused():
+            """Whole-pipeline fusion: when the source is a (Filter|Project)*
+            chain over a device-generated TableScan and the aggregation
+            qualifies for direct (small-domain) mode, compile scan → chain →
+            agg-update into ONE jitted program with a fori_loop over split
+            chunks.  One dispatch per task instead of O(batches × operators)
+            — on TPU the per-dispatch round-trip dominates wall-clock for
+            these pipelines (TPC-H Q1/Q6 shape).  Returns None when the plan
+            shape doesn't qualify; decision + compiled program are cached."""
+            if "v" in fused_cache:
+                return fused_cache["v"]
+            fused_cache["v"] = None
+            if not cfg.fuse_pipelines or self.ctx.stats is not None:
+                return None   # EXPLAIN ANALYZE wants per-operator stats
+            if any(a.distinct or a.mask for a in node.aggregations.values()):
+                return None
+            chain = []
+            nd = src_node
+            while isinstance(nd, (P.FilterNode, P.ProjectNode)):
+                chain.append(nd)
+                nd = nd.source
+            if not isinstance(nd, P.TableScanNode):
+                return None
+            meta = getattr(self._compile(nd), "fused_scan", None)
+            if meta is None:
+                return None
+            make, cap, dicts = meta["make"], meta["cap"], meta["dicts"]
+            chunks = []
+            for split in meta["splits"]:
+                p = split.start
+                while p < split.end:
+                    chunks.append((p, min(cap, split.end - p)))
+                    p += cap
+            if not chunks:
+                return None
+            steps = []
+            for cn in reversed(chain):
+                if isinstance(cn, P.FilterNode):
+                    steps.append(("filter", cn.predicate))
+                else:
+                    steps.append(("project", list(cn.assignments.items())))
+
+            def make_batch(pos, valid):
+                outs, live = make(pos, valid)
+                cols = {n2: Column(v, None, dicts.get(n2))
+                        for n2, v in outs.items()}
+                return Batch(cols, live)
+
+            def apply_chain(batch):
+                for kind, payload in steps:
+                    if kind == "filter":
+                        batch = ops.apply_filter(
+                            batch, low.eval(payload, batch))
+                    else:
+                        batch = Batch({v.name: low.eval(e, batch)
+                                       for v, e in payload}, batch.mask)
+                return batch
+
+            # shape-only probe: dictionaries / null-ness / dtypes of the key
+            # columns without executing anything (Column aux survives
+            # eval_shape, so closed-domain detection works symbolically)
+            try:
+                probe = jax.eval_shape(
+                    lambda p, v: apply_chain(make_batch(p, v)),
+                    jnp.int64(0), jnp.int64(1))
+            except NotImplementedError:
+                return None
+            key_cols = [probe.columns.get(k) for k in key_names]
+            if any(c is None for c in key_cols):
+                return None
+            info = _direct_mode_info(key_names, key_cols)
+            if info is None:
+                return None
+            doms, G, strides, key_dtypes, key_dicts = info
+            S = len(chunks)
+            pos_arr = jnp.asarray([c0 for c0, _ in chunks], dtype=jnp.int64)
+            cnt_arr = jnp.asarray([c1 for _, c1 in chunks], dtype=jnp.int64)
+            use_pallas = cfg.pallas_agg
+
+            @jax.jit
+            def run_all(pos_arr, cnt_arr, state):
+                def body(i, st):
+                    b = apply_chain(make_batch(pos_arr[i], cnt_arr[i]))
+                    codes = None
+                    for k, stride in zip(key_names, strides):
+                        c = b.columns[k].values.astype(jnp.int64)
+                        codes = (c * stride if codes is None
+                                 else codes + c * stride)
+                    if codes is None:
+                        codes = jnp.zeros(b.capacity, dtype=jnp.int64)
+                    agg_cols = {out: (low.eval(expr, b)
+                                      if expr is not None else None)
+                                for out, expr in input_exprs.items()}
+                    return ops.agg_direct_update(st, b, codes, agg_cols,
+                                                 specs, G,
+                                                 use_pallas=use_pallas)
+                return jax.lax.fori_loop(0, S, body, state)
+
+            def run():
+                state = ops.agg_direct_init(G, specs)
+                return run_all(pos_arr, cnt_arr, state)
+
+            fused_cache["v"] = {"run": run, "doms": doms,
+                                "dtypes": key_dtypes, "dicts": key_dicts}
+            return fused_cache["v"]
 
         def run_retrying(batches_fn=None, start_slots=None):
             num_slots, salt = start_slots or cfg.agg_slots, 0
@@ -718,6 +824,13 @@ class PlanCompiler:
             pool = self.ctx.memory
             if not key_names or pool.try_reserve(est_state_bytes):
                 try:
+                    fused = get_fused()
+                    if fused is not None:
+                        yield ops.agg_direct_finalize(
+                            fused["run"](), specs, key_names, fused["doms"],
+                            fused["dtypes"], fused["dicts"],
+                            force_row=not key_names)
+                        return
                     state, key_dicts, key_lazy, direct = run_retrying()
                     if direct is not None:
                         yield ops.agg_direct_finalize(
@@ -1067,6 +1180,40 @@ class PlanCompiler:
 # analog of the reference's ScanFilterAndProjectOperator evaluating
 # non-vectorizable functions row-wise during the scan.
 # ---------------------------------------------------------------------------
+
+
+def _direct_mode_info(key_names, key_cols):
+    """Closed-small-domain eligibility for direct aggregation, shared by the
+    streaming (run_once) and fused (get_fused) paths — must stay consistent
+    with ops.agg_direct_finalize's slot decode.  key_cols may be real Columns
+    or jax.eval_shape results (only dtype/nulls/dictionary/lazy are read).
+    Returns None when ineligible, else
+    (doms, G, strides, key_dtypes, key_dicts)."""
+    doms = []
+    for c in key_cols:
+        if c.nulls is not None or c.lazy is not None:
+            return None
+        if c.dictionary is not None:
+            doms.append(len(c.dictionary))
+        elif c.values.dtype == jnp.bool_:
+            doms.append(2)
+        else:
+            return None
+    G = 1
+    for d in doms:
+        G *= max(1, d)
+    if key_names and G > ops.DIRECT_AGG_MAX_GROUPS:
+        return None
+    G = max(1, G)
+    doms = tuple(max(1, d) for d in doms)
+    strides, s = [], G
+    for d in doms:
+        s //= d
+        strides.append(s)
+    key_dicts = {k: c.dictionary for k, c in zip(key_names, key_cols)
+                 if c.dictionary is not None}
+    return (doms, G, tuple(strides),
+            tuple(c.values.dtype for c in key_cols), key_dicts)
 
 
 class _StringHoister:

@@ -1,0 +1,162 @@
+"""Pallas TPU kernel: direct (small-domain) grouped aggregation as one MXU pass.
+
+The TPU-native hot path for HashAggregationOperator.java:56-style grouped
+aggregation when the group domain is small (TPC-H Q1: 6 groups) or global
+(Q6: 1 group).  The XLA fallback (operators.agg_direct_update) materializes a
+G x N boolean grid and does masked VPU reductions per aggregate; this kernel
+instead expresses the whole multi-aggregate update as a single systolic-array
+matmul per input tile:
+
+    planes (P, T) f32  @  one_hot (T, 128) f32  ->  (P, 128) f32
+
+where `planes` stacks, per aggregate input column, eight 8-bit limb planes of
+the int64 values plus one validity plane, and `one_hot` encodes each row's
+group code (mask folded in).  All in-kernel arithmetic is int32/f32 - native
+VPU/MXU dtypes - so the kernel never touches the 32-bit-ALU emulation that
+int64 math costs on TPU.  Exactness:
+
+  * limbs are < 2^8, a tile has T = 2048 rows, so every matmul partial
+    product/accumulation stays < 2^19 - exactly representable in f32;
+  * per-block f32 limb sums are combined outside the kernel in uint64 as
+    sum_k 2^(8k) * limb_sum_k, i.e. the column sum **mod 2^64** - identical
+    to int64 wraparound semantics of the engine's accumulators.
+
+Grid iterates over row tiles; each block writes its own (P, 128) partial so
+cross-block combination happens in XLA at int64 width (no in-kernel overflow).
+
+On non-TPU backends the kernel runs under the Pallas interpreter (tests).
+Routing is opt-in: ExecutionConfig.pallas_agg=True (exec/pipeline.py) sends
+eligible direct aggregations here on both the streaming and fused paths;
+the default stays on the XLA masked-reduction path, which profiles at parity
+on current hardware (the kernel exists to own this seam for shapes where
+XLA's reduction strategy degrades: many aggregates x many groups).
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+TILE_ROWS = 2048          # T: rows per grid step
+LANES = 128               # one-hot width (>= DIRECT_AGG_MAX_GROUPS)
+LIMBS = 8                 # 8-bit limbs covering int64
+
+
+def _kernel(codes_ref, mask_ref, lo_ref, hi_ref, valid_ref, out_ref, *, C, P):
+    """One grid step: build limb planes for T rows, matmul against one-hot.
+
+    codes_ref (1,T) i32; mask_ref (1,T) f32; lo/hi_ref (C,T) i32 (bitcast
+    halves of the int64 values); valid_ref (C,T) f32 (mask & not-null);
+    out_ref (1,P,128) f32 where P = 9C+1 padded to a multiple of 8.
+    """
+    codes = codes_ref[0, :]
+    onehot = (codes[:, None] ==
+              jax.lax.broadcasted_iota(jnp.int32, (TILE_ROWS, LANES), 1))
+    onehot = onehot.astype(jnp.float32) * mask_ref[0, :][:, None]
+
+    planes = []
+    for c in range(C):
+        lo = lo_ref[c, :]
+        hi = hi_ref[c, :]
+        valid = valid_ref[c, :]
+        for k in range(4):
+            limb = ((lo >> (8 * k)) & 255).astype(jnp.float32) * valid
+            planes.append(limb)
+        for k in range(4):
+            limb = ((hi >> (8 * k)) & 255).astype(jnp.float32) * valid
+            planes.append(limb)
+        planes.append(valid)                      # non-null count plane
+    planes.append(mask_ref[0, :])                 # group-count plane
+    while len(planes) < P:
+        planes.append(jnp.zeros((TILE_ROWS,), jnp.float32))
+    stacked = jnp.stack(planes, axis=0)           # (P, T)
+
+    out_ref[0, :, :] = jax.lax.dot(
+        stacked, onehot, preferred_element_type=jnp.float32)
+
+
+@partial(jax.jit, static_argnames=("G", "interpret"))
+def _grouped_sums_padded(lo, hi, valid, codes, mask, G: int, interpret: bool):
+    """lo/hi (C, N) i32, valid (C, N) f32, codes (1, N) i32, mask (1, N) f32;
+    N a multiple of TILE_ROWS.  Returns (sums u64 (C,G), counts i64 (C,G),
+    gcount i64 (G,))."""
+    C, N = lo.shape
+    P = -(-(9 * C + 1) // 8) * 8
+    nblocks = N // TILE_ROWS
+
+    out = pl.pallas_call(
+        partial(_kernel, C=C, P=P),
+        out_shape=jax.ShapeDtypeStruct((nblocks, P, LANES), jnp.float32),
+        grid=(nblocks,),
+        in_specs=[
+            # NOTE: constants via np.int32 — under jax_enable_x64 a bare 0
+            # becomes an i64 the Mosaic index-map lowering can't legalize
+            pl.BlockSpec((1, TILE_ROWS), lambda i: (np.int32(0), i)),  # codes
+            pl.BlockSpec((1, TILE_ROWS), lambda i: (np.int32(0), i)),  # mask
+            pl.BlockSpec((C, TILE_ROWS), lambda i: (np.int32(0), i)),  # lo
+            pl.BlockSpec((C, TILE_ROWS), lambda i: (np.int32(0), i)),  # hi
+            pl.BlockSpec((C, TILE_ROWS), lambda i: (np.int32(0), i)),  # valid
+        ],
+        out_specs=pl.BlockSpec((1, P, LANES),
+                               lambda i: (i, np.int32(0), np.int32(0))),
+        interpret=interpret,
+    )(codes, mask, lo, hi, valid)
+
+    # cross-block combine at integer width (per-block entries < 2^19 exact)
+    tot = out.astype(jnp.int64).sum(axis=0)       # (P, 128)
+    tot = tot[:, :G]
+    sums = jnp.zeros((C, G), dtype=jnp.uint64)
+    counts = jnp.zeros((C, G), dtype=jnp.int64)
+    for c in range(C):
+        s = jnp.zeros((G,), dtype=jnp.uint64)
+        for k in range(LIMBS):
+            s = s + (tot[9 * c + k].astype(jnp.uint64) << jnp.uint64(8 * k))
+        sums = sums.at[c].set(s)
+        counts = counts.at[c].set(tot[9 * c + 8])
+    gcount = tot[9 * C]
+    return sums, counts, gcount
+
+
+def grouped_sums(cols: List[Tuple[jnp.ndarray, Optional[jnp.ndarray]]],
+                 codes, mask, G: int,
+                 interpret: Optional[bool] = None):
+    """Masked, null-aware per-group sums of int64 columns.
+
+    cols: list of (values int64 (N,), nulls bool (N,) or None).
+    codes: per-row group code in [0, G); mask: live-row mask.
+    Returns (sums int64 (C, G) - mod-2^64 like the int64 accumulators,
+    counts int64 (C, G) non-null live counts, gcount int64 (G,) live counts).
+    Traceable (use inside jit); G static.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    N = mask.shape[0]
+    npad = -(-N // TILE_ROWS) * TILE_ROWS - N
+
+    maskf = mask.astype(jnp.float32)
+    codes32 = codes.astype(jnp.int32)
+    los, his, valids = [], [], []
+    for values, nulls in cols:
+        v = values.astype(jnp.int64)
+        u = v.astype(jnp.uint64)
+        los.append((u & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32)
+                   .astype(jnp.int32))
+        his.append((u >> jnp.uint64(32)).astype(jnp.uint32).astype(jnp.int32))
+        val = maskf if nulls is None else maskf * (~nulls).astype(jnp.float32)
+        valids.append(val)
+    lo = jnp.stack(los, axis=0)
+    hi = jnp.stack(his, axis=0)
+    valid = jnp.stack(valids, axis=0)
+    if npad:
+        lo = jnp.pad(lo, ((0, 0), (0, npad)))
+        hi = jnp.pad(hi, ((0, 0), (0, npad)))
+        valid = jnp.pad(valid, ((0, 0), (0, npad)))
+        codes32 = jnp.pad(codes32, (0, npad))
+        maskf = jnp.pad(maskf, (0, npad))
+    sums, counts, gcount = _grouped_sums_padded(
+        lo, hi, valid, codes32[None, :], maskf[None, :], G, interpret)
+    return sums.astype(jnp.int64), counts, gcount
