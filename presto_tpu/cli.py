@@ -1,0 +1,85 @@
+"""Interactive SQL console over the statement protocol (the presto-cli
+analog, Console.java:68 / :179 runConsole).
+
+    python -m presto_tpu.cli --server http://127.0.0.1:8080 [--schema sf1]
+    python -m presto_tpu.cli --server ... -e "SELECT 1 x"   # batch mode
+
+Statements end with `;` in interactive mode; `quit`/`exit` leaves."""
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from typing import List
+
+from .client import QueryError, StatementClient
+
+
+def format_table(columns: List[str], rows: list) -> str:
+    """Aligned text table like the reference CLI's ALIGNED output."""
+    cells = [[("NULL" if v is None else str(v)) for v in row]
+             for row in rows]
+    widths = [len(c) for c in columns]
+    for row in cells:
+        for i, v in enumerate(row):
+            widths[i] = max(widths[i], len(v))
+    sep = "-+-".join("-" * w for w in widths)
+    out = [" | ".join(c.ljust(w) for c, w in zip(columns, widths)), sep]
+    for row in cells:
+        out.append(" | ".join(v.ljust(w) for v, w in zip(row, widths)))
+    return "\n".join(out)
+
+
+def run_statement(client: StatementClient, sql: str,
+                  out=sys.stdout) -> bool:
+    t0 = time.time()
+    try:
+        result = client.execute(sql)
+    except QueryError as e:
+        print(f"Query failed: {e}", file=out)
+        return False
+    if result.columns:
+        print(format_table(result.column_names, result.rows), file=out)
+    print(f"({len(result.rows)} row{'s' if len(result.rows) != 1 else ''}, "
+          f"{time.time() - t0:.2f}s)", file=out)
+    return True
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="presto-tpu-cli")
+    ap.add_argument("--server", required=True,
+                    help="coordinator URI, e.g. http://127.0.0.1:8080")
+    ap.add_argument("--catalog", default="tpch")
+    ap.add_argument("--schema", default="sf0.01")
+    ap.add_argument("--user", default="user")
+    ap.add_argument("--session", action="append", default=[],
+                    metavar="K=V", help="session property (repeatable)")
+    ap.add_argument("-e", "--execute", help="run one statement and exit")
+    args = ap.parse_args(argv)
+
+    session = dict(kv.split("=", 1) for kv in args.session)
+    client = StatementClient(args.server, user=args.user,
+                             catalog=args.catalog, schema=args.schema,
+                             session=session)
+    if args.execute:
+        return 0 if run_statement(client, args.execute) else 1
+
+    buf = []
+    while True:
+        try:
+            line = input("presto-tpu> " if not buf else "        ... ")
+        except (EOFError, KeyboardInterrupt):
+            print()
+            return 0
+        if not buf and line.strip().lower() in ("quit", "exit", "\\q"):
+            return 0
+        buf.append(line)
+        if line.rstrip().endswith(";"):
+            sql = "\n".join(buf).strip().rstrip(";")
+            buf = []
+            if sql:
+                run_statement(client, sql)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

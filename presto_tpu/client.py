@@ -1,0 +1,108 @@
+"""Statement-protocol client (the StatementClientV1 analog).
+
+Speaks only the REST protocol of worker/statement.py — POST /v1/statement
+then follow `nextUri` until it disappears (StatementClientV1.java:88,
+advance() :359-372) — so it works against any coordinator implementing the
+protocol.  Values arrive as JSON and are mapped back to python types from
+the column type signatures (decimals -> Decimal)."""
+from __future__ import annotations
+
+import json
+import time
+import urllib.request
+from dataclasses import dataclass, field
+from decimal import Decimal
+from typing import Dict, List, Optional
+
+
+class QueryError(RuntimeError):
+    def __init__(self, message: str, error: dict):
+        super().__init__(message)
+        self.error = error
+
+
+@dataclass
+class StatementResult:
+    query_id: str
+    columns: List[dict] = field(default_factory=list)   # {name, type}
+    rows: list = field(default_factory=list)
+    stats: dict = field(default_factory=dict)
+
+    @property
+    def column_names(self) -> List[str]:
+        return [c["name"] for c in self.columns]
+
+
+class StatementClient:
+    """One client session against a coordinator base URI."""
+
+    def __init__(self, base_uri: str, user: str = "user",
+                 source: str = "presto-tpu-cli",
+                 catalog: str = "tpch", schema: str = "sf0.01",
+                 session: Optional[Dict[str, str]] = None,
+                 timeout_s: float = 120.0):
+        self.base_uri = base_uri.rstrip("/")
+        self.user = user
+        self.source = source
+        self.catalog = catalog
+        self.schema = schema
+        self.session: Dict[str, str] = dict(session or {})
+        self.timeout_s = timeout_s
+
+    def _request(self, url: str, method: str = "GET",
+                 data: Optional[bytes] = None) -> dict:
+        headers = {
+            "X-Presto-User": self.user,
+            "X-Presto-Source": self.source,
+            "X-Presto-Catalog": self.catalog,
+            "X-Presto-Schema": self.schema,
+        }
+        if self.session:
+            headers["X-Presto-Session"] = ",".join(
+                f"{k}={v}" for k, v in self.session.items())
+        req = urllib.request.Request(url, data=data, method=method,
+                                     headers=headers)
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            body = resp.read()
+        return json.loads(body) if body else {}
+
+    def execute(self, sql: str) -> StatementResult:
+        """Submit and poll to completion (the CLI's blocking path)."""
+        resp = self._request(f"{self.base_uri}/v1/statement", "POST",
+                             sql.encode())
+        result = StatementResult(resp.get("id", ""))
+        deadline = time.time() + self.timeout_s
+        while True:
+            if "error" in resp:
+                raise QueryError(resp["error"].get("message", "failed"),
+                                 resp["error"])
+            if resp.get("columns") and not result.columns:
+                result.columns = resp["columns"]
+            for row in resp.get("data", []) or []:
+                result.rows.append(self._decode_row(row, result.columns))
+            result.stats = resp.get("stats", result.stats)
+            nxt = resp.get("nextUri")
+            if not nxt:
+                return result
+            if time.time() > deadline:
+                self.cancel(nxt)
+                raise TimeoutError(f"query {result.query_id} timed out")
+            resp = self._request(nxt)
+
+    def cancel(self, next_uri: str) -> None:
+        """Cancel via DELETE on the current nextUri (it carries the
+        per-query slug, like StatementClientV1.close)."""
+        try:
+            self._request(next_uri, "DELETE")
+        except OSError:
+            pass
+
+    @staticmethod
+    def _decode_row(row: list, columns: List[dict]) -> list:
+        out = []
+        for v, c in zip(row, columns or [{}] * len(row)):
+            t = c.get("type", "")
+            if v is not None and t.startswith("decimal"):
+                v = Decimal(v)
+            out.append(v)
+        return out

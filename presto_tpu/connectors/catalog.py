@@ -31,6 +31,38 @@ ROWID_ORDERED = set(_tpch.ROWID_ORDERED) | set(_tpcds.ROWID_ORDERED)
 ROWID_DISTINCT = set(_tpch.ROWID_DISTINCT) | set(_tpcds.ROWID_DISTINCT)
 
 
+@dataclass
+class HostColumn:
+    """Host-generated column carrying a null mask (storage connectors can
+    produce NULLs; the generated tpch/tpcds columns never do).  `values` is
+    a numpy array or a (codes, dictionary-values) tuple."""
+    values: object
+    nulls: Optional[np.ndarray] = None
+
+
+def _rebuild_property_sets() -> None:
+    """Recompute the merged per-column property sets from the registered
+    connectors (mutated in place: engine code holds references)."""
+    for merged, attr in ((OPEN_DOMAIN, "OPEN_DOMAIN"),
+                         (ROWID_ORDERED, "ROWID_ORDERED"),
+                         (ROWID_DISTINCT, "ROWID_DISTINCT")):
+        merged.clear()
+        for conn in _CONNECTORS.values():
+            merged.update(getattr(conn, attr))
+
+
+def register_connector(connector_id: str, connector) -> None:
+    """Register a connector instance/module at runtime (the Plugin.java:42 /
+    ConnectorFactory analog).  `connector` is duck-typed: see module doc."""
+    _CONNECTORS[connector_id] = connector
+    _rebuild_property_sets()
+
+
+def unregister_connector(connector_id: str) -> None:
+    _CONNECTORS.pop(connector_id, None)
+    _rebuild_property_sets()
+
+
 def module(connector_id: str):
     return _CONNECTORS[connector_id]
 

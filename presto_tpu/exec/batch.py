@@ -220,23 +220,7 @@ def batch_to_page(batch: Batch, names, types) -> Page:
     filtered-out batches (common in selective streaming pipelines) don't pay
     for full-capacity column transfers; small batches take the single
     combined fetch since round-trips dominate their bytes."""
-    combined = batch.capacity <= (1 << 16)
-    fetch = {"__mask": batch.mask}
-    if combined:
-        for name in names:
-            col = batch.columns.get(name)
-            if col is None:
-                continue
-            fetch["v." + name] = col.values
-            if col.nulls is not None:
-                fetch["n." + name] = col.nulls
-    host = jax.device_get(fetch)
-    mask = host["__mask"]
-    keep = np.flatnonzero(mask)
-    if keep.size == 0:
-        from ..common.block import block_from_values
-        return Page([block_from_values(t, []) for t in types], 0)
-    if not combined:
+    def column_fetch():
         fetch = {}
         for name in names:
             col = batch.columns.get(name)
@@ -245,7 +229,20 @@ def batch_to_page(batch: Batch, names, types) -> Page:
             fetch["v." + name] = col.values
             if col.nulls is not None:
                 fetch["n." + name] = col.nulls
-        host.update(jax.device_get(fetch))
+        return fetch
+
+    combined = batch.capacity <= (1 << 16)
+    fetch = {"__mask": batch.mask}
+    if combined:
+        fetch.update(column_fetch())
+    host = jax.device_get(fetch)
+    mask = host["__mask"]
+    keep = np.flatnonzero(mask)
+    if keep.size == 0:
+        from ..common.block import block_from_values
+        return Page([block_from_values(t, []) for t in types], 0)
+    if not combined:
+        host.update(jax.device_get(column_fetch()))
     blocks = []
     for name, typ in zip(names, types):
         col = batch.columns[name]

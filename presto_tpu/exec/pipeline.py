@@ -262,22 +262,36 @@ class PlanCompiler:
                         raw = catalog.generate_column(
                             table, colname, split.sf, pos, n,
                             split.connector)
+                        nulls = None
+                        if isinstance(raw, catalog.HostColumn):
+                            if raw.nulls is not None:
+                                nbuf = np.zeros(cap, dtype=bool)
+                                nbuf[:n] = raw.nulls
+                                nulls = jnp.asarray(nbuf)
+                            raw = raw.values
                         if isinstance(raw, tuple):
                             codes, values = raw
                             buf = np.zeros(cap, dtype=np.int32)
                             buf[:n] = codes
-                            cols[name] = Column(jnp.asarray(buf), None,
+                            cols[name] = Column(jnp.asarray(buf), nulls,
                                                 tuple(values))
                         else:
-                            dtype = (np.int32 if raw.dtype == np.int32 or
-                                     colname.endswith("date") or
-                                     catalog.column_type(
-                                         table, colname,
-                                         split.connector).storage
-                                     == "INT_ARRAY" else np.int64)
+                            if raw.dtype == np.bool_:
+                                dtype = np.bool_
+                            elif raw.dtype in (np.float64, np.float32):
+                                dtype = np.float64
+                            elif (raw.dtype == np.int32
+                                  or colname.endswith("date")
+                                  or catalog.column_type(
+                                      table, colname,
+                                      split.connector).storage
+                                  == "INT_ARRAY"):
+                                dtype = np.int32
+                            else:
+                                dtype = np.int64
                             buf = np.zeros(cap, dtype=dtype)
                             buf[:n] = raw
-                            cols[name] = Column(jnp.asarray(buf))
+                            cols[name] = Column(jnp.asarray(buf), nulls)
                     if dev:
                         mask = dmask
                     else:
@@ -299,6 +313,70 @@ class PlanCompiler:
                           for name, colname, _k in dev},
             }
         return src
+
+    def _compile_TableWriterNode(self, node: P.TableWriterNode) -> BatchSource:
+        """Stream source batches into a connector write handle (reference
+        TableWriterOperator.java:78): pages are staged, not visible until
+        TableFinish commits.  Emits one row (rows-written, staging token)."""
+        src = self._compile(node.source)
+        names = [v.name for v in node.outputs]
+        types = [v.type for v in node.outputs]
+
+        def gen():
+            conn = catalog.module(node.connector_id)
+            # parquet fields carry the SQL-visible column names, not the
+            # planner's internal variable names
+            handle = conn.begin_write(node.table_name,
+                                      list(node.column_names),
+                                      list(src.types))
+            rows = 0
+            wrote = False
+            try:
+                for b in src.batches():
+                    page = batch_to_page(b, src.names, src.types)
+                    if page.position_count:
+                        rows += handle.write_page(page)
+                        wrote = True
+                if not wrote:
+                    # an empty result still defines the table's schema:
+                    # stage one zero-row part so scans of the empty table
+                    # see real columns (matches reference CTAS semantics)
+                    from ..common.block import block_from_values
+                    handle.write_page(Page(
+                        [block_from_values(t, []) for t in src.types], 0))
+            except BaseException:
+                handle.abort()
+                raise
+            rv, fv = node.outputs
+            cols = {rv.name: Column(jnp.asarray(np.array([rows],
+                                                         dtype=np.int64))),
+                    fv.name: Column(jnp.asarray(np.zeros(1, np.int32)), None,
+                                    (handle.staging_id,))}
+            yield Batch(cols, jnp.asarray(np.array([True])))
+        return BatchSource(gen, names, types)
+
+    def _compile_TableFinishNode(self, node: P.TableFinishNode) -> BatchSource:
+        """Commit every staged fragment from the writer(s) and emit the total
+        row count (reference TableFinishOperator.java)."""
+        src = self._compile(node.source)
+        names = [v.name for v in node.outputs]
+        types = [v.type for v in node.outputs]
+
+        def gen():
+            from ..common.block import block_to_values
+            conn = catalog.module(node.connector_id)
+            total = 0
+            for b in src.batches():
+                page = batch_to_page(b, src.names, src.types)
+                rows = block_to_values(src.types[0], page.blocks[0])
+                frags = block_to_values(src.types[1], page.blocks[1])
+                for r, f in zip(rows, frags):
+                    total += int(r)
+                    conn.staged(f).commit()
+            cols = {node.outputs[0].name:
+                    Column(jnp.asarray(np.array([total], dtype=np.int64)))}
+            yield Batch(cols, jnp.asarray(np.array([True])))
+        return BatchSource(gen, names, types)
 
     def _compile_ValuesNode(self, node: P.ValuesNode) -> BatchSource:
         names = [v.name for v in node.outputs]

@@ -95,6 +95,10 @@ def _exec_TableScanNode(node: P.TableScanNode) -> Table:
         cname = node.assignments[v].name
         raw = catalog.generate_column(th.table_name, cname, sf, 0, n,
                                       th.connector_id)
+        nulls = None
+        if isinstance(raw, catalog.HostColumn):
+            nulls = raw.nulls
+            raw = raw.values
         if isinstance(raw, tuple):
             codes, values = raw
             arr = np.array(values, dtype=object)[codes]
@@ -102,7 +106,7 @@ def _exec_TableScanNode(node: P.TableScanNode) -> Table:
             arr = np.array(raw, dtype=object)
         else:
             arr = raw
-        cols[v.name] = (arr, None)
+        cols[v.name] = (arr, nulls)
     return Table(cols, n)
 
 

@@ -60,6 +60,8 @@ class LocalQueryRunner:
         ast = A.parse_sql(sql)
         if isinstance(ast, A.Explain):
             return self._explain(ast)
+        if isinstance(ast, (A.CreateTableAs, A.InsertInto, A.DropTable)):
+            return self._execute_ddl(ast)
         entry = self._plan_cache.pop(sql, None)
         if entry is None:
             output = Planner(default_schema=self.schema,
@@ -76,6 +78,43 @@ class LocalQueryRunner:
         while len(self._plan_cache) > self._PLAN_CACHE_MAX:
             self._plan_cache.pop(next(iter(self._plan_cache)))
         return result
+
+    def _execute_ddl(self, ast) -> QueryResult:
+        """CREATE TABLE AS / INSERT INTO / DROP TABLE (reference
+        DataDefinitionExecution + TableWriter/TableFinish plans; writes run
+        through the normal pipeline compiler)."""
+        from ..common.types import BIGINT
+        from ..connectors import catalog as cat
+        from ..sql import parser as A
+        writable = [cid for cid in cat._CONNECTORS
+                    if hasattr(cat.module(cid), "begin_write")]
+        if isinstance(ast, A.DropTable):
+            # droppable catalogs win the name lookup: a generated tpch
+            # table of the same name must not shadow the stored one
+            cid = next((c for c in writable
+                        if ast.table in cat.module(c).SCHEMAS), None)
+            if cid is None or not hasattr(cat.module(cid), "drop_table"):
+                if ast.if_exists:
+                    return QueryResult(["rows"], [BIGINT], [[0]])
+                raise KeyError(f"unknown or non-droppable table "
+                               f"{ast.table!r}")
+            # cached plans may reference the dropped table
+            self._plan_cache.clear()
+            cat.module(cid).drop_table(ast.table)
+            return QueryResult(["rows"], [BIGINT], [[0]])
+        if isinstance(ast, A.CreateTableAs) and ast.if_not_exists:
+            # IF NOT EXISTS consults only writable catalogs: a read-only
+            # generated table of the same name does not shadow the target
+            if any(ast.table in cat.module(cid).SCHEMAS for cid in writable):
+                return QueryResult(["rows"], [BIGINT], [[0]])
+        output = Planner(default_schema=self.schema,
+                         default_catalog=self.catalog).plan_write(ast)
+        compiler = PlanCompiler(TaskContext(config=self.config))
+        names = output.column_names
+        types = [v.type for v in output.outputs]
+        # writes invalidate any cached plans that scanned the target table
+        self._plan_cache.clear()
+        return pages_to_result(compiler.run_to_pages(output), names, types)
 
     def _explain(self, ast) -> QueryResult:
         """EXPLAIN: plan text.  EXPLAIN ANALYZE: execute with per-node
@@ -160,6 +199,10 @@ class DistributedQueryRunner(LocalQueryRunner):
         ast = A.parse_sql(sql)
         if isinstance(ast, A.Explain):
             return self._explain_distributed(ast)
+        if isinstance(ast, (A.CreateTableAs, A.InsertInto, A.DropTable)):
+            # writes run single-task through the local pipeline (the
+            # reference's scaled-writer distribution is future work)
+            return self._execute_ddl(ast)
         from .scheduler import InProcessScheduler, SchedulerConfig
         subplan, names, types = self.plan_subplan(sql, ast=ast)
         sched = InProcessScheduler(SchedulerConfig(

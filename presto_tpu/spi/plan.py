@@ -785,6 +785,71 @@ class PlanFragment:
             d.get("partitionedSources", []))
 
 
+@_node
+@dataclass
+class TableWriterNode(PlanNode):
+    """Write the source's rows into a connector table (reference
+    TableWriterOperator.java:78).  Emits one row per task:
+    (rows BIGINT, fragment VARCHAR) where `fragment` is the connector's
+    staging token, committed by TableFinishNode."""
+    source: PlanNode
+    connector_id: str
+    table_name: str
+    column_names: List[str] = field(default_factory=list)
+    outputs: List[Variable] = field(default_factory=list)
+
+    @property
+    def sources(self):
+        return [self.source]
+
+    @property
+    def output_variables(self):
+        return list(self.outputs)
+
+    def _to_dict(self):
+        return {"source": self.source.to_dict(),
+                "connectorId": self.connector_id, "table": self.table_name,
+                "columnNames": self.column_names,
+                "outputs": _vars_to_dict(self.outputs)}
+
+    @classmethod
+    def _from_dict(cls, d):
+        return cls(d["id"], PlanNode.from_dict(d["source"]),
+                   d["connectorId"], d["table"], d["columnNames"],
+                   _vars_from_dict(d["outputs"]))
+
+
+@_node
+@dataclass
+class TableFinishNode(PlanNode):
+    """Commit staged table writes and emit the total row count (reference
+    TableFinishOperator.java: gathers writer fragments, runs the connector
+    commit, outputs rows)."""
+    source: PlanNode
+    connector_id: str
+    table_name: str
+    outputs: List[Variable] = field(default_factory=list)
+
+    @property
+    def sources(self):
+        return [self.source]
+
+    @property
+    def output_variables(self):
+        return list(self.outputs)
+
+    def _to_dict(self):
+        return {"source": self.source.to_dict(),
+                "connectorId": self.connector_id, "table": self.table_name,
+                "outputs": _vars_to_dict(self.outputs)}
+
+    @classmethod
+    def _from_dict(cls, d):
+        return cls(d["id"], PlanNode.from_dict(d["source"]),
+                   d["connectorId"], d["table"],
+                   _vars_from_dict(d["outputs"]))
+
+
 @dataclass
 class SubPlan:
     """Tree of fragments (reference sql/planner/SubPlan.java)."""

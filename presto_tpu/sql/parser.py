@@ -269,6 +269,30 @@ class Explain(Node):
 
 
 @dataclass
+class CreateTableAs(Node):
+    """CREATE TABLE [IF NOT EXISTS] name AS <query>
+    (reference sql/tree/CreateTableAsSelect.java)."""
+    table: str
+    query: Node                            # Query | SetOp
+    if_not_exists: bool = False
+
+
+@dataclass
+class InsertInto(Node):
+    """INSERT INTO name <query> (reference sql/tree/Insert.java; positional
+    columns only)."""
+    table: str
+    query: Node
+
+
+@dataclass
+class DropTable(Node):
+    """DROP TABLE [IF EXISTS] name (reference sql/tree/DropTable.java)."""
+    table: str
+    if_exists: bool = False
+
+
+@dataclass
 class SetOp(Node):
     """UNION / INTERSECT / EXCEPT.  ORDER BY / LIMIT apply to the whole
     set operation (trailing clauses of the last branch are hoisted here)."""
@@ -323,15 +347,56 @@ class Parser:
         return True
 
     # -- entry ------------------------------------------------------------
+    def _peek_word(self, k=0) -> str:
+        t = self.peek(k)
+        return t.value.lower() if t.kind in ("ident", "keyword") else ""
+
+    def _ident(self) -> str:
+        """Possibly-qualified identifier; keeps only the table part."""
+        name = self.expect("ident").value
+        while self.accept("op", "."):
+            name = self.expect("ident").value
+        return name.lower()
+
+    def _expect_word(self, w: str):
+        t = self.next()
+        if t.kind not in ("ident", "keyword") or t.value.lower() != w:
+            raise SyntaxError(f"expected {w}, got {t.value!r} at {t.pos}")
+
     def parse(self):
-        if self.peek().kind == "ident" \
-                and self.peek().value.lower() == "explain":
+        word = self._peek_word()
+        if word == "explain":
             self.next()
-            analyze = (self.peek().kind == "ident"
-                       and self.peek().value.lower() == "analyze")
+            analyze = self._peek_word() == "analyze"
             if analyze:
                 self.next()
             q = Explain(self.parse_query(), analyze)
+        elif word == "create":
+            self.next()
+            self._expect_word("table")
+            ine = False
+            if self._peek_word() == "if":
+                self.next()
+                self.expect("keyword", "not")
+                self._expect_word("exists")
+                ine = True
+            name = self._ident()
+            self.expect("keyword", "as")
+            q = CreateTableAs(name, self.parse_query(), ine)
+        elif word == "insert":
+            self.next()
+            if self._peek_word() == "into":
+                self.next()
+            q = InsertInto(self._ident(), self.parse_query())
+        elif word == "drop":
+            self.next()
+            self._expect_word("table")
+            ie = False
+            if self._peek_word() == "if":
+                self.next()
+                self._expect_word("exists")
+                ie = True
+            q = DropTable(self._ident(), ie)
         else:
             q = self.parse_query()
         self.accept("op", ";")

@@ -94,6 +94,58 @@ class Planner:
         node, names, out_vars = self.plan_query_any(query)
         return P.OutputNode(self.new_id("output"), node, names, out_vars)
 
+    def plan_write(self, ast) -> P.OutputNode:
+        """CREATE TABLE AS / INSERT INTO -> TableWriter + TableFinish plan
+        (reference LogicalPlanner.createTableWriterPlan); the target
+        connector is whichever registered connector can create tables."""
+        inner = self.plan_query_to_output(ast.query)
+        column_names = list(inner.column_names)
+        if isinstance(ast, A.InsertInto):
+            target_cid = catalog.resolve_table(ast.table,
+                                               self.default_catalog)
+            if target_cid is None:
+                raise KeyError(f"unknown table {ast.table!r}")
+            if not hasattr(catalog.module(target_cid), "begin_write"):
+                raise ValueError(
+                    f"connector {target_cid!r} does not support writes")
+            # positional insert: part files must carry the TARGET schema's
+            # column names and types, not the SELECT's output labels
+            schema = catalog.module(target_cid).SCHEMAS[ast.table]
+            if len(schema) != len(inner.outputs):
+                raise ValueError(
+                    f"INSERT has {len(inner.outputs)} columns but "
+                    f"{ast.table!r} has {len(schema)}")
+            for (tname, ttyp), v in zip(schema, inner.outputs):
+                if str(ttyp) != str(v.type):
+                    raise ValueError(
+                        f"INSERT column {tname!r} expects {ttyp} but query "
+                        f"produces {v.type}; add a CAST")
+            column_names = [n for n, _t in schema]
+        else:
+            for cid in catalog._CONNECTORS:
+                if hasattr(catalog.module(cid), "begin_write"):
+                    target_cid = cid
+                    break
+            if target_cid is None:
+                raise RuntimeError(
+                    "no writable connector registered (register a hive "
+                    "catalog: connectors.hive.HiveConnector + "
+                    "catalog.register_connector)")
+            existing = ast.table in catalog.module(target_cid).SCHEMAS
+            if existing and not ast.if_not_exists:
+                raise ValueError(f"table {ast.table!r} already exists")
+        rows_v = self.new_var("rows", BIGINT)
+        frag_v = self.new_var("fragment", VarcharType(None))
+        writer = P.TableWriterNode(
+            self.new_id("tablewriter"), inner, target_cid, ast.table,
+            column_names, [rows_v, frag_v])
+        out_rows = self.new_var("rows", BIGINT)
+        finish = P.TableFinishNode(
+            self.new_id("tablefinish"), writer, target_cid, ast.table,
+            [out_rows])
+        return P.OutputNode(self.new_id("output"), finish, ["rows"],
+                            [out_rows])
+
     def plan_query_any(self, query):
         """Dispatch: plain SELECT block vs set operation."""
         if isinstance(query, A.SetOp):

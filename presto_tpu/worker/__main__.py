@@ -22,7 +22,15 @@ def main(argv=None) -> int:
     parser.add_argument("--coordinator", action="store_true",
                         help="also host the embedded discovery service")
     parser.add_argument("--environment", default="production")
+    parser.add_argument("--hive-warehouse", default=None, metavar="DIR",
+                        help="mount a Parquet warehouse directory as the "
+                             "'hive' catalog (CREATE TABLE AS / INSERT)")
     args = parser.parse_args(argv)
+
+    if args.hive_warehouse:
+        from ..connectors import catalog, hive
+        catalog.register_connector(
+            "hive", hive.HiveConnector(args.hive_warehouse))
 
     from .server import WorkerServer
     server = WorkerServer(port=args.http_port, node_id=args.node_id,

@@ -146,8 +146,9 @@ class HttpQueryRunner(LocalQueryRunner):
                  failure_detector: Optional[HeartbeatFailureDetector] = None,
                  config: Optional[ExecutionConfig] = None,
                  n_tasks: int = 2, broadcast_threshold: int = 600_000,
-                 session: Optional[Dict[str, str]] = None):
-        super().__init__(schema, config)
+                 session: Optional[Dict[str, str]] = None,
+                 catalog: str = "tpch"):
+        super().__init__(schema, config, catalog)
         self.worker_uris = worker_uris
         self.failure_detector = failure_detector
         self.n_tasks = n_tasks

@@ -33,6 +33,18 @@ from .protocol import TaskUpdateRequest, make_announcement
 from .task import TaskManager
 
 _ROUTES = [
+    ("POST", re.compile(r"^/v1/statement$"), "statement_post"),
+    ("GET", re.compile(
+        r"^/v1/statement/queued/(?P<qid>[^/]+)/(?P<slug>[^/]+)"
+        r"/(?P<token>\d+)$"), "statement_queued"),
+    ("GET", re.compile(
+        r"^/v1/statement/executing/(?P<qid>[^/]+)/(?P<slug>[^/]+)"
+        r"/(?P<token>\d+)$"), "statement_executing"),
+    ("DELETE", re.compile(
+        r"^/v1/statement/(?:queued/|executing/)?(?P<qid>[^/]+)"
+        r"/(?P<slug>[^/]+)/\d+$"), "statement_cancel"),
+    ("GET", re.compile(r"^/v1/query$"), "query_list"),
+    ("GET", re.compile(r"^/v1/query/(?P<qid>[^/]+)$"), "query_info"),
     ("GET", re.compile(r"^/v1/info/state$"), "info_state"),
     ("PUT", re.compile(r"^/v1/info/state$"), "info_state_put"),
     ("GET", re.compile(r"^/v1/status$"), "status"),
@@ -198,6 +210,101 @@ class _Handler(BaseHTTPRequestHandler):
             s.discovery[groups["node"]] = body
         self._send(202, {"ok": True})
 
+    # -- statement protocol (coordinator role; QueuedStatementResource /
+    # ExecutingStatementResource analog — see worker/statement.py) ---------
+    def _dispatch_mgr(self):
+        d = self.server_ref.dispatch
+        if d is None:
+            self._send(404, {"error": "not a coordinator"})
+        return d
+
+    def _session_headers(self):
+        session = {}
+        for raw in self.headers.get_all("X-Presto-Session") or []:
+            for pair in raw.split(","):
+                if "=" in pair:
+                    k, v = pair.split("=", 1)
+                    session[k.strip()] = v.strip()
+        return session
+
+    def do_statement_post(self, groups, query):
+        d = self._dispatch_mgr()
+        if d is None:
+            return
+        sql = self._body().decode()
+        q = d.submit(
+            sql,
+            user=self.headers.get("X-Presto-User", "user"),
+            source=self.headers.get("X-Presto-Source", ""),
+            session=self._session_headers(),
+            catalog=self.headers.get("X-Presto-Catalog", "tpch"),
+            schema=self.headers.get("X-Presto-Schema", "sf0.01"))
+        self._send(200, d.queued_response(q, 0, self.server_ref.uri,
+                                          wait_s=0.0))
+
+    def _statement_query(self, d, groups):
+        try:
+            q = d.get(groups["qid"])
+        except KeyError:
+            self._send(404, {"error": "unknown query"})
+            return None
+        if q.slug != groups["slug"]:
+            self._send(404, {"error": "bad slug"})
+            return None
+        return q
+
+    def do_statement_queued(self, groups, query):
+        d = self._dispatch_mgr()
+        if d is None:
+            return
+        q = self._statement_query(d, groups)
+        if q is not None:
+            self._send(200, d.queued_response(
+                q, int(groups["token"]), self.server_ref.uri))
+
+    def do_statement_executing(self, groups, query):
+        d = self._dispatch_mgr()
+        if d is None:
+            return
+        q = self._statement_query(d, groups)
+        if q is not None:
+            self._send(200, d.executing_response(
+                q, int(groups["token"]), self.server_ref.uri))
+
+    def do_statement_cancel(self, groups, query):
+        d = self._dispatch_mgr()
+        if d is None:
+            return
+        # the slug is the per-query secret: without it a query id (guessable,
+        # sequential) would suffice to cancel other clients' queries
+        q = self._statement_query(d, groups)
+        if q is None:
+            return
+        d.cancel(q.query_id)
+        self._send(204)
+
+    def do_query_list(self, groups, query):
+        d = self._dispatch_mgr()
+        if d is None:
+            return
+        self._send(200, d.list_queries())
+
+    def do_query_info(self, groups, query):
+        d = self._dispatch_mgr()
+        if d is None:
+            return
+        try:
+            q = d.get(groups["qid"])
+        except KeyError:
+            self._send(404, {"error": "unknown query"})
+            return
+        self._send(200, {
+            "queryId": q.query_id, "query": q.sql, "state": q.state,
+            "queryStats": q.stats(), "session": q.session,
+            "resourceGroupId": [q.resource_group],
+            **({"failureInfo": {"message": q.error}} if q.error else {}),
+            "resourceGroups": d.resource_groups.info()})
+
     def do_task_update(self, groups, query):
         if self.server_ref.state != "ACTIVE":
             # draining node refuses new work; the coordinator reroutes
@@ -261,13 +368,16 @@ class WorkerServer:
                  discovery_uri: Optional[str] = None,
                  environment: str = "test",
                  config: Optional[ExecutionConfig] = None,
-                 announce_interval_s: float = 1.0):
+                 announce_interval_s: float = 1.0,
+                 resource_groups=None):
         self.environment = environment
         self.coordinator = coordinator
         self.state = "ACTIVE"            # ACTIVE | SHUTTING_DOWN
         self.discovery: Optional[Dict[str, dict]] = {} if coordinator else None
         self.discovery_lock = threading.Lock()
         self.started_at = time.time()
+        self.exec_config = config or ExecutionConfig(
+            batch_rows=1 << 16, join_out_capacity=1 << 18)
 
         handler = type("Handler", (_Handler,), {"server_ref": self})
         self.httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
@@ -275,6 +385,15 @@ class WorkerServer:
         self.uri = f"http://127.0.0.1:{self.port}"
         self.node_id = node_id or f"node-{self.port}"
         self.task_manager = TaskManager(self.uri, config)
+
+        # coordinator role: client statement intake (worker/statement.py)
+        self.dispatch = None
+        self._runner_cache: Dict = {}
+        self._runner_lock = threading.Lock()
+        if coordinator:
+            from .statement import DispatchManager
+            self.dispatch = DispatchManager(self._execute_statement,
+                                            resource_groups)
 
         self._serve_thread = threading.Thread(
             target=self.httpd.serve_forever, name=f"http-{self.port}",
@@ -312,6 +431,42 @@ class WorkerServer:
         with self.discovery_lock:
             return [a["services"][0]["properties"]["http"]
                     for a in (self.discovery or {}).values()]
+
+    def _execute_statement(self, q):
+        """DispatchManager executor: run a managed query over the discovered
+        workers (HttpQueryRunner) or in-process when none are announced —
+        the same fallback a single-node reference deployment makes
+        (coordinator with node-scheduler.include-coordinator=true).
+
+        Runners are cached per (workers, schema, catalog, session) so
+        repeated statements reuse the plan cache and warm jitted pipelines;
+        DDL invalidates the cache (it may change any catalog's tables)."""
+        from .protocol import apply_session_properties
+        cfg = apply_session_properties(self.exec_config, q.session)
+        uris = tuple(sorted(u for u in self.worker_uris() if u != self.uri))
+        key = (uris, q.schema, q.catalog,
+               tuple(sorted(q.session.items())))
+        with self._runner_lock:
+            runner = self._runner_cache.get(key)
+            if runner is None:
+                if uris:
+                    from .coordinator import HttpQueryRunner
+                    runner = HttpQueryRunner(list(uris), schema=q.schema,
+                                             config=cfg, session=q.session,
+                                             catalog=q.catalog)
+                else:
+                    from ..exec.runner import LocalQueryRunner
+                    runner = LocalQueryRunner(q.schema, config=cfg,
+                                              catalog=q.catalog)
+                self._runner_cache[key] = runner
+                while len(self._runner_cache) > 16:
+                    self._runner_cache.pop(next(iter(self._runner_cache)))
+        result = runner.execute(q.sql)
+        if q.sql.lstrip()[:6].lower() in ("create", "insert") \
+                or q.sql.lstrip()[:4].lower() == "drop":
+            with self._runner_lock:
+                self._runner_cache.clear()
+        return result
 
     def begin_shutdown(self) -> None:
         """Refuse new tasks, wait for running ones to drain, then stop the

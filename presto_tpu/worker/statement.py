@@ -1,0 +1,315 @@
+"""Coordinator statement protocol + dispatch queueing + resource groups.
+
+The analog of the reference coordinator's query intake path:
+
+  POST /v1/statement                    QueuedStatementResource.java:200
+  GET  /v1/statement/queued/{id}/{slug}/{token}      queued polling :339
+  GET  /v1/statement/executing/{id}/{slug}/{token}   ExecutingStatementResource.java:97
+  DELETE ...                            client cancel
+  GET  /v1/query, /v1/query/{id}        QueryResource (UI / ops listing)
+
+with DispatchManager.java:70-style admission through resource groups
+(InternalResourceGroupManager.java:84): each query is matched to a group by
+(user, source) selectors; a group runs at most `hardConcurrencyLimit`
+queries, queues at most `maxQueued` more (FIFO), and rejects beyond that —
+the same semantics as the reference's static resource-group configs
+(presto-resource-group-managers).
+
+The client walks `nextUri` exactly like StatementClientV1.advance()
+(StatementClientV1.java:359-372): queued URIs poll admission, the executing
+URI streams result rows in chunks with a monotonically increasing token.
+"""
+from __future__ import annotations
+
+import itertools
+import json
+import re
+import threading
+import time
+import uuid
+from collections import deque
+from dataclasses import dataclass, field
+from decimal import Decimal
+from typing import Callable, Dict, List, Optional
+
+QUEUED = "QUEUED"
+RUNNING = "RUNNING"
+FINISHED = "FINISHED"
+FAILED = "FAILED"
+CANCELED = "CANCELED"
+
+_query_ids = itertools.count(1)
+
+
+class QueryQueueFullError(RuntimeError):
+    pass
+
+
+@dataclass
+class ResourceGroupSpec:
+    name: str
+    hard_concurrency_limit: int = 10
+    max_queued: int = 100
+
+
+@dataclass
+class Selector:
+    """First matching selector wins (reference StaticSelector)."""
+    group: str
+    user: Optional[str] = None      # regex
+    source: Optional[str] = None    # regex
+
+    def matches(self, user: str, source: str) -> bool:
+        if self.user and not re.fullmatch(self.user, user or ""):
+            return False
+        if self.source and not re.fullmatch(self.source, source or ""):
+            return False
+        return True
+
+
+class ResourceGroupManager:
+    """Admission control (InternalResourceGroupManager.java:84, FIFO
+    scheduling policy)."""
+
+    def __init__(self, groups: Optional[List[ResourceGroupSpec]] = None,
+                 selectors: Optional[List[Selector]] = None):
+        self.groups = {g.name: g for g in (groups or [])}
+        if "global" not in self.groups:
+            self.groups["global"] = ResourceGroupSpec("global")
+        self.selectors = list(selectors or [])
+        self._running: Dict[str, set] = {n: set() for n in self.groups}
+        self._queues: Dict[str, deque] = {n: deque() for n in self.groups}
+        self._lock = threading.Lock()
+
+    def select(self, user: str, source: str) -> str:
+        for s in self.selectors:
+            if s.matches(user, source) and s.group in self.groups:
+                return s.group
+        return "global"
+
+    def admit(self, query: "ManagedQuery") -> bool:
+        """True = run now; False = queued.  Raises when the queue is full
+        (reference QUERY_QUEUE_FULL)."""
+        g = query.resource_group
+        spec = self.groups[g]
+        with self._lock:
+            if len(self._running[g]) < spec.hard_concurrency_limit:
+                self._running[g].add(query.query_id)
+                return True
+            if len(self._queues[g]) >= spec.max_queued:
+                raise QueryQueueFullError(
+                    f"Too many queued queries for {g!r} "
+                    f"(maxQueued {spec.max_queued})")
+            self._queues[g].append(query)
+            return False
+
+    def release(self, query: "ManagedQuery") -> Optional["ManagedQuery"]:
+        """Free the slot; pop the next queued query of the group, if any."""
+        g = query.resource_group
+        with self._lock:
+            self._running[g].discard(query.query_id)
+            while self._queues[g]:
+                nxt = self._queues[g].popleft()
+                if nxt.state == QUEUED:
+                    self._running[g].add(nxt.query_id)
+                    return nxt
+            return None
+
+    def remove_queued(self, query: "ManagedQuery") -> None:
+        with self._lock:
+            try:
+                self._queues[query.resource_group].remove(query)
+            except ValueError:
+                pass
+
+    def info(self) -> dict:
+        with self._lock:
+            return {n: {"running": len(self._running[n]),
+                        "queued": len(self._queues[n]),
+                        "hardConcurrencyLimit":
+                            self.groups[n].hard_concurrency_limit,
+                        "maxQueued": self.groups[n].max_queued}
+                    for n in self.groups}
+
+
+@dataclass
+class ManagedQuery:
+    query_id: str
+    sql: str
+    user: str
+    source: str
+    session: Dict[str, str]
+    catalog: str
+    schema: str
+    resource_group: str = "global"
+    slug: str = field(default_factory=lambda: uuid.uuid4().hex[:12])
+    state: str = QUEUED
+    error: Optional[str] = None
+    columns: Optional[List[dict]] = None
+    rows: Optional[list] = None
+    created_at: float = field(default_factory=time.time)
+    started_at: Optional[float] = None
+    finished_at: Optional[float] = None
+    done: threading.Event = field(default_factory=threading.Event)
+    _cancelled: bool = False
+
+    def stats(self) -> dict:
+        now = self.finished_at or time.time()
+        return {
+            "state": self.state,
+            "queued": self.state == QUEUED,
+            "scheduled": self.state not in (QUEUED,),
+            "queuedTimeMillis": int(
+                ((self.started_at or now) - self.created_at) * 1000),
+            "elapsedTimeMillis": int((now - self.created_at) * 1000),
+            "resourceGroup": self.resource_group,
+        }
+
+
+class DispatchManager:
+    """Query registry + admission + async execution
+    (DispatchManager.java:70, createQueryInternal :260)."""
+
+    RESULT_CHUNK_ROWS = 4096
+    MAX_QUERY_HISTORY = 200
+
+    def __init__(self, executor: Callable[["ManagedQuery"], "object"],
+                 resource_groups: Optional[ResourceGroupManager] = None):
+        """executor(query) runs the SQL and returns an exec.runner
+        QueryResult (column_names / column_types / rows)."""
+        self._executor = executor
+        self.resource_groups = resource_groups or ResourceGroupManager()
+        self._queries: Dict[str, ManagedQuery] = {}
+        self._lock = threading.Lock()
+
+    # -- intake -----------------------------------------------------------
+    def submit(self, sql: str, user: str = "user", source: str = "",
+               session: Optional[Dict[str, str]] = None,
+               catalog: str = "tpch", schema: str = "sf0.01") -> ManagedQuery:
+        qid = f"{time.strftime('%Y%m%d_%H%M%S')}_{next(_query_ids):05d}"
+        q = ManagedQuery(qid, sql, user, source, dict(session or {}),
+                         catalog, schema)
+        q.resource_group = self.resource_groups.select(user, source)
+        with self._lock:
+            self._queries[qid] = q
+            if len(self._queries) > self.MAX_QUERY_HISTORY:
+                for k in list(self._queries)[:len(self._queries)
+                                             - self.MAX_QUERY_HISTORY]:
+                    old = self._queries[k]
+                    if old.done.is_set():
+                        del self._queries[k]
+        try:
+            if self.resource_groups.admit(q):
+                self._start(q)
+        except QueryQueueFullError as e:
+            q.state = FAILED
+            q.error = str(e)
+            q.finished_at = time.time()
+            q.done.set()
+        return q
+
+    def _start(self, q: ManagedQuery) -> None:
+        t = threading.Thread(target=self._run, args=(q,),
+                             name=f"query-{q.query_id}", daemon=True)
+        t.start()
+
+    def _run(self, q: ManagedQuery) -> None:
+        if q._cancelled:
+            self._finish(q, CANCELED, None)
+            return
+        q.state = RUNNING
+        q.started_at = time.time()
+        try:
+            result = self._executor(q)
+            q.columns = [{"name": n, "type": str(t)}
+                         for n, t in zip(result.column_names,
+                                         result.column_types)]
+            q.rows = [[_json_value(v) for v in row] for row in result.rows]
+            self._finish(q, CANCELED if q._cancelled else FINISHED, None)
+        except Exception as e:  # noqa: BLE001 — becomes the client error
+            self._finish(q, FAILED, f"{type(e).__name__}: {e}")
+
+    def _finish(self, q: ManagedQuery, state: str, error: Optional[str]):
+        q.state = state
+        q.error = error
+        q.finished_at = time.time()
+        q.done.set()
+        nxt = self.resource_groups.release(q)
+        if nxt is not None:
+            self._start(nxt)
+
+    # -- lookup / cancel --------------------------------------------------
+    def get(self, query_id: str) -> ManagedQuery:
+        with self._lock:
+            return self._queries[query_id]
+
+    def cancel(self, query_id: str) -> None:
+        q = self.get(query_id)
+        q._cancelled = True
+        if q.state == QUEUED:
+            self.resource_groups.remove_queued(q)
+            self._finish(q, CANCELED, None)
+
+    def list_queries(self) -> List[dict]:
+        with self._lock:
+            qs = list(self._queries.values())
+        return [{"queryId": q.query_id, "state": q.state,
+                 "query": q.sql, "user": q.user,
+                 "resourceGroup": q.resource_group,
+                 **({"errorMessage": q.error} if q.error else {})}
+                for q in qs]
+
+    # -- protocol responses ----------------------------------------------
+    def queued_response(self, q: ManagedQuery, token: int,
+                        base_uri: str, wait_s: float = 0.1) -> dict:
+        if q.state == QUEUED:
+            q.done.wait(wait_s)
+        resp = {"id": q.query_id,
+                "infoUri": f"{base_uri}/v1/query/{q.query_id}",
+                "stats": q.stats()}
+        if q.state == QUEUED:
+            resp["nextUri"] = (f"{base_uri}/v1/statement/queued/"
+                               f"{q.query_id}/{q.slug}/{token + 1}")
+        elif q.state in (FAILED, CANCELED) and q.rows is None:
+            if q.error:
+                resp["error"] = {"message": q.error,
+                                 "errorName": "QUERY_FAILED"}
+        else:
+            resp["nextUri"] = (f"{base_uri}/v1/statement/executing/"
+                               f"{q.query_id}/{q.slug}/0")
+        return resp
+
+    def executing_response(self, q: ManagedQuery, token: int,
+                           base_uri: str, wait_s: float = 0.5) -> dict:
+        if not q.done.is_set():
+            q.done.wait(wait_s)
+        resp = {"id": q.query_id,
+                "infoUri": f"{base_uri}/v1/query/{q.query_id}",
+                "stats": q.stats()}
+        if not q.done.is_set():
+            # still running: poll the same token
+            resp["nextUri"] = (f"{base_uri}/v1/statement/executing/"
+                               f"{q.query_id}/{q.slug}/{token}")
+            return resp
+        if q.state in (FAILED, CANCELED):
+            if q.error:
+                resp["error"] = {"message": q.error,
+                                 "errorName": "QUERY_FAILED"}
+            return resp
+        lo = token * self.RESULT_CHUNK_ROWS
+        hi = lo + self.RESULT_CHUNK_ROWS
+        resp["columns"] = q.columns
+        if lo < len(q.rows):
+            resp["data"] = q.rows[lo:hi]
+        if hi < len(q.rows):
+            resp["nextUri"] = (f"{base_uri}/v1/statement/executing/"
+                               f"{q.query_id}/{q.slug}/{token + 1}")
+        return resp
+
+
+def _json_value(v):
+    if isinstance(v, Decimal):
+        return str(v)
+    if v is None or isinstance(v, (bool, int, float, str)):
+        return v
+    return str(v)
