@@ -78,18 +78,27 @@ def main():
         best = min(best, time.perf_counter() - t0)
     assert result.rows, "benchmark query returned no rows"
 
-    # Baseline: numpy reference interpreter, same plan + data, one timed run
-    # (it is deterministic and has no compile step).
+    # Baseline: numpy reference interpreter, same plan + data, one timed
+    # run (deterministic, no compile step).  At large BENCH_SF the row
+    # engine becomes the bottleneck of the *benchmark harness* itself, so
+    # it is measured at a capped scale factor and compared by throughput
+    # (rows/s vs rows/s) — the ratio is scale-invariant for these
+    # scan-bound queries.
+    ref_sf = min(sf, float(os.environ.get("BENCH_REF_SF", "1")))
+    ref_runner = runner if ref_sf == sf else LocalQueryRunner(
+        schema=f"sf{ref_sf:g}", config=runner.config)
+    ref_rows = tpch._table_rows("lineitem", ref_sf)
     t0 = time.perf_counter()
-    runner.execute_reference(sql)
+    ref_runner.execute_reference(sql)
     ref_wall = time.perf_counter() - t0
 
     rows_per_sec = n_rows / best
+    ref_rows_per_sec = ref_rows / ref_wall
     print(json.dumps({
         "metric": f"tpch_{qname}_sf{sf:g}_rows_per_sec",
         "value": round(rows_per_sec, 1),
         "unit": "rows/s",
-        "vs_baseline": round(ref_wall / best, 3),
+        "vs_baseline": round(rows_per_sec / ref_rows_per_sec, 3),
     }))
 
 

@@ -1,0 +1,86 @@
+"""TPC-H macro-benchmark driver (the presto-benchmark-driver /
+benchto-suite analog, SURVEY.md §2.11 + §6: per-query wall-clock with
+prewarm runs over the full q1-q22 suite).
+
+    python -m presto_tpu.benchmarks.driver [--sf 1] [--runs 3]
+        [--queries 1,6,3] [--distributed N] [--json out.json]
+
+Prints one JSON object per query: {"query", "sf", "best_s", "runs_s",
+"rows"} and a trailing suite summary; mirrors the benchto harness shape
+(6 runs / 2 prewarm in the reference's tpch.yaml — defaults here are
+smaller because compile warmup is the dominant first-run cost on TPU).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="presto-tpu-bench-driver")
+    ap.add_argument("--sf", type=float, default=1.0)
+    ap.add_argument("--runs", type=int, default=3)
+    ap.add_argument("--prewarm", type=int, default=1)
+    ap.add_argument("--queries", default=None,
+                    help="comma-separated query numbers (default: all 22)")
+    ap.add_argument("--distributed", type=int, default=0, metavar="N",
+                    help="run through the in-process distributed scheduler "
+                         "with N tasks per stage")
+    ap.add_argument("--batch-rows", type=int, default=1 << 20)
+    ap.add_argument("--json", default=None, help="write results file")
+    args = ap.parse_args(argv)
+
+    from .tpch_queries import queries_for_sf
+    from ..exec.pipeline import ExecutionConfig
+    from ..exec.runner import DistributedQueryRunner, LocalQueryRunner
+
+    suite = queries_for_sf(args.sf)
+    nums = (sorted(int(x) for x in args.queries.split(","))
+            if args.queries else sorted(suite))
+    cfg = ExecutionConfig(batch_rows=args.batch_rows,
+                          join_out_capacity=1 << 21)
+    schema = f"sf{args.sf:g}"
+    if args.distributed:
+        runner = DistributedQueryRunner(schema, config=cfg,
+                                        n_tasks=args.distributed)
+    else:
+        runner = LocalQueryRunner(schema, config=cfg)
+
+    results = []
+    for qnum in nums:
+        sql = suite[qnum]
+        try:
+            for _ in range(args.prewarm):
+                runner.execute(sql)
+            runs = []
+            rows = 0
+            for _ in range(args.runs):
+                t0 = time.perf_counter()
+                r = runner.execute(sql)
+                runs.append(round(time.perf_counter() - t0, 4))
+                rows = len(r.rows)
+            rec = {"query": f"q{qnum:02d}", "sf": args.sf,
+                   "best_s": min(runs), "runs_s": runs, "rows": rows}
+        except Exception as e:   # noqa: BLE001 — record and continue
+            rec = {"query": f"q{qnum:02d}", "sf": args.sf,
+                   "error": f"{type(e).__name__}: {e}"}
+        results.append(rec)
+        print(json.dumps(rec), flush=True)
+
+    ok = [r for r in results if "best_s" in r]
+    summary = {"suite": "tpch", "sf": args.sf,
+               "queries_ok": len(ok), "queries_failed":
+               len(results) - len(ok),
+               "total_best_s": round(sum(r["best_s"] for r in ok), 3)}
+    print(json.dumps(summary), flush=True)
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump({"results": results, "summary": summary}, f,
+                      indent=1)
+    return 0 if len(ok) == len(results) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import json
 import time
+import urllib.error
 import urllib.request
 from dataclasses import dataclass, field
 from decimal import Decimal
@@ -62,8 +63,15 @@ class StatementClient:
                 f"{k}={v}" for k, v in self.session.items())
         req = urllib.request.Request(url, data=data, method=method,
                                      headers=headers)
-        with urllib.request.urlopen(req, timeout=30) as resp:
-            body = resp.read()
+        try:
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                body = resp.read()
+        except urllib.error.HTTPError as e:
+            if e.code in (307, 308) and "Location" in e.headers:
+                # a query router redirects POST /v1/statement to the chosen
+                # cluster (urllib won't re-POST a redirect by itself)
+                return self._request(e.headers["Location"], method, data)
+            raise
         return json.loads(body) if body else {}
 
     def execute(self, sql: str) -> StatementResult:

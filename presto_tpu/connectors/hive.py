@@ -83,6 +83,15 @@ def _type_from_arrow(field) -> Type:
     raise NotImplementedError(f"unsupported parquet type {t}")
 
 
+def _stat_float(v) -> float:
+    """Parquet row-group statistic -> float in logical units (pyarrow hands
+    back datetime.date for date32 columns, Decimal for decimal128)."""
+    import datetime
+    if isinstance(v, datetime.date):
+        return float((v - datetime.date(1970, 1, 1)).days)
+    return float(v)
+
+
 def _np_dtype_for(typ: Type):
     if isinstance(typ, BooleanType):
         return np.bool_
@@ -107,6 +116,7 @@ class _Table:
         self._schema: Optional[List[Tuple[str, Type]]] = None
         self._dicts: Dict[str, Tuple[Tuple[str, ...], Dict[str, int]]] = {}
         self._col_cache: Dict[str, Tuple] = {}    # column -> (values, nulls)
+        self._stats_cache: Dict[str, object] = {}
 
     def _parts(self) -> List[str]:
         return sorted(os.path.join(self.path, f)
@@ -137,6 +147,7 @@ class _Table:
             self._schema = None
             self._dicts.clear()
             self._col_cache.clear()
+            self._stats_cache.clear()
 
     @property
     def schema(self) -> List[Tuple[str, Type]]:
@@ -206,6 +217,65 @@ class _Table:
             got = (uniq, {s: i for i, s in enumerate(uniq)})
             self._dicts[column] = got
         return got
+
+    def column_stats(self, column: str):
+        """Column stats from parquet row-group metadata (the analog of the
+        reference's HiveMetadata.getTableStatistics over file footers).
+        Physical min/max are mapped back to logical units; results are
+        cached per column — footers are re-read only after invalidate()."""
+        import pyarrow as pa
+        from ..sql.stats import ColumnStats
+        cached = self._stats_cache.get(column)
+        if cached is not None:
+            return cached
+        try:
+            typ = self.column_type(column)
+        except KeyError:
+            return None
+        lo = hi = None
+        nulls = 0
+        total = 0
+        physical_decimal = False
+        for f in self._open():
+            md = f.metadata
+            try:
+                field = f.schema_arrow.field(column)
+                ci = [md.schema.column(i).name
+                      for i in range(md.num_columns)].index(column)
+            except (KeyError, ValueError):
+                return None
+            physical_decimal = pa.types.is_decimal(field.type)
+            for rg in range(md.num_row_groups):
+                col = md.row_group(rg).column(ci)
+                total += col.num_values
+                st = col.statistics
+                if st is None:
+                    continue
+                if st.null_count is not None:
+                    nulls += st.null_count
+                if st.has_min_max and not isinstance(
+                        typ, (VarcharType, CharType)):
+                    try:
+                        mn, mx = _stat_float(st.min), _stat_float(st.max)
+                    except (TypeError, ValueError):
+                        continue
+                    lo = mn if lo is None else min(lo, mn)
+                    hi = mx if hi is None else max(hi, mx)
+        if isinstance(typ, DecimalType) and lo is not None \
+                and not physical_decimal:
+            # our own parts store decimals as scaled int64; external
+            # decimal128 stats are already logical values
+            scale = 10.0 ** typ.scale
+            lo, hi = lo / scale, hi / scale
+        ndv = None
+        dcached = self._dicts.get(column)
+        if dcached is not None:
+            ndv = float(len(dcached[0]))
+        out = ColumnStats(
+            low=lo, high=hi, ndv=ndv,
+            null_fraction=(nulls / total) if total else 0.0)
+        self._stats_cache[column] = out
+        return out
 
     def read_range(self, column: str, start: int, count: int):
         """Rows [start, start+count) of one column ->
@@ -370,6 +440,10 @@ class HiveConnector:
     def generate_column(self, table: str, column: str, sf: float,
                         start: int, count: int):
         return self._tables[table].read_range(column, start, count)
+
+    def column_stats(self, table: str, column: str, sf: float):
+        t = self._tables.get(table)
+        return None if t is None else t.column_stats(column)
 
     def generate_values_at(self, table: str, column: str, sf: float, ids):
         return self._tables[table].values_at(column, ids)

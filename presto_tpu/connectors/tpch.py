@@ -474,6 +474,81 @@ def table_row_count(table: str, sf: float) -> int:
     return _table_rows(table, sf)
 
 
+def column_stats(table: str, column: str, sf: float):
+    """Analytic column statistics from the generator specs (the
+    ConnectorMetadata.getTableStatistics analog; consumed by sql/stats.py).
+    Values are in LOGICAL units (decimals as fractional numbers, dates as
+    epoch days) to match planner constants."""
+    from ..sql.stats import ColumnStats
+    n = float(_table_rows(table, sf))
+    orders = float(_table_rows("orders", sf))
+    uniform = {
+        ("lineitem", "orderkey"): (1, orders, orders),
+        ("lineitem", "partkey"): (1, _table_rows("part", sf), None),
+        ("lineitem", "suppkey"): (1, _table_rows("supplier", sf), None),
+        ("lineitem", "linenumber"): (1, LINES_PER_ORDER, LINES_PER_ORDER),
+        ("lineitem", "quantity"): (1.0, 50.0, 50),
+        ("lineitem", "extendedprice"): (900.0, 104949.50, None),
+        ("lineitem", "discount"): (0.0, 0.10, 11),
+        ("lineitem", "tax"): (0.0, 0.08, 9),
+        ("lineitem", "shipdate"): (MIN_ORDER_DATE + 1,
+                                   MAX_ORDER_DATE + 121, None),
+        ("lineitem", "commitdate"): (MIN_ORDER_DATE + 30,
+                                     MAX_ORDER_DATE + 90, None),
+        ("lineitem", "receiptdate"): (MIN_ORDER_DATE + 2,
+                                      MAX_ORDER_DATE + 151, None),
+        ("lineitem", "returnflag"): (None, None, 3),
+        ("lineitem", "linestatus"): (None, None, 2),
+        ("lineitem", "shipinstruct"): (None, None, 4),
+        ("lineitem", "shipmode"): (None, None, 7),
+        ("orders", "orderkey"): (1, n, n),
+        ("orders", "custkey"): (1, _table_rows("customer", sf),
+                                _table_rows("customer", sf) * 2 / 3),
+        ("orders", "orderdate"): (MIN_ORDER_DATE, MAX_ORDER_DATE,
+                                  MAX_ORDER_DATE - MIN_ORDER_DATE + 1),
+        ("orders", "totalprice"): (900.0, 500000.0, None),
+        ("orders", "orderstatus"): (None, None, 3),
+        ("orders", "orderpriority"): (None, None, 5),
+        ("orders", "clerk"): (None, None, max(1.0, sf * 1000)),
+        ("orders", "shippriority"): (0, 0, 1),
+        ("customer", "custkey"): (1, n, n),
+        ("customer", "nationkey"): (0, 24, 25),
+        ("customer", "acctbal"): (-999.99, 9999.99, None),
+        ("customer", "mktsegment"): (None, None, 5),
+        ("part", "partkey"): (1, n, n),
+        ("part", "mfgr"): (None, None, 5),
+        ("part", "brand"): (None, None, 25),
+        ("part", "type"): (None, None, 150),
+        ("part", "size"): (1, 50, 50),
+        ("part", "container"): (None, None, 40),
+        ("part", "retailprice"): (900.0, 2098.99, None),
+        ("partsupp", "partkey"): (1, _table_rows("part", sf),
+                                  _table_rows("part", sf)),
+        ("partsupp", "suppkey"): (1, _table_rows("supplier", sf),
+                                  _table_rows("supplier", sf)),
+        ("partsupp", "availqty"): (1, 9999, 9999),
+        ("partsupp", "supplycost"): (1.0, 1000.0, None),
+        ("supplier", "suppkey"): (1, n, n),
+        ("supplier", "nationkey"): (0, 24, 25),
+        ("supplier", "acctbal"): (-999.99, 9999.99, None),
+        ("nation", "nationkey"): (0, 24, 25),
+        ("nation", "regionkey"): (0, 4, 5),
+        ("nation", "name"): (None, None, 25),
+        ("region", "regionkey"): (0, 4, 5),
+        ("region", "name"): (None, None, 5),
+    }
+    spec = uniform.get((table, column))
+    if spec is None:
+        return None
+    lo, hi, ndv = spec
+    if ndv is None and lo is not None:
+        ndv = min(n, max(1.0, float(hi) - float(lo)))
+    return ColumnStats(
+        low=None if lo is None else float(lo),
+        high=None if hi is None else float(hi),
+        ndv=None if ndv is None else float(ndv))
+
+
 # string columns with open (unbounded) value domains: these are produced
 # lazily on device as row-id columns and materialized on output
 # (late materialization — see exec/batch.py Column.lazy)

@@ -1,0 +1,198 @@
+"""PEP 249 (DB-API 2.0) driver over the statement protocol.
+
+The python-ecosystem analog of the reference's JDBC driver (presto-jdbc,
+presto-jdbc/src/main/java/com/facebook/presto/jdbc/): the standard database
+interface of the host language implemented purely on the public client
+protocol, so any DB-API tooling (pandas.read_sql, SQLAlchemy dialects,
+ORMs) can talk to a presto-tpu coordinator.
+
+    import presto_tpu.dbapi as dbapi
+    conn = dbapi.connect("http://127.0.0.1:8080", schema="sf1")
+    cur = conn.cursor()
+    cur.execute("SELECT returnflag, count(*) FROM lineitem GROUP BY 1")
+    cur.fetchall()
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+from .client import QueryError, StatementClient
+
+apilevel = "2.0"
+threadsafety = 1          # threads may share the module, not connections
+paramstyle = "qmark"      # positional '?' substitution
+
+
+class Error(Exception):
+    pass
+
+
+class InterfaceError(Error):
+    pass
+
+
+class DatabaseError(Error):
+    pass
+
+
+class ProgrammingError(DatabaseError):
+    pass
+
+
+class OperationalError(DatabaseError):
+    pass
+
+
+def connect(uri: str, user: str = "user", catalog: str = "tpch",
+            schema: str = "sf0.01",
+            session: Optional[Dict[str, str]] = None) -> "Connection":
+    return Connection(uri, user, catalog, schema, session)
+
+
+class Connection:
+    def __init__(self, uri: str, user: str, catalog: str, schema: str,
+                 session: Optional[Dict[str, str]]):
+        self._client = StatementClient(uri, user=user, catalog=catalog,
+                                       schema=schema, session=session,
+                                       source="presto-tpu-dbapi")
+        self._closed = False
+
+    def cursor(self) -> "Cursor":
+        if self._closed:
+            raise InterfaceError("connection is closed")
+        return Cursor(self._client)
+
+    def close(self) -> None:
+        self._closed = True
+
+    def commit(self) -> None:
+        pass              # autocommit (like the reference JDBC driver)
+
+    def rollback(self) -> None:
+        raise OperationalError("transactions are not supported")
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def _split_placeholders(sql: str) -> List[str]:
+    """Split on '?' placeholders OUTSIDE single-quoted string literals
+    (a '?' inside 'a?b' is data, not a parameter)."""
+    parts, buf, in_str = [], [], False
+    i = 0
+    while i < len(sql):
+        ch = sql[i]
+        if in_str:
+            buf.append(ch)
+            if ch == "'":
+                if i + 1 < len(sql) and sql[i + 1] == "'":
+                    buf.append("'")
+                    i += 1       # escaped quote stays inside the literal
+                else:
+                    in_str = False
+        elif ch == "'":
+            in_str = True
+            buf.append(ch)
+        elif ch == "?":
+            parts.append("".join(buf))
+            buf = []
+        else:
+            buf.append(ch)
+        i += 1
+    parts.append("".join(buf))
+    return parts
+
+
+def _quote(v) -> str:
+    if v is None:
+        return "NULL"
+    if isinstance(v, bool):
+        return "TRUE" if v else "FALSE"
+    if isinstance(v, (int, float)):
+        return repr(v)
+    s = str(v).replace("'", "''")
+    return f"'{s}'"
+
+
+class Cursor:
+    arraysize = 1
+
+    def __init__(self, client: StatementClient):
+        self._client = client
+        self._rows: List[Sequence] = []
+        self._pos = 0
+        self.description = None
+        self.rowcount = -1
+        self._closed = False
+
+    # -- execution --------------------------------------------------------
+    def execute(self, sql: str, parameters: Optional[Sequence] = None):
+        if self._closed:
+            raise InterfaceError("cursor is closed")
+        if parameters:
+            parts = _split_placeholders(sql)
+            if len(parts) != len(parameters) + 1:
+                raise ProgrammingError(
+                    f"statement has {len(parts) - 1} placeholders but "
+                    f"{len(parameters)} parameters were given")
+            sql = "".join(
+                p + (_quote(v) if i < len(parameters) else "")
+                for i, (p, v) in enumerate(
+                    zip(parts, list(parameters) + [None])))
+        try:
+            result = self._client.execute(sql)
+        except QueryError as e:
+            raise ProgrammingError(str(e)) from e
+        except OSError as e:
+            raise OperationalError(str(e)) from e
+        # description: 7-tuples (name, type_code, None x5) per PEP 249
+        self.description = [(c["name"], c["type"], None, None, None, None,
+                             None) for c in result.columns] or None
+        self._rows = result.rows
+        self._pos = 0
+        self.rowcount = len(result.rows)
+        return self
+
+    def executemany(self, sql: str, seq_of_parameters):
+        for p in seq_of_parameters:
+            self.execute(sql, p)
+        return self
+
+    # -- fetching ---------------------------------------------------------
+    def fetchone(self):
+        if self._pos >= len(self._rows):
+            return None
+        row = tuple(self._rows[self._pos])
+        self._pos += 1
+        return row
+
+    def fetchmany(self, size: Optional[int] = None):
+        size = size or self.arraysize
+        out = [tuple(r) for r in self._rows[self._pos:self._pos + size]]
+        self._pos += len(out)
+        return out
+
+    def fetchall(self):
+        out = [tuple(r) for r in self._rows[self._pos:]]
+        self._pos = len(self._rows)
+        return out
+
+    def __iter__(self):
+        while True:
+            row = self.fetchone()
+            if row is None:
+                return
+            yield row
+
+    # -- misc -------------------------------------------------------------
+    def close(self) -> None:
+        self._closed = True
+
+    def setinputsizes(self, sizes) -> None:
+        pass
+
+    def setoutputsize(self, size, column=None) -> None:
+        pass

@@ -251,7 +251,208 @@ class Lowering:
             return Column(table[c.values], c.nulls)
         if name in ("coalesce",):
             return self._coalesce([self.eval(a, batch) for a in args])
+        if name in _DOUBLE_FNS:
+            c = self.eval(args[0], batch)
+            v = _to_common_numeric(c, args[0].type, DoubleType())
+            if name == "power":
+                b = self.eval(args[1], batch)
+                bv = _to_common_numeric(b, args[1].type, DoubleType())
+                return Column(jnp.power(v, bv), _combine_nulls(c, b))
+            return Column(_DOUBLE_FNS[name](v), c.nulls)
+        if name in ("ceiling", "ceil", "floor"):
+            c = self.eval(args[0], batch)
+            t = args[0].type
+            if isinstance(t, (DoubleType, RealType)):
+                f = jnp.ceil if name != "floor" else jnp.floor
+                return Column(f(c.values), c.nulls)
+            if _is_decimal(t) and t.scale > 0:
+                den = 10 ** t.scale
+                v = c.values
+                out = (-((-v) // den)) if name != "floor" else (v // den)
+                return Column(out, c.nulls)
+            return Column(c.values, c.nulls)
+        if name == "sign":
+            c = self.eval(args[0], batch)
+            return Column(jnp.sign(c.values), c.nulls)
+        if name == "truncate":
+            c = self.eval(args[0], batch)
+            v = _to_common_numeric(c, args[0].type, DoubleType())
+            return Column(jnp.trunc(v), c.nulls)
+        if name == "round":
+            c = self.eval(args[0], batch)
+            if len(args) > 1 and not isinstance(args[1],
+                                                ConstantExpression):
+                raise NotImplementedError(
+                    "round with non-constant digits")
+            digits = int(args[1].value) if len(args) > 1 else 0
+            if _is_decimal(expr.type):
+                s = args[0].type.scale if _is_decimal(args[0].type) else 0
+                v = c.values
+                if digits < s:
+                    den = 10 ** (s - digits)
+                    q = jnp.sign(v) * ((jnp.abs(v) + den // 2) // den) * den
+                    v = q.astype(c.values.dtype)
+                return Column(_rescale(v, s, expr.type.scale), c.nulls)
+            v = _to_common_numeric(c, args[0].type, DoubleType())
+            scale = 10.0 ** digits
+            # SQL rounds half AWAY from zero (jnp.round is half-even)
+            out = jnp.sign(v) * jnp.floor(jnp.abs(v) * scale + 0.5) / scale
+            if isinstance(expr.type, (DoubleType, RealType)):
+                return Column(out, c.nulls)
+            return Column(out.astype(c.values.dtype), c.nulls)
+        if name in ("greatest", "least"):
+            cols = [self.eval(a, batch) for a in args]
+            # compare/return in the DECLARED result type so the emitted
+            # scaled values match the planner's precision/scale
+            vals = [_to_common_numeric(c, a.type, expr.type)
+                    for c, a in zip(cols, args)]
+            op = jnp.maximum if name == "greatest" else jnp.minimum
+            out = vals[0]
+            for v in vals[1:]:
+                out = op(out, v)
+            return Column(out, _combine_nulls(*cols))
+        if name in _STRING_TO_STRING or name in _STRING_TO_VALUE \
+                or name == "concat":
+            return self._string_fn(name, expr, batch)
+        if name in ("date_trunc", "date_add", "date_diff", "day_of_week",
+                    "day_of_year", "week"):
+            return self._date_fn(name, expr, batch)
         raise NotImplementedError(f"scalar function {expr.display_name!r}")
+
+    # -- string functions over dictionary columns -------------------------
+    def _string_fn(self, name: str, expr: CallExpression,
+                   batch: Batch) -> Column:
+        """String functions computed host-side over the (static) dictionary
+        and applied as a code remap / lookup — the dictionary-encoding
+        equivalent of the reference's per-row varchar kernels
+        (presto-main-base/.../operator/scalar/StringFunctions.java)."""
+        args = expr.arguments
+        if name == "concat":
+            return self._concat(args, batch)
+        c = self.eval(args[0], batch)
+        if c.dictionary is None:
+            raise NotImplementedError(f"{name} on non-dictionary varchar")
+        extra = []
+        for a in args[1:]:
+            if not isinstance(a, ConstantExpression):
+                raise NotImplementedError(f"{name} with non-constant args")
+            extra.append(a.value)
+        if name in _STRING_TO_STRING:
+            fn = _STRING_TO_STRING[name]
+            mapped = [fn(s, *extra) for s in c.dictionary]
+            return _reencode(c, mapped)
+        fn, dtype = _STRING_TO_VALUE[name]
+        table = jnp.asarray(np.array([fn(s, *extra) for s in c.dictionary],
+                                     dtype=dtype))
+        return Column(table[c.values], c.nulls)
+
+    def _concat(self, args, batch: Batch) -> Column:
+        cols = [self.eval(a, batch) for a in args]
+        dict_cols = [c for c in cols if c.dictionary is not None
+                     and len(c.dictionary) > 1]
+        if any(c.dictionary is None for c in cols):
+            raise NotImplementedError("concat on non-dictionary varchar")
+        if len(dict_cols) > 2 or (
+                len(dict_cols) == 2
+                and len(dict_cols[0].dictionary)
+                * len(dict_cols[1].dictionary) > 65536):
+            raise NotImplementedError("concat dictionary product too large")
+        nulls = None
+        for c in cols:
+            if c.nulls is not None:
+                nulls = _or_null(nulls, c.nulls)
+        if len(dict_cols) <= 1:
+            base = dict_cols[0] if dict_cols else cols[0]
+            mapped = ["".join(c.dictionary[0] if c is not base else s
+                              for c in cols)
+                      for s in base.dictionary]
+            return _reencode(Column(base.values, nulls, base.dictionary),
+                             mapped)
+        a, b = dict_cols
+        nb = len(b.dictionary)
+        product = []
+        for sa in a.dictionary:
+            for sb in b.dictionary:
+                parts = []
+                for c in cols:
+                    if c is a:
+                        parts.append(sa)
+                    elif c is b:
+                        parts.append(sb)
+                    else:
+                        parts.append(c.dictionary[0])
+                product.append("".join(parts))
+        codes = a.values * nb + b.values
+        return _reencode(Column(codes, nulls, tuple(product)), product)
+
+    # -- date functions ---------------------------------------------------
+    def _date_fn(self, name: str, expr: CallExpression,
+                 batch: Batch) -> Column:
+        args = expr.arguments
+        if name in ("day_of_week", "day_of_year", "week"):
+            c = self.eval(args[0], batch)
+            days = c.values.astype(jnp.int64)
+            if name == "day_of_week":
+                return Column((days + 3) % 7 + 1, c.nulls)
+            y, m, d = _civil_from_days(days)
+            doy = days - _days_from_civil(y, jnp.ones_like(m),
+                                          jnp.ones_like(d)) + 1
+            if name == "day_of_year":
+                return Column(doy, c.nulls)
+            dow = (days + 3) % 7 + 1
+            w0 = (10 + doy - dow) // 7
+            # nested on the ORIGINAL w: a w0<1 resolved to last year's 53
+            # must not be re-clamped by this year's 52-week count
+            w = jnp.where(w0 < 1, _iso_weeks_in_year(y - 1),
+                          jnp.where(w0 > _iso_weeks_in_year(y), 1, w0))
+            return Column(w, c.nulls)
+        unit = str(args[0].value).lower()
+        if name == "date_trunc":
+            c = self.eval(args[1], batch)
+            days = c.values.astype(jnp.int64)
+            if unit == "day":
+                return Column(days.astype(c.values.dtype), c.nulls)
+            if unit == "week":
+                return Column((days - (days + 3) % 7)
+                              .astype(c.values.dtype), c.nulls)
+            y, m, _d = _civil_from_days(days)
+            if unit == "quarter":
+                m = ((m - 1) // 3) * 3 + 1
+            elif unit == "year":
+                m = jnp.ones_like(m)
+            out = _days_from_civil(y, m, jnp.ones_like(m))
+            return Column(out.astype(c.values.dtype), c.nulls)
+        if name == "date_add":
+            n = self.eval(args[1], batch).values.astype(jnp.int64)
+            c = self.eval(args[2], batch)
+            days = c.values.astype(jnp.int64)
+            if unit in ("day", "week"):
+                out = days + n * (7 if unit == "week" else 1)
+                return Column(out.astype(c.values.dtype), c.nulls)
+            months = n * {"month": 1, "quarter": 3, "year": 12}[unit]
+            out = _add_months(days, months)
+            return Column(out.astype(c.values.dtype), c.nulls)
+        # date_diff(unit, a, b) = b - a in whole units, truncated toward 0
+        a = self.eval(args[1], batch)
+        b = self.eval(args[2], batch)
+        nulls = _combine_nulls(a, b)
+        da = a.values.astype(jnp.int64)
+        db = b.values.astype(jnp.int64)
+        if unit in ("day", "week"):
+            diff = db - da
+            den = 7 if unit == "week" else 1
+            out = jnp.sign(diff) * (jnp.abs(diff) // den)
+            return Column(out, nulls)
+        ya, ma, dda = _civil_from_days(da)
+        yb, mb, ddb = _civil_from_days(db)
+        months = (yb * 12 + mb) - (ya * 12 + ma)
+        # partial months don't count: back off one when the day-of-month
+        # hasn't been reached yet (sign-aware)
+        months = jnp.where((months > 0) & (ddb < dda), months - 1, months)
+        months = jnp.where((months < 0) & (ddb > dda), months + 1, months)
+        den = {"month": 1, "quarter": 3, "year": 12}[unit]
+        out = jnp.sign(months) * (jnp.abs(months) // den)
+        return Column(out, nulls)
 
     def _arith(self, name, expr: CallExpression, batch: Batch) -> Column:
         a_expr, b_expr = expr.arguments
@@ -535,6 +736,68 @@ def _jnp_dtype(typ: Type):
     return jnp.int64
 
 
+_DOUBLE_FNS = {
+    "sqrt": jnp.sqrt, "exp": jnp.exp, "ln": jnp.log,
+    "log2": lambda v: jnp.log(v) / jnp.log(2.0),
+    "log10": lambda v: jnp.log(v) / jnp.log(10.0),
+    "sin": jnp.sin, "cos": jnp.cos, "tan": jnp.tan,
+    "asin": jnp.arcsin, "acos": jnp.arccos, "atan": jnp.arctan,
+    "cbrt": jnp.cbrt, "degrees": jnp.degrees, "radians": jnp.radians,
+    "power": None,     # binary; handled inline
+}
+
+
+def _lpad(s, n, fill=" "):
+    """Presto lpad: pad cycles from the START of the fill string."""
+    n, fill = int(n), str(fill)
+    if len(s) >= n:
+        return s[:n]
+    pad = n - len(s)
+    return (fill * (pad // len(fill) + 1))[:pad] + s
+
+
+def _rpad(s, n, fill=" "):
+    n, fill = int(n), str(fill)
+    if len(s) >= n:
+        return s[:n]
+    pad = n - len(s)
+    return s + (fill * (pad // len(fill) + 1))[:pad]
+
+
+def _replace(s, find, repl=""):
+    return s.replace(str(find), str(repl))
+
+
+_STRING_TO_STRING = {
+    "upper": lambda s: s.upper(),
+    "lower": lambda s: s.lower(),
+    "trim": lambda s: s.strip(),
+    "ltrim": lambda s: s.lstrip(),
+    "rtrim": lambda s: s.rstrip(),
+    "reverse": lambda s: s[::-1],
+    "replace": _replace,
+    "lpad": _lpad,
+    "rpad": _rpad,
+}
+
+_STRING_TO_VALUE = {
+    # name -> (fn(entry, *const_args), numpy dtype)
+    "strpos": (lambda s, sub: s.find(str(sub)) + 1, np.int64),
+    "starts_with": (lambda s, p: s.startswith(str(p)), bool),
+}
+
+
+def _reencode(c: Column, mapped) -> Column:
+    """Remap a dictionary column through transformed entries, dedup+sort the
+    result so codes stay rank codes (grouping and order comparisons depend
+    on it)."""
+    uniq = tuple(sorted(set(mapped)))
+    index = {s: i for i, s in enumerate(uniq)}
+    remap = jnp.asarray(np.array([index[s] for s in mapped],
+                                 dtype=np.int32))
+    return Column(remap[c.values], c.nulls, uniq)
+
+
 def _civil_from_days(z):
     """Days-since-epoch -> (year, month, day); Hinnant's algorithm, integer
     ops only so XLA fuses it."""
@@ -549,3 +812,38 @@ def _civil_from_days(z):
     m = jnp.where(mp < 10, mp + 3, mp - 9)
     y = jnp.where(m <= 2, y + 1, y)
     return y, m, d
+
+
+def _days_from_civil(y, m, d):
+    """(year, month, day) -> days since epoch; inverse of
+    _civil_from_days (Hinnant)."""
+    y = y - (m <= 2)
+    era = jnp.where(y >= 0, y, y - 399) // 400
+    yoe = y - era * 400
+    mp = jnp.where(m > 2, m - 3, m + 9)
+    doy = (153 * mp + 2) // 5 + d - 1
+    doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
+    return era * 146097 + doe - 719468
+
+
+def _iso_weeks_in_year(y):
+    """52 or 53 (ISO-8601): 53 iff Jan 1 or Dec 31 falls on Thursday."""
+    jan1 = _days_from_civil(y, jnp.ones_like(y), jnp.ones_like(y))
+    dec31 = _days_from_civil(y, jnp.full_like(y, 12), jnp.full_like(y, 31))
+    thu = lambda days: (days + 3) % 7 + 1 == 4  # noqa: E731
+    return jnp.where(thu(jan1) | thu(dec31), 53, 52)
+
+
+def _add_months(days, months):
+    """Calendar month addition with end-of-month clamping (Presto
+    date_add('month'): Jan 31 + 1 month = Feb 28/29)."""
+    y, m, d = _civil_from_days(days)
+    total = (m - 1) + months
+    y2 = y + total // 12
+    m2 = total % 12 + 1
+    first = _days_from_civil(y2, m2, jnp.ones_like(m2))
+    nxt_total = total + 1
+    next_first = _days_from_civil(y + nxt_total // 12,
+                                  nxt_total % 12 + 1, jnp.ones_like(m2))
+    dim = next_first - first
+    return first + jnp.minimum(d, dim) - 1

@@ -710,7 +710,215 @@ def _eval_call(expr: CallExpression, t: Table) -> Col:
     if name == "length":
         v, m = _eval(args[0], t)
         return (np.array([len(str(x)) for x in v], dtype=np.int64), m)
+    if name in _REF_DOUBLE_FNS:
+        fn = _REF_DOUBLE_FNS[name]
+        acol = _eval(args[0], t)
+        a = _numeric_domain(args[0], acol, True, 0)
+        if name == "power":
+            bcol = _eval(args[1], t)
+            b = _numeric_domain(args[1], bcol, True, 0)
+            m = acol[1]
+            if bcol[1] is not None:
+                m = bcol[1] if m is None else (m | bcol[1])
+            return (np.array([fn(x, y) for x, y in zip(a, b)],
+                             dtype=np.float64), m)
+        return (np.array([fn(x) for x in a], dtype=np.float64), acol[1])
+    if name in ("ceiling", "floor", "sign", "truncate"):
+        import math as _math
+        col = _eval(args[0], t)
+        a = _numeric_domain(args[0], col, True, 0)
+        fn = {"ceiling": _math.ceil, "floor": _math.floor,
+              "truncate": _math.trunc,
+              "sign": lambda x: (x > 0) - (x < 0)}[name]
+        out = [fn(x) for x in a]
+        if isinstance(expr.type, (DoubleType, RealType)):
+            return (np.array(out, dtype=np.float64), col[1])
+        return (np.array(out, dtype=np.int64), col[1])
+    if name == "round":
+        col = _eval(args[0], t)
+        digits = int(args[1].value) if len(args) > 1 else 0
+        if isinstance(expr.type, DecimalType):
+            s = _scale_factor(args[0])
+            rs = expr.type.scale
+            out = np.empty(t.n, dtype=object)
+            for i, x in enumerate(col[0].tolist()):
+                x = int(x)
+                if digits < s:
+                    den = 10 ** (s - digits)
+                    q = (abs(x) + den // 2) // den * den
+                    x = q if x >= 0 else -q
+                out[i] = _round_to(x, s, rs)
+            return (out, col[1])
+        a = _numeric_domain(args[0], col, True, 0)
+        scale = 10.0 ** digits
+
+        def r(x):
+            import math as _math
+            return _math.copysign(_math.floor(abs(x) * scale + 0.5),
+                                  x) / scale
+        out = np.array([r(x) for x in a], dtype=np.float64)
+        if isinstance(expr.type, (DoubleType, RealType)):
+            return (out, col[1])
+        return (out.astype(np.int64), col[1])
+    if name in ("greatest", "least"):
+        cols = [_eval(a, t) for a in args]
+        vals = [_numeric_domain(a, c, True, 0)
+                for a, c in zip(args, cols)]
+        out = vals[0]
+        for v in vals[1:]:
+            out = np.maximum(out, v) if name == "greatest" \
+                else np.minimum(out, v)
+        m = None
+        for c in cols:
+            if c[1] is not None:
+                m = c[1] if m is None else (m | c[1])
+        if isinstance(expr.type, (DoubleType, RealType)):
+            return (out, m)
+        sc = _scale_factor(expr)
+        return (np.array([int(round(x * 10**sc)) for x in out],
+                         dtype=object), m)
+    if name in ("upper", "lower", "trim", "ltrim", "rtrim", "reverse",
+                "replace", "lpad", "rpad"):
+        v, m = _eval(args[0], t)
+        extra = [a.value for a in args[1:]]
+        fn = {
+            "upper": lambda s: s.upper(),
+            "lower": lambda s: s.lower(),
+            "trim": lambda s: s.strip(),
+            "ltrim": lambda s: s.lstrip(),
+            "rtrim": lambda s: s.rstrip(),
+            "reverse": lambda s: s[::-1],
+            "replace": lambda s: s.replace(
+                str(extra[0]), str(extra[1]) if len(extra) > 1 else ""),
+            "lpad": lambda s: _ref_pad(s, extra, left=True),
+            "rpad": lambda s: _ref_pad(s, extra, left=False),
+        }[name]
+        return (np.array([fn(str(x)) for x in v], dtype=object), m)
+    if name == "concat":
+        cols = [_eval(a, t) for a in args]
+        m = None
+        for c in cols:
+            if c[1] is not None:
+                m = c[1] if m is None else (m | c[1])
+        out = np.array(["".join(str(c[0][i]) for c in cols)
+                        for i in range(t.n)], dtype=object)
+        return (out, m)
+    if name == "strpos":
+        v, m = _eval(args[0], t)
+        sub = str(args[1].value)
+        return (np.array([str(x).find(sub) + 1 for x in v],
+                         dtype=np.int64), m)
+    if name == "starts_with":
+        v, m = _eval(args[0], t)
+        p = str(args[1].value)
+        return (np.array([str(x).startswith(p) for x in v]), m)
+    if name in ("day_of_week", "day_of_year", "week", "date_trunc",
+                "date_add", "date_diff"):
+        return _eval_date_fn(name, expr, t)
     raise NotImplementedError(f"reference fn {name}")
+
+
+def _ref_pad(s: str, extra, left: bool) -> str:
+    """Presto lpad/rpad: truncate to n when already longer, else pad with
+    the fill string repeated from its start."""
+    n = int(extra[0])
+    fill = str(extra[1]) if len(extra) > 1 else " "
+    if len(s) >= n:
+        return s[:n]
+    pad = (fill * (n - len(s)))[:n - len(s)]
+    return pad + s if left else s + pad
+
+
+import math as _m  # noqa: E402
+
+_REF_DOUBLE_FNS = {
+    "sqrt": _m.sqrt, "exp": _m.exp, "ln": _m.log, "log2": _m.log2,
+    "log10": _m.log10, "sin": _m.sin, "cos": _m.cos, "tan": _m.tan,
+    "asin": _m.asin, "acos": _m.acos, "atan": _m.atan,
+    "cbrt": lambda x: _m.copysign(abs(x) ** (1 / 3), x),
+    "degrees": _m.degrees, "radians": _m.radians, "power": _m.pow,
+}
+
+
+def _eval_date_fn(name: str, expr: CallExpression, t: Table) -> Col:
+    """Date functions via python's datetime — an implementation independent
+    of the engine's integer civil-calendar kernels, so differential tests
+    catch either side's mistakes."""
+    import datetime as _dt
+    args = expr.arguments
+    epoch = _dt.date(1970, 1, 1).toordinal()
+
+    def to_date(days):
+        return _dt.date.fromordinal(int(days) + epoch)
+
+    if name in ("day_of_week", "day_of_year", "week"):
+        v, m = _eval(args[0], t)
+        if name == "day_of_week":
+            out = [to_date(x).isoweekday() for x in v]
+        elif name == "day_of_year":
+            out = [to_date(x).timetuple().tm_yday for x in v]
+        else:
+            out = [to_date(x).isocalendar()[1] for x in v]
+        return (np.array(out, dtype=np.int64), m)
+    unit = str(args[0].value).lower()
+    if name == "date_trunc":
+        v, m = _eval(args[1], t)
+
+        def trunc(days):
+            d = to_date(days)
+            if unit == "day":
+                pass
+            elif unit == "week":
+                d = d - _dt.timedelta(days=d.weekday())
+            elif unit == "month":
+                d = d.replace(day=1)
+            elif unit == "quarter":
+                d = d.replace(month=((d.month - 1) // 3) * 3 + 1, day=1)
+            elif unit == "year":
+                d = d.replace(month=1, day=1)
+            return d.toordinal() - epoch
+        return (np.array([trunc(x) for x in v], dtype=np.int64), m)
+    if name == "date_add":
+        nv, nm = _eval(args[1], t)
+        v, m = _eval(args[2], t)
+        mm = m if nm is None else (nm if m is None else (m | nm))
+
+        def add(days, n):
+            n = int(n)
+            if unit == "day":
+                return int(days) + n
+            if unit == "week":
+                return int(days) + 7 * n
+            d = to_date(days)
+            months = n * {"month": 1, "quarter": 3, "year": 12}[unit]
+            total = d.month - 1 + months
+            y, mo = d.year + total // 12, total % 12 + 1
+            import calendar
+            day = min(d.day, calendar.monthrange(y, mo)[1])
+            return _dt.date(y, mo, day).toordinal() - epoch
+        return (np.array([add(x, n) for x, n in zip(v, nv)],
+                         dtype=np.int64), mm)
+    # date_diff
+    av, am = _eval(args[1], t)
+    bv, bm = _eval(args[2], t)
+    mm = am if bm is None else (bm if am is None else (am | bm))
+
+    def diff(a, b):
+        if unit == "day":
+            return int(b) - int(a)
+        if unit == "week":
+            d = int(b) - int(a)
+            return d // 7 if d >= 0 else -((-d) // 7)
+        da, db = to_date(a), to_date(b)
+        months = (db.year * 12 + db.month) - (da.year * 12 + da.month)
+        if months > 0 and db.day < da.day:
+            months -= 1
+        elif months < 0 and db.day > da.day:
+            months += 1
+        den = {"month": 1, "quarter": 3, "year": 12}[unit]
+        return months // den if months >= 0 else -((-months) // den)
+    return (np.array([diff(a, b) for a, b in zip(av, bv)],
+                     dtype=np.int64), mm)
 
 
 def _round_to(value: int, frm: int, to: int) -> int:

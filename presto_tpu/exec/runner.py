@@ -21,6 +21,8 @@ class QueryResult:
     column_names: List[str]
     column_types: List
     rows: List[List]
+    # per-query RuntimeStats map (§5.1; populated by the runners)
+    runtime_stats: dict = None
 
     def sorted_rows(self):
         return sorted(self.rows, key=lambda r: tuple(
@@ -40,9 +42,10 @@ def pages_to_result(pages, names, types) -> "QueryResult":
 class LocalQueryRunner:
     def __init__(self, schema: str = "sf0.01",
                  config: Optional[ExecutionConfig] = None,
-                 catalog: str = "tpch"):
+                 catalog: str = "tpch", tracer_provider=None):
         self.schema = schema
         self.catalog = catalog
+        self.tracer_provider = tracer_provider   # utils.runtime_stats
         self.config = config or ExecutionConfig(batch_rows=1 << 16,
                                                 join_out_capacity=1 << 18)
         # plan cache: SQL -> (OutputNode, PlanCompiler); re-executions reuse
@@ -57,21 +60,37 @@ class LocalQueryRunner:
 
     def execute(self, sql: str) -> QueryResult:
         from ..sql import parser as A
-        ast = A.parse_sql(sql)
+        from ..utils.runtime_stats import RuntimeStats
+        stats = RuntimeStats()
+        tracer = self.tracer_provider.new_tracer(sql) \
+            if self.tracer_provider else None
+        with stats.record_wall("queryParse"):
+            ast = A.parse_sql(sql)
+        if tracer:
+            tracer.add_point("query parsed")
         if isinstance(ast, A.Explain):
             return self._explain(ast)
         if isinstance(ast, (A.CreateTableAs, A.InsertInto, A.DropTable)):
             return self._execute_ddl(ast)
         entry = self._plan_cache.pop(sql, None)
         if entry is None:
-            output = Planner(default_schema=self.schema,
-                             default_catalog=self.catalog) \
-                .plan_query_to_output(ast)
-            entry = (output, PlanCompiler(TaskContext(config=self.config)))
+            with stats.record_wall("queryPlan"):
+                output = Planner(default_schema=self.schema,
+                                 default_catalog=self.catalog) \
+                    .plan_query_to_output(ast)
+                entry = (output,
+                         PlanCompiler(TaskContext(config=self.config)))
+        if tracer:
+            tracer.add_point("query planned")
         output, compiler = entry
         names = output.column_names
         types = [v.type for v in output.outputs]
-        result = pages_to_result(compiler.run_to_pages(output), names, types)
+        with stats.record_wall("queryExecute"):
+            result = pages_to_result(compiler.run_to_pages(output), names,
+                                     types)
+        result.runtime_stats = stats.to_dict()
+        if tracer:
+            tracer.end_trace("query finished")
         # cache only after a successful run (a failed run may leave the
         # compiler's memory pool / partial state poisoned); bounded LRU
         self._plan_cache[sql] = entry

@@ -80,8 +80,12 @@ def _details(node: P.PlanNode) -> str:
 
 def format_plan(node: P.PlanNode,
                 stats: Optional[Dict[str, dict]] = None) -> str:
-    """Indented textual plan; stats (node id -> {rows, wall_s, invocations})
-    annotate each line when given (EXPLAIN ANALYZE)."""
+    """Indented textual plan with cost-based row estimates (the PlanPrinter's
+    `Estimates: {rows: N}` annotations backed by sql/stats.py); stats
+    (node id -> {rows, wall_s, invocations}) annotate each line when given
+    (EXPLAIN ANALYZE)."""
+    from .stats import StatsCalculator
+    calc = StatsCalculator()
     lines: List[str] = []
 
     def walk(n: P.PlanNode, depth: int) -> None:
@@ -90,6 +94,12 @@ def format_plan(node: P.PlanNode,
         line = "   " * depth + f"- {name}"
         if detail:
             line += f" [{detail}]"
+        try:
+            est = calc.rows(n)
+        except Exception:
+            est = None
+        if est is not None:
+            line += f"  {{rows≈{est:,.0f}}}"
         if stats is not None and n.id in stats:
             s = stats[n.id]
             line += (f"  {{rows: {s['rows']:,}, "

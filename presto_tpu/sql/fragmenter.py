@@ -67,7 +67,17 @@ def _connector_stats_fn(connector_id: str):
 
 
 def estimate_rows(node: P.PlanNode) -> Optional[float]:
-    """Rough output-cardinality estimate; None = unknown."""
+    """Output-cardinality estimate: the stats module's selectivity-aware
+    estimator (sql/stats.py, the StatsCalculator analog) first, falling
+    back to the original coarse heuristics when stats are unavailable."""
+    from .stats import StatsCalculator
+    est = StatsCalculator().rows(node)
+    if est is not None:
+        return est
+    return _estimate_rows_heuristic(node)
+
+
+def _estimate_rows_heuristic(node: P.PlanNode) -> Optional[float]:
     if isinstance(node, P.TableScanNode):
         fn = _connector_stats_fn(node.table.connector_id)
         return fn(node.table) if fn else None

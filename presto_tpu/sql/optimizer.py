@@ -1,0 +1,38 @@
+"""Cost-based plan rewrites over the logical plan.
+
+The (much smaller) analog of the reference's PlanOptimizers pass list
+(PlanOptimizers.java:209).  Passes mutate the plan in place, like the
+fragmenter's distribution planner does.
+
+Current passes:
+  * determine_join_sides — put the smaller estimated side on the BUILD
+    (right) side of inner hash joins (reference
+    DetermineJoinDistributionType / ReorderJoins' side selection): the
+    executor builds its sorted lookup table from the right input, so a
+    large build side costs sort+memory where a probe-side scan would
+    stream.
+"""
+from __future__ import annotations
+
+from ..spi import plan as P
+from .stats import StatsCalculator
+
+SWAP_RATIO = 1.25     # hysteresis: only swap on a clear size difference
+
+
+def determine_join_sides(root: P.PlanNode,
+                         calc: StatsCalculator = None) -> P.PlanNode:
+    calc = calc or StatsCalculator()
+    for n in P.walk_plan(root):
+        if isinstance(n, P.JoinNode) and n.join_type == P.INNER \
+                and n.criteria:
+            l = calc.rows(n.left)
+            r = calc.rows(n.right)
+            if l is not None and r is not None and r > l * SWAP_RATIO:
+                n.left, n.right = n.right, n.left
+                n.criteria = [(rv, lv) for lv, rv in n.criteria]
+    return root
+
+
+def optimize(root: P.PlanNode) -> P.PlanNode:
+    return determine_join_sides(root)

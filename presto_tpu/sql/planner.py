@@ -92,7 +92,9 @@ class Planner:
 
     def plan_query_to_output(self, query) -> P.OutputNode:
         node, names, out_vars = self.plan_query_any(query)
-        return P.OutputNode(self.new_id("output"), node, names, out_vars)
+        out = P.OutputNode(self.new_id("output"), node, names, out_vars)
+        from .optimizer import optimize
+        return optimize(out)
 
     def plan_write(self, ast) -> P.OutputNode:
         """CREATE TABLE AS / INSERT INTO -> TableWriter + TableFinish plan
@@ -122,6 +124,7 @@ class Planner:
                         f"produces {v.type}; add a CAST")
             column_names = [n for n, _t in schema]
         else:
+            target_cid = None
             for cid in catalog._CONNECTORS:
                 if hasattr(catalog.module(cid), "begin_write"):
                     target_cid = cid
@@ -1355,6 +1358,57 @@ class Planner:
                 return call("cast", BIGINT, args[0]) if isinstance(
                     args[0].type, DecimalType) else call("round", args[0].type, *args)
             return call("round", args[0].type, *args)
+        # -- math (FunctionAndTypeManager built-ins; MathFunctions.java) --
+        if name == "pow":
+            name = "power"
+        if name in ("sqrt", "exp", "ln", "log2", "log10", "sin", "cos",
+                    "tan", "asin", "acos", "atan", "cbrt", "degrees",
+                    "radians", "power", "truncate"):
+            return call(name, DOUBLE, *args)
+        if name == "pi":
+            return ConstantExpression(3.141592653589793, DOUBLE)
+        if name == "e":
+            return ConstantExpression(2.718281828459045, DOUBLE)
+        if name in ("ceil", "ceiling", "floor"):
+            t = args[0].type
+            out = (DOUBLE if isinstance(t, (DoubleType, RealType))
+                   else BIGINT)
+            return call("ceiling" if name == "ceil" else name, out, *args)
+        if name == "sign":
+            t = args[0].type
+            return call("sign", DOUBLE if isinstance(
+                t, (DoubleType, RealType)) else BIGINT, *args)
+        if name == "mod":
+            return call("$operator$modulus",
+                        _arith_type("%", args[0].type, args[1].type),
+                        *args)
+        if name in ("greatest", "least"):
+            t = args[0].type
+            for a in args[1:]:
+                t = _arith_type("+", t, a.type) \
+                    if not isinstance(t, (VarcharType, CharType)) else t
+            return call(name, t, *args)
+        # -- strings (StringFunctions.java) -------------------------------
+        if name in ("upper", "lower", "trim", "ltrim", "rtrim", "reverse",
+                    "replace", "lpad", "rpad", "concat"):
+            return call(name, VarcharType(None), *args)
+        if name == "strpos":
+            return call("strpos", BIGINT, *args)
+        if name == "starts_with":
+            return call("starts_with", BOOLEAN, *args)
+        # -- dates (DateTimeFunctions.java) -------------------------------
+        if name in ("day_of_week", "dow"):
+            return call("day_of_week", BIGINT, *args)
+        if name in ("day_of_year", "doy"):
+            return call("day_of_year", BIGINT, *args)
+        if name in ("week", "week_of_year"):
+            return call("week", BIGINT, *args)
+        if name == "date_trunc":
+            return call("date_trunc", args[1].type, *args)
+        if name == "date_add":
+            return call("date_add", args[2].type, *args)
+        if name == "date_diff":
+            return call("date_diff", BIGINT, *args)
         raise PlanningError(f"unknown function {name!r}")
 
 

@@ -1,0 +1,156 @@
+"""RuntimeStats + tracer SPI.
+
+The analog of the reference's fine-grained engine profiling (§5.1):
+
+  * RuntimeStats (presto-common/.../common/RuntimeStats.java): a
+    thread-safe name -> {sum, count, min, max, unit} metric map threaded
+    through query execution; phases are recorded with
+    `record_wall(name)` the way SqlQueryExecution.java:556-614 wraps
+    analysis/optimization/fragmentation in recordWallAndCpuTime, and the
+    map is mergeable (task stats roll up into query stats).
+
+  * Tracer SPI (TracerProviderManager / SimpleTracer,
+    presto-main-base/.../tracing/): pluggable `TracerProvider`; the
+    in-tree SimpleTracer records per-query trace points with wall-clock
+    timestamps, queryable for tests/ops.  NoopTracer is the default.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+NANO = 1_000_000_000
+
+
+@dataclass
+class Metric:
+    unit: str = "NANO"      # NANO | BYTE | NONE (RuntimeUnit analog)
+    sum: float = 0.0
+    count: int = 0
+    min: float = float("inf")
+    max: float = float("-inf")
+
+    def add(self, value: float) -> None:
+        self.sum += value
+        self.count += 1
+        self.min = min(self.min, value)
+        self.max = max(self.max, value)
+
+    def merge(self, other: "Metric") -> None:
+        self.sum += other.sum
+        self.count += other.count
+        self.min = min(self.min, other.min)
+        self.max = max(self.max, other.max)
+
+    def to_dict(self) -> dict:
+        return {"unit": self.unit, "sum": self.sum, "count": self.count,
+                "min": self.min if self.count else 0,
+                "max": self.max if self.count else 0}
+
+
+class RuntimeStats:
+    def __init__(self):
+        self._metrics: Dict[str, Metric] = {}
+        self._lock = threading.Lock()
+
+    def add(self, name: str, value: float, unit: str = "NONE") -> None:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = Metric(unit)
+            m.add(value)
+
+    @contextmanager
+    def record_wall(self, name: str):
+        """recordWallAndCpuTime analog (wall only; CPU time is not
+        meaningful for device-side work)."""
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.add(name + "WallNanos",
+                     (time.perf_counter() - t0) * NANO, "NANO")
+
+    def merge(self, other: "RuntimeStats") -> None:
+        with other._lock:
+            items = list(other._metrics.items())
+        with self._lock:
+            for name, m in items:
+                mine = self._metrics.get(name)
+                if mine is None:
+                    mine = self._metrics[name] = Metric(m.unit)
+                mine.merge(m)
+
+    def get(self, name: str) -> Optional[Metric]:
+        with self._lock:
+            return self._metrics.get(name)
+
+    def to_dict(self) -> Dict[str, dict]:
+        with self._lock:
+            return {n: m.to_dict() for n, m in sorted(self._metrics.items())}
+
+
+# ---------------------------------------------------------------------------
+# tracer SPI
+# ---------------------------------------------------------------------------
+
+@dataclass
+class TracePoint:
+    annotation: str
+    at: float = field(default_factory=time.time)
+
+
+class Tracer:
+    """SPI (presto-spi tracing.Tracer analog)."""
+
+    def add_point(self, annotation: str) -> None:
+        raise NotImplementedError
+
+    def end_trace(self, annotation: str = "trace ended") -> None:
+        self.add_point(annotation)
+
+
+class NoopTracer(Tracer):
+    def add_point(self, annotation: str) -> None:
+        pass
+
+
+class SimpleTracer(Tracer):
+    """In-memory recording tracer (tracing/SimpleTracer.java)."""
+
+    def __init__(self, trace_token: str = ""):
+        self.trace_token = trace_token
+        self.points: List[TracePoint] = []
+        self._lock = threading.Lock()
+
+    def add_point(self, annotation: str) -> None:
+        with self._lock:
+            self.points.append(TracePoint(annotation))
+
+    def annotations(self) -> List[str]:
+        with self._lock:
+            return [p.annotation for p in self.points]
+
+
+class TracerProvider:
+    """Selected once per process (TracerProviderManager analog)."""
+
+    def __init__(self, kind: str = "noop"):
+        self.kind = kind
+        self._traces: Dict[str, SimpleTracer] = {}
+        self._lock = threading.Lock()
+
+    def new_tracer(self, trace_token: str) -> Tracer:
+        if self.kind != "simple":
+            return NoopTracer()
+        t = SimpleTracer(trace_token)
+        with self._lock:
+            self._traces[trace_token] = t
+        return t
+
+    def get_trace(self, trace_token: str) -> Optional[SimpleTracer]:
+        with self._lock:
+            return self._traces.get(trace_token)

@@ -45,6 +45,8 @@ _ROUTES = [
         r"/(?P<slug>[^/]+)/\d+$"), "statement_cancel"),
     ("GET", re.compile(r"^/v1/query$"), "query_list"),
     ("GET", re.compile(r"^/v1/query/(?P<qid>[^/]+)$"), "query_info"),
+    ("POST", re.compile(r"^/v1/plan-check$"), "plan_check"),
+    ("GET", re.compile(r"^/ui/?$"), "ui"),
     ("GET", re.compile(r"^/v1/info/state$"), "info_state"),
     ("PUT", re.compile(r"^/v1/info/state$"), "info_state_put"),
     ("GET", re.compile(r"^/v1/status$"), "status"),
@@ -302,8 +304,62 @@ class _Handler(BaseHTTPRequestHandler):
             "queryId": q.query_id, "query": q.sql, "state": q.state,
             "queryStats": q.stats(), "session": q.session,
             "resourceGroupId": [q.resource_group],
+            **({"runtimeStats": q.runtime_stats}
+               if q.runtime_stats else {}),
             **({"failureInfo": {"message": q.error}} if q.error else {}),
             "resourceGroups": d.resource_groups.info()})
+
+    def do_plan_check(self, groups, query):
+        """Sidecar plan validation (presto-native-sidecar-plugin
+        nativechecker analog): can the native planner handle this SQL?
+        Consumed by the plan-check router scheduler."""
+        from .router import plan_checks
+        sql = self._body().decode()
+        err = plan_checks(sql,
+                          schema=self.headers.get("X-Presto-Schema",
+                                                  "sf0.01"),
+                          catalog=self.headers.get("X-Presto-Catalog",
+                                                   "tpch"))
+        self._send(200, {"ok": err is None,
+                         **({"error": err} if err else {})})
+
+    def do_ui(self, groups, query):
+        """Minimal cluster console (the presto-ui query-list analog)."""
+        from html import escape
+        from urllib.parse import quote
+        s = self.server_ref
+        rows = []
+        if s.dispatch is not None:
+            for q in reversed(s.dispatch.list_queries()):
+                state = q["state"]
+                color = {"FINISHED": "#2d7", "FAILED": "#d55",
+                         "RUNNING": "#27d", "QUEUED": "#fa0"}.get(state,
+                                                                  "#999")
+                sql = (q["query"][:120] + "…") if len(q["query"]) > 120 \
+                    else q["query"]
+                # query text and ids are client-controlled: escape
+                rows.append(
+                    f"<tr><td><a href='/v1/query/"
+                    f"{quote(q['queryId'])}'>"
+                    f"{escape(q['queryId'])}</a></td>"
+                    f"<td style='color:{color}'>{escape(state)}</td>"
+                    f"<td>{escape(q['resourceGroup'])}</td>"
+                    f"<td><code>{escape(sql)}</code></td></tr>")
+        workers = "".join(f"<li>{u}</li>" for u in s.worker_uris())
+        html = f"""<!doctype html><html><head><title>presto-tpu</title>
+<style>body{{font-family:sans-serif;margin:2em}}table{{border-collapse:
+collapse}}td,th{{border:1px solid #ccc;padding:4px 8px;text-align:left}}
+</style></head><body>
+<h1>presto-tpu {'coordinator' if s.coordinator else 'worker'}
+ <small>{s.node_id}</small></h1>
+<p>state: {s.state} &middot; uptime: {time.time() - s.started_at:.0f}s</p>
+<h2>workers</h2><ul>{workers or '<li>(none announced)</li>'}</ul>
+<h2>queries</h2>
+<table><tr><th>query</th><th>state</th><th>group</th><th>sql</th></tr>
+{''.join(rows) or '<tr><td colspan=4>(none)</td></tr>'}</table>
+</body></html>"""
+        self._send(200, None, html.encode(),
+                   headers={"Content-Type": "text/html; charset=utf-8"})
 
     def do_task_update(self, groups, query):
         if self.server_ref.state != "ACTIVE":

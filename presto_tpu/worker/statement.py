@@ -147,11 +147,13 @@ class ManagedQuery:
     error: Optional[str] = None
     columns: Optional[List[dict]] = None
     rows: Optional[list] = None
+    runtime_stats: Optional[dict] = None
     created_at: float = field(default_factory=time.time)
     started_at: Optional[float] = None
     finished_at: Optional[float] = None
     done: threading.Event = field(default_factory=threading.Event)
     _cancelled: bool = False
+    _admitted: bool = False     # holds a resource-group running slot
 
     def stats(self) -> dict:
         now = self.finished_at or time.time()
@@ -200,6 +202,7 @@ class DispatchManager:
                         del self._queries[k]
         try:
             if self.resource_groups.admit(q):
+                q._admitted = True
                 self._start(q)
         except QueryQueueFullError as e:
             q.state = FAILED
@@ -213,30 +216,54 @@ class DispatchManager:
                              name=f"query-{q.query_id}", daemon=True)
         t.start()
 
+    MAX_RETRIES = 2
+
     def _run(self, q: ManagedQuery) -> None:
         if q._cancelled:
             self._finish(q, CANCELED, None)
             return
         q.state = RUNNING
         q.started_at = time.time()
-        try:
-            result = self._executor(q)
-            q.columns = [{"name": n, "type": str(t)}
-                         for n, t in zip(result.column_names,
-                                         result.column_types)]
-            q.rows = [[_json_value(v) for v in row] for row in result.rows]
-            self._finish(q, CANCELED if q._cancelled else FINISHED, None)
-        except Exception as e:  # noqa: BLE001 — becomes the client error
-            self._finish(q, FAILED, f"{type(e).__name__}: {e}")
+        attempt = 0
+        while True:
+            try:
+                result = self._executor(q)
+                q.columns = [{"name": n, "type": str(t)}
+                             for n, t in zip(result.column_names,
+                                             result.column_types)]
+                q.rows = [[_json_value(v) for v in row]
+                          for row in result.rows]
+                q.runtime_stats = getattr(result, "runtime_stats", None)
+                self._finish(q, CANCELED if q._cancelled else FINISHED,
+                             None)
+                return
+            except Exception as e:  # noqa: BLE001 — becomes client error
+                # transient infrastructure failures retry the whole query
+                # (the ErrorClassifier analog, presto-spark-base
+                # ErrorClassifier.java: worker death / connection loss is
+                # retryable, user errors are not)
+                if _is_retryable(e) and attempt < self.MAX_RETRIES \
+                        and not q._cancelled:
+                    attempt += 1
+                    time.sleep(0.2 * attempt)
+                    continue
+                self._finish(q, FAILED, f"{type(e).__name__}: {e}")
+                return
 
     def _finish(self, q: ManagedQuery, state: str, error: Optional[str]):
         q.state = state
+        if state == CANCELED and error is None:
+            error = "Query was canceled"   # clients must not see success
         q.error = error
         q.finished_at = time.time()
         q.done.set()
-        nxt = self.resource_groups.release(q)
-        if nxt is not None:
-            self._start(nxt)
+        # only a query that held a running slot frees one; cancelling a
+        # QUEUED query must not over-admit past hardConcurrencyLimit
+        if q._admitted:
+            nxt = self.resource_groups.release(q)
+            if nxt is not None:
+                nxt._admitted = True
+                self._start(nxt)
 
     # -- lookup / cancel --------------------------------------------------
     def get(self, query_id: str) -> ManagedQuery:
@@ -272,8 +299,10 @@ class DispatchManager:
                                f"{q.query_id}/{q.slug}/{token + 1}")
         elif q.state in (FAILED, CANCELED) and q.rows is None:
             if q.error:
-                resp["error"] = {"message": q.error,
-                                 "errorName": "QUERY_FAILED"}
+                resp["error"] = {
+                    "message": q.error,
+                    "errorName": ("USER_CANCELED" if q.state == CANCELED
+                                  else "QUERY_FAILED")}
         else:
             resp["nextUri"] = (f"{base_uri}/v1/statement/executing/"
                                f"{q.query_id}/{q.slug}/0")
@@ -293,8 +322,10 @@ class DispatchManager:
             return resp
         if q.state in (FAILED, CANCELED):
             if q.error:
-                resp["error"] = {"message": q.error,
-                                 "errorName": "QUERY_FAILED"}
+                resp["error"] = {
+                    "message": q.error,
+                    "errorName": ("USER_CANCELED" if q.state == CANCELED
+                                  else "QUERY_FAILED")}
             return resp
         lo = token * self.RESULT_CHUNK_ROWS
         hi = lo + self.RESULT_CHUNK_ROWS
@@ -305,6 +336,22 @@ class DispatchManager:
             resp["nextUri"] = (f"{base_uri}/v1/statement/executing/"
                                f"{q.query_id}/{q.slug}/{token + 1}")
         return resp
+
+
+def _is_retryable(e: Exception) -> bool:
+    """Worker/connection failures are retryable; planning, semantic, and
+    storage errors are the user's (reference ErrorClassifier semantics).
+    NOT every OSError qualifies: urllib HTTPError (4xx from a worker) and
+    FileNotFoundError are permanent."""
+    import urllib.error
+    if isinstance(e, (urllib.error.HTTPError, FileNotFoundError)):
+        return False
+    if isinstance(e, (ConnectionError, TimeoutError)):
+        return True
+    msg = str(e).lower()
+    return any(s in msg for s in ("connection refused", "no live workers",
+                                  "node is shutting down", "timed out",
+                                  "remote task failed"))
 
 
 def _json_value(v):

@@ -1,0 +1,76 @@
+"""DB-API 2.0 driver over the statement protocol (the presto-jdbc analog)."""
+import pytest
+
+import presto_tpu.dbapi as dbapi
+from presto_tpu.exec.pipeline import ExecutionConfig
+from presto_tpu.worker import WorkerServer
+
+
+@pytest.fixture(scope="module")
+def server():
+    s = WorkerServer(coordinator=True, environment="test",
+                     config=ExecutionConfig(batch_rows=1 << 13))
+    yield s
+    s.close()
+
+
+@pytest.fixture(scope="module")
+def conn(server):
+    with dbapi.connect(server.uri, schema="sf0.01") as c:
+        yield c
+
+
+def test_module_globals():
+    assert dbapi.apilevel == "2.0"
+    assert dbapi.paramstyle == "qmark"
+
+
+def test_cursor_fetch(conn):
+    cur = conn.cursor()
+    cur.execute("SELECT returnflag, count(*) c FROM lineitem "
+                "GROUP BY returnflag ORDER BY returnflag")
+    assert [d[0] for d in cur.description] == ["returnflag", "c"]
+    assert cur.rowcount == 3
+    first = cur.fetchone()
+    assert first[0] == "A"
+    rest = cur.fetchall()
+    assert len(rest) == 2
+    assert cur.fetchone() is None
+
+
+def test_iteration_and_fetchmany(conn):
+    cur = conn.cursor()
+    cur.execute("SELECT orderkey FROM orders WHERE orderkey <= 40 "
+                "ORDER BY orderkey")
+    two = cur.fetchmany(2)
+    assert [r[0] for r in two] == [1, 2]
+    remaining = list(cur)
+    assert remaining[0][0] > 2
+
+
+def test_qmark_parameters(conn):
+    cur = conn.cursor()
+    cur.execute("SELECT count(*) c FROM orders WHERE orderkey <= ? "
+                "AND orderstatus = ?", (100, "F"))
+    n = cur.fetchone()[0]
+    cur.execute("SELECT count(*) c FROM orders WHERE orderkey <= 100 "
+                "AND orderstatus = 'F'")
+    assert cur.fetchone()[0] == n
+    with pytest.raises(dbapi.ProgrammingError):
+        cur.execute("SELECT ? + ?", (1,))
+
+
+def test_qmark_inside_string_literal(conn):
+    cur = conn.cursor()
+    cur.execute("SELECT count(*) c FROM orders WHERE orderstatus <> 'a?b' "
+                "AND orderkey <= ?", (50,))
+    assert cur.fetchone()[0] == 50
+
+
+def test_errors(conn):
+    cur = conn.cursor()
+    with pytest.raises(dbapi.ProgrammingError):
+        cur.execute("SELECT no_such FROM lineitem")
+    conn2 = dbapi.connect("http://127.0.0.1:1", schema="sf0.01")
+    with pytest.raises(dbapi.OperationalError):
+        conn2.cursor().execute("SELECT 1")

@@ -1,0 +1,69 @@
+"""RuntimeStats metric map + tracer SPI (§5.1 analog: RuntimeStats.java,
+TracerProviderManager/SimpleTracer) and their flow through the runner and
+the statement protocol's query info."""
+import json
+import urllib.request
+
+from presto_tpu.exec.pipeline import ExecutionConfig
+from presto_tpu.exec.runner import LocalQueryRunner
+from presto_tpu.utils.runtime_stats import (Metric, RuntimeStats,
+                                            SimpleTracer, TracerProvider)
+
+
+def test_metric_merge():
+    a, b = RuntimeStats(), RuntimeStats()
+    a.add("x", 5)
+    b.add("x", 7)
+    b.add("y", 1)
+    a.merge(b)
+    m = a.get("x")
+    assert m.sum == 12 and m.count == 2 and m.min == 5 and m.max == 7
+    assert a.get("y").sum == 1
+
+
+def test_record_wall():
+    s = RuntimeStats()
+    with s.record_wall("phase"):
+        pass
+    m = s.get("phaseWallNanos")
+    assert m is not None and m.count == 1 and m.sum >= 0
+
+
+def test_runner_records_phases():
+    r = LocalQueryRunner("sf0.01", config=ExecutionConfig(
+        batch_rows=1 << 13))
+    res = r.execute("SELECT count(*) c FROM orders")
+    assert "queryParseWallNanos" in res.runtime_stats
+    assert "queryExecuteWallNanos" in res.runtime_stats
+    # first run plans; cached re-run may skip the plan phase
+    assert "queryPlanWallNanos" in res.runtime_stats
+
+
+def test_simple_tracer_through_runner():
+    tp = TracerProvider("simple")
+    r = LocalQueryRunner("sf0.01", config=ExecutionConfig(
+        batch_rows=1 << 13), tracer_provider=tp)
+    sql = "SELECT count(*) c FROM orders"
+    r.execute(sql)
+    trace = tp.get_trace(sql)
+    assert isinstance(trace, SimpleTracer)
+    anns = trace.annotations()
+    assert anns[0] == "query parsed"
+    assert anns[-1] == "query finished"
+
+
+def test_runtime_stats_in_query_info():
+    from presto_tpu.client import StatementClient
+    from presto_tpu.worker import WorkerServer
+    server = WorkerServer(coordinator=True, environment="test",
+                          config=ExecutionConfig(batch_rows=1 << 13))
+    try:
+        c = StatementClient(server.uri, schema="sf0.01")
+        r = c.execute("SELECT count(*) c FROM orders")
+        with urllib.request.urlopen(
+                f"{server.uri}/v1/query/{r.query_id}") as resp:
+            info = json.loads(resp.read())
+        assert "runtimeStats" in info
+        assert "queryExecuteWallNanos" in info["runtimeStats"]
+    finally:
+        server.close()

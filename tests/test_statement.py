@@ -178,6 +178,40 @@ def test_cancel_queued():
     assert q1.done.wait(5)
 
 
+def test_cancel_queued_does_not_over_admit():
+    """Cancelling a QUEUED query must not free a slot it never held."""
+    gate = threading.Event()
+    rgm = ResourceGroupManager(
+        [ResourceGroupSpec("g", hard_concurrency_limit=1, max_queued=5)],
+        [Selector(group="g")])
+    d = DispatchManager(_slow_executor(gate), rgm)
+    q1 = d.submit("s1")
+    q2 = d.submit("s2")
+    q3 = d.submit("s3")
+    d.cancel(q2.query_id)
+    time.sleep(0.1)
+    info = rgm.info()["g"]
+    assert info["running"] <= 1
+    assert q3.state == QUEUED          # q3 must not start while q1 runs
+    gate.set()
+    assert q1.done.wait(5) and q3.done.wait(5)
+
+
+def test_canceled_query_reports_error():
+    gate = threading.Event()
+    rgm = ResourceGroupManager(
+        [ResourceGroupSpec("g", hard_concurrency_limit=1, max_queued=5)],
+        [Selector(group="g")])
+    d = DispatchManager(_slow_executor(gate), rgm)
+    q1 = d.submit("s1")
+    q2 = d.submit("s2")
+    d.cancel(q2.query_id)
+    resp = d.executing_response(q2, 0, "http://x")
+    assert resp["error"]["errorName"] == "USER_CANCELED"
+    gate.set()
+    q1.done.wait(5)
+
+
 def test_selector_routing():
     rgm = ResourceGroupManager(
         [ResourceGroupSpec("etl"), ResourceGroupSpec("adhoc")],

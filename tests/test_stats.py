@@ -1,0 +1,141 @@
+"""Cost/stats layer: column stats, selectivity estimation, join build-side
+selection, EXPLAIN estimates (reference cost module analog — SURVEY.md §2.3
+StatsCalculator/CostCalculator + DetermineJoinDistributionType)."""
+import pytest
+
+from presto_tpu.spi import plan as P
+from presto_tpu.sql.planner import Planner
+from presto_tpu.sql.stats import StatsCalculator
+from presto_tpu.exec.runner import LocalQueryRunner
+from presto_tpu.exec.pipeline import ExecutionConfig
+
+
+def _plan(sql, schema="sf0.01"):
+    return Planner(schema).plan(sql)
+
+
+def _actual_rows(runner, sql):
+    return runner.execute(sql).rows[0][0]
+
+
+def test_scan_estimate_matches_row_count():
+    out = _plan("SELECT orderkey FROM orders")
+    est = StatsCalculator().rows(out)
+    assert est == 15000    # sf0.01 orders
+
+
+@pytest.mark.parametrize("pred,expect_frac", [
+    ("quantity < 24", 24 / 50),
+    ("quantity >= 40", 10 / 50),
+    ("discount BETWEEN 0.05 AND 0.07", 0.02 / 0.10),
+    ("returnflag = 'A'", 1 / 3),
+])
+def test_filter_selectivity(pred, expect_frac):
+    out = _plan(f"SELECT orderkey FROM lineitem WHERE {pred}")
+    est = StatsCalculator().rows(out)
+    assert est == pytest.approx(60175 * expect_frac, rel=0.15)
+
+
+def test_selectivity_tracks_actual():
+    """Estimated cardinality within 2x of actual for Q6-style conjunction."""
+    r = LocalQueryRunner("sf0.01", config=ExecutionConfig(
+        batch_rows=1 << 13))
+    sql = ("SELECT count(*) c FROM lineitem WHERE quantity < 24 "
+           "AND discount BETWEEN 0.05 AND 0.07")
+    actual = _actual_rows(r, sql)
+    out = _plan("SELECT orderkey FROM lineitem WHERE quantity < 24 "
+                "AND discount BETWEEN 0.05 AND 0.07")
+    est = StatsCalculator().rows(out)
+    assert actual / 2 <= est <= actual * 2
+
+
+def test_join_estimate_fk_pk():
+    out = _plan("SELECT o.orderkey FROM orders o "
+                "JOIN customer c ON o.custkey = c.custkey")
+    est = StatsCalculator().rows(out)
+    # FK-PK join keeps the fact side's cardinality
+    assert est == pytest.approx(15000, rel=0.5)
+
+
+def test_group_count_capped_by_ndv():
+    out = _plan("SELECT returnflag, linestatus, count(*) c FROM lineitem "
+                "GROUP BY returnflag, linestatus")
+    est = StatsCalculator().rows(out)
+    assert est == 6.0      # 3 x 2 closed domains
+
+
+def test_build_side_swap():
+    """Inner join with the big table on the build (right) side gets its
+    sides swapped; small build side stays."""
+    out = _plan("SELECT c.custkey FROM customer c "
+                "JOIN lineitem l ON c.custkey = l.orderkey")
+    join = next(n for n in P.walk_plan(out) if isinstance(n, P.JoinNode))
+    calc = StatsCalculator()
+    assert calc.rows(join.right) <= calc.rows(join.left)
+
+    out2 = _plan("SELECT c.custkey FROM lineitem l "
+                 "JOIN customer c ON l.orderkey = c.custkey")
+    join2 = next(n for n in P.walk_plan(out2) if isinstance(n, P.JoinNode))
+    calc2 = StatsCalculator()
+    assert calc2.rows(join2.right) <= calc2.rows(join2.left)
+
+
+def test_swap_preserves_results():
+    r = LocalQueryRunner("sf0.01", config=ExecutionConfig(
+        batch_rows=1 << 13))
+    # build side (customer) much smaller than probe (lineitem via orders):
+    # exercised both in written order and reversed
+    for sql in [
+        "SELECT c.mktsegment, count(*) n FROM customer c "
+        "JOIN orders o ON c.custkey = o.custkey GROUP BY c.mktsegment",
+        "SELECT c.mktsegment, count(*) n FROM orders o "
+        "JOIN customer c ON o.custkey = c.custkey GROUP BY c.mktsegment",
+    ]:
+        r.assert_same_as_reference(sql)
+
+
+def test_explain_includes_estimates():
+    r = LocalQueryRunner("sf0.01")
+    res = r.execute("EXPLAIN SELECT count(*) c FROM lineitem "
+                    "WHERE quantity < 24")
+    text = "\n".join(row[0] for row in res.rows)
+    assert "rows≈" in text
+
+
+def test_hive_external_decimal_stats_logical(tmp_path):
+    """External decimal128 parquet stats are already logical — no double
+    descale."""
+    import os
+    from decimal import Decimal
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    from presto_tpu.connectors import hive
+    os.makedirs(tmp_path / "ext2")
+    pq.write_table(pa.table({
+        "price": pa.array([Decimal("100.00"), Decimal("250.50")],
+                          type=pa.decimal128(10, 2))}),
+        tmp_path / "ext2" / "part-0.parquet")
+    conn = hive.HiveConnector(str(tmp_path))
+    cs = conn.column_stats("ext2", "price", 0.01)
+    assert cs.low == 100.0 and cs.high == 250.5
+
+
+def test_hive_parquet_stats(tmp_path):
+    from presto_tpu.connectors import catalog, hive
+    conn = hive.HiveConnector(str(tmp_path))
+    catalog.register_connector("hive", conn)
+    try:
+        r = LocalQueryRunner("sf0.01", config=ExecutionConfig(
+            batch_rows=1 << 13))
+        r.execute("CREATE TABLE st AS SELECT orderkey, totalprice "
+                  "FROM orders WHERE orderkey <= 1000")
+        cs = conn.column_stats("st", "orderkey", 0.01)
+        assert cs.low == 1 and cs.high == 1000
+        tp = conn.column_stats("st", "totalprice", 0.01)
+        assert tp.low is not None and tp.high <= 500000.01
+        # estimates flow into plans over hive tables
+        out = _plan("SELECT orderkey FROM st WHERE orderkey <= 100")
+        est = StatsCalculator().rows(out)
+        assert est == pytest.approx(100, rel=0.2)
+    finally:
+        catalog.unregister_connector("hive")
