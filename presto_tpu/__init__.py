@@ -10,4 +10,20 @@ import jax as _jax
 # array is created.
 _jax.config.update("jax_enable_x64", True)
 
+# Persistent XLA compilation cache: pipeline shapes recur across queries and
+# processes, and TPU sort/scan kernels can take tens of seconds to compile.
+# Opt out with PRESTO_TPU_NO_COMPILE_CACHE=1.
+import os as _os
+
+if not _os.environ.get("PRESTO_TPU_NO_COMPILE_CACHE"):
+    _cache_dir = _os.environ.get(
+        "JAX_COMPILATION_CACHE_DIR",
+        _os.path.expanduser("~/.cache/presto_tpu_xla"))
+    try:
+        _os.makedirs(_cache_dir, exist_ok=True)
+        _jax.config.update("jax_compilation_cache_dir", _cache_dir)
+        _jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:   # cache is best-effort
+        pass
+
 __version__ = "0.1.0"

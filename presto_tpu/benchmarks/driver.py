@@ -50,8 +50,8 @@ def main(argv=None) -> int:
 
     results = []
     for qnum in nums:
-        sql = suite[qnum]
         try:
+            sql = suite[qnum]
             for _ in range(args.prewarm):
                 runner.execute(sql)
             runs = []
@@ -68,6 +68,9 @@ def main(argv=None) -> int:
                    "error": f"{type(e).__name__}: {e}"}
         results.append(rec)
         print(json.dumps(rec), flush=True)
+        if args.json:   # incremental: a killed run keeps prior results
+            with open(args.json, "w") as f:
+                json.dump({"results": results}, f, indent=1)
 
     ok = [r for r in results if "best_s" in r]
     summary = {"suite": "tpch", "sf": args.sf,

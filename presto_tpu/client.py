@@ -51,7 +51,7 @@ class StatementClient:
         self.timeout_s = timeout_s
 
     def _request(self, url: str, method: str = "GET",
-                 data: Optional[bytes] = None) -> dict:
+                 data: Optional[bytes] = None, _hops: int = 0) -> dict:
         headers = {
             "X-Presto-User": self.user,
             "X-Presto-Source": self.source,
@@ -68,9 +68,13 @@ class StatementClient:
                 body = resp.read()
         except urllib.error.HTTPError as e:
             if e.code in (307, 308) and "Location" in e.headers:
+                if _hops >= 5:
+                    raise QueryError("redirect loop (more than 5 hops)",
+                                     {"location": e.headers["Location"]})
                 # a query router redirects POST /v1/statement to the chosen
                 # cluster (urllib won't re-POST a redirect by itself)
-                return self._request(e.headers["Location"], method, data)
+                return self._request(e.headers["Location"], method, data,
+                                     _hops + 1)
             raise
         return json.loads(body) if body else {}
 

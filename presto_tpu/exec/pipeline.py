@@ -48,6 +48,41 @@ DEFAULT_CAPACITY = 1 << 20
 # every query (the compile-once/execute-many property that makes repeated
 # queries cheap — the analog of the reference's reusable DriverFactories)
 _jit_concat = jax.jit(lambda batches: _concat_batches(batches))
+_jit_compact = jax.jit(ops.compact, static_argnums=1)
+
+
+def _compact_concat(batches: List[Batch]) -> Batch:
+    """Concatenate batches, dropping masked-out padding when it dominates.
+
+    Operators that materialize their whole input (sort, window, join build)
+    compile per merged shape; concatenating full-capacity padded batches
+    after a selective filter yields huge mostly-dead arrays (e.g. 8M-row
+    merges holding 80k live rows) whose sort kernels take ~50s to compile
+    and dominate execution.  When under 1/4 of the merged rows are live,
+    each batch is compacted (fixed per-capacity shapes, compiled once) and
+    sliced to a power-of-two bucket, so downstream sorts compile at a small
+    bucketed capacity shared across queries."""
+    if len(batches) == 1:
+        return batches[0]
+    total_cap = sum(b.capacity for b in batches)
+    counts = [int(c) for c in jax.device_get(
+        [b.mask.sum() for b in batches])]
+    if sum(counts) * 4 >= total_cap:
+        return _jit_concat(batches)
+    out = []
+    for b, n in zip(batches, counts):
+        if n == 0:
+            continue
+        # coarse bucket set bounds the number of compiled shape variants
+        bucket = next((s for s in (1 << 12, 1 << 16, 1 << 18, 1 << 20)
+                       if s >= n), 1 << (int(n) - 1).bit_length())
+        out.append(b if bucket >= b.capacity
+                   else _jit_compact(b, bucket))
+    if not out:
+        return batches[0]      # all rows masked: keep an all-dead batch
+    if len(out) == 1:
+        return out[0]
+    return _jit_concat(out)
 _jit_sort = None
 _jit_build = None
 _jit_window = None
@@ -532,7 +567,7 @@ class PlanCompiler:
             all_batches = list(src.batches())
             if not all_batches:
                 return
-            merged = _jit_concat(all_batches) \
+            merged = _compact_concat(all_batches) \
                 if len(all_batches) > 1 else all_batches[0]
             yield _jits()[0](merged, tuple(keys))
         return BatchSource(gen, src.names, src.types)
@@ -626,7 +661,7 @@ class PlanCompiler:
             batches = list(src.batches())
             if not batches:
                 return
-            merged = _jit_concat(batches) \
+            merged = _compact_concat(batches) \
                 if len(batches) > 1 else batches[0]
             # late-materialized string keys: window_batch both SORTS by and
             # compares (partition identity / peer detection) every key, so a
@@ -977,7 +1012,7 @@ class PlanCompiler:
             return None
         if len(batches) == 1:
             return batches[0]
-        return _jit_concat(batches)
+        return _compact_concat(batches)
 
     def _compile_JoinNode(self, node: P.JoinNode) -> BatchSource:
         if node.join_type not in (P.INNER, P.LEFT, P.FULL):
@@ -1024,7 +1059,19 @@ class PlanCompiler:
                 cfg.join_out_capacity,
                 join_type="LEFT" if full else node.join_type,
                 filter_fn=filter_fn, matched=matched)
-            return joined, overflow, matched
+            return joined, overflow, total, matched
+
+        def shrink(joined, live):
+            """Compact a joined batch whose out_capacity padding dominates:
+            downstream per-batch work (hash-agg scatter rounds, further
+            probes) scales with CAPACITY, so selective joins would
+            otherwise pay 2M-row costs for a few thousand live rows."""
+            live = int(live)
+            bucket = next((s for s in (1 << 12, 1 << 16, 1 << 18, 1 << 20)
+                           if s >= live), None)
+            if bucket is None or bucket * 4 > joined.capacity:
+                return joined
+            return _jit_compact(joined, bucket)
 
         probe_names = [n for n in out_names if n not in build_out]
 
@@ -1057,17 +1104,21 @@ class PlanCompiler:
                 matched = (jnp.zeros(build_batch.capacity, dtype=bool)
                            if full else None)
                 for batch in batches:
-                    joined, overflow, matched = step(batch, table, matched)
-                    if bool(overflow):
+                    joined, overflow, total, matched = step(batch, table,
+                                                            matched)
+                    ov, live = jax.device_get((overflow, total))
+                    if bool(ov):
                         # split the probe batch in halves and retry
                         for half in _split_batch(batch):
-                            j2, ov2, matched = step(half, table, matched)
+                            j2, ov2, t2, matched = step(half, table,
+                                                        matched)
+                            ov2, live2 = jax.device_get((ov2, t2))
                             if bool(ov2):
                                 raise RuntimeError(
                                     "join output overflow after split")
-                            yield j2.select(out_names)
+                            yield shrink(j2, live2).select(out_names)
                     else:
-                        yield joined.select(out_names)
+                        yield shrink(joined, live).select(out_names)
                 if full:
                     yield unmatched_build(build_batch, matched)
 
@@ -1099,7 +1150,7 @@ class PlanCompiler:
                     build_batch = (
                         None if not collected else collected[0]
                         if len(collected) == 1
-                        else _jit_concat(collected))
+                        else _compact_concat(collected))
                     probe = self._compile(probe_src_node)
                     if build_batch is None:
                         if node.join_type == P.INNER:

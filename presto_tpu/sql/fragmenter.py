@@ -66,54 +66,57 @@ def _connector_stats_fn(connector_id: str):
     return CONNECTOR_STATS.get(connector_id)
 
 
-def estimate_rows(node: P.PlanNode) -> Optional[float]:
+def estimate_rows(node: P.PlanNode, calc=None) -> Optional[float]:
     """Output-cardinality estimate: the stats module's selectivity-aware
     estimator (sql/stats.py, the StatsCalculator analog) first, falling
-    back to the original coarse heuristics when stats are unavailable."""
+    back to the original coarse heuristics when stats are unavailable.
+    Pass a shared StatsCalculator (`calc`) when estimating many nodes of
+    one plan — its memo makes the pass O(nodes) instead of O(nodes^2)."""
     from .stats import StatsCalculator
-    est = StatsCalculator().rows(node)
+    calc = calc or StatsCalculator()
+    est = calc.rows(node)
     if est is not None:
         return est
-    return _estimate_rows_heuristic(node)
+    return _estimate_rows_heuristic(node, calc)
 
 
-def _estimate_rows_heuristic(node: P.PlanNode) -> Optional[float]:
+def _estimate_rows_heuristic(node: P.PlanNode, calc) -> Optional[float]:
     if isinstance(node, P.TableScanNode):
         fn = _connector_stats_fn(node.table.connector_id)
         return fn(node.table) if fn else None
     if isinstance(node, P.FilterNode):
-        c = estimate_rows(node.source)
+        c = estimate_rows(node.source, calc)
         return None if c is None else c * 0.5
     if isinstance(node, (P.ProjectNode, P.OutputNode, P.SortNode,
                          P.MarkDistinctNode, P.AssignUniqueIdNode,
                          P.EnforceSingleRowNode, P.WindowNode)):
-        return estimate_rows(node.sources[0])
+        return estimate_rows(node.sources[0], calc)
     if isinstance(node, (P.LimitNode, P.TopNNode, P.DistinctLimitNode)):
-        c = estimate_rows(node.sources[0])
+        c = estimate_rows(node.sources[0], calc)
         return node.count if c is None else min(float(node.count), c)
     if isinstance(node, P.AggregationNode):
-        c = estimate_rows(node.source)
+        c = estimate_rows(node.source, calc)
         if not node.grouping_keys:
             return 1.0
         return None if c is None else max(1.0, c * 0.1)
     if isinstance(node, P.JoinNode):
-        l, r = estimate_rows(node.left), estimate_rows(node.right)
+        l, r = estimate_rows(node.left, calc), estimate_rows(node.right, calc)
         if l is None or r is None:
             return None
         return max(l, r)
     if isinstance(node, P.SemiJoinNode):
-        return estimate_rows(node.source)
+        return estimate_rows(node.source, calc)
     if isinstance(node, P.ValuesNode):
         return float(len(node.rows))
     if isinstance(node, (P.ExchangeNode, P.UnionNode)):
-        ests = [estimate_rows(s) for s in node.sources]
+        ests = [estimate_rows(s, calc) for s in node.sources]
         if any(e is None for e in ests):
             return None
         return sum(ests)
     if isinstance(node, P.RemoteSourceNode):
         return None
     srcs = node.sources
-    return estimate_rows(srcs[0]) if srcs else None
+    return estimate_rows(srcs[0], calc) if srcs else None
 
 
 # ---------------------------------------------------------------------------
@@ -134,8 +137,11 @@ class _Placed:
 
 class ExchangeInserter:
     def __init__(self, config: Optional[FragmenterConfig] = None):
+        from .stats import StatsCalculator
         self.config = config or FragmenterConfig()
         self._counter = 0
+        # shared memoized estimator for the whole pass (O(nodes))
+        self._calc = StatsCalculator()
 
     # -- helpers ----------------------------------------------------------
     def _id(self, hint: str) -> str:
@@ -295,8 +301,8 @@ class ExchangeInserter:
         if left.dist == SINGLE and right.dist == SINGLE:
             return _Placed(node, SINGLE)
 
-        lest = estimate_rows(node.left)
-        rest = estimate_rows(node.right)
+        lest = estimate_rows(node.left, self._calc)
+        rest = estimate_rows(node.right, self._calc)
         # INNER joins may swap sides so the smaller relation is built
         if node.join_type == P.INNER and lest is not None and rest is not None \
                 and lest < rest:
@@ -331,7 +337,7 @@ class ExchangeInserter:
         node.source, node.filtering_source = src.node, filt.node
         if src.dist == SINGLE and filt.dist == SINGLE:
             return _Placed(node, SINGLE)
-        fest = estimate_rows(node.filtering_source)
+        fest = estimate_rows(node.filtering_source, self._calc)
         if fest is not None and fest <= self.config.broadcast_threshold:
             if filt.dist != SINGLE or src.dist != SINGLE:
                 node.filtering_source = self._broadcast(node.filtering_source)

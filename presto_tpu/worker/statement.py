@@ -241,8 +241,13 @@ class DispatchManager:
                 # transient infrastructure failures retry the whole query
                 # (the ErrorClassifier analog, presto-spark-base
                 # ErrorClassifier.java: worker death / connection loss is
-                # retryable, user errors are not)
-                if _is_retryable(e) and attempt < self.MAX_RETRIES \
+                # retryable, user errors are not).  Writes never retry: a
+                # partially-committed INSERT/CTAS re-executed would
+                # duplicate data.
+                word = q.sql.lstrip()[:6].lower()
+                is_write = word.startswith(("create", "insert", "drop"))
+                if _is_retryable(e) and not is_write \
+                        and attempt < self.MAX_RETRIES \
                         and not q._cancelled:
                     attempt += 1
                     time.sleep(0.2 * attempt)
