@@ -235,7 +235,6 @@ class _Table:
         lo = hi = None
         nulls = 0
         total = 0
-        physical_decimal = False
         for f in self._open():
             md = f.metadata
             try:
@@ -244,7 +243,14 @@ class _Table:
                       for i in range(md.num_columns)].index(column)
             except (KeyError, ValueError):
                 return None
-            physical_decimal = pa.types.is_decimal(field.type)
+            # physical type is PER FILE: a table can mix engine-written
+            # parts (decimals as scaled int64) and external decimal128
+            # parts — convert each file's min/max to logical units before
+            # folding into the running lo/hi
+            descale = 1.0
+            if isinstance(typ, DecimalType) \
+                    and not pa.types.is_decimal(field.type):
+                descale = 10.0 ** typ.scale
             for rg in range(md.num_row_groups):
                 col = md.row_group(rg).column(ci)
                 total += col.num_values
@@ -256,17 +262,12 @@ class _Table:
                 if st.has_min_max and not isinstance(
                         typ, (VarcharType, CharType)):
                     try:
-                        mn, mx = _stat_float(st.min), _stat_float(st.max)
+                        mn, mx = (_stat_float(st.min) / descale,
+                                  _stat_float(st.max) / descale)
                     except (TypeError, ValueError):
                         continue
                     lo = mn if lo is None else min(lo, mn)
                     hi = mx if hi is None else max(hi, mx)
-        if isinstance(typ, DecimalType) and lo is not None \
-                and not physical_decimal:
-            # our own parts store decimals as scaled int64; external
-            # decimal128 stats are already logical values
-            scale = 10.0 ** typ.scale
-            lo, hi = lo / scale, hi / scale
         ndv = None
         dcached = self._dicts.get(column)
         if dcached is not None:

@@ -1,0 +1,493 @@
+"""Whole-pipeline fusion of scan -> filter/project -> join chain -> (agg).
+
+Round-1 fused only scan->filter/project->direct-agg (TPC-H Q1/Q6 shape);
+join-heavy queries streamed probe batches with a host sync per batch, which
+dominated wall-clock (per-sync cost ~0.1-1s on a remote device).  This module
+generalizes fusion to probe-side JOIN CHAINS so an entire pipeline compiles
+into ONE XLA program with a fori_loop over scan chunks — the TPU analog of the
+reference Driver streaming pages through an operator chain with zero host
+round-trips (presto-main-base/.../operator/Driver.java:421-451).
+
+The enabling observation: TPC-H/DS probe joins are FK->PK.  When the build
+side's keys are UNIQUE (checked once on the host after the build side is
+materialized), a probe is fanout<=1: the join never expands rows, so the
+chunk capacity is preserved through the whole chain, no overflow machinery is
+needed in-loop, and a join step reduces to "lookup + gather build columns +
+mask update".  Two lookup structures:
+
+  * DirectTable — dense integer PK (orderkey/custkey/partkey/...): a direct-
+    address array keyed by (key - base).  Probe is ONE int32 gather — no
+    hashing, no searchsorted.  The TPU-native analog of the reference's
+    LookupJoinOperator fast path for integer keys.
+  * the hash-sorted ops.BuildTable — multi-column or sparse keys; probe is
+    one searchsorted (fanout-1 variant of ops.probe_join).
+
+Build sides are materialized BEFORE the loop compiles (they are plan
+subtrees, usually small dims); rows with NULL keys are excluded from the
+build and NULL probe keys never match, per SQL equi-join semantics (the
+numpy oracle exec/reference.py:438-449 is the fixture for this).
+
+Semi joins (IN/EXISTS markers) fuse the same way; duplicate build keys are
+harmless there (the marker is existence), so semi steps never force a
+fallback.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import lru_cache
+from typing import Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..spi import plan as P
+from .batch import Batch, Column
+from . import operators as ops
+
+# absolute cap on a direct-address table (entries), and the max ratio of
+# key span to build rows before falling back to the hash table
+DIRECT_TABLE_MAX = 1 << 26
+DIRECT_TABLE_SPAN_RATIO = 8
+# largest per-join fanout the in-loop expansion handles, and the largest
+# combined expansion across a chain (chunk capacity is divided by it)
+MAX_EXPAND = 64
+MAX_EXPAND_PRODUCT = 256
+
+
+@dataclass
+class DirectTable:
+    """Direct-address build table for a dense integer key."""
+    slots: jnp.ndarray                # int32 build-row index, -1 = absent
+    base: jnp.ndarray                 # scalar int64: smallest key
+    columns: Dict[str, Column]        # original build columns
+
+    def tree_flatten(self):
+        names = tuple(sorted(self.columns))
+        return ((self.slots, self.base,
+                 tuple(self.columns[n] for n in names)), names)
+
+    @classmethod
+    def tree_unflatten(cls, names, children):
+        slots, base, cols = children
+        return cls(slots, base, dict(zip(names, cols)))
+
+
+jax.tree_util.register_pytree_node_class(DirectTable)
+
+
+@lru_cache(maxsize=None)
+def _direct_builder(size: int):
+    @jax.jit
+    def build(values, mask, base):
+        k = jnp.where(mask, values.astype(jnp.int64) - base, size)
+        k = jnp.clip(k, 0, size).astype(jnp.int32)   # size = drop slot
+        rows = jnp.arange(values.shape[0], dtype=jnp.int32)
+        slots = jnp.full(size, -1, jnp.int32).at[k].set(
+            rows, mode="drop")
+        counts = jnp.zeros(size, jnp.int32).at[k].add(
+            mask.astype(jnp.int32), mode="drop")
+        return slots, jnp.any(counts > 1)
+    return build
+
+
+@jax.jit
+def _key_stats(values, mask):
+    """(min, max, live count) of a key column over live rows."""
+    v = values.astype(jnp.int64)
+    vmin = jnp.min(jnp.where(mask, v, jnp.iinfo(jnp.int64).max))
+    vmax = jnp.max(jnp.where(mask, v, jnp.iinfo(jnp.int64).min))
+    return vmin, vmax, jnp.sum(mask)
+
+
+@jax.jit
+def _max_run(table: ops.BuildTable):
+    """Largest live-key duplicate run (the join's max fanout; padding runs
+    excluded)."""
+    n = table.run_len.shape[0]
+    pos = jnp.arange(n, dtype=jnp.int32)
+    return jnp.max(jnp.where(pos < table.valid_count, table.run_len, 0))
+
+
+def _drop_null_keys(batch: Batch, key_names: Tuple[str, ...]) -> Batch:
+    """Exclude build rows with NULL keys (SQL equi-join: NULL never
+    matches).  Runs eagerly — a handful of elementwise ops, once per build."""
+    m = batch.mask
+    for k in key_names:
+        c = batch.columns[k]
+        if c.nulls is not None:
+            m = m & ~c.nulls
+    return batch.with_mask(m)
+
+
+def probe_direct(batch: Batch, dt: DirectTable, key_name: str):
+    """(hit, build_row_index) for a direct-address lookup.  Misses return
+    index 0 (in-bounds garbage; callers mask/null those rows)."""
+    col = batch.columns[key_name]
+    v = col.values.astype(jnp.int64)
+    size = dt.slots.shape[0]
+    k = v - dt.base
+    inb = (k >= 0) & (k < size)
+    slot = dt.slots[jnp.clip(k, 0, size - 1).astype(jnp.int32)]
+    hit = inb & (slot >= 0)
+    if col.nulls is not None:
+        hit = hit & ~col.nulls
+    return hit, jnp.where(hit, slot, 0)
+
+
+def probe_unique(batch: Batch, table: ops.BuildTable,
+                 key_names: Tuple[str, ...]):
+    """(hit, build_row_index) against a hash-sorted unique-key build."""
+    cols = [batch.columns[k] for k in key_names]
+    kh = ops._orderable_hash(ops.hash_columns(cols))
+    nb = table.perm.shape[0]
+    lo = jnp.clip(jnp.searchsorted(table.keyhash_sorted, kh, side="left",
+                                   method="scan_unrolled")
+                  .astype(jnp.int32), 0, nb - 1)
+    hit = table.keyhash_sorted[lo] == kh
+    for c in cols:
+        if c.nulls is not None:
+            hit = hit & ~c.nulls
+    return hit, jnp.where(hit, table.perm[lo], 0)
+
+
+class FusedChain:
+    """A compile-time description of a fusible probe pipeline.
+
+    steps (leaf->root order):
+      ("filter", predicate)
+      ("project", [(variable, expr), ...])
+      ("rename", [(out_name, in_name), ...])
+      ("join", JoinNode)         aux entry: DirectTable | BuildTable
+      ("semi", SemiJoinNode)     aux entry: DirectTable | BuildTable
+
+    prep() (runtime) returns (aux, expands): per-join lookup tables plus
+    static per-join fanout factors.  A join whose build keys repeat up to
+    k times expands each probe row into k candidate slots IN-LOOP; the
+    chunk capacity is divided by the product of factors so the in-flight
+    batch footprint stays at the configured batch size.
+    """
+
+    def __init__(self, compiler, steps: List[tuple], scan_meta: dict):
+        self.compiler = compiler
+        self.steps = steps
+        self.scan_meta = scan_meta
+        self.cap = scan_meta["cap"]
+        self.chunks = self.chunks_for((1,) * sum(
+            1 for s in steps if s[0] in ("join", "semi")))
+        self.total_rows = sum(n for _, n in self.chunks)
+        self._leaf_make: Dict[int, Callable] = {}
+
+    def chunks_for(self, expands: Tuple[int, ...]) -> List[Tuple[int, int]]:
+        kprod = 1
+        for k in expands:
+            kprod *= k
+        cap = max(1 << 12, self.cap // kprod)
+        chunks = []
+        for split in self.scan_meta["splits"]:
+            p = split.start
+            while p < split.end:
+                chunks.append((p, min(cap, split.end - p)))
+                p += cap
+        return chunks
+
+    def leaf_cap(self, expands: Tuple[int, ...]) -> int:
+        kprod = 1
+        for k in expands:
+            kprod *= k
+        return max(1 << 12, self.cap // kprod)
+
+    # -- runtime: materialize build sides ---------------------------------
+    def prep(self) -> Optional[Tuple[tuple, Tuple[int, ...]]]:
+        """Materialize every build side and construct lookup tables.
+        Returns (aux, expands), or None when a join's fanout exceeds the
+        expansion limits (caller falls back to the streaming executor)."""
+        aux: List = []
+        expands: List[int] = []
+        for step in self.steps:
+            kind = step[0]
+            if kind == "join":
+                node = step[1]
+                res = self._build_for(
+                    node.right, tuple(r.name for _l, r in node.criteria),
+                    for_join=True)
+                if res is None:
+                    return None
+                tbl, k = res
+                aux.append(tbl)
+                expands.append(k)
+            elif kind == "semi":
+                node = step[1]
+                tbl, _k = self._build_for(
+                    node.filtering_source,
+                    (node.filtering_source_join_variable.name,),
+                    for_join=False)
+                aux.append(tbl)
+                expands.append(1)
+        kprod = 1
+        for k in expands:
+            kprod *= k
+        if kprod > MAX_EXPAND_PRODUCT:
+            return None
+        return tuple(aux), tuple(expands)
+
+    def _build_for(self, build_node: P.PlanNode, keys: Tuple[str, ...],
+                   for_join: bool):
+        """Returns (table, fanout) — fanout is the pow2-rounded max key
+        multiplicity (1 = unique keys) — or None when fanout > MAX_EXPAND."""
+        comp = self.compiler
+        batch = comp._materialize_node(build_node)
+        if batch is None:
+            batch = _empty_build_batch(build_node)
+        batch = _drop_null_keys(batch, keys)
+        # dense single integer key -> direct-address table (unique keys only)
+        if len(keys) == 1:
+            col = batch.columns[keys[0]]
+            if col.values.dtype in (jnp.int64, jnp.int32, jnp.int16):
+                vmin, vmax, live = jax.device_get(
+                    _key_stats(col.values, batch.mask))
+                span = int(vmax) - int(vmin) + 1
+                if (int(live) > 0 and span <= DIRECT_TABLE_MAX
+                        and span <= max(1024, DIRECT_TABLE_SPAN_RATIO
+                                        * int(live))):
+                    size = 1 << (span - 1).bit_length()
+                    slots, dup = _direct_builder(size)(
+                        col.values, batch.mask, jnp.int64(int(vmin)))
+                    if not for_join or not bool(jax.device_get(dup)):
+                        return DirectTable(slots, jnp.int64(int(vmin)),
+                                           dict(batch.columns)), 1
+        from .pipeline import _jits
+        table = _jits()[1](batch, keys)
+        if not for_join:
+            return table, 1
+        kmax = int(jax.device_get(_max_run(table)))
+        if kmax <= 1:
+            return table, 1
+        if kmax > MAX_EXPAND:
+            return None
+        return table, 1 << (kmax - 1).bit_length()
+
+    # -- traced: one chunk through the whole chain ------------------------
+    def make(self, pos, valid, aux, expands: Tuple[int, ...],
+             leaf_cap: int) -> Batch:
+        meta = self.scan_meta
+        mk = self._leaf_make.get(leaf_cap)
+        if mk is None:
+            mk = meta["make"] if leaf_cap == self.cap \
+                else meta["make_factory"](leaf_cap)
+            self._leaf_make[leaf_cap] = mk
+        outs, live = mk(pos, valid)
+        dicts = meta["dicts"]
+        batch = Batch({n: Column(v, None, dicts.get(n))
+                       for n, v in outs.items()}, live)
+        low = self.compiler.lowering
+        ai = 0
+        for step in self.steps:
+            kind = step[0]
+            if kind == "filter":
+                batch = ops.apply_filter(batch, low.eval(step[1], batch))
+            elif kind == "project":
+                batch = Batch({v.name: low.eval(e, batch)
+                               for v, e in step[1]}, batch.mask)
+            elif kind == "rename":
+                batch = Batch({o: batch.columns[i] for o, i in step[1]},
+                              batch.mask)
+            elif kind == "join":
+                if expands[ai] == 1:
+                    batch = self._apply_join(batch, step[1], aux[ai], low)
+                else:
+                    batch = self._apply_join_expand(
+                        batch, step[1], aux[ai], expands[ai], low)
+                ai += 1
+            elif kind == "semi":
+                node = step[1]
+                key = node.source_join_variable.name
+                hit, _ = (probe_direct(batch, aux[ai], key)
+                          if isinstance(aux[ai], DirectTable)
+                          else probe_unique(batch, aux[ai], (key,)))
+                batch = batch.with_columns(
+                    {node.semi_join_output.name: Column(hit, None)})
+                ai += 1
+        return batch
+
+    def _apply_join(self, batch: Batch, node: P.JoinNode, tbl, low) -> Batch:
+        probe_keys = tuple(l.name for l, _r in node.criteria)
+        if isinstance(tbl, DirectTable):
+            hit, bidx = probe_direct(batch, tbl, probe_keys[0])
+        else:
+            hit, bidx = probe_unique(batch, tbl, probe_keys)
+        build_names = {v.name for v in node.right.output_variables}
+        out_names = [v.name for v in node.outputs]
+        cols = dict(batch.columns)
+        for n in out_names:
+            if n in build_names:
+                cols[n] = tbl.columns[n].gather(bidx)
+        pairs = Batch(cols, batch.mask)
+        matched = hit
+        if node.filter is not None:
+            pred = low.eval(node.filter, pairs)
+            keep = pred.values.astype(bool)
+            if pred.nulls is not None:
+                keep = keep & ~pred.nulls
+            matched = matched & keep
+        if node.join_type == P.INNER:
+            return Batch(cols, batch.mask & matched)
+        # LEFT: keep every probe row; null-extend build columns on misses
+        miss = ~matched
+        for n in out_names:
+            if n in build_names:
+                c = cols[n]
+                cols[n] = Column(c.values, c.null_mask() | miss,
+                                 c.dictionary, c.lazy)
+        return Batch(cols, batch.mask)
+
+    def _apply_join_expand(self, batch: Batch, node: P.JoinNode,
+                           tbl: ops.BuildTable, k: int, low) -> Batch:
+        """Fanout-k join: each probe row expands into k candidate build
+        slots (k = pow2-rounded max key run in the build).  Output capacity
+        = k * input capacity; flat index j*C + i is (probe row i, match j)."""
+        C = batch.capacity
+        probe_keys = tuple(l.name for l, _r in node.criteria)
+        pcols = [batch.columns[kk] for kk in probe_keys]
+        kh = ops._orderable_hash(ops.hash_columns(pcols))
+        nb = tbl.perm.shape[0]
+        lo = jnp.clip(jnp.searchsorted(tbl.keyhash_sorted, kh, side="left",
+                                       method="scan_unrolled")
+                      .astype(jnp.int32), 0, nb - 1)
+        hit = tbl.keyhash_sorted[lo] == kh
+        for c in pcols:
+            if c.nulls is not None:
+                hit = hit & ~c.nulls
+        cnt = jnp.where(hit & batch.mask, tbl.run_len[lo], 0)      # (C,)
+        j = jnp.arange(k, dtype=jnp.int32)[:, None]                # (k,1)
+        sub = j < cnt[None, :]                                     # (k,C)
+        bpos = jnp.clip(lo[None, :] + j, 0, nb - 1)
+        bidx = jnp.where(sub, tbl.perm[bpos], 0).reshape(k * C)
+
+        build_names = {v.name for v in node.right.output_variables}
+        out_names = [v.name for v in node.outputs]
+        cols: Dict[str, Column] = {}
+        for n, c in batch.columns.items():
+            cols[n] = Column(jnp.tile(c.values, k),
+                             None if c.nulls is None
+                             else jnp.tile(c.nulls, k),
+                             c.dictionary, c.lazy)
+        for n in out_names:
+            if n in build_names:
+                cols[n] = tbl.columns[n].gather(bidx)
+        pair_mask = (batch.mask[None, :] & sub).reshape(k * C)
+        matched = pair_mask
+        if node.filter is not None:
+            pred = low.eval(node.filter, Batch(cols, pair_mask))
+            keep = pred.values.astype(bool)
+            if pred.nulls is not None:
+                keep = keep & ~pred.nulls
+            matched = matched & keep
+        if node.join_type == P.INNER:
+            return Batch(cols, matched)
+        # LEFT: a probe row none of whose candidates survived emits one
+        # null-extended row in its j==0 slot
+        any_match = jnp.any(matched.reshape(k, C), axis=0)         # (C,)
+        fill = jnp.where(jnp.arange(k, dtype=jnp.int32)[:, None] == 0,
+                         (batch.mask & ~any_match)[None, :],
+                         False).reshape(k * C)
+        for n in out_names:
+            if n in build_names:
+                c = cols[n]
+                cols[n] = Column(c.values, c.null_mask() | fill,
+                                 c.dictionary, c.lazy)
+        return Batch(cols, matched | fill)
+
+
+def assemble_chain(compiler, node: P.PlanNode) -> Optional[FusedChain]:
+    """Walk a Filter/Project/Join/SemiJoin chain down to a device-generated
+    TableScan.  Returns None when the plan shape is not fusible (the caller
+    keeps the streaming path)."""
+    steps: List[tuple] = []
+    nd = node
+    while True:
+        if isinstance(nd, P.FilterNode):
+            steps.append(("filter", nd.predicate))
+            nd = nd.source
+        elif isinstance(nd, P.ProjectNode):
+            steps.append(("project", list(nd.assignments.items())))
+            nd = nd.source
+        elif isinstance(nd, P.ExchangeNode) and not nd.inputs \
+                and len(nd.exchange_sources) == 1:
+            src = nd.exchange_sources[0]
+            outer = [v.name for v in nd.partitioning_scheme.output_layout]
+            inner = [v.name for v in src.output_variables]
+            if outer != inner:
+                steps.append(("rename", list(zip(outer, inner))))
+            nd = src
+        elif isinstance(nd, P.JoinNode) \
+                and nd.join_type in (P.INNER, P.LEFT) and nd.criteria:
+            steps.append(("join", nd))
+            nd = nd.left
+        elif isinstance(nd, P.SemiJoinNode):
+            steps.append(("semi", nd))
+            nd = nd.source
+        elif isinstance(nd, P.TableScanNode):
+            meta = getattr(compiler._compile(nd), "fused_scan", None)
+            if meta is None:
+                return None
+            steps.reverse()
+            return FusedChain(compiler, steps, meta)
+        else:
+            return None
+
+
+def fused_materialize(compiler, node: P.PlanNode) -> Optional[Batch]:
+    """Materialize a fusible chain's full output as ONE device batch via a
+    single lax.map program over scan chunks — the zero-host-sync analog of
+    draining a streaming subtree batch by batch.  Used for join build
+    sides and sort/window inputs.  Returns None when the subtree is not a
+    fusible chain (caller streams instead)."""
+    if compiler.ctx.memory.budget is not None:
+        return None     # budgeted runs keep the accounted streaming path
+    chain = assemble_chain(compiler, node)
+    if chain is None or not chain.chunks:
+        return None
+    try:
+        prep_res = chain.prep()
+    except NotImplementedError:
+        return None
+    if prep_res is None:
+        return None
+    aux, expands = prep_res
+    leaf_cap = chain.leaf_cap(expands)
+    chunks = chain.chunks_for(expands)
+    S = len(chunks)
+    try:
+        jax.eval_shape(lambda p, v: chain.make(p, v, aux, expands, leaf_cap),
+                       jnp.int64(0), jnp.int64(1))
+    except NotImplementedError:
+        return None
+    pos_arr = jnp.asarray([c[0] for c in chunks], dtype=jnp.int64)
+    cnt_arr = jnp.asarray([c[1] for c in chunks], dtype=jnp.int64)
+    key = ("fmat", node.id, expands)
+    run_all = compiler._jit_cache.get(key)
+    if run_all is None:
+        @jax.jit
+        def run_all(pos_arr, cnt_arr, aux):
+            def step(pc):
+                return chain.make(pc[0], pc[1], aux, expands, leaf_cap)
+            stacked = jax.lax.map(step, (pos_arr, cnt_arr))
+            return jax.tree_util.tree_map(
+                lambda a: a.reshape((-1,) + a.shape[2:]), stacked)
+        compiler._jit_cache[key] = run_all
+    from .pipeline import _maybe_compact
+    return _maybe_compact(run_all(pos_arr, cnt_arr, aux))
+
+
+def _empty_build_batch(build_node: P.PlanNode) -> Batch:
+    """8-row all-masked batch with the build schema (empty build side)."""
+    from ..common.types import VarcharType, CharType
+    from .lowering import _jnp_dtype
+    cols = {}
+    for v in build_node.output_variables:
+        if isinstance(v.type, (VarcharType, CharType)):
+            cols[v.name] = Column(jnp.zeros(8, dtype=jnp.int32), None, ("",))
+        else:
+            cols[v.name] = Column(jnp.zeros(8, dtype=_jnp_dtype(v.type)))
+    return Batch(cols, jnp.zeros(8, dtype=bool))

@@ -361,6 +361,14 @@ class Lowering:
         for c in cols:
             if c.nulls is not None:
                 nulls = _or_null(nulls, c.nulls)
+        if any(not c.dictionary for c in cols):
+            # an empty dictionary (empty table / all-null column, e.g.
+            # after an empty CTAS) has no representable value: emit an
+            # all-null empty-dictionary result instead of indexing [0]
+            ref = cols[0]
+            return Column(jnp.zeros_like(ref.values),
+                          jnp.ones(ref.values.shape, dtype=bool),
+                          ("",))
         if len(dict_cols) <= 1:
             base = dict_cols[0] if dict_cols else cols[0]
             mapped = ["".join(c.dictionary[0] if c is not base else s

@@ -284,6 +284,8 @@ def agg_merge(a: dict, b: dict, specs: Tuple[AggSpec, ...],
 # ---------------------------------------------------------------------------
 
 DIRECT_AGG_MAX_GROUPS = 64
+# max accumulator length for span-direct (scatter-indexed) aggregation
+SPAN_AGG_MAX_GROUPS = 1 << 26
 
 
 def agg_direct_init(G: int, specs: Tuple[AggSpec, ...]) -> dict:
@@ -409,6 +411,82 @@ def _agg_direct_update_pallas(state: dict, batch: Batch, codes,
         if spec.name == "count_star":
             out[spec.output] = state[spec.output] + gcount
     return out
+
+
+def agg_span_init(G: int, specs: Tuple[AggSpec, ...]) -> dict:
+    """State for span-direct aggregation: integer group codes in [0, G)
+    index the accumulators directly (code = combined key - base) — no
+    hashing, no probing, no collision retries.  The TPU-native replacement
+    for the scatter hash table whenever the key span is bounded (dense PK
+    group-bys like TPC-H Q3/Q18's l_orderkey).  Group keys are not stored:
+    the caller reconstructs them from the slot index (see
+    agg_span_finalize)."""
+    state = agg_direct_init(G, specs)
+    return state
+
+
+def agg_span_update(state: dict, batch: Batch, codes,
+                    agg_inputs: Dict[str, Optional[Column]],
+                    specs: Tuple[AggSpec, ...], G: int) -> dict:
+    """codes: per-row group index (int, in [0, G) for live rows); masked
+    rows are routed out of range and dropped."""
+    mask = batch.mask
+    slot = jnp.where(mask, codes, G).astype(jnp.int32)
+    out = dict(state)
+    out["__seen"] = state["__seen"].at[slot].add(
+        mask.astype(jnp.int64), mode="drop")
+    for spec in specs:
+        if spec.name == "count_star":
+            out[spec.output] = state[spec.output].at[slot].add(
+                mask.astype(jnp.int64), mode="drop")
+            continue
+        col = agg_inputs[spec.output]
+        valid = mask & ~col.null_mask()
+        vslot = jnp.where(valid, slot, G).astype(jnp.int32)
+        if spec.name == "count":
+            out[spec.output] = state[spec.output].at[vslot].add(
+                jnp.ones_like(vslot, dtype=jnp.int64), mode="drop")
+            continue
+        v = col.values
+        if spec.is_float and v.dtype != jnp.float64:
+            v = v.astype(jnp.float64)
+        if not spec.is_float and v.dtype != jnp.int64:
+            v = v.astype(jnp.int64)
+        if spec.name in ("sum", "avg"):
+            key = spec.output if spec.name == "sum" else spec.output + "$sum"
+            out[key] = state[key].at[vslot].add(v, mode="drop")
+            ckey = spec.output + "$count"
+            out[ckey] = state[ckey].at[vslot].add(
+                jnp.ones_like(vslot, dtype=jnp.int64), mode="drop")
+        elif spec.name == "min":
+            out[spec.output] = state[spec.output].at[vslot].min(
+                v, mode="drop")
+            out[spec.output + "$count"] = \
+                state[spec.output + "$count"].at[vslot].add(
+                    jnp.ones_like(vslot, dtype=jnp.int64), mode="drop")
+        elif spec.name == "max":
+            out[spec.output] = state[spec.output].at[vslot].max(
+                v, mode="drop")
+            out[spec.output + "$count"] = \
+                state[spec.output + "$count"].at[vslot].add(
+                    jnp.ones_like(vslot, dtype=jnp.int64), mode="drop")
+    return out
+
+
+def agg_span_finalize(state: dict, specs: Tuple[AggSpec, ...],
+                      key_names: Tuple[str, ...],
+                      key_arrays: Dict[str, jnp.ndarray],
+                      key_dicts: Dict[str, Tuple[str, ...]],
+                      key_lazy: Optional[Dict[str, Tuple]] = None) -> Batch:
+    """key_arrays: slot-index -> key value per key (reconstructed by the
+    caller, e.g. base + arange(G) for a single-int-key span)."""
+    fake = dict(state)
+    fake["__occupied"] = state["__seen"] > 0
+    G = state["__seen"].shape[0]
+    for k in key_names:
+        fake[f"__key_{k}"] = key_arrays[k]
+        fake[f"__keynull_{k}"] = jnp.zeros(G, dtype=bool)
+    return agg_finalize(fake, specs, key_names, key_dicts, key_lazy)
 
 
 def agg_direct_finalize(state: dict, specs: Tuple[AggSpec, ...],
@@ -570,6 +648,11 @@ def probe_join(batch: Batch, table: BuildTable, probe_keys: List[str],
                           method="scan_unrolled").astype(jnp.int32)
     lo_c = jnp.clip(lo, 0, nb - 1)
     hit = table.keyhash_sorted[lo_c] == kh
+    # SQL equi-join: a NULL key never matches (exec/reference.py:452-457)
+    for k in probe_keys:
+        nn = batch.columns[k].nulls
+        if nn is not None:
+            hit = hit & ~nn
     counts = jnp.where(batch.mask & hit, table.run_len[lo_c], 0)
     offsets = jnp.cumsum(counts.astype(jnp.int64))
     total = offsets[-1]
@@ -639,13 +722,20 @@ def probe_join(batch: Batch, table: BuildTable, probe_keys: List[str],
 
 def semi_join_mark(batch: Batch, table: BuildTable, probe_keys: List[str],
                    salt: int = 0) -> Column:
-    """True per row iff the key exists in the build table (SemiJoin marker)."""
+    """True per row iff the key exists in the build table (SemiJoin
+    marker).  NULL probe keys never match (callers exclude NULL build keys
+    before building), consistent with the join paths and the oracle."""
     kh = _orderable_hash(hash_columns(
         [batch.columns[k] for k in probe_keys], salt))
     lo = jnp.clip(jnp.searchsorted(table.keyhash_sorted, kh, side="left",
                                    method="scan_unrolled")
                   .astype(jnp.int32), 0, table.perm.shape[0] - 1)
-    return Column(table.keyhash_sorted[lo] == kh, None)
+    hit = table.keyhash_sorted[lo] == kh
+    for k in probe_keys:
+        nn = batch.columns[k].nulls
+        if nn is not None:
+            hit = hit & ~nn
+    return Column(hit, None)
 
 
 # ---------------------------------------------------------------------------

@@ -73,9 +73,7 @@ def _compact_concat(batches: List[Batch]) -> Batch:
     for b, n in zip(batches, counts):
         if n == 0:
             continue
-        # coarse bucket set bounds the number of compiled shape variants
-        bucket = next((s for s in (1 << 12, 1 << 16, 1 << 18, 1 << 20)
-                       if s >= n), 1 << (int(n) - 1).bit_length())
+        bucket = _bucket_for(n) or 1 << (int(n) - 1).bit_length()
         out.append(b if bucket >= b.capacity
                    else _jit_compact(b, bucket))
     if not out:
@@ -83,6 +81,30 @@ def _compact_concat(batches: List[Batch]) -> Batch:
     if len(out) == 1:
         return out[0]
     return _jit_concat(out)
+# coarse bucket set bounds the number of compiled shape variants for
+# compacted batches (shared by every compaction site)
+_COMPACT_BUCKETS = (1 << 12, 1 << 16, 1 << 18, 1 << 20)
+
+
+def _bucket_for(live: int):
+    """Smallest standard bucket holding `live` rows (None above the
+    largest bucket)."""
+    return next((s for s in _COMPACT_BUCKETS if s >= live), None)
+
+
+def _maybe_compact(batch: Batch) -> Batch:
+    """Compact a single mostly-dead batch (e.g. a sparse aggregation table)
+    to a bucketed capacity so downstream sorts/joins/probes don't pay
+    full-capacity costs.  One host sync for the live count."""
+    live = int(jax.device_get(batch.mask.sum()))
+    if live * 4 >= batch.capacity:
+        return batch
+    bucket = _bucket_for(live)
+    if bucket is None or bucket >= batch.capacity:
+        return batch
+    return _jit_compact(batch, bucket)
+
+
 _jit_sort = None
 _jit_build = None
 _jit_window = None
@@ -167,12 +189,17 @@ class PlanCompiler:
         self._sources: Dict[str, BatchSource] = {}
         self.lowering = Lowering()
         self._jit_cache: Dict = {}
+        # batch buffers of shared (multi-consumer) sources; cleared per
+        # execution (see _share)
+        self._shared_states: List[dict] = []
 
     # -- public -----------------------------------------------------------
     def compile(self, root: P.PlanNode) -> BatchSource:
         return self._compile(root)
 
     def run_to_pages(self, root: P.PlanNode) -> Iterator[Page]:
+        for st in self._shared_states:
+            st.update(buf=[], it=None, done=False)
         src = self.compile(root)
         for batch in src.batches():
             page = batch_to_page(batch, src.names, src.types)
@@ -186,6 +213,11 @@ class PlanCompiler:
         # so its cached jitted steps stay warm
         cached = self._sources.get(node.id)
         if cached is not None:
+            # a second consumer of the same subtree: tee its batches so the
+            # subtree executes ONCE per query (decorrelated plans replay
+            # whole join chains several times — TPC-H Q2/Q21 shape; the
+            # reference gets this for free from its CTE materialization)
+            self._share(cached)
             return cached
         m = getattr(self, "_compile_" + type(node).__name__, None)
         if m is None:
@@ -195,6 +227,38 @@ class PlanCompiler:
             src = self._instrument(node, src)
         self._sources[node.id] = src
         return src
+
+    def _share(self, src: BatchSource) -> None:
+        """Convert a BatchSource into a teeing source: the first consumer's
+        batches are buffered (device-resident) and replayed to later — or
+        interleaved — consumers, so multi-consumer subtrees execute once."""
+        if getattr(src, "_shared", False):
+            return
+        src._shared = True
+        inner_fn = src._fn
+        state = {"buf": [], "it": None, "done": False}
+        self._shared_states.append(state)
+
+        def shared_fn():
+            i = 0
+            while True:
+                if i < len(state["buf"]):
+                    yield state["buf"][i]
+                    i += 1
+                    continue
+                if state["done"]:
+                    return
+                if state["it"] is None:
+                    state["it"] = iter(inner_fn())
+                try:
+                    b = next(state["it"])
+                except StopIteration:
+                    state["done"] = True
+                    continue
+                state["buf"].append(b)
+                yield b
+                i += 1
+        src._fn = shared_fn
 
     def _instrument(self, node: P.PlanNode, src: BatchSource) -> BatchSource:
         """EXPLAIN ANALYZE wrapper: cumulative wall time (includes
@@ -255,23 +319,29 @@ class PlanCompiler:
                          == "INT_ARRAY")
                for _n, colname, kind in dev if kind == "gen"}
 
-        def make(pos, valid):
-            idx0 = jnp.arange(cap, dtype=jnp.int64)
-            live = idx0 < valid
-            idx = pos + idx0
-            outs = {}
-            for name, colname, kind in dev:
-                if kind == "lazy":
-                    # padding must hold a valid row id (materializers run
-                    # over the full capacity)
-                    outs[name] = jnp.where(live, idx, 0)
-                    continue
-                v = device_gen.column(cid, table, colname, sf, idx)
-                if v.dtype == jnp.int64 and i32[colname]:
-                    v = v.astype(jnp.int32)
-                outs[name] = jnp.where(live, v, jnp.zeros((), v.dtype))
-            return outs, live
+        def make_factory(cap2):
+            """Pure scan kernel at an arbitrary chunk capacity (fused join
+            chains shrink the chunk so in-loop fanout expansion stays within
+            the configured batch footprint)."""
+            def make(pos, valid):
+                idx0 = jnp.arange(cap2, dtype=jnp.int64)
+                live = idx0 < valid
+                idx = pos + idx0
+                outs = {}
+                for name, colname, kind in dev:
+                    if kind == "lazy":
+                        # padding must hold a valid row id (materializers
+                        # run over the full capacity)
+                        outs[name] = jnp.where(live, idx, 0)
+                        continue
+                    v = device_gen.column(cid, table, colname, sf, idx)
+                    if v.dtype == jnp.int64 and i32[colname]:
+                        v = v.astype(jnp.int32)
+                    outs[name] = jnp.where(live, v, jnp.zeros((), v.dtype))
+                return outs, live
+            return make
 
+        make = make_factory(cap)
         dev_make = jax.jit(make)
 
         def gen():
@@ -343,7 +413,8 @@ class PlanCompiler:
             # program with a fori_loop over split chunks, eliminating the
             # per-batch dispatch round-trips that dominate wall-clock
             src.fused_scan = {
-                "make": make, "splits": splits, "cap": cap,
+                "make": make, "make_factory": make_factory,
+                "splits": splits, "cap": cap,
                 "dicts": {name: device_gen.dictionary(cid, table, colname)
                           for name, colname, _k in dev},
             }
@@ -560,17 +631,15 @@ class PlanCompiler:
         return BatchSource(gen, src.names, src.types)
 
     def _compile_SortNode(self, node: P.SortNode) -> BatchSource:
-        src = self._compile(node.source)
+        names, types = output_schema(node.source)
         keys = [(v.name, order) for v, order in node.ordering_scheme.orderings]
 
         def gen():
-            all_batches = list(src.batches())
-            if not all_batches:
+            merged = self._materialize_node(node.source)
+            if merged is None:
                 return
-            merged = _compact_concat(all_batches) \
-                if len(all_batches) > 1 else all_batches[0]
             yield _jits()[0](merged, tuple(keys))
-        return BatchSource(gen, src.names, src.types)
+        return BatchSource(gen, names, types)
 
     def _compile_UnionNode(self, node: P.UnionNode) -> BatchSource:
         """UNION ALL: concatenate the source streams.  Numeric/date columns
@@ -638,7 +707,7 @@ class PlanCompiler:
         """Materialize + one jitted segmented-scan pass (operators.window_batch);
         the reference streams partition-at-a-time (WindowOperator.java:69) but
         a single static-shape sort+scan is the XLA-friendly formulation."""
-        src = self._compile(node.source)
+        src_names, src_types = output_schema(node.source)
         part_names = tuple(v.name for v in node.partition_by)
         orderings = tuple((v.name, o) for v, o in
                           node.ordering_scheme.orderings) \
@@ -654,15 +723,13 @@ class PlanCompiler:
             is_float = isinstance(v.type, (DoubleType, RealType))
             specs.append(ops.WindowSpec(fname, v.name, arg, is_float))
         specs = tuple(specs)
-        out_names = src.names + [v.name for v in node.window_functions]
-        out_types = src.types + [v.type for v in node.window_functions]
+        out_names = src_names + [v.name for v in node.window_functions]
+        out_types = src_types + [v.type for v in node.window_functions]
 
         def gen():
-            batches = list(src.batches())
-            if not batches:
+            merged = self._materialize_node(node.source)
+            if merged is None:
                 return
-            merged = _compact_concat(batches) \
-                if len(batches) > 1 else batches[0]
             # late-materialized string keys: window_batch both SORTS by and
             # compares (partition identity / peer detection) every key, so a
             # lazy column's row ids must match the value order AND be
@@ -811,110 +878,241 @@ class PlanCompiler:
         fused_cache: dict = {}
 
         def get_fused():
-            """Whole-pipeline fusion: when the source is a (Filter|Project)*
-            chain over a device-generated TableScan and the aggregation
-            qualifies for direct (small-domain) mode, compile scan → chain →
-            agg-update into ONE jitted program with a fori_loop over split
-            chunks.  One dispatch per task instead of O(batches × operators)
-            — on TPU the per-dispatch round-trip dominates wall-clock for
-            these pipelines (TPC-H Q1/Q6 shape).  Returns None when the plan
-            shape doesn't qualify; decision + compiled program are cached."""
-            if "v" in fused_cache:
-                return fused_cache["v"]
-            fused_cache["v"] = None
+            """Whole-pipeline fusion: when the source is a
+            (Filter|Project|Join|SemiJoin)* chain over a device-generated
+            TableScan (exec/fused.py), compile scan → chain → agg-update
+            into ONE jitted program with a fori_loop over split chunks.
+            One dispatch per task instead of O(batches × operators) — on
+            TPU the per-dispatch round-trip dominates wall-clock for these
+            pipelines (all of TPC-H's heavy shapes).  Returns the compiled
+            FusedChain or None; decision is cached."""
+            if "chain" in fused_cache:
+                return fused_cache["chain"]
+            fused_cache["chain"] = None
             if not cfg.fuse_pipelines or self.ctx.stats is not None:
                 return None   # EXPLAIN ANALYZE wants per-operator stats
             if any(a.distinct or a.mask for a in node.aggregations.values()):
                 return None
-            chain = []
-            nd = src_node
-            while isinstance(nd, (P.FilterNode, P.ProjectNode)):
-                chain.append(nd)
-                nd = nd.source
-            if not isinstance(nd, P.TableScanNode):
-                return None
-            meta = getattr(self._compile(nd), "fused_scan", None)
-            if meta is None:
-                return None
-            make, cap, dicts = meta["make"], meta["cap"], meta["dicts"]
-            chunks = []
-            for split in meta["splits"]:
-                p = split.start
-                while p < split.end:
-                    chunks.append((p, min(cap, split.end - p)))
-                    p += cap
-            if not chunks:
-                return None
-            steps = []
-            for cn in reversed(chain):
-                if isinstance(cn, P.FilterNode):
-                    steps.append(("filter", cn.predicate))
-                else:
-                    steps.append(("project", list(cn.assignments.items())))
+            from .fused import assemble_chain
+            chain = assemble_chain(self, src_node)
+            if chain is not None and not chain.chunks:
+                chain = None
+            fused_cache["chain"] = chain
+            return chain
 
-            def make_batch(pos, valid):
-                outs, live = make(pos, valid)
-                cols = {n2: Column(v, None, dicts.get(n2))
-                        for n2, v in outs.items()}
-                return Batch(cols, live)
+        def _agg_exprs(b):
+            return {out: (low.eval(expr, b) if expr is not None else None)
+                    for out, expr in input_exprs.items()}
 
-            def apply_chain(batch):
-                for kind, payload in steps:
-                    if kind == "filter":
-                        batch = ops.apply_filter(
-                            batch, low.eval(payload, batch))
-                    else:
-                        batch = Batch({v.name: low.eval(e, batch)
-                                       for v, e in payload}, batch.mask)
-                return batch
-
-            # shape-only probe: dictionaries / null-ness / dtypes of the key
-            # columns without executing anything (Column aux survives
-            # eval_shape, so closed-domain detection works symbolically)
+        def run_fused(chain):
+            """Execute a fused chain to a finalized output Batch, or None
+            to fall back to the streaming executor.  Four modes by group-key
+            shape: one-hot grid (G<=64, MXU-friendly), static span (closed
+            dictionary domains), runtime span (single integer key — probe
+            min/max, then collision-free scatter-direct), hash table."""
+            pool = self.ctx.memory
+            if pool.budget is not None:
+                # budgeted execution keeps the streaming path: its build
+                # reservation / grace-spill machinery owns memory discipline
+                return None
+            try:
+                prep_res = chain.prep()
+            except (NotImplementedError, MemoryExceededError):
+                return None
+            if prep_res is None:
+                return None
+            aux, expands = prep_res
+            leaf_cap = chain.leaf_cap(expands)
+            chunks = chain.chunks_for(expands)
             try:
                 probe = jax.eval_shape(
-                    lambda p, v: apply_chain(make_batch(p, v)),
+                    lambda p, v: chain.make(p, v, aux, expands, leaf_cap),
                     jnp.int64(0), jnp.int64(1))
             except NotImplementedError:
                 return None
             key_cols = [probe.columns.get(k) for k in key_names]
             if any(c is None for c in key_cols):
                 return None
-            info = _direct_mode_info(key_names, key_cols)
-            if info is None:
-                return None
-            doms, G, strides, key_dtypes, key_dicts = info
+            key_lazy: Dict[str, Tuple] = {}
+            for k, c in zip(key_names, key_cols):
+                if c.lazy is not None:
+                    _, tbl, coln, _sf = c.lazy
+                    if (tbl, coln) not in catalog.ROWID_DISTINCT:
+                        return None    # needs host dictionary encoding
+                    key_lazy[k] = c.lazy
+            key_dicts = {k: c.dictionary
+                         for k, c in zip(key_names, key_cols)
+                         if c.dictionary is not None}
+            key_dtypes = tuple(c.values.dtype for c in key_cols)
+            pos_arr = jnp.asarray([c0 for c0, _ in chunks],
+                                  dtype=jnp.int64)
+            cnt_arr = jnp.asarray([c1 for _, c1 in chunks],
+                                  dtype=jnp.int64)
             S = len(chunks)
-            pos_arr = jnp.asarray([c0 for c0, _ in chunks], dtype=jnp.int64)
-            cnt_arr = jnp.asarray([c1 for _, c1 in chunks], dtype=jnp.int64)
             use_pallas = cfg.pallas_agg
 
-            @jax.jit
-            def run_all(pos_arr, cnt_arr, state):
-                def body(i, st):
-                    b = apply_chain(make_batch(pos_arr[i], cnt_arr[i]))
-                    codes = None
-                    for k, stride in zip(key_names, strides):
-                        c = b.columns[k].values.astype(jnp.int64)
-                        codes = (c * stride if codes is None
-                                 else codes + c * stride)
-                    if codes is None:
-                        codes = jnp.zeros(b.capacity, dtype=jnp.int64)
-                    agg_cols = {out: (low.eval(expr, b)
-                                      if expr is not None else None)
-                                for out, expr in input_exprs.items()}
-                    return ops.agg_direct_update(st, b, codes, agg_cols,
-                                                 specs, G,
-                                                 use_pallas=use_pallas)
-                return jax.lax.fori_loop(0, S, body, state)
+            def loop(key, update, init_state):
+                """fori_loop over scan chunks; the jitted program is cached
+                under `key` so re-executions of the plan skip retracing."""
+                key = key + (expands,)
+                run_all = fused_cache.get(key)
+                if run_all is None:
+                    @jax.jit
+                    def run_all(pos_arr, cnt_arr, state, aux):
+                        def body(i, st):
+                            b = chain.make(pos_arr[i], cnt_arr[i], aux,
+                                           expands, leaf_cap)
+                            return update(st, b)
+                        return jax.lax.fori_loop(0, S, body, state)
+                    fused_cache[key] = run_all
+                return run_all(pos_arr, cnt_arr, init_state, aux)
 
-            def run():
-                state = ops.agg_direct_init(G, specs)
-                return run_all(pos_arr, cnt_arr, state)
+            def stride_codes(b, strides, G):
+                codes = None
+                for k, stride in zip(key_names, strides):
+                    c = b.columns[k].values.astype(jnp.int64)
+                    codes = (c * stride if codes is None
+                             else codes + c * stride)
+                if codes is None:
+                    codes = jnp.zeros(b.capacity, dtype=jnp.int64)
+                return codes
 
-            fused_cache["v"] = {"run": run, "doms": doms,
-                                "dtypes": key_dtypes, "dicts": key_dicts}
-            return fused_cache["v"]
+            info = _direct_mode_info(key_names, key_cols)
+            if info is not None:
+                doms, G, strides, kdts, kdicts = info
+
+                def update(st, b):
+                    return ops.agg_direct_update(
+                        st, b, stride_codes(b, strides, G),
+                        _agg_exprs(b), specs, G, use_pallas=use_pallas)
+                state = loop(("direct",), update,
+                             ops.agg_direct_init(G, specs))
+                return ops.agg_direct_finalize(
+                    state, specs, key_names, doms, kdts, kdicts,
+                    force_row=not key_names)
+
+            # static span: closed dictionary/bool domains beyond the grid
+            # limit — combined stride code indexes accumulators directly
+            info = _direct_mode_info(key_names, key_cols,
+                                     gmax=ops.SPAN_AGG_MAX_GROUPS)
+            if info is not None:
+                doms, G, strides, kdts, kdicts = info
+                if not pool.try_reserve(G * 24 * max(1, len(specs))):
+                    return None
+                try:
+                    def update(st, b):
+                        return ops.agg_span_update(
+                            st, b, stride_codes(b, strides, G),
+                            _agg_exprs(b), specs, G)
+                    state = loop(("static_span",), update,
+                                 ops.agg_span_init(G, specs))
+                    slot = jnp.arange(G, dtype=jnp.int64)
+                    key_arrays = {}
+                    stride = G
+                    for k, dom, dt in zip(key_names, doms, kdts):
+                        stride //= dom
+                        key_arrays[k] = ((slot // stride) % dom).astype(dt)
+                    return _maybe_compact(ops.agg_span_finalize(
+                        state, specs, key_names, key_arrays, kdicts,
+                        key_lazy))
+                finally:
+                    pool.free(G * 24 * max(1, len(specs)))
+
+            # runtime span: single integer key — one cheap min/max pass
+            # over the chain, then collision-free scatter-direct updates
+            if (len(key_names) == 1 and key_cols[0].nulls is None
+                    and key_cols[0].values.dtype in (jnp.int64, jnp.int32,
+                                                     jnp.int16)):
+                kname = key_names[0]
+                spanp = fused_cache.get(("span_probe", expands))
+                if spanp is None:
+                    @jax.jit
+                    def spanp(pos_arr, cnt_arr, aux):
+                        def body(i, mm):
+                            b = chain.make(pos_arr[i], cnt_arr[i], aux,
+                                           expands, leaf_cap)
+                            v = b.columns[kname].values.astype(jnp.int64)
+                            lo = jnp.minimum(mm[0], jnp.min(jnp.where(
+                                b.mask, v, ops.INT64_MAX)))
+                            hi = jnp.maximum(mm[1], jnp.max(jnp.where(
+                                b.mask, v, ops.INT64_MIN)))
+                            return (lo, hi)
+                        return jax.lax.fori_loop(
+                            0, S, body,
+                            (jnp.int64(ops.INT64_MAX),
+                             jnp.int64(ops.INT64_MIN)))
+                    fused_cache[("span_probe", expands)] = spanp
+                lo, hi = jax.device_get(spanp(pos_arr, cnt_arr, aux))
+                lo, hi = int(lo), int(hi)
+                span = hi - lo + 1
+                if hi >= lo and span <= ops.SPAN_AGG_MAX_GROUPS:
+                    G = 1 << (span - 1).bit_length()
+                    if not pool.try_reserve(G * 24 * max(1, len(specs))):
+                        return None
+                    try:
+                        base = jnp.int64(lo)
+
+                        run = fused_cache.get(("span", G, expands))
+                        if run is None:
+                            @jax.jit
+                            def run(pos_arr, cnt_arr, state, aux, base):
+                                def body(i, st):
+                                    b = chain.make(pos_arr[i], cnt_arr[i],
+                                                   aux, expands, leaf_cap)
+                                    codes = b.columns[kname].values \
+                                        .astype(jnp.int64) - base
+                                    return ops.agg_span_update(
+                                        st, b, codes, _agg_exprs(b),
+                                        specs, G)
+                                return jax.lax.fori_loop(0, S, body, state)
+                            fused_cache[("span", G, expands)] = run
+                        state = run(pos_arr, cnt_arr,
+                                    ops.agg_span_init(G, specs),
+                                    aux, base)
+                        key_arrays = {kname: (
+                            base + jnp.arange(G, dtype=jnp.int64))
+                            .astype(key_dtypes[0])}
+                        return _maybe_compact(ops.agg_span_finalize(
+                            state, specs, key_names, key_arrays,
+                            key_dicts, key_lazy))
+                    finally:
+                        pool.free(G * 24 * max(1, len(specs)))
+
+            # hash table, sized from the scan row count so the common case
+            # completes without a collision-doubling recompile
+            total = chain.total_rows
+            # initial size from the pre-filter scan rows, capped so a
+            # selective query doesn't over-allocate; collision retries
+            # double from there when the group count really is huge
+            num_slots = max(cfg.agg_slots,
+                            1 << (min(2 * total, 1 << 22) - 1).bit_length())
+            salt = 0
+            for _attempt in range(cfg.max_agg_retries):
+                est = num_slots * (16 + 12 * len(key_names)
+                                   + 24 * max(1, len(specs)))
+                if not pool.try_reserve(est):
+                    return None
+                try:
+                    def update(st, b, _n=num_slots, _s=salt):
+                        kc = [b.columns[k] for k in key_names]
+                        return ops.agg_update(st, b, kc, _agg_exprs(b),
+                                              specs, _n, _s, key_names)
+                    state = loop(("hash", num_slots, salt), update,
+                                 ops.agg_init(num_slots, specs, key_names,
+                                              key_dtypes))
+                    if not bool(jax.device_get(state["__collision"])):
+                        if not key_names \
+                                and not bool(jnp.any(state["__occupied"])):
+                            state["__occupied"] = \
+                                state["__occupied"].at[0].set(True)
+                        return _maybe_compact(ops.agg_finalize(
+                            state, specs, key_names, key_dicts, key_lazy))
+                finally:
+                    pool.free(est)
+                num_slots *= 2
+                salt += 1
+            raise RuntimeError("fused aggregation collision retries "
+                               "exhausted")
 
         def run_retrying(batches_fn=None, start_slots=None):
             num_slots, salt = start_slots or cfg.agg_slots, 0
@@ -935,15 +1133,14 @@ class PlanCompiler:
 
         def gen():
             pool = self.ctx.memory
+            fused = get_fused()
+            if fused is not None:
+                out = run_fused(fused)
+                if out is not None:
+                    yield out
+                    return
             if not key_names or pool.try_reserve(est_state_bytes):
                 try:
-                    fused = get_fused()
-                    if fused is not None:
-                        yield ops.agg_direct_finalize(
-                            fused["run"](), specs, key_names, fused["doms"],
-                            fused["dtypes"], fused["dicts"],
-                            force_row=not key_names)
-                        return
                     state, key_dicts, key_lazy, direct = run_retrying()
                     if direct is not None:
                         yield ops.agg_direct_finalize(
@@ -1014,6 +1211,16 @@ class PlanCompiler:
             return batches[0]
         return _compact_concat(batches)
 
+    def _materialize_node(self, node: P.PlanNode) -> Optional[Batch]:
+        """Materialize a subtree's full output as one batch, via the fused
+        single-program path when the subtree is a fusible chain (zero host
+        syncs), else by draining the streaming source."""
+        from .fused import fused_materialize
+        b = fused_materialize(self, node)
+        if b is not None:
+            return b
+        return self._materialize(self._compile(node))
+
     def _compile_JoinNode(self, node: P.JoinNode) -> BatchSource:
         if node.join_type not in (P.INNER, P.LEFT, P.FULL):
             raise NotImplementedError(f"join type {node.join_type}")
@@ -1067,8 +1274,7 @@ class PlanCompiler:
             probes) scales with CAPACITY, so selective joins would
             otherwise pay 2M-row costs for a few thousand live rows."""
             live = int(live)
-            bucket = next((s for s in (1 << 12, 1 << 16, 1 << 18, 1 << 20)
-                           if s >= live), None)
+            bucket = _bucket_for(live)
             if bucket is None or bucket * 4 > joined.capacity:
                 return joined
             return _jit_compact(joined, bucket)
@@ -1104,20 +1310,22 @@ class PlanCompiler:
                 matched = (jnp.zeros(build_batch.capacity, dtype=bool)
                            if full else None)
                 for batch in batches:
-                    joined, overflow, total, matched = step(batch, table,
-                                                            matched)
-                    ov, live = jax.device_get((overflow, total))
-                    if bool(ov):
-                        # split the probe batch in halves and retry
-                        for half in _split_batch(batch):
-                            j2, ov2, t2, matched = step(half, table,
-                                                        matched)
-                            ov2, live2 = jax.device_get((ov2, t2))
-                            if bool(ov2):
+                    # recursive halving on output overflow: high-fanout
+                    # probes (worst case a constant-key cross join) keep
+                    # splitting until each piece fits the output capacity
+                    work = [batch]
+                    while work:
+                        piece = work.pop()
+                        joined, overflow, total, matched = step(piece, table,
+                                                                matched)
+                        ov, live = jax.device_get((overflow, total))
+                        if bool(ov):
+                            if piece.capacity <= 1:
                                 raise RuntimeError(
-                                    "join output overflow after split")
-                            yield shrink(j2, live2).select(out_names)
-                    else:
+                                    "join output overflow on a single "
+                                    "probe row: raise join_out_capacity")
+                            work.extend(reversed(_split_batch(piece)))
+                            continue
                         yield shrink(joined, live).select(out_names)
                 if full:
                     yield unmatched_build(build_batch, matched)
@@ -1128,7 +1336,15 @@ class PlanCompiler:
             collected, spill = [], None
             reserved = 0
             try:
-                for b in self._compile(build_src_node).batches():
+                from .fused import fused_materialize
+                fb = fused_materialize(self, build_src_node)
+                if fb is not None:
+                    # fused single-program build materialization (only when
+                    # memory is unbudgeted, so no reservation bookkeeping)
+                    collected = [fb]
+                build_stream = ([] if fb is not None
+                                else self._compile(build_src_node).batches())
+                for b in build_stream:
                     nb = batch_bytes(b)
                     if spill is None and pool.try_reserve(nb):
                         collected.append(b)
@@ -1158,7 +1374,10 @@ class PlanCompiler:
                         for batch in probe.batches():
                             yield null_extended(batch)
                         return
-                    table = _jits()[1](build_batch, tuple(build_keys))
+                    from .fused import _drop_null_keys
+                    table = _jits()[1](
+                        _drop_null_keys(build_batch, tuple(build_keys)),
+                        tuple(build_keys))
                     yield from probe_stream(table, probe.batches(),
                                             build_batch)
                     return
@@ -1211,8 +1430,11 @@ class PlanCompiler:
                                     for q in range(cfg.spill_partitions))
                         continue
                     try:
+                        from .fused import _drop_null_keys
                         bucket = list(bstore.bucket_batches(p, bcap))[0]
-                        table = _jits()[1](bucket, tuple(build_keys))
+                        table = _jits()[1](
+                            _drop_null_keys(bucket, tuple(build_keys)),
+                            tuple(build_keys))
                         yield from probe_stream(
                             table,
                             pstore.bucket_batches(p, cfg.batch_rows),
@@ -1236,13 +1458,15 @@ class PlanCompiler:
             return batch.with_columns({node.semi_join_output.name: marker})
 
         def gen():
-            build_batch = self._materialize(self._compile(node.filtering_source))
+            build_batch = self._materialize_node(node.filtering_source)
             if build_batch is None:
                 for b in src.batches():
                     yield b.with_columns({node.semi_join_output.name: Column(
                         jnp.zeros(b.capacity, dtype=bool), None)})
                 return
-            table = _jits()[1](build_batch, (fkey,))
+            from .fused import _drop_null_keys
+            table = _jits()[1](_drop_null_keys(build_batch, (fkey,)),
+                               (fkey,))
             for b in src.batches():
                 yield step(b, table)
         return BatchSource(gen, names, types)
@@ -1311,7 +1535,8 @@ class PlanCompiler:
 # ---------------------------------------------------------------------------
 
 
-def _direct_mode_info(key_names, key_cols):
+def _direct_mode_info(key_names, key_cols,
+                      gmax: int = ops.DIRECT_AGG_MAX_GROUPS):
     """Closed-small-domain eligibility for direct aggregation, shared by the
     streaming (run_once) and fused (get_fused) paths — must stay consistent
     with ops.agg_direct_finalize's slot decode.  key_cols may be real Columns
@@ -1331,7 +1556,7 @@ def _direct_mode_info(key_names, key_cols):
     G = 1
     for d in doms:
         G *= max(1, d)
-    if key_names and G > ops.DIRECT_AGG_MAX_GROUPS:
+    if key_names and G > gmax:
         return None
     G = max(1, G)
     doms = tuple(max(1, d) for d in doms)

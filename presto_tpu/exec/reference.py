@@ -532,9 +532,12 @@ def _exec_EnforceSingleRowNode(node: P.EnforceSingleRowNode) -> Table:
 def _exec_SemiJoinNode(node: P.SemiJoinNode) -> Table:
     src = _exec(node.source)
     filt = _exec(node.filtering_source)
-    fvals = set(filt.cols[node.filtering_source_join_variable.name][0].tolist())
+    fv, fm = filt.cols[node.filtering_source_join_variable.name]
+    fvals = {x for i, x in enumerate(fv.tolist())
+             if fm is None or not fm[i]}     # NULL keys never match
     sv, sm = src.cols[node.source_join_variable.name]
-    marker = np.array([x in fvals for x in sv.tolist()])
+    marker = np.array([(sm is None or not sm[i]) and x in fvals
+                       for i, x in enumerate(sv.tolist())])
     cols = dict(src.cols)
     cols[node.semi_join_output.name] = (marker, None)
     return Table(cols, src.n)

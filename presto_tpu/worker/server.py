@@ -345,7 +345,9 @@ class _Handler(BaseHTTPRequestHandler):
                     f"<td style='color:{color}'>{escape(state)}</td>"
                     f"<td>{escape(q['resourceGroup'])}</td>"
                     f"<td><code>{escape(sql)}</code></td></tr>")
-        workers = "".join(f"<li>{u}</li>" for u in s.worker_uris())
+        # worker URIs arrive via the unauthenticated announcement endpoint:
+        # escape like every other client-controlled field
+        workers = "".join(f"<li>{escape(u)}</li>" for u in s.worker_uris())
         html = f"""<!doctype html><html><head><title>presto-tpu</title>
 <style>body{{font-family:sans-serif;margin:2em}}table{{border-collapse:
 collapse}}td,th{{border:1px solid #ccc;padding:4px 8px;text-align:left}}
