@@ -236,7 +236,7 @@ class FusedChain:
         """Returns (table, fanout) — fanout is the pow2-rounded max key
         multiplicity (1 = unique keys) — or None when fanout > MAX_EXPAND."""
         comp = self.compiler
-        batch = comp._materialize_node(build_node)
+        batch = comp._materialize_node(build_node, cache=True)
         if batch is None:
             batch = _empty_build_batch(build_node)
         batch = _drop_null_keys(batch, keys)
@@ -319,9 +319,8 @@ class FusedChain:
         build_names = {v.name for v in node.right.output_variables}
         out_names = [v.name for v in node.outputs]
         cols = dict(batch.columns)
-        for n in out_names:
-            if n in build_names:
-                cols[n] = tbl.columns[n].gather(bidx)
+        for n in _join_build_cols(node, out_names, build_names):
+            cols[n] = tbl.columns[n].gather(bidx)
         pairs = Batch(cols, batch.mask)
         matched = hit
         if node.filter is not None:
@@ -334,11 +333,10 @@ class FusedChain:
             return Batch(cols, batch.mask & matched)
         # LEFT: keep every probe row; null-extend build columns on misses
         miss = ~matched
-        for n in out_names:
-            if n in build_names:
-                c = cols[n]
-                cols[n] = Column(c.values, c.null_mask() | miss,
-                                 c.dictionary, c.lazy)
+        for n in _join_build_cols(node, out_names, build_names):
+            c = cols[n]
+            cols[n] = Column(c.values, c.null_mask() | miss,
+                             c.dictionary, c.lazy)
         return Batch(cols, batch.mask)
 
     def _apply_join_expand(self, batch: Batch, node: P.JoinNode,
@@ -372,9 +370,8 @@ class FusedChain:
                              None if c.nulls is None
                              else jnp.tile(c.nulls, k),
                              c.dictionary, c.lazy)
-        for n in out_names:
-            if n in build_names:
-                cols[n] = tbl.columns[n].gather(bidx)
+        for n in _join_build_cols(node, out_names, build_names):
+            cols[n] = tbl.columns[n].gather(bidx)
         pair_mask = (batch.mask[None, :] & sub).reshape(k * C)
         matched = pair_mask
         if node.filter is not None:
@@ -391,11 +388,10 @@ class FusedChain:
         fill = jnp.where(jnp.arange(k, dtype=jnp.int32)[:, None] == 0,
                          (batch.mask & ~any_match)[None, :],
                          False).reshape(k * C)
-        for n in out_names:
-            if n in build_names:
-                c = cols[n]
-                cols[n] = Column(c.values, c.null_mask() | fill,
-                                 c.dictionary, c.lazy)
+        for n in _join_build_cols(node, out_names, build_names):
+            c = cols[n]
+            cols[n] = Column(c.values, c.null_mask() | fill,
+                             c.dictionary, c.lazy)
         return Batch(cols, matched | fill)
 
 
@@ -437,14 +433,40 @@ def assemble_chain(compiler, node: P.PlanNode) -> Optional[FusedChain]:
             return None
 
 
-def fused_materialize(compiler, node: P.PlanNode) -> Optional[Batch]:
+# PROCESS-WIDE cap on device-resident cached build materializations
+# (the runner's plan cache can hold ~64 live compilers; a per-compiler
+# budget would multiply); a compiler's contribution is returned to the
+# pool when the compiler is garbage-collected (plan-cache eviction)
+_FMAT_CACHE_BYTES = 1 << 31
+_fmat_pool = {"bytes": 0}
+
+
+def _fmat_reserve(compiler, nb: int) -> bool:
+    import weakref
+    if _fmat_pool["bytes"] + nb > _FMAT_CACHE_BYTES:
+        return False
+    _fmat_pool["bytes"] += nb
+
+    def _release(n=nb):
+        _fmat_pool["bytes"] -= n
+    weakref.finalize(compiler, _release)
+    return True
+
+
+def fused_materialize(compiler, node: P.PlanNode,
+                      cache: bool = False) -> Optional[Batch]:
     """Materialize a fusible chain's full output as ONE device batch via a
     single lax.map program over scan chunks — the zero-host-sync analog of
     draining a streaming subtree batch by batch.  Used for join build
-    sides and sort/window inputs.  Returns None when the subtree is not a
+    sides (cache=True: results stay HBM-resident across re-executions —
+    generated connector data is immutable and writes clear the plan cache)
+    and sort/window inputs.  Returns None when the subtree is not a
     fusible chain (caller streams instead)."""
     if compiler.ctx.memory.budget is not None:
         return None     # budgeted runs keep the accounted streaming path
+    ckey = ("fmat_result", node.id)
+    if cache and ckey in compiler._jit_cache:
+        return compiler._jit_cache[ckey]
     chain = assemble_chain(compiler, node)
     if chain is None or not chain.chunks:
         return None
@@ -477,7 +499,73 @@ def fused_materialize(compiler, node: P.PlanNode) -> Optional[Batch]:
                 lambda a: a.reshape((-1,) + a.shape[2:]), stacked)
         compiler._jit_cache[key] = run_all
     from .pipeline import _maybe_compact
-    return _maybe_compact(run_all(pos_arr, cnt_arr, aux))
+    from .memory import batch_bytes
+    out = _maybe_compact(run_all(pos_arr, cnt_arr, aux))
+    if cache and _fmat_reserve(compiler, batch_bytes(out)):
+        compiler._jit_cache[ckey] = out
+    return out
+
+
+def _join_build_cols(node: P.JoinNode, out_names, build_names):
+    """Build columns a join step must gather: join outputs plus any
+    build-side columns the ON filter reads (pruning may have dropped the
+    latter from the output list)."""
+    needed = [n for n in out_names if n in build_names]
+    if node.filter is not None:
+        from ..spi.expr import free_variables
+        for v in free_variables(node.filter):
+            if v.name in build_names and v.name not in needed:
+                needed.append(v.name)
+    return needed
+
+
+def fused_stream(compiler, node: P.PlanNode):
+    """Stream a fusible chain's output chunk by chunk as device Batches —
+    one dispatch per chunk, ZERO host syncs (the fanout-bounded probes
+    need no overflow checks).  Used by the streaming Join/SemiJoin
+    compilers so chains consumed by non-aggregation operators (window,
+    AssignUniqueId, ...) avoid the per-batch overflow-fetch pattern.
+    Returns a Batch iterator or None (caller keeps the classic path)."""
+    if compiler.ctx.memory.budget is not None:
+        return None
+    key = ("fstream", node.id)
+    ent = compiler._jit_cache.get(key, False)
+    if ent is None:          # negative-cached
+        return None
+    if ent is False:
+        chain = assemble_chain(compiler, node)
+        if chain is None or not chain.chunks:
+            compiler._jit_cache[key] = None
+            return None
+        try:
+            prep_res = chain.prep()
+        except NotImplementedError:
+            prep_res = None
+        if prep_res is None:
+            compiler._jit_cache[key] = None
+            return None
+        aux, expands = prep_res
+        leaf_cap = chain.leaf_cap(expands)
+        chunks = chain.chunks_for(expands)
+        try:
+            jax.eval_shape(
+                lambda p, v: chain.make(p, v, aux, expands, leaf_cap),
+                jnp.int64(0), jnp.int64(1))
+        except NotImplementedError:
+            compiler._jit_cache[key] = None
+            return None
+
+        @jax.jit
+        def step(pos, valid, aux):
+            return chain.make(pos, valid, aux, expands, leaf_cap)
+        ent = (step, aux, chunks)
+        compiler._jit_cache[key] = ent
+    step, aux, chunks = ent
+
+    def gen():
+        for pos, cnt in chunks:
+            yield step(jnp.int64(pos), jnp.int64(cnt), aux)
+    return gen()
 
 
 def _empty_build_batch(build_node: P.PlanNode) -> Batch:

@@ -802,11 +802,19 @@ def sort_batch(batch: Batch, keys: List[Tuple[str, str]]) -> Batch:
 
 @dataclass(frozen=True)
 class WindowSpec:
-    """One window function over the node's shared (partition, order) spec."""
-    name: str            # row_number|rank|dense_rank|sum|count|count_star|min|max|avg
+    """One window function over the node's shared (partition, order) spec.
+
+    frame: None = default (RANGE UNBOUNDED PRECEDING .. CURRENT ROW) or a
+    normalized tuple (type, start_kind, start_off, end_kind, end_off) per
+    the reference WindowFrame (presto-main-base/.../operator/window/).
+    extra: constant arguments (lag/lead offset + default, nth_value n,
+    ntile n)."""
+    name: str
     output: str
     arg: Optional[str] = None   # input column (None for ranking / count(*))
     is_float: bool = False      # float accumulation (vs int64 / decimal)
+    frame: Optional[tuple] = None
+    extra: tuple = ()
 
 
 def _row_change(col: Column) -> jnp.ndarray:
@@ -824,6 +832,32 @@ def _row_change(col: Column) -> jnp.ndarray:
     return jnp.concatenate([jnp.ones(1, dtype=bool), ~eq])
 
 
+def _range_reduce(x, fs, fe, is_min: bool, ident):
+    """Per-row min/max of x over index range [fs, fe] (sparse doubling
+    table: log2(n) precomputed levels, two gathers per query row).  Empty
+    ranges (fe < fs) return ident."""
+    n = x.shape[0]
+    levels = [x]
+    size = 1
+    while size < n:
+        cur = levels[-1]
+        pad = jnp.full((size,), ident, x.dtype)
+        shifted = jnp.concatenate([cur[size:], pad])
+        levels.append(jnp.minimum(cur, shifted) if is_min
+                      else jnp.maximum(cur, shifted))
+        size <<= 1
+    stacked = jnp.stack(levels)                         # (L, n)
+    length = jnp.maximum(fe - fs + 1, 1)
+    j = (63 - jax.lax.clz(length.astype(jnp.uint64))).astype(jnp.int32)
+    fs_c = jnp.clip(fs, 0, n - 1).astype(jnp.int32)
+    hi = jnp.clip(fe - (jnp.int64(1) << j.astype(jnp.int64)) + 1,
+                  0, n - 1).astype(jnp.int32)
+    a = stacked[j, fs_c]
+    b = stacked[j, hi]
+    r = jnp.minimum(a, b) if is_min else jnp.maximum(a, b)
+    return jnp.where(fe < fs, ident, r)
+
+
 def window_batch(batch: Batch, partition_names: Tuple[str, ...],
                  orderings: Tuple[Tuple[str, str], ...],
                  specs: Tuple[WindowSpec, ...]) -> Batch:
@@ -831,9 +865,12 @@ def window_batch(batch: Batch, partition_names: Tuple[str, ...],
 
     Sorts the whole batch by (partition keys, order keys) — padding rows
     last, forming their own segment — then computes every function with
-    segmented prefix scans: no per-partition loop, so partition count and
-    sizes stay out of the compiled shape.  Output row order is the sorted
-    order (SQL does not guarantee WindowNode output order)."""
+    segmented prefix scans / sparse-table range reductions: no
+    per-partition loop, so partition count and sizes stay out of the
+    compiled shape.  Frames per reference WindowOperator.java:69 +
+    operator/window/: ROWS with offsets, RANGE with
+    unbounded/current-row bounds.  Output row order is the sorted order
+    (SQL does not guarantee WindowNode output order)."""
     sort_keys = [(p, "ASC_NULLS_FIRST") for p in partition_names] + list(orderings)
     perm = sort_indices(batch, sort_keys)   # [] keys still sorts padding last
     cols = {n: c.gather(perm) for n, c in batch.columns.items()}
@@ -861,6 +898,36 @@ def window_batch(batch: Batch, partition_names: Tuple[str, ...],
         jnp.where(peer_start, idx, n))))
     peer_end = jnp.concatenate(
         [at_or_after[1:], jnp.full(1, n, dtype=jnp.int64)]) - 1
+    at_or_after_p = jnp.flip(jax.lax.cummin(jnp.flip(
+        jnp.where(part_start, idx, n))))
+    seg_end = jnp.concatenate(
+        [at_or_after_p[1:], jnp.full(1, n, dtype=jnp.int64)]) - 1
+
+    def frame_bounds(spec: WindowSpec):
+        """(fs, fe) row index bounds of the spec's frame, clamped to the
+        partition; empty frames have fe < fs."""
+        f = spec.frame
+        if f is None:
+            return seg_start, peer_end
+        ftype, sk, so, ek, eo = f
+        if ftype == "RANGE":
+            fs = {"UNBOUNDED_PRECEDING": seg_start,
+                  "CURRENT": peer_start_idx}.get(sk)
+            fe = {"CURRENT": peer_end,
+                  "UNBOUNDED_FOLLOWING": seg_end}.get(ek)
+            if fs is None or fe is None:
+                raise NotImplementedError(
+                    "RANGE frame bounds with offsets")
+            return fs, fe
+        fs = {"UNBOUNDED_PRECEDING": seg_start, "CURRENT": idx,
+              "PRECEDING": idx - (so or 0),
+              "FOLLOWING": idx + (so or 0),
+              "UNBOUNDED_FOLLOWING": seg_end + 1}[sk]
+        fe = {"UNBOUNDED_FOLLOWING": seg_end, "CURRENT": idx,
+              "PRECEDING": idx - (eo or 0),
+              "FOLLOWING": idx + (eo or 0),
+              "UNBOUNDED_PRECEDING": seg_start - 1}[ek]
+        return jnp.maximum(fs, seg_start), jnp.minimum(fe, seg_end)
 
     out = dict(cols)
     for spec in specs:
@@ -874,8 +941,70 @@ def window_batch(batch: Batch, partition_names: Tuple[str, ...],
             cp = jnp.cumsum(peer_start.astype(jnp.int64))
             out[spec.output] = Column(cp - cp[seg_start] + 1, None)
             continue
+        if spec.name == "percent_rank":
+            size = seg_end - seg_start + 1
+            rank = peer_start_idx - seg_start + 1
+            denom = jnp.maximum(size - 1, 1)
+            v = (rank - 1).astype(jnp.float64) / denom
+            out[spec.output] = Column(jnp.where(size <= 1, 0.0, v), None)
+            continue
+        if spec.name == "cume_dist":
+            size = seg_end - seg_start + 1
+            thru = peer_end - seg_start + 1
+            out[spec.output] = Column(
+                thru.astype(jnp.float64) / jnp.maximum(size, 1), None)
+            continue
+        if spec.name == "ntile":
+            nt = jnp.int64(spec.extra[0])
+            size = seg_end - seg_start + 1
+            rn = idx - seg_start
+            q, r = size // nt, size % nt
+            big = r * (q + 1)
+            bucket = jnp.where(
+                rn < big, rn // jnp.maximum(q + 1, 1),
+                r + (rn - big) // jnp.maximum(q, 1))
+            out[spec.output] = Column(bucket + 1, None)
+            continue
 
-        # frame aggregate over rows [seg_start .. peer_end]
+        if spec.name in ("lag", "lead", "first_value", "last_value",
+                         "nth_value"):
+            col = cols[spec.arg]
+            fs, fe = frame_bounds(spec)
+            if spec.name in ("lag", "lead"):
+                off = jnp.int64(spec.extra[0] if spec.extra else 1)
+                src = idx - off if spec.name == "lag" else idx + off
+                valid = (src >= seg_start) & (src <= seg_end) & mask
+            elif spec.name == "first_value":
+                src = fs
+                valid = (fe >= fs) & mask
+            elif spec.name == "last_value":
+                src = fe
+                valid = (fe >= fs) & mask
+            else:   # nth_value(x, k)
+                k = jnp.int64(spec.extra[0] if spec.extra else 1)
+                src = fs + k - 1
+                valid = (src >= fs) & (src <= fe) & mask
+            src_c = jnp.clip(src, 0, n - 1)
+            vals = col.values[src_c]
+            nulls = col.null_mask()[src_c] | ~valid
+            default = spec.extra[1] if (spec.name in ("lag", "lead")
+                                        and len(spec.extra) > 1) else None
+            if default is not None:
+                if col.dictionary is not None or col.lazy is not None:
+                    raise NotImplementedError(
+                        "lag/lead default over string columns")
+                vals = jnp.where(valid, vals,
+                                 jnp.asarray(default, vals.dtype))
+                nulls = jnp.where(valid, col.null_mask()[src_c], False)
+            out[spec.output] = Column(vals, nulls, col.dictionary,
+                                      col.lazy)
+            continue
+
+        # frame aggregates
+        fs, fe = frame_bounds(spec)
+        empty = fe < fs
+        fs_c = jnp.clip(fs, 0, n - 1)
+        fe_c = jnp.clip(fe, 0, n - 1)
         if spec.name == "count_star":
             contrib = mask
             x = contrib.astype(jnp.int64)
@@ -885,25 +1014,26 @@ def window_batch(batch: Batch, partition_names: Tuple[str, ...],
             x = c.values
         cnt0 = jnp.concatenate([jnp.zeros(1, dtype=jnp.int64),
                                 jnp.cumsum(contrib.astype(jnp.int64))])
-        frame_cnt = cnt0[peer_end + 1] - cnt0[seg_start]
+        frame_cnt = jnp.where(empty, 0, cnt0[fe_c + 1] - cnt0[fs_c])
         if spec.name in ("count", "count_star"):
             out[spec.output] = Column(frame_cnt, None)
         elif spec.name in ("sum", "avg"):
             dt = jnp.float64 if spec.is_float else jnp.int64
             xv = jnp.where(contrib, x, 0).astype(dt)
             ps0 = jnp.concatenate([jnp.zeros(1, dtype=dt), jnp.cumsum(xv)])
-            frame_sum = ps0[peer_end + 1] - ps0[seg_start]
-            empty = frame_cnt == 0       # SQL: aggregate of no rows is NULL
-            safe = jnp.where(empty, 1, frame_cnt)
+            frame_sum = jnp.where(empty, jnp.zeros((), dt),
+                                  ps0[fe_c + 1] - ps0[fs_c])
+            isempty = frame_cnt == 0     # SQL: aggregate of no rows is NULL
+            safe = jnp.where(isempty, 1, frame_cnt)
             if spec.name == "sum":
-                out[spec.output] = Column(frame_sum, empty)
+                out[spec.output] = Column(frame_sum, isempty)
             elif spec.is_float:
-                out[spec.output] = Column(frame_sum / safe, empty)
+                out[spec.output] = Column(frame_sum / safe, isempty)
             else:
                 # decimal avg: round-half-up integer division at same scale
                 q = jnp.sign(frame_sum) * ((jnp.abs(frame_sum) + safe // 2)
                                            // safe)
-                out[spec.output] = Column(q.astype(jnp.int64), empty)
+                out[spec.output] = Column(q.astype(jnp.int64), isempty)
         elif spec.name in ("min", "max"):
             is_min = spec.name == "min"
             was_bool = x.dtype == jnp.bool_
@@ -925,30 +1055,20 @@ def window_batch(batch: Batch, partition_names: Tuple[str, ...],
                 ident = jnp.array(jnp.iinfo(x.dtype).max if is_min
                                   else jnp.iinfo(x.dtype).min, x.dtype)
             xv = jnp.where(contrib, x, ident)
-
-            def comb(a, b, _min=is_min):
-                fa, va = a
-                fb, vb = b
-                m = jnp.minimum(va, vb) if _min else jnp.maximum(va, vb)
-                return (fa | fb, jnp.where(fb, vb, m))
-
-            # segmented running min/max (reset at partition starts), read
-            # at the frame end to include the current peer group
-            _, run = jax.lax.associative_scan(comb, (part_start, xv))
-            vals = run[peer_end]
-            empty = frame_cnt == 0
+            vals = _range_reduce(xv, fs, fe, is_min, ident)
+            isempty = frame_cnt == 0
             if was_bool:
                 vals = vals.astype(jnp.bool_)
             if col.dictionary is not None:
                 # rank -> code; empty frames hold the identity sentinel,
                 # clamp before the gather (result is NULL there anyway)
-                vals = code_of_rank[jnp.where(empty, 0, vals)]
-                out[spec.output] = Column(vals, empty, col.dictionary)
+                vals = code_of_rank[jnp.where(isempty, 0, vals)]
+                out[spec.output] = Column(vals, isempty, col.dictionary)
             elif col.lazy is not None:
-                vals = jnp.where(empty, 0, vals)
-                out[spec.output] = Column(vals, empty, None, col.lazy)
+                vals = jnp.where(isempty, 0, vals)
+                out[spec.output] = Column(vals, isempty, None, col.lazy)
             else:
-                out[spec.output] = Column(vals, empty)
+                out[spec.output] = Column(vals, isempty)
         else:
             raise NotImplementedError(f"window function {spec.name}")
     return Batch(out, mask)

@@ -147,6 +147,10 @@ class TaskContext:
     splits: Dict[str, List[tpch.TpchSplit]] = field(default_factory=dict)
     # remote-source node id -> iterator of host Pages (exchange input)
     remote_pages: Dict[str, Callable[[], Iterator[Tuple[Page, List[str], List[Type]]]]] = field(default_factory=dict)
+    # remote-source node id -> iterator of DEVICE Batches (ICI exchange
+    # input: rows arrived via all_to_all, no host round-trip); wins over
+    # remote_pages when both are present
+    remote_batches: Dict[str, Callable[[], Iterator["Batch"]]] = field(default_factory=dict)
     # this task's index in its stage: namespaces AssignUniqueId across tasks
     task_index: int = 0
     # HBM byte accounting for this task (created by PlanCompiler if absent)
@@ -205,6 +209,14 @@ class PlanCompiler:
             page = batch_to_page(batch, src.names, src.types)
             if page.position_count:
                 yield page
+
+    def run_to_batches(self, root: P.PlanNode) -> Iterator[Batch]:
+        """Device-resident drain of the fragment (the ICI exchange path:
+        output rows stay in HBM for the cross-device shuffle)."""
+        for st in self._shared_states:
+            st.update(buf=[], it=None, done=False)
+        src = self.compile(root)
+        yield from src.batches()
 
     # -- dispatch ---------------------------------------------------------
     def _compile(self, node: P.PlanNode) -> BatchSource:
@@ -511,14 +523,21 @@ class PlanCompiler:
     def _compile_RemoteSourceNode(self, node: P.RemoteSourceNode) -> BatchSource:
         names = [v.name for v in node.outputs]
         types = [v.type for v in node.outputs]
-        source = self.ctx.remote_pages[node.id]
         cap = self.ctx.config.batch_rows
+        ctx = self.ctx
 
         def gen():
-            # string columns are materialized + remapped to a union
-            # dictionary (producer tasks ship independent dictionaries;
-            # jitted consumers need one per column); numeric-only streams
-            yield from pages_to_batches(source(), names, types, cap)
+            dev = ctx.remote_batches.get(node.id)
+            if dev is not None:
+                # ICI path: batches arrive device-resident from the
+                # all_to_all exchange (parallel/exchange.py)
+                yield from dev()
+                return
+            # HTTP/host path: string columns are materialized + remapped
+            # to a union dictionary (producer tasks ship independent
+            # dictionaries; jitted consumers need one per column)
+            yield from pages_to_batches(ctx.remote_pages[node.id](),
+                                        names, types, cap)
         return BatchSource(gen, names, types)
 
     # -- streaming transforms --------------------------------------------
@@ -712,16 +731,31 @@ class PlanCompiler:
         orderings = tuple((v.name, o) for v, o in
                           node.ordering_scheme.orderings) \
             if node.ordering_scheme else ()
+        from .lowering import constant_device_value
         specs = []
         for v, wf in node.window_functions.items():
             fname = canonical_name(wf.call.display_name)
+            args = wf.call.arguments
             arg = None
-            if fname == "count" and not wf.call.arguments:
+            extra = ()
+            if fname == "count" and not args:
                 fname = "count_star"
-            elif wf.call.arguments:
-                arg = wf.call.arguments[0].name
+            elif fname == "ntile":
+                extra = (int(args[0].value),)
+            elif args:
+                arg = args[0].name
+                consts = []
+                for a in args[1:]:
+                    consts.append(constant_device_value(a.value, a.type))
+                extra = tuple(consts)
+            frame = None
+            if wf.frame:
+                f = wf.frame
+                frame = (f["type"], f["startKind"], f["startOffset"],
+                         f["endKind"], f["endOffset"])
             is_float = isinstance(v.type, (DoubleType, RealType))
-            specs.append(ops.WindowSpec(fname, v.name, arg, is_float))
+            specs.append(ops.WindowSpec(fname, v.name, arg, is_float,
+                                        frame, extra))
         specs = tuple(specs)
         out_names = src_names + [v.name for v in node.window_functions]
         out_types = src_types + [v.type for v in node.window_functions]
@@ -915,12 +949,19 @@ class PlanCompiler:
                 # budgeted execution keeps the streaming path: its build
                 # reservation / grace-spill machinery owns memory discipline
                 return None
-            try:
-                prep_res = chain.prep()
-            except (NotImplementedError, MemoryExceededError):
-                return None
+            # build tables are deterministic per plan (generated connectors
+            # are immutable; writes clear the runner's plan cache), so prep
+            # results persist across re-executions — the warm path costs
+            # zero host syncs for builds
+            prep_res = fused_cache.get("prep")
             if prep_res is None:
-                return None
+                try:
+                    prep_res = chain.prep()
+                except (NotImplementedError, MemoryExceededError):
+                    return None
+                if prep_res is None:
+                    return None
+                fused_cache["prep"] = prep_res
             aux, expands = prep_res
             leaf_cap = chain.leaf_cap(expands)
             chunks = chain.chunks_for(expands)
@@ -1042,8 +1083,13 @@ class PlanCompiler:
                             (jnp.int64(ops.INT64_MAX),
                              jnp.int64(ops.INT64_MIN)))
                     fused_cache[("span_probe", expands)] = spanp
-                lo, hi = jax.device_get(spanp(pos_arr, cnt_arr, aux))
-                lo, hi = int(lo), int(hi)
+                span_key = ("span_range", expands)
+                if span_key in fused_cache:
+                    lo, hi = fused_cache[span_key]
+                else:
+                    lo, hi = jax.device_get(spanp(pos_arr, cnt_arr, aux))
+                    lo, hi = int(lo), int(hi)
+                    fused_cache[span_key] = (lo, hi)
                 span = hi - lo + 1
                 if hi >= lo and span <= ops.SPAN_AGG_MAX_GROUPS:
                     G = 1 << (span - 1).bit_length()
@@ -1211,12 +1257,14 @@ class PlanCompiler:
             return batches[0]
         return _compact_concat(batches)
 
-    def _materialize_node(self, node: P.PlanNode) -> Optional[Batch]:
+    def _materialize_node(self, node: P.PlanNode,
+                          cache: bool = False) -> Optional[Batch]:
         """Materialize a subtree's full output as one batch, via the fused
         single-program path when the subtree is a fusible chain (zero host
-        syncs), else by draining the streaming source."""
+        syncs), else by draining the streaming source.  cache=True keeps
+        the result HBM-resident across re-executions (join build sides)."""
         from .fused import fused_materialize
-        b = fused_materialize(self, node)
+        b = fused_materialize(self, node, cache=cache)
         if b is not None:
             return b
         return self._materialize(self._compile(node))
@@ -1230,8 +1278,11 @@ class PlanCompiler:
         build_keys = [r.name for l, r in node.criteria]
         out_names = [v.name for v in node.outputs]
         out_types = [v.type for v in node.outputs]
+        from .fused import _join_build_cols
         build_names = [v.name for v in build_src_node.output_variables]
-        build_out = [n for n in out_names if n in build_names]
+        # join outputs plus ON-filter-referenced build columns (pruning
+        # may have dropped the latter from the output list)
+        build_out = _join_build_cols(node, out_names, set(build_names))
         cfg = self.ctx.config
         low = self.lowering
         filter_expr = node.filter
@@ -1303,6 +1354,12 @@ class PlanCompiler:
 
         def gen():
             pool = self.ctx.memory
+            from .fused import fused_stream
+            fs = fused_stream(self, node)
+            if fs is not None:
+                for b in fs:
+                    yield b.select(out_names)
+                return
 
             def probe_stream(table, batches, build_batch=None):
                 # matched is threaded through for FULL joins; the build
@@ -1337,7 +1394,7 @@ class PlanCompiler:
             reserved = 0
             try:
                 from .fused import fused_materialize
-                fb = fused_materialize(self, build_src_node)
+                fb = fused_materialize(self, build_src_node, cache=True)
                 if fb is not None:
                     # fused single-program build materialization (only when
                     # memory is unbudgeted, so no reservation bookkeeping)
@@ -1458,7 +1515,13 @@ class PlanCompiler:
             return batch.with_columns({node.semi_join_output.name: marker})
 
         def gen():
-            build_batch = self._materialize_node(node.filtering_source)
+            from .fused import fused_stream
+            fs = fused_stream(self, node)
+            if fs is not None:
+                yield from (b.select(names) for b in fs)
+                return
+            build_batch = self._materialize_node(node.filtering_source,
+                                                 cache=True)
             if build_batch is None:
                 for b in src.batches():
                     yield b.with_columns({node.semi_join_output.name: Column(

@@ -235,9 +235,12 @@ def _exec_UnionNode(node: P.UnionNode) -> Table:
 
 def _exec_WindowNode(node: P.WindowNode) -> Table:
     """Per-partition python loop (independent of the device engine's
-    segmented-scan formulation).  Default frame only: RANGE UNBOUNDED
-    PRECEDING .. CURRENT ROW — running aggregates include the whole peer
-    group of the current row."""
+    segmented-scan formulation).  Supports ranking functions
+    (row_number/rank/dense_rank/ntile/percent_rank/cume_dist), value
+    functions (lag/lead/first_value/last_value/nth_value) and frame
+    aggregates with ROWS offset frames and RANGE
+    unbounded/current-row frames (reference WindowOperator.java:69 +
+    operator/window/)."""
     t = _exec(node.source)
     n = t.n
     part_vars = node.partition_by
@@ -267,23 +270,118 @@ def _exec_WindowNode(node: P.WindowNode) -> Table:
     peer_start = part_start | change_flags([v.name for v, _ in orderings])
     bounds = np.append(np.flatnonzero(part_start), n)
 
+    def peer_range(s, e, i):
+        """[gs, ge) peer group of row i within partition [s, e)."""
+        gs = i
+        while gs > s and not peer_start[gs]:
+            gs -= 1
+        ge = i + 1
+        while ge < e and not peer_start[ge]:
+            ge += 1
+        return gs, ge
+
+    def frame_rows(frame, s, e, i):
+        """Row index list of the frame of row i in partition [s, e)."""
+        if frame is None:
+            _gs, ge = peer_range(s, e, i)
+            return range(s, ge)
+        ftype = frame["type"]
+        sk, so = frame["startKind"], frame["startOffset"]
+        ek, eo = frame["endKind"], frame["endOffset"]
+        if ftype == "RANGE":
+            gs, ge = peer_range(s, e, i)
+            lo = s if sk == "UNBOUNDED_PRECEDING" else gs
+            hi = ge if ek == "CURRENT" else e
+            return range(lo, hi)
+        lo = {"UNBOUNDED_PRECEDING": s, "CURRENT": i,
+              "PRECEDING": i - (so or 0), "FOLLOWING": i + (so or 0),
+              "UNBOUNDED_FOLLOWING": e}[sk]
+        hi = {"UNBOUNDED_FOLLOWING": e - 1, "CURRENT": i,
+              "PRECEDING": i - (eo or 0), "FOLLOWING": i + (eo or 0),
+              "UNBOUNDED_PRECEDING": s - 1}[ek]
+        return range(max(lo, s), min(hi, e - 1) + 1)
+
     new_cols = dict(t.cols)
     for var, wf in node.window_functions.items():
         fname = canonical_name(wf.call.display_name)
         args = wf.call.arguments
-        if fname in ("row_number", "rank", "dense_rank"):
-            out = np.zeros(n, dtype=np.int64)
+        frame = wf.frame
+
+        if fname in ("row_number", "rank", "dense_rank", "ntile",
+                     "percent_rank", "cume_dist"):
+            is_f = fname in ("percent_rank", "cume_dist")
+            out = np.zeros(n, dtype=np.float64 if is_f else np.int64)
             for s, e in zip(bounds[:-1], bounds[1:]):
-                if fname == "row_number":
-                    out[s:e] = np.arange(1, e - s + 1)
-                else:
-                    r = d = 0
+                size = e - s
+                if fname == "ntile":
+                    nt = int(args[0].value)
+                    q, r = divmod(size, nt)
                     for i in range(s, e):
-                        if peer_start[i] or i == s:
-                            r = i - s + 1
-                            d += 1
-                        out[i] = r if fname == "rank" else d
+                        rn = i - s
+                        big = r * (q + 1)
+                        out[i] = (rn // (q + 1) if rn < big
+                                  else r + (rn - big) // max(q, 1)) + 1
+                    continue
+                rk = dr = 0
+                for i in range(s, e):
+                    if peer_start[i] or i == s:
+                        rk = i - s + 1
+                        dr += 1
+                    if fname == "row_number":
+                        out[i] = i - s + 1
+                    elif fname == "rank":
+                        out[i] = rk
+                    elif fname == "dense_rank":
+                        out[i] = dr
+                    elif fname == "percent_rank":
+                        out[i] = 0.0 if size <= 1 else (rk - 1) / (size - 1)
+                    else:   # cume_dist
+                        _gs, ge = peer_range(s, e, i)
+                        out[i] = (ge - s) / size
             new_cols[var.name] = (out, None)
+            continue
+
+        if fname in ("lag", "lead", "first_value", "last_value",
+                     "nth_value"):
+            vals, nulls = t.cols[args[0].name]
+            from .lowering import constant_device_value
+            outv = (np.zeros(n, dtype=vals.dtype) if vals.dtype != object
+                    else np.empty(n, dtype=object))
+            outn = np.zeros(n, dtype=bool)
+            for s, e in zip(bounds[:-1], bounds[1:]):
+                for i in range(s, e):
+                    if fname in ("lag", "lead"):
+                        off = int(args[1].value) if len(args) > 1 else 1
+                        src_i = i - off if fname == "lag" else i + off
+                        if s <= src_i < e:
+                            outv[i] = vals[src_i]
+                            outn[i] = bool(nulls[src_i]) if nulls is not None \
+                                else False
+                        elif len(args) > 2:
+                            dv = constant_device_value(args[2].value,
+                                                       args[2].type)
+                            if dv is None:
+                                outn[i] = True
+                            else:
+                                outv[i] = dv
+                        else:
+                            outn[i] = True
+                        continue
+                    rows = list(frame_rows(frame, s, e, i))
+                    if fname == "first_value":
+                        src_i = rows[0] if rows else None
+                    elif fname == "last_value":
+                        src_i = rows[-1] if rows else None
+                    else:
+                        k = int(args[1].value) if len(args) > 1 else 1
+                        src_i = rows[k - 1] if len(rows) >= k else None
+                    if src_i is None:
+                        outn[i] = True
+                    else:
+                        outv[i] = vals[src_i]
+                        outn[i] = bool(nulls[src_i]) if nulls is not None \
+                            else False
+            new_cols[var.name] = (outv, outn if outn.any() else None)
             continue
 
         star = fname == "count" and not args
@@ -301,49 +399,33 @@ def _exec_WindowNode(node: P.WindowNode) -> Table:
             outv = np.zeros(n, dtype=np.float64)
         outn = np.zeros(n, dtype=bool)
         for s, e in zip(bounds[:-1], bounds[1:]):
-            acc_sum, acc_cnt = 0, 0
-            acc_min = acc_max = None
-            gs = s
-            while gs < e:
-                ge = gs + 1
-                while ge < e and not peer_start[ge]:
-                    ge += 1
-                for i in range(gs, ge):
-                    if star:
-                        acc_cnt += 1
-                    elif notnull[i]:
-                        x = vals[i]
-                        acc_cnt += 1
-                        if fname in ("sum", "avg"):
-                            acc_sum += x
-                        elif fname == "min":
-                            if acc_min is None or x < acc_min:
-                                acc_min = x
-                        elif fname == "max":
-                            if acc_max is None or x > acc_max:
-                                acc_max = x
-                for i in range(gs, ge):
-                    if fname == "count":
-                        outv[i] = acc_cnt
-                    elif acc_cnt == 0:
-                        outn[i] = True       # aggregate of no rows is NULL
-                    elif fname == "sum":
-                        outv[i] = acc_sum
-                    elif fname == "avg":
-                        if out_is_float:
-                            outv[i] = acc_sum / acc_cnt
-                        else:
-                            si = int(acc_sum)   # decimal: round-half-up
-                            sign = -1 if si < 0 else 1
-                            outv[i] = sign * ((abs(si) + acc_cnt // 2)
-                                              // acc_cnt)
-                    elif fname == "min":
-                        outv[i] = acc_min
-                    elif fname == "max":
-                        outv[i] = acc_max
+            for i in range(s, e):
+                rows = [j for j in frame_rows(frame, s, e, i)
+                        if star or notnull[j]]
+                cnt = len(rows)
+                if fname == "count":
+                    outv[i] = cnt
+                    continue
+                if cnt == 0:
+                    outn[i] = True      # aggregate of no rows is NULL
+                    continue
+                xs = [vals[j] for j in rows]
+                if fname == "sum":
+                    outv[i] = sum(xs)
+                elif fname == "avg":
+                    sm = sum(xs)
+                    if out_is_float:
+                        outv[i] = sm / cnt
                     else:
-                        raise NotImplementedError(fname)
-                gs = ge
+                        si = int(sm)    # decimal: round-half-up
+                        sign = -1 if si < 0 else 1
+                        outv[i] = sign * ((abs(si) + cnt // 2) // cnt)
+                elif fname == "min":
+                    outv[i] = min(xs)
+                elif fname == "max":
+                    outv[i] = max(xs)
+                else:
+                    raise NotImplementedError(fname)
         new_cols[var.name] = (outv, outn if outn.any() else None)
     return Table(new_cols, n)
 
@@ -466,7 +548,15 @@ def _exec_JoinNode(node: P.JoinNode) -> Table:
     for name, (v, m) in right.cols.items():
         cols[name] = (v[ri], None if m is None else m[ri])
     out_names = [v.name for v in node.outputs]
-    pairs = Table({n: cols[n] for n in out_names}, len(li))
+    # the ON filter may read columns pruned from the output list: evaluate
+    # over the full pair table, project to out_names after
+    keep_names = list(out_names)
+    if node.filter is not None:
+        from ..spi.expr import free_variables
+        for fv in free_variables(node.filter):
+            if fv.name in cols and fv.name not in keep_names:
+                keep_names.append(fv.name)
+    pairs = Table({n: cols[n] for n in keep_names}, len(li))
 
     # 2. ON filter applies to pairs BEFORE null-extension (SQL semantics)
     keep = np.ones(pairs.n, dtype=bool)
@@ -476,6 +566,7 @@ def _exec_JoinNode(node: P.JoinNode) -> Table:
         if m is not None:
             keep &= ~m
     pairs = pairs.mask(keep)
+    pairs = Table({n: pairs.cols[n] for n in out_names}, pairs.n)
 
     if node.join_type not in (P.LEFT, P.FULL):
         return pairs

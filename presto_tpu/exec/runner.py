@@ -179,10 +179,13 @@ class DistributedQueryRunner(LocalQueryRunner):
     def __init__(self, schema: str = "sf0.01",
                  config: Optional[ExecutionConfig] = None,
                  n_tasks: int = 2, broadcast_threshold: int = 600_000,
-                 catalog: str = "tpch"):
+                 catalog: str = "tpch", mesh=None):
         super().__init__(schema, config, catalog)
         self.n_tasks = n_tasks
         self.broadcast_threshold = broadcast_threshold
+        # jax.sharding.Mesh: hashed exchanges between stages whose task
+        # count equals the mesh size run as ICI all_to_all collectives
+        self.mesh = mesh
 
     def plan_subplan(self, sql: str, ast=None):
         from ..sql.fragmenter import FragmenterConfig, plan_distributed
@@ -226,7 +229,7 @@ class DistributedQueryRunner(LocalQueryRunner):
         subplan, names, types = self.plan_subplan(sql, ast=ast)
         sched = InProcessScheduler(SchedulerConfig(
             exec_config=self.config, source_tasks=self.n_tasks,
-            hash_tasks=self.n_tasks))
+            hash_tasks=self.n_tasks, mesh=self.mesh))
         return pages_to_result(sched.execute(subplan), names, types)
 
 

@@ -36,6 +36,14 @@ class SchedulerConfig:
     source_tasks: int = 2
     # tasks per FIXED_HASH intermediate stage
     hash_tasks: int = 2
+    # jax.sharding.Mesh over parallel.mesh.WORKER_AXIS: when set and a
+    # hashed stage's task count equals the mesh size, tasks are pinned
+    # 1:1 to mesh devices and the hash exchange runs as a jitted
+    # all_to_all over ICI (parallel/exchange.py) instead of host-side
+    # page splitting; other edges (gather/broadcast/cross-process) keep
+    # the page path (SURVEY.md §5.8: HTTP stays for the coordinator and
+    # cross-pod edges)
+    mesh: object = None
 
 
 # ---------------------------------------------------------------------------
@@ -151,6 +159,11 @@ class StageInfo:
     n_tasks: int = 1
     n_partitions: int = 1      # consumer task count (output fan-out)
     buffers: Optional[OutputBuffers] = None
+    # ICI exchange result: consumer task -> device-resident Batch (rows
+    # whose hash targets that consumer), plus the producer's output
+    # column order for positional renaming at the consumer
+    device_out: Optional[list] = None
+    out_names: Optional[List[str]] = None
 
 
 class InProcessScheduler:
@@ -191,6 +204,11 @@ class InProcessScheduler:
         self._run_stage(root)
         yield from root.buffers.pages_for_consumer(0)
 
+    def _mesh_size(self) -> int:
+        from ..parallel.mesh import WORKER_AXIS
+        return (0 if self.config.mesh is None
+                else self.config.mesh.shape[WORKER_AXIS])
+
     def _run_stage(self, stage: StageInfo) -> None:
         for child in stage.children:
             self._run_stage(child)
@@ -200,6 +218,15 @@ class InProcessScheduler:
         out_types = [v.type for v in frag.root.output_variables]
         key_indices = [out_names.index(a.name) for a in scheme.arguments]
         hashed = scheme.handle == P.FIXED_HASH_DISTRIBUTION
+        stage.out_names = out_names
+
+        # ICI eligibility: hashed fan-out, tasks 1:1 with mesh devices
+        # (SURVEY.md §5.8: intra-pod hash exchange rides ICI; gather /
+        # broadcast / cross-process edges keep the page path)
+        mesh = self.config.mesh
+        ici = (hashed and stage.n_partitions > 1
+               and stage.n_tasks == stage.n_partitions
+               and stage.n_tasks == self._mesh_size())
 
         # split assignment per scan node: task i takes splits[i::n]
         scan_splits: Dict[str, List] = {}
@@ -216,6 +243,25 @@ class InProcessScheduler:
                         if isinstance(n, P.RemoteSourceNode)]
         child_by_fid = {c.fragment.fragment_id: c for c in stage.children}
 
+        # consuming device shards requires task<->device pinning too;
+        # a node mixing device and page children, or device children whose
+        # string dictionaries disagree, reads everything as pages (the
+        # device children are converted lazily in _remote_reader)
+        device_inputs = {}
+        for rnode in remote_nodes:
+            sources = [child_by_fid[fid]
+                       for fid in rnode.source_fragment_ids]
+            device_inputs[rnode.id] = (
+                all(s.device_out is not None for s in sources)
+                and _device_dicts_agree(sources))
+        pin = (ici or any(device_inputs.values())) \
+            and stage.n_tasks == self._mesh_size()
+        devices = (list(mesh.devices.flat)
+                   if pin or ici else [None] * stage.n_tasks)
+
+        import contextlib
+        import jax
+        task_batches: List = []
         for task_index in range(stage.n_tasks):
             ctx = TaskContext(config=self.config.exec_config,
                               task_index=task_index)
@@ -224,23 +270,236 @@ class InProcessScheduler:
             for rnode in remote_nodes:
                 sources = [child_by_fid[fid] for fid in
                            rnode.source_fragment_ids]
-                ctx.remote_pages[rnode.id] = _remote_reader(
-                    sources, task_index)
-            compiler = PlanCompiler(ctx)
-            for page in compiler.run_to_pages(frag.root):
-                if hashed and stage.n_partitions > 1:
-                    targets = partition_targets(
-                        page, out_types, key_indices, stage.n_partitions)
-                    for p, sub in enumerate(
-                            split_page(page, targets, stage.n_partitions)):
-                        if sub is not None:
-                            stage.buffers.add(task_index, p, sub)
+                if device_inputs[rnode.id] and pin:
+                    ctx.remote_batches[rnode.id] = _device_reader(
+                        sources, task_index, rnode)
                 else:
-                    stage.buffers.add(task_index, 0, page)
+                    ctx.remote_pages[rnode.id] = _remote_reader(
+                        sources, task_index)
+            compiler = PlanCompiler(ctx)
+            dev_ctx = (jax.default_device(devices[task_index])
+                       if pin else contextlib.nullcontext())
+            with dev_ctx:
+                if ici:
+                    from .pipeline import _compact_concat
+                    batches = [b for b in
+                               compiler.run_to_batches(frag.root)]
+                    task_batches.append(
+                        _compact_concat(batches) if batches else None)
+                    continue
+                for page in compiler.run_to_pages(frag.root):
+                    if hashed and stage.n_partitions > 1:
+                        targets = partition_targets(
+                            page, out_types, key_indices,
+                            stage.n_partitions)
+                        for p, sub in enumerate(
+                                split_page(page, targets,
+                                           stage.n_partitions)):
+                            if sub is not None:
+                                stage.buffers.add(task_index, p, sub)
+                    else:
+                        stage.buffers.add(task_index, 0, page)
+        if ici:
+            keys = tuple(out_names[i] for i in key_indices)
+            if not self._ici_exchange(stage, task_batches, keys):
+                # metadata mismatch across tasks: fall back to pages
+                self._spill_batches_to_pages(
+                    stage, task_batches, out_names, out_types,
+                    key_indices)
+
+    # -- ICI exchange -----------------------------------------------------
+    _exch_cache: Dict = {}
+
+    def _ici_exchange(self, stage: StageInfo, task_batches: List,
+                      keys: Tuple[str, ...]) -> bool:
+        """all_to_all the per-task output batches across the mesh; on
+        success stage.device_out[consumer] holds that consumer's rows
+        device-resident.  Returns False when per-task batch metadata
+        (dictionaries / null-ness / schema) disagrees — the caller then
+        falls back to the page exchange."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec
+        from ..exec import operators as ops
+        from ..exec.batch import Batch, Column
+        from ..parallel.exchange import make_partitioned_exchange
+        from ..parallel.mesh import WORKER_AXIS
+        mesh = self.config.mesh
+        devices = list(mesh.devices.flat)
+        n = stage.n_tasks
+
+        lives = [0 if b is None else int(jax.device_get(b.mask.sum()))
+                 for b in task_batches]
+        template = next((b for b in task_batches if b is not None), None)
+        if template is None:
+            stage.device_out = [None] * n
+            return True
+        # schema/metadata must agree across tasks (scan dictionaries are
+        # table-stable, so they normally do)
+        tstruct = _batch_meta(template)
+        for b in task_batches:
+            if b is not None and _batch_meta(b) != tstruct:
+                return False
+
+        B = max(256, 1 << (max(max(lives), 1) - 1).bit_length())
+        from .pipeline import _jit_compact
+        norm = []
+        for i, b in enumerate(task_batches):
+            with jax.default_device(devices[i]):
+                if b is None:
+                    nb = _zeros_like_batch(template, B)
+                elif b.capacity == B:
+                    nb = b
+                else:
+                    nb = _jit_compact(b, B)
+            norm.append(nb)
+
+        sharding = NamedSharding(mesh, PartitionSpec(WORKER_AXIS))
+
+        def to_global(arrays):
+            arrays = [jax.device_put(a, devices[i])
+                      for i, a in enumerate(arrays)]
+            shape = (n * B,) + arrays[0].shape[1:]
+            return jax.make_array_from_single_device_arrays(
+                shape, sharding, arrays)
+
+        cols = {}
+        for name, c in template.columns.items():
+            values = to_global([nb.columns[name].values for nb in norm])
+            nulls = (to_global([nb.columns[name].null_mask()
+                                for nb in norm])
+                     if c.nulls is not None else None)
+            cols[name] = Column(values, nulls, c.dictionary, c.lazy)
+        gbatch = Batch(cols, to_global([nb.mask for nb in norm]))
+
+        # quota retry: start near the balanced share, double on overflow
+        # (the device-side overflow flag is the module's promised
+        # split-and-retry recovery; quota == B always fits)
+        quota = max(64, 1 << ((2 * max(max(lives), 1) // n) | 1)
+                    .bit_length())
+        quota = min(quota, B)
+        while True:
+            key = (tuple(devices), keys, quota, B)
+            exch = self._exch_cache.get(key)
+            if exch is None:
+                exch = make_partitioned_exchange(mesh, keys, quota)
+                self._exch_cache[key] = exch
+            out, overflow = exch(gbatch)
+            if not bool(jax.device_get(overflow)):
+                break
+            if quota >= B:
+                raise RuntimeError("ICI exchange overflow at full quota")
+            quota = min(B, quota * 2)
+
+        shard_cap = n * quota
+        by_dev = {}
+        first_col = next(iter(out.columns.values())).values
+        for s in first_col.addressable_shards:
+            by_dev[s.device] = None
+        stage.device_out = []
+        for i in range(n):
+            ccols = {}
+            for name, c in out.columns.items():
+                ccols[name] = Column(
+                    _shard_on(c.values, devices[i]),
+                    (_shard_on(c.nulls, devices[i])
+                     if c.nulls is not None else None),
+                    c.dictionary, c.lazy)
+            stage.device_out.append(
+                Batch(ccols, _shard_on(out.mask, devices[i])))
+        return True
+
+    def _spill_batches_to_pages(self, stage: StageInfo, task_batches,
+                                out_names, out_types, key_indices) -> None:
+        from .batch import batch_to_page
+        for task_index, b in enumerate(task_batches):
+            if b is None:
+                continue
+            page = batch_to_page(b, out_names, out_types)
+            if not page.position_count:
+                continue
+            targets = partition_targets(page, out_types, key_indices,
+                                        stage.n_partitions)
+            for p, sub in enumerate(
+                    split_page(page, targets, stage.n_partitions)):
+                if sub is not None:
+                    stage.buffers.add(task_index, p, sub)
+
+
+def _batch_meta(b) -> tuple:
+    return tuple(sorted(
+        (name, str(c.values.dtype), c.nulls is not None, c.dictionary,
+         c.lazy) for name, c in b.columns.items()))
+
+
+def _zeros_like_batch(template, B: int):
+    import jax.numpy as jnp
+    from ..exec.batch import Batch, Column
+    cols = {}
+    for name, c in template.columns.items():
+        v = jnp.zeros((B,) + c.values.shape[1:], c.values.dtype)
+        nn = jnp.zeros(B, dtype=bool) if c.nulls is not None else None
+        cols[name] = Column(v, nn, c.dictionary, c.lazy)
+    return Batch(cols, jnp.zeros(B, dtype=bool))
+
+
+def _shard_on(arr, device):
+    for s in arr.addressable_shards:
+        if s.device == device:
+            return s.data
+    raise RuntimeError(f"no shard on {device}")
+
+
+def _device_reader(sources: List[StageInfo], consumer_task: int, rnode):
+    """Consumer-side ICI input: the device-resident shard for this task,
+    renamed positionally to the RemoteSourceNode's output variables."""
+    from ..exec.batch import Batch
+    names = [v.name for v in rnode.outputs]
+
+    def read():
+        for src in sources:
+            b = src.device_out[consumer_task]
+            if b is None:
+                continue
+            prod = src.out_names
+            cols = {names[j]: b.columns[prod[j]]
+                    for j in range(len(names))}
+            yield Batch(cols, b.mask)
+    return read
+
+
+def _device_dicts_agree(sources: List[StageInfo]) -> bool:
+    """Device batches skip the union-dictionary remap of the page path
+    (exec/batch.py pages_to_batches), so the device reader is only safe
+    when every source fragment ships identical per-column dictionary /
+    lazy metadata."""
+    seen: Dict[int, tuple] = {}
+    for src in sources:
+        for b in src.device_out or []:
+            if b is None:
+                continue
+            cols = [b.columns[n] for n in src.out_names]
+            for j, c in enumerate(cols):
+                meta = (c.dictionary, c.lazy)
+                if seen.setdefault(j, meta) != meta:
+                    return False
+    return True
 
 
 def _remote_reader(sources: List[StageInfo], consumer_task: int):
+    """Page reader; ICI children (device_out) are converted to pages
+    lazily so mixed device/page source sets lose no rows."""
     def read() -> Iterator[Page]:
         for src in sources:
+            if src.device_out is not None:
+                from .batch import batch_to_page
+                b = src.device_out[consumer_task]
+                if b is not None:
+                    types = [v.type for v in
+                             src.fragment.root.output_variables]
+                    page = batch_to_page(b, src.out_names, types)
+                    if page.position_count:
+                        yield page
+                continue
             yield from src.buffers.pages_for_consumer(consumer_task)
     return read

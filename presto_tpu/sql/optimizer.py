@@ -14,10 +14,161 @@ Current passes:
 """
 from __future__ import annotations
 
+from typing import Dict, Set
+
 from ..spi import plan as P
+from ..spi.expr import free_variables
 from .stats import StatsCalculator
 
 SWAP_RATIO = 1.25     # hysteresis: only swap on a clear size difference
+
+
+# ---------------------------------------------------------------------------
+# unused-output pruning (reference PruneUnreferencedOutputsRule family in
+# presto-main-base/.../planner/iterative/rule/): drop columns no ancestor
+# reads.  Critical on TPU: a table scan that materializes host-generated
+# string columns nobody reads both wastes transfer AND disqualifies the
+# scan from whole-pipeline fusion (exec/fused.py requires device-generated
+# scans).  Decorrelated plans contain deep-copied subtrees SHARING node
+# ids; the pipeline compiler memoizes by id, so requirements are unioned
+# per id first and every copy is rewritten identically.
+# ---------------------------------------------------------------------------
+
+def prune_unused_outputs(root: P.PlanNode) -> P.PlanNode:
+    req: Dict[str, Set[str]] = {}
+
+    def expr_vars(*exprs) -> Set[str]:
+        out: Set[str] = set()
+        for e in exprs:
+            if e is not None:
+                out.update(v.name for v in free_variables(e))
+        return out
+
+    def visit(node: P.PlanNode, needed: Set[str]) -> None:
+        prev = req.get(node.id)
+        if prev is not None and needed <= prev:
+            return
+        needed = (prev or set()) | needed
+        req[node.id] = set(needed)
+        t = type(node).__name__
+        if t == "OutputNode":
+            visit(node.source, set(v.name
+                                   for v in node.source.output_variables))
+        elif t == "ProjectNode":
+            child: Set[str] = set()
+            for v, e in node.assignments.items():
+                if v.name in needed:
+                    child |= expr_vars(e)
+            if not child:
+                # keep at least one input column for row-count semantics
+                if node.assignments:
+                    child |= expr_vars(next(iter(node.assignments.values())))
+                if not child and node.source.output_variables:
+                    child.add(node.source.output_variables[0].name)
+            visit(node.source, child)
+        elif t == "FilterNode":
+            visit(node.source, needed | expr_vars(node.predicate))
+        elif t == "TableScanNode":
+            pass
+        elif t == "AggregationNode":
+            child = {v.name for v in node.grouping_keys}
+            for agg in node.aggregations.values():
+                child |= expr_vars(agg.call)
+                if agg.mask is not None:
+                    child |= expr_vars(agg.mask)
+            visit(node.source, child)
+        elif t == "JoinNode":
+            child = set(needed)
+            for l, r in node.criteria:
+                child.add(l.name)
+                child.add(r.name)
+            child |= expr_vars(node.filter)
+            visit(node.left, child)
+            visit(node.right, child)
+        elif t == "SemiJoinNode":
+            visit(node.source, (needed - {node.semi_join_output.name})
+                  | {node.source_join_variable.name})
+            visit(node.filtering_source,
+                  {node.filtering_source_join_variable.name})
+        elif t in ("SortNode", "TopNNode"):
+            keys = {v.name for v, _o in node.ordering_scheme.orderings}
+            visit(node.source, needed | keys)
+        elif t == "WindowNode":
+            child = needed & {v.name for v in node.source.output_variables}
+            child |= {v.name for v in node.partition_by}
+            if node.ordering_scheme:
+                child |= {v.name for v, _o in
+                          node.ordering_scheme.orderings}
+            for wf in node.window_functions.values():
+                child |= expr_vars(wf.call)
+            visit(node.source, child)
+        elif t == "DistinctLimitNode":
+            visit(node.source, {v.name for v in node.distinct_variables})
+        elif t == "MarkDistinctNode":
+            visit(node.source, (needed - {node.marker.name})
+                  | {v.name for v in node.distinct_variables})
+        elif t == "AssignUniqueIdNode":
+            visit(node.source, needed - {node.id_variable.name})
+        elif t in ("LimitNode", "EnforceSingleRowNode"):
+            visit(node.source, needed)
+        elif t == "UnionNode":
+            # every source is projected to the union's output variables
+            for s in node.inputs:
+                visit(s, set(needed))
+        elif t == "ExchangeNode":
+            if not node.inputs and len(node.exchange_sources) == 1:
+                visit(node.exchange_sources[0], set(needed))
+            else:
+                for s in node.exchange_sources:
+                    visit(s, {v.name for v in s.output_variables})
+        else:
+            # conservative: require everything below (Values, Unnest,
+            # RemoteSource, TableWriter/Finish, unknown nodes)
+            for s in node.sources:
+                visit(s, {v.name for v in s.output_variables})
+
+    visit(root, {v.name for v in root.output_variables})
+
+    # rewrite pass: every node-id copy sees the same unioned requirement
+    def rewrite(node: P.PlanNode) -> None:
+        needed = req.get(node.id)
+        t = type(node).__name__
+        if needed is not None:
+            if t == "TableScanNode":
+                keep = [v for v in node.outputs if v.name in needed]
+                if not keep and node.outputs:
+                    # keep one (prefer non-string: stays device-generable)
+                    keep = sorted(
+                        node.outputs,
+                        key=lambda v: type(v.type).__name__
+                        in ("VarcharType", "CharType"))[:1]
+                if len(keep) != len(node.outputs):
+                    node.outputs = keep
+                    node.assignments = {v: c for v, c
+                                        in node.assignments.items()
+                                        if v in keep}
+            elif t == "ProjectNode":
+                keep = {v: e for v, e in node.assignments.items()
+                        if v.name in needed}
+                if not keep and node.assignments:
+                    v0 = next(iter(node.assignments))
+                    keep = {v0: node.assignments[v0]}
+                node.assignments = keep
+            elif t == "JoinNode":
+                keep = [v for v in node.outputs if v.name in needed]
+                if not keep and node.outputs:
+                    # keep one probe column for row-count semantics
+                    left_names = {v.name for v in
+                                  node.left.output_variables}
+                    keep = ([v for v in node.outputs
+                             if v.name in left_names]
+                            or node.outputs)[:1]
+                node.outputs = keep
+        for s in node.sources:
+            rewrite(s)
+
+    rewrite(root)
+    return root
 
 
 def determine_join_sides(root: P.PlanNode,
@@ -35,4 +186,5 @@ def determine_join_sides(root: P.PlanNode,
 
 
 def optimize(root: P.PlanNode) -> P.PlanNode:
+    root = prune_unused_outputs(root)
     return determine_join_sides(root)

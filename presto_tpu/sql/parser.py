@@ -201,12 +201,24 @@ class ExtractExpr(Node):
 
 
 @dataclass
+class WindowFrame(Node):
+    """ROWS|RANGE frame.  Bound kinds: UNBOUNDED_PRECEDING, PRECEDING(n),
+    CURRENT, FOLLOWING(n), UNBOUNDED_FOLLOWING."""
+    frame_type: str                       # ROWS | RANGE
+    start_kind: str
+    start_offset: Optional[int]
+    end_kind: str
+    end_offset: Optional[int]
+
+
+@dataclass
 class WindowCall(Node):
-    """fn(args) OVER (PARTITION BY ... ORDER BY ...) — default frame only
-    (RANGE UNBOUNDED PRECEDING .. CURRENT ROW)."""
+    """fn(args) OVER (PARTITION BY ... ORDER BY ... [frame]); frame None =
+    default RANGE UNBOUNDED PRECEDING .. CURRENT ROW."""
     func: "FuncCall"
     partition_by: List[Node]
     order_by: List["OrderItem"]
+    frame: Optional[WindowFrame] = None
 
 
 # relations
@@ -858,11 +870,53 @@ class Parser:
             order_by.append(self.parse_order_item())
             while self.accept("op", ","):
                 order_by.append(self.parse_order_item())
+        frame = None
         if self.peek().kind in ("ident", "keyword") \
                 and self.peek().value.lower() in ("rows", "range", "groups"):
-            raise SyntaxError("explicit window frames not supported")
+            frame = self.parse_window_frame()
         self.expect("op", ")")
-        return WindowCall(fc, partition_by, order_by)
+        return WindowCall(fc, partition_by, order_by, frame)
+
+    def parse_window_frame(self) -> "WindowFrame":
+        ftype = self.next().value.upper()
+        if ftype == "GROUPS":
+            raise SyntaxError("GROUPS window frames not supported")
+
+        def bound():
+            t = self.peek()
+            if t.value.lower() == "unbounded":
+                self.next()
+                d = self.next().value.lower()
+                if d == "preceding":
+                    return ("UNBOUNDED_PRECEDING", None)
+                if d == "following":
+                    return ("UNBOUNDED_FOLLOWING", None)
+                raise SyntaxError(f"bad frame bound near {d!r}")
+            if t.value.lower() == "current":
+                self.next()
+                if self.next().value.lower() != "row":
+                    raise SyntaxError("expected CURRENT ROW")
+                return ("CURRENT", None)
+            if t.kind == "number":
+                n = int(self.next().value)
+                d = self.next().value.lower()
+                if d == "preceding":
+                    return ("PRECEDING", n)
+                if d == "following":
+                    return ("FOLLOWING", n)
+                raise SyntaxError(f"bad frame bound near {d!r}")
+            raise SyntaxError(f"bad frame bound near {t.value!r}")
+
+        if self.peek().value.lower() == "between":
+            self.next()
+            sk, so = bound()
+            if self.next().value.lower() != "and":
+                raise SyntaxError("expected AND in frame BETWEEN")
+            ek, eo = bound()
+        else:
+            sk, so = bound()
+            ek, eo = "CURRENT", None
+        return WindowFrame(ftype, sk, so, ek, eo)
 
     def parse_type_name(self) -> str:
         base = self.next().value.lower()

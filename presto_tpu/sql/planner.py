@@ -1099,8 +1099,11 @@ class Planner:
     # ------------------------------------------------------------------
     # window planning
     # ------------------------------------------------------------------
-    _RANKING_FUNCS = {"row_number", "rank", "dense_rank"}
+    _RANKING_FUNCS = {"row_number", "rank", "dense_rank", "ntile",
+                      "percent_rank", "cume_dist"}
     _WINDOW_AGGS = {"sum", "avg", "count", "min", "max"}
+    _VALUE_FUNCS = {"lag", "lead", "first_value", "last_value",
+                    "nth_value"}
 
     def plan_windows(self, node: P.PlanNode, scope: Scope,
                      wcalls: List[A.WindowCall]):
@@ -1136,11 +1139,55 @@ class Planner:
                     order += "_NULLS_FIRST" if oi.nulls_first \
                         else "_NULLS_LAST"
                 orderings.append((v, order))
+            frame = None
+            if wc.frame is not None:
+                if wc.frame.frame_type == "RANGE" and (
+                        wc.frame.start_kind in ("PRECEDING", "FOLLOWING")
+                        or wc.frame.end_kind in ("PRECEDING", "FOLLOWING")):
+                    raise PlanningError(
+                        "RANGE frames with numeric offsets are not "
+                        "supported")
+                frame = {"type": wc.frame.frame_type,
+                         "startKind": wc.frame.start_kind,
+                         "startOffset": wc.frame.start_offset,
+                         "endKind": wc.frame.end_kind,
+                         "endOffset": wc.frame.end_offset}
             if fname in self._RANKING_FUNCS:
                 if not orderings:
                     raise PlanningError(f"{fname}() requires ORDER BY")
-                out_type: Type = BIGINT
-                fcall = CallExpression(fname, out_type, [])
+                if fname == "ntile":
+                    if len(wc.func.args) != 1:
+                        raise PlanningError("ntile(n) takes one argument")
+                    n_expr = self.plan_expr(wc.func.args[0], scope)
+                    if not isinstance(n_expr, ConstantExpression) \
+                            or not isinstance(n_expr.value, int) \
+                            or n_expr.value <= 0:
+                        raise PlanningError(
+                            "ntile(n) requires a constant positive "
+                            "integer")
+                    out_type = BIGINT
+                    fcall = CallExpression(fname, out_type, [n_expr])
+                elif fname in ("percent_rank", "cume_dist"):
+                    out_type = DOUBLE
+                    fcall = CallExpression(fname, out_type, [])
+                else:
+                    out_type: Type = BIGINT
+                    fcall = CallExpression(fname, out_type, [])
+            elif fname in self._VALUE_FUNCS:
+                if not wc.func.args:
+                    raise PlanningError(f"{fname}() requires an argument")
+                arg = self.plan_expr(wc.func.args[0], scope)
+                av = ensure(arg, "warg")
+                out_type = arg.type
+                extra = []
+                for a in wc.func.args[1:]:
+                    e = self.plan_expr(a, scope)
+                    if not isinstance(e, ConstantExpression):
+                        raise PlanningError(
+                            f"{fname}: offset/default arguments must be "
+                            f"constants")
+                    extra.append(e)
+                fcall = CallExpression(fname, out_type, [av] + extra)
             elif fname in self._WINDOW_AGGS:
                 if wc.func.args:
                     arg = self.plan_expr(wc.func.args[0], scope)
@@ -1157,7 +1204,7 @@ class Planner:
             g = groups.setdefault(spec_key, {
                 "partition": part_vars, "orderings": orderings, "funcs": {}})
             out_var = self.new_var(fname, out_type)
-            g["funcs"][out_var] = P.WindowFunction(fcall)
+            g["funcs"][out_var] = P.WindowFunction(fcall, frame)
             expr_vars[_canon(wc, scope)] = out_var
 
         node = P.ProjectNode(self.new_id("prewindow"), node, pre_assign)

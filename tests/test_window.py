@@ -1,0 +1,171 @@
+"""Window-function conformance bank (VERDICT item 7): ranking, value
+functions (lag/lead/first_value/last_value/nth_value), ntile,
+percent_rank/cume_dist, and explicit ROWS/RANGE frames — engine
+(exec/operators.py window_batch) vs the independent numpy oracle
+(exec/reference.py), per the reference's AbstractTestWindowQueries
+differential strategy (SURVEY.md §4.3).
+
+Reference semantics fixture: presto-main-base/.../operator/window/
+(frames), WindowOperator.java:69.
+"""
+import pytest
+
+from presto_tpu.exec.pipeline import ExecutionConfig
+from presto_tpu.exec.runner import LocalQueryRunner
+
+
+@pytest.fixture(scope="module")
+def runner():
+    return LocalQueryRunner("sf0.01", config=ExecutionConfig(
+        batch_rows=1 << 14, join_out_capacity=1 << 16))
+
+
+SHAPES = {
+    "row_number": """
+        SELECT custkey, orderkey,
+               row_number() OVER (PARTITION BY custkey ORDER BY orderkey)
+        FROM orders WHERE orderkey < 2000""",
+    "rank_dense": """
+        SELECT orderkey, rank() OVER (ORDER BY orderpriority),
+               dense_rank() OVER (ORDER BY orderpriority)
+        FROM orders WHERE orderkey < 400""",
+    "running_sum": """
+        SELECT custkey, orderkey,
+               sum(totalprice) OVER (PARTITION BY custkey ORDER BY orderkey)
+        FROM orders WHERE orderkey < 4000""",
+    "global_agg": """
+        SELECT orderkey, avg(totalprice) OVER () FROM orders
+        WHERE orderkey < 500""",
+    "lag_default": """
+        SELECT custkey, orderkey,
+               lag(orderkey) OVER (PARTITION BY custkey ORDER BY orderkey),
+               lag(orderkey, 2, -1) OVER (PARTITION BY custkey
+                                          ORDER BY orderkey)
+        FROM orders WHERE orderkey < 4000""",
+    "lead": """
+        SELECT custkey, orderkey,
+               lead(totalprice) OVER (PARTITION BY custkey ORDER BY orderkey)
+        FROM orders WHERE orderkey < 4000""",
+    "first_last_value": """
+        SELECT custkey, orderkey,
+               first_value(orderkey) OVER (PARTITION BY custkey
+                                           ORDER BY orderkey),
+               last_value(orderkey) OVER (PARTITION BY custkey
+                                          ORDER BY orderkey)
+        FROM orders WHERE orderkey < 4000""",
+    "last_value_full_frame": """
+        SELECT custkey, orderkey,
+               last_value(orderkey) OVER (
+                   PARTITION BY custkey ORDER BY orderkey
+                   RANGE BETWEEN UNBOUNDED PRECEDING
+                             AND UNBOUNDED FOLLOWING)
+        FROM orders WHERE orderkey < 4000""",
+    "nth_value": """
+        SELECT custkey, orderkey,
+               nth_value(orderkey, 2) OVER (
+                   PARTITION BY custkey ORDER BY orderkey
+                   ROWS BETWEEN UNBOUNDED PRECEDING
+                            AND UNBOUNDED FOLLOWING)
+        FROM orders WHERE orderkey < 4000""",
+    "ntile": """
+        SELECT orderkey, ntile(4) OVER (ORDER BY totalprice)
+        FROM orders WHERE orderkey < 800""",
+    "percent_rank": """
+        SELECT orderkey, percent_rank() OVER (ORDER BY orderpriority),
+               cume_dist() OVER (ORDER BY orderpriority)
+        FROM orders WHERE orderkey < 400""",
+    "rows_preceding": """
+        SELECT custkey, orderkey,
+               sum(totalprice) OVER (PARTITION BY custkey ORDER BY orderkey
+                                     ROWS 2 PRECEDING)
+        FROM orders WHERE orderkey < 4000""",
+    "rows_between": """
+        SELECT custkey, orderkey,
+               sum(totalprice) OVER (
+                   PARTITION BY custkey ORDER BY orderkey
+                   ROWS BETWEEN 1 PRECEDING AND 1 FOLLOWING)
+        FROM orders WHERE orderkey < 4000""",
+    "rows_moving_min_max": """
+        SELECT custkey, orderkey,
+               min(totalprice) OVER (PARTITION BY custkey ORDER BY orderkey
+                                     ROWS BETWEEN 2 PRECEDING
+                                              AND CURRENT ROW),
+               max(totalprice) OVER (PARTITION BY custkey ORDER BY orderkey
+                                     ROWS BETWEEN 2 PRECEDING
+                                              AND CURRENT ROW)
+        FROM orders WHERE orderkey < 4000""",
+    "rows_following_only": """
+        SELECT custkey, orderkey,
+               count(*) OVER (PARTITION BY custkey ORDER BY orderkey
+                              ROWS BETWEEN 1 FOLLOWING AND 2 FOLLOWING)
+        FROM orders WHERE orderkey < 4000""",
+    "rows_unbounded_following": """
+        SELECT custkey, orderkey,
+               sum(totalprice) OVER (
+                   PARTITION BY custkey ORDER BY orderkey
+                   ROWS BETWEEN CURRENT ROW AND UNBOUNDED FOLLOWING)
+        FROM orders WHERE orderkey < 4000""",
+    "range_unbounded_both": """
+        SELECT custkey, orderkey,
+               count(*) OVER (PARTITION BY custkey ORDER BY orderkey
+                              RANGE BETWEEN UNBOUNDED PRECEDING
+                                        AND UNBOUNDED FOLLOWING)
+        FROM orders WHERE orderkey < 4000""",
+    "min_max_string": """
+        SELECT orderkey,
+               max(orderpriority) OVER (ORDER BY orderkey
+                                        ROWS 3 PRECEDING)
+        FROM orders WHERE orderkey < 800""",
+    "window_over_join": """
+        SELECT o.orderkey,
+               rank() OVER (PARTITION BY o.custkey ORDER BY o.totalprice)
+        FROM orders o JOIN customer c ON o.custkey = c.custkey
+        WHERE c.nationkey < 5 AND o.orderkey < 4000""",
+    "multi_specs": """
+        SELECT orderkey,
+               row_number() OVER (ORDER BY totalprice),
+               sum(totalprice) OVER (PARTITION BY orderpriority
+                                     ORDER BY orderkey)
+        FROM orders WHERE orderkey < 800""",
+    "empty_input": """
+        SELECT orderkey, lag(totalprice) OVER (ORDER BY orderkey)
+        FROM orders WHERE orderkey < 0""",
+}
+
+
+@pytest.mark.parametrize("name", sorted(SHAPES))
+def test_window_shape(runner, name):
+    runner.assert_same_as_reference(SHAPES[name])
+
+
+def test_hand_checked_frames(runner):
+    """Anchor both implementations to hand-computed values (guards against
+    a shared misunderstanding of frame semantics)."""
+    r = runner.execute("""
+        SELECT orderkey,
+               sum(orderkey) OVER (ORDER BY orderkey
+                                   ROWS BETWEEN 1 PRECEDING AND 1 FOLLOWING)
+        FROM orders WHERE orderkey IN (1, 2, 3, 4, 5, 6)
+    """)
+    got = {int(a): int(b) for a, b in r.rows}
+    # rows present: orderkeys 1..6 that exist in tpch data
+    keys = sorted(got)
+    for i, k in enumerate(keys):
+        lo = max(0, i - 1)
+        hi = min(len(keys) - 1, i + 1)
+        assert got[k] == sum(keys[lo:hi + 1]), (k, got[k])
+
+
+def test_ntile_hand_checked(runner):
+    r = runner.execute("""
+        SELECT orderkey, ntile(3) OVER (ORDER BY orderkey)
+        FROM orders WHERE orderkey < 30
+    """)
+    rows = sorted((int(a), int(b)) for a, b in r.rows)
+    n = len(rows)
+    q, rem = divmod(n, 3)
+    sizes = [q + 1] * rem + [q] * (3 - rem)
+    want = []
+    for b, sz in enumerate(sizes, 1):
+        want += [b] * sz
+    assert [b for _, b in rows] == want
