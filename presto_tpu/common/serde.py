@@ -292,17 +292,34 @@ def _checksum(page_data: bytes, markers: int, position_count: int,
     return crc & 0xFFFFFFFF
 
 
-def serialize_page(page: Page, checksummed: bool = True) -> bytes:
+# pages smaller than this are stored raw: compression overhead beats the
+# saved bytes (reference PagesSerde only compresses when the compressed
+# form is < ~95.7% of the original — MAX_COMPRESSION_RATIO)
+MIN_COMPRESS_BYTES = 1 << 12
+
+
+def serialize_page(page: Page, checksummed: bool = True,
+                   compress: bool = False) -> bytes:
+    """Wire-format page (21-byte header + channel data); compress=True
+    deflates the body (zlib — the engine's transport codec; the marker
+    bit and uncompressedSize field follow PageCodecMarker.java:27-29 /
+    PagesSerdeUtil.java:79-88) when it actually shrinks the page."""
     body = io.BytesIO()
     body.write(struct.pack("<i", page.channel_count))
     for b in page.blocks:
         write_block(body, b)
     data = body.getvalue()
+    uncompressed = len(data)
     markers = CHECKSUMMED if checksummed else 0
-    checksum = (_checksum(data, markers, page.position_count, len(data))
+    if compress and uncompressed >= MIN_COMPRESS_BYTES:
+        packed = zlib.compress(data, 1)
+        if len(packed) < uncompressed * 0.957:
+            data = packed
+            markers |= COMPRESSED
+    checksum = (_checksum(data, markers, page.position_count, uncompressed)
                 if checksummed else 0)
     header = struct.pack("<ibiiq", page.position_count, markers,
-                         len(data), len(data), checksum)
+                         uncompressed, len(data), checksum)
     return header + data
 
 
@@ -313,15 +330,21 @@ def deserialize_page(buf: bytes, pos: int = 0):
         "<ibiiq", view, pos)
     pos += PAGE_METADATA_SIZE
     data = view[pos:pos + size]
-    if markers & COMPRESSED:
-        raise NotImplementedError("compressed pages not supported yet")
     if markers & ENCRYPTED:
         raise NotImplementedError("encrypted pages not supported")
     if markers & CHECKSUMMED:
-        actual = _checksum(bytes(data), markers, position_count, uncompressed_size)
+        # checksum covers the wire form (compressed bytes if compressed)
+        actual = _checksum(bytes(data), markers, position_count,
+                           uncompressed_size)
         if actual != (checksum & 0xFFFFFFFF):
             raise ValueError(
                 f"page checksum mismatch: {actual:#x} != {checksum:#x}")
+    if markers & COMPRESSED:
+        data = memoryview(zlib.decompress(bytes(data)))
+        if len(data) != uncompressed_size:
+            raise ValueError(
+                f"decompressed size {len(data)} != header "
+                f"{uncompressed_size}")
     (channels,) = struct.unpack_from("<i", data, 0)
     p = 4
     blocks: List[Block] = []

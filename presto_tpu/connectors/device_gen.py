@@ -211,6 +211,23 @@ def _ds_uniform(table, column, idx, lo, hi):
 
 def _ds_store_sales(column: str, idx, sf: float):
     L = DS.LINES_PER_ORDER
+    if column == "ss_sold_time_sk":
+        return _ds_uniform("store_sales", "time", idx // L, 28800, 75600)
+    if column == "ss_cdemo_sk":
+        return _ds_uniform("store_sales", "cdemo", idx // L, 1,
+                           DS._table_rows("customer_demographics", sf))
+    if column == "ss_hdemo_sk":
+        return _ds_uniform("store_sales", "hdemo", idx // L, 1,
+                           DS._table_rows("household_demographics", sf))
+    if column == "ss_addr_sk":
+        return _ds_uniform("store_sales", "addr", idx // L, 1,
+                           DS._table_rows("customer_address", sf))
+    if column == "ss_ext_list_price":
+        return (_ds_store_sales("ss_list_price", idx, sf)
+                * _ds_store_sales("ss_quantity", idx, sf))
+    if column == "ss_coupon_amt":
+        return _ds_uniform("store_sales", "coupon", idx, 0, 50000) \
+            * (_ds_uniform("store_sales", "hascoup", idx, 0, 9) == 0)
     if column == "ss_sold_date_sk":
         return DS.JULIAN_BASE + _ds_uniform("store_sales", "sold", idx // L,
                                             DS.SALES_MIN, DS.SALES_MAX)
@@ -256,6 +273,9 @@ def _ds_store_sales(column: str, idx, sf: float):
 
 def _ds_web_sales(column: str, idx, sf: float):
     order = idx // DS.LINES_PER_ORDER
+    if column == "ws_ship_mode_sk":
+        return _ds_uniform("web_sales", "shipmode", order, 1,
+                           DS._table_rows("ship_mode", sf))
     if column == "ws_sold_date_sk":
         return DS.JULIAN_BASE + _ds_uniform("web_sales", "sold", order,
                                             DS.SALES_MIN, DS.SALES_MAX)

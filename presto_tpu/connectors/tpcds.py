@@ -96,20 +96,33 @@ YN = ["N", "Y"]
 LINES_PER_ORDER = 3
 
 
+INVENTORY_WEEKS = 261       # weekly snapshots, 1998-01-01 .. 2002-12-31
+
+
 def _table_rows(table: str, sf: float) -> int:
     fixed = {"date_dim": DATE_DIM_ROWS, "web_site": 30, "warehouse": 5,
-             "promotion": 300}
+             "promotion": 300, "ship_mode": 20, "reason": 35,
+             "income_band": 20, "household_demographics": 7_200,
+             "customer_demographics": 1_920_800, "time_dim": 86_400,
+             "call_center": 6, "catalog_page": 11_718, "web_page": 60}
     if table in fixed:
         return fixed[table]
     if table == "store":
         return max(2, int(12 * sf))
+    if table == "inventory":
+        # weekly (item x warehouse) snapshots, spec 2.5 layout
+        return INVENTORY_WEEKS * _table_rows("item", sf) \
+            * _table_rows("warehouse", sf)
     base = {
         "item": 18_000, "customer": 100_000, "customer_address": 50_000,
         "store_sales": 2_880_000, "web_sales": 720_000,
-        "web_returns": 72_000,
+        "web_returns": 72_000, "catalog_sales": 1_440_000,
+        "catalog_returns": 144_000, "store_returns": 288_000,
     }
     floor = {"item": 200, "customer": 1_000, "customer_address": 500,
-             "store_sales": 10_000, "web_sales": 7_200, "web_returns": 720}
+             "store_sales": 10_000, "web_sales": 7_200, "web_returns": 720,
+             "catalog_sales": 9_000, "catalog_returns": 900,
+             "store_returns": 1_000}
     return max(floor[table], int(base[table] * sf))
 
 
@@ -134,7 +147,9 @@ SCHEMAS: Dict[str, List[Tuple[str, Type]]] = {
     ],
     "customer": [
         ("c_customer_sk", BIGINT), ("c_customer_id", VarcharType(16)),
-        ("c_current_addr_sk", BIGINT), ("c_first_name", VarcharType(20)),
+        ("c_current_addr_sk", BIGINT), ("c_current_cdemo_sk", BIGINT),
+        ("c_current_hdemo_sk", BIGINT),
+        ("c_first_name", VarcharType(20)),
         ("c_last_name", VarcharType(30)), ("c_birth_year", INTEGER),
         ("c_birth_month", INTEGER), ("c_birth_country", VarcharType(20)),
         ("c_email_address", VarcharType(50)),
@@ -150,6 +165,8 @@ SCHEMAS: Dict[str, List[Tuple[str, Type]]] = {
         ("s_store_name", VarcharType(50)), ("s_number_employees", INTEGER),
         ("s_floor_space", INTEGER), ("s_market_id", INTEGER),
         ("s_state", VarcharType(2)), ("s_company_id", INTEGER),
+        ("s_city", VarcharType(60)), ("s_county", VarcharType(30)),
+        ("s_zip", VarcharType(10)), ("s_gmt_offset", D5_2),
     ],
     "web_site": [
         ("web_site_sk", BIGINT), ("web_site_id", VarcharType(16)),
@@ -166,16 +183,21 @@ SCHEMAS: Dict[str, List[Tuple[str, Type]]] = {
         ("p_channel_tv", VarcharType(1)),
     ],
     "store_sales": [
-        ("ss_sold_date_sk", BIGINT), ("ss_item_sk", BIGINT),
-        ("ss_customer_sk", BIGINT), ("ss_store_sk", BIGINT),
+        ("ss_sold_date_sk", BIGINT), ("ss_sold_time_sk", BIGINT),
+        ("ss_item_sk", BIGINT),
+        ("ss_customer_sk", BIGINT), ("ss_cdemo_sk", BIGINT),
+        ("ss_hdemo_sk", BIGINT), ("ss_addr_sk", BIGINT),
+        ("ss_store_sk", BIGINT),
         ("ss_promo_sk", BIGINT), ("ss_ticket_number", BIGINT),
         ("ss_quantity", INTEGER), ("ss_wholesale_cost", D7_2),
         ("ss_list_price", D7_2), ("ss_sales_price", D7_2),
         ("ss_ext_discount_amt", D7_2), ("ss_ext_sales_price", D7_2),
+        ("ss_ext_list_price", D7_2), ("ss_coupon_amt", D7_2),
         ("ss_net_paid", D7_2), ("ss_net_profit", D7_2),
     ],
     "web_sales": [
         ("ws_sold_date_sk", BIGINT), ("ws_ship_date_sk", BIGINT),
+        ("ws_ship_mode_sk", BIGINT),
         ("ws_item_sk", BIGINT), ("ws_bill_customer_sk", BIGINT),
         ("ws_ship_addr_sk", BIGINT), ("ws_web_site_sk", BIGINT),
         ("ws_warehouse_sk", BIGINT), ("ws_promo_sk", BIGINT),
@@ -189,6 +211,89 @@ SCHEMAS: Dict[str, List[Tuple[str, Type]]] = {
         ("wr_refunded_customer_sk", BIGINT), ("wr_order_number", BIGINT),
         ("wr_return_quantity", INTEGER), ("wr_return_amt", D7_2),
         ("wr_net_loss", D7_2),
+    ],
+    "store_returns": [
+        ("sr_returned_date_sk", BIGINT), ("sr_item_sk", BIGINT),
+        ("sr_customer_sk", BIGINT), ("sr_cdemo_sk", BIGINT),
+        ("sr_hdemo_sk", BIGINT), ("sr_store_sk", BIGINT),
+        ("sr_reason_sk", BIGINT), ("sr_ticket_number", BIGINT),
+        ("sr_return_quantity", INTEGER), ("sr_return_amt", D7_2),
+        ("sr_net_loss", D7_2),
+    ],
+    "catalog_sales": [
+        ("cs_sold_date_sk", BIGINT), ("cs_ship_date_sk", BIGINT),
+        ("cs_bill_customer_sk", BIGINT), ("cs_bill_cdemo_sk", BIGINT),
+        ("cs_bill_hdemo_sk", BIGINT), ("cs_bill_addr_sk", BIGINT),
+        ("cs_ship_addr_sk", BIGINT), ("cs_call_center_sk", BIGINT),
+        ("cs_catalog_page_sk", BIGINT), ("cs_ship_mode_sk", BIGINT),
+        ("cs_warehouse_sk", BIGINT), ("cs_item_sk", BIGINT),
+        ("cs_promo_sk", BIGINT), ("cs_order_number", BIGINT),
+        ("cs_quantity", INTEGER), ("cs_wholesale_cost", D7_2),
+        ("cs_list_price", D7_2), ("cs_sales_price", D7_2),
+        ("cs_ext_discount_amt", D7_2), ("cs_ext_sales_price", D7_2),
+        ("cs_ext_ship_cost", D7_2), ("cs_net_paid", D7_2),
+        ("cs_net_profit", D7_2),
+    ],
+    "catalog_returns": [
+        ("cr_returned_date_sk", BIGINT), ("cr_item_sk", BIGINT),
+        ("cr_refunded_customer_sk", BIGINT),
+        ("cr_returning_customer_sk", BIGINT),
+        ("cr_call_center_sk", BIGINT), ("cr_reason_sk", BIGINT),
+        ("cr_order_number", BIGINT), ("cr_return_quantity", INTEGER),
+        ("cr_return_amount", D7_2), ("cr_net_loss", D7_2),
+    ],
+    "inventory": [
+        ("inv_date_sk", BIGINT), ("inv_item_sk", BIGINT),
+        ("inv_warehouse_sk", BIGINT), ("inv_quantity_on_hand", INTEGER),
+    ],
+    "catalog_page": [
+        ("cp_catalog_page_sk", BIGINT), ("cp_catalog_page_id", VarcharType(16)),
+        ("cp_department", VarcharType(50)), ("cp_catalog_number", INTEGER),
+        ("cp_catalog_page_number", INTEGER),
+    ],
+    "ship_mode": [
+        ("sm_ship_mode_sk", BIGINT), ("sm_ship_mode_id", VarcharType(16)),
+        ("sm_type", VarcharType(30)), ("sm_code", VarcharType(10)),
+        ("sm_carrier", VarcharType(20)),
+    ],
+    "reason": [
+        ("r_reason_sk", BIGINT), ("r_reason_id", VarcharType(16)),
+        ("r_reason_desc", VarcharType(100)),
+    ],
+    "income_band": [
+        ("ib_income_band_sk", BIGINT), ("ib_lower_bound", INTEGER),
+        ("ib_upper_bound", INTEGER),
+    ],
+    "household_demographics": [
+        ("hd_demo_sk", BIGINT), ("hd_income_band_sk", BIGINT),
+        ("hd_buy_potential", VarcharType(15)), ("hd_dep_count", INTEGER),
+        ("hd_vehicle_count", INTEGER),
+    ],
+    "customer_demographics": [
+        ("cd_demo_sk", BIGINT), ("cd_gender", VarcharType(1)),
+        ("cd_marital_status", VarcharType(1)),
+        ("cd_education_status", VarcharType(20)),
+        ("cd_purchase_estimate", INTEGER),
+        ("cd_credit_rating", VarcharType(10)),
+        ("cd_dep_count", INTEGER), ("cd_dep_employed_count", INTEGER),
+        ("cd_dep_college_count", INTEGER),
+    ],
+    "time_dim": [
+        ("t_time_sk", BIGINT), ("t_time_id", VarcharType(16)),
+        ("t_time", INTEGER), ("t_hour", INTEGER), ("t_minute", INTEGER),
+        ("t_second", INTEGER), ("t_am_pm", VarcharType(2)),
+        ("t_shift", VarcharType(20)), ("t_meal_time", VarcharType(20)),
+    ],
+    "call_center": [
+        ("cc_call_center_sk", BIGINT), ("cc_call_center_id", VarcharType(16)),
+        ("cc_name", VarcharType(50)), ("cc_class", VarcharType(50)),
+        ("cc_employees", INTEGER), ("cc_manager", VarcharType(40)),
+        ("cc_county", VarcharType(30)), ("cc_state", VarcharType(2)),
+    ],
+    "web_page": [
+        ("wp_web_page_sk", BIGINT), ("wp_web_page_id", VarcharType(16)),
+        ("wp_url", VarcharType(100)), ("wp_char_count", INTEGER),
+        ("wp_link_count", INTEGER),
     ],
 }
 
@@ -209,18 +314,28 @@ OPEN_DOMAIN = {
     ("item", "i_item_id"), ("customer", "c_customer_id"),
     ("customer", "c_email_address"), ("customer_address", "ca_address_id"),
     ("customer_address", "ca_zip"), ("store", "s_store_id"),
+    ("store", "s_zip"),
     ("web_site", "web_site_id"), ("promotion", "p_promo_id"),
+    ("catalog_page", "cp_catalog_page_id"), ("ship_mode", "sm_ship_mode_id"),
+    ("reason", "r_reason_id"), ("time_dim", "t_time_id"),
+    ("call_center", "cc_call_center_id"), ("web_page", "wp_web_page_id"),
 }
 ROWID_ORDERED = {
     ("item", "i_item_id"), ("customer", "c_customer_id"),
     ("customer_address", "ca_address_id"), ("store", "s_store_id"),
     ("web_site", "web_site_id"), ("promotion", "p_promo_id"),
+    ("catalog_page", "cp_catalog_page_id"), ("ship_mode", "sm_ship_mode_id"),
+    ("reason", "r_reason_id"), ("time_dim", "t_time_id"),
+    ("call_center", "cc_call_center_id"), ("web_page", "wp_web_page_id"),
 }
 ROWID_DISTINCT = {
     ("item", "i_item_id"), ("customer", "c_customer_id"),
     ("customer", "c_email_address"), ("customer_address", "ca_address_id"),
     ("store", "s_store_id"), ("web_site", "web_site_id"),
     ("promotion", "p_promo_id"),
+    ("catalog_page", "cp_catalog_page_id"), ("ship_mode", "sm_ship_mode_id"),
+    ("reason", "r_reason_id"), ("time_dim", "t_time_id"),
+    ("call_center", "cc_call_center_id"), ("web_page", "wp_web_page_id"),
 }
 
 
@@ -300,6 +415,12 @@ def _gen_item(column: str, idx: np.ndarray, sf: float):
 
 def _gen_customer(column: str, idx: np.ndarray, sf: float):
     sk = idx + 1
+    if column == "c_current_cdemo_sk":
+        return _uniform("customer", "cdemo", idx, 1,
+                        _table_rows("customer_demographics", sf))
+    if column == "c_current_hdemo_sk":
+        return _uniform("customer", "hdemo", idx, 1,
+                        _table_rows("household_demographics", sf))
     if column == "c_customer_sk":
         return sk
     if column == "c_customer_id":
@@ -353,6 +474,17 @@ def _gen_customer_address(column: str, idx: np.ndarray, sf: float):
 
 def _gen_store(column: str, idx: np.ndarray, sf: float):
     sk = idx + 1
+    if column == "s_city":
+        return (_uniform("store", "city", idx, 0,
+                         len(CITIES) - 1).astype(np.int32), CITIES)
+    if column == "s_county":
+        return (_uniform("store", "county", idx, 0,
+                         len(COUNTIES) - 1).astype(np.int32), COUNTIES)
+    if column == "s_zip":
+        z = _uniform("store", "zip", idx, 10000, 99999)
+        return [f"{int(v):05d}" for v in z]
+    if column == "s_gmt_offset":
+        return -100 * _uniform("store", "gmt", idx, 5, 8)
     if column == "s_store_sk":
         return sk
     if column == "s_store_id":
@@ -421,6 +553,24 @@ def _date_sk_from_offset(off: np.ndarray) -> np.ndarray:
 
 
 def _gen_store_sales(column: str, idx: np.ndarray, sf: float):
+    if column == "ss_sold_time_sk":
+        return _uniform("store_sales", "time", idx // LINES_PER_ORDER,
+                        28800, 75600)      # store hours 8:00-21:00
+    if column == "ss_cdemo_sk":
+        return _uniform("store_sales", "cdemo", idx // LINES_PER_ORDER, 1,
+                        _table_rows("customer_demographics", sf))
+    if column == "ss_hdemo_sk":
+        return _uniform("store_sales", "hdemo", idx // LINES_PER_ORDER, 1,
+                        _table_rows("household_demographics", sf))
+    if column == "ss_addr_sk":
+        return _uniform("store_sales", "addr", idx // LINES_PER_ORDER, 1,
+                        _table_rows("customer_address", sf))
+    if column == "ss_ext_list_price":
+        return (_gen_store_sales("ss_list_price", idx, sf)
+                * _gen_store_sales("ss_quantity", idx, sf))
+    if column == "ss_coupon_amt":
+        return _uniform("store_sales", "coupon", idx, 0, 50000) \
+            * (_uniform("store_sales", "hascoup", idx, 0, 9) == 0)
     if column == "ss_sold_date_sk":
         return _date_sk_from_offset(
             _uniform("store_sales", "sold", idx // LINES_PER_ORDER,
@@ -466,6 +616,9 @@ def _gen_store_sales(column: str, idx: np.ndarray, sf: float):
 
 def _gen_web_sales(column: str, idx: np.ndarray, sf: float):
     order = idx // LINES_PER_ORDER
+    if column == "ws_ship_mode_sk":
+        return _uniform("web_sales", "shipmode", order, 1,
+                        _table_rows("ship_mode", sf))
     if column == "ws_sold_date_sk":
         return _date_sk_from_offset(
             _uniform("web_sales", "sold", order, SALES_MIN, SALES_MAX))
@@ -532,12 +685,351 @@ def _gen_web_returns(column: str, idx: np.ndarray, sf: float):
     raise KeyError(column)
 
 
+SM_TYPES = ["EXPRESS", "NEXT DAY", "OVERNIGHT", "REGULAR", "TWO DAY"]
+SM_CODES = ["AIR", "SURFACE", "SEA", "SHIP"]
+SM_CARRIERS = ["UPS", "FEDEX", "AIRBORNE", "USPS", "DHL", "TBS", "ZHOU",
+               "ZOUROS", "MSC", "LATVIAN", "ALLIANCE", "ORIENTAL",
+               "BARIAN", "BOXBUNDLES", "CARGO", "DIAMOND", "RUPEKSA",
+               "GERMA", "HARMSTORF", "PRIVATECARRIER"]
+REASONS = [f"reason {i}" for i in range(1, 36)]
+BUY_POTENTIAL = ["0-500", "501-1000", "1001-5000", "5001-10000",
+                 ">10000", "Unknown"]
+EDUCATION = ["Primary", "Secondary", "College", "2 yr Degree",
+             "4 yr Degree", "Advanced Degree", "Unknown"]
+CREDIT_RATING = ["Low Risk", "Good", "High Risk", "Unknown"]
+DEPARTMENTS = ["DEPARTMENT"]
+CC_NAMES = ["NY Metro", "Mid Atlantic", "North Midwest", "California",
+            "Pacific Northwest", "Central"]
+CC_CLASSES = ["small", "medium", "large"]
+
+
+def _gen_store_returns(column: str, idx: np.ndarray, sf: float):
+    # each return references a deterministic store_sales row (spec: ~10%
+    # of tickets are returned), so returned keys join back to real sales
+    sale = _uniform("store_returns", "sale", idx, 0,
+                    _table_rows("store_sales", sf) - 1)
+    if column == "sr_returned_date_sk":
+        sold = _gen_store_sales("ss_sold_date_sk", sale, sf)
+        return sold + _uniform("store_returns", "lag", idx, 1, 60)
+    if column == "sr_item_sk":
+        return _gen_store_sales("ss_item_sk", sale, sf)
+    if column == "sr_customer_sk":
+        return _gen_store_sales("ss_customer_sk", sale, sf)
+    if column == "sr_cdemo_sk":
+        return _gen_store_sales("ss_cdemo_sk", sale, sf)
+    if column == "sr_hdemo_sk":
+        return _gen_store_sales("ss_hdemo_sk", sale, sf)
+    if column == "sr_store_sk":
+        return _gen_store_sales("ss_store_sk", sale, sf)
+    if column == "sr_ticket_number":
+        return _gen_store_sales("ss_ticket_number", sale, sf)
+    if column == "sr_reason_sk":
+        return _uniform("store_returns", "reason", idx, 1,
+                        _table_rows("reason", sf))
+    if column == "sr_return_quantity":
+        return _uniform("store_returns", "qty", idx, 1, 50)
+    if column == "sr_return_amt":
+        return _uniform("store_returns", "amt", idx, 100, 500000)
+    if column == "sr_net_loss":
+        return _uniform("store_returns", "loss", idx, 50, 100000)
+    raise KeyError(column)
+
+
+def _gen_catalog_sales(column: str, idx: np.ndarray, sf: float):
+    order = idx // LINES_PER_ORDER
+    if column == "cs_sold_date_sk":
+        return _date_sk_from_offset(
+            _uniform("catalog_sales", "sold", order, SALES_MIN, SALES_MAX))
+    if column == "cs_ship_date_sk":
+        sold = _uniform("catalog_sales", "sold", order,
+                        SALES_MIN, SALES_MAX)
+        return _date_sk_from_offset(sold) \
+            + _uniform("catalog_sales", "lag", idx, 2, 90)
+    if column == "cs_bill_customer_sk":
+        return _uniform("catalog_sales", "cust", order, 1,
+                        _table_rows("customer", sf))
+    if column == "cs_bill_cdemo_sk":
+        return _uniform("catalog_sales", "cdemo", order, 1,
+                        _table_rows("customer_demographics", sf))
+    if column == "cs_bill_hdemo_sk":
+        return _uniform("catalog_sales", "hdemo", order, 1,
+                        _table_rows("household_demographics", sf))
+    if column == "cs_bill_addr_sk":
+        return _uniform("catalog_sales", "baddr", order, 1,
+                        _table_rows("customer_address", sf))
+    if column == "cs_ship_addr_sk":
+        return _uniform("catalog_sales", "saddr", order, 1,
+                        _table_rows("customer_address", sf))
+    if column == "cs_call_center_sk":
+        return _uniform("catalog_sales", "cc", order, 1,
+                        _table_rows("call_center", sf))
+    if column == "cs_catalog_page_sk":
+        return _uniform("catalog_sales", "page", idx, 1,
+                        _table_rows("catalog_page", sf))
+    if column == "cs_ship_mode_sk":
+        return _uniform("catalog_sales", "shipmode", order, 1,
+                        _table_rows("ship_mode", sf))
+    if column == "cs_warehouse_sk":
+        return _uniform("catalog_sales", "wh", idx, 1,
+                        _table_rows("warehouse", sf))
+    if column == "cs_item_sk":
+        return _uniform("catalog_sales", "item", idx, 1,
+                        _table_rows("item", sf))
+    if column == "cs_promo_sk":
+        return _uniform("catalog_sales", "promo", idx, 1,
+                        _table_rows("promotion", sf))
+    if column == "cs_order_number":
+        return order + 1
+    if column == "cs_quantity":
+        return _uniform("catalog_sales", "qty", idx, 1, 100)
+    if column == "cs_wholesale_cost":
+        return _uniform("catalog_sales", "wholesale", idx, 100, 10000)
+    if column == "cs_list_price":
+        w = _gen_catalog_sales("cs_wholesale_cost", idx, sf)
+        return w + w * _uniform("catalog_sales", "markup", idx, 0, 200) // 100
+    if column == "cs_sales_price":
+        lp = _gen_catalog_sales("cs_list_price", idx, sf)
+        return lp * _uniform("catalog_sales", "dscnt", idx, 20, 100) // 100
+    if column == "cs_ext_discount_amt":
+        lp = _gen_catalog_sales("cs_list_price", idx, sf)
+        sp = _gen_catalog_sales("cs_sales_price", idx, sf)
+        return (lp - sp) * _gen_catalog_sales("cs_quantity", idx, sf)
+    if column == "cs_ext_sales_price":
+        return (_gen_catalog_sales("cs_sales_price", idx, sf)
+                * _gen_catalog_sales("cs_quantity", idx, sf))
+    if column == "cs_ext_ship_cost":
+        return _uniform("catalog_sales", "shipc", idx, 0, 50000)
+    if column == "cs_net_paid":
+        return _gen_catalog_sales("cs_ext_sales_price", idx, sf)
+    if column == "cs_net_profit":
+        q = _gen_catalog_sales("cs_quantity", idx, sf)
+        w = _gen_catalog_sales("cs_wholesale_cost", idx, sf)
+        return _gen_catalog_sales("cs_net_paid", idx, sf) - q * w
+    raise KeyError(column)
+
+
+def _gen_catalog_returns(column: str, idx: np.ndarray, sf: float):
+    sale = _uniform("catalog_returns", "sale", idx, 0,
+                    _table_rows("catalog_sales", sf) - 1)
+    if column == "cr_returned_date_sk":
+        sold = _gen_catalog_sales("cs_sold_date_sk", sale, sf)
+        return sold + _uniform("catalog_returns", "lag", idx, 1, 60)
+    if column == "cr_item_sk":
+        return _gen_catalog_sales("cs_item_sk", sale, sf)
+    if column == "cr_refunded_customer_sk":
+        return _gen_catalog_sales("cs_bill_customer_sk", sale, sf)
+    if column == "cr_returning_customer_sk":
+        # 80% returned by the buyer, else a random customer
+        buyer = _gen_catalog_sales("cs_bill_customer_sk", sale, sf)
+        other = _uniform("catalog_returns", "other", idx, 1,
+                         _table_rows("customer", sf))
+        same = _uniform("catalog_returns", "same", idx, 0, 9) < 8
+        return np.where(same, buyer, other)
+    if column == "cr_call_center_sk":
+        return _gen_catalog_sales("cs_call_center_sk", sale, sf)
+    if column == "cr_reason_sk":
+        return _uniform("catalog_returns", "reason", idx, 1,
+                        _table_rows("reason", sf))
+    if column == "cr_order_number":
+        return _gen_catalog_sales("cs_order_number", sale, sf)
+    if column == "cr_return_quantity":
+        return _uniform("catalog_returns", "qty", idx, 1, 50)
+    if column == "cr_return_amount":
+        return _uniform("catalog_returns", "amt", idx, 100, 500000)
+    if column == "cr_net_loss":
+        return _uniform("catalog_returns", "loss", idx, 50, 100000)
+    raise KeyError(column)
+
+
+def _gen_inventory(column: str, idx: np.ndarray, sf: float):
+    n_wh = _table_rows("warehouse", sf)
+    n_item = _table_rows("item", sf)
+    if column == "inv_warehouse_sk":
+        return idx % n_wh + 1
+    if column == "inv_item_sk":
+        return (idx // n_wh) % n_item + 1
+    if column == "inv_date_sk":
+        week = idx // (n_wh * n_item)
+        return JULIAN_BASE + (_days("1998-01-01") - EPOCH_1900) + week * 7
+    if column == "inv_quantity_on_hand":
+        return _uniform("inventory", "qoh", idx, 0, 1000)
+    raise KeyError(column)
+
+
+def _gen_catalog_page(column: str, idx: np.ndarray, sf: float):
+    sk = idx + 1
+    if column == "cp_catalog_page_sk":
+        return sk
+    if column == "cp_catalog_page_id":
+        return [f"AAAAAAAA{int(v):08d}" for v in sk]
+    if column == "cp_department":
+        return (np.zeros(len(idx), dtype=np.int32), DEPARTMENTS)
+    if column == "cp_catalog_number":
+        return idx // 108 + 1
+    if column == "cp_catalog_page_number":
+        return idx % 108 + 1
+    raise KeyError(column)
+
+
+def _gen_ship_mode(column: str, idx: np.ndarray, sf: float):
+    sk = idx + 1
+    if column == "sm_ship_mode_sk":
+        return sk
+    if column == "sm_ship_mode_id":
+        return [f"AAAAAAAA{int(v):08d}" for v in sk]
+    if column == "sm_type":
+        return ((idx % len(SM_TYPES)).astype(np.int32), SM_TYPES)
+    if column == "sm_code":
+        return ((idx // 5 % len(SM_CODES)).astype(np.int32), SM_CODES)
+    if column == "sm_carrier":
+        return ((idx % len(SM_CARRIERS)).astype(np.int32), SM_CARRIERS)
+    raise KeyError(column)
+
+
+def _gen_reason(column: str, idx: np.ndarray, sf: float):
+    sk = idx + 1
+    if column == "r_reason_sk":
+        return sk
+    if column == "r_reason_id":
+        return [f"AAAAAAAA{int(v):08d}" for v in sk]
+    if column == "r_reason_desc":
+        return ((idx % len(REASONS)).astype(np.int32), REASONS)
+    raise KeyError(column)
+
+
+def _gen_income_band(column: str, idx: np.ndarray, sf: float):
+    if column == "ib_income_band_sk":
+        return idx + 1
+    if column == "ib_lower_bound":
+        return idx * 10000 + 1
+    if column == "ib_upper_bound":
+        return (idx + 1) * 10000
+    raise KeyError(column)
+
+
+def _gen_household_demographics(column: str, idx: np.ndarray, sf: float):
+    # cross product: income_band(20) x buy_potential(6) x dep(10) x veh(6)
+    if column == "hd_demo_sk":
+        return idx + 1
+    if column == "hd_income_band_sk":
+        return idx % 20 + 1
+    if column == "hd_buy_potential":
+        return ((idx // 20 % 6).astype(np.int32), BUY_POTENTIAL)
+    if column == "hd_dep_count":
+        return idx // 120 % 10
+    if column == "hd_vehicle_count":
+        return idx // 1200 % 6 - 1       # -1..4 per spec
+    raise KeyError(column)
+
+
+def _gen_customer_demographics(column: str, idx: np.ndarray, sf: float):
+    # spec layout: cross product over gender(2) x marital(5) x
+    # education(7) x purchase_estimate(20) x credit(4) x deps(7) x ...
+    if column == "cd_demo_sk":
+        return idx + 1
+    if column == "cd_gender":
+        return ((idx % 2).astype(np.int32), ["M", "F"])
+    if column == "cd_marital_status":
+        return ((idx // 2 % 5).astype(np.int32), ["M", "S", "D", "W", "U"])
+    if column == "cd_education_status":
+        return ((idx // 10 % 7).astype(np.int32), EDUCATION)
+    if column == "cd_purchase_estimate":
+        return (idx // 70 % 20 + 1) * 500
+    if column == "cd_credit_rating":
+        return ((idx // 1400 % 4).astype(np.int32), CREDIT_RATING)
+    if column == "cd_dep_count":
+        return idx // 5600 % 7
+    if column == "cd_dep_employed_count":
+        return idx // 39200 % 7
+    if column == "cd_dep_college_count":
+        return idx // 274400 % 7
+    raise KeyError(column)
+
+
+def _gen_time_dim(column: str, idx: np.ndarray, sf: float):
+    if column == "t_time_sk":
+        return idx
+    if column == "t_time_id":
+        return [f"AAAAAAAA{int(v):08d}" for v in idx]
+    if column == "t_time":
+        return idx
+    if column == "t_hour":
+        return idx // 3600
+    if column == "t_minute":
+        return idx // 60 % 60
+    if column == "t_second":
+        return idx % 60
+    if column == "t_am_pm":
+        return ((idx // 43200).astype(np.int32), ["AM", "PM"])
+    if column == "t_shift":
+        return ((idx // 28800).astype(np.int32),
+                ["third", "first", "second"])
+    if column == "t_meal_time":
+        h = idx // 3600
+        code = np.where((h >= 6) & (h <= 8), 1,
+                        np.where((h >= 11) & (h <= 13), 2,
+                                 np.where((h >= 17) & (h <= 19), 3, 0)))
+        return (code.astype(np.int32),
+                ["", "breakfast", "lunch", "dinner"])
+    raise KeyError(column)
+
+
+def _gen_call_center(column: str, idx: np.ndarray, sf: float):
+    sk = idx + 1
+    if column == "cc_call_center_sk":
+        return sk
+    if column == "cc_call_center_id":
+        return [f"AAAAAAAA{int(v):08d}" for v in sk]
+    if column == "cc_name":
+        return ((idx % len(CC_NAMES)).astype(np.int32), CC_NAMES)
+    if column == "cc_class":
+        return ((idx % len(CC_CLASSES)).astype(np.int32), CC_CLASSES)
+    if column == "cc_employees":
+        return _uniform("call_center", "emp", idx, 1, 7)
+    if column == "cc_manager":
+        return (_uniform("call_center", "mgr", idx, 0,
+                         len(FIRST_NAMES) - 1).astype(np.int32), FIRST_NAMES)
+    if column == "cc_county":
+        return (_uniform("call_center", "county", idx, 0,
+                         len(COUNTIES) - 1).astype(np.int32), COUNTIES)
+    if column == "cc_state":
+        return (_uniform("call_center", "state", idx, 0,
+                         len(STATES) - 1).astype(np.int32), STATES)
+    raise KeyError(column)
+
+
+def _gen_web_page(column: str, idx: np.ndarray, sf: float):
+    sk = idx + 1
+    if column == "wp_web_page_sk":
+        return sk
+    if column == "wp_web_page_id":
+        return [f"AAAAAAAA{int(v):08d}" for v in sk]
+    if column == "wp_url":
+        return (np.zeros(len(idx), dtype=np.int32),
+                ["http://www.foo.com"])
+    if column == "wp_char_count":
+        return _uniform("web_page", "chars", idx, 100, 8000)
+    if column == "wp_link_count":
+        return _uniform("web_page", "links", idx, 2, 25)
+    raise KeyError(column)
+
+
 _GENERATORS = {
     "date_dim": _gen_date_dim, "item": _gen_item, "customer": _gen_customer,
     "customer_address": _gen_customer_address, "store": _gen_store,
     "web_site": _gen_web_site, "warehouse": _gen_warehouse,
     "promotion": _gen_promotion, "store_sales": _gen_store_sales,
     "web_sales": _gen_web_sales, "web_returns": _gen_web_returns,
+    "store_returns": _gen_store_returns,
+    "catalog_sales": _gen_catalog_sales,
+    "catalog_returns": _gen_catalog_returns,
+    "inventory": _gen_inventory, "catalog_page": _gen_catalog_page,
+    "ship_mode": _gen_ship_mode, "reason": _gen_reason,
+    "income_band": _gen_income_band,
+    "household_demographics": _gen_household_demographics,
+    "customer_demographics": _gen_customer_demographics,
+    "time_dim": _gen_time_dim, "call_center": _gen_call_center,
+    "web_page": _gen_web_page,
 }
 
 

@@ -757,16 +757,13 @@ def _eval_call(expr: CallExpression, t: Table) -> Col:
             if an.dtype == object else an
         return (ops[name](an, bn), m)
     if name == "between":
-        lo = _eval_call(CallExpression("gte", expr.type,
-                                       [args[0], args[1]]), t)
-        hi = _eval_call(CallExpression("lte", expr.type,
-                                       [args[0], args[2]]), t)
-        v = lo[0] & hi[0]
-        m = None
-        if lo[1] is not None or hi[1] is not None:
-            m = (lo[1] if lo[1] is not None else 0) | \
-                (hi[1] if hi[1] is not None else 0)
-        return (v, m)
+        # Kleene: x BETWEEN lo AND hi == (x >= lo) AND (x <= hi); a NULL
+        # bound still yields FALSE when the other comparison is FALSE
+        # (fuzzer-found: the old null-if-any-null shortcut was wrong)
+        return _eval_special(SpecialFormExpression(
+            "AND", expr.type,
+            [CallExpression("gte", expr.type, [args[0], args[1]]),
+             CallExpression("lte", expr.type, [args[0], args[2]])]), t)
     if name == "not":
         v, m = _eval(args[0], t)
         return (~v.astype(bool), m)

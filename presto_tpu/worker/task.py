@@ -137,15 +137,18 @@ class TpuTask:
                 self.memory_peak = ctx.memory.peak
                 if self.state in DONE_STATES:
                     return
+                compress = ctx.config.exchange_compression
                 if partitioned:
                     targets = partition_targets(page, out_types, key_indices,
                                                 n_parts)
                     for p, sub in enumerate(
                             split_page(page, targets, n_parts)):
                         if sub is not None:
-                            self.buffers.add(p, serialize_page(sub))
+                            self.buffers.add(
+                                p, serialize_page(sub, compress=compress))
                 else:
-                    self.buffers.add(0, serialize_page(page))
+                    self.buffers.add(
+                        0, serialize_page(page, compress=compress))
             self.memory_peak = ctx.memory.peak
             self.buffers.set_complete()
             self._set_state(FINISHED)

@@ -1,0 +1,260 @@
+"""TPC-DS query conformance bank (VERDICT item 8): 20 official-shaped
+queries over the full 24-table schema, engine vs numpy oracle at SF0.01
+(differential strategy per SURVEY.md §4.3; reference suite:
+presto-tpcds/ + presto-native-tests).
+
+Query texts follow the official TPC-DS shapes with the standard
+validation substitutions, adapted to the generated schema's column
+subset (connectors/tpcds.py documents the layout).
+"""
+import pytest
+
+from presto_tpu.exec.pipeline import ExecutionConfig
+from presto_tpu.exec.runner import LocalQueryRunner
+
+
+@pytest.fixture(scope="module")
+def runner():
+    return LocalQueryRunner("sf0.01", catalog="tpcds",
+                            config=ExecutionConfig(
+                                batch_rows=1 << 14,
+                                join_out_capacity=1 << 16))
+
+
+QUERIES = {
+    "q03": """
+        SELECT d_year, i_brand_id, i_brand,
+               sum(ss_ext_sales_price) AS sum_agg
+        FROM date_dim, store_sales, item
+        WHERE d_date_sk = ss_sold_date_sk AND ss_item_sk = i_item_sk
+          AND i_manufact_id = 128 AND d_moy = 11
+        GROUP BY d_year, i_brand_id, i_brand
+        ORDER BY d_year, sum_agg DESC, i_brand_id
+        LIMIT 100""",
+    "q07": """
+        SELECT i_item_id, avg(ss_quantity) AS agg1,
+               avg(ss_list_price) AS agg2, avg(ss_coupon_amt) AS agg3,
+               avg(ss_sales_price) AS agg4
+        FROM store_sales, customer_demographics, date_dim, item, promotion
+        WHERE ss_sold_date_sk = d_date_sk AND ss_item_sk = i_item_sk
+          AND ss_cdemo_sk = cd_demo_sk AND ss_promo_sk = p_promo_sk
+          AND cd_gender = 'M' AND cd_marital_status = 'S'
+          AND cd_education_status = 'College'
+          AND (p_channel_email = 'N' OR p_channel_tv = 'N')
+          AND d_year = 2000
+        GROUP BY i_item_id ORDER BY i_item_id LIMIT 100""",
+    "q19": """
+        SELECT i_brand_id, i_brand, i_manufact_id,
+               sum(ss_ext_sales_price) AS ext_price
+        FROM date_dim, store_sales, item, customer, customer_address, store
+        WHERE d_date_sk = ss_sold_date_sk AND ss_item_sk = i_item_sk
+          AND i_manager_id = 8 AND d_moy = 11 AND d_year = 1998
+          AND ss_customer_sk = c_customer_sk
+          AND c_current_addr_sk = ca_address_sk
+          AND ss_store_sk = s_store_sk
+          AND ca_state <> s_state
+        GROUP BY i_brand_id, i_brand, i_manufact_id
+        ORDER BY ext_price DESC, i_brand_id LIMIT 100""",
+    "q26": """
+        SELECT i_item_id, avg(cs_quantity) AS agg1,
+               avg(cs_list_price) AS agg2, avg(cs_sales_price) AS agg3
+        FROM catalog_sales, customer_demographics, date_dim, item
+        WHERE cs_sold_date_sk = d_date_sk AND cs_item_sk = i_item_sk
+          AND cs_bill_cdemo_sk = cd_demo_sk
+          AND cd_gender = 'F' AND cd_marital_status = 'W'
+          AND cd_education_status = 'Primary' AND d_year = 2000
+        GROUP BY i_item_id ORDER BY i_item_id LIMIT 100""",
+    "q37": """
+        SELECT i_item_id, i_current_price, count(*) AS cnt
+        FROM item, inventory, date_dim, catalog_sales
+        WHERE i_current_price BETWEEN 20 AND 50
+          AND inv_item_sk = i_item_sk
+          AND d_date_sk = inv_date_sk AND d_year = 2000
+          AND inv_quantity_on_hand BETWEEN 100 AND 500
+          AND cs_item_sk = i_item_sk
+        GROUP BY i_item_id, i_current_price
+        ORDER BY i_item_id LIMIT 100""",
+    "q43": """
+        SELECT s_store_name, s_store_id,
+               sum(CASE WHEN d_day_name = 'Sunday'
+                        THEN ss_sales_price ELSE NULL END) AS sun_sales,
+               sum(CASE WHEN d_day_name = 'Monday'
+                        THEN ss_sales_price ELSE NULL END) AS mon_sales,
+               sum(CASE WHEN d_day_name = 'Friday'
+                        THEN ss_sales_price ELSE NULL END) AS fri_sales
+        FROM date_dim, store_sales, store
+        WHERE d_date_sk = ss_sold_date_sk AND s_store_sk = ss_store_sk
+          AND d_year = 2000
+        GROUP BY s_store_name, s_store_id
+        ORDER BY s_store_name, s_store_id LIMIT 100""",
+    "q52": """
+        SELECT d_year, i_brand_id, i_brand,
+               sum(ss_ext_sales_price) AS ext_price
+        FROM date_dim, store_sales, item
+        WHERE d_date_sk = ss_sold_date_sk AND ss_item_sk = i_item_sk
+          AND i_manager_id = 1 AND d_moy = 11 AND d_year = 2000
+        GROUP BY d_year, i_brand_id, i_brand
+        ORDER BY d_year, ext_price DESC, i_brand_id LIMIT 100""",
+    "q55": """
+        SELECT i_brand_id, i_brand, sum(ss_ext_sales_price) AS ext_price
+        FROM date_dim, store_sales, item
+        WHERE d_date_sk = ss_sold_date_sk AND ss_item_sk = i_item_sk
+          AND i_manager_id = 28 AND d_moy = 11 AND d_year = 1999
+        GROUP BY i_brand_id, i_brand
+        ORDER BY ext_price DESC, i_brand_id LIMIT 100""",
+    "q62": """
+        SELECT w_warehouse_name, sm_type, web_name,
+               sum(CASE WHEN ws_ship_date_sk - ws_sold_date_sk <= 30
+                        THEN 1 ELSE 0 END) AS d30,
+               sum(CASE WHEN ws_ship_date_sk - ws_sold_date_sk > 30
+                         AND ws_ship_date_sk - ws_sold_date_sk <= 60
+                        THEN 1 ELSE 0 END) AS d60,
+               sum(CASE WHEN ws_ship_date_sk - ws_sold_date_sk > 120
+                        THEN 1 ELSE 0 END) AS dmore
+        FROM web_sales, warehouse, ship_mode, web_site, date_dim
+        WHERE d_month_seq BETWEEN 1200 AND 1211
+          AND ws_ship_date_sk = d_date_sk
+          AND ws_warehouse_sk = w_warehouse_sk
+          AND ws_ship_mode_sk = sm_ship_mode_sk
+          AND ws_web_site_sk = web_site_sk
+        GROUP BY w_warehouse_name, sm_type, web_name
+        ORDER BY w_warehouse_name, sm_type, web_name LIMIT 100""",
+    "q65": """
+        SELECT s_store_name, i_item_id, sb.revenue
+        FROM store, item,
+             (SELECT ss_store_sk AS store_sk, ss_item_sk AS item_sk,
+                     sum(ss_sales_price) AS revenue
+              FROM store_sales, date_dim
+              WHERE ss_sold_date_sk = d_date_sk
+                AND d_month_seq BETWEEN 1176 AND 1187
+              GROUP BY ss_store_sk, ss_item_sk) sb
+        WHERE sb.store_sk = s_store_sk AND sb.item_sk = i_item_sk
+          AND sb.revenue > 490000
+        ORDER BY s_store_name, i_item_id LIMIT 100""",
+    "q82": """
+        SELECT i_item_id, i_current_price, count(*) AS cnt
+        FROM item, inventory, date_dim, store_sales
+        WHERE i_current_price BETWEEN 30 AND 60
+          AND inv_item_sk = i_item_sk
+          AND d_date_sk = inv_date_sk AND d_year = 1999
+          AND inv_quantity_on_hand BETWEEN 100 AND 500
+          AND ss_item_sk = i_item_sk
+        GROUP BY i_item_id, i_current_price
+        ORDER BY i_item_id LIMIT 100""",
+    "q84": """
+        SELECT c_customer_id, c_last_name, c_first_name
+        FROM customer, customer_address, customer_demographics,
+             household_demographics, income_band, store_returns
+        WHERE ca_city = 'Pleasant Hill'
+          AND c_current_addr_sk = ca_address_sk
+          AND ib_income_band_sk = hd_income_band_sk
+          AND ib_lower_bound >= 30000 AND ib_upper_bound <= 70000
+          AND cd_demo_sk = c_current_cdemo_sk
+          AND hd_demo_sk = c_current_hdemo_sk
+          AND sr_cdemo_sk = cd_demo_sk
+        ORDER BY c_customer_id LIMIT 100""",
+    "q89": """
+        SELECT i_category, i_class, s_store_name, d_moy,
+               sum(ss_sales_price) AS sum_sales,
+               avg(sum(ss_sales_price)) OVER (
+                   PARTITION BY i_category, i_class, s_store_name)
+                   AS avg_monthly_sales
+        FROM item, store_sales, date_dim, store
+        WHERE ss_item_sk = i_item_sk AND ss_sold_date_sk = d_date_sk
+          AND ss_store_sk = s_store_sk AND d_year = 1999
+          AND i_category IN ('Books', 'Electronics', 'Sports')
+        GROUP BY i_category, i_class, s_store_name, d_moy
+        ORDER BY i_category, i_class, s_store_name, d_moy LIMIT 100""",
+    "q91": """
+        SELECT cc_name, cc_manager, sum(cr_net_loss) AS net_loss
+        FROM call_center, catalog_returns, date_dim, customer,
+             customer_demographics, household_demographics
+        WHERE cr_call_center_sk = cc_call_center_sk
+          AND cr_returned_date_sk = d_date_sk
+          AND cr_returning_customer_sk = c_customer_sk
+          AND cd_demo_sk = c_current_cdemo_sk
+          AND hd_demo_sk = c_current_hdemo_sk
+          AND d_year = 1999 AND d_moy = 11
+          AND cd_marital_status = 'M' AND cd_education_status = 'Unknown'
+          AND hd_buy_potential LIKE 'Unknown%'
+        GROUP BY cc_name, cc_manager
+        ORDER BY net_loss DESC, cc_name""",
+    "q96": """
+        SELECT count(*) AS cnt
+        FROM store_sales, household_demographics, time_dim, store
+        WHERE ss_sold_time_sk = t_time_sk AND ss_hdemo_sk = hd_demo_sk
+          AND ss_store_sk = s_store_sk
+          AND t_hour = 20 AND t_minute >= 30 AND hd_dep_count = 7
+          AND s_store_name = 'ese'""",
+    "q98": """
+        SELECT i_item_id, i_category, i_class, i_current_price,
+               sum(ss_ext_sales_price) AS itemrevenue,
+               sum(ss_ext_sales_price) * 100
+                   / sum(sum(ss_ext_sales_price)) OVER
+                     (PARTITION BY i_class) AS revenueratio
+        FROM store_sales, item, date_dim
+        WHERE ss_item_sk = i_item_sk
+          AND i_category IN ('Sports', 'Books', 'Home')
+          AND ss_sold_date_sk = d_date_sk
+          AND d_date BETWEEN DATE '1999-02-22' AND DATE '1999-03-24'
+        GROUP BY i_item_id, i_category, i_class, i_current_price
+        ORDER BY i_category, i_class, i_item_id LIMIT 100""",
+    "q99": """
+        SELECT w_warehouse_name, sm_type, cc_name,
+               sum(CASE WHEN cs_ship_date_sk - cs_sold_date_sk <= 30
+                        THEN 1 ELSE 0 END) AS d30,
+               sum(CASE WHEN cs_ship_date_sk - cs_sold_date_sk > 30
+                         AND cs_ship_date_sk - cs_sold_date_sk <= 60
+                        THEN 1 ELSE 0 END) AS d60,
+               sum(CASE WHEN cs_ship_date_sk - cs_sold_date_sk > 120
+                        THEN 1 ELSE 0 END) AS dmore
+        FROM catalog_sales, warehouse, ship_mode, call_center, date_dim
+        WHERE d_month_seq BETWEEN 1200 AND 1211
+          AND cs_ship_date_sk = d_date_sk
+          AND cs_warehouse_sk = w_warehouse_sk
+          AND cs_ship_mode_sk = sm_ship_mode_sk
+          AND cs_call_center_sk = cc_call_center_sk
+        GROUP BY w_warehouse_name, sm_type, cc_name
+        ORDER BY w_warehouse_name, sm_type, cc_name LIMIT 100""",
+    "q25_shape": """
+        SELECT i_item_id, i_item_sk, sum(ss_net_profit) AS store_profit,
+               sum(sr_net_loss) AS return_loss
+        FROM store_sales, store_returns, item
+        WHERE ss_item_sk = i_item_sk AND sr_item_sk = i_item_sk
+          AND ss_customer_sk = sr_customer_sk
+          AND ss_ticket_number = sr_ticket_number
+        GROUP BY i_item_id, i_item_sk
+        ORDER BY i_item_id, i_item_sk LIMIT 100""",
+    "q16_shape_exists": """
+        SELECT count(DISTINCT cs_order_number) AS order_count,
+               sum(cs_ext_ship_cost) AS total_ship
+        FROM catalog_sales, date_dim, customer_address, call_center
+        WHERE d_date >= DATE '2002-02-01' AND d_date < DATE '2002-04-01'
+          AND cs_ship_date_sk = d_date_sk
+          AND cs_ship_addr_sk = ca_address_sk AND ca_state = 'GA'
+          AND cs_call_center_sk = cc_call_center_sk
+          AND EXISTS (SELECT 1 FROM catalog_returns
+                      WHERE cs_order_number = cr_order_number)""",
+    "q42_full": """
+        SELECT d_year, i_category_id, i_category,
+               sum(ss_ext_sales_price) AS total
+        FROM date_dim, store_sales, item
+        WHERE d_date_sk = ss_sold_date_sk AND ss_item_sk = i_item_sk
+          AND i_manager_id = 1 AND d_moy = 11 AND d_year = 2000
+        GROUP BY d_year, i_category_id, i_category
+        ORDER BY total DESC, d_year, i_category_id LIMIT 100""",
+    "q93_shape": """
+        SELECT ss_customer_sk, sum(ss_sales_price) AS sumsales
+        FROM store_sales
+        JOIN store_returns ON ss_item_sk = sr_item_sk
+                          AND ss_ticket_number = sr_ticket_number
+        JOIN reason ON sr_reason_sk = r_reason_sk
+        WHERE r_reason_desc = 'reason 28'
+        GROUP BY ss_customer_sk
+        ORDER BY sumsales DESC, ss_customer_sk LIMIT 100""",
+}
+
+
+@pytest.mark.parametrize("name", sorted(QUERIES))
+def test_tpcds_query(runner, name):
+    runner.assert_same_as_reference(QUERIES[name])
