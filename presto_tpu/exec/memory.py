@@ -80,7 +80,8 @@ class PartitionedSpillStore:
     keys to equal bucket indices, which is what the grace hash join and
     partitioned aggregation rely on."""
 
-    def __init__(self, k: int, salt: int = _SPILL_SALT):
+    def __init__(self, k: int, salt: int = _SPILL_SALT,
+                 budget_bytes: Optional[int] = None):
         self.k = k
         self.salt = salt
         self.buckets: List[List[Dict[str, Tuple[np.ndarray,
@@ -90,6 +91,9 @@ class PartitionedSpillStore:
         self.rows = [0] * k
         self.bytes = [0] * k
         self.spilled_bytes = 0
+        # host-RAM ceiling for staged rows: spilling must not itself OOM
+        # the host (reference spiller's max-spill-size); None = unlimited
+        self.budget_bytes = budget_bytes
 
     def add(self, batch: Batch, key_names: List[str]) -> None:
         key_cols = [batch.columns[n] for n in key_names]
@@ -114,6 +118,12 @@ class PartitionedSpillStore:
                      for v, m in rows.values())
             self.bytes[p] += nb
             self.spilled_bytes += nb
+            if self.budget_bytes is not None \
+                    and self.spilled_bytes > self.budget_bytes:
+                raise MemoryExceededError(
+                    f"spill store exceeds host budget "
+                    f"{self.budget_bytes} bytes "
+                    f"({self.spilled_bytes} staged)")
 
     def bucket_batches(self, p: int, capacity: int) -> Iterator[Batch]:
         """Re-upload bucket p as device Batches of at most `capacity` rows."""

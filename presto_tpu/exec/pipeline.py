@@ -133,6 +133,9 @@ class ExecutionConfig:
     memory_budget_bytes: Optional[int] = None   # None = unlimited
     spill_enabled: bool = True
     spill_partitions: int = 8
+    # host-RAM ceiling for spill staging (None = unlimited); enforced by
+    # PartitionedSpillStore so spilling cannot OOM the host
+    spill_budget_bytes: Optional[int] = None
     # compile scan→filter/project→direct-agg chains into ONE XLA program
     # (fori_loop over split chunks): eliminates per-batch dispatch overhead
     fuse_pipelines: bool = True
@@ -1257,7 +1260,8 @@ class PlanCompiler:
             # budget too small for one table: hash-partition the input by
             # group keys into host-staged buckets and aggregate per bucket
             # (buckets hold disjoint key sets, so each finalize is exact)
-            store = PartitionedSpillStore(cfg.spill_partitions)
+            store = PartitionedSpillStore(cfg.spill_partitions,
+                                  budget_bytes=cfg.spill_budget_bytes)
             encode_keys: Optional[List[str]] = None
             for batch in self._compile(src_node).batches():
                 if encode_keys is None:
@@ -1398,6 +1402,54 @@ class PlanCompiler:
             return Batch(cols, build_batch.mask & ~matched) \
                 .select(out_names)
 
+        # dynamic filtering (reference DynamicFilterSourceOperator): once
+        # the build side is materialized, its per-key min/max narrows the
+        # probe stream before the (more expensive) probe step; counted in
+        # EXPLAIN ANALYZE stats as dynamicFilterRowsDropped
+        df_cache: dict = {}
+
+        def make_dynamic_filter(build_batch):
+            if not node.dynamic_filters or build_batch is None:
+                return None
+            pairs = [(l.name, r.name) for l, r in node.criteria]
+            numeric = [(ln, rn) for ln, rn in pairs
+                       if build_batch.columns[rn].dictionary is None
+                       and build_batch.columns[rn].lazy is None
+                       and jnp.issubdtype(
+                           build_batch.columns[rn].values.dtype,
+                           jnp.integer)]
+            if not numeric:
+                return None
+            if "fn" not in df_cache:
+                names = tuple(rn for _ln, rn in numeric)
+                probe_names = tuple(ln for ln, _rn in numeric)
+
+                @jax.jit
+                def bounds(bb):
+                    out = []
+                    for rn in names:
+                        c = bb.columns[rn]
+                        m = bb.mask if c.nulls is None                             else bb.mask & ~c.nulls
+                        v = c.values
+                        out.append((
+                            jnp.min(jnp.where(m, v, jnp.iinfo(v.dtype).max)),
+                            jnp.max(jnp.where(m, v, jnp.iinfo(v.dtype).min))))
+                    return out
+
+                @jax.jit
+                def apply(batch, bnds):
+                    keep = batch.mask
+                    for (ln, lohis) in zip(probe_names, bnds):
+                        lo, hi = lohis
+                        v = batch.columns[ln].values
+                        keep = keep & (v >= lo) & (v <= hi)
+                    return batch.with_mask(keep)
+
+                df_cache["fn"] = (bounds, apply)
+            bounds, apply = df_cache["fn"]
+            bnds = bounds(build_batch)
+            return lambda batch: apply(batch, bnds)
+
         def gen():
             pool = self.ctx.memory
             from .fused import fused_stream
@@ -1407,7 +1459,18 @@ class PlanCompiler:
                     yield b.select(out_names)
                 return
 
-            def probe_stream(table, batches, build_batch=None):
+            def probe_stream(table, batches, build_batch=None,
+                             dyn_filter=None):
+                stats_ent = None
+                if dyn_filter is not None and self.ctx.stats is not None:
+                    stats_ent = self.ctx.stats.setdefault(
+                        node.id, {"rows": 0, "wall_s": 0.0, "batches": 0})
+                    stats_ent.setdefault("dynamicFilterRowsDropped", 0)
+                batches = iter(batches)
+                batches = _apply_dyn_filter(batches, dyn_filter, stats_ent)
+                yield from _probe_stream_inner(table, batches, build_batch)
+
+            def _probe_stream_inner(table, batches, build_batch=None):
                 # matched is threaded through for FULL joins; the build
                 # rows nobody matched are emitted null-extended at the end
                 matched = (jnp.zeros(build_batch.capacity, dtype=bool)
@@ -1458,7 +1521,8 @@ class PlanCompiler:
                             raise MemoryExceededError(
                                 f"join build side exceeds memory budget "
                                 f"{pool.budget} bytes and spill is disabled")
-                        spill = PartitionedSpillStore(cfg.spill_partitions)
+                        spill = PartitionedSpillStore(cfg.spill_partitions,
+                                              budget_bytes=cfg.spill_budget_bytes)
                         for cb in collected:
                             spill.add(cb, build_keys)
                         collected = []
@@ -1481,15 +1545,17 @@ class PlanCompiler:
                     table = _jits()[1](
                         _drop_null_keys(build_batch, tuple(build_keys)),
                         tuple(build_keys))
-                    yield from probe_stream(table, probe.batches(),
-                                            build_batch)
+                    yield from probe_stream(
+                        table, probe.batches(), build_batch,
+                        dyn_filter=make_dynamic_filter(build_batch))
                     return
                 # grace path: partition the probe the same way, join
                 # bucket-by-bucket (each bucket is a Lifespan).  A bucket
                 # whose build side still exceeds the budget is RE-partitioned
                 # with a fresh hash salt (recursive grace join); only a
                 # bucket that stops shrinking — single-key skew — fails.
-                probe_store = PartitionedSpillStore(cfg.spill_partitions)
+                probe_store = PartitionedSpillStore(cfg.spill_partitions,
+                                      budget_bytes=cfg.spill_budget_bytes)
                 for b in self._compile(probe_src_node).batches():
                     probe_store.add(b, probe_keys)
                 work = [(spill, probe_store, p, 0)
@@ -1521,12 +1587,14 @@ class PlanCompiler:
                                 f"exceeds memory budget {pool.budget} after "
                                 f"{depth} re-partitions (key skew)")
                         salt2 = bstore.salt * 33 + 0x9E37
-                        sub_b = PartitionedSpillStore(cfg.spill_partitions,
-                                                      salt2)
+                        sub_b = PartitionedSpillStore(
+                            cfg.spill_partitions, salt2,
+                            budget_bytes=cfg.spill_budget_bytes)
                         for bb in bstore.bucket_batches(p, cfg.batch_rows):
                             sub_b.add(bb, build_keys)
-                        sub_p = PartitionedSpillStore(cfg.spill_partitions,
-                                                      salt2)
+                        sub_p = PartitionedSpillStore(
+                            cfg.spill_partitions, salt2,
+                            budget_bytes=cfg.spill_budget_bytes)
                         for pb in pstore.bucket_batches(p, cfg.batch_rows):
                             sub_p.add(pb, probe_keys)
                         work.extend((sub_b, sub_p, q, depth + 1)
@@ -1839,6 +1907,20 @@ def _concat_batches(batches: List[Batch]) -> Batch:
         cols[n] = Column(values, nulls, first.dictionary, first.lazy)
     mask = jnp.concatenate([b.mask for b in batches])
     return Batch(cols, mask)
+
+
+def _apply_dyn_filter(batches, dyn_filter, stats_ent):
+    """Apply a dynamic filter to a probe stream, tracking dropped rows
+    when EXPLAIN ANALYZE stats are enabled."""
+    for b in batches:
+        if dyn_filter is None:
+            yield b
+            continue
+        nb = dyn_filter(b)
+        if stats_ent is not None:
+            before, after = jax.device_get((b.mask.sum(), nb.mask.sum()))
+            stats_ent["dynamicFilterRowsDropped"] += int(before) - int(after)
+        yield nb
 
 
 def _split_batch(batch: Batch) -> List[Batch]:

@@ -58,6 +58,24 @@ class LocalQueryRunner:
 
     _PLAN_CACHE_MAX = 64
 
+    def _plan_or_cached(self, sql: str, ast, stats):
+        """Pop the cached (output plan, compiler) for `sql` or plan it
+        fresh; callers re-insert via _recache after a successful run."""
+        entry = self._plan_cache.pop(sql, None)
+        if entry is None:
+            with stats.record_wall("queryPlan"):
+                output = Planner(default_schema=self.schema,
+                                 default_catalog=self.catalog) \
+                    .plan_query_to_output(ast)
+                entry = (output,
+                         PlanCompiler(TaskContext(config=self.config)))
+        return entry
+
+    def _recache(self, sql: str, entry) -> None:
+        self._plan_cache[sql] = entry
+        while len(self._plan_cache) > self._PLAN_CACHE_MAX:
+            self._plan_cache.pop(next(iter(self._plan_cache)))
+
     def execute(self, sql: str) -> QueryResult:
         from ..sql import parser as A
         from ..utils.runtime_stats import RuntimeStats
@@ -72,14 +90,7 @@ class LocalQueryRunner:
             return self._explain(ast)
         if isinstance(ast, (A.CreateTableAs, A.InsertInto, A.DropTable)):
             return self._execute_ddl(ast)
-        entry = self._plan_cache.pop(sql, None)
-        if entry is None:
-            with stats.record_wall("queryPlan"):
-                output = Planner(default_schema=self.schema,
-                                 default_catalog=self.catalog) \
-                    .plan_query_to_output(ast)
-                entry = (output,
-                         PlanCompiler(TaskContext(config=self.config)))
+        entry = self._plan_or_cached(sql, ast, stats)
         if tracer:
             tracer.add_point("query planned")
         output, compiler = entry
@@ -93,10 +104,42 @@ class LocalQueryRunner:
             tracer.end_trace("query finished")
         # cache only after a successful run (a failed run may leave the
         # compiler's memory pool / partial state poisoned); bounded LRU
-        self._plan_cache[sql] = entry
-        while len(self._plan_cache) > self._PLAN_CACHE_MAX:
-            self._plan_cache.pop(next(iter(self._plan_cache)))
+        self._recache(sql, entry)
         return result
+
+    def execute_streaming(self, sql: str):
+        """(columns-meta, row iterator) for a plain SELECT — pages are
+        decoded and yielded as they are produced, so callers (the
+        statement protocol) never hold the full result set (reference
+        Query.java:116 streams from the root-stage ExchangeClient).
+        Returns None for statements that need materialized execution
+        (DDL / EXPLAIN)."""
+        from ..sql import parser as A
+        from ..utils.runtime_stats import RuntimeStats
+        stats = RuntimeStats()
+        with stats.record_wall("queryParse"):
+            ast = A.parse_sql(sql)
+        if isinstance(ast, (A.Explain, A.CreateTableAs, A.InsertInto,
+                            A.DropTable)):
+            return None
+        entry = self._plan_or_cached(sql, ast, stats)
+        output, compiler = entry
+        names = output.column_names
+        types = [v.type for v in output.outputs]
+        columns = [{"name": n, "type": str(t)}
+                   for n, t in zip(names, types)]
+
+        def rows():
+            from ..common.block import block_to_values
+            with stats.record_wall("queryExecute"):
+                for page in compiler.run_to_pages(output):
+                    cols = [block_to_values(t, b)
+                            for t, b in zip(types, page.blocks)]
+                    for i in range(page.position_count):
+                        yield [c[i] for c in cols]
+            # cache only after a fully successful drain (mirrors execute)
+            self._recache(sql, entry)
+        return columns, rows(), stats
 
     def _execute_ddl(self, ast) -> QueryResult:
         """CREATE TABLE AS / INSERT INTO / DROP TABLE (reference

@@ -298,6 +298,10 @@ class JoinNode(PlanNode):
     outputs: List[Variable]
     filter: Optional[RowExpression] = None
     distribution: Optional[str] = None  # PARTITIONED / REPLICATED
+    # dynamic filter id per probe key (reference JoinNode.dynamicFilters /
+    # DynamicFilterSourceOperator): the executor narrows the probe side to
+    # the build side's key domain before probing
+    dynamic_filters: Dict[str, str] = field(default_factory=dict)
 
     @property
     def sources(self):
@@ -314,7 +318,8 @@ class JoinNode(PlanNode):
                              for l, r in self.criteria],
                 "outputVariables": _vars_to_dict(self.outputs),
                 "filter": self.filter.to_dict() if self.filter else None,
-                "distributionType": self.distribution}
+                "distributionType": self.distribution,
+                "dynamicFilters": dict(self.dynamic_filters)}
 
     @classmethod
     def _from_dict(cls, d):
@@ -324,7 +329,8 @@ class JoinNode(PlanNode):
                     for c in d["criteria"]],
                    _vars_from_dict(d["outputVariables"]),
                    RowExpression.from_dict(d["filter"]) if d.get("filter") else None,
-                   d.get("distributionType"))
+                   d.get("distributionType"),
+                   d.get("dynamicFilters", {}))
 
 
 @_node

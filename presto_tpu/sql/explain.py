@@ -40,6 +40,10 @@ def _details(node: P.PlanNode) -> str:
     if isinstance(node, P.JoinNode):
         crit = ", ".join(f"{l.name} = {r.name}" for l, r in node.criteria)
         extra = f", filter = {node.filter}" if node.filter is not None else ""
+        if node.dynamic_filters:
+            dfs = ", ".join(f"{df}:{v}" for v, df in
+                            sorted(node.dynamic_filters.items()))
+            extra += f", dynamicFilters = [{dfs}]"
         return f"type = {node.join_type}, criteria = [{crit}]{extra}"
     if isinstance(node, P.SemiJoinNode):
         return (f"{node.source_join_variable.name} IN "

@@ -185,6 +185,24 @@ def determine_join_sides(root: P.PlanNode,
     return root
 
 
+def plan_dynamic_filters(root: P.PlanNode) -> P.PlanNode:
+    """Annotate inner hash joins with dynamic filters (reference
+    DynamicFilterSourceOperator + LocalDynamicFilter planning): each
+    equi-join key gets a filter id; at execution the build side's key
+    domain (min/max) narrows the probe stream before the probe
+    (exec/pipeline.py probe_stream)."""
+    n = 0
+    for node in P.walk_plan(root):
+        if isinstance(node, P.JoinNode) and node.criteria \
+                and node.join_type == P.INNER:
+            node.dynamic_filters = {
+                l.name: f"df_{n}_{i}"
+                for i, (l, _r) in enumerate(node.criteria)}
+            n += 1
+    return root
+
+
 def optimize(root: P.PlanNode) -> P.PlanNode:
     root = prune_unused_outputs(root)
-    return determine_join_sides(root)
+    root = determine_join_sides(root)
+    return plan_dynamic_filters(root)

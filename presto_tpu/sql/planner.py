@@ -436,6 +436,41 @@ class Planner:
                 planned[i] = (node, rscope, jt, on)
         remaining = [c for c in where_conjuncts if c not in consumed_where]
 
+        # Pure comma-join lists (q8/q9-class) can name relations in an
+        # order that forces a cross join mid-tree (part, supplier,
+        # lineitem: part x supplier share no predicate).  Reorder greedily
+        # by predicate connectivity — each next relation must share an
+        # equi-conjunct with the joined prefix when any such relation
+        # exists (reference ReorderJoins, reduced to the connectivity
+        # heuristic).  Explicit JOIN ... ON syntax keeps its order.
+        if len(planned) > 2 and all(jt == "INNER" and on is None
+                                    for _n, _s, jt, on in planned):
+            plain = [c for c in where_conjuncts if not _has_subquery(c)]
+
+            def connects(i, chosen) -> bool:
+                chosen_sc = Scope([planned[k][1] for k in chosen])
+                both_sc = Scope([planned[k][1] for k in chosen]
+                                + [planned[i][1]])
+                own_sc = Scope([planned[i][1]])
+                for c in plain:
+                    if _resolvable(self, c, both_sc) \
+                            and not _resolvable(self, c, chosen_sc) \
+                            and not _resolvable(self, c, own_sc):
+                        return True
+                return False
+
+            order = [0]
+            left = set(range(1, len(planned)))
+            while left:
+                nxt = next((i for i in sorted(left)
+                            if connects(i, order)), None)
+                if nxt is None:
+                    nxt = min(left)
+                order.append(nxt)
+                left.discard(nxt)
+            if order != list(range(len(planned))):
+                planned = [planned[i] for i in order]
+
         # build left-deep join tree in FROM order
         node, rscope, _, _ = planned[0]
         scopes = [rscope]

@@ -106,6 +106,40 @@ class TaskUpdateRequest:
             d.get("session", {}))
 
 
+def from_reference_update(task_id: str, d: dict) -> "TaskUpdateRequest":
+    """Accept an HttpRemoteTask-shaped TaskUpdateRequest
+    (presto_protocol_core.h:807: session/extraCredentials/fragment/
+    sources/outputIds/tableWriteInfo) and map it onto the worker's compact
+    internal request.  Output partitioning keys are not carried by the
+    reference OutputBuffers — the task derives them from the fragment's
+    partitioning scheme (same seam as PrestoToVeloxQueryPlan).  The task
+    index (AssignUniqueId namespacing) comes from the reference taskId's
+    partition component (queryId.stageId.stageExecutionId.partition.attempt,
+    TaskId.java)."""
+    from .presto_protocol import TaskUpdateRequest as RefUpdate
+    ref = RefUpdate.from_json(d)
+    parts = task_id.split(".")
+    try:
+        task_index = int(parts[3]) if len(parts) >= 4 else 0
+    except ValueError:
+        task_index = 0
+    sources = []
+    for ts in ref.sources:
+        splits = []
+        for s in ts.splits:
+            sp = s.split or {}
+            splits.append(sp.get("connectorSplit", sp))
+        sources.append(TaskSource(ts.planNodeId, splits, ts.noMoreSplits))
+    bufs = ref.outputIds.buffers
+    n_buffers = (max(int(v) for v in bufs.values()) + 1) if bufs else 1
+    ob = OutputBuffersSpec(
+        "BROADCAST" if ref.outputIds.type == "BROADCAST"
+        else "PARTITIONED", n_buffers, [])
+    session = dict(ref.session.systemProperties)
+    return TaskUpdateRequest(task_id, task_index, ref.fragment, sources,
+                             ob, session)
+
+
 @dataclass
 class TaskStatus:
     task_id: str
@@ -117,16 +151,25 @@ class TaskStatus:
     completed_drivers: int = 0
 
     def to_dict(self):
-        return {"taskId": self.task_id, "state": self.state,
-                "version": self.version, "self": self.self_uri,
-                "failures": self.failures,
-                "memoryReservationInBytes": self.memory_reservation,
-                "completedDrivers": self.completed_drivers}
+        # reference-shaped TaskStatus fields (presto_protocol_core.h:2358:
+        # failures are ExecutionFailureInfo-shaped dicts) merged with the
+        # compact extra fields in-repo clients read
+        from .presto_protocol import TaskStatus as RefStatus
+        ref = RefStatus(
+            version=self.version, state=self.state, self_uri=self.self_uri,
+            failures=[{"message": f, "type": "TASK_FAILURE"}
+                      for f in self.failures],
+            memoryReservationInBytes=self.memory_reservation).to_json()
+        ref.update({"taskId": self.task_id,
+                    "completedDrivers": self.completed_drivers})
+        return ref
 
     @staticmethod
     def from_dict(d):
+        failures = [f["message"] if isinstance(f, dict) else f
+                    for f in d.get("failures", [])]
         return TaskStatus(d["taskId"], d["state"], d["version"], d["self"],
-                          d.get("failures", []),
+                          failures,
                           d.get("memoryReservationInBytes", 0),
                           d.get("completedDrivers", 0))
 

@@ -368,7 +368,13 @@ collapse}}td,th{{border:1px solid #ccc;padding:4px 8px;text-align:left}}
             # draining node refuses new work; the coordinator reroutes
             self._send(503, {"error": "node is shutting down"})
             return
-        update = TaskUpdateRequest.from_dict(json.loads(self._body()))
+        body = json.loads(self._body())
+        if "outputIds" in body or "extraCredentials" in body:
+            # reference-shaped request (HttpRemoteTask.java:883-936)
+            from .protocol import from_reference_update
+            update = from_reference_update(groups["task"], body)
+        else:
+            update = TaskUpdateRequest.from_dict(body)
         status = self.server_ref.task_manager.create_or_update(update)
         self._send(200, status.to_dict())
 
@@ -382,10 +388,7 @@ collapse}}td,th{{border:1px solid #ccc;padding:4px 8px;text-align:left}}
 
     def do_task_info(self, groups, query):
         task = self.server_ref.task_manager.get(groups["task"])
-        status = task.status()
-        self._send(200, {"taskId": task.task_id,
-                         "taskStatus": status.to_dict(),
-                         "noMoreSplits": True})
+        self._send(200, task.info())
 
     def do_task_delete(self, groups, query):
         task = self.server_ref.task_manager.get(groups["task"])
@@ -519,6 +522,18 @@ class WorkerServer:
                 self._runner_cache[key] = runner
                 while len(self._runner_cache) > 16:
                     self._runner_cache.pop(next(iter(self._runner_cache)))
+        if not uris and hasattr(runner, "execute_streaming"):
+            # single-node SELECTs stream chunk-by-chunk: the coordinator
+            # never materializes the full result (reference Query.java
+            # pumps the root-stage buffer)
+            sr = runner.execute_streaming(q.sql)
+            if sr is not None:
+                from .statement import StreamingResult, _json_value
+                columns, row_iter, stats = sr
+                return StreamingResult(
+                    columns,
+                    ([_json_value(v) for v in row] for row in row_iter),
+                    stats)
         result = runner.execute(q.sql)
         if q.sql.lstrip()[:6].lower() in ("create", "insert") \
                 or q.sql.lstrip()[:4].lower() == "drop":

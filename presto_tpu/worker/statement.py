@@ -133,6 +133,17 @@ class ResourceGroupManager:
 
 
 @dataclass
+class StreamingResult:
+    """Executor return value for streamed results: rows are pulled chunk
+    by chunk as the client advances tokens, so the coordinator never
+    materializes the full result set (reference Query.java streams from
+    the root-stage buffer via its ExchangeClient)."""
+    columns: List[dict]
+    row_iter: object            # iterator of JSON-ready row lists
+    stats: object = None        # RuntimeStats-like (to_dict), read at drain
+
+
+@dataclass
 class ManagedQuery:
     query_id: str
     sql: str
@@ -154,6 +165,15 @@ class ManagedQuery:
     done: threading.Event = field(default_factory=threading.Event)
     _cancelled: bool = False
     _admitted: bool = False     # holds a resource-group running slot
+    # streaming result state (StreamingResult executors)
+    _row_iter: object = None
+    _stats_src: object = None
+    _iter_lock: threading.Lock = field(default_factory=threading.Lock)
+    _chunks: dict = field(default_factory=dict)
+    _max_token: int = -1
+    _drained: bool = False
+    rows_served: int = 0
+    last_access: float = field(default_factory=time.time)
 
     def stats(self) -> dict:
         now = self.finished_at or time.time()
@@ -185,9 +205,25 @@ class DispatchManager:
         self._lock = threading.Lock()
 
     # -- intake -----------------------------------------------------------
+    # a streaming query whose client stopped polling is canceled so its
+    # resource-group slot frees (the reference's client abandonment
+    # timeout, query.client.timeout)
+    ABANDONED_AFTER_S = 300.0
+
+    def _reap_abandoned(self) -> None:
+        now = time.time()
+        with self._lock:
+            stale = [q for q in self._queries.values()
+                     if q._row_iter is not None and not q.done.is_set()
+                     and now - q.last_access > self.ABANDONED_AFTER_S]
+        for q in stale:
+            q._cancelled = True
+            self._finish(q, CANCELED, "client abandoned the query")
+
     def submit(self, sql: str, user: str = "user", source: str = "",
                session: Optional[Dict[str, str]] = None,
                catalog: str = "tpch", schema: str = "sf0.01") -> ManagedQuery:
+        self._reap_abandoned()
         qid = f"{time.strftime('%Y%m%d_%H%M%S')}_{next(_query_ids):05d}"
         q = ManagedQuery(qid, sql, user, source, dict(session or {}),
                          catalog, schema)
@@ -228,6 +264,14 @@ class DispatchManager:
         while True:
             try:
                 result = self._executor(q)
+                if isinstance(result, StreamingResult):
+                    # rows are pulled lazily by executing_response; the
+                    # query finishes (and frees its resource-group slot)
+                    # when the client drains the iterator
+                    q.columns = result.columns
+                    q._stats_src = result.stats
+                    q._row_iter = iter(result.row_iter)
+                    return
                 q.columns = [{"name": n, "type": str(t)}
                              for n, t in zip(result.column_names,
                                              result.column_types)]
@@ -256,6 +300,8 @@ class DispatchManager:
                 return
 
     def _finish(self, q: ManagedQuery, state: str, error: Optional[str]):
+        if q.done.is_set():
+            return
         q.state = state
         if state == CANCELED and error is None:
             error = "Query was canceled"   # clients must not see success
@@ -313,8 +359,62 @@ class DispatchManager:
                                f"{q.query_id}/{q.slug}/0")
         return resp
 
+    # chunks retained behind the client's token (re-GET of the current
+    # token must work; anything older is gone, like the reference's
+    # acknowledged pages)
+    _CHUNK_KEEP = 2
+
+    def _ensure_chunk(self, q: ManagedQuery, token: int) -> None:
+        """Pull rows from the streaming iterator until chunk `token`
+        exists or the stream is drained; forget acknowledged chunks."""
+        while not q._drained and q._max_token < token:
+            rows = list(itertools.islice(q._row_iter,
+                                         self.RESULT_CHUNK_ROWS))
+            if not rows:
+                q._drained = True
+                if q._stats_src is not None:
+                    q.runtime_stats = q._stats_src.to_dict()
+                break
+            q._max_token += 1
+            q._chunks[q._max_token] = rows
+            q.rows_served += len(rows)
+        for t in [t for t in q._chunks if t < token - self._CHUNK_KEEP + 1]:
+            del q._chunks[t]
+
+    def _executing_streaming(self, q: ManagedQuery, token: int,
+                             base_uri: str) -> dict:
+        resp = {"id": q.query_id,
+                "infoUri": f"{base_uri}/v1/query/{q.query_id}",
+                "stats": q.stats()}
+        with q._iter_lock:
+            try:
+                self._ensure_chunk(q, token)
+            except Exception as e:  # noqa: BLE001 — surfaces to client
+                self._finish(q, FAILED, f"{type(e).__name__}: {e}")
+        if q.state in (FAILED, CANCELED):
+            if q.error:
+                resp["error"] = {
+                    "message": q.error,
+                    "errorName": ("USER_CANCELED" if q.state == CANCELED
+                                  else "QUERY_FAILED")}
+            return resp
+        resp["columns"] = q.columns
+        chunk = q._chunks.get(token)
+        if chunk:
+            resp["data"] = chunk
+        if q._drained and token >= q._max_token:
+            self._finish(q, FINISHED, None)
+            resp["stats"] = q.stats()     # reflect the final state
+        else:
+            resp["nextUri"] = (f"{base_uri}/v1/statement/executing/"
+                               f"{q.query_id}/{q.slug}/{token + 1}")
+        return resp
+
     def executing_response(self, q: ManagedQuery, token: int,
                            base_uri: str, wait_s: float = 0.5) -> dict:
+        q.last_access = time.time()
+        if q._row_iter is not None:
+            return self._executing_streaming(q, token, base_uri)
         if not q.done.is_set():
             q.done.wait(wait_s)
         resp = {"id": q.query_id,

@@ -39,8 +39,41 @@ class TpuTask:
         self.buffers: Optional[OutputBufferManager] = None
         self.done_at: Optional[float] = None
         self.memory_peak = 0
+        # TaskInfo stats surface (reference TaskInfo/TaskStats): the
+        # coordinator-side aggregation and UI drill-down consume these
+        import time as _t
+        self.created_at = _t.time()
+        self.output_rows = 0
+        self.output_pages = 0
+        self.output_bytes = 0
+        self.plan_nodes: List[dict] = []
         self._cond = threading.Condition()
         self._thread: Optional[threading.Thread] = None
+
+    def info(self) -> dict:
+        """TaskInfo payload (reference TaskInfo.java shape, scoped to the
+        fields our coordinator consumes: status + task-level stats + the
+        fragment's plan-node inventory)."""
+        import time as _t
+        status = self.status()
+        return {
+            "taskId": self.task_id,
+            "taskStatus": status.to_dict(),
+            "noMoreSplits": True,
+            "stats": {
+                "createTime": self.created_at,
+                "elapsedTimeInNanos": int(
+                    (_t.time() - self.created_at) * 1e9),
+                "outputPositions": self.output_rows,
+                "outputDataSizeInBytes": self.output_bytes,
+                "bufferedPages": self.output_pages,
+                "peakTotalMemoryInBytes": self.memory_peak,
+                "state": self.state,
+            },
+            "pipelines": [{
+                "operators": self.plan_nodes,
+            }],
+        }
 
     # -- state ------------------------------------------------------------
     def _set_state(self, state: str, failure: Optional[str] = None) -> None:
@@ -125,10 +158,26 @@ class TpuTask:
 
     def _run(self, fragment: P.PlanFragment, spec, ctx: TaskContext) -> None:
         try:
+            self.plan_nodes = [
+                {"planNodeId": n.id, "operatorType": type(n).__name__}
+                for n in P.walk_plan(fragment.root)]
             out_vars = fragment.root.output_variables
             out_types = [v.type for v in out_vars]
             out_names = [v.name for v in out_vars]
-            key_indices = [out_names.index(k) for k in spec.partition_keys]
+            keys = spec.partition_keys
+            if keys:
+                # explicit keys: a name the fragment doesn't output is a
+                # malformed update and must fail loudly
+                key_indices = [out_names.index(k) for k in keys]
+            else:
+                # reference-shaped updates carry no keys in OutputBuffers:
+                # the fragment's own partitioning scheme defines them
+                scheme = getattr(fragment, "output_partitioning_scheme",
+                                 None)
+                key_indices = [out_names.index(a.name)
+                               for a in (scheme.arguments if scheme
+                                         else [])
+                               if a.name in out_names]
             n_parts = len(self.buffers.buffers)
             partitioned = (spec.type == "PARTITIONED" and n_parts > 1
                            and key_indices)
@@ -137,6 +186,7 @@ class TpuTask:
                 self.memory_peak = ctx.memory.peak
                 if self.state in DONE_STATES:
                     return
+                self.output_rows += page.position_count
                 compress = ctx.config.exchange_compression
                 if partitioned:
                     targets = partition_targets(page, out_types, key_indices,
@@ -144,11 +194,15 @@ class TpuTask:
                     for p, sub in enumerate(
                             split_page(page, targets, n_parts)):
                         if sub is not None:
-                            self.buffers.add(
-                                p, serialize_page(sub, compress=compress))
+                            data = serialize_page(sub, compress=compress)
+                            self.output_pages += 1
+                            self.output_bytes += len(data)
+                            self.buffers.add(p, data)
                 else:
-                    self.buffers.add(
-                        0, serialize_page(page, compress=compress))
+                    data = serialize_page(page, compress=compress)
+                    self.output_pages += 1
+                    self.output_bytes += len(data)
+                    self.buffers.add(0, data)
             self.memory_peak = ctx.memory.peak
             self.buffers.set_complete()
             self._set_state(FINISHED)

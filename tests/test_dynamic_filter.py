@@ -1,0 +1,57 @@
+"""Dynamic filtering (VERDICT item 5; reference
+DynamicFilterSourceOperator + LocalDynamicFilter planning): inner hash
+joins are annotated with per-key dynamic filters at plan time, and the
+streaming executor narrows the probe stream to the build side's key
+domain before probing.
+"""
+import pytest
+
+from presto_tpu.exec.pipeline import ExecutionConfig, PlanCompiler, TaskContext
+from presto_tpu.exec.runner import LocalQueryRunner
+
+Q5ISH = """
+SELECT n.name, sum(l.extendedprice * (1 - l.discount)) AS revenue
+FROM customer c, orders o, lineitem l, supplier s, nation n, region r
+WHERE c.custkey = o.custkey AND l.orderkey = o.orderkey
+  AND l.suppkey = s.suppkey AND c.nationkey = s.nationkey
+  AND s.nationkey = n.nationkey AND n.regionkey = r.regionkey
+  AND r.name = 'ASIA' AND o.orderdate >= DATE '1994-01-01'
+  AND o.orderdate < DATE '1995-01-01'
+GROUP BY n.name ORDER BY revenue DESC
+"""
+
+Q17ISH = """
+SELECT sum(l.extendedprice) AS total
+FROM lineitem l, part p
+WHERE p.partkey = l.partkey AND p.brand = 'Brand#23'
+  AND p.container = 'MED BOX'
+  AND l.quantity < (SELECT 0.2 * avg(l2.quantity) FROM lineitem l2
+                    WHERE l2.partkey = l.partkey)
+"""
+
+
+def test_plan_shows_dynamic_filters():
+    r = LocalQueryRunner("sf0.01")
+    for sql in (Q5ISH, Q17ISH):
+        plan = r.execute("EXPLAIN " + sql).rows[0][0]
+        assert "dynamicFilters = [" in plan, plan
+
+
+def test_streaming_probe_row_reduction():
+    """With fusion off (streaming executor), the dynamic filter must both
+    preserve results and measurably drop probe rows (EXPLAIN ANALYZE
+    exposes dynamicFilterRowsDropped per join)."""
+    cfg = ExecutionConfig(batch_rows=1 << 13, join_out_capacity=1 << 15,
+                          fuse_pipelines=False)
+    r = LocalQueryRunner("sf0.01", config=cfg)
+    r.assert_same_as_reference(Q5ISH)
+
+    # run the plan with per-node stats and inspect the counters
+    plan = r.plan(Q5ISH)
+    stats = {}
+    compiler = PlanCompiler(TaskContext(config=cfg, stats=stats))
+    for _ in compiler.run_to_pages(plan):
+        pass
+    dropped = sum(e.get("dynamicFilterRowsDropped", 0)
+                  for e in stats.values())
+    assert dropped > 0, f"no probe rows dropped: {stats}"

@@ -1,0 +1,129 @@
+"""Reference-shaped protocol DTO conformance (VERDICT item 4): the DTOs
+in worker/presto_protocol.py round-trip the REFERENCE's own JSON test
+fixtures (presto-native-execution/presto_cpp/main/tests/data/), read
+from the reference tree at test time, and an HttpRemoteTask-shaped
+TaskUpdateRequest drives a live worker end to end.
+"""
+import base64
+import json
+import os
+import time
+
+import pytest
+
+from presto_tpu.worker import presto_protocol as PP
+
+FIXTURES = ("/root/reference/presto-native-execution/presto_cpp/"
+            "main/tests/data")
+
+needs_fixtures = pytest.mark.skipif(
+    not os.path.isdir(FIXTURES), reason="reference fixtures not present")
+
+
+@needs_fixtures
+def test_task_status_round_trips_reference_fixture():
+    with open(os.path.join(FIXTURES, "TaskInfo.json")) as f:
+        ref = json.load(f)
+    status = PP.TaskStatus.from_json(ref["taskStatus"])
+    out = status.to_json()
+    for k, v in ref["taskStatus"].items():
+        assert out[k] == v, (k, out.get(k), v)
+    assert set(out) == set(ref["taskStatus"])
+
+
+def test_update_request_round_trip():
+    req = PP.TaskUpdateRequest(
+        session=PP.SessionRepresentation(
+            queryId="q1", user="alice", catalog="tpch", schema="sf0.01",
+            systemProperties={"query_max_memory": "1GB"}),
+        extraCredentials={"token": "t"},
+        fragment=base64.b64encode(b"{}").decode(),
+        sources=[PP.TaskSource("scan.0", [
+            PP.ScheduledSplit(7, "scan.0",
+                              {"connectorId": "tpch",
+                               "connectorSplit": {"table": "lineitem",
+                                                  "sf": 0.01,
+                                                  "start": 0, "end": 10}})])],
+        outputIds=PP.OutputBuffers("PARTITIONED", 3, True,
+                                   {"0": 0, "1": 1}))
+    d = req.to_json()
+    back = PP.TaskUpdateRequest.from_json(d)
+    assert back.to_json() == d
+    assert back.session.systemProperties == {"query_max_memory": "1GB"}
+    assert back.sources[0].splits[0].sequenceId == 7
+
+
+def test_worker_accepts_reference_shaped_update():
+    """POST a reference-shaped TaskUpdateRequest (session/sources/
+    outputIds/fragment, HttpRemoteTask.java:883-936) to a live worker and
+    pull SerializedPage results — coordinator interop end to end."""
+    import threading
+    import urllib.request
+    from presto_tpu.common.serde import deserialize_page
+    from presto_tpu.common.block import block_to_values
+    from presto_tpu.common.types import BIGINT
+    from presto_tpu.sql.planner import Planner
+    from presto_tpu.sql.fragmenter import FragmenterConfig, plan_distributed
+    from presto_tpu.worker.server import WorkerServer
+
+    w = WorkerServer()
+    t = threading.Thread(target=w.httpd.serve_forever, daemon=True)
+    t.start()
+    try:
+        out = Planner(default_schema="sf0.01", default_catalog="tpch") \
+            .plan("SELECT count(*) AS n FROM nation")
+        sub = plan_distributed(out, FragmenterConfig())
+        # leaf fragment of the subplan tree
+        frag = (sub.children[0].fragment if sub.children else sub.fragment)
+        from presto_tpu.connectors import catalog as cat
+        scans = [n for n in __import__(
+            "presto_tpu.spi.plan", fromlist=["walk_plan"]).walk_plan(
+                frag.root) if type(n).__name__ == "TableScanNode"]
+        sources = []
+        for sc in scans:
+            splits = cat.make_splits(sc.table.table_name, 0.01, 1,
+                                     sc.table.connector_id)
+            sources.append(PP.TaskSource(sc.id, [
+                PP.ScheduledSplit(i, sc.id, {
+                    "connectorId": sp.connector,
+                    "connectorSplit": sp.to_dict()})
+                for i, sp in enumerate(splits)]).to_json())
+        body = {
+            "session": PP.SessionRepresentation(
+                queryId="q_interop", user="test").to_json(),
+            "extraCredentials": {},
+            "fragment": base64.b64encode(
+                json.dumps(frag.to_dict()).encode()).decode(),
+            "sources": sources,
+            "outputIds": PP.OutputBuffers(
+                "PARTITIONED", 0, True, {"0": 0}).to_json(),
+        }
+        req = urllib.request.Request(
+            f"{w.uri}/v1/task/q_interop.0.0.0.0",
+            data=json.dumps(body).encode(), method="POST",
+            headers={"Content-Type": "application/json"})
+        st = json.load(urllib.request.urlopen(req))
+        assert st["state"] in ("PLANNED", "RUNNING", "FINISHED")
+        assert "taskInstanceIdLeastSignificantBits" in st  # reference shape
+        # pull pages until the buffer completes
+        rows = []
+        token = 0
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            r = urllib.request.urlopen(
+                f"{w.uri}/v1/task/q_interop.0.0.0.0/results/0/{token}")
+            data = r.read()
+            complete = r.headers.get("X-Presto-Buffer-Complete") == "true"
+            nxt = r.headers.get("X-Presto-Page-Token")
+            if data:
+                pos = 0
+                while pos < len(data):
+                    page, pos = deserialize_page(data, pos)
+                    rows += block_to_values(BIGINT, page.blocks[0])
+            if complete:
+                break
+            token = int(nxt) if nxt else token + 1
+            time.sleep(0.05)
+        assert rows, "no pages returned"
+    finally:
+        w.httpd.shutdown()
