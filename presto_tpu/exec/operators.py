@@ -76,10 +76,61 @@ def apply_filter(batch: Batch, predicate: Column) -> Batch:
 
 @dataclass(frozen=True)
 class AggSpec:
-    """One aggregate: function name, whether input is float, output column."""
-    name: str          # sum / count / count_star / min / max / avg
+    """One aggregate: function name, whether input is float, output column.
+    param carries a constant argument (approx_percentile's p)."""
+    name: str          # sum/count/count_star/min/max/avg/stddev*/var*/
+    #                    corr/covar_pop/covar_samp/approx_percentile
     output: str
     is_float: bool = False
+    param: object = None
+
+
+# aggregates every execution mode supports; anything else routes through
+# the scatter-hash or sort paths (run_fused / run_once gate on this)
+BASIC_AGGS = {"sum", "avg", "count", "count_star", "min", "max"}
+# moment-based aggregates (sum / sum-of-squares / cross-moment state)
+MOMENT_AGGS = {"stddev", "stddev_pop", "stddev_samp", "variance",
+               "var_pop", "var_samp"}
+CORR_AGGS = {"corr", "covar_pop", "covar_samp"}
+# aggregates only the sort path implements (need value-ordered segments)
+SORT_ONLY_AGGS = {"approx_percentile"}
+
+
+def _moment_finalize(name, s, ss, n):
+    """(value, is_null) for a variance-family aggregate from
+    (sum, sum of squares, count).
+
+    NOTE: the sum-of-squares formula cancels when |mean| >> spread; it is
+    used only by the scatter-table fallback (streaming non-fused sources).
+    The sort aggregation path — the default for these aggregates —
+    computes the numerically stable two-pass centered form instead
+    (sort_group_aggregate), matching the reference's central-moment
+    VarianceAggregation."""
+    nf = n.astype(jnp.float64)
+    pop = name in ("stddev_pop", "var_pop")
+    denom = jnp.where(pop, jnp.maximum(nf, 1.0),
+                      jnp.maximum(nf - 1.0, 1.0))
+    m2 = jnp.maximum(ss - s * s / jnp.maximum(nf, 1.0), 0.0)
+    var = m2 / denom
+    if name.startswith("stddev"):
+        var = jnp.sqrt(var)
+    null = n < (1 if pop else 2)
+    return var, null
+
+
+def _corr_finalize(name, sx, sy, sxy, sx2, sy2, n):
+    nf = n.astype(jnp.float64)
+    safe = jnp.maximum(nf, 1.0)
+    if name == "corr":
+        num = nf * sxy - sx * sy
+        den = jnp.sqrt(jnp.maximum(nf * sx2 - sx * sx, 0.0)
+                       * jnp.maximum(nf * sy2 - sy * sy, 0.0))
+        null = (n < 1) | (den == 0)
+        return num / jnp.where(den == 0, 1.0, den), null
+    cov = (sxy - sx * sy / safe)
+    if name == "covar_samp":
+        return cov / jnp.maximum(nf - 1.0, 1.0), n < 2
+    return cov / safe, n < 1
 
 
 EMPTY_SLOT = jnp.uint64(0xFFFFFFFFFFFFFFFF)
@@ -114,6 +165,19 @@ def agg_init(num_slots: int, specs: Tuple[AggSpec, ...],
                 else (INT64_MAX if spec.name == "min" else INT64_MIN)
             state[spec.output] = jnp.full(num_slots, init, dtype=dt)
             state[spec.output + "$count"] = jnp.zeros(num_slots, dtype=jnp.int64)
+        elif spec.name in MOMENT_AGGS:
+            state[spec.output + "$sum"] = jnp.zeros(num_slots,
+                                                    dtype=jnp.float64)
+            state[spec.output + "$sumsq"] = jnp.zeros(num_slots,
+                                                      dtype=jnp.float64)
+            state[spec.output + "$count"] = jnp.zeros(num_slots,
+                                                      dtype=jnp.int64)
+        elif spec.name in CORR_AGGS:
+            for suffix in ("$sx", "$sy", "$sxy", "$sx2", "$sy2"):
+                state[spec.output + suffix] = jnp.zeros(num_slots,
+                                                        dtype=jnp.float64)
+            state[spec.output + "$count"] = jnp.zeros(num_slots,
+                                                      dtype=jnp.int64)
         else:
             raise NotImplementedError(f"aggregate {spec.name}")
     return state
@@ -122,7 +186,8 @@ def agg_init(num_slots: int, specs: Tuple[AggSpec, ...],
 def agg_update(state: dict, batch: Batch, key_cols: List[Column],
                agg_inputs: Dict[str, Optional[Column]],
                specs: Tuple[AggSpec, ...], num_slots: int, salt: int,
-               key_names: Tuple[str, ...] = ()) -> dict:
+               key_names: Tuple[str, ...] = (),
+               agg_inputs2: Optional[Dict[str, Column]] = None) -> dict:
     """Scatter one batch into the accumulator table.
 
     Open addressing, linear probing vectorized as PROBE_ROUNDS scatter rounds:
@@ -186,6 +251,31 @@ def agg_update(state: dict, batch: Batch, key_cols: List[Column],
         if spec.name == "count":
             out[spec.output] = state[spec.output].at[slot].add(
                 valid.astype(jnp.int64), mode="drop")
+            continue
+        if spec.name in MOMENT_AGGS:
+            x = col.values.astype(jnp.float64)
+            vslot = jnp.where(valid, slot, num_slots)
+            out[spec.output + "$sum"] = state[spec.output + "$sum"] \
+                .at[vslot].add(x, mode="drop")
+            out[spec.output + "$sumsq"] = state[spec.output + "$sumsq"] \
+                .at[vslot].add(x * x, mode="drop")
+            out[spec.output + "$count"] = state[spec.output + "$count"] \
+                .at[vslot].add(jnp.ones_like(vslot, dtype=jnp.int64),
+                               mode="drop")
+            continue
+        if spec.name in CORR_AGGS:
+            c2 = agg_inputs2[spec.output]
+            valid = valid & ~c2.null_mask()
+            x = col.values.astype(jnp.float64)
+            y = c2.values.astype(jnp.float64)
+            vslot = jnp.where(valid, slot, num_slots)
+            for suffix, v2 in (("$sx", x), ("$sy", y), ("$sxy", x * y),
+                               ("$sx2", x * x), ("$sy2", y * y)):
+                out[spec.output + suffix] = state[spec.output + suffix] \
+                    .at[vslot].add(v2, mode="drop")
+            out[spec.output + "$count"] = state[spec.output + "$count"] \
+                .at[vslot].add(jnp.ones_like(vslot, dtype=jnp.int64),
+                               mode="drop")
             continue
         v = col.values
         if spec.is_float and v.dtype != jnp.float64:
@@ -252,7 +342,14 @@ def agg_merge(a: dict, b: dict, specs: Tuple[AggSpec, ...],
             jnp.where(mask, b[key], jnp.zeros((), b[key].dtype)), mode="drop")
 
     for spec in specs:
-        if spec.name in ("count", "count_star"):
+        if spec.name in MOMENT_AGGS:
+            _add(spec.output + "$sum")
+            _add(spec.output + "$sumsq")
+            _add(spec.output + "$count")
+        elif spec.name in CORR_AGGS:
+            for suffix in ("$sx", "$sy", "$sxy", "$sx2", "$sy2", "$count"):
+                _add(spec.output + suffix)
+        elif spec.name in ("count", "count_star"):
             _add(spec.output)
         elif spec.name == "avg":
             _add(spec.output + "$sum")
@@ -500,7 +597,9 @@ def _decimal_avg(s, cnt, empty):
 
 def sort_group_aggregate(batch: Batch, key_names: Tuple[str, ...],
                          agg_inputs: Dict[str, Optional[Column]],
-                         specs: Tuple[AggSpec, ...]) -> Batch:
+                         specs: Tuple[AggSpec, ...],
+                         agg_inputs2: Optional[Dict[str, Column]] = None
+                         ) -> Batch:
     """Grouped aggregation by SORT + segmented scans — argsort, gathers,
     cumsums and associative scans only, NO scatters.  On TPU a scatter
     costs ~100ms per million rows while sorts and scans stream at memory
@@ -512,8 +611,12 @@ def sort_group_aggregate(batch: Batch, key_names: Tuple[str, ...],
     distinct hashes — the same assumption the scatter table makes).
     Output: capacity == input capacity, one live row per group at its
     segment-start position."""
-    kh = _orderable_hash(hash_columns(
-        [batch.columns[k] for k in key_names]))
+    if key_names:
+        kh = _orderable_hash(hash_columns(
+            [batch.columns[k] for k in key_names]))
+    else:
+        # global aggregation: every live row in one segment
+        kh = jnp.zeros(batch.mask.shape, dtype=jnp.int64)
     kh = jnp.where(batch.mask, kh, INT64_MAX)
     perm = jnp.argsort(kh).astype(jnp.int32)
     khs = kh[perm]
@@ -521,6 +624,10 @@ def sort_group_aggregate(batch: Batch, key_names: Tuple[str, ...],
     live = khs != INT64_MAX
     is_start = live & jnp.concatenate(
         [jnp.ones(1, dtype=bool), khs[1:] != khs[:-1]])
+    if not key_names:
+        # SQL: a global aggregate yields one row even over empty input
+        # (the dead row-0 segment has zero contributions -> NULL/0 row)
+        is_start = is_start.at[0].set(True)
     # int32 index math: int64-indexed gathers are ~8x slower on TPU and
     # n is far below 2^31 (SORT_AGG_MAX_BYTES bound)
     idx = jnp.arange(n, dtype=jnp.int32)
@@ -531,12 +638,18 @@ def sort_group_aggregate(batch: Batch, key_names: Tuple[str, ...],
     seg_end = jnp.where(live, seg_end, idx + 1)
     s_lo = idx
     s_hi = jnp.clip(seg_end, 0, n).astype(jnp.int32)
+    # per-row segment START (for whole-group values at interior rows)
+    seg_start_row = jax.lax.cummax(jnp.where(is_start, idx, 0)) \
+        .astype(jnp.int32)
 
     cols: Dict[str, Column] = {}
     for k in key_names:
         cols[k] = batch.columns[k].gather(perm)
     for spec in specs:
         if spec.name == "count_star":
+            contrib = live
+            x = None
+        elif spec.name == "approx_percentile":
             contrib = live
             x = None
         else:
@@ -586,6 +699,70 @@ def sort_group_aggregate(batch: Batch, key_names: Tuple[str, ...],
             _, run = jax.lax.associative_scan(comb, (is_start, xv))
             vals = run[jnp.clip(s_hi - 1, 0, n - 1)]
             cols[spec.output] = Column(vals, empty)
+        elif spec.name in MOMENT_AGGS:
+            # numerically stable two-pass: the group mean comes from the
+            # first prefix sum IN THE SAME program, then the second pass
+            # accumulates centered squares (the reference's
+            # VarianceAggregation keeps central moments for the same
+            # reason)
+            xf = jnp.where(contrib, x.astype(jnp.float64), 0.0)
+            ps = jnp.concatenate([jnp.zeros(1), jnp.cumsum(xf)])
+            c0m = jnp.concatenate([jnp.zeros(1, dtype=jnp.int64),
+                                   jnp.cumsum(contrib.astype(jnp.int64))])
+            g_sum = ps[s_hi] - ps[seg_start_row]     # whole-group, per row
+            g_cnt = c0m[s_hi] - c0m[seg_start_row]
+            mean_row = g_sum / jnp.maximum(g_cnt, 1)
+            d = jnp.where(contrib, x.astype(jnp.float64) - mean_row, 0.0)
+            ps2 = jnp.concatenate([jnp.zeros(1), jnp.cumsum(d * d)])
+            m2 = ps2[s_hi] - ps2[s_lo]
+            pop = spec.name in ("stddev_pop", "var_pop")
+            denom = jnp.maximum(cnt if pop else cnt - 1, 1) \
+                .astype(jnp.float64)
+            v = m2 / denom
+            if spec.name.startswith("stddev"):
+                v = jnp.sqrt(v)
+            null = cnt < (1 if pop else 2)
+            cols[spec.output] = Column(v, null)
+        elif spec.name in CORR_AGGS:
+            c2 = agg_inputs2[spec.output].gather(perm)
+            contrib2 = contrib & ~c2.null_mask()
+            c0 = jnp.concatenate([jnp.zeros(1, dtype=jnp.int64),
+                                  jnp.cumsum(contrib2.astype(jnp.int64))])
+            n2 = c0[s_hi] - c0[s_lo]
+            xf = jnp.where(contrib2, x.astype(jnp.float64), 0.0)
+            yf = jnp.where(contrib2, c2.values.astype(jnp.float64), 0.0)
+            # one stacked (5, n) cumsum instead of five: fewer HLO ops
+            stackm = jnp.stack([xf, yf, xf * yf, xf * xf, yf * yf])
+            p0 = jnp.concatenate(
+                [jnp.zeros((5, 1)), jnp.cumsum(stackm, axis=1)], axis=1)
+            seg = p0[:, s_hi] - p0[:, s_lo]
+            v, null = _corr_finalize(spec.name, seg[0], seg[1], seg[2],
+                                     seg[3], seg[4], n2)
+            cols[spec.output] = Column(v, null)
+        elif spec.name == "approx_percentile":
+            # value-ordered secondary sort: NULL/dead rows sort last
+            # within their key-hash segment, then the nearest-rank element
+            # is one gather at fs + round(p * (cnt-1))
+            p = float(spec.param if spec.param is not None else 0.5)
+            xc = agg_inputs[spec.output]
+            vx = xc.values
+            if jnp.issubdtype(vx.dtype, jnp.floating):
+                dead_v = jnp.array(jnp.inf, vx.dtype)
+            else:
+                dead_v = jnp.array(jnp.iinfo(vx.dtype).max, vx.dtype)
+            alive = batch.mask & ~xc.null_mask()
+            sv = jnp.where(alive, vx, dead_v)
+            perm_p = jnp.lexsort((sv, kh)).astype(jnp.int32)
+            vx_sorted = vx[perm_p]
+            alive_p = alive[perm_p]
+            a0 = jnp.concatenate([jnp.zeros(1, dtype=jnp.int64),
+                                  jnp.cumsum(alive_p.astype(jnp.int64))])
+            cntp = a0[s_hi] - a0[s_lo]
+            pos = s_lo + jnp.floor(
+                p * jnp.maximum(cntp - 1, 0) + 0.5).astype(jnp.int32)
+            vals = vx_sorted[jnp.clip(pos, 0, n - 1)]
+            cols[spec.output] = Column(vals, cntp == 0, xc.dictionary,
+                                       xc.lazy)
         else:
             raise NotImplementedError(spec.name)
     return Batch(cols, is_start)
@@ -651,6 +828,19 @@ def agg_finalize(state: dict, specs: Tuple[AggSpec, ...],
         elif spec.name in ("min", "max"):
             empty = state[spec.output + "$count"] == 0
             cols[spec.output] = Column(state[spec.output], empty)
+        elif spec.name in MOMENT_AGGS:
+            v, null = _moment_finalize(
+                spec.name, state[spec.output + "$sum"],
+                state[spec.output + "$sumsq"],
+                state[spec.output + "$count"])
+            cols[spec.output] = Column(v, null)
+        elif spec.name in CORR_AGGS:
+            v, null = _corr_finalize(
+                spec.name, state[spec.output + "$sx"],
+                state[spec.output + "$sy"], state[spec.output + "$sxy"],
+                state[spec.output + "$sx2"], state[spec.output + "$sy2"],
+                state[spec.output + "$count"])
+            cols[spec.output] = Column(v, null)
     return Batch(cols, occupied)
 
 

@@ -818,16 +818,27 @@ class PlanCompiler:
 
         specs = []
         input_exprs: Dict[str, Optional[RowExpression]] = {}
+        input_exprs2: Dict[str, RowExpression] = {}
         for v, agg in node.aggregations.items():
             fname = canonical_name(agg.call.display_name)
-            if fname == "count" and not agg.call.arguments:
+            args = agg.call.arguments
+            if fname == "count" and not args:
                 fname = "count_star"
             is_float = isinstance(v.type, (DoubleType, RealType)) or (
-                fname == "avg" and isinstance(v.type, (DoubleType, RealType)))
-            specs.append(ops.AggSpec(fname, v.name, is_float))
-            input_exprs[v.name] = (agg.call.arguments[0]
-                                   if agg.call.arguments else None)
+                fname == "avg" and isinstance(v.type, (DoubleType,
+                                                       RealType)))
+            param = None
+            if fname == "approx_percentile" and len(args) > 1:
+                param = float(args[1].value)
+                is_float = isinstance(args[0].type, (DoubleType, RealType))
+
+            if fname in ops.CORR_AGGS and len(args) > 1:
+                input_exprs2[v.name] = args[1]
+            specs.append(ops.AggSpec(fname, v.name, is_float, param))
+            input_exprs[v.name] = args[0] if args else None
         specs = tuple(specs)
+        basic_specs = all(s.name in ops.BASIC_AGGS for s in specs)
+        sort_only_specs = any(s.name in ops.SORT_ONLY_AGGS for s in specs)
 
         cfg = self.ctx.config
 
@@ -865,8 +876,11 @@ class PlanCompiler:
                     for out, expr in input_exprs.items():
                         agg_cols[out] = (low.eval(expr, batch)
                                          if expr is not None else None)
+                    agg_cols2 = {out: low.eval(expr, batch)
+                                 for out, expr in input_exprs2.items()}
                     return ops.agg_update(state, batch, key_cols, agg_cols,
-                                          specs, num_slots, salt, key_names)
+                                          specs, num_slots, salt, key_names,
+                                          agg_cols2)
                 update_cache[(num_slots, salt)] = fn
             return fn
 
@@ -902,7 +916,8 @@ class PlanCompiler:
                         if c.dictionary is not None:
                             key_dicts[k] = c.dictionary
                     # closed small domains: combined code IS the slot index
-                    info = _direct_mode_info(key_names, key_cols)
+                    info = (_direct_mode_info(key_names, key_cols)
+                            if basic_specs else None)
                     if info is not None:
                         doms, G, strides, kdts, _kd = info
                         direct = (doms, kdts)
@@ -947,6 +962,10 @@ class PlanCompiler:
         def _agg_exprs(b):
             return {out: (low.eval(expr, b) if expr is not None else None)
                     for out, expr in input_exprs.items()}
+
+        def _agg_exprs2(b):
+            return {out: low.eval(expr, b)
+                    for out, expr in input_exprs2.items()}
 
         def run_fused(chain):
             """Execute a fused chain to a finalized output Batch, or None
@@ -1028,7 +1047,10 @@ class PlanCompiler:
                     codes = jnp.zeros(b.capacity, dtype=jnp.int64)
                 return codes
 
-            info = _direct_mode_info(key_names, key_cols)
+            basic = basic_specs
+            sort_only = sort_only_specs
+            info = (_direct_mode_info(key_names, key_cols)
+                    if basic else None)
             if info is not None:
                 doms, G, strides, kdts, kdicts = info
 
@@ -1044,8 +1066,9 @@ class PlanCompiler:
 
             # static span: closed dictionary/bool domains beyond the grid
             # limit — combined stride code indexes accumulators directly
-            info = _direct_mode_info(key_names, key_cols,
-                                     gmax=ops.SPAN_AGG_MAX_GROUPS)
+            info = (_direct_mode_info(key_names, key_cols,
+                                      gmax=ops.SPAN_AGG_MAX_GROUPS)
+                    if basic else None)
             if info is not None:
                 doms, G, strides, kdts, kdicts = info
                 if not pool.try_reserve(G * 24 * max(1, len(specs))):
@@ -1071,7 +1094,7 @@ class PlanCompiler:
 
             # runtime span: single integer key — one cheap min/max pass
             # over the chain, then collision-free scatter-direct updates
-            if (len(key_names) == 1 and key_cols[0].nulls is None
+            if (basic and len(key_names) == 1 and key_cols[0].nulls is None
                     and key_cols[0].values.dtype in (jnp.int64, jnp.int32,
                                                      jnp.int16)):
                 kname = key_names[0]
@@ -1144,7 +1167,7 @@ class PlanCompiler:
             width = len(key_names) + sum(
                 1 for e in input_exprs.values() if e is not None)
             est_mat = total * kprod * width * 9
-            if est_mat <= SORT_AGG_MAX_BYTES \
+            if (est_mat <= SORT_AGG_MAX_BYTES or sort_only) \
                     and pool.try_reserve(est_mat):
                 run = fused_cache.get(("sortagg", expands))
                 if run is None:
@@ -1157,6 +1180,8 @@ class PlanCompiler:
                             for out, col in _agg_exprs(b).items():
                                 if col is not None:
                                     cols["$in_" + out] = col
+                            for out, col in _agg_exprs2(b).items():
+                                cols["$in2_" + out] = col
                             return Batch(cols, b.mask)
                         stacked = jax.lax.map(step, (pos_arr, cnt_arr))
                         flat = jax.tree_util.tree_map(
@@ -1164,15 +1189,26 @@ class PlanCompiler:
                             stacked)
                         inputs = {s.output: flat.columns.get(
                             "$in_" + s.output) for s in specs}
+                        inputs2 = {s.output: flat.columns["$in2_"
+                                                          + s.output]
+                                   for s in specs
+                                   if s.name in ops.CORR_AGGS}
                         return ops.sort_group_aggregate(
                             Batch({k: flat.columns[k] for k in key_names},
                                   flat.mask),
-                            key_names, inputs, specs)
+                            key_names, inputs, specs, inputs2)
                     fused_cache[("sortagg", expands)] = run
                 try:
                     return _maybe_compact(run(pos_arr, cnt_arr, aux))
                 finally:
                     pool.free(est_mat)
+
+            if sort_only:
+                # percentile-class aggregates need value-ordered segments;
+                # without sort-mode memory there is no fallback
+                raise NotImplementedError(
+                    "approx_percentile over an input too large for the "
+                    "sort aggregation budget")
 
             # scatter hash table fallback, sized from the scan row count
             # so the common case completes without a doubling recompile
@@ -1191,7 +1227,8 @@ class PlanCompiler:
                     def update(st, b, _n=num_slots, _s=salt):
                         kc = [b.columns[k] for k in key_names]
                         return ops.agg_update(st, b, kc, _agg_exprs(b),
-                                              specs, _n, _s, key_names)
+                                              specs, _n, _s, key_names,
+                                              _agg_exprs2(b))
                     state = loop(("hash", num_slots, salt), update,
                                  ops.agg_init(num_slots, specs, key_names,
                                               key_dtypes))
@@ -1226,6 +1263,32 @@ class PlanCompiler:
         est_state_bytes = cfg.agg_slots * (
             16 + 12 * len(key_names) + 24 * max(1, len(specs)))
 
+        def run_sort_fallback():
+            """approx_percentile-class aggregates over a non-fused
+            source: materialize the input and run the sort-based grouped
+            aggregation (the only mode with value-ordered segments)."""
+            merged = self._materialize_node(src_node)
+            if merged is None:
+                # zero-batch source: an all-masked schema-shaped batch so
+                # a global aggregate still yields its one NULL row
+                from .fused import _empty_build_batch
+                merged = _empty_build_batch(src_node)
+            low2 = self.lowering
+            key = ("sortagg_fallback", node.id)
+            fn = self._jit_cache.get(key)
+            if fn is None:
+                @jax.jit
+                def fn(b):
+                    inputs = {out: (low2.eval(e, b) if e is not None
+                                    else None)
+                              for out, e in input_exprs.items()}
+                    inputs2 = {out: low2.eval(e, b)
+                               for out, e in input_exprs2.items()}
+                    return ops.sort_group_aggregate(b, key_names, inputs,
+                                                    specs, inputs2)
+                self._jit_cache[key] = fn
+            return _maybe_compact(fn(merged))
+
         def gen():
             pool = self.ctx.memory
             fused = get_fused()
@@ -1234,6 +1297,9 @@ class PlanCompiler:
                 if out is not None:
                     yield out
                     return
+            if sort_only_specs:
+                yield run_sort_fallback()
+                return
             if not key_names or pool.try_reserve(est_state_bytes):
                 try:
                     state, key_dicts, key_lazy, direct = run_retrying()
@@ -1475,24 +1541,50 @@ class PlanCompiler:
                 # rows nobody matched are emitted null-extended at the end
                 matched = (jnp.zeros(build_batch.capacity, dtype=bool)
                            if full else None)
-                for batch in batches:
-                    # recursive halving on output overflow: high-fanout
-                    # probes (worst case a constant-key cross join) keep
-                    # splitting until each piece fits the output capacity
-                    work = [batch]
-                    while work:
-                        piece = work.pop()
-                        joined, overflow, total, matched = step(piece, table,
-                                                                matched)
-                        ov, live = jax.device_get((overflow, total))
-                        if bool(ov):
-                            if piece.capacity <= 1:
-                                raise RuntimeError(
-                                    "join output overflow on a single "
-                                    "probe row: raise join_out_capacity")
-                            work.extend(reversed(_split_batch(piece)))
+                # dispatch runs ahead of the per-batch overflow fetch
+                # (lookahead window): the host sync for batch i overlaps
+                # the device computing batch i+1, halving the
+                # sync-per-batch wall cost of non-fused probe streams
+                from collections import deque
+                work = deque()
+                inflight = deque()   # (piece, joined, overflow, total)
+
+                def submit(piece):
+                    nonlocal matched
+                    joined, overflow, total, matched = step(piece, table,
+                                                            matched)
+                    inflight.append((piece, joined, overflow, total))
+
+                def drain_one():
+                    piece, joined, overflow, total = inflight.popleft()
+                    ov, live = jax.device_get((overflow, total))
+                    if bool(ov):
+                        # recursive halving on output overflow: high-
+                        # fanout probes (worst case a constant-key cross
+                        # join) split until each piece fits
+                        if piece.capacity <= 1:
+                            raise RuntimeError(
+                                "join output overflow on a single "
+                                "probe row: raise join_out_capacity")
+                        work.extendleft(reversed(_split_batch(piece)))
+                        return None
+                    return shrink(joined, live).select(out_names)
+
+                batches = iter(batches)
+                while True:
+                    while len(inflight) < 2:
+                        if work:
+                            submit(work.popleft())
                             continue
-                        yield shrink(joined, live).select(out_names)
+                        nxt = next(batches, None)
+                        if nxt is None:
+                            break
+                        submit(nxt)
+                    if not inflight:
+                        break
+                    out = drain_one()
+                    if out is not None:
+                        yield out
                 if full:
                     yield unmatched_build(build_batch, matched)
 

@@ -512,6 +512,80 @@ def _exec_AggregationNode(node: P.AggregationNode) -> Table:
             vals = red(masked, starts) if t.n else np.full(n_groups, big)
             cols[var.name] = (np.asarray(vals, dtype=object),
                               outm if outm.any() else None)
+        elif fname in ("stddev", "stddev_pop", "stddev_samp", "variance",
+                       "var_pop", "var_samp"):
+            pop = fname in ("stddev_pop", "var_pop")
+            sqrt = fname.startswith("stddev")
+            out = np.zeros(n_groups, dtype=np.float64)
+            outm = np.zeros(n_groups, dtype=bool)
+            ends = np.append(starts[1:], t.n)
+            for g in range(n_groups):
+                xs = [float(sv[i]) for i in range(starts[g], ends[g])
+                      if svalid[i]] if t.n else []
+                k = len(xs)
+                if k < (1 if pop else 2):
+                    outm[g] = True
+                    continue
+                m = sum(xs) / k
+                m2 = sum((x - m) ** 2 for x in xs)
+                v = m2 / (k if pop else k - 1)
+                out[g] = v ** 0.5 if sqrt else v
+            cols[var.name] = (out, outm if outm.any() else None)
+        elif fname in ("corr", "covar_pop", "covar_samp"):
+            bv, bm = _eval(agg.call.arguments[1], t)
+            bvalid = np.ones(t.n, dtype=bool) if bm is None else ~bm
+            sb = bv[order]
+            sbvalid = (svalid & bvalid[order])
+            out = np.zeros(n_groups, dtype=np.float64)
+            outm = np.zeros(n_groups, dtype=bool)
+            ends = np.append(starts[1:], t.n)
+            for g in range(n_groups):
+                pairs = [(float(sv[i]), float(sb[i]))
+                         for i in range(starts[g], ends[g])
+                         if sbvalid[i]] if t.n else []
+                k = len(pairs)
+                if fname == "corr":
+                    if k < 1:
+                        outm[g] = True
+                        continue
+                    sx = sum(x for x, _ in pairs)
+                    sy = sum(y for _, y in pairs)
+                    sxy = sum(x * y for x, y in pairs)
+                    sx2 = sum(x * x for x, _ in pairs)
+                    sy2 = sum(y * y for _, y in pairs)
+                    den = ((k * sx2 - sx * sx) * (k * sy2 - sy * sy)) ** 0.5
+                    if den == 0:
+                        outm[g] = True
+                        continue
+                    out[g] = (k * sxy - sx * sy) / den
+                    continue
+                need = 1 if fname == "covar_pop" else 2
+                if k < need:
+                    outm[g] = True
+                    continue
+                mx = sum(x for x, _ in pairs) / k
+                my = sum(y for _, y in pairs) / k
+                c = sum((x - mx) * (y - my) for x, y in pairs)
+                out[g] = c / (k if fname == "covar_pop" else k - 1)
+            cols[var.name] = (out, outm if outm.any() else None)
+        elif fname == "approx_percentile":
+            p = float(agg.call.arguments[1].value) \
+                if len(agg.call.arguments) > 1 else 0.5
+            outv = np.empty(n_groups, dtype=object)
+            outm = np.zeros(n_groups, dtype=bool)
+            ends = np.append(starts[1:], t.n)
+            for g in range(n_groups):
+                xs = sorted(sv[i] for i in range(starts[g], ends[g])
+                            if svalid[i]) if t.n else []
+                if not xs:
+                    outm[g] = True
+                    outv[g] = 0
+                    continue
+                # nearest rank, matching ops.sort_group_aggregate:
+                # round-half-up of p * (n-1)
+                import math
+                outv[g] = xs[int(math.floor(p * (len(xs) - 1) + 0.5))]
+            cols[var.name] = (outv, outm if outm.any() else None)
         else:
             raise NotImplementedError(fname)
     return Table(cols, n_groups)

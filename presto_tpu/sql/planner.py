@@ -28,6 +28,14 @@ from ..spi.expr import (CallExpression, ConstantExpression, RowExpression,
                         SpecialFormExpression, VariableReferenceExpression,
                         call, constant, special, variable)
 from . import parser as A
+
+# recognized aggregate functions (reference FunctionAndTypeManager
+# built-ins scoped to this engine's agg executor: exec/operators.py)
+AGG_FUNCS = ("sum", "avg", "count", "min", "max",
+             "stddev", "stddev_pop", "stddev_samp",
+             "variance", "var_pop", "var_samp",
+             "corr", "covar_pop", "covar_samp",
+             "approx_distinct", "approx_percentile")
 from ..connectors import catalog
 
 
@@ -91,6 +99,7 @@ class Planner:
         return self.plan_query_to_output(query)
 
     def plan_query_to_output(self, query) -> P.OutputNode:
+        _rewrite_approx_distinct(query)
         node, names, out_vars = self.plan_query_any(query)
         out = P.OutputNode(self.new_id("output"), node, names, out_vars)
         from .optimizer import optimize
@@ -945,14 +954,27 @@ class Planner:
                 continue
             fname = fc.name
             if fc.args:
-                arg = self.plan_expr(fc.args[0], scope)
-                if isinstance(arg, VariableReferenceExpression):
-                    av = arg
-                else:
-                    av = self.new_var("agginput", arg.type)
-                pre_assign[av] = arg
-                out_type = _agg_output_type(fname, arg.type)
-                acall = call(fname, out_type, av)
+                planned_args = []
+                for i, a in enumerate(fc.args):
+                    e = self.plan_expr(a, scope)
+                    if isinstance(e, ConstantExpression) and i > 0:
+                        planned_args.append(e)   # e.g. percentile p
+                        continue
+                    if fname in ("stddev", "stddev_pop", "stddev_samp",
+                                 "variance", "var_pop", "var_samp",
+                                 "corr", "covar_pop", "covar_samp") \
+                            and isinstance(e.type, DecimalType):
+                        # moment aggregates are double-valued in LOGICAL
+                        # units: descale decimal inputs up front
+                        e = call("cast", DOUBLE, e)
+                    if isinstance(e, VariableReferenceExpression):
+                        av = e
+                    else:
+                        av = self.new_var("agginput", e.type)
+                    pre_assign[av] = e
+                    planned_args.append(av)
+                out_type = _agg_output_type(fname, planned_args[0].type)
+                acall = CallExpression(fname, out_type, planned_args)
             else:
                 out_type = BIGINT
                 acall = CallExpression("count", out_type, [])
@@ -1417,7 +1439,7 @@ class Planner:
     def _plan_func(self, e: A.FuncCall, scope) -> RowExpression:
         args = [self.plan_expr(a, scope) for a in e.args]
         name = e.name
-        if name in ("sum", "avg", "count", "min", "max"):
+        if name in AGG_FUNCS:
             # bare aggregate call (used when planning inside agg rewrite)
             out = _agg_output_type(name, args[0].type if args else BIGINT)
             return CallExpression(name, out, args)
@@ -1583,6 +1605,26 @@ def _or_ast(disjs: List[A.Node]) -> A.Node:
     for d in disjs[1:]:
         out = A.BinaryOp("or", out, d)
     return out
+
+
+def _rewrite_approx_distinct(node) -> None:
+    """approx_distinct(x) executes as the exact count(DISTINCT x): an
+    exact answer is within the reference HLL's error bound.  Mutates the
+    AST in place so select items, HAVING, and the aggregation planner all
+    see the same canonical call."""
+    if isinstance(node, A.FuncCall) and node.name == "approx_distinct":
+        node.name = "count"
+        node.distinct = True
+    fields = vars(node).values() if isinstance(node, A.Node) else []
+    for f in fields:
+        items = f if isinstance(f, (list, tuple)) else [f]
+        for x in items:
+            if isinstance(x, (list, tuple)):
+                for y in x:
+                    if isinstance(y, A.Node):
+                        _rewrite_approx_distinct(y)
+            elif isinstance(x, A.Node):
+                _rewrite_approx_distinct(x)
 
 
 def _normalize_conjuncts(conjs: List[A.Node]) -> List[A.Node]:
@@ -1769,8 +1811,7 @@ def _collect_agg_calls(query: A.Query) -> List[A.FuncCall]:
             for oi in n.order_by:
                 walk(oi.expr)
             return
-        if isinstance(n, A.FuncCall) and n.name in ("sum", "avg", "count",
-                                                    "min", "max"):
+        if isinstance(n, A.FuncCall) and n.name in AGG_FUNCS:
             key = _canon(n)
             if key not in seen:
                 seen.add(key)
@@ -1986,5 +2027,13 @@ def _agg_output_type(fname: str, input_type: Type) -> Type:
         if isinstance(input_type, DecimalType):
             return input_type
         return DOUBLE
+    if fname in ("stddev", "stddev_pop", "stddev_samp", "variance",
+                 "var_pop", "var_samp", "corr", "covar_pop",
+                 "covar_samp"):
+        return DOUBLE
+    if fname == "approx_distinct":
+        return BIGINT
+    if fname == "approx_percentile":
+        return input_type
     # min / max preserve type
     return input_type

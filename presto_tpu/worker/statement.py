@@ -386,6 +386,8 @@ class DispatchManager:
         resp = {"id": q.query_id,
                 "infoUri": f"{base_uri}/v1/query/{q.query_id}",
                 "stats": q.stats()}
+        if q._cancelled and not q.done.is_set():
+            self._finish(q, CANCELED, None)
         with q._iter_lock:
             try:
                 self._ensure_chunk(q, token)

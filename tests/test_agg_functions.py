@@ -1,0 +1,79 @@
+"""Statistical aggregate breadth (VERDICT missing #7): stddev/variance
+family, corr/covar, approx_distinct, approx_percentile — engine vs the
+independent numpy oracle, plus hand-computed anchors (python statistics)
+so a shared misunderstanding cannot hide.
+
+Reference: presto-main-base/.../operator/aggregation/ (112 files;
+VarianceAggregation, CovarianceAggregation, ApproximateCountDistinct,
+ApproximateLongPercentileAggregations).
+"""
+import statistics
+
+import pytest
+
+from presto_tpu.exec.pipeline import ExecutionConfig
+from presto_tpu.exec.runner import LocalQueryRunner
+
+
+@pytest.fixture(scope="module")
+def runner():
+    return LocalQueryRunner("sf0.01", config=ExecutionConfig(
+        batch_rows=1 << 13, join_out_capacity=1 << 15))
+
+
+QUERIES = [
+    "SELECT stddev(totalprice) s, variance(totalprice) v FROM orders",
+    "SELECT stddev_pop(totalprice) s, var_pop(totalprice) v FROM orders",
+    """SELECT orderpriority, stddev_samp(totalprice) s,
+              var_samp(totalprice) v
+       FROM orders GROUP BY orderpriority ORDER BY orderpriority""",
+    "SELECT corr(totalprice, custkey) c FROM orders",
+    """SELECT covar_pop(totalprice, custkey) a,
+              covar_samp(totalprice, custkey) b FROM orders""",
+    "SELECT approx_distinct(custkey) d, count(*) n FROM orders",
+    """SELECT orderpriority, approx_distinct(custkey) d
+       FROM orders GROUP BY orderpriority ORDER BY orderpriority""",
+    "SELECT approx_percentile(totalprice, 0.5) m FROM orders",
+    """SELECT orderpriority, approx_percentile(totalprice, 0.9) p
+       FROM orders GROUP BY orderpriority ORDER BY orderpriority""",
+    """SELECT o.orderpriority, stddev(l.extendedprice) s
+       FROM lineitem l JOIN orders o ON l.orderkey = o.orderkey
+       GROUP BY o.orderpriority ORDER BY o.orderpriority""",
+]
+
+
+@pytest.mark.parametrize("i", range(len(QUERIES)))
+def test_agg_differential(runner, i):
+    runner.assert_same_as_reference(QUERIES[i])
+
+
+def test_stddev_anchor(runner):
+    """Both implementations vs python statistics over the same values."""
+    vals = [float(r[0]) for r in runner.execute(
+        "SELECT totalprice FROM orders WHERE orderkey < 400").rows]
+    got = runner.execute(
+        "SELECT stddev(totalprice) s, var_pop(totalprice) v "
+        "FROM orders WHERE orderkey < 400").rows[0]
+    assert abs(float(got[0]) - statistics.stdev(vals)) \
+        <= 1e-6 * statistics.stdev(vals)
+    assert abs(float(got[1]) - statistics.pvariance(vals)) \
+        <= 1e-6 * statistics.pvariance(vals)
+
+
+def test_approx_distinct_exact(runner):
+    got = runner.execute(
+        "SELECT approx_distinct(orderpriority) FROM orders").rows[0][0]
+    exact = runner.execute(
+        "SELECT count(DISTINCT orderpriority) FROM orders").rows[0][0]
+    assert got == exact == 5
+
+
+def test_percentile_anchor(runner):
+    vals = sorted(float(r[0]) for r in runner.execute(
+        "SELECT totalprice FROM orders WHERE orderkey < 400").rows)
+    got = float(runner.execute(
+        "SELECT approx_percentile(totalprice, 0.5) FROM orders "
+        "WHERE orderkey < 400").rows[0][0])
+    import math
+    want = vals[int(math.floor(0.5 * (len(vals) - 1) + 0.5))]
+    assert abs(got - want) < 1e-9
