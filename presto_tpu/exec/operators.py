@@ -746,13 +746,11 @@ def sort_group_aggregate(batch: Batch, key_names: Tuple[str, ...],
             p = float(spec.param if spec.param is not None else 0.5)
             xc = agg_inputs[spec.output]
             vx = xc.values
-            if jnp.issubdtype(vx.dtype, jnp.floating):
-                dead_v = jnp.array(jnp.inf, vx.dtype)
-            else:
-                dead_v = jnp.array(jnp.iinfo(vx.dtype).max, vx.dtype)
             alive = batch.mask & ~xc.null_mask()
-            sv = jnp.where(alive, vx, dead_v)
-            perm_p = jnp.lexsort((sv, kh)).astype(jnp.int32)
+            # dead/NULL rows ordered by an explicit flag (not an in-band
+            # value sentinel, which legitimate inf/INT64_MAX values or
+            # NaN would interleave with)
+            perm_p = jnp.lexsort((vx, ~alive, kh)).astype(jnp.int32)
             vx_sorted = vx[perm_p]
             alive_p = alive[perm_p]
             a0 = jnp.concatenate([jnp.zeros(1, dtype=jnp.int64),

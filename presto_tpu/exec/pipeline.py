@@ -1273,6 +1273,14 @@ class PlanCompiler:
                 # a global aggregate still yields its one NULL row
                 from .fused import _empty_build_batch
                 merged = _empty_build_batch(src_node)
+            # account the materialization (the fused path reserves its
+            # estimate up front; this fallback reserves what it holds)
+            nb = batch_bytes(merged)
+            if not self.ctx.memory.try_reserve(nb):
+                raise MemoryExceededError(
+                    f"sort-aggregation input of {nb} bytes exceeds the "
+                    f"memory budget {self.ctx.memory.budget}")
+            self.ctx.memory.free(nb)
             low2 = self.lowering
             key = ("sortagg_fallback", node.id)
             fn = self._jit_cache.get(key)

@@ -456,6 +456,19 @@ class WorkerServer:
             self.dispatch = DispatchManager(self._execute_statement,
                                             resource_groups)
 
+        # system runtime tables (reference system connector /
+        # presto_cpp SystemConnector): SQL-queryable server state.  Only
+        # the coordinator registers (workers have no dispatch registry,
+        # and the global catalog must not be hijacked by the last-built
+        # worker in multi-server tests).
+        self._registered_system = False
+        if coordinator:
+            from ..connectors import catalog as _catalog
+            from ..connectors.system_tables import SystemTablesConnector
+            _catalog.register_connector("system",
+                                        SystemTablesConnector(self))
+            self._registered_system = True
+
         self._serve_thread = threading.Thread(
             target=self.httpd.serve_forever, name=f"http-{self.port}",
             daemon=True)
@@ -541,6 +554,15 @@ class WorkerServer:
                 self._runner_cache.clear()
         return result
 
+    def _unregister_system(self) -> None:
+        if getattr(self, "_registered_system", False):
+            from ..connectors import catalog as _catalog
+            if _catalog._CONNECTORS.get("system") is not None and \
+                    getattr(_catalog._CONNECTORS["system"], "server",
+                            None) is self:
+                _catalog.unregister_connector("system")
+            self._registered_system = False
+
     def begin_shutdown(self) -> None:
         """Refuse new tasks, wait for running ones to drain, then stop the
         server (reference GracefulShutdownHandler / native
@@ -566,6 +588,7 @@ class WorkerServer:
 
     def close(self) -> None:
         self._stop.set()
+        self._unregister_system()
         self.task_manager.cancel_all()
         self.httpd.shutdown()
         self.httpd.server_close()

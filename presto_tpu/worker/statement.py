@@ -388,11 +388,12 @@ class DispatchManager:
                 "stats": q.stats()}
         if q._cancelled and not q.done.is_set():
             self._finish(q, CANCELED, None)
-        with q._iter_lock:
-            try:
-                self._ensure_chunk(q, token)
-            except Exception as e:  # noqa: BLE001 — surfaces to client
-                self._finish(q, FAILED, f"{type(e).__name__}: {e}")
+        if not (q._cancelled or q.done.is_set()):
+            with q._iter_lock:
+                try:
+                    self._ensure_chunk(q, token)
+                except Exception as e:  # noqa: BLE001 — surfaces to client
+                    self._finish(q, FAILED, f"{type(e).__name__}: {e}")
         if q.state in (FAILED, CANCELED):
             if q.error:
                 resp["error"] = {

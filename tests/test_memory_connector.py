@@ -1,0 +1,82 @@
+"""Memory / blackhole / system-table connectors (SURVEY §2.8 utility
+connectors: presto-memory MemoryPagesStore, presto-blackhole, and the
+system runtime tables presto-main-base/.../connector/system/)."""
+import pytest
+
+from presto_tpu.connectors import catalog
+from presto_tpu.connectors.memory import BlackholeConnector, MemoryConnector
+from presto_tpu.exec.pipeline import ExecutionConfig
+from presto_tpu.exec.runner import LocalQueryRunner
+
+
+@pytest.fixture()
+def runner():
+    catalog.register_connector("memory", MemoryConnector())
+    catalog.register_connector("blackhole", BlackholeConnector())
+    try:
+        yield LocalQueryRunner("sf0.01", config=ExecutionConfig(
+            batch_rows=1 << 13, join_out_capacity=1 << 15))
+    finally:
+        catalog.unregister_connector("memory")
+        catalog.unregister_connector("blackhole")
+
+
+def test_memory_ctas_round_trip(runner):
+    runner.execute("CREATE TABLE mem_orders AS "
+                   "SELECT orderkey, totalprice, orderpriority, orderdate "
+                   "FROM orders WHERE orderkey < 200")
+    got = runner.execute(
+        "SELECT count(*), sum(totalprice) FROM mem_orders")
+    want = runner.execute(
+        "SELECT count(*), sum(totalprice) FROM orders WHERE orderkey < 200")
+    assert got.rows == want.rows
+    # joins against generated tables work too
+    j = runner.execute(
+        "SELECT count(*) FROM mem_orders m JOIN orders o "
+        "ON m.orderkey = o.orderkey")
+    assert j.rows[0][0] == want.rows[0][0]
+
+
+def test_memory_insert_appends(runner):
+    runner.execute("CREATE TABLE mem_t AS "
+                   "SELECT orderkey FROM orders WHERE orderkey < 100")
+    before = runner.execute("SELECT count(*) FROM mem_t").rows[0][0]
+    runner.execute("INSERT INTO mem_t "
+                   "SELECT orderkey FROM orders WHERE orderkey < 100")
+    after = runner.execute("SELECT count(*) FROM mem_t").rows[0][0]
+    assert after == 2 * before > 0
+    runner.execute("DROP TABLE mem_t")
+    with pytest.raises(Exception):
+        runner.execute("SELECT count(*) FROM mem_t")
+
+
+def test_memory_nulls_and_strings(runner):
+    runner.execute("CREATE TABLE mem_c AS "
+                   "SELECT clerk, CASE WHEN orderkey % 3 = 0 THEN NULL "
+                   "ELSE totalprice END AS tp "
+                   "FROM orders WHERE orderkey < 300")
+    got = runner.execute("SELECT count(*), count(tp), count(DISTINCT clerk)"
+                         " FROM mem_c")
+    want = runner.execute(
+        "SELECT count(*), count(CASE WHEN orderkey % 3 = 0 THEN NULL "
+        "ELSE totalprice END), count(DISTINCT clerk) "
+        "FROM orders WHERE orderkey < 300")
+    assert got.rows == want.rows
+
+
+def test_system_runtime_tables():
+    from presto_tpu.worker.server import WorkerServer
+    from presto_tpu.client import StatementClient
+    s = WorkerServer(coordinator=True)   # serves from its own thread
+    try:
+        c = StatementClient(s.uri, schema="sf0.01")
+        c.execute("SELECT 1")
+        r = c.execute("SELECT node_id, coordinator, state "
+                      "FROM runtime_nodes")
+        assert any(row[0] == s.node_id and row[1] for row in r.rows)
+        r = c.execute("SELECT query_id, state FROM runtime_queries")
+        assert len(r.rows) >= 1          # includes at least the SELECT 1
+        assert all(row[1] in ("QUEUED", "RUNNING", "FINISHED", "FAILED",
+                              "CANCELED") for row in r.rows)
+    finally:
+        s.close()
