@@ -109,6 +109,17 @@ def _max_run(table: ops.BuildTable):
     return jnp.max(jnp.where(pos < table.valid_count, table.run_len, 0))
 
 
+def _build_has_null_key(batch: Batch, key_names: Tuple[str, ...]) -> bool:
+    """Whether any live build row has a NULL key — needed for the semi-join
+    marker's three-valued output (x IN (...NULL...) is UNKNOWN on a miss)."""
+    m = jnp.zeros((), dtype=bool)
+    for k in key_names:
+        c = batch.columns[k]
+        if c.nulls is not None:
+            m = m | jnp.any(batch.mask & c.nulls)
+    return bool(jax.device_get(m))
+
+
 def _drop_null_keys(batch: Batch, key_names: Tuple[str, ...]) -> Batch:
     """Exclude build rows with NULL keys (SQL equi-join: NULL never
     matches).  Runs eagerly — a handful of elementwise ops, once per build."""
@@ -213,16 +224,18 @@ class FusedChain:
                     for_join=True)
                 if res is None:
                     return None
-                tbl, k = res
+                tbl, k, _ = res
                 aux.append(tbl)
                 expands.append(k)
             elif kind == "semi":
                 node = step[1]
-                tbl, _k = self._build_for(
-                    node.filtering_source,
-                    (node.filtering_source_join_variable.name,),
-                    for_join=False)
-                aux.append(tbl)
+                fkey = node.filtering_source_join_variable.name
+                tbl, _k, had_null = self._build_for(
+                    node.filtering_source, (fkey,), for_join=False)
+                # (table, build-had-null-key) — the flag rides the traced
+                # aux pytree so the marker can go three-valued without a
+                # retrace per data change
+                aux.append((tbl, jnp.asarray(had_null)))
                 expands.append(1)
         kprod = 1
         for k in expands:
@@ -233,12 +246,18 @@ class FusedChain:
 
     def _build_for(self, build_node: P.PlanNode, keys: Tuple[str, ...],
                    for_join: bool):
-        """Returns (table, fanout) — fanout is the pow2-rounded max key
-        multiplicity (1 = unique keys) — or None when fanout > MAX_EXPAND."""
+        """Returns (table, fanout, build_had_null_key) — fanout is the
+        pow2-rounded max key multiplicity (1 = unique keys) — or None when
+        fanout > MAX_EXPAND.  The null flag is computed only for semi
+        builds (for_join=False); join builds report False unconditionally
+        (they drop NULL keys either way)."""
         comp = self.compiler
         batch = comp._materialize_node(build_node, cache=True)
         if batch is None:
             batch = _empty_build_batch(build_node)
+        # only semi-join markers need the null-key flag (three-valued
+        # output); join builds skip the device round-trip it costs
+        had_null = False if for_join else _build_has_null_key(batch, keys)
         batch = _drop_null_keys(batch, keys)
         # dense single integer key -> direct-address table (unique keys only)
         if len(keys) == 1:
@@ -254,18 +273,19 @@ class FusedChain:
                     slots, dup = _direct_builder(size)(
                         col.values, batch.mask, jnp.int64(int(vmin)))
                     if not for_join or not bool(jax.device_get(dup)):
-                        return DirectTable(slots, jnp.int64(int(vmin)),
-                                           dict(batch.columns)), 1
+                        return (DirectTable(slots, jnp.int64(int(vmin)),
+                                            dict(batch.columns)), 1,
+                                had_null)
         from .pipeline import _jits
         table = _jits()[1](batch, keys)
         if not for_join:
-            return table, 1
+            return table, 1, had_null
         kmax = int(jax.device_get(_max_run(table)))
         if kmax <= 1:
-            return table, 1
+            return table, 1, False
         if kmax > MAX_EXPAND:
             return None
-        return table, 1 << (kmax - 1).bit_length()
+        return table, 1 << (kmax - 1).bit_length(), False
 
     # -- traced: one chunk through the whole chain ------------------------
     def make(self, pos, valid, aux, expands: Tuple[int, ...],
@@ -302,11 +322,19 @@ class FusedChain:
             elif kind == "semi":
                 node = step[1]
                 key = node.source_join_variable.name
-                hit, _ = (probe_direct(batch, aux[ai], key)
-                          if isinstance(aux[ai], DirectTable)
-                          else probe_unique(batch, aux[ai], (key,)))
+                tbl, bhn = aux[ai]
+                hit, _ = (probe_direct(batch, tbl, key)
+                          if isinstance(tbl, DirectTable)
+                          else probe_unique(batch, tbl, (key,)))
+                # three-valued marker: NULL probe key, or miss against a
+                # build side that contained NULL (reference
+                # HashSemiJoinOperator semantics)
+                nulls = ~hit & bhn
+                pn = batch.columns[key].nulls
+                if pn is not None:
+                    nulls = nulls | pn
                 batch = batch.with_columns(
-                    {node.semi_join_output.name: Column(hit, None)})
+                    {node.semi_join_output.name: Column(hit, nulls)})
                 ai += 1
         return batch
 

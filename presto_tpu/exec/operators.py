@@ -96,41 +96,50 @@ CORR_AGGS = {"corr", "covar_pop", "covar_samp"}
 SORT_ONLY_AGGS = {"approx_percentile"}
 
 
-def _moment_finalize(name, s, ss, n):
-    """(value, is_null) for a variance-family aggregate from
-    (sum, sum of squares, count).
+def _chan_merge(na, ma, m2a, nb, mb, m2b):
+    """Chan et al. parallel merge of central-moment states (n, mean, M2).
 
-    NOTE: the sum-of-squares formula cancels when |mean| >> spread; it is
-    used only by the scatter-table fallback (streaming non-fused sources).
-    The sort aggregation path — the default for these aggregates —
-    computes the numerically stable two-pass centered form instead
-    (sort_group_aggregate), matching the reference's central-moment
-    VarianceAggregation."""
+    Numerically stable (no large-magnitude cancellation), and exact at the
+    boundaries: an empty side contributes nothing because its mean is 0 and
+    the delta term is scaled by na*nb.  Matches the reference's
+    CentralMomentsState merge (VarianceAggregation)."""
+    n = na + nb
+    nf = jnp.maximum(n.astype(jnp.float64), 1.0)
+    naf = na.astype(jnp.float64)
+    nbf = nb.astype(jnp.float64)
+    delta = mb - ma
+    mean = ma + delta * nbf / nf
+    m2 = m2a + m2b + delta * delta * naf * nbf / nf
+    return n, mean, m2
+
+
+def _moment_finalize(name, mean, m2, n):
+    """(value, is_null) for a variance-family aggregate from the central
+    moments (mean, M2=Σ(x-mean)², count).  `mean` is unused by the formula
+    but kept in the signature for symmetry with the accumulator state."""
+    del mean
     nf = n.astype(jnp.float64)
     pop = name in ("stddev_pop", "var_pop")
     denom = jnp.where(pop, jnp.maximum(nf, 1.0),
                       jnp.maximum(nf - 1.0, 1.0))
-    m2 = jnp.maximum(ss - s * s / jnp.maximum(nf, 1.0), 0.0)
-    var = m2 / denom
+    var = jnp.maximum(m2, 0.0) / denom
     if name.startswith("stddev"):
         var = jnp.sqrt(var)
     null = n < (1 if pop else 2)
     return var, null
 
 
-def _corr_finalize(name, sx, sy, sxy, sx2, sy2, n):
+def _corr_finalize(name, m2x, m2y, cxy, n):
+    """(value, is_null) from central cross-moments: M2x=Σ(x-mx)²,
+    M2y=Σ(y-my)², Cxy=Σ(x-mx)(y-my)."""
     nf = n.astype(jnp.float64)
-    safe = jnp.maximum(nf, 1.0)
     if name == "corr":
-        num = nf * sxy - sx * sy
-        den = jnp.sqrt(jnp.maximum(nf * sx2 - sx * sx, 0.0)
-                       * jnp.maximum(nf * sy2 - sy * sy, 0.0))
+        den = jnp.sqrt(jnp.maximum(m2x, 0.0) * jnp.maximum(m2y, 0.0))
         null = (n < 1) | (den == 0)
-        return num / jnp.where(den == 0, 1.0, den), null
-    cov = (sxy - sx * sy / safe)
+        return cxy / jnp.where(den == 0, 1.0, den), null
     if name == "covar_samp":
-        return cov / jnp.maximum(nf - 1.0, 1.0), n < 2
-    return cov / safe, n < 1
+        return cxy / jnp.maximum(nf - 1.0, 1.0), n < 2
+    return cxy / jnp.maximum(nf, 1.0), n < 1
 
 
 EMPTY_SLOT = jnp.uint64(0xFFFFFFFFFFFFFFFF)
@@ -166,14 +175,13 @@ def agg_init(num_slots: int, specs: Tuple[AggSpec, ...],
             state[spec.output] = jnp.full(num_slots, init, dtype=dt)
             state[spec.output + "$count"] = jnp.zeros(num_slots, dtype=jnp.int64)
         elif spec.name in MOMENT_AGGS:
-            state[spec.output + "$sum"] = jnp.zeros(num_slots,
-                                                    dtype=jnp.float64)
-            state[spec.output + "$sumsq"] = jnp.zeros(num_slots,
-                                                      dtype=jnp.float64)
+            for suffix in ("$mean", "$m2"):
+                state[spec.output + suffix] = jnp.zeros(num_slots,
+                                                        dtype=jnp.float64)
             state[spec.output + "$count"] = jnp.zeros(num_slots,
                                                       dtype=jnp.int64)
         elif spec.name in CORR_AGGS:
-            for suffix in ("$sx", "$sy", "$sxy", "$sx2", "$sy2"):
+            for suffix in ("$mx", "$my", "$m2x", "$m2y", "$cxy"):
                 state[spec.output + suffix] = jnp.zeros(num_slots,
                                                         dtype=jnp.float64)
             state[spec.output + "$count"] = jnp.zeros(num_slots,
@@ -253,15 +261,26 @@ def agg_update(state: dict, batch: Batch, key_cols: List[Column],
                 valid.astype(jnp.int64), mode="drop")
             continue
         if spec.name in MOMENT_AGGS:
+            # Two scatter passes per batch: batch-local (n, mean), then
+            # batch-local M2 around that mean; fold into the running state
+            # with the stable Chan merge (no sum-of-squares cancellation).
             x = col.values.astype(jnp.float64)
             vslot = jnp.where(valid, slot, num_slots)
-            out[spec.output + "$sum"] = state[spec.output + "$sum"] \
-                .at[vslot].add(x, mode="drop")
-            out[spec.output + "$sumsq"] = state[spec.output + "$sumsq"] \
-                .at[vslot].add(x * x, mode="drop")
-            out[spec.output + "$count"] = state[spec.output + "$count"] \
-                .at[vslot].add(jnp.ones_like(vslot, dtype=jnp.int64),
-                               mode="drop")
+            gslot = jnp.where(valid, slot, 0)
+            nb = jnp.zeros(num_slots, jnp.int64).at[vslot].add(
+                jnp.ones_like(vslot, dtype=jnp.int64), mode="drop")
+            sb = jnp.zeros(num_slots, jnp.float64).at[vslot].add(
+                x, mode="drop")
+            mb = sb / jnp.maximum(nb.astype(jnp.float64), 1.0)
+            cx = jnp.where(valid, x - mb[gslot], 0.0)
+            m2b = jnp.zeros(num_slots, jnp.float64).at[vslot].add(
+                cx * cx, mode="drop")
+            n, mean, m2 = _chan_merge(
+                state[spec.output + "$count"], state[spec.output + "$mean"],
+                state[spec.output + "$m2"], nb, mb, m2b)
+            out[spec.output + "$count"] = n
+            out[spec.output + "$mean"] = mean
+            out[spec.output + "$m2"] = m2
             continue
         if spec.name in CORR_AGGS:
             c2 = agg_inputs2[spec.output]
@@ -269,13 +288,40 @@ def agg_update(state: dict, batch: Batch, key_cols: List[Column],
             x = col.values.astype(jnp.float64)
             y = c2.values.astype(jnp.float64)
             vslot = jnp.where(valid, slot, num_slots)
-            for suffix, v2 in (("$sx", x), ("$sy", y), ("$sxy", x * y),
-                               ("$sx2", x * x), ("$sy2", y * y)):
-                out[spec.output + suffix] = state[spec.output + suffix] \
-                    .at[vslot].add(v2, mode="drop")
-            out[spec.output + "$count"] = state[spec.output + "$count"] \
-                .at[vslot].add(jnp.ones_like(vslot, dtype=jnp.int64),
-                               mode="drop")
+            gslot = jnp.where(valid, slot, 0)
+            ones = jnp.ones_like(vslot, dtype=jnp.int64)
+            nb = jnp.zeros(num_slots, jnp.int64).at[vslot].add(
+                ones, mode="drop")
+            nbf = jnp.maximum(nb.astype(jnp.float64), 1.0)
+            mxb = jnp.zeros(num_slots, jnp.float64).at[vslot].add(
+                x, mode="drop") / nbf
+            myb = jnp.zeros(num_slots, jnp.float64).at[vslot].add(
+                y, mode="drop") / nbf
+            cx = jnp.where(valid, x - mxb[gslot], 0.0)
+            cy = jnp.where(valid, y - myb[gslot], 0.0)
+            zeros = jnp.zeros(num_slots, jnp.float64)
+            m2xb = zeros.at[vslot].add(cx * cx, mode="drop")
+            m2yb = zeros.at[vslot].add(cy * cy, mode="drop")
+            cxyb = zeros.at[vslot].add(cx * cy, mode="drop")
+            na = state[spec.output + "$count"]
+            n, mx, m2x = _chan_merge(na, state[spec.output + "$mx"],
+                                     state[spec.output + "$m2x"],
+                                     nb, mxb, m2xb)
+            _, my, m2y = _chan_merge(na, state[spec.output + "$my"],
+                                     state[spec.output + "$m2y"],
+                                     nb, myb, m2yb)
+            nf = jnp.maximum(n.astype(jnp.float64), 1.0)
+            dx = mxb - state[spec.output + "$mx"]
+            dy = myb - state[spec.output + "$my"]
+            cxy = (state[spec.output + "$cxy"] + cxyb
+                   + dx * dy * na.astype(jnp.float64)
+                   * nb.astype(jnp.float64) / nf)
+            out[spec.output + "$count"] = n
+            out[spec.output + "$mx"] = mx
+            out[spec.output + "$my"] = my
+            out[spec.output + "$m2x"] = m2x
+            out[spec.output + "$m2y"] = m2y
+            out[spec.output + "$cxy"] = cxy
             continue
         v = col.values
         if spec.is_float and v.dtype != jnp.float64:
@@ -341,14 +387,47 @@ def agg_merge(a: dict, b: dict, specs: Tuple[AggSpec, ...],
         out[key] = a[key].at[slot].add(
             jnp.where(mask, b[key], jnp.zeros((), b[key].dtype)), mode="drop")
 
+    def _realign(key, dtype=jnp.float64):
+        # b's per-slot values re-addressed to a's slot space; distinct keys
+        # land on distinct slots, so add-into-zeros is an exact placement
+        return jnp.zeros(num_slots, dtype).at[slot].add(
+            jnp.where(mask, b[key], jnp.zeros((), b[key].dtype)),
+            mode="drop")
+
     for spec in specs:
         if spec.name in MOMENT_AGGS:
-            _add(spec.output + "$sum")
-            _add(spec.output + "$sumsq")
-            _add(spec.output + "$count")
+            nb = _realign(spec.output + "$count", jnp.int64)
+            n, mean, m2 = _chan_merge(
+                a[spec.output + "$count"], a[spec.output + "$mean"],
+                a[spec.output + "$m2"], nb,
+                _realign(spec.output + "$mean"),
+                _realign(spec.output + "$m2"))
+            out[spec.output + "$count"] = n
+            out[spec.output + "$mean"] = mean
+            out[spec.output + "$m2"] = m2
         elif spec.name in CORR_AGGS:
-            for suffix in ("$sx", "$sy", "$sxy", "$sx2", "$sy2", "$count"):
-                _add(spec.output + suffix)
+            na = a[spec.output + "$count"]
+            nb = _realign(spec.output + "$count", jnp.int64)
+            mxb = _realign(spec.output + "$mx")
+            myb = _realign(spec.output + "$my")
+            n, mx, m2x = _chan_merge(na, a[spec.output + "$mx"],
+                                     a[spec.output + "$m2x"], nb, mxb,
+                                     _realign(spec.output + "$m2x"))
+            _, my, m2y = _chan_merge(na, a[spec.output + "$my"],
+                                     a[spec.output + "$m2y"], nb, myb,
+                                     _realign(spec.output + "$m2y"))
+            nf = jnp.maximum(n.astype(jnp.float64), 1.0)
+            dx = mxb - a[spec.output + "$mx"]
+            dy = myb - a[spec.output + "$my"]
+            cxy = (a[spec.output + "$cxy"] + _realign(spec.output + "$cxy")
+                   + dx * dy * na.astype(jnp.float64)
+                   * nb.astype(jnp.float64) / nf)
+            out[spec.output + "$count"] = n
+            out[spec.output + "$mx"] = mx
+            out[spec.output + "$my"] = my
+            out[spec.output + "$m2x"] = m2x
+            out[spec.output + "$m2y"] = m2y
+            out[spec.output + "$cxy"] = cxy
         elif spec.name in ("count", "count_star"):
             _add(spec.output)
         elif spec.name == "avg":
@@ -731,13 +810,22 @@ def sort_group_aggregate(batch: Batch, key_names: Tuple[str, ...],
             n2 = c0[s_hi] - c0[s_lo]
             xf = jnp.where(contrib2, x.astype(jnp.float64), 0.0)
             yf = jnp.where(contrib2, c2.values.astype(jnp.float64), 0.0)
-            # one stacked (5, n) cumsum instead of five: fewer HLO ops
-            stackm = jnp.stack([xf, yf, xf * yf, xf * xf, yf * yf])
-            p0 = jnp.concatenate(
-                [jnp.zeros((5, 1)), jnp.cumsum(stackm, axis=1)], axis=1)
-            seg = p0[:, s_hi] - p0[:, s_lo]
-            v, null = _corr_finalize(spec.name, seg[0], seg[1], seg[2],
-                                     seg[3], seg[4], n2)
+            # two-pass centered cross-moments (same stability rationale as
+            # the MOMENT branch); stacked cumsums keep the HLO op count low
+            stack1 = jnp.stack([xf, yf])
+            p1 = jnp.concatenate(
+                [jnp.zeros((2, 1)), jnp.cumsum(stack1, axis=1)], axis=1)
+            g_cnt = jnp.maximum(c0[s_hi] - c0[seg_start_row], 1)
+            mean_x = (p1[0, s_hi] - p1[0, seg_start_row]) / g_cnt
+            mean_y = (p1[1, s_hi] - p1[1, seg_start_row]) / g_cnt
+            dx = jnp.where(contrib2, x.astype(jnp.float64) - mean_x, 0.0)
+            dy = jnp.where(contrib2,
+                           c2.values.astype(jnp.float64) - mean_y, 0.0)
+            stack2 = jnp.stack([dx * dx, dy * dy, dx * dy])
+            p2 = jnp.concatenate(
+                [jnp.zeros((3, 1)), jnp.cumsum(stack2, axis=1)], axis=1)
+            seg = p2[:, s_hi] - p2[:, s_lo]
+            v, null = _corr_finalize(spec.name, seg[0], seg[1], seg[2], n2)
             cols[spec.output] = Column(v, null)
         elif spec.name == "approx_percentile":
             # value-ordered secondary sort: NULL/dead rows sort last
@@ -828,15 +916,14 @@ def agg_finalize(state: dict, specs: Tuple[AggSpec, ...],
             cols[spec.output] = Column(state[spec.output], empty)
         elif spec.name in MOMENT_AGGS:
             v, null = _moment_finalize(
-                spec.name, state[spec.output + "$sum"],
-                state[spec.output + "$sumsq"],
+                spec.name, state[spec.output + "$mean"],
+                state[spec.output + "$m2"],
                 state[spec.output + "$count"])
             cols[spec.output] = Column(v, null)
         elif spec.name in CORR_AGGS:
             v, null = _corr_finalize(
-                spec.name, state[spec.output + "$sx"],
-                state[spec.output + "$sy"], state[spec.output + "$sxy"],
-                state[spec.output + "$sx2"], state[spec.output + "$sy2"],
+                spec.name, state[spec.output + "$m2x"],
+                state[spec.output + "$m2y"], state[spec.output + "$cxy"],
                 state[spec.output + "$count"])
             cols[spec.output] = Column(v, null)
     return Batch(cols, occupied)
@@ -1009,21 +1096,32 @@ def probe_join(batch: Batch, table: BuildTable, probe_keys: List[str],
 
 
 def semi_join_mark(batch: Batch, table: BuildTable, probe_keys: List[str],
-                   salt: int = 0) -> Column:
-    """True per row iff the key exists in the build table (SemiJoin
-    marker).  NULL probe keys never match (callers exclude NULL build keys
-    before building), consistent with the join paths and the oracle."""
+                   salt: int = 0, build_has_null=False) -> Column:
+    """SemiJoin marker with SQL three-valued semantics (reference
+    HashSemiJoinOperator): TRUE on a match, FALSE on a definite miss, NULL
+    when the probe key is NULL or when there is no match but the build side
+    contained a NULL key (x IN (..., NULL) is UNKNOWN, never FALSE).
+    Callers exclude NULL build keys before building and pass
+    `build_has_null` (python bool or traced scalar) to report them."""
     kh = _orderable_hash(hash_columns(
         [batch.columns[k] for k in probe_keys], salt))
     lo = jnp.clip(jnp.searchsorted(table.keyhash_sorted, kh, side="left",
                                    method="scan_unrolled")
                   .astype(jnp.int32), 0, table.perm.shape[0] - 1)
     hit = table.keyhash_sorted[lo] == kh
+    probe_null = None
     for k in probe_keys:
         nn = batch.columns[k].nulls
         if nn is not None:
             hit = hit & ~nn
-    return Column(hit, None)
+            probe_null = nn if probe_null is None else probe_null | nn
+    if probe_null is None and isinstance(build_has_null, bool) \
+            and not build_has_null:
+        return Column(hit, None)
+    nulls = ~hit & build_has_null
+    if probe_null is not None:
+        nulls = nulls | probe_null
+    return Column(hit, nulls)
 
 
 # ---------------------------------------------------------------------------

@@ -22,6 +22,7 @@ import dataclasses
 import itertools
 import json
 from dataclasses import dataclass, field
+from functools import partial
 from typing import Callable, Dict, Iterator, List, Optional, Tuple
 
 import jax
@@ -1723,9 +1724,10 @@ class PlanCompiler:
         key = node.source_join_variable.name
         fkey = node.filtering_source_join_variable.name
 
-        @jax.jit
-        def step(batch, table):
-            marker = ops.semi_join_mark(batch, table, [key])
+        @partial(jax.jit, static_argnames=("build_has_null",))
+        def step(batch, table, build_has_null):
+            marker = ops.semi_join_mark(batch, table, [key],
+                                        build_has_null=build_has_null)
             return batch.with_columns({node.semi_join_output.name: marker})
 
         def gen():
@@ -1741,11 +1743,12 @@ class PlanCompiler:
                     yield b.with_columns({node.semi_join_output.name: Column(
                         jnp.zeros(b.capacity, dtype=bool), None)})
                 return
-            from .fused import _drop_null_keys
+            from .fused import _build_has_null_key, _drop_null_keys
+            has_null = _build_has_null_key(build_batch, (fkey,))
             table = _jits()[1](_drop_null_keys(build_batch, (fkey,)),
                                (fkey,))
             for b in src.batches():
-                yield step(b, table)
+                yield step(b, table, has_null)
         return BatchSource(gen, names, types)
 
     def _compile_AssignUniqueIdNode(self, node: P.AssignUniqueIdNode) -> BatchSource:

@@ -695,16 +695,27 @@ def _exec_EnforceSingleRowNode(node: P.EnforceSingleRowNode) -> Table:
 
 
 def _exec_SemiJoinNode(node: P.SemiJoinNode) -> Table:
+    """Three-valued marker (reference HashSemiJoinOperator): TRUE on match,
+    NULL when the probe key is NULL or the build side contains NULL and
+    there is no match, FALSE only on a definite miss."""
     src = _exec(node.source)
     filt = _exec(node.filtering_source)
     fv, fm = filt.cols[node.filtering_source_join_variable.name]
     fvals = {x for i, x in enumerate(fv.tolist())
              if fm is None or not fm[i]}     # NULL keys never match
+    build_has_null = fm is not None and bool(np.any(fm))
     sv, sm = src.cols[node.source_join_variable.name]
-    marker = np.array([(sm is None or not sm[i]) and x in fvals
-                       for i, x in enumerate(sv.tolist())])
+    marker = np.zeros(src.n, dtype=bool)
+    nulls = np.zeros(src.n, dtype=bool)
+    for i, x in enumerate(sv.tolist()):
+        if sm is not None and sm[i]:
+            nulls[i] = True
+        elif x in fvals:
+            marker[i] = True
+        elif build_has_null:
+            nulls[i] = True
     cols = dict(src.cols)
-    cols[node.semi_join_output.name] = (marker, None)
+    cols[node.semi_join_output.name] = (marker, nulls if nulls.any() else None)
     return Table(cols, src.n)
 
 

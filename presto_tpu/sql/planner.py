@@ -836,9 +836,10 @@ class Planner:
         inner_conjs, corr, mixed, _ = self._subquery_parts(subq, scope)
         if corr or mixed:
             raise PlanningError("correlated IN subquery not supported")
-        # NOTE: NOT IN over a build side containing NULLs should yield no
-        # rows (SQL three-valued semantics); TPC-H/DS key columns are
-        # non-null so the anti-join mark is exact here.
+        # The semi-join marker is three-valued (NULL probe key, or miss
+        # against a NULL-bearing build side → NULL); NOT over it is Kleene,
+        # so `x NOT IN (subquery)` drops rows whose membership is UNKNOWN,
+        # per SQL semantics (reference HashSemiJoinOperator).
         sub_node, _, sub_vars = self.plan_query_any(subq)
         if len(sub_vars) != 1:
             raise PlanningError("IN subquery must produce one column")
@@ -1871,8 +1872,14 @@ def _canon(e: A.Node, scope: Optional[Scope] = None) -> str:
         parts = [c(p) for p in e.partition_by]
         orders = [f"{c(oi.expr)}:{oi.ascending}:{oi.nulls_first}"
                   for oi in e.order_by]
+        if e.frame is not None:
+            f = e.frame
+            frame = (f" {f.frame_type} {f.start_kind}:{f.start_offset}"
+                     f"..{f.end_kind}:{f.end_offset}")
+        else:
+            frame = ""
         return (f"{c(e.func)} over (partition by {','.join(parts)} "
-                f"order by {','.join(orders)})")
+                f"order by {','.join(orders)}{frame})")
     if isinstance(e, A.CastExpr):
         return f"cast({c(e.operand)} as {e.type_name})"
     if isinstance(e, A.Between):

@@ -47,6 +47,48 @@ def test_agg_differential(runner, i):
     runner.assert_same_as_reference(QUERIES[i])
 
 
+def test_scatter_path_variance_stability():
+    """The streaming scatter-table accumulator (agg_update/agg_merge) must
+    not catastrophically cancel when |mean| >> spread.  The raw
+    sum-of-squares form collapses var(1e9 + {0,1,2,...}) to 0; the Chan
+    central-moment state keeps full precision.  Exercised directly because
+    query-level tests route through the (already stable) sort path."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from presto_tpu.exec.batch import Batch, Column
+    from presto_tpu.exec.operators import (
+        AggSpec, agg_finalize, agg_init, agg_merge, agg_update)
+
+    base = 1e9
+    vals = np.arange(20, dtype=np.float64)         # var_samp = 35.0
+    specs = (AggSpec("var_samp", "v", is_float=True),
+             AggSpec("stddev", "s", is_float=True),
+             AggSpec("corr", "c", is_float=True))
+    slots = 16
+
+    def mk_state(chunk):
+        st = agg_init(slots, specs, ("k",), (jnp.int64,))
+        x = Column(jnp.asarray(base + chunk))
+        y = Column(jnp.asarray(2.0 * chunk - base))  # corr(x, y) == 1
+        k = Column(jnp.zeros(len(chunk), dtype=jnp.int64))
+        b = Batch({"k": k}, jnp.ones(len(chunk), dtype=bool))
+        return agg_update(st, b, [k], {"v": x, "s": x, "c": x},
+                          specs, slots, 0, ("k",),
+                          agg_inputs2={"c": y})
+
+    merged = agg_merge(mk_state(vals[:7]), mk_state(vals[7:]),
+                       specs, ("k",), slots)
+    out = agg_finalize(merged, specs, ("k",), {})
+    m = np.asarray(out.mask)
+    var = float(np.asarray(out.columns["v"].values)[m][0])
+    sd = float(np.asarray(out.columns["s"].values)[m][0])
+    cr = float(np.asarray(out.columns["c"].values)[m][0])
+    assert abs(var - 35.0) < 1e-6, var
+    assert abs(sd - 35.0 ** 0.5) < 1e-6, sd
+    assert abs(cr - 1.0) < 1e-9, cr
+
+
 def test_stddev_anchor(runner):
     """Both implementations vs python statistics over the same values."""
     vals = [float(r[0]) for r in runner.execute(

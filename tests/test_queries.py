@@ -218,6 +218,38 @@ def test_like_literal_metachars():
     assert like_matcher("%special%requests%")("xx special yy requests zz")
 
 
+def test_not_in_three_valued(runner):
+    """NOT IN under SQL three-valued logic (reference HashSemiJoinOperator):
+    a NULL in the subquery makes every non-matching row UNKNOWN (dropped),
+    and a NULL probe key is UNKNOWN regardless of the build side.
+    Hand-checked counts — the oracle shares the semi-join semantics, so a
+    differential test alone cannot anchor this."""
+    # build = {NULL,1,2,3,4}: matches are definite FALSE for NOT IN, all
+    # other rows UNKNOWN -> zero rows survive
+    r = runner.execute(
+        "SELECT count(*) FROM nation WHERE n_nationkey NOT IN "
+        "(SELECT nullif(r_regionkey, 0) FROM region)")
+    assert int(r.rows[0][0]) == 0
+    # build = {1,2,3,4}, no NULL: plain anti-join, 25 - 4
+    r = runner.execute(
+        "SELECT count(*) FROM nation WHERE n_nationkey NOT IN "
+        "(SELECT r_regionkey FROM region WHERE r_regionkey > 0)")
+    assert int(r.rows[0][0]) == 21
+    # NULL probe key (nationkey=3) is UNKNOWN even without build NULLs
+    r = runner.execute(
+        "SELECT count(*) FROM nation WHERE nullif(n_nationkey, 3) NOT IN "
+        "(SELECT r_regionkey FROM region WHERE r_regionkey > 0)")
+    assert int(r.rows[0][0]) == 21
+    # positive IN: matches still found, misses vs NULL-bearing build drop
+    r = runner.execute(
+        "SELECT count(*) FROM nation WHERE n_nationkey IN "
+        "(SELECT nullif(r_regionkey, 0) FROM region)")
+    assert int(r.rows[0][0]) == 4
+    runner.assert_same_as_reference(
+        "SELECT count(*) FROM nation WHERE n_nationkey NOT IN "
+        "(SELECT nullif(r_regionkey, 0) FROM region)")
+
+
 def test_nullif_null_argument(runner):
     res = runner.execute(
         "select nullif(n_nationkey, null), nullif(0, 0) from nation "

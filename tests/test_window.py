@@ -130,12 +130,38 @@ SHAPES = {
     "empty_input": """
         SELECT orderkey, lag(totalprice) OVER (ORDER BY orderkey)
         FROM orders WHERE orderkey < 0""",
+    "same_spec_different_frames": """
+        SELECT custkey, orderkey,
+               sum(totalprice) OVER (PARTITION BY custkey ORDER BY orderkey
+                                     ROWS 1 PRECEDING),
+               sum(totalprice) OVER (PARTITION BY custkey ORDER BY orderkey
+                                     ROWS 3 PRECEDING)
+        FROM orders WHERE orderkey < 4000""",
 }
 
 
 @pytest.mark.parametrize("name", sorted(SHAPES))
 def test_window_shape(runner, name):
     runner.assert_same_as_reference(SHAPES[name])
+
+
+def test_frames_not_deduped(runner):
+    """Two window calls that differ ONLY in frame must produce distinct
+    columns (the planner dedups by canonical text — the frame is part of
+    it).  Hand-checked because the oracle runs the same planned IR and
+    would inherit a planner-side dedup bug."""
+    r = runner.execute("""
+        SELECT orderkey,
+               sum(orderkey) OVER (ORDER BY orderkey ROWS 1 PRECEDING),
+               sum(orderkey) OVER (ORDER BY orderkey ROWS 3 PRECEDING)
+        FROM orders WHERE orderkey IN (1, 2, 3, 4, 5, 6, 7)
+    """)
+    got = {int(a): (int(b), int(c)) for a, b, c in r.rows}
+    keys = sorted(got)
+    for i, k in enumerate(keys):
+        want1 = sum(keys[max(0, i - 1):i + 1])
+        want3 = sum(keys[max(0, i - 3):i + 1])
+        assert got[k] == (want1, want3), (k, got[k], (want1, want3))
 
 
 def test_hand_checked_frames(runner):
