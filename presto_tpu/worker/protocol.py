@@ -88,7 +88,13 @@ class TaskUpdateRequest:
 
     def fragment(self) -> P.PlanFragment:
         raw = base64.b64decode(self.fragment_b64)
-        return P.PlanFragment.from_dict(json.loads(raw))
+        d = json.loads(raw)
+        from .plan_translation import is_reference_fragment, translate_fragment
+        if is_reference_fragment(d):
+            # a Java-coordinator-shaped fragment (PrestoToVeloxQueryPlan
+            # seam): translate the reference plan-node/RowExpression JSON
+            return translate_fragment(d)
+        return P.PlanFragment.from_dict(d)
 
     def to_dict(self):
         return {"taskId": self.task_id, "taskIndex": self.task_index,
@@ -125,13 +131,15 @@ def from_reference_update(task_id: str, d: dict) -> "TaskUpdateRequest":
         task_index = 0
     sources = []
     for ts in ref.sources:
-        splits = []
-        for s in ts.splits:
-            sp = s.split or {}
-            splits.append(sp.get("connectorSplit", sp))
+        # raw reference split dicts; Task.start translates them inside its
+        # fail-the-task guard (a malformed split must FAIL the task, not
+        # 404/500 the update request)
+        splits = [s.split or {} for s in ts.splits]
         sources.append(TaskSource(ts.planNodeId, splits, ts.noMoreSplits))
     bufs = ref.outputIds.buffers
-    n_buffers = (max(int(v) for v in bufs.values()) + 1) if bufs else 1
+    # buffers maps bufferId -> partition; BROADCAST repeats partition 0 for
+    # every consumer, so the buffer COUNT comes from the ids
+    n_buffers = (max(int(k) for k in bufs.keys()) + 1) if bufs else 1
     ob = OutputBuffersSpec(
         "BROADCAST" if ref.outputIds.type == "BROADCAST"
         else "PARTITIONED", n_buffers, [])

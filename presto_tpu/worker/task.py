@@ -128,10 +128,11 @@ class TpuTask:
             cfg = apply_session_properties(self.config, update.session)
             ctx = TaskContext(config=cfg, task_index=update.task_index,
                               memory=MemoryPool(cfg.memory_budget_bytes))
+            from .plan_translation import translate_split
             for source in update.sources:
-                remote = [s["location"] for s in source.splits
-                          if s.get("remote")]
-                conn = [s for s in source.splits if not s.get("remote")]
+                splits = [translate_split(s) for s in source.splits]
+                remote = [s["location"] for s in splits if s.get("remote")]
+                conn = [s for s in splits if not s.get("remote")]
                 if remote:
                     ctx.remote_pages[source.plan_node_id] = \
                         remote_page_reader(remote)

@@ -53,10 +53,31 @@ def test_update_request_round_trip():
     assert back.sources[0].splits[0].sequenceId == 7
 
 
-def test_worker_accepts_reference_shaped_update():
-    """POST a reference-shaped TaskUpdateRequest (session/sources/
-    outputIds/fragment, HttpRemoteTask.java:883-936) to a live worker and
-    pull SerializedPage results — coordinator interop end to end."""
+def test_broadcast_buffer_count_from_ids():
+    """OutputBuffers maps bufferId -> partition; BROADCAST repeats
+    partition 0 for every consumer, so the buffer count must come from the
+    ids, not the partition values."""
+    from presto_tpu.worker.protocol import from_reference_update
+    body = {
+        "session": PP.SessionRepresentation(queryId="q", user="u").to_json(),
+        "extraCredentials": {},
+        "fragment": base64.b64encode(b"{}").decode(),
+        "sources": [],
+        "outputIds": PP.OutputBuffers(
+            "BROADCAST", 0, True, {"0": 0, "1": 0, "2": 0}).to_json(),
+    }
+    upd = from_reference_update("q.0.0.0.0", body)
+    assert upd.output_buffers.n_buffers == 3
+    assert upd.output_buffers.type == "BROADCAST"
+
+
+def test_worker_accepts_reference_envelope_with_repo_fragment():
+    """POST a reference-shaped TaskUpdateRequest ENVELOPE (session/sources/
+    outputIds/fragment, HttpRemoteTask.java:883-936) carrying a repo-IR
+    fragment payload and pull SerializedPage results.  This validates the
+    envelope and results protocol only; the full interop test — a
+    REFERENCE-shaped fragment with reference TpchSplit splits — is
+    test_plan_translation.py::test_worker_runs_reference_fragment_end_to_end."""
     import threading
     import urllib.request
     from presto_tpu.common.serde import deserialize_page
