@@ -204,10 +204,18 @@ class VariableWidthBlock(Block):
         lengths = (self.offsets[1:] - self.offsets[:-1])[positions]
         new_offsets = np.zeros(len(positions) + 1, dtype=np.int32)
         np.cumsum(lengths, out=new_offsets[1:])
-        out = np.empty(int(new_offsets[-1]), dtype=np.uint8)
-        for i, p in enumerate(positions):
-            out[new_offsets[i]:new_offsets[i + 1]] = (
-                self.data[self.offsets[p]:self.offsets[p + 1]])
+        total = int(new_offsets[-1])
+        if total == 0:
+            out = np.empty(0, dtype=np.uint8)
+        else:
+            # vectorized byte gather: source index = row start + offset
+            # within the row (no per-row python loop — this sits on the
+            # exchange partition-split path)
+            within = np.arange(total, dtype=np.int64) - np.repeat(
+                new_offsets[:-1].astype(np.int64), lengths)
+            src = np.repeat(self.offsets[positions].astype(np.int64),
+                            lengths) + within
+            out = self.data[src]
         return VariableWidthBlock(
             new_offsets, out,
             None if self.nulls is None else self.nulls[positions])

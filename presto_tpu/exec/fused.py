@@ -132,18 +132,9 @@ def _drop_null_keys(batch: Batch, key_names: Tuple[str, ...]) -> Batch:
 
 
 def probe_direct(batch: Batch, dt: DirectTable, key_name: str):
-    """(hit, build_row_index) for a direct-address lookup.  Misses return
-    index 0 (in-bounds garbage; callers mask/null those rows)."""
-    col = batch.columns[key_name]
-    v = col.values.astype(jnp.int64)
-    size = dt.slots.shape[0]
-    k = v - dt.base
-    inb = (k >= 0) & (k < size)
-    slot = dt.slots[jnp.clip(k, 0, size - 1).astype(jnp.int32)]
-    hit = inb & (slot >= 0)
-    if col.nulls is not None:
-        hit = hit & ~col.nulls
-    return hit, jnp.where(hit, slot, 0)
+    """(hit, build_row_index) for a direct-address lookup (shared slot
+    math: ops.direct_lookup)."""
+    return ops.direct_lookup(batch, dt, key_name)
 
 
 def probe_unique(batch: Batch, table: ops.BuildTable,
@@ -246,48 +237,8 @@ class FusedChain:
 
     def _build_for(self, build_node: P.PlanNode, keys: Tuple[str, ...],
                    for_join: bool):
-        """Returns (table, fanout, build_had_null_key) — fanout is the
-        pow2-rounded max key multiplicity (1 = unique keys) — or None when
-        fanout > MAX_EXPAND.  The null flag is computed only for semi
-        builds (for_join=False); join builds report False unconditionally
-        (they drop NULL keys either way)."""
-        comp = self.compiler
-        batch = comp._materialize_node(build_node, cache=True)
-        if batch is None:
-            batch = _empty_build_batch(build_node)
-        # only semi-join markers need the null-key flag (three-valued
-        # output); join builds skip the device round-trip it costs
-        had_null = False if for_join else _build_has_null_key(batch, keys)
-        batch = _drop_null_keys(batch, keys)
-        # dense single integer key -> direct-address table (unique keys only)
-        if len(keys) == 1:
-            col = batch.columns[keys[0]]
-            if col.values.dtype in (jnp.int64, jnp.int32, jnp.int16):
-                vmin, vmax, live = jax.device_get(
-                    _key_stats(col.values, batch.mask))
-                span = int(vmax) - int(vmin) + 1
-                if (int(live) > 0 and span <= DIRECT_TABLE_MAX
-                        and span <= max(1024, DIRECT_TABLE_SPAN_RATIO
-                                        * int(live))):
-                    size = 1 << (span - 1).bit_length()
-                    slots, dup = _direct_builder(size)(
-                        col.values, batch.mask, jnp.int64(int(vmin)))
-                    if not for_join or not bool(jax.device_get(dup)):
-                        return (DirectTable(slots, jnp.int64(int(vmin)),
-                                            dict(batch.columns)), 1,
-                                had_null)
-        from .pipeline import _jits
-        table = _jits()[1](batch, keys)
-        if not for_join:
-            return table, 1, had_null
-        kmax = int(jax.device_get(_max_run(table)))
-        if kmax <= 1:
-            return table, 1, False
-        if kmax > MAX_EXPAND:
-            return None
-        return table, 1 << (kmax - 1).bit_length(), False
+        return build_lookup(self.compiler, build_node, keys, for_join)
 
-    # -- traced: one chunk through the whole chain ------------------------
     def make(self, pos, valid, aux, expands: Tuple[int, ...],
              leaf_cap: int) -> Batch:
         meta = self.scan_meta
@@ -347,8 +298,11 @@ class FusedChain:
         build_names = {v.name for v in node.right.output_variables}
         out_names = [v.name for v in node.outputs]
         cols = dict(batch.columns)
-        for n in _join_build_cols(node, out_names, build_names):
-            cols[n] = tbl.columns[n].gather(bidx)
+        gcols = _join_build_cols(node, out_names, build_names)
+        gathered = ops._packed_gather([tbl.columns[n] for n in gcols],
+                                      bidx)
+        for n in gcols:
+            cols[n] = gathered[id(tbl.columns[n])]
         pairs = Batch(cols, batch.mask)
         matched = hit
         if node.filter is not None:
@@ -398,8 +352,11 @@ class FusedChain:
                              None if c.nulls is None
                              else jnp.tile(c.nulls, k),
                              c.dictionary, c.lazy)
-        for n in _join_build_cols(node, out_names, build_names):
-            cols[n] = tbl.columns[n].gather(bidx)
+        gcols = _join_build_cols(node, out_names, build_names)
+        gathered = ops._packed_gather([tbl.columns[n] for n in gcols],
+                                      bidx)
+        for n in gcols:
+            cols[n] = gathered[id(tbl.columns[n])]
         pair_mask = (batch.mask[None, :] & sub).reshape(k * C)
         matched = pair_mask
         if node.filter is not None:
@@ -421,6 +378,57 @@ class FusedChain:
             cols[n] = Column(c.values, c.null_mask() | fill,
                              c.dictionary, c.lazy)
         return Batch(cols, matched | fill)
+
+
+def try_direct_table(batch: Batch, key: str,
+                     allow_dup: bool) -> Optional[DirectTable]:
+    """Direct-address table for a dense single integer key, or None when
+    the key is non-integer / sparse / (for joins) duplicated.  Costs two
+    small host fetches, once per build."""
+    col = batch.columns[key]
+    if col.values.dtype not in (jnp.int64, jnp.int32, jnp.int16):
+        return None
+    vmin, vmax, live = jax.device_get(_key_stats(col.values, batch.mask))
+    span = int(vmax) - int(vmin) + 1
+    if not (int(live) > 0 and span <= DIRECT_TABLE_MAX
+            and span <= max(1024, DIRECT_TABLE_SPAN_RATIO * int(live))):
+        return None
+    size = 1 << (span - 1).bit_length()
+    slots, dup = _direct_builder(size)(col.values, batch.mask,
+                                       jnp.int64(int(vmin)))
+    if not allow_dup and bool(jax.device_get(dup)):
+        return None
+    return DirectTable(slots, jnp.int64(int(vmin)), dict(batch.columns))
+
+
+def build_lookup(compiler, build_node: P.PlanNode, keys: Tuple[str, ...],
+                 for_join: bool):
+    """Returns (table, fanout, build_had_null_key) — fanout is the
+    pow2-rounded max key multiplicity (1 = unique keys) — or None when
+    fanout > MAX_EXPAND.  The null flag is computed only for semi builds
+    (for_join=False); join builds report False unconditionally (they drop
+    NULL keys either way)."""
+    batch = compiler._materialize_node(build_node, cache=True)
+    if batch is None:
+        batch = _empty_build_batch(build_node)
+    # only semi-join markers need the null-key flag (three-valued
+    # output); join builds skip the device round-trip it costs
+    had_null = False if for_join else _build_has_null_key(batch, keys)
+    batch = _drop_null_keys(batch, keys)
+    if len(keys) == 1:
+        dt = try_direct_table(batch, keys[0], allow_dup=not for_join)
+        if dt is not None:
+            return dt, 1, had_null
+    from .pipeline import _jits
+    table = _jits()[1](batch, keys)
+    if not for_join:
+        return table, 1, had_null
+    kmax = int(jax.device_get(_max_run(table)))
+    if kmax <= 1:
+        return table, 1, False
+    if kmax > MAX_EXPAND:
+        return None
+    return table, 1 << (kmax - 1).bit_length(), False
 
 
 def assemble_chain(compiler, node: P.PlanNode) -> Optional[FusedChain]:
@@ -492,9 +500,15 @@ def fused_materialize(compiler, node: P.PlanNode,
     fusible chain (caller streams instead)."""
     if compiler.ctx.memory.budget is not None:
         return None     # budgeted runs keep the accounted streaming path
-    ckey = ("fmat_result", node.id)
+    # keyed STRUCTURALLY so replayed subtrees (scalar-subquery re-plans,
+    # decorrelated copies — fresh node ids, same shape) share one
+    # materialization; on a hit from a twin, columns rename positionally
+    ckey = ("fmat_result", P.structural_key(node),
+            compiler._splits_fingerprint(node))
     if cache and ckey in compiler._jit_cache:
-        return compiler._jit_cache[ckey]
+        cached, names = compiler._jit_cache[ckey]
+        return _renamed_batch(cached, names,
+                              [v.name for v in node.output_variables])
     chain = assemble_chain(compiler, node)
     if chain is None or not chain.chunks:
         return None
@@ -530,8 +544,19 @@ def fused_materialize(compiler, node: P.PlanNode,
     from .memory import batch_bytes
     out = _maybe_compact(run_all(pos_arr, cnt_arr, aux))
     if cache and _fmat_reserve(compiler, batch_bytes(out)):
-        compiler._jit_cache[ckey] = out
+        compiler._jit_cache[ckey] = \
+            (out, [v.name for v in node.output_variables])
     return out
+
+
+def _renamed_batch(batch: Batch, names: List[str],
+                   new_names: List[str]) -> Batch:
+    """Positionally rename a cached twin's columns to this subtree's
+    output names (structural equality aligns the output order)."""
+    if names == new_names:
+        return batch
+    cols = {new: batch.columns[old] for old, new in zip(names, new_names)}
+    return Batch(cols, batch.mask)
 
 
 def _join_build_cols(node: P.JoinNode, out_names, build_names):

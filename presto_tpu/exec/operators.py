@@ -605,23 +605,32 @@ def agg_span_update(state: dict, batch: Batch, codes,
                     agg_inputs: Dict[str, Optional[Column]],
                     specs: Tuple[AggSpec, ...], G: int) -> dict:
     """codes: per-row group index (int, in [0, G) for live rows); masked
-    rows are routed out of range and dropped."""
+    rows are routed out of range and dropped.
+
+    All accumulator columns of one op/dtype class are packed into a single
+    (N, k) -> (G, k) scatter: TPU scatters cost per-INDEX, so a scalar
+    scatter wastes the lane dimension — one packed scatter of k columns
+    runs ~k times faster than k scalar scatters (measured 5.5x for k=6 at
+    4M rows).  NULL handling folds into the updates (add of 0 / min of
+    +inf is a no-op), so every column shares one slot vector."""
     mask = batch.mask
     slot = jnp.where(mask, codes, G).astype(jnp.int32)
     out = dict(state)
-    out["__seen"] = state["__seen"].at[slot].add(
-        mask.astype(jnp.int64), mode="drop")
+    ones = mask.astype(jnp.int64)
+
+    adds_i: List[Tuple[str, jnp.ndarray]] = [("__seen", ones)]
+    adds_f: List[Tuple[str, jnp.ndarray]] = []
+    mins: List[Tuple[str, jnp.ndarray]] = []
+    maxs: List[Tuple[str, jnp.ndarray]] = []
     for spec in specs:
         if spec.name == "count_star":
-            out[spec.output] = state[spec.output].at[slot].add(
-                mask.astype(jnp.int64), mode="drop")
+            adds_i.append((spec.output, ones))
             continue
         col = agg_inputs[spec.output]
         valid = mask & ~col.null_mask()
-        vslot = jnp.where(valid, slot, G).astype(jnp.int32)
+        vones = valid.astype(jnp.int64)
         if spec.name == "count":
-            out[spec.output] = state[spec.output].at[vslot].add(
-                jnp.ones_like(vslot, dtype=jnp.int64), mode="drop")
+            adds_i.append((spec.output, vones))
             continue
         v = col.values
         if spec.is_float and v.dtype != jnp.float64:
@@ -630,22 +639,39 @@ def agg_span_update(state: dict, batch: Batch, codes,
             v = v.astype(jnp.int64)
         if spec.name in ("sum", "avg"):
             key = spec.output if spec.name == "sum" else spec.output + "$sum"
-            out[key] = state[key].at[vslot].add(v, mode="drop")
-            ckey = spec.output + "$count"
-            out[ckey] = state[ckey].at[vslot].add(
-                jnp.ones_like(vslot, dtype=jnp.int64), mode="drop")
-        elif spec.name == "min":
-            out[spec.output] = state[spec.output].at[vslot].min(
-                v, mode="drop")
-            out[spec.output + "$count"] = \
-                state[spec.output + "$count"].at[vslot].add(
-                    jnp.ones_like(vslot, dtype=jnp.int64), mode="drop")
-        elif spec.name == "max":
-            out[spec.output] = state[spec.output].at[vslot].max(
-                v, mode="drop")
-            out[spec.output + "$count"] = \
-                state[spec.output + "$count"].at[vslot].add(
-                    jnp.ones_like(vslot, dtype=jnp.int64), mode="drop")
+            (adds_f if spec.is_float else adds_i).append(
+                (key, jnp.where(valid, v, jnp.zeros((), v.dtype))))
+            adds_i.append((spec.output + "$count", vones))
+        elif spec.name in ("min", "max"):
+            is_min = spec.name == "min"
+            ident = ((jnp.inf if is_min else -jnp.inf) if spec.is_float
+                     else (INT64_MAX if is_min else INT64_MIN))
+            upd = jnp.where(valid, v, jnp.asarray(ident, v.dtype))
+            (mins if is_min else maxs).append((spec.output, upd))
+            adds_i.append((spec.output + "$count", vones))
+
+    def apply(group, op):
+        if not group:
+            return
+        if len(group) == 1:
+            key, upd = group[0]
+            out[key] = getattr(state[key].at[slot], op)(upd, mode="drop")
+            return
+        acc = jnp.stack([state[k] for k, _ in group], axis=1)
+        upd = jnp.stack([u for _, u in group], axis=1)
+        acc = getattr(acc.at[slot], op)(upd, mode="drop")
+        for i, (key, _) in enumerate(group):
+            out[key] = acc[:, i]
+
+    apply(adds_i, "add")
+    apply(adds_f, "add")
+    # min/max need dtype-uniform packing; split by dtype
+    for group, op in ((mins, "min"), (maxs, "max")):
+        by_dt: Dict = {}
+        for key, upd in group:
+            by_dt.setdefault(upd.dtype, []).append((key, upd))
+        for sub in by_dt.values():
+            apply(sub, op)
     return out
 
 
@@ -653,7 +679,9 @@ def agg_span_finalize(state: dict, specs: Tuple[AggSpec, ...],
                       key_names: Tuple[str, ...],
                       key_arrays: Dict[str, jnp.ndarray],
                       key_dicts: Dict[str, Tuple[str, ...]],
-                      key_lazy: Optional[Dict[str, Tuple]] = None) -> Batch:
+                      key_lazy: Optional[Dict[str, Tuple]] = None,
+                      key_nulls: Optional[Dict[str, jnp.ndarray]] = None
+                      ) -> Batch:
     """key_arrays: slot-index -> key value per key (reconstructed by the
     caller, e.g. base + arange(G) for a single-int-key span)."""
     fake = dict(state)
@@ -661,8 +689,106 @@ def agg_span_finalize(state: dict, specs: Tuple[AggSpec, ...],
     G = state["__seen"].shape[0]
     for k in key_names:
         fake[f"__key_{k}"] = key_arrays[k]
-        fake[f"__keynull_{k}"] = jnp.zeros(G, dtype=bool)
+        fake[f"__keynull_{k}"] = (key_nulls or {}).get(
+            k, jnp.zeros(G, dtype=bool))
     return agg_finalize(fake, specs, key_names, key_dicts, key_lazy)
+
+
+def _depkey_as_int64(col: Column):
+    """A grouping key's values as an exact int64 representation (floats
+    bitcast — the dependency check needs per-group CONSTANCY, and rows of
+    one underlying source row carry bit-identical values)."""
+    v = col.values
+    if v.dtype == jnp.float64:
+        return jax.lax.bitcast_convert_type(v, jnp.int64)
+    if v.dtype == jnp.float32:
+        return jax.lax.bitcast_convert_type(v, jnp.int32).astype(jnp.int64)
+    if v.dtype == jnp.bool_:
+        return v.astype(jnp.int64)
+    return v.astype(jnp.int64)
+
+
+def _depkey_restore(minv, dtype):
+    if dtype == jnp.float64:
+        return jax.lax.bitcast_convert_type(minv, jnp.float64)
+    if dtype == jnp.float32:
+        return jax.lax.bitcast_convert_type(
+            minv.astype(jnp.int32), jnp.float32)
+    return minv.astype(dtype)
+
+
+def depkey_init(G: int, names: Tuple[str, ...]) -> dict:
+    """Accumulators verifying that grouping keys are CONSTANT within each
+    anchor-key group (the runtime-span multi-key scheme: group by one
+    integer anchor, prove the other keys functionally dependent)."""
+    st = {}
+    for k in names:
+        st[f"__dep_{k}$min"] = jnp.full(G, INT64_MAX, dtype=jnp.int64)
+        st[f"__dep_{k}$max"] = jnp.full(G, INT64_MIN, dtype=jnp.int64)
+        st[f"__dep_{k}$nulls"] = jnp.zeros(G, dtype=jnp.int64)
+    return st
+
+
+def depkey_update(st: dict, batch: Batch, codes, key_cols: Dict[str, Column],
+                  G: int) -> dict:
+    """Constancy tracking for the dependent grouping keys in as few
+    scatters as possible: min and NEGATED max share one packed min-scatter
+    (max(x) == -min(-x); identities chosen so INT64_MIN never negates),
+    and null counting is skipped entirely for columns with no null mask
+    (lazy row-ids / dictionary codes — the common case)."""
+    out = dict(st)
+    if not key_cols:
+        return out
+    mask = batch.mask
+    slot = jnp.where(mask, codes, G).astype(jnp.int32)
+    names = list(key_cols)
+    mins, nulls_names, nulls = [], [], []
+    for k in names:
+        c = key_cols[k]
+        v = _depkey_as_int64(c)
+        if c.nulls is None:
+            valid = mask
+        else:
+            valid = mask & ~c.nulls
+            nulls_names.append(k)
+            nulls.append((mask & c.nulls).astype(jnp.int64))
+        mins.append(jnp.where(valid, v, INT64_MAX))
+        # negated-max lane: min over (-v) recovers max; clamp so the
+        # identity never overflows on negation
+        mins.append(jnp.where(valid, -jnp.maximum(v, -INT64_MAX),
+                              INT64_MAX))
+    acc = jnp.stack(
+        [st[f"__dep_{k}$min"] for k in names]
+        + [-jnp.maximum(st[f"__dep_{k}$max"], -INT64_MAX) for k in names],
+        axis=1)
+    # interleave is (min_0, negmax_0, min_1, negmax_1, ...) for updates but
+    # (mins..., negmaxs...) for state — align both as [mins..., negmaxs...]
+    upd = jnp.stack([mins[2 * i] for i in range(len(names))]
+                    + [mins[2 * i + 1] for i in range(len(names))], axis=1)
+    acc = acc.at[slot].min(upd, mode="drop")
+    for i, k in enumerate(names):
+        out[f"__dep_{k}$min"] = acc[:, i]
+        out[f"__dep_{k}$max"] = -acc[:, len(names) + i]
+    if nulls:
+        nacc = jnp.stack([st[f"__dep_{k}$nulls"] for k in nulls_names],
+                         axis=1)
+        nacc = nacc.at[slot].add(jnp.stack(nulls, axis=1), mode="drop")
+        for i, k in enumerate(nulls_names):
+            out[f"__dep_{k}$nulls"] = nacc[:, i]
+    return out
+
+
+def depkey_verify(st: dict, seen, names: Tuple[str, ...]):
+    """All-groups scalar: every dependent key is uniform (one non-null
+    value, or all NULL) within every occupied group."""
+    ok = jnp.ones((), dtype=bool)
+    for k in names:
+        minv = st[f"__dep_{k}$min"]
+        maxv = st[f"__dep_{k}$max"]
+        nc = st[f"__dep_{k}$nulls"]
+        uniform = ((nc == 0) & (minv == maxv)) | (nc == seen)
+        ok = ok & jnp.all(uniform | (seen == 0))
+    return ok
 
 
 def _decimal_avg(s, cnt, empty):
@@ -672,6 +798,35 @@ def _decimal_avg(s, cnt, empty):
     safe = jnp.where(empty, 1, cnt)
     q = jnp.sign(s) * ((jnp.abs(s) + safe // 2) // safe)
     return q.astype(jnp.int64)
+
+
+def _packed_gather(columns: List[Column], perm) -> Dict[int, Column]:
+    """Gather columns through one permutation with dtype-packed indexing:
+    same-dtype value arrays stack into an (N, k) matrix gathered ONCE
+    (TPU gathers cost per-index — k packed lanes are ~3x faster than k
+    scalar gathers), null masks pack as their own bool group.  Returns
+    {id(original column): gathered Column}."""
+    by_dtype: Dict = {}
+    for c in columns:
+        by_dtype.setdefault(c.values.dtype, []).append(c)
+    out_vals: Dict[int, jnp.ndarray] = {}
+    for items in by_dtype.values():
+        if len(items) == 1:
+            out_vals[id(items[0])] = items[0].values[perm]
+        else:
+            stacked = jnp.stack([c.values for c in items], axis=1)[perm]
+            for i, c in enumerate(items):
+                out_vals[id(c)] = stacked[:, i]
+    nullable = [c for c in columns if c.nulls is not None]
+    out_nulls: Dict[int, jnp.ndarray] = {}
+    if len(nullable) == 1:
+        out_nulls[id(nullable[0])] = nullable[0].nulls[perm]
+    elif nullable:
+        stacked = jnp.stack([c.nulls for c in nullable], axis=1)[perm]
+        for i, c in enumerate(nullable):
+            out_nulls[id(c)] = stacked[:, i]
+    return {id(c): Column(out_vals[id(c)], out_nulls.get(id(c)),
+                          c.dictionary, c.lazy) for c in columns}
 
 
 def sort_group_aggregate(batch: Batch, key_names: Tuple[str, ...],
@@ -721,33 +876,73 @@ def sort_group_aggregate(batch: Batch, key_names: Tuple[str, ...],
     seg_start_row = jax.lax.cummax(jnp.where(is_start, idx, 0)) \
         .astype(jnp.int32)
 
-    cols: Dict[str, Column] = {}
+    # -- packed gathers: the permutation gather is the dominant cost here
+    # (TPU gathers pay per-index; one (N, k) gather of k same-dtype
+    # columns runs ~3x faster than k scalar gathers), so key and input
+    # columns are stacked by dtype and gathered once per dtype
+    gather_cols: Dict[int, Column] = {}
     for k in key_names:
-        cols[k] = batch.columns[k].gather(perm)
+        gather_cols[id(batch.columns[k])] = batch.columns[k]
     for spec in specs:
-        if spec.name == "count_star":
-            contrib = live
-            x = None
-        elif spec.name == "approx_percentile":
-            contrib = live
-            x = None
+        if spec.name not in ("count_star", "approx_percentile"):
+            c = agg_inputs[spec.output]
+            gather_cols[id(c)] = c
+    if agg_inputs2:
+        for c in agg_inputs2.values():
+            gather_cols[id(c)] = c
+    gathered = _packed_gather(list(gather_cols.values()), perm)
+
+    # -- packed segment counts/sums: every spec needs its segment count,
+    # sum/avg need a value sum — ONE stacked cumsum per dtype class
+    # replaces a cumsum per spec
+    i64_items: List[jnp.ndarray] = []
+    f64_items: List[jnp.ndarray] = []
+    plan = []           # (spec, contrib, x, cnt_idx, sum_idx, is_f64)
+    for spec in specs:
+        if spec.name in ("count_star", "approx_percentile"):
+            contrib, x = live, None
         else:
-            c = agg_inputs[spec.output].gather(perm)
+            c = gathered[id(agg_inputs[spec.output])]
             contrib = live & ~c.null_mask()
             x = c.values
-        cnt0 = jnp.concatenate([jnp.zeros(1, dtype=jnp.int64),
-                                jnp.cumsum(contrib.astype(jnp.int64))])
-        cnt = cnt0[s_hi] - cnt0[s_lo]
+        cnt_idx = len(i64_items)
+        i64_items.append(contrib.astype(jnp.int64))
+        sum_idx = None
+        is_f64 = False
+        if spec.name in ("sum", "avg"):
+            dt = jnp.float64 if spec.is_float else jnp.int64
+            xv = jnp.where(contrib, x, 0).astype(dt)
+            is_f64 = spec.is_float
+            if is_f64:
+                sum_idx = len(f64_items)
+                f64_items.append(xv)
+            else:
+                sum_idx = len(i64_items)
+                i64_items.append(xv)
+        plan.append((spec, contrib, x, cnt_idx, sum_idx, is_f64))
+
+    def _seg(items, dt):
+        if not items:
+            return None
+        m = jnp.stack(items)                              # (k, N)
+        p = jnp.concatenate([jnp.zeros((len(items), 1), dtype=dt),
+                             jnp.cumsum(m, axis=1)], axis=1)
+        return p[:, s_hi] - p[:, s_lo]                    # (k, N)
+
+    seg_i = _seg(i64_items, jnp.int64)
+    seg_f = _seg(f64_items, jnp.float64)
+
+    cols: Dict[str, Column] = {}
+    for k in key_names:
+        cols[k] = gathered[id(batch.columns[k])]
+    for spec, contrib, x, cnt_idx, sum_idx, is_f64 in plan:
+        cnt = seg_i[cnt_idx]
         if spec.name in ("count", "count_star"):
             cols[spec.output] = Column(cnt, None)
             continue
         empty = cnt == 0
         if spec.name in ("sum", "avg"):
-            dt = jnp.float64 if spec.is_float else jnp.int64
-            xv = jnp.where(contrib, x, 0).astype(dt)
-            ps0 = jnp.concatenate([jnp.zeros(1, dtype=dt),
-                                   jnp.cumsum(xv)])
-            s = ps0[s_hi] - ps0[s_lo]
+            s = (seg_f if is_f64 else seg_i)[sum_idx]
             if spec.name == "sum":
                 cols[spec.output] = Column(s, empty)
             else:
@@ -803,7 +998,7 @@ def sort_group_aggregate(batch: Batch, key_names: Tuple[str, ...],
             null = cnt < (1 if pop else 2)
             cols[spec.output] = Column(v, null)
         elif spec.name in CORR_AGGS:
-            c2 = agg_inputs2[spec.output].gather(perm)
+            c2 = gathered[id(agg_inputs2[spec.output])]
             contrib2 = contrib & ~c2.null_mask()
             c0 = jnp.concatenate([jnp.zeros(1, dtype=jnp.int64),
                                   jnp.cumsum(contrib2.astype(jnp.int64))])
@@ -1049,10 +1244,12 @@ def probe_join(batch: Batch, table: BuildTable, probe_keys: List[str],
     out_mask = j < total
 
     out_cols: Dict[str, Column] = {}
+    pg = _packed_gather(list(batch.columns.values()), row)
     for name, col in batch.columns.items():
-        out_cols[name] = col.gather(row)
+        out_cols[name] = pg[id(col)]
+    bg = _packed_gather([table.columns[n] for n in build_output], build_idx)
     for name in build_output:
-        out_cols[name] = table.columns[name].gather(build_idx)
+        out_cols[name] = bg[id(table.columns[name])]
     pairs = Batch(out_cols, out_mask)
     if filter_fn is not None:
         pred = filter_fn(pairs)
@@ -1093,6 +1290,82 @@ def probe_join(batch: Batch, table: BuildTable, probe_keys: List[str],
     # is still judged against the pair region alone
     return (Batch(final_cols, final_mask), overflow,
             total + jnp.sum(extra_mask), matched)
+
+
+def direct_lookup(batch: Batch, dt, probe_key: str):
+    """(hit, build_row_index) for a direct-address table lookup —
+    THE single definition of the slot math shared by the fused chain
+    (fused.probe_direct), the streaming direct join, and the direct semi
+    marker.  Misses return index 0 (in-bounds garbage; callers mask/null
+    those rows); NULL probe keys never match."""
+    col = batch.columns[probe_key]
+    v = col.values.astype(jnp.int64)
+    size = dt.slots.shape[0]
+    k = v - dt.base
+    inb = (k >= 0) & (k < size)
+    slot = dt.slots[jnp.clip(k, 0, size - 1).astype(jnp.int32)]
+    hit = inb & (slot >= 0)
+    if col.nulls is not None:
+        hit = hit & ~col.nulls
+    return hit, jnp.where(hit, slot, 0)
+
+
+def probe_join_direct(batch: Batch, dt, probe_key: str,
+                      build_output: List[str], join_type: str = "INNER",
+                      filter_fn=None, matched=None):
+    """Fanout-1 equi-join probe against a direct-address table
+    (fused.DirectTable): ONE int32 gather instead of a searchsorted, and —
+    because each probe row yields at most one output row — the output
+    capacity equals the probe capacity, so there is no overflow flag, no
+    live-count compaction, and ZERO host syncs per batch.  Mirrors
+    probe_join's semantics: the ON-filter applies to pairs BEFORE
+    null-extension; `matched` (FULL joins) records surviving build rows."""
+    hit, bidx = direct_lookup(batch, dt, probe_key)
+    hit = hit & batch.mask
+    bidx = jnp.where(hit, bidx, 0)
+
+    out_cols: Dict[str, Column] = dict(batch.columns)
+    bg = _packed_gather([dt.columns[n] for n in build_output], bidx)
+    for name in build_output:
+        out_cols[name] = bg[id(dt.columns[name])]
+    pairs = Batch(out_cols, hit)
+    if filter_fn is not None:
+        pred = filter_fn(pairs)
+        keep = pred.values.astype(bool)
+        if pred.nulls is not None:
+            keep = keep & ~pred.nulls
+        hit = hit & keep
+        pairs = pairs.with_mask(hit)
+    if matched is not None:
+        nbuild = matched.shape[0]
+        vslot = jnp.where(hit, bidx, nbuild)
+        matched = matched.at[vslot].max(hit, mode="drop")
+    if join_type == "INNER":
+        return pairs, matched
+    # LEFT/FULL: rows without a surviving match keep their probe columns
+    # and read NULL on the build side (in-place, no extra row region —
+    # fanout is 1, so the null-extended row IS the probe row)
+    final_cols = dict(batch.columns)
+    for name in build_output:
+        c = pairs.columns[name]
+        nulls = ~hit if c.nulls is None else (~hit | c.nulls)
+        final_cols[name] = Column(c.values, nulls, c.dictionary, c.lazy)
+    return Batch(final_cols, batch.mask), matched
+
+
+def semi_join_mark_direct(batch: Batch, dt, probe_key: str,
+                          build_has_null=False) -> Column:
+    """semi_join_mark against a direct-address table: one int32 gather per
+    probe batch, same three-valued semantics."""
+    hit, _ = direct_lookup(batch, dt, probe_key)
+    probe_null = batch.columns[probe_key].nulls
+    if probe_null is None and isinstance(build_has_null, bool) \
+            and not build_has_null:
+        return Column(hit, None)
+    nulls = ~hit & build_has_null
+    if probe_null is not None:
+        nulls = nulls | probe_null
+    return Column(hit, nulls)
 
 
 def semi_join_mark(batch: Batch, table: BuildTable, probe_keys: List[str],

@@ -1093,46 +1093,88 @@ class PlanCompiler:
                 finally:
                     pool.free(G * 24 * max(1, len(specs)))
 
-            # runtime span: single integer key — one cheap min/max pass
-            # over the chain, then collision-free scatter-direct updates
-            if (basic and len(key_names) == 1 and key_cols[0].nulls is None
-                    and key_cols[0].values.dtype in (jnp.int64, jnp.int32,
-                                                     jnp.int16)):
-                kname = key_names[0]
-                spanp = fused_cache.get(("span_probe", expands))
+            # runtime span: one integer ANCHOR key indexes the
+            # accumulators directly (collision-free scatter-direct); any
+            # OTHER grouping keys must be functionally dependent on the
+            # anchor — verified at runtime by per-group min==max (+ null
+            # uniformity), the TPC-H Q3/Q10/Q18 shape where order/customer
+            # attributes are grouped alongside their key.  On violation
+            # the run is discarded and the sort path below takes over.
+            candidates = [i for i, c in enumerate(key_cols)
+                          if c.nulls is None and c.values.dtype in
+                          (jnp.int64, jnp.int32, jnp.int16)]
+            if basic and candidates \
+                    and all(c.values.ndim == 1 for c in key_cols):
+                cand_names = tuple(key_names[i] for i in candidates)
+                spanp = fused_cache.get(("span_probe", cand_names, expands))
                 if spanp is None:
                     @jax.jit
                     def spanp(pos_arr, cnt_arr, aux):
                         def body(i, mm):
                             b = chain.make(pos_arr[i], cnt_arr[i], aux,
                                            expands, leaf_cap)
-                            v = b.columns[kname].values.astype(jnp.int64)
-                            lo = jnp.minimum(mm[0], jnp.min(jnp.where(
-                                b.mask, v, ops.INT64_MAX)))
-                            hi = jnp.maximum(mm[1], jnp.max(jnp.where(
-                                b.mask, v, ops.INT64_MIN)))
-                            return (lo, hi)
+                            los, his = mm
+                            vs = jnp.stack(
+                                [b.columns[k].values.astype(jnp.int64)
+                                 for k in cand_names])
+                            los = jnp.minimum(los, jnp.min(jnp.where(
+                                b.mask[None, :], vs, ops.INT64_MAX),
+                                axis=1))
+                            his = jnp.maximum(his, jnp.max(jnp.where(
+                                b.mask[None, :], vs, ops.INT64_MIN),
+                                axis=1))
+                            return (los, his)
+                        k = len(cand_names)
                         return jax.lax.fori_loop(
                             0, S, body,
-                            (jnp.int64(ops.INT64_MAX),
-                             jnp.int64(ops.INT64_MIN)))
-                    fused_cache[("span_probe", expands)] = spanp
-                span_key = ("span_range", expands)
+                            (jnp.full(k, ops.INT64_MAX, dtype=jnp.int64),
+                             jnp.full(k, ops.INT64_MIN, dtype=jnp.int64)))
+                    fused_cache[("span_probe", cand_names, expands)] = spanp
+                span_key = ("span_range", cand_names, expands)
                 if span_key in fused_cache:
-                    lo, hi = fused_cache[span_key]
+                    ranges = fused_cache[span_key]
                 else:
-                    lo, hi = jax.device_get(spanp(pos_arr, cnt_arr, aux))
-                    lo, hi = int(lo), int(hi)
-                    fused_cache[span_key] = (lo, hi)
-                span = hi - lo + 1
-                if hi >= lo and span <= ops.SPAN_AGG_MAX_GROUPS:
+                    los, his = jax.device_get(spanp(pos_arr, cnt_arr, aux))
+                    ranges = [(int(l), int(h)) for l, h in zip(los, his)]
+                    fused_cache[span_key] = ranges
+                # the anchor must be unique per group (verified below by
+                # the dependency check).  Heuristic order: "key"-named
+                # columns widest-span first (PK/FK naming convention, the
+                # finest key is the likeliest group identity), then lazy
+                # row-ids (row identity), then the rest; the first anchor
+                # that verifies is cached for re-executions.
+                viable = []
+                for ci, (lo, hi) in zip(candidates, ranges):
+                    span = hi - lo + 1
+                    if hi >= lo and span <= ops.SPAN_AGG_MAX_GROUPS:
+                        nm = key_names[ci].lower()
+                        rank = (0 if "key" in nm
+                                else 1 if key_cols[ci].lazy is not None
+                                else 2)
+                        viable.append((rank, -span, ci, span, lo))
+                viable.sort()
+                anchor_key = ("span_anchor", cand_names, expands)
+                cached_anchor = fused_cache.get(anchor_key)
+                if cached_anchor is not None:
+                    # -1 = every candidate failed once; don't re-pay the
+                    # wasted verification passes on re-execution
+                    viable = [v for v in viable if v[2] == cached_anchor]
+                attempts = [(v[2], v[3], v[4]) for v in viable[:2]]
+                if not attempts and cached_anchor is None:
+                    fused_cache[anchor_key] = -1
+                for ci, span, lo in attempts:
+                    dep_idx = [i for i in range(len(key_names)) if i != ci]
+                    dep_names = tuple(key_names[i] for i in dep_idx)
+                    kname = key_names[ci]
                     G = 1 << (span - 1).bit_length()
-                    if not pool.try_reserve(G * 24 * max(1, len(specs))):
+                    nacc = max(1, len(specs)) + len(dep_names)
+                    if not pool.try_reserve(G * 24 * nacc):
                         return None
                     try:
                         base = jnp.int64(lo)
 
-                        run = fused_cache.get(("span", G, expands))
+                        run = fused_cache.get(
+                            ("span", G, kname, dep_names, expands))
                         if run is None:
                             @jax.jit
                             def run(pos_arr, cnt_arr, state, aux, base):
@@ -1141,22 +1183,47 @@ class PlanCompiler:
                                                    aux, expands, leaf_cap)
                                     codes = b.columns[kname].values \
                                         .astype(jnp.int64) - base
-                                    return ops.agg_span_update(
+                                    st = ops.agg_span_update(
                                         st, b, codes, _agg_exprs(b),
                                         specs, G)
-                                return jax.lax.fori_loop(0, S, body, state)
-                            fused_cache[("span", G, expands)] = run
-                        state = run(pos_arr, cnt_arr,
-                                    ops.agg_span_init(G, specs),
-                                    aux, base)
+                                    return ops.depkey_update(
+                                        st, b, codes,
+                                        {k: b.columns[k]
+                                         for k in dep_names}, G)
+                                state = jax.lax.fori_loop(0, S, body,
+                                                          state)
+                                dep_ok = ops.depkey_verify(
+                                    state, state["__seen"], dep_names)
+                                return state, dep_ok
+                            fused_cache[("span", G, kname, dep_names,
+                                         expands)] = run
+                        init = {**ops.agg_span_init(G, specs),
+                                **ops.depkey_init(G, dep_names)}
+                        state, dep_ok = run(pos_arr, cnt_arr, init,
+                                            aux, base)
+                        if dep_names and not bool(jax.device_get(dep_ok)):
+                            # a grouping key varies within an anchor
+                            # group: this anchor was not unique — try the
+                            # next candidate, else the sort path below
+                            continue
+                        fused_cache[anchor_key] = ci
                         key_arrays = {kname: (
                             base + jnp.arange(G, dtype=jnp.int64))
-                            .astype(key_dtypes[0])}
+                            .astype(key_dtypes[ci])}
+                        key_nulls = {}
+                        for i in dep_idx:
+                            k = key_names[i]
+                            key_arrays[k] = ops._depkey_restore(
+                                state[f"__dep_{k}$min"], key_dtypes[i])
+                            key_nulls[k] = state[f"__dep_{k}$nulls"] > 0
                         return _maybe_compact(ops.agg_span_finalize(
                             state, specs, key_names, key_arrays,
-                            key_dicts, key_lazy))
+                            key_dicts, key_lazy, key_nulls))
                     finally:
-                        pool.free(G * 24 * max(1, len(specs)))
+                        pool.free(G * 24 * nacc)
+                else:
+                    if attempts and cached_anchor is None:
+                        fused_cache[anchor_key] = -1
 
             # high-cardinality keys: SORT-based grouping (argsort +
             # segmented scans — no scatters, which cost ~100ms/M rows on
@@ -1374,6 +1441,18 @@ class PlanCompiler:
         return BatchSource(gen, out_names, out_types)
 
     # -- joins ------------------------------------------------------------
+    def _splits_fingerprint(self, node: P.PlanNode) -> str:
+        """Task-assigned splits under a subtree, in walk order — part of
+        the structural result-cache key: two structurally equal subtrees
+        only share data when their scans cover the same splits."""
+        parts = []
+        for n in P.walk_plan(node):
+            if isinstance(n, P.TableScanNode):
+                sp = self.ctx.splits.get(n.id)
+                parts.append("-" if sp is None else json.dumps(
+                    [s.to_dict() for s in sp], sort_keys=True))
+        return "|".join(parts)
+
     def _materialize(self, src: BatchSource) -> Optional[Batch]:
         batches = list(src.batches())
         if not batches:
@@ -1387,12 +1466,31 @@ class PlanCompiler:
         """Materialize a subtree's full output as one batch, via the fused
         single-program path when the subtree is a fusible chain (zero host
         syncs), else by draining the streaming source.  cache=True keeps
-        the result HBM-resident across re-executions (join build sides)."""
-        from .fused import fused_materialize
+        the result HBM-resident across re-executions (join build sides)
+        and across structurally identical replays of the subtree (scalar-
+        subquery re-plans, decorrelated copies)."""
+        from .fused import _fmat_reserve, _renamed_batch, fused_materialize
         b = fused_materialize(self, node, cache=cache)
         if b is not None:
             return b
-        return self._materialize(self._compile(node))
+        skey = None
+        if cache and self.ctx.memory.budget is None:
+            skey = ("mat_result", P.structural_key(node),
+                    self._splits_fingerprint(node))
+            ent = self._jit_cache.get(skey)
+            if ent is not None:
+                cached, names = ent
+                return (None if cached is None else _renamed_batch(
+                    cached, names, [v.name for v in node.output_variables]))
+        out = self._materialize(self._compile(node))
+        if skey is not None:
+            from .memory import batch_bytes
+            nb = 0 if out is None else batch_bytes(out)
+            if _fmat_reserve(self, nb):
+                self._jit_cache[skey] = \
+                    (out, [] if out is None
+                     else [v.name for v in node.output_variables])
+        return out
 
     def _compile_JoinNode(self, node: P.JoinNode) -> BatchSource:
         if node.join_type not in (P.INNER, P.LEFT, P.FULL):
@@ -1545,18 +1643,46 @@ class PlanCompiler:
                 batches = _apply_dyn_filter(batches, dyn_filter, stats_ent)
                 yield from _probe_stream_inner(table, batches, build_batch)
 
+            @jax.jit
+            def step_direct(batch, dt, matched):
+                return ops.probe_join_direct(
+                    batch, dt, probe_keys[0], build_out,
+                    join_type="LEFT" if full else node.join_type,
+                    filter_fn=filter_fn, matched=matched)
+
+            def probe_stream_direct(dt, batches, build_batch,
+                                    dyn_filter=None):
+                stats_ent = None
+                if dyn_filter is not None and self.ctx.stats is not None:
+                    stats_ent = self.ctx.stats.setdefault(
+                        node.id, {"rows": 0, "wall_s": 0.0, "batches": 0})
+                    stats_ent.setdefault("dynamicFilterRowsDropped", 0)
+                batches = _apply_dyn_filter(iter(batches), dyn_filter,
+                                            stats_ent)
+                matched = (jnp.zeros(build_batch.capacity, dtype=bool)
+                           if full else None)
+                for b in batches:
+                    out, matched = step_direct(b, dt, matched)
+                    yield out.select(out_names)
+                if full:
+                    yield unmatched_build(build_batch, matched)
+
             def _probe_stream_inner(table, batches, build_batch=None):
                 # matched is threaded through for FULL joins; the build
                 # rows nobody matched are emitted null-extended at the end
                 matched = (jnp.zeros(build_batch.capacity, dtype=bool)
                            if full else None)
-                # dispatch runs ahead of the per-batch overflow fetch
-                # (lookahead window): the host sync for batch i overlaps
-                # the device computing batch i+1, halving the
-                # sync-per-batch wall cost of non-fused probe streams
+                # windowed drains: dispatch up to K probe batches, then
+                # fetch ALL their (overflow, live) scalars in ONE
+                # device_get — one tunnel round trip (~100ms on the axon
+                # link) per K batches instead of per batch.  K shrinks as
+                # join_out_capacity grows so the in-flight padded join
+                # outputs stay bounded in HBM.
                 from collections import deque
                 work = deque()
                 inflight = deque()   # (piece, joined, overflow, total)
+                K = max(2, min(8, (1 << 22) // max(1,
+                                                   cfg.join_out_capacity)))
 
                 def submit(piece):
                     nonlocal matched
@@ -1564,36 +1690,41 @@ class PlanCompiler:
                                                             matched)
                     inflight.append((piece, joined, overflow, total))
 
-                def drain_one():
-                    piece, joined, overflow, total = inflight.popleft()
-                    ov, live = jax.device_get((overflow, total))
-                    if bool(ov):
-                        # recursive halving on output overflow: high-
-                        # fanout probes (worst case a constant-key cross
-                        # join) split until each piece fits
-                        if piece.capacity <= 1:
-                            raise RuntimeError(
-                                "join output overflow on a single "
-                                "probe row: raise join_out_capacity")
-                        work.extendleft(reversed(_split_batch(piece)))
-                        return None
-                    return shrink(joined, live).select(out_names)
-
                 batches = iter(batches)
+                exhausted = False
                 while True:
-                    while len(inflight) < 2:
+                    # overflow-split pieces (work) refill regardless of
+                    # iterator exhaustion — only NEW batches stop coming
+                    while len(inflight) < K:
                         if work:
                             submit(work.popleft())
                             continue
+                        if exhausted:
+                            break
                         nxt = next(batches, None)
                         if nxt is None:
+                            exhausted = True
                             break
                         submit(nxt)
                     if not inflight:
                         break
-                    out = drain_one()
-                    if out is not None:
-                        yield out
+                    metas = jax.device_get(
+                        [(ov, tot) for _p, _j, ov, tot in inflight])
+                    window = list(inflight)
+                    inflight.clear()
+                    for (piece, joined, _o, _t), (ovv, livev) in zip(
+                            window, metas):
+                        if bool(ovv):
+                            # recursive halving on output overflow: high-
+                            # fanout probes (worst case a constant-key
+                            # cross join) split until each piece fits
+                            if piece.capacity <= 1:
+                                raise RuntimeError(
+                                    "join output overflow on a single "
+                                    "probe row: raise join_out_capacity")
+                            work.extendleft(reversed(_split_batch(piece)))
+                            continue
+                        yield shrink(joined, livev).select(out_names)
                 if full:
                     yield unmatched_build(build_batch, matched)
 
@@ -1642,10 +1773,21 @@ class PlanCompiler:
                         for batch in probe.batches():
                             yield null_extended(batch)
                         return
-                    from .fused import _drop_null_keys
-                    table = _jits()[1](
-                        _drop_null_keys(build_batch, tuple(build_keys)),
-                        tuple(build_keys))
+                    from .fused import _drop_null_keys, try_direct_table
+                    dropped = _drop_null_keys(build_batch,
+                                              tuple(build_keys))
+                    dt = (try_direct_table(dropped, build_keys[0],
+                                           allow_dup=False)
+                          if len(build_keys) == 1 else None)
+                    if dt is not None:
+                        # dense unique integer key: fanout-1 direct probe,
+                        # zero per-batch host syncs (no overflow/live
+                        # fetch — output capacity == probe capacity)
+                        yield from probe_stream_direct(
+                            dt, probe.batches(), build_batch,
+                            dyn_filter=make_dynamic_filter(build_batch))
+                        return
+                    table = _jits()[1](dropped, tuple(build_keys))
                     yield from probe_stream(
                         table, probe.batches(), build_batch,
                         dyn_filter=make_dynamic_filter(build_batch))
@@ -1730,6 +1872,12 @@ class PlanCompiler:
                                         build_has_null=build_has_null)
             return batch.with_columns({node.semi_join_output.name: marker})
 
+        @partial(jax.jit, static_argnames=("build_has_null",))
+        def step_direct(batch, dt, build_has_null):
+            marker = ops.semi_join_mark_direct(
+                batch, dt, key, build_has_null=build_has_null)
+            return batch.with_columns({node.semi_join_output.name: marker})
+
         def gen():
             from .fused import fused_stream
             fs = fused_stream(self, node)
@@ -1743,10 +1891,16 @@ class PlanCompiler:
                     yield b.with_columns({node.semi_join_output.name: Column(
                         jnp.zeros(b.capacity, dtype=bool), None)})
                 return
-            from .fused import _build_has_null_key, _drop_null_keys
+            from .fused import (_build_has_null_key, _drop_null_keys,
+                                try_direct_table)
             has_null = _build_has_null_key(build_batch, (fkey,))
-            table = _jits()[1](_drop_null_keys(build_batch, (fkey,)),
-                               (fkey,))
+            dropped = _drop_null_keys(build_batch, (fkey,))
+            dt = try_direct_table(dropped, fkey, allow_dup=True)
+            if dt is not None:
+                for b in src.batches():
+                    yield step_direct(b, dt, has_null)
+                return
+            table = _jits()[1](dropped, (fkey,))
             for b in src.batches():
                 yield step(b, table, has_null)
         return BatchSource(gen, names, types)

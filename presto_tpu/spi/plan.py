@@ -874,3 +874,41 @@ def walk_plan(node: PlanNode):
     yield node
     for s in node.sources:
         yield from walk_plan(s)
+
+
+def structural_key(node: PlanNode) -> str:
+    """Canonical text of a subtree that is identical for structurally
+    equal plans regardless of node ids or variable names — node ids are
+    blanked and variables renamed by first occurrence in a deterministic
+    (sorted-key) traversal.  Lets execution-layer result caches recognize
+    REPLAYED subtrees (scalar-subquery re-plans, decorrelated deep copies)
+    whose node ids differ; a false mismatch only costs a cache miss, and
+    structural equality implies identical output data (generated connector
+    data is immutable and AssignUniqueId ids are deterministic)."""
+    rename: Dict[str, str] = {}
+
+    def canon(x):
+        if isinstance(x, dict):
+            if x.get("@type") == "variable" and "name" in x:
+                nm = x["name"]
+                if nm not in rename:
+                    rename[nm] = f"v{len(rename)}"
+                return {"@type": "variable", "name": rename[nm],
+                        "type": x.get("type")}
+            out = {}
+            for k in sorted(x):
+                v = x[k]
+                if k == "id":
+                    out[k] = ""
+                elif k == "dynamicFilters" and isinstance(v, dict):
+                    # ids are planner counters; values are variable names
+                    out[k] = sorted(rename.get(n, n) for n in v.values())
+                else:
+                    out[k] = canon(v)
+            return out
+        if isinstance(x, list):
+            return [canon(i) for i in x]
+        return x
+
+    import json as _json
+    return _json.dumps(canon(node.to_dict()), sort_keys=True, default=str)
