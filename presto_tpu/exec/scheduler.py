@@ -78,16 +78,17 @@ def _fnv1a64_rows(block) -> np.ndarray:
     h = np.full(n, _FNV_OFFSET, dtype=np.uint64)
     if n == 0:
         return h
-    max_len = int(lengths.max(initial=0))
+    # active-set shrink keeps this O(total_bytes): each pass only touches
+    # rows still longer than j, so one long outlier string doesn't make
+    # every row pay for its length
+    active = np.flatnonzero(lengths > 0)
+    j = 0
     with np.errstate(over="ignore"):
-        for j in range(max_len):
-            alive = lengths > j
-            if not alive.any():
-                break
-            idx = np.where(alive, offsets[:-1] + j, 0)
-            b = data[idx].astype(np.uint64)
-            hj = (h ^ b) * _FNV_PRIME
-            h = np.where(alive, hj, h)
+        while active.size:
+            b = data[offsets[active] + j].astype(np.uint64)
+            h[active] = (h[active] ^ b) * _FNV_PRIME
+            j += 1
+            active = active[lengths[active] > j]
     return h
 
 
@@ -182,6 +183,10 @@ class StageInfo:
     # column order for positional renaming at the consumer
     device_out: Optional[list] = None
     out_names: Optional[List[str]] = None
+    # concurrency telemetry: per-task wall seconds and the stage wall —
+    # overlap quality = stage_wall / sum(task_walls)
+    task_walls: Optional[List[float]] = None
+    stage_wall: Optional[float] = None
 
 
 class InProcessScheduler:
@@ -278,9 +283,13 @@ class InProcessScheduler:
                    if pin or ici else [None] * stage.n_tasks)
 
         import contextlib
+        import time as _time
         import jax
-        task_batches: List = []
-        for task_index in range(stage.n_tasks):
+
+        def run_task(task_index: int):
+            """One task's fragment execution; returns (batch-or-None for
+            ICI stages, wall seconds)."""
+            t0 = _time.perf_counter()
             ctx = TaskContext(config=self.config.exec_config,
                               task_index=task_index)
             for node_id, splits in scan_splits.items():
@@ -297,26 +306,51 @@ class InProcessScheduler:
             compiler = PlanCompiler(ctx)
             dev_ctx = (jax.default_device(devices[task_index])
                        if pin else contextlib.nullcontext())
+            out = None
             with dev_ctx:
                 if ici:
                     from .pipeline import _compact_concat
                     batches = [b for b in
                                compiler.run_to_batches(frag.root)]
-                    task_batches.append(
-                        _compact_concat(batches) if batches else None)
-                    continue
-                for page in compiler.run_to_pages(frag.root):
-                    if hashed and stage.n_partitions > 1:
-                        targets = partition_targets(
-                            page, out_types, key_indices,
-                            stage.n_partitions)
-                        for p, sub in enumerate(
-                                split_page(page, targets,
-                                           stage.n_partitions)):
-                            if sub is not None:
-                                stage.buffers.add(task_index, p, sub)
-                    else:
-                        stage.buffers.add(task_index, 0, page)
+                    out = _compact_concat(batches) if batches else None
+                else:
+                    for page in compiler.run_to_pages(frag.root):
+                        if hashed and stage.n_partitions > 1:
+                            targets = partition_targets(
+                                page, out_types, key_indices,
+                                stage.n_partitions)
+                            for p, sub in enumerate(
+                                    split_page(page, targets,
+                                               stage.n_partitions)):
+                                if sub is not None:
+                                    stage.buffers.add(task_index, p, sub)
+                        else:
+                            stage.buffers.add(task_index, 0, page)
+            return out, _time.perf_counter() - t0
+
+        # a stage's N tasks run CONCURRENTLY (reference
+        # SqlStageExecution.scheduleTask / the worker TaskExecutor thread
+        # pool): each task's host syncs release the GIL while waiting on
+        # its device, so other tasks keep dispatching — stage wall
+        # approaches the slowest task, not the sum.  jax.default_device
+        # is thread-local, so per-device pinning survives threading.
+        stage_t0 = _time.perf_counter()
+        # concurrency requires memory isolation: pinned tasks own their
+        # device; unpinned tasks share one device, so when a memory
+        # budget is configured their independent per-task pools would
+        # stack to n_tasks x budget — run those sequentially
+        concurrent = stage.n_tasks > 1 and (
+            pin or self.config.exec_config.memory_budget_bytes is None)
+        if not concurrent:
+            results = [run_task(i) for i in range(stage.n_tasks)]
+        else:
+            from concurrent.futures import ThreadPoolExecutor
+            with ThreadPoolExecutor(max_workers=stage.n_tasks) as pool_ex:
+                results = list(pool_ex.map(run_task,
+                                           range(stage.n_tasks)))
+        task_batches = [r[0] for r in results]
+        stage.task_walls = [round(r[1], 4) for r in results]
+        stage.stage_wall = round(_time.perf_counter() - stage_t0, 4)
         if ici:
             keys = tuple(out_names[i] for i in key_indices)
             if not self._ici_exchange(stage, task_batches, keys):
