@@ -1,0 +1,157 @@
+"""Page compression codecs, byte-interoperable with the reference's
+``PagesSerdeFactory`` codec set (PagesSerdeFactory.java:69-108):
+
+    GZIP | LZ4 | LZO | SNAPPY | ZLIB | ZSTD | NONE
+
+The reference compresses page bodies with airlift *aircompressor* codecs,
+which use the raw container-less encodings: LZ4 block format (not LZ4
+frame), raw Snappy block format, standard zstd frames, and RFC-1950/1952
+for ZLIB/GZIP.  pyarrow's bundled codecs emit the same encodings
+(``lz4_raw``/``snappy``/``zstd``), so bytes produced here decode on the
+Java side and vice versa.  LZO has no system codec available and is the
+one codec we do not support (it is effectively dead in the reference too).
+
+The codec is cluster configuration, not wire metadata: the SerializedPage
+header only carries the COMPRESSED marker bit (PageCodecMarker.java:27),
+so serializer and deserializer must agree on the codec out of band exactly
+like the reference's ``exchange.compression-codec`` config.
+"""
+from __future__ import annotations
+
+import zlib
+from typing import Callable, Dict, Tuple
+
+try:
+    import pyarrow as _pa
+except Exception:  # pragma: no cover - pyarrow is baked into the image
+    _pa = None
+
+
+def _pa_compress(codec: str) -> Callable[[bytes], bytes]:
+    def compress(data: bytes) -> bytes:
+        return bytes(_pa.compress(data, codec=codec, asbytes=True))
+    return compress
+
+
+def _pa_decompress(codec: str) -> Callable[[bytes, int], bytes]:
+    def decompress(data: bytes, uncompressed_size: int) -> bytes:
+        return bytes(_pa.decompress(data, decompressed_size=uncompressed_size,
+                                    codec=codec, asbytes=True))
+    return decompress
+
+
+# --- pure-python LZ4 block codec -------------------------------------------
+# Fallback when pyarrow is unavailable; the decoder doubles as an
+# independent spec check in tests (it shares no code with pyarrow's C LZ4).
+
+def lz4_block_decompress(data: bytes, uncompressed_size: int) -> bytes:
+    """Decode one raw LZ4 block (lz4 block format spec 1.5.1)."""
+    src = memoryview(data)
+    out = bytearray()
+    i, n = 0, len(src)
+    while i < n:
+        token = src[i]
+        i += 1
+        lit_len = token >> 4
+        if lit_len == 15:
+            while True:
+                b = src[i]
+                i += 1
+                lit_len += b
+                if b != 255:
+                    break
+        out += bytes(src[i:i + lit_len])
+        i += lit_len
+        if i >= n:  # last sequence has no match part
+            break
+        offset = src[i] | (src[i + 1] << 8)
+        i += 2
+        if offset == 0:
+            raise ValueError("corrupt LZ4 block: zero match offset")
+        match_len = (token & 0xF) + 4
+        if (token & 0xF) == 15:
+            while True:
+                b = src[i]
+                i += 1
+                match_len += b
+                if b != 255:
+                    break
+        start = len(out) - offset
+        if start < 0:
+            raise ValueError("corrupt LZ4 block: offset before start")
+        for _ in range(match_len):  # byte-wise: matches may overlap forward
+            out.append(out[start])
+            start += 1
+    if len(out) != uncompressed_size:
+        raise ValueError(
+            f"LZ4 decompressed {len(out)} bytes, expected {uncompressed_size}")
+    return bytes(out)
+
+
+def _lz4_literal_compress(data: bytes) -> bytes:
+    """Literals-only LZ4 block (always valid, never smaller than input).
+
+    Only used when pyarrow is absent; the serde's compression-ratio gate
+    then simply keeps pages uncompressed, which is always correct.
+    """
+    out = bytearray()
+    i, n = 0, len(data)
+    while i < n or n == 0:
+        chunk = min(n - i, 1 << 20)
+        if chunk >= 15:
+            out.append(0xF0)
+            rest = chunk - 15
+            while rest >= 255:
+                out.append(255)
+                rest -= 255
+            out.append(rest)
+        else:
+            out.append(chunk << 4)
+        out += data[i:i + chunk]
+        i += chunk
+        if n == 0:
+            break
+    return bytes(out)
+
+
+def _zlib_compress(data: bytes) -> bytes:
+    return zlib.compress(data, 4)
+
+
+def _zlib_decompress(data: bytes, uncompressed_size: int) -> bytes:
+    return zlib.decompress(data)
+
+
+def _gzip_compress(data: bytes) -> bytes:
+    co = zlib.compressobj(4, zlib.DEFLATED, 16 + zlib.MAX_WBITS)
+    return co.compress(data) + co.flush()
+
+
+def _gzip_decompress(data: bytes, uncompressed_size: int) -> bytes:
+    return zlib.decompress(data, 16 + zlib.MAX_WBITS)
+
+
+_CODECS: Dict[str, Tuple[Callable[[bytes], bytes],
+                         Callable[[bytes, int], bytes]]] = {
+    "ZLIB": (_zlib_compress, _zlib_decompress),
+    "GZIP": (_gzip_compress, _gzip_decompress),
+}
+
+if _pa is not None:
+    _CODECS["LZ4"] = (_pa_compress("lz4_raw"), _pa_decompress("lz4_raw"))
+    _CODECS["SNAPPY"] = (_pa_compress("snappy"), _pa_decompress("snappy"))
+    _CODECS["ZSTD"] = (_pa_compress("zstd"), _pa_decompress("zstd"))
+else:  # pragma: no cover
+    _CODECS["LZ4"] = (_lz4_literal_compress, lz4_block_decompress)
+
+
+def supported_codecs():
+    return sorted(_CODECS) + ["NONE"]
+
+
+def compress(codec: str, data: bytes) -> bytes:
+    return _CODECS[codec.upper()][0](data)
+
+
+def decompress(codec: str, data: bytes, uncompressed_size: int) -> bytes:
+    return _CODECS[codec.upper()][1](data, uncompressed_size)

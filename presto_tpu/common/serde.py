@@ -24,6 +24,7 @@ from typing import List, Optional
 
 import numpy as np
 
+from . import compression
 from .block import (
     ArrayBlock, Block, DictionaryBlock, FixedWidthBlock, Int128Block,
     RowBlock, RunLengthBlock, VariableWidthBlock,
@@ -293,17 +294,26 @@ def _checksum(page_data: bytes, markers: int, position_count: int,
 
 
 # pages smaller than this are stored raw: compression overhead beats the
-# saved bytes (reference PagesSerde only compresses when the compressed
-# form is < ~95.7% of the original — MAX_COMPRESSION_RATIO)
+# saved bytes for tiny pages (the reference compresses unconditionally and
+# relies on the ratio gate; we additionally skip sub-4KiB bodies)
 MIN_COMPRESS_BYTES = 1 << 12
+
+# reference PagesSerde.MINIMUM_COMPRESSION_RATIO (PagesSerde.java:44):
+# keep the compressed form only when compressed/uncompressed <= 0.9
+MINIMUM_COMPRESSION_RATIO = 0.9
+
+DEFAULT_CODEC = "LZ4"
 
 
 def serialize_page(page: Page, checksummed: bool = True,
-                   compress: bool = False) -> bytes:
+                   compress: bool = False,
+                   codec: str = DEFAULT_CODEC) -> bytes:
     """Wire-format page (21-byte header + channel data); compress=True
-    deflates the body (zlib — the engine's transport codec; the marker
-    bit and uncompressedSize field follow PageCodecMarker.java:27-29 /
-    PagesSerdeUtil.java:79-88) when it actually shrinks the page."""
+    compresses the body with `codec` (LZ4 raw block format by default,
+    matching PagesSerdeFactory.java:75-76's aircompressor Lz4Compressor)
+    when it shrinks the page below the reference's MINIMUM_COMPRESSION_RATIO
+    gate (PagesSerde.java:44,138-141).  The marker bit and uncompressedSize
+    field follow PageCodecMarker.java:27-29 / PagesSerdeUtil.java:79-88."""
     body = io.BytesIO()
     body.write(struct.pack("<i", page.channel_count))
     for b in page.blocks:
@@ -311,9 +321,9 @@ def serialize_page(page: Page, checksummed: bool = True,
     data = body.getvalue()
     uncompressed = len(data)
     markers = CHECKSUMMED if checksummed else 0
-    if compress and uncompressed >= MIN_COMPRESS_BYTES:
-        packed = zlib.compress(data, 1)
-        if len(packed) < uncompressed * 0.957:
+    if compress and codec != "NONE" and uncompressed >= MIN_COMPRESS_BYTES:
+        packed = compression.compress(codec, data)
+        if len(packed) <= uncompressed * MINIMUM_COMPRESSION_RATIO:
             data = packed
             markers |= COMPRESSED
     checksum = (_checksum(data, markers, page.position_count, uncompressed)
@@ -323,8 +333,10 @@ def serialize_page(page: Page, checksummed: bool = True,
     return header + data
 
 
-def deserialize_page(buf: bytes, pos: int = 0):
-    """Returns (Page, next_pos)."""
+def deserialize_page(buf: bytes, pos: int = 0, codec: str = DEFAULT_CODEC):
+    """Returns (Page, next_pos).  `codec` names the decompressor for
+    COMPRESSED pages — cluster config, not wire metadata, exactly like the
+    reference (PagesSerde carries the configured decompressor)."""
     view = memoryview(buf)
     position_count, markers, uncompressed_size, size, checksum = struct.unpack_from(
         "<ibiiq", view, pos)
@@ -340,7 +352,8 @@ def deserialize_page(buf: bytes, pos: int = 0):
             raise ValueError(
                 f"page checksum mismatch: {actual:#x} != {checksum:#x}")
     if markers & COMPRESSED:
-        data = memoryview(zlib.decompress(bytes(data)))
+        data = memoryview(compression.decompress(
+            codec, bytes(data), uncompressed_size))
         if len(data) != uncompressed_size:
             raise ValueError(
                 f"decompressed size {len(data)} != header "
@@ -354,13 +367,15 @@ def deserialize_page(buf: bytes, pos: int = 0):
     return Page(blocks, position_count), pos + size
 
 
-def serialize_pages(pages) -> bytes:
-    return b"".join(serialize_page(p) for p in pages)
+def serialize_pages(pages, compress: bool = False,
+                    codec: str = DEFAULT_CODEC) -> bytes:
+    return b"".join(serialize_page(p, compress=compress, codec=codec)
+                    for p in pages)
 
 
-def deserialize_pages(buf: bytes):
+def deserialize_pages(buf: bytes, codec: str = DEFAULT_CODEC):
     pages, pos = [], 0
     while pos < len(buf):
-        page, pos = deserialize_page(buf, pos)
+        page, pos = deserialize_page(buf, pos, codec=codec)
         pages.append(page)
     return pages

@@ -13,6 +13,7 @@ drivers sit in pipeline.py.
 """
 from __future__ import annotations
 
+import math
 from dataclasses import dataclass
 from functools import partial
 from typing import Callable, Dict, List, Optional, Tuple
@@ -94,6 +95,83 @@ MOMENT_AGGS = {"stddev", "stddev_pop", "stddev_samp", "variance",
 CORR_AGGS = {"corr", "covar_pop", "covar_samp"}
 # aggregates only the sort path implements (need value-ordered segments)
 SORT_ONLY_AGGS = {"approx_percentile"}
+# HyperLogLog sketch aggregates (dense register arrays, scatter-max)
+HLL_AGGS = {"approx_distinct"}
+
+# Dense HLL with 2^11 registers: standard error 1.04/sqrt(2048) = 2.3%,
+# the reference's default approx_distinct error bound
+# (ApproximateCountDistinctAggregations.java DEFAULT_STANDARD_ERROR=0.023).
+HLL_DEFAULT_BUCKETS = 2048
+# reference bound on approx_distinct(x, e): lowest/highest accepted max
+# standard error (HyperLogLogUtils / NumberOfBuckets limits)
+HLL_MIN_STANDARD_ERROR = 0.0040625
+HLL_MAX_STANDARD_ERROR = 0.26
+
+
+def hll_buckets_for_error(e: float) -> int:
+    """max-standard-error -> power-of-two register count m with
+    1.04/sqrt(m) <= e, clamped to [2^4, 2^16] like the reference."""
+    if not (HLL_MIN_STANDARD_ERROR <= e <= HLL_MAX_STANDARD_ERROR):
+        raise ValueError(
+            f"approx_distinct standard error {e} out of range "
+            f"[{HLL_MIN_STANDARD_ERROR}, {HLL_MAX_STANDARD_ERROR}]")
+    m = 16
+    while 1.04 / math.sqrt(m) > e and m < (1 << 16):
+        m *= 2
+    return m
+
+
+def _bit_length64(x):
+    """Per-element bit length of a uint64 array (0 for 0)."""
+    bl = jnp.zeros(x.shape, dtype=jnp.int32)
+    for s in (32, 16, 8, 4, 2, 1):
+        big = x >= (jnp.uint64(1) << jnp.uint64(s))
+        bl = bl + jnp.where(big, s, 0)
+        x = jnp.where(big, x >> jnp.uint64(s), x)
+    return bl + (x > 0).astype(jnp.int32)
+
+
+def _hll_bucket_rank(h, m: int):
+    """uint64 hash -> (bucket index int32, rank int8).
+
+    Bucket = low log2(m) bits; rank = leading-zero count of the remaining
+    64-p bits + 1 (the HyperLogLog rho function over disjoint bit ranges)."""
+    p = m.bit_length() - 1
+    bucket = (h & jnp.uint64(m - 1)).astype(jnp.int32)
+    rem = h >> jnp.uint64(p)
+    rank = ((64 - p) - _bit_length64(rem) + 1).astype(jnp.int8)
+    return bucket, rank
+
+
+def _hll_alpha(m: int) -> float:
+    if m == 16:
+        return 0.673
+    if m == 32:
+        return 0.697
+    if m == 64:
+        return 0.709
+    return 0.7213 / (1 + 1.079 / m)
+
+
+def _hll_estimate(registers, m: int):
+    """(G, m) int8 register array -> int64 cardinality estimates (G,).
+
+    Flajolet et al. HyperLogLog with the small-range linear-counting
+    correction, the same estimator family as the reference's airlift
+    HyperLogLog (ApproximateCountDistinctAggregations.java)."""
+    R = registers.reshape(-1, m).astype(jnp.float64)
+    Z = jnp.sum(jnp.exp2(-R), axis=1)
+    E = _hll_alpha(m) * m * m / Z
+    V = jnp.sum(R == 0.0, axis=1)
+    lin = m * jnp.log(m / jnp.maximum(V.astype(jnp.float64), 1.0))
+    est = jnp.where((E <= 2.5 * m) & (V > 0), lin, E)
+    return jnp.round(est).astype(jnp.int64)
+
+
+def hll_state_bytes(specs) -> int:
+    """Extra per-slot accumulator bytes for HLL register arrays."""
+    return sum((s.param or HLL_DEFAULT_BUCKETS)
+               for s in specs if s.name in HLL_AGGS)
 
 
 def _chan_merge(na, ma, m2a, nb, mb, m2b):
@@ -186,6 +264,11 @@ def agg_init(num_slots: int, specs: Tuple[AggSpec, ...],
                                                         dtype=jnp.float64)
             state[spec.output + "$count"] = jnp.zeros(num_slots,
                                                       dtype=jnp.int64)
+        elif spec.name in HLL_AGGS:
+            m = spec.param or HLL_DEFAULT_BUCKETS
+            # flat (num_slots * m) register file: one scatter-max per batch
+            state[spec.output + "$hll"] = jnp.zeros(num_slots * m,
+                                                    dtype=jnp.int8)
         else:
             raise NotImplementedError(f"aggregate {spec.name}")
     return state
@@ -323,6 +406,15 @@ def agg_update(state: dict, batch: Batch, key_cols: List[Column],
             out[spec.output + "$m2y"] = m2y
             out[spec.output + "$cxy"] = cxy
             continue
+        if spec.name in HLL_AGGS:
+            m = spec.param or HLL_DEFAULT_BUCKETS
+            # salt-free value hash so register content is identical across
+            # probe-salt retries and across tables merged by agg_merge
+            bucket, rank = _hll_bucket_rank(hash_columns([col]), m)
+            idx = jnp.where(valid, slot * m + bucket, num_slots * m)
+            key = spec.output + "$hll"
+            out[key] = state[key].at[idx].max(rank, mode="drop")
+            continue
         v = col.values
         if spec.is_float and v.dtype != jnp.float64:
             v = v.astype(jnp.float64)
@@ -448,6 +540,14 @@ def agg_merge(a: dict, b: dict, specs: Tuple[AggSpec, ...],
             out[spec.output] = a[spec.output].at[slot].max(
                 jnp.where(mask, b[spec.output], fill), mode="drop")
             _add(spec.output + "$count")
+        elif spec.name in HLL_AGGS:
+            m = spec.param or HLL_DEFAULT_BUCKETS
+            key = spec.output + "$hll"
+            breg = b[key].reshape(-1, m)
+            rows = jnp.where(mask, slot, a["__keyhash"].shape[0])
+            out[key] = a[key].reshape(-1, m).at[rows].max(
+                jnp.where(mask[:, None], breg, jnp.int8(0)),
+                mode="drop").reshape(-1)
     return out
 
 
@@ -1121,6 +1221,11 @@ def agg_finalize(state: dict, specs: Tuple[AggSpec, ...],
                 state[spec.output + "$m2y"], state[spec.output + "$cxy"],
                 state[spec.output + "$count"])
             cols[spec.output] = Column(v, null)
+        elif spec.name in HLL_AGGS:
+            m = spec.param or HLL_DEFAULT_BUCKETS
+            # approx_distinct is never NULL: 0 over empty/all-null input
+            cols[spec.output] = Column(
+                _hll_estimate(state[spec.output + "$hll"], m), None)
     return Batch(cols, occupied)
 
 

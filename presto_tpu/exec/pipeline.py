@@ -148,6 +148,9 @@ class ExecutionConfig:
     # marker; opt-in like the reference's exchange.compression-enabled —
     # same-host exchanges have no bandwidth to save, cross-host ones do)
     exchange_compression: bool = False
+    # codec for COMPRESSED pages (reference exchange.compression-codec /
+    # PagesSerdeFactory.java:69-80): LZ4 | SNAPPY | ZSTD | GZIP | ZLIB | NONE
+    exchange_compression_codec: str = "LZ4"
 
 
 @dataclass
@@ -832,6 +835,12 @@ class PlanCompiler:
             if fname == "approx_percentile" and len(args) > 1:
                 param = float(args[1].value)
                 is_float = isinstance(args[0].type, (DoubleType, RealType))
+            if fname in ops.HLL_AGGS:
+                # optional max standard error -> register count (reference
+                # approx_distinct(x, e), ApproximateCountDistinct
+                # Aggregations.java)
+                param = (ops.hll_buckets_for_error(float(args[1].value))
+                         if len(args) > 1 else ops.HLL_DEFAULT_BUCKETS)
 
             if fname in ops.CORR_AGGS and len(args) > 1:
                 input_exprs2[v.name] = args[1]
@@ -895,6 +904,7 @@ class PlanCompiler:
             update = make_update(num_slots, salt)
 
             direct = None        # (doms, dtypes) when small-domain mode
+            hll_outs = {s.output for s in specs if s.name in ops.HLL_AGGS}
             for batch in batches:
                 if state is None:
                     for k in key_names:
@@ -909,6 +919,18 @@ class PlanCompiler:
                                 # by row id would split groups — encode to a
                                 # real whole-column dictionary on the host
                                 encode_keys.append(k)
+                    # HLL sketches hash the device values: a lazy column's
+                    # row ids are only distinct-faithful when the row id is
+                    # unique per VALUE; otherwise encode to dictionary codes
+                    for out in hll_outs:
+                        expr = input_exprs[out]
+                        if isinstance(expr, VariableReferenceExpression):
+                            col = batch.columns.get(expr.name)
+                            if col is not None and col.lazy is not None:
+                                _, tbl, coln, _sf = col.lazy
+                                if (tbl, coln) not in catalog.ROWID_DISTINCT \
+                                        and expr.name not in encode_keys:
+                                    encode_keys.append(expr.name)
                     if encode_keys:
                         batch = _encode_lazy_keys(batch, encode_keys)
                     key_cols = [batch.columns[k] for k in key_names]
@@ -952,6 +974,10 @@ class PlanCompiler:
             if not cfg.fuse_pipelines or self.ctx.stats is not None:
                 return None   # EXPLAIN ANALYZE wants per-operator stats
             if any(a.distinct or a.mask for a in node.aggregations.values()):
+                return None
+            if any(s.name in ops.HLL_AGGS for s in specs):
+                # HLL registers live in the scatter-hash table only; the
+                # fused sort path has no register file
                 return None
             from .fused import assemble_chain
             chain = assemble_chain(self, src_node)
@@ -1288,7 +1314,8 @@ class PlanCompiler:
             salt = 0
             for _attempt in range(cfg.max_agg_retries):
                 est = num_slots * (16 + 12 * len(key_names)
-                                   + 24 * max(1, len(specs)))
+                                   + 24 * max(1, len(specs))
+                                   + ops.hll_state_bytes(specs))
                 if not pool.try_reserve(est):
                     return None
                 try:
@@ -1329,7 +1356,8 @@ class PlanCompiler:
         # rough accumulator footprint for the budget check (hash + occupied
         # + per-key value/null + per-aggregate state columns)
         est_state_bytes = cfg.agg_slots * (
-            16 + 12 * len(key_names) + 24 * max(1, len(specs)))
+            16 + 12 * len(key_names) + 24 * max(1, len(specs))
+            + ops.hll_state_bytes(specs))
 
         def run_sort_fallback():
             """approx_percentile-class aggregates over a non-fused
@@ -1374,6 +1402,14 @@ class PlanCompiler:
                     yield out
                     return
             if sort_only_specs:
+                if any(s.name in ops.HLL_AGGS for s in specs):
+                    # percentile needs value-ordered segments (sort path),
+                    # HLL needs the register file (hash path) — one
+                    # aggregation node can't run both executors
+                    raise NotImplementedError(
+                        "approx_percentile and approx_distinct in the "
+                        "same aggregation are not supported; split the "
+                        "query into two aggregations")
                 yield run_sort_fallback()
                 return
             if not key_names or pool.try_reserve(est_state_bytes):

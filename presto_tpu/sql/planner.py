@@ -99,7 +99,6 @@ class Planner:
         return self.plan_query_to_output(query)
 
     def plan_query_to_output(self, query) -> P.OutputNode:
-        _rewrite_approx_distinct(query)
         node, names, out_vars = self.plan_query_any(query)
         out = P.OutputNode(self.new_id("output"), node, names, out_vars)
         from .optimizer import optimize
@@ -1606,26 +1605,6 @@ def _or_ast(disjs: List[A.Node]) -> A.Node:
     for d in disjs[1:]:
         out = A.BinaryOp("or", out, d)
     return out
-
-
-def _rewrite_approx_distinct(node) -> None:
-    """approx_distinct(x) executes as the exact count(DISTINCT x): an
-    exact answer is within the reference HLL's error bound.  Mutates the
-    AST in place so select items, HAVING, and the aggregation planner all
-    see the same canonical call."""
-    if isinstance(node, A.FuncCall) and node.name == "approx_distinct":
-        node.name = "count"
-        node.distinct = True
-    fields = vars(node).values() if isinstance(node, A.Node) else []
-    for f in fields:
-        items = f if isinstance(f, (list, tuple)) else [f]
-        for x in items:
-            if isinstance(x, (list, tuple)):
-                for y in x:
-                    if isinstance(y, A.Node):
-                        _rewrite_approx_distinct(y)
-            elif isinstance(x, A.Node):
-                _rewrite_approx_distinct(x)
 
 
 def _normalize_conjuncts(conjs: List[A.Node]) -> List[A.Node]:

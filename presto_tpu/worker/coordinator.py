@@ -193,9 +193,14 @@ class HttpQueryRunner(LocalQueryRunner):
         all_tasks: List[RemoteTask] = []
         try:
             self._schedule(root, qid, consumer_tasks=1, all_tasks=all_tasks)
+            # decode with the session's codec — workers compress every
+            # output buffer, including the root stage this pull reads
+            codec = str(self.session.get(
+                "exchange_compression_codec", "LZ4")).upper()
             pages = []
             for task in root.tasks:
-                pages.extend(pull_pages(task.result_location(0)))
+                pages.extend(pull_pages(task.result_location(0),
+                                        codec=codec))
             self._check_failures(all_tasks)
             return pages_to_result(iter(pages), names, types)
         finally:

@@ -14,7 +14,7 @@ import urllib.request
 from typing import Iterator, List
 
 from ..common.page import Page
-from ..common.serde import deserialize_pages
+from ..common.serde import DEFAULT_CODEC, deserialize_pages
 
 DEFAULT_MAX_WAIT_S = 1.0
 REQUEST_TIMEOUT_S = 30.0
@@ -27,9 +27,11 @@ def _request(url: str, method: str = "GET",
     return urllib.request.urlopen(req, timeout=timeout)
 
 
-def pull_pages(location: str) -> Iterator[Page]:
+def pull_pages(location: str, codec: str = DEFAULT_CODEC) -> Iterator[Page]:
     """Stream every page from one upstream buffer location
-    (http://host:port/v1/task/{taskId}/results/{bufferId})."""
+    (http://host:port/v1/task/{taskId}/results/{bufferId}).  `codec`
+    decodes COMPRESSED pages; it is cluster config shared with the
+    producer, like the reference exchange.compression-codec."""
     token = 0
     retries = 0
     while True:
@@ -55,7 +57,7 @@ def pull_pages(location: str) -> Iterator[Page]:
             time.sleep(min(2.0, 0.1 * (2 ** retries)))
             continue
         if body:
-            for page in deserialize_pages(body):
+            for page in deserialize_pages(body, codec=codec):
                 yield page
         if next_token != token:
             try:
@@ -71,10 +73,10 @@ def pull_pages(location: str) -> Iterator[Page]:
             return
 
 
-def remote_page_reader(locations: List[str]):
+def remote_page_reader(locations: List[str], codec: str = DEFAULT_CODEC):
     """A TaskContext.remote_pages callable: pages from every upstream task
     feeding one RemoteSourceNode."""
     def read() -> Iterator[Page]:
         for loc in locations:
-            yield from pull_pages(loc)
+            yield from pull_pages(loc, codec=codec)
     return read

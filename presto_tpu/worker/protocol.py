@@ -239,4 +239,17 @@ def apply_session_properties(config, session: Dict[str, str]):
         kw["spill_partitions"] = int(session["spill_partitions"])
     if "task_batch_rows" in session:
         kw["batch_rows"] = int(session["task_batch_rows"])
+    if "exchange_compression" in session:
+        kw["exchange_compression"] = (
+            str(session["exchange_compression"]).lower() == "true")
+    if "exchange_compression_codec" in session:
+        codec = str(session["exchange_compression_codec"]).upper()
+        from ..common.compression import supported_codecs
+        if codec not in supported_codecs():
+            # reject at task creation (fails the task with a clear error)
+            # rather than KeyError deep inside the output loop
+            raise ValueError(
+                f"unsupported exchange_compression_codec {codec!r}; "
+                f"supported: {', '.join(supported_codecs())}")
+        kw["exchange_compression_codec"] = codec
     return dataclasses.replace(config, **kw) if kw else config

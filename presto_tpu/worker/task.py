@@ -135,7 +135,8 @@ class TpuTask:
                 conn = [s for s in splits if not s.get("remote")]
                 if remote:
                     ctx.remote_pages[source.plan_node_id] = \
-                        remote_page_reader(remote)
+                        remote_page_reader(
+                            remote, codec=cfg.exchange_compression_codec)
                 if conn:
                     ctx.splits[source.plan_node_id] = [
                         catalog.TableSplit.from_dict(s) for s in conn]
@@ -189,18 +190,21 @@ class TpuTask:
                     return
                 self.output_rows += page.position_count
                 compress = ctx.config.exchange_compression
+                codec = ctx.config.exchange_compression_codec
                 if partitioned:
                     targets = partition_targets(page, out_types, key_indices,
                                                 n_parts)
                     for p, sub in enumerate(
                             split_page(page, targets, n_parts)):
                         if sub is not None:
-                            data = serialize_page(sub, compress=compress)
+                            data = serialize_page(sub, compress=compress,
+                                                  codec=codec)
                             self.output_pages += 1
                             self.output_bytes += len(data)
                             self.buffers.add(p, data)
                 else:
-                    data = serialize_page(page, compress=compress)
+                    data = serialize_page(page, compress=compress,
+                                          codec=codec)
                     self.output_pages += 1
                     self.output_bytes += len(data)
                     self.buffers.add(0, data)

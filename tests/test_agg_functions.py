@@ -30,9 +30,6 @@ QUERIES = [
     "SELECT corr(totalprice, custkey) c FROM orders",
     """SELECT covar_pop(totalprice, custkey) a,
               covar_samp(totalprice, custkey) b FROM orders""",
-    "SELECT approx_distinct(custkey) d, count(*) n FROM orders",
-    """SELECT orderpriority, approx_distinct(custkey) d
-       FROM orders GROUP BY orderpriority ORDER BY orderpriority""",
     "SELECT approx_percentile(totalprice, 0.5) m FROM orders",
     """SELECT orderpriority, approx_percentile(totalprice, 0.9) p
        FROM orders GROUP BY orderpriority ORDER BY orderpriority""",
@@ -102,7 +99,8 @@ def test_stddev_anchor(runner):
         <= 1e-6 * statistics.pvariance(vals)
 
 
-def test_approx_distinct_exact(runner):
+def test_approx_distinct_small_cardinality_exact(runner):
+    # 5 distinct values: HLL linear counting is exact at tiny cardinality
     got = runner.execute(
         "SELECT approx_distinct(orderpriority) FROM orders").rows[0][0]
     exact = runner.execute(

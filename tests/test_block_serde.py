@@ -309,3 +309,71 @@ def test_incompressible_page_stays_raw():
     wire = serialize_page(page, compress=True)
     _pc, markers, _unc, _size, _ck = struct.unpack_from("<ibiiq", wire, 0)
     assert not (markers & COMPRESSED), "random data should stay raw"
+
+
+def _codec_page(n=4096):
+    from presto_tpu.common.block import block_from_values
+    from presto_tpu.common.page import Page
+    from presto_tpu.common.types import BIGINT, VARCHAR
+    return Page([
+        block_from_values(BIGINT, [i % 7 for i in range(n)]),
+        block_from_values(VARCHAR, [f"value-{i % 3}" for i in range(n)]),
+    ], n)
+
+
+def test_every_reference_codec_round_trips():
+    """PagesSerdeFactory.java:69-108 codec set (minus dead LZO): each codec
+    compresses and round-trips; serializer and deserializer share the codec
+    as cluster config, the wire only carries the COMPRESSED bit."""
+    import struct
+    from presto_tpu.common import compression
+    from presto_tpu.common.serde import (COMPRESSED, deserialize_page,
+                                         serialize_page)
+    from presto_tpu.common.block import block_to_values
+    from presto_tpu.common.types import BIGINT, VARCHAR
+
+    page = _codec_page()
+    codecs = [c for c in compression.supported_codecs() if c != "NONE"]
+    assert {"LZ4", "SNAPPY", "ZSTD", "GZIP", "ZLIB"} <= set(codecs)
+    for codec in codecs:
+        wire = serialize_page(page, compress=True, codec=codec)
+        _pc, markers, _unc, _size, _ck = struct.unpack_from("<ibiiq", wire, 0)
+        assert markers & COMPRESSED, codec
+        got, _ = deserialize_page(wire, codec=codec)
+        for t, a, b in zip((BIGINT, VARCHAR), got.blocks, page.blocks):
+            assert block_to_values(t, a) == block_to_values(t, b), codec
+
+
+def test_lz4_page_body_decodes_with_independent_decoder():
+    """The compressed body must be raw LZ4 *block* format (what airlift
+    aircompressor Lz4Compressor/Lz4Decompressor speak, PagesSerdeFactory
+    .java:75-76) — verified with a from-the-spec pure-Python decoder that
+    shares no code with the production codec."""
+    import struct
+    from presto_tpu.common.compression import lz4_block_decompress
+    from presto_tpu.common.serde import (COMPRESSED, PAGE_METADATA_SIZE,
+                                         serialize_page)
+
+    page = _codec_page()
+    raw = serialize_page(page, compress=False)
+    body_raw = raw[PAGE_METADATA_SIZE:]
+    wire = serialize_page(page, compress=True, codec="LZ4")
+    _pc, markers, unc, size, _ck = struct.unpack_from("<ibiiq", wire, 0)
+    assert markers & COMPRESSED
+    body = wire[PAGE_METADATA_SIZE:PAGE_METADATA_SIZE + size]
+    assert lz4_block_decompress(body, unc) == body_raw
+
+
+def test_lz4_golden_block_decodes():
+    """Hand-derived LZ4 block golden (spec v1.5.1): token 0x1A = 1 literal
+    + match len 10+4, offset 1 (overlapping run), trailing token 0x50 =
+    5 literals -> 'a' * 20."""
+    from presto_tpu.common.compression import decompress, lz4_block_decompress
+    golden = bytes.fromhex("1a610100506161616161")
+    assert lz4_block_decompress(golden, 20) == b"a" * 20
+    assert decompress("LZ4", golden, 20) == b"a" * 20
+
+
+def test_compression_ratio_gate_is_reference_value():
+    from presto_tpu.common.serde import MINIMUM_COMPRESSION_RATIO
+    assert MINIMUM_COMPRESSION_RATIO == 0.9  # PagesSerde.java:44
