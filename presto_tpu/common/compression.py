@@ -91,26 +91,24 @@ def lz4_block_decompress(data: bytes, uncompressed_size: int) -> bytes:
 def _lz4_literal_compress(data: bytes) -> bytes:
     """Literals-only LZ4 block (always valid, never smaller than input).
 
-    Only used when pyarrow is absent; the serde's compression-ratio gate
-    then simply keeps pages uncompressed, which is always correct.
+    One literal run covers the whole input — the LZ4 literal length
+    extends indefinitely via 255-continuation bytes, and only the FINAL
+    sequence of a block may omit the match part, so a single sequence is
+    the only spec-valid literal-only form.  Only used when pyarrow is
+    absent; the serde's ratio gate then keeps pages uncompressed.
     """
+    n = len(data)
     out = bytearray()
-    i, n = 0, len(data)
-    while i < n or n == 0:
-        chunk = min(n - i, 1 << 20)
-        if chunk >= 15:
-            out.append(0xF0)
-            rest = chunk - 15
-            while rest >= 255:
-                out.append(255)
-                rest -= 255
-            out.append(rest)
-        else:
-            out.append(chunk << 4)
-        out += data[i:i + chunk]
-        i += chunk
-        if n == 0:
-            break
+    if n >= 15:
+        out.append(0xF0)
+        rest = n - 15
+        while rest >= 255:
+            out.append(255)
+            rest -= 255
+        out.append(rest)
+    else:
+        out.append(n << 4)
+    out += data
     return bytes(out)
 
 

@@ -193,10 +193,13 @@ class HttpQueryRunner(LocalQueryRunner):
         all_tasks: List[RemoteTask] = []
         try:
             self._schedule(root, qid, consumer_tasks=1, all_tasks=all_tasks)
-            # decode with the session's codec — workers compress every
-            # output buffer, including the root stage this pull reads
+            # decode with the session codec, else the coordinator's own
+            # configured codec — workers compress every output buffer,
+            # including the root stage this pull reads, with the same
+            # cluster config (reference: one PagesSerdeFactory per cluster)
             codec = str(self.session.get(
-                "exchange_compression_codec", "LZ4")).upper()
+                "exchange_compression_codec",
+                self.config.exchange_compression_codec)).upper()
             pages = []
             for task in root.tasks:
                 pages.extend(pull_pages(task.result_location(0),

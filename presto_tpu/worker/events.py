@@ -1,0 +1,105 @@
+"""Query event pipeline: created/completed events fanned out to pluggable
+listeners.
+
+The analog of the reference's QueryMonitor publishing QueryCreatedEvent /
+QueryCompletedEvent to every registered EventListener
+(presto-main-base/.../event/QueryMonitor.java:106,queryCreatedEvent and
+:138,queryCompletedEvent; listener SPI at
+presto-spi/.../eventlistener/EventListener.java).  Listener failures are
+isolated: one broken listener must not fail the query or starve the other
+listeners, matching EventListenerManager's dispatch.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+import traceback
+from dataclasses import asdict, dataclass, field
+from typing import List, Optional
+
+
+@dataclass
+class QueryCreatedEvent:
+    """Reference QueryCreatedEvent: identity + context at intake."""
+    query_id: str
+    sql: str
+    user: str
+    source: str
+    resource_group: str
+    catalog: str
+    schema: str
+    create_time: float = field(default_factory=time.time)
+
+
+@dataclass
+class QueryCompletedEvent:
+    """Reference QueryCompletedEvent: outcome + statistics at finish."""
+    query_id: str
+    sql: str
+    user: str
+    state: str                      # FINISHED | FAILED | CANCELED
+    create_time: float
+    end_time: float
+    wall_time_s: float
+    queued_time_s: float
+    rows: int
+    error: Optional[str] = None
+
+
+class EventListener:
+    """Listener SPI (EventListener.java): override any subset."""
+
+    def query_created(self, event: QueryCreatedEvent) -> None:
+        pass
+
+    def query_completed(self, event: QueryCompletedEvent) -> None:
+        pass
+
+
+class FileEventListener(EventListener):
+    """Append events as JSON lines — the simplest useful listener (audit
+    log / test fixture), analogous to the file-based event-listener
+    plugins shipped around the reference."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+
+    def _write(self, kind: str, event) -> None:
+        line = json.dumps({"event": kind, **asdict(event)})
+        with self._lock, open(self.path, "a") as f:
+            f.write(line + "\n")
+
+    def query_created(self, event: QueryCreatedEvent) -> None:
+        self._write("query_created", event)
+
+    def query_completed(self, event: QueryCompletedEvent) -> None:
+        self._write("query_completed", event)
+
+
+class EventListenerManager:
+    """Fan events out to every registered listener, isolating failures
+    (EventListenerManager.java: a throwing listener is logged and
+    skipped)."""
+
+    def __init__(self):
+        self._listeners: List[EventListener] = []
+        self.dispatch_errors = 0
+
+    def register(self, listener: EventListener) -> None:
+        self._listeners.append(listener)
+
+    def _fire(self, method: str, event) -> None:
+        for listener in self._listeners:
+            try:
+                getattr(listener, method)(event)
+            except Exception:   # noqa: BLE001 — listener isolation
+                self.dispatch_errors += 1
+                traceback.print_exc()
+
+    def query_created(self, event: QueryCreatedEvent) -> None:
+        self._fire("query_created", event)
+
+    def query_completed(self, event: QueryCompletedEvent) -> None:
+        self._fire("query_completed", event)

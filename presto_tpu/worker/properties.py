@@ -1,0 +1,149 @@
+"""Properties-file configuration layer.
+
+The reference boots from an etc/ directory of Java .properties files:
+config.properties (server keys, presto_cpp/main/common/Configs.h:162 and
+ConfigPropertyMetadata), node.properties (node.id / node.environment,
+NodeConfig), and catalog/*.properties (one connector mount per file,
+connector.name selects the plugin — presto_cpp/main/PrestoServer.cpp
+registerConnectors / java CatalogManager).  This module parses that
+layout and maps the keys this engine understands onto WorkerServer and
+ExecutionConfig arguments; unknown keys are ignored the way the native
+worker ignores coordinator-only properties.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional, Tuple
+
+from ..exec.pipeline import ExecutionConfig
+from .protocol import parse_data_size
+
+
+def load_properties(path: str) -> Dict[str, str]:
+    """Parse a Java .properties file: key=value (or key:value), # / !
+    comments, backslash line continuation, whitespace-trimmed keys."""
+    props: Dict[str, str] = {}
+
+    def store(line: str) -> None:
+        for sep in "=:":
+            i = line.find(sep)
+            if i >= 0:
+                props[line[:i].strip()] = line[i + 1:].strip()
+                return
+        props[line] = ""
+
+    with open(path) as f:
+        pending = ""
+        for raw in f:
+            line = pending + raw.strip()
+            pending = ""
+            if not line or line[0] in "#!":
+                continue
+            if line.endswith("\\") and not line.endswith("\\\\"):
+                pending = line[:-1]
+                continue
+            store(line)
+        if pending:  # trailing continuation with no following line
+            store(pending)
+    return props
+
+
+def _bool(v: str) -> bool:
+    return str(v).strip().lower() == "true"
+
+
+def execution_config_from_properties(props: Dict[str, str],
+                                     base: Optional[ExecutionConfig] = None
+                                     ) -> ExecutionConfig:
+    """config.properties keys -> ExecutionConfig (the worker-side subset
+    of Configs.h / SystemSessionProperties)."""
+    import dataclasses
+    cfg = base or ExecutionConfig()
+    kw = {}
+    if "query.max-memory-per-node" in props:
+        kw["memory_budget_bytes"] = parse_data_size(
+            props["query.max-memory-per-node"])
+    if "experimental.spill-enabled" in props:
+        kw["spill_enabled"] = _bool(props["experimental.spill-enabled"])
+    if "experimental.spiller-max-used-space" in props:
+        kw["spill_budget_bytes"] = parse_data_size(
+            props["experimental.spiller-max-used-space"])
+    if "exchange.compression-enabled" in props:
+        kw["exchange_compression"] = _bool(
+            props["exchange.compression-enabled"])
+    if "exchange.compression-codec" in props:
+        codec = props["exchange.compression-codec"].upper()
+        from ..common.compression import supported_codecs
+        if codec not in supported_codecs():
+            raise ValueError(
+                f"unsupported exchange.compression-codec {codec!r}")
+        kw["exchange_compression_codec"] = codec
+    if "task.batch-rows" in props:
+        kw["batch_rows"] = int(props["task.batch-rows"])
+    if "task.fuse-pipelines" in props:
+        kw["fuse_pipelines"] = _bool(props["task.fuse-pipelines"])
+    return dataclasses.replace(cfg, **kw) if kw else cfg
+
+
+def server_kwargs_from_etc(etc_dir: str) -> Tuple[dict, Dict[str, str]]:
+    """etc/{config,node}.properties -> WorkerServer kwargs + raw props.
+
+    Returns (kwargs, merged_props).  Catalog mounts are handled by
+    register_catalogs_from_etc (import side effects live there)."""
+    config_path = os.path.join(etc_dir, "config.properties")
+    node_path = os.path.join(etc_dir, "node.properties")
+    props: Dict[str, str] = {}
+    if os.path.exists(config_path):
+        props.update(load_properties(config_path))
+    if os.path.exists(node_path):
+        props.update(load_properties(node_path))
+
+    kwargs: dict = {}
+    if "http-server.http.port" in props:
+        kwargs["port"] = int(props["http-server.http.port"])
+    if "node.id" in props:
+        kwargs["node_id"] = props["node.id"]
+    if "node.environment" in props:
+        kwargs["environment"] = props["node.environment"]
+    if "coordinator" in props:
+        kwargs["coordinator"] = _bool(props["coordinator"])
+    if "discovery.uri" in props:
+        kwargs["discovery_uri"] = props["discovery.uri"]
+    kwargs["config"] = execution_config_from_properties(props)
+    return kwargs, props
+
+
+def register_catalogs_from_etc(etc_dir: str) -> Dict[str, str]:
+    """Mount every etc/catalog/*.properties connector (CatalogManager
+    analog): connector.name picks the connector; returns
+    {catalog_name: connector.name} for what was mounted."""
+    from ..connectors import catalog as registry
+    catalog_dir = os.path.join(etc_dir, "catalog")
+    mounted: Dict[str, str] = {}
+    if not os.path.isdir(catalog_dir):
+        return mounted
+    for fn in sorted(os.listdir(catalog_dir)):
+        if not fn.endswith(".properties"):
+            continue
+        name = fn[:-len(".properties")]
+        props = load_properties(os.path.join(catalog_dir, fn))
+        kind = props.get("connector.name", "")
+        if kind == "hive" or kind == "hive-hadoop2":
+            from ..connectors import hive
+            warehouse = props.get("hive.warehouse.dir",
+                                  os.path.join(etc_dir, "warehouse"))
+            registry.register_connector(
+                name, hive.HiveConnector(warehouse))
+        elif kind == "memory":
+            from ..connectors.memory import MemoryConnector
+            registry.register_connector(name, MemoryConnector())
+        elif kind == "blackhole":
+            from ..connectors.memory import BlackholeConnector
+            registry.register_connector(name, BlackholeConnector())
+        elif kind in ("tpch", "tpcds"):
+            pass  # built-in generated catalogs are always mounted
+        else:
+            raise ValueError(
+                f"catalog {name}: unknown connector.name {kind!r}")
+        mounted[name] = kind
+    return mounted

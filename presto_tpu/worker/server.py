@@ -430,7 +430,7 @@ class WorkerServer:
                  environment: str = "test",
                  config: Optional[ExecutionConfig] = None,
                  announce_interval_s: float = 1.0,
-                 resource_groups=None):
+                 resource_groups=None, events=None):
         self.environment = environment
         self.coordinator = coordinator
         self.state = "ACTIVE"            # ACTIVE | SHUTTING_DOWN
@@ -454,7 +454,7 @@ class WorkerServer:
         if coordinator:
             from .statement import DispatchManager
             self.dispatch = DispatchManager(self._execute_statement,
-                                            resource_groups)
+                                            resource_groups, events=events)
 
         # system runtime tables (reference system connector /
         # presto_cpp SystemConnector): SQL-queryable server state.  Only

@@ -196,11 +196,16 @@ class DispatchManager:
     MAX_QUERY_HISTORY = 200
 
     def __init__(self, executor: Callable[["ManagedQuery"], "object"],
-                 resource_groups: Optional[ResourceGroupManager] = None):
+                 resource_groups: Optional[ResourceGroupManager] = None,
+                 events=None):
         """executor(query) runs the SQL and returns an exec.runner
-        QueryResult (column_names / column_types / rows)."""
+        QueryResult (column_names / column_types / rows).  `events` is an
+        EventListenerManager receiving created/completed events (the
+        QueryMonitor analog, QueryMonitor.java:106)."""
+        from .events import EventListenerManager
         self._executor = executor
         self.resource_groups = resource_groups or ResourceGroupManager()
+        self.events = events or EventListenerManager()
         self._queries: Dict[str, ManagedQuery] = {}
         self._lock = threading.Lock()
 
@@ -228,6 +233,11 @@ class DispatchManager:
         q = ManagedQuery(qid, sql, user, source, dict(session or {}),
                          catalog, schema)
         q.resource_group = self.resource_groups.select(user, source)
+        from .events import QueryCreatedEvent
+        self.events.query_created(QueryCreatedEvent(
+            query_id=qid, sql=sql, user=user, source=source,
+            resource_group=q.resource_group, catalog=catalog,
+            schema=schema, create_time=q.created_at))
         with self._lock:
             self._queries[qid] = q
             if len(self._queries) > self.MAX_QUERY_HISTORY:
@@ -241,10 +251,9 @@ class DispatchManager:
                 q._admitted = True
                 self._start(q)
         except QueryQueueFullError as e:
-            q.state = FAILED
-            q.error = str(e)
-            q.finished_at = time.time()
-            q.done.set()
+            # through _finish so the completed event fires (the reference
+            # emits an immediate-failure event for queue rejection)
+            self._finish(q, FAILED, str(e))
         return q
 
     def _start(self, q: ManagedQuery) -> None:
@@ -308,6 +317,16 @@ class DispatchManager:
         q.error = error
         q.finished_at = time.time()
         q.done.set()
+        from .events import QueryCompletedEvent
+        now = q.finished_at
+        self.events.query_completed(QueryCompletedEvent(
+            query_id=q.query_id, sql=q.sql, user=q.user, state=state,
+            create_time=q.created_at, end_time=now,
+            wall_time_s=now - q.created_at,
+            queued_time_s=(q.started_at or now) - q.created_at,
+            rows=(q.rows_served if q._row_iter is not None
+                  else len(q.rows or [])),
+            error=error))
         # only a query that held a running slot frees one; cancelling a
         # QUEUED query must not over-admit past hardConcurrencyLimit
         if q._admitted:
