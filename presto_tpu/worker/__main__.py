@@ -47,7 +47,7 @@ def main(argv=None) -> int:
     if args.etc_dir:
         from .properties import (register_catalogs_from_etc,
                                  server_kwargs_from_etc)
-        file_kwargs, props = server_kwargs_from_etc(args.etc_dir)
+        file_kwargs, _props = server_kwargs_from_etc(args.etc_dir)
         register_catalogs_from_etc(args.etc_dir)
         kwargs.update(file_kwargs)
     for k, v in (("port", args.http_port), ("node_id", args.node_id),
@@ -64,12 +64,17 @@ def main(argv=None) -> int:
             from .events import EventListenerManager, FileEventListener
             from .properties import load_properties
             lp = load_properties(listener_path)
-            if lp.get("event-listener.name") == "file":
-                mgr = EventListenerManager()
-                mgr.register(FileEventListener(
-                    lp.get("event-listener.path",
-                           os.path.join(args.etc_dir, "events.jsonl"))))
-                kwargs["events"] = mgr
+            name = lp.get("event-listener.name")
+            if name != "file":
+                # refuse to boot with a silently-dead audit log
+                raise SystemExit(
+                    f"unknown event-listener.name {name!r} in "
+                    f"{listener_path}; supported: file")
+            mgr = EventListenerManager()
+            mgr.register(FileEventListener(
+                lp.get("event-listener.path",
+                       os.path.join(args.etc_dir, "events.jsonl"))))
+            kwargs["events"] = mgr
 
     from .server import WorkerServer
     server = WorkerServer(**kwargs)

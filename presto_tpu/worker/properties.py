@@ -25,12 +25,14 @@ def load_properties(path: str) -> Dict[str, str]:
     props: Dict[str, str] = {}
 
     def store(line: str) -> None:
-        for sep in "=:":
-            i = line.find(sep)
-            if i >= 0:
-                props[line[:i].strip()] = line[i + 1:].strip()
-                return
-        props[line] = ""
+        # earliest separator wins (java.util.Properties: '=' and ':' are
+        # equivalent; the first unescaped one terminates the key)
+        idxs = [i for i in (line.find("="), line.find(":")) if i >= 0]
+        if idxs:
+            i = min(idxs)
+            props[line[:i].strip()] = line[i + 1:].strip()
+        else:
+            props[line] = ""
 
     with open(path) as f:
         pending = ""
@@ -109,7 +111,11 @@ def server_kwargs_from_etc(etc_dir: str) -> Tuple[dict, Dict[str, str]]:
         kwargs["coordinator"] = _bool(props["coordinator"])
     if "discovery.uri" in props:
         kwargs["discovery_uri"] = props["discovery.uri"]
-    kwargs["config"] = execution_config_from_properties(props)
+    # base on the server's tuned defaults (WorkerServer.__init__), not the
+    # bare ExecutionConfig — file keys override, absence must not detune
+    kwargs["config"] = execution_config_from_properties(
+        props, base=ExecutionConfig(batch_rows=1 << 16,
+                                    join_out_capacity=1 << 18))
     return kwargs, props
 
 

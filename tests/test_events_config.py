@@ -331,3 +331,18 @@ def test_literal_lz4_fallback_large_input():
     data = os.urandom((1 << 20) + 12345)
     packed = _lz4_literal_compress(data)
     assert lz4_block_decompress(packed, len(data)) == data
+
+
+def test_colon_separator_with_equals_in_value(tmp_path):
+    p = tmp_path / "c.properties"
+    p.write_text("launcher.args: -Dfoo=bar\n")
+    assert load_properties(str(p)) == {"launcher.args": "-Dfoo=bar"}
+
+
+def test_etc_config_keeps_tuned_defaults(tmp_path):
+    """An etc dir with no execution keys must keep the worker's tuned
+    ExecutionConfig defaults, not regress to the bare dataclass ones."""
+    etc = _write_etc(tmp_path)
+    kwargs, _ = server_kwargs_from_etc(etc)
+    assert kwargs["config"].batch_rows == 1 << 16
+    assert kwargs["config"].join_out_capacity == 1 << 18

@@ -1,0 +1,111 @@
+"""External-worker launcher (VERDICT r2 #10): the
+setExternalWorkerLauncher-shaped entry a Java DistributedQueryRunner uses
+to spawn TPU workers (DistributedQueryRunner.java:190-215,
+PrestoNativeQueryRunnerUtils.java:434-520).  The Java-coordinator parity
+test runs whenever PRESTO_JAVA_COORDINATOR_URI is set and skips otherwise
+— the moment a Java coordinator exists in the environment, the suite
+exercises it with zero code changes.
+"""
+import json
+import os
+import time
+import urllib.request
+
+import pytest
+
+from presto_tpu.worker.launcher import launch_worker, write_etc_dir
+
+
+def test_write_etc_dir_layout(tmp_path):
+    etc = write_etc_dir(3, "http://127.0.0.1:9999", base_dir=str(tmp_path))
+    from presto_tpu.worker.properties import load_properties
+    cfg = load_properties(os.path.join(etc, "config.properties"))
+    assert cfg["discovery.uri"] == "http://127.0.0.1:9999"
+    assert cfg["http-server.http.port"] == "0"
+    node = load_properties(os.path.join(etc, "node.properties"))
+    assert node["node.environment"] == "testing"
+    assert os.path.exists(
+        os.path.join(etc, "catalog", "tpchstandard.properties"))
+
+
+def test_launcher_spawns_announcing_worker(tmp_path):
+    """launch_worker(index, discoveryUri) -> a worker that announces to
+    the coordinator's discovery and serves queries (the exact contract
+    the Java harness relies on)."""
+    from presto_tpu.worker import HttpQueryRunner, WorkerServer
+    coordinator = WorkerServer(coordinator=True, environment="testing")
+    proc = None
+    try:
+        proc = launch_worker(0, coordinator.uri, base_dir=str(tmp_path))
+        deadline = time.time() + 60
+        while not coordinator.worker_uris() and time.time() < deadline:
+            time.sleep(0.1)
+        uris = coordinator.worker_uris()
+        assert uris, "worker never announced"
+        r = HttpQueryRunner(uris, "sf0.01", n_tasks=1)
+        res = r.execute("select count(*) from nation")
+        assert res.rows == [[25]]
+    finally:
+        if proc is not None:
+            proc.terminate()
+            proc.wait(timeout=10)
+        coordinator.close()
+
+
+def test_launcher_exec_form(tmp_path):
+    """`python -m presto_tpu.worker.launcher <index> <discoveryUri>` — the
+    ProcessBuilder form for the Java side; the Process handle IS the
+    worker (terminate kills it)."""
+    import subprocess
+    import sys
+    from presto_tpu.worker import WorkerServer
+    coordinator = WorkerServer(coordinator=True, environment="testing")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", JAX_PLATFORM_NAME="cpu",
+               PYTHONPATH=os.path.dirname(os.path.dirname(
+                   os.path.abspath(__file__))))
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "presto_tpu.worker.launcher",
+         "1", coordinator.uri],
+        stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT, env=env)
+    try:
+        deadline = time.time() + 60
+        while not coordinator.worker_uris() and time.time() < deadline:
+            time.sleep(0.1)
+        assert coordinator.worker_uris(), "exec-form worker never announced"
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
+        coordinator.close()
+
+
+JAVA_URI = os.environ.get("PRESTO_JAVA_COORDINATOR_URI")
+
+
+@pytest.mark.skipif(not JAVA_URI, reason
+                    ="PRESTO_JAVA_COORDINATOR_URI not set (no Java "
+                      "coordinator in this environment)")
+def test_java_coordinator_parity():
+    """Drive a real Java coordinator (whose workers are TPU workers
+    spawned via the launcher) through the statement protocol and compare
+    against the local engine."""
+    from presto_tpu.exec.runner import LocalQueryRunner
+    for sql in ("select count(*) from nation",
+                "select l_returnflag, l_linestatus, sum(l_quantity) "
+                "from lineitem group by l_returnflag, l_linestatus "
+                "order by l_returnflag, l_linestatus"):
+        req = urllib.request.Request(
+            JAVA_URI.rstrip("/") + "/v1/statement", data=sql.encode(),
+            headers={"X-Presto-User": "parity",
+                     "X-Presto-Catalog": "tpchstandard",
+                     "X-Presto-Schema": "sf0.01"})
+        d = json.loads(urllib.request.urlopen(req, timeout=30).read())
+        rows = list(d.get("data", []))
+        deadline = time.time() + 300
+        while "nextUri" in d and time.time() < deadline:
+            d = json.loads(urllib.request.urlopen(
+                d["nextUri"], timeout=30).read())
+            rows.extend(d.get("data", []))
+        assert "error" not in d, d.get("error")
+        local = LocalQueryRunner("sf0.01").execute(sql).rows
+        assert [[*map(str, r)] for r in rows] == \
+            [[*map(str, r)] for r in local]
