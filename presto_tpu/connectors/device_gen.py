@@ -67,12 +67,42 @@ def _li_suppkey(idx, sf):
     return ((partkey + j * (s // 4 + (partkey - 1) // s)) % s) + 1
 
 
+def _li_cum_table():
+    """(5040, 8) cumulative lines-per-order permutation table (numpy host
+    constant; jnp.asarray per call so a traced constant is never cached
+    across jit scopes)."""
+    _, cum = H._li_perm_tables()
+    return jnp.asarray(cum.astype(np.int32))
+
+
+def _li_order_map(idx, sf: float):
+    """Device mirror of tpch._li_order_map: idx -> (orderkey, linenumber)
+    under the 28-lineitems-per-7-orders block scheme."""
+    cum = _li_cum_table()
+    n_orders = H._table_rows("orders", sf)
+    full = (n_orders // 7) * 28
+    b = idx // 28
+    r = (idx % 28).astype(jnp.int32)
+    pid = (_cell("lineitem", "orderblock", b)
+           % _U(5040)).astype(jnp.int32)
+    crows = cum[pid]                                     # (n, 8)
+    pos = jnp.sum(r[:, None] >= crows[:, 1:], axis=1).astype(jnp.int32)
+    start = jnp.take_along_axis(crows, pos[:, None], axis=1)[:, 0]
+    orderkey = b * 7 + pos.astype(idx.dtype) + 1
+    linenumber = (r - start + 1).astype(idx.dtype)
+    tail = idx >= full
+    t = idx - full
+    orderkey = jnp.where(tail, (n_orders // 7) * 7 + t // 4 + 1, orderkey)
+    linenumber = jnp.where(tail, t % 4 + 1, linenumber)
+    return orderkey, linenumber
+
+
 def _tpch_lineitem(column: str, idx, sf: float):
-    orderkey = idx // H.LINES_PER_ORDER + 1
+    # (orderkey, linenumber) only where needed, mirroring the host gen
     if column == "orderkey":
-        return orderkey
+        return _li_order_map(idx, sf)[0]
     if column == "linenumber":
-        return idx % H.LINES_PER_ORDER + 1
+        return _li_order_map(idx, sf)[1]
     if column == "partkey":
         return _uniform("lineitem", "partkey", idx, 1,
                         H._table_rows("part", sf))
@@ -90,11 +120,11 @@ def _tpch_lineitem(column: str, idx, sf: float):
     if column == "tax":
         return _uniform("lineitem", "tax", idx, 0, 8)
     if column == "shipdate":
-        return _order_date(orderkey) + _uniform("lineitem", "shipdays",
-                                                idx, 1, 121)
+        return _order_date(_li_order_map(idx, sf)[0]) \
+            + _uniform("lineitem", "shipdays", idx, 1, 121)
     if column == "commitdate":
-        return _order_date(orderkey) + _uniform("lineitem", "commitdays",
-                                                idx, 30, 90)
+        return _order_date(_li_order_map(idx, sf)[0]) \
+            + _uniform("lineitem", "commitdays", idx, 30, 90)
     if column == "receiptdate":
         sd = _tpch_lineitem("shipdate", idx, sf)
         return sd + _uniform("lineitem", "receiptdays", idx, 1, 30)

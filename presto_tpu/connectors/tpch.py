@@ -122,7 +122,70 @@ COMMENT_WORDS = [
     "beans", "foxes", "dependencies", "instructions", "platelets", "asymptotes",
 ]
 
-LINES_PER_ORDER = 4  # fixed fanout: 6M lineitems / 1.5M orders per SF
+# the spec's P_NAME word source (dbgen dists.dss "colors", 92 entries):
+# part names are 5 words drawn from this list, so LIKE filters over colors
+# (q9 '%green%', q20 'forest%') select at spec-like rates
+P_NAME_WORDS = [
+    "almond", "antique", "aquamarine", "azure", "beige", "bisque", "black",
+    "blanched", "blue", "blush", "brown", "burlywood", "burnished",
+    "chartreuse", "chiffon", "chocolate", "coral", "cornflower", "cornsilk",
+    "cream", "cyan", "dark", "deep", "dim", "dodger", "drab", "firebrick",
+    "floral", "forest", "frosted", "gainsboro", "ghost", "goldenrod",
+    "green", "grey", "honeydew", "hot", "indian", "ivory", "khaki", "lace",
+    "lavender", "lawn", "lemon", "light", "lime", "linen", "magenta",
+    "maroon", "medium", "metallic", "midnight", "mint", "misty", "moccasin",
+    "navajo", "navy", "olive", "orange", "orchid", "pale", "papaya",
+    "peach", "peru", "pink", "plum", "powder", "puff", "purple", "red",
+    "rose", "rosy", "royal", "saddle", "salmon", "sandy", "seashell",
+    "sienna", "sky", "slate", "smoke", "snow", "spring", "steel", "tan",
+    "thistle", "tomato", "turquoise", "violet", "wheat", "white", "yellow",
+]
+
+LINES_PER_ORDER = 4  # AVERAGE fanout: 6M lineitems / 1.5M orders per SF
+
+# Variable lines-per-order with a closed-form row mapping: each block of 7
+# consecutive orders carries exactly 28 lineitems, split 1..7 per order by
+# a hash-chosen permutation (dbgen draws counts uniform 1..7 per order; the
+# fixed block sum keeps idx -> orderkey a pure function, which the
+# device-side generator needs).  Orders past the last full block (at most
+# 6) keep the fixed fanout of 4 so total rows stay exactly 4 * orders.
+_LI_PERMS = None
+_LI_CUM = None
+
+
+def _li_perm_tables():
+    global _LI_PERMS, _LI_CUM
+    if _LI_CUM is None:
+        import itertools
+        _LI_PERMS = np.array(list(itertools.permutations(range(1, 8))),
+                             dtype=np.int64)                 # (5040, 7)
+        _LI_CUM = np.concatenate(
+            [np.zeros((5040, 1), dtype=np.int64),
+             np.cumsum(_LI_PERMS, axis=1)], axis=1)          # (5040, 8)
+    return _LI_PERMS, _LI_CUM
+
+
+def _li_order_map(idx: np.ndarray, sf: float):
+    """lineitem row index -> (orderkey, linenumber), vectorized."""
+    _, cum = _li_perm_tables()
+    n_orders = _table_rows("orders", sf)
+    full = (n_orders // 7) * 28
+    b = idx // 28
+    r = idx % 28
+    pid = (_cell_hash("lineitem", "orderblock", b)
+           % np.uint64(5040)).astype(np.int64)
+    crows = cum[pid]                                         # (n, 8)
+    pos = (r[:, None] >= crows[:, 1:]).sum(axis=1)           # 0..6
+    start = np.take_along_axis(crows, pos[:, None], axis=1)[:, 0]
+    orderkey = b * 7 + pos + 1
+    linenumber = r - start + 1
+    tail = idx >= full
+    if tail.any():
+        t = idx - full
+        orderkey = np.where(tail, (n_orders // 7) * 7 + t // 4 + 1,
+                            orderkey)
+        linenumber = np.where(tail, t % 4 + 1, linenumber)
+    return orderkey, linenumber
 
 
 def _table_rows(table: str, sf: float) -> int:
@@ -236,11 +299,12 @@ def _comment(table: str, idx: np.ndarray, nwords: int = 4) -> list:
 
 
 def _gen_lineitem(column: str, idx: np.ndarray, sf: float):
-    orderkey = idx // LINES_PER_ORDER + 1
+    # (orderkey, linenumber) only where needed — the map costs a hash +
+    # permutation gather per row, pure waste for order-independent columns
     if column == "orderkey":
-        return orderkey
+        return _li_order_map(idx, sf)[0]
     if column == "linenumber":
-        return (idx % LINES_PER_ORDER + 1).astype(np.int64)
+        return _li_order_map(idx, sf)[1].astype(np.int64)
     if column == "partkey":
         return _uniform("lineitem", "partkey", idx, 1, _table_rows("part", sf))
     if column == "suppkey":
@@ -260,10 +324,10 @@ def _gen_lineitem(column: str, idx: np.ndarray, sf: float):
     if column == "tax":
         return _uniform("lineitem", "tax", idx, 0, 8)
     if column == "shipdate":
-        od = _order_date(orderkey)
+        od = _order_date(_li_order_map(idx, sf)[0])
         return od + _uniform("lineitem", "shipdays", idx, 1, 121)
     if column == "commitdate":
-        od = _order_date(orderkey)
+        od = _order_date(_li_order_map(idx, sf)[0])
         return od + _uniform("lineitem", "commitdays", idx, 30, 90)
     if column == "receiptdate":
         sd = _gen_lineitem("shipdate", idx, sf)
@@ -362,10 +426,13 @@ def _gen_part(column: str, idx: np.ndarray, sf: float):
     if column == "partkey":
         return partkey
     if column == "name":
+        # 5 words from the 92-entry P_NAME list (spec 4.2.3: P_NAME is a
+        # concatenation of 5 variable-length words)
         h = _cell_hash("part", "name", idx)
-        w = len(COMMENT_WORDS)
-        return [f"{COMMENT_WORDS[int(v % w)]} {COMMENT_WORDS[int((v >> 8) % w)]} part"
-                for v in h]
+        w = np.uint64(len(P_NAME_WORDS))
+        cols = [(h >> np.uint64(8 * k)) % w for k in range(5)]
+        arr = np.stack(cols, axis=1)
+        return [" ".join(P_NAME_WORDS[int(j)] for j in row) for row in arr]
     if column == "mfgr":
         m = _uniform("part", "mfgr", idx, 1, 5)
         return ((m - 1).astype(np.int32), MFGRS)
@@ -486,7 +553,7 @@ def column_stats(table: str, column: str, sf: float):
         ("lineitem", "orderkey"): (1, orders, orders),
         ("lineitem", "partkey"): (1, _table_rows("part", sf), None),
         ("lineitem", "suppkey"): (1, _table_rows("supplier", sf), None),
-        ("lineitem", "linenumber"): (1, LINES_PER_ORDER, LINES_PER_ORDER),
+        ("lineitem", "linenumber"): (1, 7, 7),
         ("lineitem", "quantity"): (1.0, 50.0, 50),
         ("lineitem", "extendedprice"): (900.0, 104949.50, None),
         ("lineitem", "discount"): (0.0, 0.10, 11),

@@ -153,6 +153,15 @@ class ExecutionConfig:
     exchange_compression_codec: str = "LZ4"
 
 
+def tuned_config(**overrides) -> "ExecutionConfig":
+    """The server/runner default ExecutionConfig: 64K-row scan batches and
+    256K-row join output keep HBM footprint and dispatch count balanced on
+    one chip.  Single source of truth — WorkerServer, LocalQueryRunner,
+    TaskManager, and the etc-dir properties loader all start from this."""
+    return ExecutionConfig(batch_rows=1 << 16, join_out_capacity=1 << 18,
+                           **overrides)
+
+
 @dataclass
 class TaskContext:
     """Execution context for one task: configuration + split assignment."""

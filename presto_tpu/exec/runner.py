@@ -13,7 +13,8 @@ from typing import Dict, List, Optional
 from ..common.block import block_to_values
 from ..common.page import Page
 from ..sql.planner import Planner
-from .pipeline import ExecutionConfig, PlanCompiler, TaskContext
+from .pipeline import (ExecutionConfig, PlanCompiler, TaskContext,
+                       tuned_config)
 
 
 @dataclass
@@ -46,8 +47,7 @@ class LocalQueryRunner:
         self.schema = schema
         self.catalog = catalog
         self.tracer_provider = tracer_provider   # utils.runtime_stats
-        self.config = config or ExecutionConfig(batch_rows=1 << 16,
-                                                join_out_capacity=1 << 18)
+        self.config = config or tuned_config()
         # plan cache: SQL -> (OutputNode, PlanCompiler); re-executions reuse
         # the compiled pipeline so its jitted steps stay warm
         self._plan_cache: Dict[str, tuple] = {}

@@ -57,8 +57,9 @@ def write_etc_dir(worker_index: int, discovery_uri: str,
                 "node.location=test-location\n")
     catalog_dir = os.path.join(etc, "catalog")
     os.makedirs(catalog_dir)
-    for name, body in (catalogs or {"tpchstandard": "connector.name=tpch\n"}
-                       ).items():
+    if catalogs is None:
+        catalogs = {"tpchstandard": "connector.name=tpch\n"}
+    for name, body in catalogs.items():
         with open(os.path.join(catalog_dir, f"{name}.properties"), "w") as f:
             f.write(body)
     return etc

@@ -15,7 +15,7 @@ from __future__ import annotations
 import os
 from typing import Dict, Optional, Tuple
 
-from ..exec.pipeline import ExecutionConfig
+from ..exec.pipeline import ExecutionConfig, tuned_config
 from .protocol import parse_data_size
 
 
@@ -114,8 +114,7 @@ def server_kwargs_from_etc(etc_dir: str) -> Tuple[dict, Dict[str, str]]:
     # base on the server's tuned defaults (WorkerServer.__init__), not the
     # bare ExecutionConfig — file keys override, absence must not detune
     kwargs["config"] = execution_config_from_properties(
-        props, base=ExecutionConfig(batch_rows=1 << 16,
-                                    join_out_capacity=1 << 18))
+        props, base=tuned_config())
     return kwargs, props
 
 

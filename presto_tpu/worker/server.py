@@ -28,7 +28,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional
 from urllib.parse import parse_qs, urlparse
 
-from ..exec.pipeline import ExecutionConfig
+from ..exec.pipeline import ExecutionConfig, tuned_config
 from .protocol import TaskUpdateRequest, make_announcement
 from .task import TaskManager
 
@@ -437,8 +437,7 @@ class WorkerServer:
         self.discovery: Optional[Dict[str, dict]] = {} if coordinator else None
         self.discovery_lock = threading.Lock()
         self.started_at = time.time()
-        self.exec_config = config or ExecutionConfig(
-            batch_rows=1 << 16, join_out_capacity=1 << 18)
+        self.exec_config = config or tuned_config()
 
         handler = type("Handler", (_Handler,), {"server_ref": self})
         self.httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)

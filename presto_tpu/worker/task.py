@@ -17,7 +17,8 @@ from typing import Dict, List, Optional
 
 from ..common.serde import serialize_page
 from ..connectors import catalog, tpch
-from ..exec.pipeline import ExecutionConfig, PlanCompiler, TaskContext
+from ..exec.pipeline import (ExecutionConfig, PlanCompiler, TaskContext,
+                             tuned_config)
 from ..exec.scheduler import partition_targets, split_page
 from ..spi import plan as P
 from .buffers import OutputBufferManager
@@ -227,8 +228,7 @@ class TaskManager:
     def __init__(self, base_uri: str = "",
                  config: Optional[ExecutionConfig] = None):
         self.base_uri = base_uri
-        self.config = config or ExecutionConfig(batch_rows=1 << 16,
-                                                join_out_capacity=1 << 18)
+        self.config = config or tuned_config()
         self.tasks: Dict[str, TpuTask] = {}
         self._lock = threading.Lock()
         self.tasks_created = 0

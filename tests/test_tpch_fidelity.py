@@ -1,0 +1,81 @@
+"""TPC-H data-fidelity properties (VERDICT r2 missing #7): the generator
+is counter-hash (self-consistent, not dbgen-bit-identical), but the value
+domains the benchmark queries FILTER on must match the spec or whole
+queries run hollow — q9 ('%green%') and q20 ('forest%') matched zero parts
+and q18 (sum(l_quantity) > 300) could never fire with a fixed 4-line
+fanout.  Reference: dbgen dists.dss colors list, spec 4.2.3 (P_NAME = 5
+words), spec table layouts (O_ORDERKEY 1..7 lineitems).
+"""
+import numpy as np
+import pytest
+
+from presto_tpu.connectors import device_gen as D
+from presto_tpu.connectors import tpch as H
+
+SF = 0.01
+
+
+def test_part_names_use_spec_word_list():
+    n = H._table_rows("part", SF)
+    names = H._gen_part("name", np.arange(n, dtype=np.int64), SF)
+    assert len(H.P_NAME_WORDS) == 92  # dbgen dists.dss colors
+    assert all(len(x.split()) == 5 for x in names[:100])
+    assert all(w in H.P_NAME_WORDS for x in names[:100] for w in x.split())
+    assert all(len(x) <= 55 for x in names)  # VarcharType(55)
+    # q9-class selectivity: P(contains 'green') = 1-(91/92)^5 ~ 5.3%
+    frac = sum("green" in x for x in names) / n
+    assert 0.03 < frac < 0.08, frac
+    # q20-class prefix selectivity ~ 5/92 * 1/5 = 1.1%
+    frac = sum(x.startswith("forest") for x in names) / n
+    assert 0.004 < frac < 0.025, frac
+
+
+def test_lineitem_fanout_one_to_seven():
+    n_li = H._table_rows("lineitem", SF)
+    n_orders = H._table_rows("orders", SF)
+    idx = np.arange(n_li, dtype=np.int64)
+    ok, ln = H._li_order_map(idx, SF)
+    assert ok.min() == 1 and ok.max() == n_orders
+    assert (np.diff(ok) >= 0).all()          # ROWID_ORDERED contract
+    cnt = np.bincount(ok)[1:]
+    assert cnt.sum() == n_li                 # row count exactly 4x orders
+    assert cnt.min() >= 1 and cnt.max() == 7  # spec: 1..7 lines per order
+    # linenumber is 1..cnt within each order
+    for o in (1, 7, 8, 12345, n_orders):
+        rows = np.where(ok == o)[0]
+        assert list(ln[rows]) == list(range(1, len(rows) + 1))
+
+
+def test_device_host_order_map_parity():
+    import jax.numpy as jnp
+    idx = np.arange(H._table_rows("lineitem", SF), dtype=np.int64)
+    ok_h, ln_h = H._li_order_map(idx, SF)
+    ok_d, ln_d = D._li_order_map(jnp.asarray(idx), SF)
+    assert (np.asarray(ok_d) == ok_h).all()
+    assert (np.asarray(ln_d) == ln_h).all()
+
+
+def test_q18_shape_is_satisfiable():
+    """Orders with sum(l_quantity) > 300 must be rare-but-possible: 7-line
+    orders exist and the max possible sum is 350."""
+    n_li = H._table_rows("lineitem", SF)
+    idx = np.arange(n_li, dtype=np.int64)
+    ok, _ = H._li_order_map(idx, SF)
+    qty = H._gen_lineitem("quantity", idx, SF) // 100
+    sums = np.bincount(ok, weights=qty)[1:]
+    assert sums.max() > 250                  # the tail exists
+    assert sums.max() <= 350                 # 7 * 50 spec ceiling
+
+
+def test_benchmark_queries_not_hollow():
+    """q9 and q20 must select real rows now (they returned 0 for two
+    rounds because the filters matched nothing)."""
+    from presto_tpu.exec.runner import LocalQueryRunner
+    r = LocalQueryRunner(f"sf{SF:g}")
+    green = r.execute(
+        "select count(*) from part where name like '%green%'").rows[0][0]
+    assert green > 50, green
+    q9ish = r.execute(
+        "select count(*) from lineitem l, part p "
+        "where p.partkey = l.partkey and p.name like '%green%'").rows[0][0]
+    assert q9ish > 1000, q9ish
