@@ -270,6 +270,31 @@ class FusedChain:
                     batch = self._apply_join_expand(
                         batch, step[1], aux[ai], expands[ai], low)
                 ai += 1
+            elif kind == "uid":
+                # position-keyed unique ids: chunk [pos, pos+leaf_cap)
+                # owns id range [pos*K, (pos+leaf_cap)*K) where K is the
+                # join expansion applied so far — disjoint across chunks
+                # and splits, deterministic per (chain, splits), so a
+                # deep-copied decorrelated subtree replays identical ids
+                # (same contract as the streaming operator,
+                # _compile_AssignUniqueIdNode)
+                node = step[1]
+                kprod = 1
+                for j in range(ai):
+                    kprod *= expands[j]
+                cap_here = batch.mask.shape[0]
+                leaf_c = cap_here // kprod
+                base = self.compiler.ctx.task_index << 40
+                # id keyed by (global leaf row, expansion branch): the
+                # join-expand layout is slot = j*C + i, so slot s maps to
+                # leaf row s % leaf_c and branch s // leaf_c — unique even
+                # when a truncated chunk's live rows land in high branches
+                s = jnp.arange(cap_here, dtype=jnp.int64)
+                ids = (base
+                       + (jnp.asarray(pos, dtype=jnp.int64) + s % leaf_c)
+                       * kprod + s // leaf_c)
+                batch = batch.with_columns(
+                    {node.id_variable.name: Column(ids)})
             elif kind == "semi":
                 node = step[1]
                 key = node.source_join_variable.name
@@ -458,6 +483,11 @@ def assemble_chain(compiler, node: P.PlanNode) -> Optional[FusedChain]:
             nd = nd.left
         elif isinstance(nd, P.SemiJoinNode):
             steps.append(("semi", nd))
+            nd = nd.source
+        elif isinstance(nd, P.AssignUniqueIdNode):
+            # unique ids derive from the scan position (see make), so the
+            # decorrelated EXISTS stacks (q21-class) stay in one program
+            steps.append(("uid", nd))
             nd = nd.source
         elif isinstance(nd, P.TableScanNode):
             meta = getattr(compiler._compile(nd), "fused_scan", None)

@@ -1351,7 +1351,7 @@ class PlanCompiler:
                                "exhausted")
 
         def run_retrying(batches_fn=None, start_slots=None):
-            num_slots, salt = start_slots or cfg.agg_slots, 0
+            num_slots, salt = start_slots or initial_slots, 0
             for attempt in range(cfg.max_agg_retries):
                 state, key_dicts, key_lazy, direct = run_once(
                     num_slots, salt, batches_fn)
@@ -1362,9 +1362,29 @@ class PlanCompiler:
                 salt += 1
             raise RuntimeError("aggregation collision retries exhausted")
 
+        # size the scatter table from the optimizer's group-count estimate
+        # so the common case never pays a collision retry (each retry
+        # re-streams the ENTIRE source — 3 full passes for a 10k-group
+        # aggregate started at 4096 slots, the q21 shape).  ~2x headroom
+        # for probing; clamped so a wild overestimate cannot blow HBM.
+        initial_slots = cfg.agg_slots
+        if key_names:
+            try:
+                from ..sql.stats import StatsCalculator
+                est_groups = StatsCalculator().rows(node)
+            except Exception:   # noqa: BLE001 — estimate only
+                est_groups = None
+            if est_groups:
+                # clamp only the ESTIMATE term: a user-configured
+                # agg_slots above the clamp must never be reduced
+                est_based = 1 << max(0, (int(2 * est_groups)
+                                         - 1).bit_length())
+                initial_slots = max(initial_slots,
+                                    min(est_based, 1 << 20))
+
         # rough accumulator footprint for the budget check (hash + occupied
         # + per-key value/null + per-aggregate state columns)
-        est_state_bytes = cfg.agg_slots * (
+        est_state_bytes = initial_slots * (
             16 + 12 * len(key_names) + 24 * max(1, len(specs))
             + ops.hll_state_bytes(specs))
 
@@ -1464,7 +1484,7 @@ class PlanCompiler:
                 store.add(batch, list(key_names))
             # each bucket sees ~1/K of the keys: start with a
             # proportionally smaller table, and account for it
-            bucket_slots = max(256, cfg.agg_slots // cfg.spill_partitions)
+            bucket_slots = max(256, initial_slots // cfg.spill_partitions)
             bucket_bytes = est_state_bytes // cfg.spill_partitions
             for p in range(cfg.spill_partitions):
                 if store.bucket_rows(p) == 0:
