@@ -266,8 +266,13 @@ class DictionaryBlock(Block):
 
     def compact(self) -> "DictionaryBlock":
         """Rewrite so the dictionary contains only referenced entries
-        (DictionaryBlock.compact in the reference — required before serializing)."""
+        (DictionaryBlock.compact in the reference — required before
+        serializing).  An already-compact block is returned unchanged so
+        its dictionary instance id survives re-serialization."""
         used, inverse = np.unique(self.ids, return_inverse=True)
+        if len(used) == self.dictionary.position_count \
+                and np.array_equal(used, np.arange(len(used))):
+            return self
         return DictionaryBlock(inverse.astype(np.int32), self.dictionary.take(used))
 
     def decode(self) -> Block:

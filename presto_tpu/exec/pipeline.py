@@ -819,8 +819,48 @@ class PlanCompiler:
         lim = P.LimitNode(node.id + ".limit", agg, node.count)
         return self._compile(lim)
 
+    def _compile_GroupIdNode(self, node: P.GroupIdNode) -> BatchSource:
+        """Grouping-set expansion (reference GroupIdOperator.java): lower
+        to one ProjectNode per grouping set over the shared source (the
+        compiler memoizes by node id, so the source executes once and its
+        batches are teed), unioned.  The downstream aggregation groups by
+        (grouping columns..., group_id), exactly the reference pairing."""
+        from ..spi.expr import constant
+        branches = []
+        for i, gset in enumerate(node.grouping_sets):
+            in_set = {v.name for v in gset}
+            assigns = {}
+            for out_v, in_v in node.grouping_columns.items():
+                assigns[out_v] = (in_v if out_v.name in in_set
+                                  else constant(None, out_v.type))
+            for v in node.aggregation_arguments:
+                assigns[v] = v
+            assigns[node.group_id_variable] = \
+                constant(i, node.group_id_variable.type)
+            branches.append(P.ProjectNode(f"{node.id}.gid{i}", node.source,
+                                          assigns))
+        union = P.UnionNode(node.id + ".union", branches,
+                            list(node.output_variables))
+        return self._compile(union)
+
+    def _compile_MarkDistinctNode(self, node: P.MarkDistinctNode) -> BatchSource:
+        """Marker = first row of its distinct-key group (reference
+        MarkDistinctOperator/MarkDistinctHash): row_number() partitioned by
+        the distinct keys, marker = (rn == 1)."""
+        from ..spi.expr import call, constant
+        rn = VariableReferenceExpression(f"{node.marker.name}__rn", BIGINT)
+        win = P.WindowNode(
+            node.id + ".rn", node.source, list(node.distinct_variables),
+            None, {rn: P.WindowFunction(
+                CallExpression("row_number", BIGINT, []), None)})
+        assigns = {v: v for v in node.source.output_variables}
+        assigns[node.marker] = call("eq", BOOLEAN, rn, constant(1, BIGINT))
+        proj = P.ProjectNode(node.id + ".mark", win, assigns)
+        return self._compile(proj)
+
     # -- aggregation ------------------------------------------------------
     def _compile_AggregationNode(self, node: P.AggregationNode) -> BatchSource:
+        node = _rewrite_agg_masks(node)
         src_node = node.source
         key_vars = node.grouping_keys
         key_names = tuple(v.name for v in key_vars)
@@ -982,7 +1022,8 @@ class PlanCompiler:
             fused_cache["chain"] = None
             if not cfg.fuse_pipelines or self.ctx.stats is not None:
                 return None   # EXPLAIN ANALYZE wants per-operator stats
-            if any(a.distinct or a.mask for a in node.aggregations.values()):
+            # masks were already lowered to IF-inputs by _rewrite_agg_masks
+            if any(a.distinct for a in node.aggregations.values()):
                 return None
             if any(s.name in ops.HLL_AGGS for s in specs):
                 # HLL registers live in the scatter-hash table only; the
@@ -1483,13 +1524,35 @@ class PlanCompiler:
                     batch = _encode_lazy_keys(batch, encode_keys)
                 store.add(batch, list(key_names))
             # each bucket sees ~1/K of the keys: start with a
-            # proportionally smaller table, and account for it
-            bucket_slots = max(256, initial_slots // cfg.spill_partitions)
-            bucket_bytes = est_state_bytes // cfg.spill_partitions
+            # proportionally smaller table, and account for it.  A bucket
+            # never holds more distinct keys than rows, so cap by the
+            # bucket's actual row count; if even that over-runs the pool,
+            # halve the table until the reservation fits (more retry
+            # passes instead of failure, mirroring the reference's
+            # spill-don't-throw behavior, HashBuilderOperator.java:56).
+            # Only when even the 256-slot minimum exceeds the remaining
+            # budget does reserve() raise — no smaller table exists.
+            per_slot = max(1, est_state_bytes // max(1, initial_slots))
             for p in range(cfg.spill_partitions):
-                if store.bucket_rows(p) == 0:
+                rows_p = store.bucket_rows(p)
+                if rows_p == 0:
                     continue
-                pool.reserve(bucket_bytes)
+                bucket_slots = max(
+                    256, min(initial_slots // cfg.spill_partitions,
+                             1 << (2 * rows_p - 1).bit_length()))
+                reserved = False
+                while True:
+                    bucket_bytes = bucket_slots * per_slot
+                    if pool.try_reserve(bucket_bytes):
+                        reserved = True
+                        break
+                    if bucket_slots <= 256:
+                        break
+                    bucket_slots = max(256, bucket_slots // 2)
+                if not reserved:
+                    # even the minimum table exceeds the remaining budget:
+                    # raise the engine's exceeded-limit error
+                    pool.reserve(bucket_bytes)
                 try:
                     state, key_dicts, key_lazy, direct = run_retrying(
                         lambda p=p: store.bucket_batches(p, cfg.batch_rows),
@@ -2032,6 +2095,36 @@ class PlanCompiler:
 # analog of the reference's ScanFilterAndProjectOperator evaluating
 # non-vectorizable functions row-wise during the scan.
 # ---------------------------------------------------------------------------
+
+
+def _rewrite_agg_masks(node: P.AggregationNode) -> P.AggregationNode:
+    """Lower Aggregation.mask (the reference's FILTER-WHERE / mask channel,
+    AggregationNode.java Aggregation) into masked inputs: every aggregate
+    in the engine ignores NULL inputs, so  agg(x) MASK m  ==
+    agg(IF(m, x, NULL))  and  count(*) MASK m == count(IF(m, 1, NULL))."""
+    if not any(a.mask is not None for a in node.aggregations.values()):
+        return node
+    from ..spi.expr import ConstantExpression, special
+    aggs = {}
+    for v, a in node.aggregations.items():
+        if a.mask is None:
+            aggs[v] = a
+            continue
+        call_ = a.call
+        if call_.arguments:
+            arg0 = call_.arguments[0]
+            masked = special("IF", arg0.type, a.mask, arg0,
+                             ConstantExpression(None, arg0.type))
+            call_ = CallExpression(call_.display_name, call_.type,
+                                   [masked] + list(call_.arguments[1:]))
+        else:                       # count(*)
+            masked = special("IF", BIGINT, a.mask,
+                             ConstantExpression(1, BIGINT),
+                             ConstantExpression(None, BIGINT))
+            call_ = CallExpression(call_.display_name, call_.type, [masked])
+        aggs[v] = P.Aggregation(call_, a.distinct, None)
+    return P.AggregationNode(node.id, node.source, aggs,
+                             node.grouping_keys, node.step)
 
 
 def _direct_mode_info(key_names, key_cols,

@@ -612,6 +612,53 @@ class MarkDistinctNode(PlanNode):
 
 @_node
 @dataclass
+class GroupIdNode(PlanNode):
+    """Grouping-set row expansion (reference GroupIdNode,
+    presto_protocol_core.h:1340-1349, executed by GroupIdOperator.java):
+    each input row is replicated once per grouping set with the grouping
+    columns absent from that set null-filled and `group_id_variable` set to
+    the set's ordinal.  The AggregationNode above groups by
+    (grouping columns..., group_id)."""
+    source: PlanNode
+    grouping_sets: List[List[Variable]]           # per-set OUTPUT columns
+    grouping_columns: Dict[Variable, Variable]    # output -> input column
+    aggregation_arguments: List[Variable] = field(default_factory=list)
+    group_id_variable: Variable = None
+
+    @property
+    def sources(self):
+        return [self.source]
+
+    @property
+    def output_variables(self):
+        return (list(self.grouping_columns) + self.aggregation_arguments
+                + [self.group_id_variable])
+
+    def _to_dict(self):
+        return {"source": self.source.to_dict(),
+                "groupingSets": [_vars_to_dict(s)
+                                 for s in self.grouping_sets],
+                "groupingColumns": [{"output": o.to_dict(),
+                                     "input": i.to_dict()}
+                                    for o, i in
+                                    self.grouping_columns.items()],
+                "aggregationArguments":
+                    _vars_to_dict(self.aggregation_arguments),
+                "groupIdVariable": self.group_id_variable.to_dict()}
+
+    @classmethod
+    def _from_dict(cls, d):
+        return cls(d["id"], PlanNode.from_dict(d["source"]),
+                   [_vars_from_dict(s) for s in d["groupingSets"]],
+                   {RowExpression.from_dict(e["output"]):
+                    RowExpression.from_dict(e["input"])
+                    for e in d["groupingColumns"]},
+                   _vars_from_dict(d["aggregationArguments"]),
+                   RowExpression.from_dict(d["groupIdVariable"]))
+
+
+@_node
+@dataclass
 class EnforceSingleRowNode(PlanNode):
     source: PlanNode
 

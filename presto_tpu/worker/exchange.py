@@ -40,8 +40,11 @@ def pull_pages(location: str, codec: str = DEFAULT_CODEC) -> Iterator[Page]:
             with _request(url) as resp:
                 complete = resp.headers.get(
                     "X-Presto-Buffer-Complete", "false") == "true"
-                next_token = int(resp.headers.get(
-                    "X-Presto-Page-Next-Token", token))
+                # reference name first (PrestoHeaders.PRESTO_PAGE_NEXT_TOKEN
+                # = X-Presto-Page-End-Sequence-Id), repo alias as fallback
+                next_token = int(
+                    resp.headers.get("X-Presto-Page-End-Sequence-Id")
+                    or resp.headers.get("X-Presto-Page-Next-Token", token))
                 body = resp.read()
             retries = 0
         except urllib.error.HTTPError as e:

@@ -288,21 +288,62 @@ def _t_aggregation(d: dict) -> P.PlanNode:
             "multiple grouping sets arrive via GroupIdNode; a plain "
             "AggregationNode must have exactly one")
     keys = [parse_variable(v) for v in gsets["groupingKeys"]]
+    source = _src(d)
     aggregations: Dict[VariableReferenceExpression, P.Aggregation] = {}
+    filter_projections: Dict[VariableReferenceExpression, RowExpression] = {}
     for key, agg in (d.get("aggregations") or {}).items():
         var = parse_map_key_variable(key)
         call = translate_expr(agg["call"])
         mask = parse_variable(agg["mask"]) if agg.get("mask") else None
         if agg.get("filter"):
-            raise PlanTranslationError("FILTER (WHERE ...) aggregates "
-                                       "are not supported")
+            # FILTER (WHERE p): the engine's Aggregation.mask is exactly
+            # the reference's filter semantics (AggregationNode.java pairs
+            # them; the coordinator plans FILTER as either field).  A
+            # non-variable filter expression is bound below via a
+            # synthesized pass-through projection.
+            fexpr = translate_expr(agg["filter"])
+            if mask is not None:
+                # combine with the existing mask INLINE (both operands
+                # must resolve against the input batch: projection
+                # assignments cannot reference sibling assignments)
+                from ..spi.expr import special as _mkspecial
+                combined = VariableReferenceExpression(
+                    f"{var.name}__filtermask", parse_type("boolean"))
+                filter_projections[combined] = _mkspecial(
+                    "AND", parse_type("boolean"), mask, fexpr)
+                mask = combined
+            elif isinstance(fexpr, VariableReferenceExpression):
+                mask = fexpr
+            else:
+                fvar = VariableReferenceExpression(
+                    f"{var.name}__filter", parse_type("boolean"))
+                filter_projections[fvar] = fexpr
+                mask = fvar
         if agg.get("orderBy"):
             raise PlanTranslationError("ORDER BY aggregates are not "
                                        "supported")
         aggregations[var] = P.Aggregation(call, bool(agg.get("distinct")),
                                           mask)
-    return P.AggregationNode(d["id"], _src(d), aggregations, keys,
+    if filter_projections:
+        assigns = {v: v for v in source.output_variables}
+        assigns.update(filter_projections)
+        source = P.ProjectNode(d["id"] + ".aggfilter", source, assigns)
+    return P.AggregationNode(d["id"], source, aggregations, keys,
                              d.get("step", "SINGLE"))
+
+
+def _t_group_id(d: dict) -> P.PlanNode:
+    """GroupIdNode (presto_protocol_core.h:1340-1349): groupingSets are
+    lists of OUTPUT grouping columns; groupingColumns maps each output
+    column to its input ("name<type>" map keys)."""
+    grouping_columns = {parse_map_key_variable(k): parse_variable(v)
+                        for k, v in (d.get("groupingColumns") or {}).items()}
+    return P.GroupIdNode(
+        d["id"], _src(d),
+        [[parse_variable(v) for v in s] for s in d["groupingSets"]],
+        grouping_columns,
+        [parse_variable(v) for v in d.get("aggregationArguments") or []],
+        parse_variable(d["groupIdVariable"]))
 
 
 def _t_join(d: dict) -> P.PlanNode:
@@ -350,10 +391,31 @@ _BOUND = {"UNBOUNDED_PRECEDING": "UNBOUNDED_PRECEDING",
           "UNBOUNDED_FOLLOWING": "UNBOUNDED_FOLLOWING"}
 
 
+def _resolve_constant_int(src: P.PlanNode, expr: RowExpression):
+    """Resolve a frame-offset RowExpression to a Python int.  The
+    coordinator binds offsets as variables assigned constants by a
+    projection below the window (WindowNode.Frame startValue/endValue are
+    variable references); walk the source subtree's projections for the
+    binding (the constant-propagation step the native worker performs in
+    toVeloxQueryPlan's frame conversion)."""
+    if isinstance(expr, ConstantExpression):
+        return int(expr.value)
+    if isinstance(expr, VariableReferenceExpression):
+        for n in P.walk_plan(src):
+            if isinstance(n, P.ProjectNode):
+                for v, e in n.assignments.items():
+                    if v.name == expr.name and \
+                            isinstance(e, ConstantExpression):
+                        return int(e.value)
+    raise PlanTranslationError(
+        f"window frame offset is not a resolvable constant: {expr!r}")
+
+
 def _t_window(d: dict) -> P.PlanNode:
     spec = d["specification"]
     part = [parse_variable(v) for v in spec.get("partitionBy") or []]
     ordering = _ordering_scheme(spec.get("orderingScheme"))
+    source = _src(d)
     funcs: Dict[VariableReferenceExpression, P.WindowFunction] = {}
     for key, f in (d.get("windowFunctions") or {}).items():
         var = parse_map_key_variable(key)
@@ -363,29 +425,81 @@ def _t_window(d: dict) -> P.PlanNode:
         if frame_j:
             start = _BOUND[frame_j["startType"]]
             end = _BOUND[frame_j["endType"]]
-            if frame_j.get("startValue") or frame_j.get("endValue"):
-                # offsets arrive as variables bound below; resolving them
-                # needs constant propagation we don't do yet
+            def _offset(which):
+                if not frame_j.get(which + "Value"):
+                    return None
+                try:
+                    return _resolve_constant_int(
+                        source, translate_expr(frame_j[which + "Value"]))
+                except PlanTranslationError:
+                    # Frame.originalStartValue/originalEndValue carry the
+                    # source text of the offset (presto_protocol_core.h:
+                    # 1324-1325) — a literal offset parses directly
+                    orig = frame_j.get("original" + which.capitalize()
+                                       + "Value")
+                    if orig is not None:
+                        try:
+                            return int(str(orig))
+                        except ValueError:
+                            pass
+                    raise
+
+            so = _offset("start")
+            eo = _offset("end")
+            if frame_j["type"] != "ROWS" and (so is not None
+                                              or eo is not None):
+                # the window executor implements offset bounds for ROWS
+                # frames only (operators.py frame_bounds); RANGE/GROUPS
+                # offsets must stay a translate-time rejection
                 raise PlanTranslationError(
-                    "window frames with value offsets are not supported")
-            if not (frame_j["type"] == "RANGE"
+                    f"{frame_j['type']} frames with value offsets are "
+                    f"not supported")
+            if not (frame_j["type"] == "RANGE" and so is None and eo is None
                     and start == "UNBOUNDED_PRECEDING" and end == "CURRENT"):
                 frame = {"type": frame_j["type"], "startKind": start,
-                         "startOffset": None, "endKind": end,
-                         "endOffset": None}
+                         "startOffset": so, "endKind": end,
+                         "endOffset": eo}
         funcs[var] = P.WindowFunction(call, frame)
-    return P.WindowNode(d["id"], _src(d), part, ordering, funcs)
+    return P.WindowNode(d["id"], source, part, ordering, funcs)
+
+
+def _row_number_limited(node_id: str, source: P.PlanNode,
+                        part: List[VariableReferenceExpression],
+                        ordering: Optional[P.OrderingScheme],
+                        rn: VariableReferenceExpression,
+                        limit: Optional[int]) -> P.PlanNode:
+    """row_number() window, optionally filtered to rn <= limit — the
+    shared lowering for RowNumberNode.maxRowCountPerPartition and
+    TopNRowNumberNode (the reference's TopNRowNumberOperator is an
+    execution-time optimization of exactly this pair)."""
+    from ..spi.expr import call as _mkcall, constant as _mkconst
+    win = P.WindowNode(node_id, source, part, ordering,
+                       {rn: P.WindowFunction(
+                           CallExpression("row_number", BIGINT, []), None)})
+    if limit is None:
+        return win
+    pred = _mkcall("lte", parse_type("boolean"), rn,
+                   _mkconst(int(limit), BIGINT))
+    return P.FilterNode(node_id + ".topn", win, pred)
+
+
+def _t_topn_row_number(d: dict) -> P.PlanNode:
+    """TopNRowNumberNode (presto_protocol_core.h:2417-2426)."""
+    spec = d["specification"]
+    return _row_number_limited(
+        d["id"], _src(d),
+        [parse_variable(v) for v in spec.get("partitionBy") or []],
+        _ordering_scheme(spec.get("orderingScheme")),
+        parse_variable(d["rowNumberVariable"]),
+        int(d["maxRowCountPerPartition"]))
 
 
 def _t_row_number(d: dict) -> P.PlanNode:
-    if d.get("maxRowCountPerPartition") is not None:
-        raise PlanTranslationError(
-            "RowNumberNode with maxRowCountPerPartition")
-    var = parse_variable(d["rowNumberVariable"])
-    part = [parse_variable(v) for v in d.get("partitionBy") or []]
-    call = CallExpression("row_number", BIGINT, [])
-    return P.WindowNode(d["id"], _src(d), part, None,
-                        {var: P.WindowFunction(call, None)})
+    return _row_number_limited(
+        d["id"], _src(d),
+        [parse_variable(v) for v in d.get("partitionBy") or []],
+        None, parse_variable(d["rowNumberVariable"]),
+        d.get("maxRowCountPerPartition"))
 
 
 _NODE_HANDLERS = {
@@ -400,6 +514,8 @@ _NODE_HANDLERS = {
     ".DistinctLimitNode": _t_distinct_limit,
     ".MarkDistinctNode": _t_mark_distinct,
     ".AggregationNode": _t_aggregation,
+    ".GroupIdNode": _t_group_id,
+    ".TopNRowNumberNode": _t_topn_row_number,
     ".JoinNode": _t_join,
     ".SemiJoinNode": _t_semi_join,
     ".WindowNode": _t_window,

@@ -401,7 +401,13 @@ collapse}}td,th{{border:1px solid #ccc;padding:4px 8px;text-align:left}}
         pages, next_token, complete = task.buffers.get(
             int(groups["buffer"]), int(groups["token"]), max_wait)
         body = b"".join(pages)
+        # reference header names (PrestoHeaders.java:51-52 /
+        # presto_protocol_core.cpp:82-84): the Java ExchangeClient reads
+        # X-Presto-Page-Sequence-Id / X-Presto-Page-End-Sequence-Id.  The
+        # pre-round-4 repo names are kept as aliases for older peers.
         self._send(200, None, body, headers={
+            "X-Presto-Page-Sequence-Id": groups["token"],
+            "X-Presto-Page-End-Sequence-Id": str(next_token),
             "X-Presto-Page-Token": groups["token"],
             "X-Presto-Page-Next-Token": str(next_token),
             "X-Presto-Buffer-Complete": "true" if complete else "false",

@@ -203,6 +203,60 @@ def node_json(n: P.PlanNode) -> dict:
         return {"@type": _JAVA + "AssignUniqueId", "id": n.id,
                 "source": node_json(n.source),
                 "idVariable": var_json(n.id_variable)}
+    if isinstance(n, P.GroupIdNode):
+        return {"@type": _JAVA + "GroupIdNode", "id": n.id,
+                "source": node_json(n.source),
+                "groupingSets": [[var_json(v) for v in s]
+                                 for s in n.grouping_sets],
+                "groupingColumns": {map_key(o): var_json(i)
+                                    for o, i in n.grouping_columns.items()},
+                "aggregationArguments": [var_json(v)
+                                         for v in n.aggregation_arguments],
+                "groupIdVariable": var_json(n.group_id_variable)}
+    if isinstance(n, P.WindowNode):
+        funcs = {}
+        for v, wf in n.window_functions.items():
+            cj = call_json(wf.call)
+            cj["functionHandle"]["signature"]["kind"] = "WINDOW"
+            f = wf.frame
+            if f is None:
+                frame = {"type": "RANGE",
+                         "startType": "UNBOUNDED_PRECEDING",
+                         "endType": "CURRENT_ROW"}
+            else:
+                unbound = {"UNBOUNDED_PRECEDING": "UNBOUNDED_PRECEDING",
+                           "UNBOUNDED_FOLLOWING": "UNBOUNDED_FOLLOWING",
+                           "PRECEDING": "PRECEDING",
+                           "FOLLOWING": "FOLLOWING",
+                           "CURRENT": "CURRENT_ROW"}
+                frame = {"type": f["type"],
+                         "startType": unbound[f["startKind"]],
+                         "endType": unbound[f["endKind"]]}
+                # offsets ride as variable refs plus the original literal
+                # text (Frame.originalStartValue, presto_protocol_core.h:
+                # 1324-1325) — the coordinator binds the variable in a
+                # projection below; the literal is the fallback
+                if f.get("startOffset") is not None:
+                    frame["startValue"] = var_json(
+                        VariableReferenceExpression(
+                            f"$frame_start_{n.id}", wf.call.type))
+                    frame["originalStartValue"] = str(f["startOffset"])
+                if f.get("endOffset") is not None:
+                    frame["endValue"] = var_json(
+                        VariableReferenceExpression(
+                            f"$frame_end_{n.id}", wf.call.type))
+                    frame["originalEndValue"] = str(f["endOffset"])
+            funcs[map_key(v)] = {"functionCall": cj, "frame": frame,
+                                 "ignoreNulls": False}
+        return {"@type": _JAVA + "WindowNode", "id": n.id,
+                "source": node_json(n.source),
+                "specification": {
+                    "partitionBy": [var_json(v) for v in n.partition_by],
+                    **({"orderingScheme":
+                        ordering_json(n.ordering_scheme)}
+                       if n.ordering_scheme else {})},
+                "windowFunctions": funcs,
+                "prePartitionedInputs": [], "preSortedOrderPrefix": 0}
     if isinstance(n, P.RemoteSourceNode):
         return {"@type": _JAVA + "RemoteSourceNode", "id": n.id,
                 "sourceFragmentIds": list(n.source_fragment_ids),

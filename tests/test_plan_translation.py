@@ -227,6 +227,316 @@ def test_reference_shaped_execution_parity(runner, name):
 
 
 # ---------------------------------------------------------------------------
+# 2b. hand-authored wire samples for round-3 gap nodes (field layouts
+# copied from presto_protocol_core.h structs, cited per test) — each is
+# translated and EXECUTED, with a plain-SQL oracle on the same data
+# ---------------------------------------------------------------------------
+
+def _run_node(runner, node, out_names=None):
+    from presto_tpu.exec.pipeline import PlanCompiler, TaskContext
+    from presto_tpu.exec.runner import pages_to_result
+    comp = PlanCompiler(TaskContext(config=runner.config))
+    names = out_names or [v.name for v in node.output_variables]
+    return pages_to_result(comp.run_to_pages(node), names,
+                           [v.type for v in node.output_variables])
+
+
+def _nation_scan_json(cols):
+    """Reference TableScanNode JSON over tpch nation (shape as in
+    ScanAgg.json / presto_protocol_core.h TableScanNode)."""
+    return {
+        "@type": ".TableScanNode", "id": "scan",
+        "table": {"connectorId": "tpch",
+                  "connectorHandle": {"@type": "tpch",
+                                      "tableName": "nation",
+                                      "scaleFactor": 0.01},
+                  "transaction": {"@type": "tpch", "instance": "test"}},
+        "outputVariables": [{"@type": "variable", "name": n,
+                             "type": "bigint"} for n in cols],
+        "assignments": {f"{n}<bigint>": {"@type": "tpch",
+                                         "columnName": n.split("_", 1)[1],
+                                         "type": "bigint"}
+                        for n in cols}}
+
+
+def _vj(name, typ="bigint"):
+    return {"@type": "variable", "name": name, "type": typ}
+
+
+def _count_call(arg):
+    return {"@type": "call", "displayName": "count",
+            "functionHandle": {"@type": "$static", "signature": {
+                "name": "presto.default.count", "kind": "AGGREGATE",
+                "returnType": "bigint", "argumentTypes": ["bigint"],
+                "typeVariableConstraints": [],
+                "longVariableConstraints": [], "variableArity": False}},
+            "returnType": "bigint", "arguments": [_vj(arg)]}
+
+
+def test_group_id_node_wire_sample(runner):
+    """GroupIdNode wire layout per presto_protocol_core.h:1340-1349
+    (groupingSets: List<List<Variable>>, groupingColumns: Map with
+    "name<type>" keys, aggregationArguments, groupIdVariable), paired with
+    the grouping AggregationNode above it the way the coordinator plans
+    ROLLUP.  Oracle: the engine's own ROLLUP SQL."""
+    gid = {
+        "@type": "com.facebook.presto.sql.planner.plan.GroupIdNode",
+        "id": "groupid",
+        "source": _nation_scan_json(["n_regionkey", "n_nationkey"]),
+        "groupingSets": [[_vj("n_regionkey$gid")], []],
+        "groupingColumns": {"n_regionkey$gid<bigint>": _vj("n_regionkey")},
+        "aggregationArguments": [_vj("n_nationkey")],
+        "groupIdVariable": _vj("groupid")}
+    agg = {
+        "@type": ".AggregationNode", "id": "agg", "source": gid,
+        "aggregations": {"cnt<bigint>": {"call": _count_call("n_nationkey"),
+                                         "distinct": False}},
+        "groupingSets": {"groupingKeys": [_vj("n_regionkey$gid"),
+                                          _vj("groupid")],
+                         "groupingSetCount": 1, "globalGroupingSets": []},
+        "preGroupedVariables": [], "step": "SINGLE"}
+    node = T.translate_node(json.loads(json.dumps(agg)))
+    assert isinstance(node, P.AggregationNode)
+    assert isinstance(node.source, P.GroupIdNode)
+    got = _run_node(runner, node)
+    # project away groupid, as the coordinator's enclosing projection would
+    key = lambda r: tuple((v is None, v) for v in r)   # noqa: E731
+    got_rows = sorted(((r[0], r[2]) for r in got.rows), key=key)
+    want = runner.execute("SELECT n_regionkey, count(n_nationkey) "
+                          "FROM nation GROUP BY ROLLUP(n_regionkey)")
+    assert got_rows == sorted((tuple(r) for r in want.rows), key=key)
+
+
+def test_filter_aggregate_wire_sample(runner):
+    """Aggregation.filter (presto_protocol_core.h:434-442: filter is a
+    RowExpression next to call/mask) — both the expression form and the
+    pre-bound variable form.  Oracle: WHERE-equivalent SQL."""
+    gt_call = {"@type": "call", "displayName": "GREATER_THAN",
+               "functionHandle": {"@type": "$static", "signature": {
+                   "name": "presto.default.$operator$greater_than",
+                   "kind": "SCALAR", "returnType": "boolean",
+                   "argumentTypes": ["bigint", "bigint"],
+                   "typeVariableConstraints": [],
+                   "longVariableConstraints": [], "variableArity": False}},
+               "returnType": "boolean",
+               "arguments": [_vj("n_regionkey"),
+                             {"@type": "constant", "type": "bigint",
+                              "valueBlock":
+                              "CgAAAExPTkdfQVJSQVkBAAAAAAIAAAAAAAAA"}]}
+    agg = {
+        "@type": ".AggregationNode", "id": "agg",
+        "source": _nation_scan_json(["n_regionkey", "n_nationkey"]),
+        "aggregations": {"cnt<bigint>": {"call": _count_call("n_nationkey"),
+                                         "filter": gt_call,
+                                         "distinct": False}},
+        "groupingSets": {"groupingKeys": [], "groupingSetCount": 1,
+                         "globalGroupingSets": []},
+        "preGroupedVariables": [], "step": "SINGLE"}
+    node = T.translate_node(json.loads(json.dumps(agg)))
+    assert isinstance(node, P.AggregationNode)
+    (_, a), = node.aggregations.items()
+    assert a.mask is not None      # filter lowered to the engine's mask
+    got = _run_node(runner, node)
+    want = runner.execute("SELECT count(n_nationkey) FROM nation "
+                          "WHERE n_regionkey > 2")
+    assert got.rows[0][0] == want.rows[0][0]
+
+
+def test_filter_plus_mask_aggregate_executes(runner):
+    """An aggregate carrying BOTH a mask variable and a FILTER expression
+    (the coordinator's count(DISTINCT x) FILTER (WHERE p) shape) must
+    combine them and execute — regression for the inline-AND translation."""
+    gt_call = {"@type": "call", "displayName": "GREATER_THAN",
+               "functionHandle": {"@type": "$static", "signature": {
+                   "name": "presto.default.$operator$greater_than",
+                   "kind": "SCALAR", "returnType": "boolean",
+                   "argumentTypes": ["bigint", "bigint"],
+                   "typeVariableConstraints": [],
+                   "longVariableConstraints": [], "variableArity": False}},
+               "returnType": "boolean",
+               "arguments": [_vj("n_regionkey"),
+                             {"@type": "constant", "type": "bigint",
+                              "valueBlock":
+                              "CgAAAExPTkdfQVJSQVkBAAAAAAIAAAAAAAAA"}]}
+    # mask variable bound below: m = n_nationkey < 20
+    lt_call = {"@type": "call", "displayName": "LESS_THAN",
+               "functionHandle": {"@type": "$static", "signature": {
+                   "name": "presto.default.$operator$less_than",
+                   "kind": "SCALAR", "returnType": "boolean",
+                   "argumentTypes": ["bigint", "bigint"],
+                   "typeVariableConstraints": [],
+                   "longVariableConstraints": [], "variableArity": False}},
+               "returnType": "boolean",
+               "arguments": [_vj("n_nationkey"),
+                             {"@type": "constant", "type": "bigint",
+                              "valueBlock": base64.b64encode(
+                                  b"\x0a\x00\x00\x00LONG_ARRAY"
+                                  b"\x01\x00\x00\x00\x00"
+                                  b"\x14\x00\x00\x00\x00\x00\x00\x00"
+                              ).decode()}]}
+    proj = {"@type": ".ProjectNode", "id": "bindmask",
+            "source": _nation_scan_json(["n_regionkey", "n_nationkey"]),
+            "assignments": {"assignments": {
+                "n_regionkey<bigint>": _vj("n_regionkey"),
+                "n_nationkey<bigint>": _vj("n_nationkey"),
+                "m<boolean>": lt_call}},
+            "locality": "LOCAL"}
+    agg = {
+        "@type": ".AggregationNode", "id": "agg", "source": proj,
+        "aggregations": {"cnt<bigint>": {"call": _count_call("n_nationkey"),
+                                         "filter": gt_call,
+                                         "mask": _vj("m", "boolean"),
+                                         "distinct": False}},
+        "groupingSets": {"groupingKeys": [], "groupingSetCount": 1,
+                         "globalGroupingSets": []},
+        "preGroupedVariables": [], "step": "SINGLE"}
+    node = T.translate_node(json.loads(json.dumps(agg)))
+    got = _run_node(runner, node)
+    want = runner.execute("SELECT count(n_nationkey) FROM nation "
+                          "WHERE n_regionkey > 2 AND n_nationkey < 20")
+    assert got.rows[0][0] == want.rows[0][0]
+
+
+def test_range_frame_with_offsets_rejected():
+    """RANGE frames with value offsets must fail at TRANSLATE time (the
+    executor implements offset bounds for ROWS only)."""
+    win = {"@type": "com.facebook.presto.sql.planner.plan.WindowNode",
+           "id": "win",
+           "source": _nation_scan_json(["n_regionkey", "n_nationkey"]),
+           "specification": {
+               "partitionBy": [],
+               "orderingScheme": {"orderBy": [
+                   {"variable": _vj("n_nationkey"),
+                    "sortOrder": "ASC_NULLS_LAST"}]}},
+           "windowFunctions": {"s<bigint>": {
+               "functionCall": _count_call("n_nationkey"),
+               "frame": {"type": "RANGE", "startType": "PRECEDING",
+                         "originalStartValue": "2",
+                         "startValue": _vj("$off"),
+                         "endType": "CURRENT_ROW"},
+               "ignoreNulls": False}},
+           "prePartitionedInputs": [], "preSortedOrderPrefix": 0}
+    with pytest.raises(T.PlanTranslationError, match="RANGE"):
+        T.translate_node(json.loads(json.dumps(win)))
+
+
+def test_topn_row_number_wire_sample(runner):
+    """TopNRowNumberNode (presto_protocol_core.h:2417-2426: specification
+    + rowNumberVariable + maxRowCountPerPartition + partial).  Oracle: the
+    row_number()-subquery SQL the node is an optimization of."""
+    d = {"@type":
+         "com.facebook.presto.sql.planner.plan.TopNRowNumberNode",
+         "id": "topnrn",
+         "source": _nation_scan_json(["n_regionkey", "n_nationkey"]),
+         "specification": {
+             "partitionBy": [_vj("n_regionkey")],
+             "orderingScheme": {"orderBy": [
+                 {"variable": _vj("n_nationkey"),
+                  "sortOrder": "DESC_NULLS_LAST"}]}},
+         "rowNumberVariable": _vj("rn"),
+         "maxRowCountPerPartition": 2, "partial": False}
+    node = T.translate_node(json.loads(json.dumps(d)))
+    got = _run_node(runner, node)
+    got_rows = sorted((r[0], r[1]) for r in got.rows)
+    want = runner.execute(
+        "SELECT * FROM (SELECT n_regionkey, n_nationkey, row_number() "
+        "OVER (PARTITION BY n_regionkey ORDER BY n_nationkey DESC) rn "
+        "FROM nation) t WHERE rn <= 2")
+    assert got_rows == sorted((r[0], r[1]) for r in want.rows)
+
+
+def test_window_value_offset_frame_wire_sample(runner):
+    """Frame startValue/endValue as variable refs bound to constants by
+    the projection below (presto_protocol_core.h:1314-1326) — the
+    coordinator's actual shape for ROWS k PRECEDING.  Also exercises the
+    originalStartValue fallback text.  Oracle: the same frame in SQL."""
+    proj = {"@type": ".ProjectNode", "id": "bindoffsets",
+            "source": _nation_scan_json(["n_regionkey", "n_nationkey"]),
+            "assignments": {"assignments": {
+                "n_regionkey<bigint>": _vj("n_regionkey"),
+                "n_nationkey<bigint>": _vj("n_nationkey"),
+                "$off<bigint>": {"@type": "constant", "type": "bigint",
+                                 "valueBlock":
+                                 "CgAAAExPTkdfQVJSQVkBAAAAAAIAAAAAAAAA"}}},
+            "locality": "LOCAL"}
+    sum_call = {"@type": "call", "displayName": "sum",
+                "functionHandle": {"@type": "$static", "signature": {
+                    "name": "presto.default.sum", "kind": "WINDOW",
+                    "returnType": "bigint", "argumentTypes": ["bigint"],
+                    "typeVariableConstraints": [],
+                    "longVariableConstraints": [],
+                    "variableArity": False}},
+                "returnType": "bigint", "arguments": [_vj("n_nationkey")]}
+    win = {"@type": "com.facebook.presto.sql.planner.plan.WindowNode",
+           "id": "win", "source": proj,
+           "specification": {
+               "partitionBy": [],
+               "orderingScheme": {"orderBy": [
+                   {"variable": _vj("n_nationkey"),
+                    "sortOrder": "ASC_NULLS_LAST"}]}},
+           "windowFunctions": {"s<bigint>": {
+               "functionCall": sum_call,
+               "frame": {"type": "ROWS",
+                         "startType": "PRECEDING",
+                         "startValue": _vj("$off"),
+                         "originalStartValue": "2",
+                         "endType": "CURRENT_ROW"},
+               "ignoreNulls": False}},
+           "prePartitionedInputs": [], "preSortedOrderPrefix": 0}
+    node = T.translate_node(json.loads(json.dumps(win)))
+    assert isinstance(node, P.WindowNode)
+    (_, wf), = node.window_functions.items()
+    assert wf.frame == {"type": "ROWS", "startKind": "PRECEDING",
+                        "startOffset": 2, "endKind": "CURRENT",
+                        "endOffset": None}
+    got = _run_node(runner, node)
+    got_rows = sorted((r[1], r[3]) for r in got.rows)
+    want = runner.execute(
+        "SELECT n_nationkey, sum(n_nationkey) OVER (ORDER BY n_nationkey "
+        "ROWS BETWEEN 2 PRECEDING AND CURRENT ROW) s FROM nation")
+    assert got_rows == sorted(tuple(r) for r in want.rows)
+
+
+def test_mark_distinct_executes(runner):
+    """MarkDistinctNode now has an execution path (round-3 latent gap: it
+    translated but could not compile).  Round-trip through the
+    reference-shaped emitter; oracle = count(distinct)."""
+    from presto_tpu.common.types import BOOLEAN
+    scan = T.translate_node(
+        json.loads(json.dumps(_nation_scan_json(["n_regionkey",
+                                                 "n_nationkey"]))))
+    from presto_tpu.spi.expr import VariableReferenceExpression as V
+    md = P.MarkDistinctNode("md", scan, V("marker", BOOLEAN),
+                            [V("n_regionkey", scan.outputs[0].type)])
+    back = T.translate_node(json.loads(json.dumps(RS.node_json(md))))
+    assert isinstance(back, P.MarkDistinctNode)
+    got = _run_node(runner, back)
+    marked = [r for r in got.rows if r[2]]
+    want = runner.execute("SELECT count(DISTINCT n_regionkey) FROM nation")
+    assert len(marked) == want.rows[0][0]
+
+
+def test_group_id_round_trip_via_emitter(runner):
+    """Repo GroupIdNode IR -> reference JSON (tests/reference_shapes.py)
+    -> translate -> same IR shape."""
+    scan = T.translate_node(
+        json.loads(json.dumps(_nation_scan_json(["n_regionkey",
+                                                 "n_nationkey"]))))
+    from presto_tpu.spi.expr import VariableReferenceExpression as V
+    rk = V("n_regionkey$gid", scan.outputs[0].type)
+    gid = P.GroupIdNode("gid", scan, [[rk], []],
+                        {rk: scan.outputs[0]}, [scan.outputs[1]],
+                        V("groupid", scan.outputs[0].type))
+    back = T.translate_node(json.loads(json.dumps(RS.node_json(gid))))
+    assert isinstance(back, P.GroupIdNode)
+    assert [[v.name for v in s] for s in back.grouping_sets] \
+        == [["n_regionkey$gid"], []]
+    assert {o.name: i.name for o, i in back.grouping_columns.items()} \
+        == {"n_regionkey$gid": "n_regionkey"}
+    assert back.group_id_variable.name == "groupid"
+
+
+# ---------------------------------------------------------------------------
 # 3. live worker driven by a fully reference-shaped update
 # ---------------------------------------------------------------------------
 
@@ -286,7 +596,7 @@ def test_worker_runs_reference_fragment_end_to_end():
                 f"{w.uri}/v1/task/q_ref.0.0.0.0/results/0/{token}")
             data = r.read()
             complete = r.headers.get("X-Presto-Buffer-Complete") == "true"
-            nxt = r.headers.get("X-Presto-Page-Token")
+            nxt = r.headers.get("X-Presto-Page-End-Sequence-Id")
             if data:
                 pos = 0
                 while pos < len(data):

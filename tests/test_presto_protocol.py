@@ -135,7 +135,7 @@ def test_worker_accepts_reference_envelope_with_repo_fragment():
                 f"{w.uri}/v1/task/q_interop.0.0.0.0/results/0/{token}")
             data = r.read()
             complete = r.headers.get("X-Presto-Buffer-Complete") == "true"
-            nxt = r.headers.get("X-Presto-Page-Token")
+            nxt = r.headers.get("X-Presto-Page-End-Sequence-Id")
             if data:
                 pos = 0
                 while pos < len(data):

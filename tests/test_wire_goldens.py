@@ -1,0 +1,254 @@
+"""Encoding-per-encoding SerializedPage wire goldens (VERDICT r3 next #7).
+
+Two independent layers of evidence:
+
+1. JAVA-PRODUCED bytes: every base64 "valueBlock" in the reference tree's
+   checked-in JSON fixtures (presto_cpp/main/types/tests/data/,
+   presto_cpp/presto_protocol/tests/data/ — bytes written by the Java
+   BlockEncodings via Jackson) must decode through the repo serde AND
+   re-encode byte-identically.  This covers INT_ARRAY, LONG_ARRAY,
+   BYTE_ARRAY, VARIABLE_WIDTH and a nested ARRAY[VARIABLE_WIDTH].
+
+2. Hand-derived FULL-PAGE goldens for the encodings the fixtures do not
+   reach (dictionary, RLE, nulled var-width, INT128), built field-by-field
+   in this file from the reference encoder sources, cited per field:
+     header        PagesSerdeUtil.java:64-88 (21 bytes: positionCount:i32,
+                   codecMarkers:u8, uncompressedSize:i32, size:i32,
+                   checksum:i64, all LE)
+     checksum      PagesSerdeUtil.java:102-119 (CRC32 over pageData,
+                   markers byte, positionCount LE32, uncompressedSize LE32)
+     raw page      PagesSerdeUtil.writeRawPage:45-51 (channelCount then
+                   writeBlock per channel)
+     block framing BlockEncodingManager.java:79-99 (i32 name length,
+                   UTF-8 name, payload)
+     nulls         EncoderUtil.java (mayHaveNull byte; MSB-first bitmap,
+                   1 == null; fixed-width payloads carry non-null values
+                   only)
+     DICTIONARY    DictionaryBlockEncoding.java:38-53 (positionCount,
+                   nested dictionary block, i32 ids, 24-byte instance id:
+                   msb/lsb/sequenceId longs)
+     RLE           RunLengthBlockEncoding.java:31-41 (positionCount, then
+                   the single-position value block)
+     VARIABLE_WIDTH VariableWidthBlockEncoding.java:37-58 (positionCount,
+                   cumulative end offsets incl. null positions, nulls,
+                   totalLength, bytes)
+     INT128_ARRAY  Int128ArrayBlockEncoding.java (positionCount, nulls,
+                   16-byte values for non-null positions)
+
+The LZ4 page test cross-checks the compressed body against the repo's
+INDEPENDENT pure-python LZ4 block decoder (common/compression.py) rather
+than the encoder's own inverse.
+"""
+import base64
+import glob
+import io
+import json
+import os
+import re
+import struct
+import zlib
+
+import numpy as np
+import pytest
+
+from presto_tpu.common import (
+    DictionaryBlock, FixedWidthBlock, Int128Block, Page, RunLengthBlock,
+    VariableWidthBlock, deserialize_page, serialize_page,
+)
+from presto_tpu.common.serde import read_block, write_block
+
+REF_FIXTURE_DIRS = [
+    "/root/reference/presto-native-execution/presto_cpp/main/types/tests/data",
+    "/root/reference/presto-native-execution/presto_cpp/presto_protocol/tests/data",
+    "/root/reference/presto-native-execution/presto_cpp/main/tests/data",
+]
+
+needs_reference = pytest.mark.skipif(
+    not os.path.isdir(REF_FIXTURE_DIRS[0]), reason="reference tree absent")
+
+
+def _scavenge_valueblocks():
+    """Every distinct base64 valueBlock in the reference JSON fixtures."""
+    found = set()
+    for d in REF_FIXTURE_DIRS:
+        for path in glob.glob(os.path.join(d, "*.json")):
+            with open(path) as f:
+                text = f.read()
+            for m in re.finditer(r'"valueBlock"\s*:\s*"([^"]+)"', text):
+                found.add(m.group(1))
+    return sorted(found)
+
+
+@needs_reference
+def test_java_produced_blocks_roundtrip_byte_identical():
+    samples = _scavenge_valueblocks()
+    assert len(samples) >= 6, "expected Java-produced samples in fixtures"
+    encodings = set()
+    for b64 in samples:
+        raw = base64.b64decode(b64)
+        block, pos = read_block(memoryview(raw), 0)
+        assert pos == len(raw), "trailing bytes after Java block"
+        out = io.BytesIO()
+        write_block(out, block)
+        assert out.getvalue() == raw, \
+            f"re-encode of Java bytes differs for {b64[:24]}…"
+        encodings.add(block.encoding)
+    # the fixture population must actually exercise several encodings
+    assert {"INT_ARRAY", "LONG_ARRAY", "BYTE_ARRAY",
+            "VARIABLE_WIDTH", "ARRAY"} <= encodings
+
+
+# ---------------------------------------------------------------------------
+# hand-derived full-page goldens
+# ---------------------------------------------------------------------------
+
+def _enc_name(name: str) -> bytes:
+    # BlockEncodingManager.java:79-84: i32 length + UTF-8 name
+    return struct.pack("<i", len(name)) + name.encode()
+
+
+def _page_golden(body: bytes, position_count: int) -> bytes:
+    """21-byte header + body with CHECKSUMMED marker, every field built
+    here independently of presto_tpu.common.serde."""
+    markers = 0x04                              # PageCodecMarker CHECKSUMMED
+    crc = zlib.crc32(body)
+    crc = zlib.crc32(bytes([markers]), crc)
+    crc = zlib.crc32(struct.pack("<i", position_count), crc)
+    crc = zlib.crc32(struct.pack("<i", len(body)), crc)
+    return struct.pack("<ibiiq", position_count, markers, len(body),
+                       len(body), crc & 0xFFFFFFFF) + body
+
+
+def test_dictionary_page_golden():
+    """DICTIONARY[VARIABLE_WIDTH] page: ids [0,1,0,0] over dict
+    ["aa","b"], layout per DictionaryBlockEncoding.java:38-53."""
+    dict_block = (
+        _enc_name("VARIABLE_WIDTH")
+        + struct.pack("<i", 2)                  # dictionary positionCount
+        + struct.pack("<ii", 2, 3)              # cumulative end offsets
+        + b"\x00"                               # no nulls
+        + struct.pack("<i", 3) + b"aab"         # totalLength + bytes
+    )
+    body = (
+        struct.pack("<i", 1)                    # channelCount
+        + _enc_name("DICTIONARY")
+        + struct.pack("<i", 4)                  # positionCount
+        + dict_block                            # nested dictionary
+        + struct.pack("<4i", 0, 1, 0, 0)        # ids
+        + struct.pack("<qqq", 7, 8, 9)          # instance id msb/lsb/seq
+    )
+    golden = _page_golden(body, 4)
+    page, pos = deserialize_page(golden)
+    assert pos == len(golden)
+    (blk,) = page.blocks
+    assert isinstance(blk, DictionaryBlock)
+    assert blk.to_pylist() == ["aa", "b", "aa", "aa"]
+    assert tuple(blk.source_id) == (7, 8, 9)
+    # encode side: same page must serialize back to the same bytes
+    assert serialize_page(page, checksummed=True) == golden
+
+
+def test_rle_page_golden():
+    """RLE page: 5 x BIGINT 42, layout per
+    RunLengthBlockEncoding.java:31-41."""
+    body = (
+        struct.pack("<i", 1)
+        + _enc_name("RLE")
+        + struct.pack("<i", 5)                  # run length
+        + _enc_name("LONG_ARRAY")               # single-position value
+        + struct.pack("<i", 1) + b"\x00" + struct.pack("<q", 42)
+    )
+    golden = _page_golden(body, 5)
+    page, pos = deserialize_page(golden)
+    assert pos == len(golden)
+    (blk,) = page.blocks
+    assert isinstance(blk, RunLengthBlock)
+    assert blk.to_pylist() == [42] * 5
+    assert serialize_page(page, checksummed=True) == golden
+
+
+def test_varwidth_nulls_page_golden():
+    """VARIABLE_WIDTH with a null at position 1: offsets STILL advance one
+    slot per position (VariableWidthBlockEncoding.java:45-50 writes the
+    cumulative length for every position; a null contributes 0)."""
+    body = (
+        struct.pack("<i", 1)
+        + _enc_name("VARIABLE_WIDTH")
+        + struct.pack("<i", 3)                  # positionCount
+        + struct.pack("<iii", 2, 2, 5)          # ends: "ab", null, "cde"
+        + b"\x01" + bytes([0b01000000])         # nulls bitmap, MSB-first
+        + struct.pack("<i", 5) + b"abcde"
+    )
+    golden = _page_golden(body, 3)
+    page, pos = deserialize_page(golden)
+    assert pos == len(golden)
+    (blk,) = page.blocks
+    assert isinstance(blk, VariableWidthBlock)
+    assert blk.to_pylist() == ["ab", None, "cde"]
+    assert serialize_page(page, checksummed=True) == golden
+
+
+def test_int128_nulls_page_golden():
+    """INT128_ARRAY (long decimals): 3 positions, null at 2; non-null
+    values only, (high, low) long pairs per Int128ArrayBlockEncoding."""
+    body = (
+        struct.pack("<i", 1)
+        + _enc_name("INT128_ARRAY")
+        + struct.pack("<i", 3)
+        + b"\x01" + bytes([0b00100000])         # null at position 2
+        + struct.pack("<qq", 0, 1)              # value 1  (high, low)
+        + struct.pack("<qq", -1, -2)            # value -2 sign-extended
+    )
+    golden = _page_golden(body, 3)
+    page, pos = deserialize_page(golden)
+    assert pos == len(golden)
+    (blk,) = page.blocks
+    assert isinstance(blk, Int128Block)
+    got = np.asarray(blk.values)
+    assert got[0].tolist() == [0, 1]
+    assert got[1].tolist() == [-1, -2]
+    assert blk.null_mask().tolist() == [False, False, True]
+    assert serialize_page(page, checksummed=True) == golden
+
+
+def test_fixed_width_nulls_page_golden():
+    """LONG_ARRAY with nulls inside a multi-channel page: channelCount
+    per PagesSerdeUtil.writeRawPage:45-51, fixed-width non-null packing
+    per EncoderUtil.encodeNullsAsBits + LongArrayBlockEncoding."""
+    ch0 = (_enc_name("LONG_ARRAY") + struct.pack("<i", 3)
+           + b"\x01" + bytes([0b01000000])      # null at position 1
+           + struct.pack("<qq", 10, 30))        # non-null values only
+    ch1 = (_enc_name("INT_ARRAY") + struct.pack("<i", 3)
+           + b"\x00" + struct.pack("<iii", 1, 2, 3))
+    body = struct.pack("<i", 2) + ch0 + ch1
+    golden = _page_golden(body, 3)
+    page, pos = deserialize_page(golden)
+    assert pos == len(golden)
+    a, b = page.blocks
+    assert a.to_pylist() == [10, None, 30]
+    assert b.to_pylist() == [1, 2, 3]
+    assert serialize_page(page, checksummed=True) == golden
+
+
+def test_lz4_compressed_page_against_independent_decoder():
+    """A >4KiB page serialized with compress=True: COMPRESSED|CHECKSUMMED
+    markers (PageCodecMarker.java:27-29), uncompressedSize != size, and
+    the compressed body must decode with the repo's independent
+    pure-python LZ4 block decoder (common/compression.py:47) to exactly
+    the raw body bytes — proving the wire bytes are real LZ4 block format
+    (aircompressor-compatible, PagesSerdeFactory.java:75-76), not merely
+    self-consistent."""
+    from presto_tpu.common.compression import lz4_block_decompress
+    values = np.arange(4096, dtype=np.int64) % 17       # compressible
+    page = Page([FixedWidthBlock(values, None)])
+    raw = serialize_page(page, checksummed=True, compress=False)
+    comp = serialize_page(page, checksummed=True, compress=True)
+    pc, markers, uncomp, size, _crc = struct.unpack_from("<ibiiq", comp, 0)
+    assert markers & 0x01, "COMPRESSED marker missing"
+    assert markers & 0x04, "CHECKSUMMED marker missing"
+    assert size < uncomp == len(raw) - 21
+    body = lz4_block_decompress(comp[21:21 + size], uncomp)
+    assert bytes(body) == raw[21:]
+    # and the normal path agrees
+    got, _ = deserialize_page(comp)
+    assert got.blocks[0].to_pylist() == values.tolist()
