@@ -1348,11 +1348,10 @@ class PlanCompiler:
                     pool.free(est_mat)
 
             if sort_only:
-                # percentile-class aggregates need value-ordered segments;
-                # without sort-mode memory there is no fallback
-                raise NotImplementedError(
-                    "approx_percentile over an input too large for the "
-                    "sort aggregation budget")
+                # percentile-class aggregates need value-ordered
+                # segments; over the sort budget the streaming summary /
+                # spilled-bucket paths in gen() take over
+                return None
 
             # scatter hash table fallback, sized from the scan row count
             # so the common case completes without a doubling recompile
@@ -1363,9 +1362,7 @@ class PlanCompiler:
                             1 << (min(2 * total, 1 << 22) - 1).bit_length())
             salt = 0
             for _attempt in range(cfg.max_agg_retries):
-                est = num_slots * (16 + 12 * len(key_names)
-                                   + 24 * max(1, len(specs))
-                                   + ops.hll_state_bytes(specs))
+                est = _agg_state_bytes(num_slots, key_names, specs)
                 if not pool.try_reserve(est):
                     return None
                 try:
@@ -1425,28 +1422,9 @@ class PlanCompiler:
 
         # rough accumulator footprint for the budget check (hash + occupied
         # + per-key value/null + per-aggregate state columns)
-        est_state_bytes = initial_slots * (
-            16 + 12 * len(key_names) + 24 * max(1, len(specs))
-            + ops.hll_state_bytes(specs))
+        est_state_bytes = _agg_state_bytes(initial_slots, key_names, specs)
 
-        def run_sort_fallback():
-            """approx_percentile-class aggregates over a non-fused
-            source: materialize the input and run the sort-based grouped
-            aggregation (the only mode with value-ordered segments)."""
-            merged = self._materialize_node(src_node)
-            if merged is None:
-                # zero-batch source: an all-masked schema-shaped batch so
-                # a global aggregate still yields its one NULL row
-                from .fused import _empty_build_batch
-                merged = _empty_build_batch(src_node)
-            # account the materialization (the fused path reserves its
-            # estimate up front; this fallback reserves what it holds)
-            nb = batch_bytes(merged)
-            if not self.ctx.memory.try_reserve(nb):
-                raise MemoryExceededError(
-                    f"sort-aggregation input of {nb} bytes exceeds the "
-                    f"memory budget {self.ctx.memory.budget}")
-            self.ctx.memory.free(nb)
+        def _sortagg_fn():
             low2 = self.lowering
             key = ("sortagg_fallback", node.id)
             fn = self._jit_cache.get(key)
@@ -1461,7 +1439,203 @@ class PlanCompiler:
                     return ops.sort_group_aggregate(b, key_names, inputs,
                                                     specs, inputs2)
                 self._jit_cache[key] = fn
-            return _maybe_compact(fn(merged))
+            return fn
+
+        def drain_sort_input():
+            """Drain the source once under per-batch reservation.
+            Returns (merged, None) when the whole input fit the budget;
+            else (None, stream) where the stream replays the collected
+            (still-reserved) batches and then continues the SAME source
+            iterator — the over-budget paths never re-execute the source
+            and device bytes stay accounted until consumed."""
+            pool = self.ctx.memory
+            collected, reserved = [], 0
+            it = self._compile(src_node).batches()
+            over_batch = None
+            for b in it:
+                nb = batch_bytes(b)
+                if pool.try_reserve(nb):
+                    collected.append(b)
+                    reserved += nb
+                else:
+                    over_batch = b
+                    break
+            if over_batch is None:
+                merged = (_compact_concat(collected) if collected
+                          else None)
+                pool.free(reserved)
+                if merged is None:
+                    # zero-batch source: an all-masked schema-shaped
+                    # batch so a global aggregate still yields its row
+                    from .fused import _empty_build_batch
+                    merged = _empty_build_batch(src_node)
+                return merged, None
+
+            def stream():
+                try:
+                    yield from collected
+                    yield over_batch
+                    yield from it
+                finally:
+                    pool.free(reserved)
+            return None, stream()
+
+        def run_global_percentile_stream(batches):
+            """Global approx_percentile over a budget-exceeding input:
+            one streaming pass keeping only an m-point mergeable quantile
+            summary per batch (operators.percentile_batch_summary — the
+            t-digest-state analog of
+            ApproximateLongPercentileAggregations.java), plus the running
+            scatter state for any sibling aggregates.  Rank error <=
+            1/(2m) (m=8192 -> 0.006%); memory = O(batches * m) floats on
+            the host, never the input."""
+            m = ops.PERCENTILE_SKETCH_POINTS
+            pct_specs = tuple(s for s in specs
+                              if s.name == "approx_percentile")
+            other_specs = tuple(s for s in specs
+                                if s.name != "approx_percentile")
+            low2 = self.lowering
+            key = ("pctsketch", node.id)
+            fns = self._jit_cache.get(key)
+            if fns is None:
+                @jax.jit
+                def summarize(b):
+                    out = {}
+                    for s in pct_specs:
+                        col = low2.eval(input_exprs[s.output], b)
+                        alive = b.mask & ~col.null_mask()
+                        out[s.output] = ops.percentile_batch_summary(
+                            col.values, alive, m)
+                    return out
+
+                @jax.jit
+                def update_others(state, b):
+                    agg_cols = {s.output: low2.eval(
+                        input_exprs[s.output], b)
+                        if input_exprs[s.output] is not None else None
+                        for s in other_specs}
+                    agg_cols2 = {s.output: low2.eval(
+                        input_exprs2[s.output], b)
+                        for s in other_specs if s.name in ops.CORR_AGGS}
+                    return ops.agg_update(state, b, [], agg_cols,
+                                          other_specs, 256, 0, (),
+                                          agg_cols2)
+                self._jit_cache[key] = fns = (summarize, update_others)
+            summarize, update_others = fns
+            state = (ops.agg_init(256, other_specs, (), ())
+                     if other_specs else None)
+            summaries = {s.output: [] for s in pct_specs}
+            for b in batches:
+                for out, (pts, cnt) in summarize(b).items():
+                    summaries[out].append((pts, cnt))
+                if state is not None:
+                    state = update_others(state, b)
+            if state is not None:
+                if not bool(jnp.any(state["__occupied"])):
+                    state["__occupied"] = \
+                        state["__occupied"].at[0].set(True)
+                row = ops.agg_finalize(state, other_specs, (), {}, {})
+            else:
+                row = Batch({}, jnp.ones(1, dtype=bool))
+            cols = dict(row.columns)
+            for s in pct_specs:
+                chunks = summaries[s.output]
+                if chunks:
+                    pts = jnp.stack([c[0] for c in chunks])
+                    cnts = jnp.stack([c[1] for c in chunks])
+                else:
+                    pts = jnp.full((1, m), jnp.nan)
+                    cnts = jnp.zeros(1, dtype=jnp.int64)
+                p = float(s.param if s.param is not None else 0.5)
+                val, is_null = ops.percentile_union_value(pts, cnts, p)
+                if not s.is_float:
+                    val = val.astype(jnp.int64)
+                # broadcast to the finalize batch's capacity: every
+                # column of a Batch must share one shape (the sibling
+                # aggregate columns are full hash-table slots)
+                cap = row.capacity
+                cols[s.output] = Column(
+                    jnp.broadcast_to(val[None], (cap,)),
+                    jnp.broadcast_to(is_null[None], (cap,)))
+            order = [v.name for v in node.aggregations]
+            return Batch({o: cols[o] for o in order}, row.mask)
+
+        def subdivide_bucket(bstore, p, depth, work):
+            """K-way sub-partition of an over-budget bucket with a fresh
+            salt (recursive grouped execution, same shape as the grace
+            join's re-partition), shared by the sorted- and hash-spill
+            paths.  The callers' depth caps differ DELIBERATELY: the
+            sort path stops at 2 — beyond that only single-key skew
+            remains, handled by the per-key summary path — while the
+            hash path splits to 4 because its per-KEY state always
+            shrinks with more partitions."""
+            salt2 = bstore.salt * 33 + 0x9E37
+            sub = PartitionedSpillStore(cfg.spill_partitions, salt2,
+                                        budget_bytes=cfg.spill_budget_bytes)
+            for bb in bstore.bucket_batches(p, cfg.batch_rows):
+                sub.add(bb, list(key_names))
+            work.extend((sub, q, depth + 1)
+                        for q in range(cfg.spill_partitions))
+
+        def fill_spill_store(batches=None):
+            """Stream the source into a key-partitioned host store.
+            Lazy open-domain key columns are whole-column encoded FIRST
+            (row ids for non-ROWID_DISTINCT columns would split value
+            groups across buckets) — shared by the hash-spill and
+            sorted-spill paths."""
+            store = PartitionedSpillStore(cfg.spill_partitions,
+                                  budget_bytes=cfg.spill_budget_bytes)
+            encode_keys = None
+            if batches is None:
+                batches = self._compile(src_node).batches()
+            for batch in batches:
+                if encode_keys is None:
+                    encode_keys = []
+                    for k in key_names:
+                        col = batch.columns[k]
+                        if col.lazy is not None:
+                            _, tbl, coln, _sf = col.lazy
+                            if (tbl, coln) not in catalog.ROWID_DISTINCT:
+                                encode_keys.append(k)
+                if encode_keys:
+                    batch = _encode_lazy_keys(batch, encode_keys)
+                store.add(batch, list(key_names))
+            return store
+
+        def run_sorted_spilled(batches):
+            """Grouped percentile-class aggregation over budget: hash-
+            partition rows by group key into host buckets (disjoint key
+            sets), then run the exact sort aggregation bucket-by-bucket —
+            the grouped-execution Lifespan model, same store the hash
+            path spills through."""
+            store = fill_spill_store(batches)
+            fn = _sortagg_fn()
+            pool = self.ctx.memory
+            work = [(store, p, 0) for p in range(cfg.spill_partitions)]
+            while work:
+                bstore, p, depth = work.pop()
+                rows_p = bstore.bucket_rows(p)
+                if rows_p == 0:
+                    continue
+                bcap = 1 << max(0, rows_p - 1).bit_length()
+                nb = bstore.bucket_bytes(p) * bcap // max(1, rows_p)
+                if not pool.try_reserve(nb):
+                    if depth >= 2:
+                        # the bucket stopped shrinking: one (or a few)
+                        # keys own more rows than the budget — no
+                        # partitioning can split a single key's rows for
+                        # the sort.  Per-key streaming summaries instead.
+                        yield self._skewed_percentile_bucket(
+                            bstore, p, key_names, specs, input_exprs,
+                            input_exprs2)
+                        continue
+                    subdivide_bucket(bstore, p, depth, work)
+                    continue
+                try:
+                    bucket = list(bstore.bucket_batches(p, bcap))[0]
+                    yield _maybe_compact(fn(bucket))
+                finally:
+                    pool.free(nb)
 
         def gen():
             pool = self.ctx.memory
@@ -1480,7 +1654,18 @@ class PlanCompiler:
                         "approx_percentile and approx_distinct in the "
                         "same aggregation are not supported; split the "
                         "query into two aggregations")
-                yield run_sort_fallback()
+                merged, stream = drain_sort_input()
+                if stream is None:
+                    yield _maybe_compact(_sortagg_fn()(merged))
+                    return
+                if not cfg.spill_enabled:
+                    raise MemoryExceededError(
+                        f"sort-aggregation input exceeds memory budget "
+                        f"{pool.budget} bytes and spill is disabled")
+                if key_names:
+                    yield from run_sorted_spilled(stream)
+                else:
+                    yield run_global_percentile_stream(stream)
                 return
             if not key_names or pool.try_reserve(est_state_bytes):
                 try:
@@ -1508,21 +1693,7 @@ class PlanCompiler:
             # budget too small for one table: hash-partition the input by
             # group keys into host-staged buckets and aggregate per bucket
             # (buckets hold disjoint key sets, so each finalize is exact)
-            store = PartitionedSpillStore(cfg.spill_partitions,
-                                  budget_bytes=cfg.spill_budget_bytes)
-            encode_keys: Optional[List[str]] = None
-            for batch in self._compile(src_node).batches():
-                if encode_keys is None:
-                    encode_keys = []
-                    for k in key_names:
-                        col = batch.columns[k]
-                        if col.lazy is not None:
-                            _, tbl, coln, _sf = col.lazy
-                            if (tbl, coln) not in catalog.ROWID_DISTINCT:
-                                encode_keys.append(k)
-                if encode_keys:
-                    batch = _encode_lazy_keys(batch, encode_keys)
-                store.add(batch, list(key_names))
+            store = fill_spill_store()
             # each bucket sees ~1/K of the keys: start with a
             # proportionally smaller table, and account for it.  A bucket
             # never holds more distinct keys than rows, so cap by the
@@ -1533,40 +1704,273 @@ class PlanCompiler:
             # Only when even the 256-slot minimum exceeds the remaining
             # budget does reserve() raise — no smaller table exists.
             per_slot = max(1, est_state_bytes // max(1, initial_slots))
-            for p in range(cfg.spill_partitions):
-                rows_p = store.bucket_rows(p)
+            work = [(store, pp, 0) for pp in range(cfg.spill_partitions)]
+            while work:
+                bstore, p, depth = work.pop()
+                rows_p = bstore.bucket_rows(p)
                 if rows_p == 0:
                     continue
+
                 bucket_slots = max(
                     256, min(initial_slots // cfg.spill_partitions,
                              1 << (2 * rows_p - 1).bit_length()))
-                reserved = False
+                held = 0
                 while True:
                     bucket_bytes = bucket_slots * per_slot
                     if pool.try_reserve(bucket_bytes):
-                        reserved = True
+                        held = bucket_bytes
                         break
                     if bucket_slots <= 256:
                         break
                     bucket_slots = max(256, bucket_slots // 2)
-                if not reserved:
-                    # even the minimum table exceeds the remaining budget:
-                    # raise the engine's exceeded-limit error
+                if not held:
+                    if depth < 4:
+                        subdivide_bucket(bstore, p, depth, work)
+                        continue
+                    # even the minimum table exceeds the remaining
+                    # budget after 4 re-partitions: raise the engine's
+                    # exceeded-limit error
                     pool.reserve(bucket_bytes)
+                # collision retries double the table — each growth is
+                # re-reserved so device bytes never silently exceed the
+                # budget; when the needed table cannot fit, sub-partition
+                # instead of over-reserving
+                num_slots, salt = bucket_slots, 0
+                done = False
                 try:
-                    state, key_dicts, key_lazy, direct = run_retrying(
-                        lambda p=p: store.bucket_batches(p, cfg.batch_rows),
-                        start_slots=bucket_slots)
-                    if direct is not None:
-                        yield ops.agg_direct_finalize(
-                            state, specs, key_names, direct[0], direct[1],
-                            key_dicts)
-                    else:
-                        yield ops.agg_finalize(state, specs, key_names,
-                                               key_dicts, key_lazy)
+                    for _attempt in range(cfg.max_agg_retries):
+                        state, key_dicts, key_lazy, direct = run_once(
+                            num_slots, salt,
+                            lambda b=bstore, p=p: b.bucket_batches(
+                                p, cfg.batch_rows))
+                        if direct is not None:
+                            yield ops.agg_direct_finalize(
+                                state, specs, key_names, direct[0],
+                                direct[1], key_dicts)
+                            done = True
+                            break
+                        if not bool(state["__collision"]):
+                            yield ops.agg_finalize(state, specs,
+                                                   key_names, key_dicts,
+                                                   key_lazy)
+                            done = True
+                            break
+                        grown = 2 * num_slots * per_slot
+                        pool.free(held)
+                        held = 0
+                        if not pool.try_reserve(grown):
+                            if depth < 4:
+                                subdivide_bucket(bstore, p, depth, work)
+                                done = True   # handled via sub-buckets
+                                break
+                            raise MemoryExceededError(
+                                f"aggregation table of {grown} bytes "
+                                f"exceeds memory budget {pool.budget} "
+                                f"after {depth} re-partitions")
+                        held = grown
+                        num_slots *= 2
+                        salt += 1
+                    if not done:
+                        raise RuntimeError(
+                            "aggregation collision retries exhausted")
                 finally:
-                    pool.free(bucket_bytes)
+                    pool.free(held)
         return BatchSource(gen, out_names, out_types)
+
+    def _skewed_percentile_bucket(self, bstore, p, key_names, specs,
+                                  input_exprs, input_exprs2) -> Batch:
+        """Percentile aggregation over a spill bucket whose rows exceed
+        the memory budget even after re-partitioning — i.e. single keys
+        own more rows than fit (no key-hash split can help a sort).
+
+        Split the work: percentile outputs come from per-key mergeable
+        quantile summaries computed chunk-by-chunk over the HOST-resident
+        spill rows (the summaries are the same m-point construction as
+        operators.percentile_batch_summary, so rank error <= 1/(2m));
+        every other aggregate runs exactly through the engine's scatter
+        hash path over the same bucket (its state is per-KEY, tiny under
+        skew).  The two result sets join on the grouping keys."""
+        cfg = self.ctx.config
+        pool = self.ctx.memory
+        low = self.lowering
+        pct_specs = [s for s in specs if s.name == "approx_percentile"]
+        other_specs = tuple(s for s in specs
+                            if s.name != "approx_percentile")
+        for s in pct_specs:
+            if not isinstance(input_exprs[s.output],
+                              VariableReferenceExpression):
+                raise NotImplementedError(
+                    "approx_percentile over a computed expression on a "
+                    "skew-spilled bucket")
+
+        # --- per-key percentile summaries over host chunks (numpy,
+        # vectorized grouping; summaries carry min(m, cnt) points so a
+        # key contributing few rows to a chunk costs those rows only) ---
+        m = ops.PERCENTILE_SKETCH_POINTS
+        per_key: Dict[tuple, Dict[str, list]] = {}
+
+        for rows in bstore.buckets[p]:
+            n = len(next(iter(rows.values()))[0])
+            arrs = []
+            for k in key_names:
+                vals, nulls = rows[k]
+                arrs.append(vals)
+                arrs.append(nulls if nulls is not None
+                            else np.zeros(n, dtype=bool))
+            rec = np.rec.fromarrays(arrs)
+            uniq, inverse = np.unique(rec, return_inverse=True)
+            order = np.argsort(inverse, kind="stable")
+            bounds = np.searchsorted(inverse[order],
+                                     np.arange(len(uniq) + 1))
+            for g in range(len(uniq)):
+                t = tuple(None if uniq[g][2 * j + 1] else
+                          uniq[g][2 * j].item()
+                          for j in range(len(key_names)))
+                idxs = order[bounds[g]:bounds[g + 1]]
+                ent = per_key.setdefault(
+                    t, {s.output: [] for s in pct_specs})
+                for s in pct_specs:
+                    arg = input_exprs[s.output].name
+                    vals, nulls = rows[arg]
+                    v = vals[idxs]
+                    if nulls is not None:
+                        v = v[~nulls[idxs]]
+                    cnt = len(v)
+                    if cnt == 0:
+                        continue
+                    v = np.sort(v.astype(np.float64))
+                    k_pts = min(m, cnt)
+                    if k_pts < cnt:
+                        pos = np.floor(np.arange(k_pts) * (cnt - 1)
+                                       / (k_pts - 1) + 0.5) \
+                            .astype(np.int64)
+                        v = v[np.clip(pos, 0, cnt - 1)]
+                    ent[s.output].append((v, cnt))
+
+        def _pct_value(chunks, frac):
+            if not chunks:
+                return 0.0, True
+            pts = np.concatenate([c[0] for c in chunks])
+            w = np.concatenate([np.full(len(c[0]), c[1] / len(c[0]))
+                                for c in chunks])
+            order = np.argsort(pts, kind="stable")
+            cum = np.cumsum(w[order])
+            total = sum(c[1] for c in chunks)
+            target = np.floor(frac * max(total - 1, 0) + 0.5)
+            idx = int(np.searchsorted(cum, target, side="right"))
+            return float(pts[order][min(idx, len(pts) - 1)]), False
+
+        # --- non-percentile aggregates: exact scatter hash over the
+        # bucket (keys are few, so a small table suffices) ---
+        key_batch0 = next(iter(bstore.bucket_batches(p, cfg.batch_rows)))
+        key_dtypes = [key_batch0.columns[k].values.dtype
+                      for k in key_names]
+        key_dicts = {k: key_batch0.columns[k].dictionary
+                     for k in key_names
+                     if key_batch0.columns[k].dictionary is not None}
+        key_lazy = {k: key_batch0.columns[k].lazy for k in key_names
+                    if key_batch0.columns[k].lazy is not None}
+        out_batch = None
+        if other_specs:
+            num_slots, salt = 256, 0
+            for _attempt in range(cfg.max_agg_retries):
+                est = _agg_state_bytes(num_slots, key_names, other_specs)
+                pool.reserve(est)
+                try:
+                    jk = ("skewagg", tuple(key_names), other_specs,
+                          num_slots, salt)
+                    upd = self._jit_cache.get(jk)
+                    if upd is None:
+                        @jax.jit
+                        def upd(state, b):
+                            kc = [b.columns[k] for k in key_names]
+                            ac = {s.output: (low.eval(
+                                input_exprs[s.output], b)
+                                if input_exprs[s.output] is not None
+                                else None) for s in other_specs}
+                            ac2 = {s.output: low.eval(
+                                input_exprs2[s.output], b)
+                                for s in other_specs
+                                if s.name in ops.CORR_AGGS}
+                            return ops.agg_update(
+                                state, b, kc, ac, other_specs,
+                                num_slots, salt, tuple(key_names), ac2)
+                        self._jit_cache[jk] = upd
+                    state = ops.agg_init(num_slots, other_specs,
+                                         tuple(key_names), key_dtypes)
+                    for b in bstore.bucket_batches(p, cfg.batch_rows):
+                        state = upd(state, b)
+                    if not bool(jax.device_get(state["__collision"])):
+                        out_batch = ops.agg_finalize(
+                            state, other_specs, tuple(key_names),
+                            key_dicts, key_lazy)
+                        break
+                finally:
+                    pool.free(est)
+                num_slots *= 2
+                salt += 1
+            if out_batch is None:
+                raise RuntimeError(
+                    "skewed-bucket aggregation collision retries "
+                    "exhausted")
+            # attach percentile columns by key lookup on the host
+            kcols = [np.asarray(out_batch.columns[k].values)
+                     for k in key_names]
+            knulls = [None if out_batch.columns[k].nulls is None
+                      else np.asarray(out_batch.columns[k].nulls)
+                      for k in key_names]
+            mask = np.asarray(out_batch.mask)
+            cap = out_batch.capacity
+            new_cols = dict(out_batch.columns)
+            for s in pct_specs:
+                vals = np.zeros(cap, dtype=np.float64)
+                nulls = np.ones(cap, dtype=bool)
+                for i in range(cap):
+                    if not mask[i]:
+                        continue
+                    t = tuple(
+                        (None if (knulls[j] is not None and knulls[j][i])
+                         else kcols[j].item(i))
+                        for j in range(len(key_names)))
+                    ent = per_key.get(t)
+                    if ent is None:
+                        continue
+                    frac = float(s.param if s.param is not None else 0.5)
+                    v, isnull = _pct_value(ent[s.output], frac)
+                    vals[i], nulls[i] = v, isnull
+                arr = (jnp.asarray(vals) if s.is_float
+                       else jnp.asarray(vals).astype(jnp.int64))
+                new_cols[s.output] = Column(arr, jnp.asarray(nulls))
+            return Batch(new_cols, out_batch.mask)
+
+        # percentile-only aggregation: build the output from the host map
+        keys = sorted(per_key, key=lambda t: tuple(
+            (v is None, v) for v in t))
+        cap = max(1, len(keys))
+        cols: Dict[str, Column] = {}
+        for j, k in enumerate(key_names):
+            kv = np.zeros(cap, dtype=key_dtypes[j])
+            kn = np.zeros(cap, dtype=bool)
+            for i, t in enumerate(keys):
+                if t[j] is None:
+                    kn[i] = True
+                else:
+                    kv[i] = t[j]
+            cols[k] = Column(jnp.asarray(kv),
+                             jnp.asarray(kn) if kn.any() else None,
+                             key_dicts.get(k), key_lazy.get(k))
+        for s in pct_specs:
+            frac = float(s.param if s.param is not None else 0.5)
+            vals = np.zeros(cap, dtype=np.float64)
+            nulls = np.ones(cap, dtype=bool)
+            for i, t in enumerate(keys):
+                vals[i], nulls[i] = _pct_value(per_key[t][s.output], frac)
+            arr = (jnp.asarray(vals) if s.is_float
+                   else jnp.asarray(vals).astype(jnp.int64))
+            cols[s.output] = Column(arr, jnp.asarray(nulls))
+        mask = np.zeros(cap, dtype=bool)
+        mask[:len(keys)] = True
+        return Batch(cols, jnp.asarray(mask))
 
     # -- joins ------------------------------------------------------------
     def _splits_fingerprint(self, node: P.PlanNode) -> str:
@@ -2095,6 +2499,16 @@ class PlanCompiler:
 # analog of the reference's ScanFilterAndProjectOperator evaluating
 # non-vectorizable functions row-wise during the scan.
 # ---------------------------------------------------------------------------
+
+
+def _agg_state_bytes(num_slots: int, key_names, specs) -> int:
+    """Accumulator footprint estimate shared by every aggregation budget
+    check (hash + occupied + per-key value/null + per-aggregate state
+    columns) — ONE formula so a state-layout change cannot drift the
+    reservation paths apart."""
+    return num_slots * (16 + 12 * len(key_names)
+                        + 24 * max(1, len(specs))
+                        + ops.hll_state_bytes(specs))
 
 
 def _rewrite_agg_masks(node: P.AggregationNode) -> P.AggregationNode:

@@ -126,6 +126,45 @@ def test_tpcds_q95_under_budget():
         order by 1 limit 100""")
 
 
+def test_global_percentile_streams_under_budget(runner):
+    # the input (lineitem.l_extendedprice at sf0.01, ~60k rows) exceeds
+    # the 200KB budget: the streaming m-point quantile summary path must
+    # produce the same nearest-rank answer as the unconstrained engine
+    # (rank error 1/(2m) rounds away below m rows per batch)
+    free = LocalQueryRunner("sf0.01")
+    sql = ("select approx_percentile(l_extendedprice, 0.5), count(*), "
+           "sum(l_quantity) from lineitem")
+    got = runner.execute(sql)
+    want = free.execute(sql)
+    assert got.rows[0][1:] == want.rows[0][1:]
+    assert abs(float(got.rows[0][0]) - float(want.rows[0][0])) \
+        <= 1e-9 * abs(float(want.rows[0][0]))
+
+
+def test_global_percentile_stream_composes_downstream(runner):
+    # the streamed-percentile output batch must keep the engine's
+    # uniform-capacity invariant so downstream operators (sort) compose
+    free = LocalQueryRunner("sf0.01")
+    sql = ("select approx_percentile(l_extendedprice, 0.5) p, count(*) c "
+           "from lineitem order by p")
+    got = runner.execute(sql)
+    want = free.execute(sql)
+    assert got.rows[0][1] == want.rows[0][1]
+    assert abs(float(got.rows[0][0]) - float(want.rows[0][0])) \
+        <= 1e-9 * abs(float(want.rows[0][0]))
+
+
+def test_grouped_percentile_spills_exact(runner):
+    # grouped percentile over budget: bucket-by-bucket sort aggregation
+    # over the key-partitioned spill store is EXACT (disjoint key sets)
+    free = LocalQueryRunner("sf0.01")
+    sql = ("select l_returnflag, approx_percentile(l_quantity, 0.5), "
+           "count(*) from lineitem group by l_returnflag")
+    got = runner.execute(sql)
+    want = free.execute(sql)
+    assert got.sorted_rows() == want.sorted_rows()
+
+
 def test_spill_disabled_raises():
     cfg = ExecutionConfig(batch_rows=1 << 14, memory_budget_bytes=50_000,
                           spill_enabled=False)
