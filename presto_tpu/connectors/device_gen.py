@@ -298,6 +298,14 @@ def _ds_store_sales(column: str, idx, sf: float):
         q = _ds_store_sales("ss_quantity", idx, sf)
         w = _ds_store_sales("ss_wholesale_cost", idx, sf)
         return _ds_store_sales("ss_net_paid", idx, sf) - q * w
+    if column == "ss_ext_tax":
+        return _ds_store_sales("ss_ext_sales_price", idx, sf) * 9 // 100
+    if column == "ss_ext_wholesale_cost":
+        return (_ds_store_sales("ss_wholesale_cost", idx, sf)
+                * _ds_store_sales("ss_quantity", idx, sf))
+    if column == "ss_net_paid_inc_tax":
+        return (_ds_store_sales("ss_net_paid", idx, sf)
+                + _ds_store_sales("ss_ext_tax", idx, sf))
     raise KeyError(column)
 
 
@@ -349,6 +357,58 @@ def _ds_web_sales(column: str, idx, sf: float):
         return (_ds_web_sales("ws_net_paid", idx, sf)
                 - _ds_uniform("web_sales", "cost", idx, 50, 40000)
                 * _ds_web_sales("ws_quantity", idx, sf))
+    if column == "ws_sold_time_sk":
+        return _ds_uniform("web_sales", "time", order, 0, 86399)
+    if column == "ws_bill_addr_sk":
+        return _ds_uniform("web_sales", "baddr", order, 1,
+                           DS._table_rows("customer_address", sf))
+    if column == "ws_bill_cdemo_sk":
+        return _ds_uniform("web_sales", "bcdemo", order, 1,
+                           DS._table_rows("customer_demographics", sf))
+    if column == "ws_bill_hdemo_sk":
+        return _ds_uniform("web_sales", "bhdemo", order, 1,
+                           DS._table_rows("household_demographics", sf))
+    if column == "ws_ship_customer_sk":
+        buyer = _ds_web_sales("ws_bill_customer_sk", idx, sf)
+        other = _ds_uniform("web_sales", "shipcust", order, 1,
+                            DS._table_rows("customer", sf))
+        same = _ds_uniform("web_sales", "shipsame", order, 0, 9) < 7
+        return jnp.where(same, buyer, other)
+    if column == "ws_ship_cdemo_sk":
+        return _ds_uniform("web_sales", "scdemo", order, 1,
+                           DS._table_rows("customer_demographics", sf))
+    if column == "ws_ship_hdemo_sk":
+        return _ds_uniform("web_sales", "shdemo", order, 1,
+                           DS._table_rows("household_demographics", sf))
+    if column == "ws_web_page_sk":
+        return _ds_uniform("web_sales", "page", order, 1,
+                           DS._table_rows("web_page", sf))
+    if column == "ws_wholesale_cost":
+        return _ds_uniform("web_sales", "wholesale", idx, 100, 10000)
+    if column == "ws_list_price":
+        w = _ds_web_sales("ws_wholesale_cost", idx, sf)
+        return w + w * _ds_uniform("web_sales", "markup", idx, 0, 200) // 100
+    if column == "ws_ext_list_price":
+        return (_ds_web_sales("ws_list_price", idx, sf)
+                * _ds_web_sales("ws_quantity", idx, sf))
+    if column == "ws_ext_discount_amt":
+        lp = _ds_web_sales("ws_list_price", idx, sf)
+        return ((lp - _ds_web_sales("ws_sales_price", idx, sf))
+                * _ds_web_sales("ws_quantity", idx, sf)).clip(0)
+    if column == "ws_ext_wholesale_cost":
+        return (_ds_web_sales("ws_wholesale_cost", idx, sf)
+                * _ds_web_sales("ws_quantity", idx, sf))
+    if column == "ws_ext_tax":
+        return _ds_web_sales("ws_ext_sales_price", idx, sf) * 9 // 100
+    if column == "ws_coupon_amt":
+        return _ds_uniform("web_sales", "coupon", idx, 0, 50000) \
+            * (_ds_uniform("web_sales", "hascoup", idx, 0, 9) == 0)
+    if column == "ws_net_paid_inc_tax":
+        return (_ds_web_sales("ws_net_paid", idx, sf)
+                + _ds_web_sales("ws_ext_tax", idx, sf))
+    if column == "ws_net_paid_inc_ship":
+        return (_ds_web_sales("ws_net_paid", idx, sf)
+                + _ds_web_sales("ws_ext_ship_cost", idx, sf))
     raise KeyError(column)
 
 
@@ -371,6 +431,57 @@ def _ds_web_returns(column: str, idx, sf: float):
         return _ds_uniform("web_returns", "amt", idx, 100, 500000)
     if column == "wr_net_loss":
         return _ds_uniform("web_returns", "loss", idx, 50, 100000)
+    if column == "wr_returning_customer_sk":
+        buyer = _ds_web_returns("wr_refunded_customer_sk", idx, sf)
+        other = _ds_uniform("web_returns", "rcust", idx, 1,
+                            DS._table_rows("customer", sf))
+        same = _ds_uniform("web_returns", "rsame", idx, 0, 9) < 8
+        return jnp.where(same, buyer, other)
+    if column == "wr_refunded_addr_sk":
+        return _ds_uniform("web_returns", "faddr", idx, 1,
+                           DS._table_rows("customer_address", sf))
+    if column == "wr_returning_addr_sk":
+        return _ds_uniform("web_returns", "raddr", idx, 1,
+                           DS._table_rows("customer_address", sf))
+    if column == "wr_refunded_cdemo_sk":
+        return _ds_uniform("web_returns", "fcdemo", idx, 1,
+                           DS._table_rows("customer_demographics", sf))
+    if column == "wr_returning_cdemo_sk":
+        return _ds_uniform("web_returns", "rcdemo", idx, 1,
+                           DS._table_rows("customer_demographics", sf))
+    if column == "wr_refunded_hdemo_sk":
+        return _ds_uniform("web_returns", "fhdemo", idx, 1,
+                           DS._table_rows("household_demographics", sf))
+    if column == "wr_web_page_sk":
+        return _ds_uniform("web_returns", "page", idx, 1,
+                           DS._table_rows("web_page", sf))
+    if column == "wr_reason_sk":
+        return _ds_uniform("web_returns", "reason", idx, 1,
+                           DS._table_rows("reason", sf))
+    if column == "wr_returned_time_sk":
+        return _ds_uniform("web_returns", "time", idx, 0, 86399)
+    if column == "wr_refunded_cash":
+        amt = _ds_web_returns("wr_return_amt", idx, sf)
+        return amt * _ds_uniform("web_returns", "cashfrac", idx,
+                                 0, 100) // 100
+    if column == "wr_reversed_charge":
+        amt = _ds_web_returns("wr_return_amt", idx, sf)
+        cash = _ds_web_returns("wr_refunded_cash", idx, sf)
+        return (amt - cash) // 2
+    if column == "wr_account_credit":
+        amt = _ds_web_returns("wr_return_amt", idx, sf)
+        cash = _ds_web_returns("wr_refunded_cash", idx, sf)
+        rev = _ds_web_returns("wr_reversed_charge", idx, sf)
+        return amt - cash - rev
+    if column == "wr_fee":
+        return _ds_uniform("web_returns", "fee", idx, 50, 10000)
+    if column == "wr_return_ship_cost":
+        return _ds_uniform("web_returns", "shipc", idx, 0, 25000)
+    if column == "wr_return_tax":
+        return _ds_web_returns("wr_return_amt", idx, sf) * 9 // 100
+    if column == "wr_return_amt_inc_tax":
+        return (_ds_web_returns("wr_return_amt", idx, sf)
+                + _ds_web_returns("wr_return_tax", idx, sf))
     raise KeyError(column)
 
 

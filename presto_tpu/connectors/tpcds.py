@@ -136,6 +136,7 @@ SCHEMAS: Dict[str, List[Tuple[str, Type]]] = {
         ("d_quarter_seq", INTEGER), ("d_year", INTEGER), ("d_dow", INTEGER),
         ("d_moy", INTEGER), ("d_dom", INTEGER), ("d_qoy", INTEGER),
         ("d_day_name", VarcharType(9)),
+        ("d_quarter_name", VarcharType(6)),
     ],
     "item": [
         ("i_item_sk", BIGINT), ("i_item_id", VarcharType(16)),
@@ -144,6 +145,9 @@ SCHEMAS: Dict[str, List[Tuple[str, Type]]] = {
         ("i_class", VarcharType(50)), ("i_category_id", INTEGER),
         ("i_category", VarcharType(50)), ("i_manufact_id", INTEGER),
         ("i_color", VarcharType(20)), ("i_manager_id", INTEGER),
+        ("i_manufact", VarcharType(50)), ("i_product_name", VarcharType(50)),
+        ("i_item_desc", VarcharType(200)), ("i_size", VarcharType(20)),
+        ("i_units", VarcharType(10)), ("i_wholesale_cost", D7_2),
     ],
     "customer": [
         ("c_customer_sk", BIGINT), ("c_customer_id", VarcharType(16)),
@@ -153,12 +157,22 @@ SCHEMAS: Dict[str, List[Tuple[str, Type]]] = {
         ("c_last_name", VarcharType(30)), ("c_birth_year", INTEGER),
         ("c_birth_month", INTEGER), ("c_birth_country", VarcharType(20)),
         ("c_email_address", VarcharType(50)),
+        ("c_preferred_cust_flag", VarcharType(1)),
+        ("c_salutation", VarcharType(10)), ("c_login", VarcharType(13)),
+        ("c_birth_day", INTEGER), ("c_first_sales_date_sk", BIGINT),
+        ("c_first_shipto_date_sk", BIGINT),
+        ("c_last_review_date_sk", BIGINT),
     ],
     "customer_address": [
         ("ca_address_sk", BIGINT), ("ca_address_id", VarcharType(16)),
         ("ca_city", VarcharType(60)), ("ca_county", VarcharType(30)),
         ("ca_state", VarcharType(2)), ("ca_zip", VarcharType(10)),
         ("ca_country", VarcharType(20)), ("ca_gmt_offset", D5_2),
+        ("ca_street_number", VarcharType(10)),
+        ("ca_street_name", VarcharType(60)),
+        ("ca_street_type", VarcharType(15)),
+        ("ca_suite_number", VarcharType(10)),
+        ("ca_location_type", VarcharType(20)),
     ],
     "store": [
         ("s_store_sk", BIGINT), ("s_store_id", VarcharType(16)),
@@ -167,6 +181,11 @@ SCHEMAS: Dict[str, List[Tuple[str, Type]]] = {
         ("s_state", VarcharType(2)), ("s_company_id", INTEGER),
         ("s_city", VarcharType(60)), ("s_county", VarcharType(30)),
         ("s_zip", VarcharType(10)), ("s_gmt_offset", D5_2),
+        ("s_street_number", VarcharType(10)),
+        ("s_street_name", VarcharType(60)),
+        ("s_street_type", VarcharType(15)),
+        ("s_suite_number", VarcharType(10)),
+        ("s_company_name", VarcharType(50)),
     ],
     "web_site": [
         ("web_site_sk", BIGINT), ("web_site_id", VarcharType(16)),
@@ -176,11 +195,15 @@ SCHEMAS: Dict[str, List[Tuple[str, Type]]] = {
     "warehouse": [
         ("w_warehouse_sk", BIGINT), ("w_warehouse_name", VarcharType(20)),
         ("w_warehouse_sq_ft", INTEGER), ("w_state", VarcharType(2)),
+        ("w_city", VarcharType(60)), ("w_county", VarcharType(30)),
+        ("w_country", VarcharType(20)),
     ],
     "promotion": [
         ("p_promo_sk", BIGINT), ("p_promo_id", VarcharType(16)),
         ("p_channel_dmail", VarcharType(1)), ("p_channel_email", VarcharType(1)),
         ("p_channel_tv", VarcharType(1)),
+        ("p_channel_event", VarcharType(1)),
+        ("p_channel_catalog", VarcharType(1)),
     ],
     "store_sales": [
         ("ss_sold_date_sk", BIGINT), ("ss_sold_time_sk", BIGINT),
@@ -194,6 +217,8 @@ SCHEMAS: Dict[str, List[Tuple[str, Type]]] = {
         ("ss_ext_discount_amt", D7_2), ("ss_ext_sales_price", D7_2),
         ("ss_ext_list_price", D7_2), ("ss_coupon_amt", D7_2),
         ("ss_net_paid", D7_2), ("ss_net_profit", D7_2),
+        ("ss_ext_tax", D7_2), ("ss_ext_wholesale_cost", D7_2),
+        ("ss_net_paid_inc_tax", D7_2),
     ],
     "web_sales": [
         ("ws_sold_date_sk", BIGINT), ("ws_ship_date_sk", BIGINT),
@@ -204,13 +229,29 @@ SCHEMAS: Dict[str, List[Tuple[str, Type]]] = {
         ("ws_order_number", BIGINT), ("ws_quantity", INTEGER),
         ("ws_sales_price", D7_2), ("ws_ext_sales_price", D7_2),
         ("ws_ext_ship_cost", D7_2), ("ws_net_paid", D7_2),
-        ("ws_net_profit", D7_2),
+        ("ws_net_profit", D7_2), ("ws_sold_time_sk", BIGINT),
+        ("ws_bill_addr_sk", BIGINT), ("ws_bill_cdemo_sk", BIGINT),
+        ("ws_bill_hdemo_sk", BIGINT), ("ws_ship_customer_sk", BIGINT),
+        ("ws_ship_cdemo_sk", BIGINT), ("ws_ship_hdemo_sk", BIGINT),
+        ("ws_web_page_sk", BIGINT), ("ws_wholesale_cost", D7_2),
+        ("ws_list_price", D7_2), ("ws_ext_list_price", D7_2),
+        ("ws_ext_discount_amt", D7_2), ("ws_ext_wholesale_cost", D7_2),
+        ("ws_ext_tax", D7_2), ("ws_coupon_amt", D7_2),
+        ("ws_net_paid_inc_tax", D7_2), ("ws_net_paid_inc_ship", D7_2),
     ],
     "web_returns": [
         ("wr_returned_date_sk", BIGINT), ("wr_item_sk", BIGINT),
         ("wr_refunded_customer_sk", BIGINT), ("wr_order_number", BIGINT),
         ("wr_return_quantity", INTEGER), ("wr_return_amt", D7_2),
-        ("wr_net_loss", D7_2),
+        ("wr_net_loss", D7_2), ("wr_returning_customer_sk", BIGINT),
+        ("wr_refunded_addr_sk", BIGINT), ("wr_returning_addr_sk", BIGINT),
+        ("wr_refunded_cdemo_sk", BIGINT), ("wr_returning_cdemo_sk", BIGINT),
+        ("wr_refunded_hdemo_sk", BIGINT), ("wr_web_page_sk", BIGINT),
+        ("wr_reason_sk", BIGINT), ("wr_returned_time_sk", BIGINT),
+        ("wr_refunded_cash", D7_2), ("wr_reversed_charge", D7_2),
+        ("wr_account_credit", D7_2), ("wr_fee", D7_2),
+        ("wr_return_ship_cost", D7_2), ("wr_return_amt_inc_tax", D7_2),
+        ("wr_return_tax", D7_2),
     ],
     "store_returns": [
         ("sr_returned_date_sk", BIGINT), ("sr_item_sk", BIGINT),
@@ -232,7 +273,12 @@ SCHEMAS: Dict[str, List[Tuple[str, Type]]] = {
         ("cs_list_price", D7_2), ("cs_sales_price", D7_2),
         ("cs_ext_discount_amt", D7_2), ("cs_ext_sales_price", D7_2),
         ("cs_ext_ship_cost", D7_2), ("cs_net_paid", D7_2),
-        ("cs_net_profit", D7_2),
+        ("cs_net_profit", D7_2), ("cs_sold_time_sk", BIGINT),
+        ("cs_ship_customer_sk", BIGINT), ("cs_ship_cdemo_sk", BIGINT),
+        ("cs_ship_hdemo_sk", BIGINT), ("cs_coupon_amt", D7_2),
+        ("cs_ext_list_price", D7_2), ("cs_ext_wholesale_cost", D7_2),
+        ("cs_ext_tax", D7_2), ("cs_net_paid_inc_tax", D7_2),
+        ("cs_net_paid_inc_ship", D7_2), ("cs_net_paid_inc_ship_tax", D7_2),
     ],
     "catalog_returns": [
         ("cr_returned_date_sk", BIGINT), ("cr_item_sk", BIGINT),
@@ -241,6 +287,12 @@ SCHEMAS: Dict[str, List[Tuple[str, Type]]] = {
         ("cr_call_center_sk", BIGINT), ("cr_reason_sk", BIGINT),
         ("cr_order_number", BIGINT), ("cr_return_quantity", INTEGER),
         ("cr_return_amount", D7_2), ("cr_net_loss", D7_2),
+        ("cr_catalog_page_sk", BIGINT), ("cr_refunded_addr_sk", BIGINT),
+        ("cr_returning_addr_sk", BIGINT), ("cr_refunded_cash", D7_2),
+        ("cr_reversed_charge", D7_2), ("cr_store_credit", D7_2),
+        ("cr_fee", D7_2), ("cr_return_ship_cost", D7_2),
+        ("cr_return_amt_inc_tax", D7_2), ("cr_return_tax", D7_2),
+        ("cr_warehouse_sk", BIGINT),
     ],
     "inventory": [
         ("inv_date_sk", BIGINT), ("inv_item_sk", BIGINT),
@@ -312,6 +364,10 @@ def column_type(table: str, column: str) -> Type:
 # row-id-compatible order / identity (see tpch.py for the rules)
 OPEN_DOMAIN = {
     ("item", "i_item_id"), ("customer", "c_customer_id"),
+    ("item", "i_product_name"), ("item", "i_item_desc"),
+    ("store", "s_street_number"), ("store", "s_suite_number"),
+    ("customer_address", "ca_street_number"),
+    ("customer_address", "ca_suite_number"),
     ("customer", "c_email_address"), ("customer_address", "ca_address_id"),
     ("customer_address", "ca_zip"), ("store", "s_store_id"),
     ("store", "s_zip"),
@@ -322,6 +378,7 @@ OPEN_DOMAIN = {
 }
 ROWID_ORDERED = {
     ("item", "i_item_id"), ("customer", "c_customer_id"),
+    ("item", "i_product_name"),
     ("customer_address", "ca_address_id"), ("store", "s_store_id"),
     ("web_site", "web_site_id"), ("promotion", "p_promo_id"),
     ("catalog_page", "cp_catalog_page_id"), ("ship_mode", "sm_ship_mode_id"),
@@ -330,6 +387,7 @@ ROWID_ORDERED = {
 }
 ROWID_DISTINCT = {
     ("item", "i_item_id"), ("customer", "c_customer_id"),
+    ("item", "i_product_name"),
     ("customer", "c_email_address"), ("customer_address", "ca_address_id"),
     ("store", "s_store_id"), ("web_site", "web_site_id"),
     ("promotion", "p_promo_id"),
@@ -377,6 +435,13 @@ def _gen_date_dim(column: str, idx: np.ndarray, sf: float):
         y = _gen_date_dim("d_year", idx, sf)
         q = _gen_date_dim("d_qoy", idx, sf)
         return (y - 1900) * 4 + q - 1
+    if column == "d_quarter_name":
+        y = _gen_date_dim("d_year", idx, sf)
+        q = _gen_date_dim("d_qoy", idx, sf)
+        # closed domain (years x 4): dictionary codes
+        names = [f"{yy}Q{qq}" for yy in range(1900, 2101)
+                 for qq in range(1, 5)]
+        return (((y - 1900) * 4 + q - 1).astype(np.int32), names)
     raise KeyError(column)
 
 
@@ -410,6 +475,25 @@ def _gen_item(column: str, idx: np.ndarray, sf: float):
                          len(COLORS) - 1).astype(np.int32), COLORS)
     if column == "i_manager_id":
         return _uniform("item", "manager", idx, 1, 100)
+    if column == "i_manufact":
+        m = _gen_item("i_manufact_id", idx, sf)
+        names = [f"manufact#{i}" for i in range(1001)]
+        return (m.astype(np.int32), names)
+    if column == "i_product_name":
+        return [f"product{int(v):011d}" for v in idx + 1]
+    if column == "i_item_desc":
+        h = _hash("item", "desc", idx)
+        return [f"Item description {int(v) % 10000:04d} text body"
+                for v in h]
+    if column == "i_size":
+        return (_uniform("item", "size", idx, 0, 6).astype(np.int32),
+                ["N/A", "petite", "small", "medium", "large",
+                 "extra large", "economy"])
+    if column == "i_units":
+        return (_uniform("item", "units", idx, 0, 4).astype(np.int32),
+                ["Each", "Dozen", "Case", "Pallet", "Unknown"])
+    if column == "i_wholesale_cost":
+        return _uniform("item", "wholesale", idx, 100, 8800)
     raise KeyError(column)
 
 
@@ -444,6 +528,25 @@ def _gen_customer(column: str, idx: np.ndarray, sf: float):
     if column == "c_email_address":
         h = _hash("customer", "email", idx)
         return [f"user{int(v):016x}@example.com" for v in h]
+    if column == "c_preferred_cust_flag":
+        return (_uniform("customer", "pref", idx, 0, 1).astype(np.int32),
+                YN)
+    if column == "c_salutation":
+        return (_uniform("customer", "salut", idx, 0, 5).astype(np.int32),
+                ["Mr.", "Mrs.", "Ms.", "Dr.", "Sir", "Miss"])
+    if column == "c_login":
+        return (np.zeros(len(idx), dtype=np.int32), [""])
+    if column == "c_birth_day":
+        return _uniform("customer", "bday", idx, 1, 28)
+    if column == "c_first_sales_date_sk":
+        return _date_sk_from_offset(
+            _uniform("customer", "fsale", idx, SALES_MIN, SALES_MAX))
+    if column == "c_first_shipto_date_sk":
+        return _gen_customer("c_first_sales_date_sk", idx, sf) \
+            + _uniform("customer", "fship", idx, 1, 30)
+    if column == "c_last_review_date_sk":
+        return _date_sk_from_offset(
+            _uniform("customer", "lastrev", idx, SALES_MIN, SALES_MAX))
     raise KeyError(column)
 
 
@@ -469,6 +572,23 @@ def _gen_customer_address(column: str, idx: np.ndarray, sf: float):
         return (np.zeros(len(idx), dtype=np.int32), ["United States"])
     if column == "ca_gmt_offset":
         return -100 * _uniform("customer_address", "gmt", idx, 5, 8)
+    if column == "ca_street_number":
+        n = _uniform("customer_address", "stno", idx, 1, 999)
+        return [str(int(v)) for v in n]
+    if column == "ca_street_name":
+        return (_uniform("customer_address", "stname", idx, 0,
+                         len(COUNTIES) - 1).astype(np.int32), COUNTIES)
+    if column == "ca_street_type":
+        return (_uniform("customer_address", "sttype", idx, 0,
+                         4).astype(np.int32),
+                ["Street", "Ave", "Blvd", "Ct.", "Lane"])
+    if column == "ca_suite_number":
+        n = _uniform("customer_address", "suite", idx, 0, 99)
+        return [f"Suite {int(v)}" for v in n]
+    if column == "ca_location_type":
+        return (_uniform("customer_address", "loctype", idx, 0,
+                         2).astype(np.int32),
+                ["apartment", "condo", "single family"])
     raise KeyError(column)
 
 
@@ -504,6 +624,20 @@ def _gen_store(column: str, idx: np.ndarray, sf: float):
                          len(STATES) - 1).astype(np.int32), STATES)
     if column == "s_company_id":
         return np.ones(len(idx), dtype=np.int64)
+    if column == "s_street_number":
+        n = _uniform("store", "stno", idx, 1, 999)
+        return [str(int(v)) for v in n]
+    if column == "s_street_name":
+        return (_uniform("store", "stname", idx, 0,
+                         len(COUNTIES) - 1).astype(np.int32), COUNTIES)
+    if column == "s_street_type":
+        return (_uniform("store", "sttype", idx, 0, 4).astype(np.int32),
+                ["Street", "Ave", "Blvd", "Ct.", "Lane"])
+    if column == "s_suite_number":
+        n = _uniform("store", "suite", idx, 0, 99)
+        return [f"Suite {int(v)}" for v in n]
+    if column == "s_company_name":
+        return (np.zeros(len(idx), dtype=np.int32), ["Unknown"])
     raise KeyError(column)
 
 
@@ -533,6 +667,12 @@ def _gen_warehouse(column: str, idx: np.ndarray, sf: float):
         return _uniform("warehouse", "sqft", idx, 50_000, 1_000_000)
     if column == "w_state":
         return ((idx % len(STATES)).astype(np.int32), STATES)
+    if column == "w_city":
+        return ((idx % len(CITIES)).astype(np.int32), CITIES)
+    if column == "w_county":
+        return ((idx % len(COUNTIES)).astype(np.int32), COUNTIES)
+    if column == "w_country":
+        return (np.zeros(len(idx), dtype=np.int32), ["United States"])
     raise KeyError(column)
 
 
@@ -542,7 +682,8 @@ def _gen_promotion(column: str, idx: np.ndarray, sf: float):
         return sk
     if column == "p_promo_id":
         return [f"AAAAAAAA{int(v):08d}" for v in sk]
-    if column in ("p_channel_dmail", "p_channel_email", "p_channel_tv"):
+    if column in ("p_channel_dmail", "p_channel_email", "p_channel_tv",
+                  "p_channel_event", "p_channel_catalog"):
         return (_uniform("promotion", column, idx, 0, 1).astype(np.int32), YN)
     raise KeyError(column)
 
@@ -611,6 +752,14 @@ def _gen_store_sales(column: str, idx: np.ndarray, sf: float):
         q = _gen_store_sales("ss_quantity", idx, sf)
         w = _gen_store_sales("ss_wholesale_cost", idx, sf)
         return _gen_store_sales("ss_net_paid", idx, sf) - q * w
+    if column == "ss_ext_tax":
+        return _gen_store_sales("ss_ext_sales_price", idx, sf) * 9 // 100
+    if column == "ss_ext_wholesale_cost":
+        return (_gen_store_sales("ss_wholesale_cost", idx, sf)
+                * _gen_store_sales("ss_quantity", idx, sf))
+    if column == "ss_net_paid_inc_tax":
+        return (_gen_store_sales("ss_net_paid", idx, sf)
+                + _gen_store_sales("ss_ext_tax", idx, sf))
     raise KeyError(column)
 
 
@@ -660,6 +809,59 @@ def _gen_web_sales(column: str, idx: np.ndarray, sf: float):
         return (_gen_web_sales("ws_net_paid", idx, sf)
                 - _uniform("web_sales", "cost", idx, 50, 40000)
                 * _gen_web_sales("ws_quantity", idx, sf))
+    if column == "ws_sold_time_sk":
+        return _uniform("web_sales", "time", order, 0, 86399)
+    if column == "ws_bill_addr_sk":
+        return _uniform("web_sales", "baddr", order, 1,
+                        _table_rows("customer_address", sf))
+    if column == "ws_bill_cdemo_sk":
+        return _uniform("web_sales", "bcdemo", order, 1,
+                        _table_rows("customer_demographics", sf))
+    if column == "ws_bill_hdemo_sk":
+        return _uniform("web_sales", "bhdemo", order, 1,
+                        _table_rows("household_demographics", sf))
+    if column == "ws_ship_customer_sk":
+        # usually the buyer, sometimes a gift recipient
+        buyer = _gen_web_sales("ws_bill_customer_sk", idx, sf)
+        other = _uniform("web_sales", "shipcust", order, 1,
+                         _table_rows("customer", sf))
+        same = _uniform("web_sales", "shipsame", order, 0, 9) < 7
+        return np.where(same, buyer, other)
+    if column == "ws_ship_cdemo_sk":
+        return _uniform("web_sales", "scdemo", order, 1,
+                        _table_rows("customer_demographics", sf))
+    if column == "ws_ship_hdemo_sk":
+        return _uniform("web_sales", "shdemo", order, 1,
+                        _table_rows("household_demographics", sf))
+    if column == "ws_web_page_sk":
+        return _uniform("web_sales", "page", order, 1,
+                        _table_rows("web_page", sf))
+    if column == "ws_wholesale_cost":
+        return _uniform("web_sales", "wholesale", idx, 100, 10000)
+    if column == "ws_list_price":
+        w = _gen_web_sales("ws_wholesale_cost", idx, sf)
+        return w + w * _uniform("web_sales", "markup", idx, 0, 200) // 100
+    if column == "ws_ext_list_price":
+        return (_gen_web_sales("ws_list_price", idx, sf)
+                * _gen_web_sales("ws_quantity", idx, sf))
+    if column == "ws_ext_discount_amt":
+        lp = _gen_web_sales("ws_list_price", idx, sf)
+        return ((lp - _gen_web_sales("ws_sales_price", idx, sf))
+                * _gen_web_sales("ws_quantity", idx, sf)).clip(0)
+    if column == "ws_ext_wholesale_cost":
+        return (_gen_web_sales("ws_wholesale_cost", idx, sf)
+                * _gen_web_sales("ws_quantity", idx, sf))
+    if column == "ws_ext_tax":
+        return _gen_web_sales("ws_ext_sales_price", idx, sf) * 9 // 100
+    if column == "ws_coupon_amt":
+        return _uniform("web_sales", "coupon", idx, 0, 50000) \
+            * (_uniform("web_sales", "hascoup", idx, 0, 9) == 0)
+    if column == "ws_net_paid_inc_tax":
+        return (_gen_web_sales("ws_net_paid", idx, sf)
+                + _gen_web_sales("ws_ext_tax", idx, sf))
+    if column == "ws_net_paid_inc_ship":
+        return (_gen_web_sales("ws_net_paid", idx, sf)
+                + _gen_web_sales("ws_ext_ship_cost", idx, sf))
     raise KeyError(column)
 
 
@@ -682,6 +884,56 @@ def _gen_web_returns(column: str, idx: np.ndarray, sf: float):
         return _uniform("web_returns", "amt", idx, 100, 500000)
     if column == "wr_net_loss":
         return _uniform("web_returns", "loss", idx, 50, 100000)
+    if column == "wr_returning_customer_sk":
+        buyer = _gen_web_returns("wr_refunded_customer_sk", idx, sf)
+        other = _uniform("web_returns", "rcust", idx, 1,
+                         _table_rows("customer", sf))
+        same = _uniform("web_returns", "rsame", idx, 0, 9) < 8
+        return np.where(same, buyer, other)
+    if column == "wr_refunded_addr_sk":
+        return _uniform("web_returns", "faddr", idx, 1,
+                        _table_rows("customer_address", sf))
+    if column == "wr_returning_addr_sk":
+        return _uniform("web_returns", "raddr", idx, 1,
+                        _table_rows("customer_address", sf))
+    if column == "wr_refunded_cdemo_sk":
+        return _uniform("web_returns", "fcdemo", idx, 1,
+                        _table_rows("customer_demographics", sf))
+    if column == "wr_returning_cdemo_sk":
+        return _uniform("web_returns", "rcdemo", idx, 1,
+                        _table_rows("customer_demographics", sf))
+    if column == "wr_refunded_hdemo_sk":
+        return _uniform("web_returns", "fhdemo", idx, 1,
+                        _table_rows("household_demographics", sf))
+    if column == "wr_web_page_sk":
+        return _uniform("web_returns", "page", idx, 1,
+                        _table_rows("web_page", sf))
+    if column == "wr_reason_sk":
+        return _uniform("web_returns", "reason", idx, 1,
+                        _table_rows("reason", sf))
+    if column == "wr_returned_time_sk":
+        return _uniform("web_returns", "time", idx, 0, 86399)
+    if column == "wr_refunded_cash":
+        amt = _gen_web_returns("wr_return_amt", idx, sf)
+        return amt * _uniform("web_returns", "cashfrac", idx, 0, 100) // 100
+    if column == "wr_reversed_charge":
+        amt = _gen_web_returns("wr_return_amt", idx, sf)
+        cash = _gen_web_returns("wr_refunded_cash", idx, sf)
+        return (amt - cash) // 2
+    if column == "wr_account_credit":
+        amt = _gen_web_returns("wr_return_amt", idx, sf)
+        cash = _gen_web_returns("wr_refunded_cash", idx, sf)
+        rev = _gen_web_returns("wr_reversed_charge", idx, sf)
+        return amt - cash - rev
+    if column == "wr_fee":
+        return _uniform("web_returns", "fee", idx, 50, 10000)
+    if column == "wr_return_ship_cost":
+        return _uniform("web_returns", "shipc", idx, 0, 25000)
+    if column == "wr_return_tax":
+        return _gen_web_returns("wr_return_amt", idx, sf) * 9 // 100
+    if column == "wr_return_amt_inc_tax":
+        return (_gen_web_returns("wr_return_amt", idx, sf)
+                + _gen_web_returns("wr_return_tax", idx, sf))
     raise KeyError(column)
 
 
@@ -805,6 +1057,40 @@ def _gen_catalog_sales(column: str, idx: np.ndarray, sf: float):
         q = _gen_catalog_sales("cs_quantity", idx, sf)
         w = _gen_catalog_sales("cs_wholesale_cost", idx, sf)
         return _gen_catalog_sales("cs_net_paid", idx, sf) - q * w
+    if column == "cs_sold_time_sk":
+        return _uniform("catalog_sales", "time", order, 0, 86399)
+    if column == "cs_ship_customer_sk":
+        buyer = _gen_catalog_sales("cs_bill_customer_sk", idx, sf)
+        other = _uniform("catalog_sales", "shipcust", order, 1,
+                         _table_rows("customer", sf))
+        same = _uniform("catalog_sales", "shipsame", order, 0, 9) < 7
+        return np.where(same, buyer, other)
+    if column == "cs_ship_cdemo_sk":
+        return _uniform("catalog_sales", "scdemo", order, 1,
+                        _table_rows("customer_demographics", sf))
+    if column == "cs_ship_hdemo_sk":
+        return _uniform("catalog_sales", "shdemo", order, 1,
+                        _table_rows("household_demographics", sf))
+    if column == "cs_coupon_amt":
+        return _uniform("catalog_sales", "coupon", idx, 0, 50000) \
+            * (_uniform("catalog_sales", "hascoup", idx, 0, 9) == 0)
+    if column == "cs_ext_list_price":
+        return (_gen_catalog_sales("cs_list_price", idx, sf)
+                * _gen_catalog_sales("cs_quantity", idx, sf))
+    if column == "cs_ext_wholesale_cost":
+        return (_gen_catalog_sales("cs_wholesale_cost", idx, sf)
+                * _gen_catalog_sales("cs_quantity", idx, sf))
+    if column == "cs_ext_tax":
+        return _gen_catalog_sales("cs_ext_sales_price", idx, sf) * 9 // 100
+    if column == "cs_net_paid_inc_tax":
+        return (_gen_catalog_sales("cs_net_paid", idx, sf)
+                + _gen_catalog_sales("cs_ext_tax", idx, sf))
+    if column == "cs_net_paid_inc_ship":
+        return (_gen_catalog_sales("cs_net_paid", idx, sf)
+                + _gen_catalog_sales("cs_ext_ship_cost", idx, sf))
+    if column == "cs_net_paid_inc_ship_tax":
+        return (_gen_catalog_sales("cs_net_paid_inc_ship", idx, sf)
+                + _gen_catalog_sales("cs_ext_tax", idx, sf))
     raise KeyError(column)
 
 
@@ -838,6 +1124,37 @@ def _gen_catalog_returns(column: str, idx: np.ndarray, sf: float):
         return _uniform("catalog_returns", "amt", idx, 100, 500000)
     if column == "cr_net_loss":
         return _uniform("catalog_returns", "loss", idx, 50, 100000)
+    if column == "cr_catalog_page_sk":
+        return _gen_catalog_sales("cs_catalog_page_sk", sale, sf)
+    if column == "cr_refunded_addr_sk":
+        return _gen_catalog_sales("cs_bill_addr_sk", sale, sf)
+    if column == "cr_returning_addr_sk":
+        return _uniform("catalog_returns", "raddr", idx, 1,
+                        _table_rows("customer_address", sf))
+    if column == "cr_refunded_cash":
+        amt = _gen_catalog_returns("cr_return_amount", idx, sf)
+        return amt * _uniform("catalog_returns", "cashfrac", idx,
+                              0, 100) // 100
+    if column == "cr_reversed_charge":
+        amt = _gen_catalog_returns("cr_return_amount", idx, sf)
+        cash = _gen_catalog_returns("cr_refunded_cash", idx, sf)
+        return (amt - cash) // 2
+    if column == "cr_store_credit":
+        amt = _gen_catalog_returns("cr_return_amount", idx, sf)
+        cash = _gen_catalog_returns("cr_refunded_cash", idx, sf)
+        rev = _gen_catalog_returns("cr_reversed_charge", idx, sf)
+        return amt - cash - rev
+    if column == "cr_fee":
+        return _uniform("catalog_returns", "fee", idx, 50, 10000)
+    if column == "cr_return_ship_cost":
+        return _uniform("catalog_returns", "shipc", idx, 0, 25000)
+    if column == "cr_return_tax":
+        return _gen_catalog_returns("cr_return_amount", idx, sf) * 9 // 100
+    if column == "cr_return_amt_inc_tax":
+        return (_gen_catalog_returns("cr_return_amount", idx, sf)
+                + _gen_catalog_returns("cr_return_tax", idx, sf))
+    if column == "cr_warehouse_sk":
+        return _gen_catalog_sales("cs_warehouse_sk", sale, sf)
     raise KeyError(column)
 
 

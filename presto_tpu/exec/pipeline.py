@@ -2633,6 +2633,22 @@ def _rewrite_expr(e: RowExpression, table: Dict[str, RowExpression]):
 
 
 _SUBSTR_DICT_CACHE: Dict[Tuple, Tuple[str, ...]] = {}
+# whole-column substr codes / LIKE masks, indexed by row id: computed ONCE
+# per (column, call) then every batch is a vectorized gather — re-running
+# the Python string generator per batch per call site dominated q22-class
+# queries (three substr sites over customer.phone cost ~10s each per run)
+_SUBSTR_CODES_CACHE: Dict[Tuple, np.ndarray] = {}
+_LIKE_MASK_CACHE: Dict[Tuple, np.ndarray] = {}
+# entries are O(table rows): bound both caches (FIFO evict) so a
+# long-lived worker serving varied patterns/scale factors cannot grow
+# host memory without limit
+_COLUMN_CACHE_MAX_ENTRIES = 64
+
+
+def _cache_put(cache: Dict[Tuple, np.ndarray], key, value) -> None:
+    if len(cache) >= _COLUMN_CACHE_MAX_ENTRIES:
+        cache.pop(next(iter(cache)))
+    cache[key] = value
 
 
 def _canonical_substr_dict(cid: str, table: str, column: str, sf: float,
@@ -2654,40 +2670,85 @@ def _canonical_substr_dict(cid: str, table: str, column: str, sf: float,
     return _SUBSTR_DICT_CACHE[key]
 
 
+def _column_substr_codes(cid: str, table: str, column: str, sf: float,
+                         start: int, length) -> np.ndarray:
+    """int32 substr dictionary codes for EVERY row of the column."""
+    from .. import native
+    key = (cid, table, column, sf, start, length)
+    codes_all = _SUBSTR_CODES_CACHE.get(key)
+    if codes_all is None:
+        cdict = _canonical_substr_dict(cid, table, column, sf, start,
+                                       length)
+        n = catalog.table_row_count(table, sf, cid)
+        codes_all = np.empty(n, dtype=np.int32)
+        index = None
+        for pos in range(0, n, 1 << 18):
+            cnt = min(1 << 18, n - pos)
+            strings = catalog.generate_values_at(
+                table, column, sf,
+                np.arange(pos, pos + cnt, dtype=np.int64), cid)
+            chunk = native.substr_dict_encode(strings, start, length, cdict)
+            if chunk is None:
+                if index is None:
+                    index = {s: i for i, s in enumerate(cdict)}
+                chunk = np.fromiter(
+                    (index[_py_substr(s, start, length)] for s in strings),
+                    dtype=np.int32, count=cnt)
+            codes_all[pos:pos + cnt] = chunk
+        _cache_put(_SUBSTR_CODES_CACHE, key, codes_all)
+    return codes_all
+
+
+def _column_like_mask(cid: str, table: str, column: str, sf: float,
+                      pattern: str) -> np.ndarray:
+    """LIKE match results for EVERY row of the column."""
+    from .lowering import like_matcher
+    from .. import native
+    key = (cid, table, column, sf, pattern)
+    mask_all = _LIKE_MASK_CACHE.get(key)
+    if mask_all is None:
+        n = catalog.table_row_count(table, sf, cid)
+        mask_all = np.empty(n, dtype=bool)
+        match = None
+        for pos in range(0, n, 1 << 18):
+            cnt = min(1 << 18, n - pos)
+            strings = catalog.generate_values_at(
+                table, column, sf,
+                np.arange(pos, pos + cnt, dtype=np.int64), cid)
+            chunk = native.like_match(strings, pattern)
+            if chunk is None:
+                if match is None:
+                    match = like_matcher(pattern)
+                chunk = np.fromiter((match(s) for s in strings),
+                                    dtype=bool, count=cnt)
+            mask_all[pos:pos + cnt] = chunk
+        _cache_put(_LIKE_MASK_CACHE, key, mask_all)
+    return mask_all
+
+
 def _py_substr(s: str, start: int, length) -> str:
     i = start - 1 if start > 0 else len(s) + start
     return s[i:i + length] if length is not None else s[i:]
 
 
 def _host_string_column(call_expr: CallExpression, batch: Batch) -> Column:
-    from .lowering import like_matcher
-    from .. import native
     arg = call_expr.arguments[0]
     col = batch.columns[arg.name]
-    ids = np.asarray(col.values)
     cid, table, column, sf = col.lazy
-    strings = catalog.generate_values_at(table, column, sf, ids, cid)
     name = canonical_name(call_expr.display_name)
     if name == "like":
         pattern = str(call_expr.arguments[1].value)
-        vals = native.like_match(strings, pattern)
-        if vals is None:  # no native lib / non-ASCII: python matcher
-            match = like_matcher(pattern)
-            vals = np.fromiter((match(s) for s in strings), dtype=bool,
-                               count=len(strings))
-        return Column(jnp.asarray(vals), col.nulls)
+        mask_all = _column_like_mask(cid, table, column, sf, pattern)
+        # masked-out lanes may hold arbitrary ids: clamp for the gather
+        ids = np.clip(np.asarray(col.values), 0, len(mask_all) - 1)
+        return Column(jnp.asarray(mask_all[ids]), col.nulls)
     start = int(call_expr.arguments[1].value)
     length = (int(call_expr.arguments[2].value)
               if len(call_expr.arguments) > 2 else None)
-    cdict = _canonical_substr_dict(cid, table, column, sf, start,
-                                   length)
-    codes = native.substr_dict_encode(strings, start, length, cdict)
-    if codes is None:
-        index = {s: i for i, s in enumerate(cdict)}
-        codes = np.fromiter((index[_py_substr(s, start, length)]
-                             for s in strings), dtype=np.int32,
-                            count=len(strings))
-    return Column(jnp.asarray(codes), col.nulls, cdict)
+    cdict = _canonical_substr_dict(cid, table, column, sf, start, length)
+    codes_all = _column_substr_codes(cid, table, column, sf, start, length)
+    ids = np.clip(np.asarray(col.values), 0, len(codes_all) - 1)
+    return Column(jnp.asarray(codes_all[ids]), col.nulls, cdict)
 
 
 def _add_hoisted(batch: Batch, hoisted: Dict[str, CallExpression]) -> Batch:
@@ -2701,20 +2762,15 @@ def _encode_lazy_keys(batch: Batch, keys: List[str]) -> Batch:
     """Replace late-materialized key columns by whole-column dictionary
     codes (for GROUP BY on small-pool open-domain columns, where row ids
     would split value groups)."""
-    from .. import native
     new_cols = {}
     for k in keys:
         col = batch.columns[k]
         cid, table, column, sf = col.lazy
         cdict = _canonical_substr_dict(cid, table, column, sf, 1, None)
-        strings = catalog.generate_values_at(
-            table, column, sf, np.asarray(col.values), cid)
-        codes = native.substr_dict_encode(strings, 1, None, cdict)
-        if codes is None:
-            index = {s: i for i, s in enumerate(cdict)}
-            codes = np.fromiter((index[s] for s in strings), dtype=np.int32,
-                                count=len(strings))
-        new_cols[k] = Column(jnp.asarray(codes), col.nulls, cdict)
+        codes_all = _column_substr_codes(cid, table, column, sf, 1, None)
+        # masked-out lanes may hold arbitrary ids: clamp for the gather
+        ids = np.clip(np.asarray(col.values), 0, len(codes_all) - 1)
+        new_cols[k] = Column(jnp.asarray(codes_all[ids]), col.nulls, cdict)
     return batch.with_columns(new_cols)
 
 

@@ -186,10 +186,15 @@ def _sort_key_arrays(t: Table, orderings) -> list:
         v, m = t.cols[var.name]
         desc = order.startswith("DESC")
         if v.dtype == object:
-            # rank-encode object values
-            uniq = sorted(set(v.tolist()), key=lambda x: (x is None, x))
+            # rank-encode object values; masked payloads may hold
+            # type-mismatched fill (a grouping-set union's null branch
+            # fills varchar keys with int zeros) — treat them as None
+            items = v.tolist()
+            if m is not None:
+                items = [None if m[i] else x for i, x in enumerate(items)]
+            uniq = sorted(set(items), key=lambda x: (x is None, x))
             rank = {u: i for i, u in enumerate(uniq)}
-            v = np.array([rank[x] for x in v.tolist()], dtype=np.int64)
+            v = np.array([rank[x] for x in items], dtype=np.int64)
         vv = v.astype(np.float64) if v.dtype != np.float64 else v.copy()
         vv = np.where(np.isnan(vv), np.inf, vv)
         key = -vv if desc else vv

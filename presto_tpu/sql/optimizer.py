@@ -112,7 +112,12 @@ def prune_unused_outputs(root: P.PlanNode) -> P.PlanNode:
         elif t in ("LimitNode", "EnforceSingleRowNode"):
             visit(node.source, needed)
         elif t == "UnionNode":
-            # every source is projected to the union's output variables
+            # every source is projected to the union's output variables;
+            # a row-count-only consumer still needs one column to exist
+            # in both the union's outputs and its branch projections
+            if not needed and node.outputs:
+                needed = {node.outputs[0].name}
+                req[node.id] = set(needed)
             for s in node.inputs:
                 visit(s, set(needed))
         elif t == "ExchangeNode":
@@ -164,6 +169,14 @@ def prune_unused_outputs(root: P.PlanNode) -> P.PlanNode:
                              if v.name in left_names]
                             or node.outputs)[:1]
                 node.outputs = keep
+            elif t == "UnionNode":
+                # branch projections were pruned to `needed`; the union's
+                # own output list must shrink with them or the union
+                # compile demands columns no branch carries
+                keep = [v for v in node.outputs if v.name in needed]
+                if not keep and node.outputs:
+                    keep = node.outputs[:1]
+                node.outputs = keep
         for s in node.sources:
             rewrite(s)
 
@@ -202,7 +215,83 @@ def plan_dynamic_filters(root: P.PlanNode) -> P.PlanNode:
     return root
 
 
+def hoist_join_filter_string_calls(root: P.PlanNode) -> P.PlanNode:
+    """Rewrite substr/like calls inside JOIN ON-filters into columns
+    projected below the join when their argument is an open-domain
+    (late-materialized) scan column.  A join filter evaluates inside the
+    jitted probe step where a lazy column holds row ids and host hoisting
+    cannot run; a projection below the join takes the Filter/Project
+    hoisting path instead (the reference's analog is PushdownSubfields +
+    expression pushdown below the join)."""
+    from ..connectors import catalog
+    from ..exec.lowering import canonical_name
+    from ..spi.expr import (CallExpression, SpecialFormExpression,
+                            VariableReferenceExpression)
+
+    # variable name -> (table, column) for open-domain scan outputs
+    open_vars: Dict[str, tuple] = {}
+    for n in P.walk_plan(root):
+        if isinstance(n, P.TableScanNode):
+            for v in n.outputs:
+                ch = n.assignments.get(v)
+                if ch is not None and \
+                        (n.table.table_name, ch.name) in catalog.OPEN_DOMAIN:
+                    open_vars[v.name] = (n.table.table_name, ch.name)
+
+    if not open_vars:
+        return root
+    counter = [0]
+
+    def rewrite_filter(e, side_injections):
+        if isinstance(e, CallExpression):
+            name = canonical_name(e.display_name)
+            if name in ("like", "substr") and e.arguments and isinstance(
+                    e.arguments[0], VariableReferenceExpression) \
+                    and e.arguments[0].name in open_vars:
+                counter[0] += 1
+                v = VariableReferenceExpression(
+                    f"__jfhoist_{counter[0]}", e.type)
+                side_injections.setdefault(
+                    e.arguments[0].name, {})[v] = e
+                return v
+            return CallExpression(
+                e.display_name, e.type,
+                [rewrite_filter(a, side_injections) for a in e.arguments])
+        if isinstance(e, SpecialFormExpression):
+            return SpecialFormExpression(
+                e.form, e.type,
+                [rewrite_filter(a, side_injections) for a in e.arguments])
+        return e
+
+    def visit(node: P.PlanNode) -> None:
+        for s in node.sources:
+            visit(s)
+        if not isinstance(node, P.JoinNode) or node.filter is None:
+            return
+        injections: Dict[str, Dict] = {}
+        new_filter = rewrite_filter(node.filter, injections)
+        if not injections:
+            return
+        for side_attr in ("left", "right"):
+            side = getattr(node, side_attr)
+            names = {v.name for v in side.output_variables}
+            assigns = {}
+            for src_name, mapping in injections.items():
+                if src_name in names:
+                    assigns.update(mapping)
+            if assigns:
+                full = {v: v for v in side.output_variables}
+                full.update(assigns)
+                setattr(node, side_attr, P.ProjectNode(
+                    f"{node.id}.jfhoist_{side_attr}", side, full))
+        node.filter = new_filter
+
+    visit(root)
+    return root
+
+
 def optimize(root: P.PlanNode) -> P.PlanNode:
+    root = hoist_join_filter_string_calls(root)
     root = prune_unused_outputs(root)
     root = determine_join_sides(root)
     return plan_dynamic_filters(root)

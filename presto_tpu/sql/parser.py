@@ -642,12 +642,29 @@ class Parser:
 
     def parse_relation_primary(self) -> Node:
         if self.accept("op", "("):
-            if self.peek().value in ("select", "with"):
-                q = self.parse_query()
-                self.expect("op", ")")
-                self.accept("keyword", "as")
-                alias = self.expect("ident").value
-                return SubqueryRef(q, alias)
+            if self.peek().value in ("select", "with") \
+                    or self.peek().value == "(":
+                # `(` could open a parenthesized query ((SELECT..) UNION
+                # (SELECT..)) or a parenthesized join relation; try the
+                # query grammar first and backtrack (SqlBase.g4 resolves
+                # the same ambiguity via aliasedRelation | subquery)
+                save = self.i
+                try:
+                    q = self.parse_query()
+                    self.expect("op", ")")
+                except SyntaxError:
+                    self.i = save
+                else:
+                    if self.accept("keyword", "as"):
+                        alias = self.expect("ident").value
+                    elif self.peek().kind == "ident":
+                        alias = self.next().value
+                    else:
+                        # Presto allows an unaliased derived table; scope
+                        # needs a name, so synthesize a unique one
+                        self._subq_n = getattr(self, "_subq_n", 0) + 1
+                        alias = f"__subq{self._subq_n}"
+                    return SubqueryRef(q, alias)
             rel = self.parse_relation()
             self.expect("op", ")")
             return rel
@@ -761,6 +778,12 @@ class Parser:
         if t.kind == "number":
             self.next()
             return NumberLit(t.value)
+        if t.kind == "ident" and t.value.lower() == "decimal" \
+                and self.peek(1).kind == "string":
+            # typed literal DECIMAL '1.2' (SqlBase.g4 typeConstructor; the
+            # Presto unparser emits every decimal this way)
+            self.next()
+            return NumberLit(self.expect("string").value)
         if t.kind == "string":
             self.next()
             return StringLit(t.value)

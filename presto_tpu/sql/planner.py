@@ -334,9 +334,14 @@ class Planner:
         # ORDER BY may reference select aliases, ordinals, or source columns
         sort_items: List[Tuple[VariableReferenceExpression, str]] = []
         extra_assign: Dict[VariableReferenceExpression, RowExpression] = {}
+        # aliases referenced INSIDE order-by expressions substitute their
+        # DEFINING expression (all assignments share one projection, so a
+        # sibling output name is not visible to a sort-key assignment)
+        alias_defs = {name: proj_assign[v]
+                      for name, v in alias_vars.items()}
         for oi in query.order_by:
             v = self._resolve_order_item(oi, scope, out_vars, alias_vars,
-                                         extra_assign)
+                                         extra_assign, alias_defs)
             order = ("ASC" if oi.ascending else "DESC")
             if oi.nulls_first is None:
                 order += "_NULLS_LAST" if oi.ascending else "_NULLS_FIRST"
@@ -659,8 +664,51 @@ class Planner:
             if isinstance(c.left, A.ScalarSubquery):
                 return self._apply_scalar_compare(node, scope, flip[c.op],
                                                   c.right, c.left.query, neg)
-        raise PlanningError(
-            f"unsupported subquery conjunct {type(c).__name__}")
+        # general shape: subquery expressions nested anywhere inside the
+        # conjunct (x > 1.2 * (SELECT avg ...), OR of EXISTS marks,
+        # BETWEEN with subquery bounds...).  Bind every subquery to a
+        # joined-in value/marker column, then plan the conjunct as an
+        # ordinary filter over those bindings (the reference models this
+        # as ApplyNode creation + PredicatePushDown over the markers).
+        if neg:
+            c = A.UnaryOp("not", c)
+        expr_vars = dict(scope.expr_vars or {})
+        from ..spi.expr import call as _mkcall
+
+        def bind(n):
+            nonlocal node
+            if isinstance(n, A.ScalarSubquery):
+                node, var = self._bind_scalar_subquery(node, scope, n.query,
+                                                       preserve=True)
+                expr_vars[_canon(n, scope)] = var
+                return
+            if isinstance(n, A.InSubquery):
+                node, mark = self._bind_in_subquery(node, scope, n.value,
+                                                    n.query)
+                expr_vars[_canon(n, scope)] = (
+                    _mkcall("not", BOOLEAN, mark) if n.negated else mark)
+                return
+            if isinstance(n, A.Exists):
+                node, mark = self._bind_exists(node, scope, n.query)
+                expr_vars[_canon(n, scope)] = (
+                    _mkcall("not", BOOLEAN, mark) if n.negated else mark)
+                return
+            for f in (vars(n).values() if isinstance(n, A.Node) else []):
+                if isinstance(f, A.Node):
+                    bind(f)
+                elif isinstance(f, list):
+                    for x in f:
+                        if isinstance(x, A.Node):
+                            bind(x)
+                        elif isinstance(x, tuple):
+                            for y in x:
+                                if isinstance(y, A.Node):
+                                    bind(y)
+
+        bind(c)
+        scope2 = Scope(scope.relations, expr_vars)
+        pred = _to_boolean(self.plan_expr(c, scope2))
+        return P.FilterNode(self.new_id("subqfilter"), node, pred)
 
     def _subquery_parts(self, subq: A.Query, outer_scope: Scope):
         """Classify the subquery's WHERE conjuncts against its own FROM.
@@ -740,13 +788,38 @@ class Planner:
 
     def _apply_exists(self, node: P.PlanNode, scope: Scope, subq: A.Query,
                       negated: bool) -> P.PlanNode:
+        node, mark = self._bind_exists(node, scope, subq)
+        pred: RowExpression = mark if not negated \
+            else call("not", BOOLEAN, mark)
+        return P.FilterNode(self.new_id("semifilter"), node, pred)
+
+    def _bind_exists(self, node: P.PlanNode, scope: Scope,
+                     subq: A.Query) -> Tuple[P.PlanNode, RowExpression]:
+        """Attach an EXISTS marker column for `subq` to `node` (semi-join
+        decorrelation); returns (new node, boolean marker expression)."""
         if isinstance(subq, A.SetOp):
             raise PlanningError("EXISTS over a set operation not supported")
         if subq.group_by or subq.having:
             raise PlanningError("EXISTS over grouped subquery")
         inner_conjs, corr, mixed, inner_map = self._subquery_parts(subq, scope)
         if not corr:
-            raise PlanningError("uncorrelated EXISTS not supported")
+            if mixed:
+                # outer references exist but none are equi-correlations:
+                # dropping them would change results (confirmed-bug class:
+                # EXISTS (... WHERE r > n + 100) is NOT uncorrelated)
+                raise PlanningError(
+                    "EXISTS with only non-equi outer references")
+            # uncorrelated EXISTS: count the SUBQUERY's rows (wrapping it
+            # keeps aggregate one-row semantics and LIMIT intact —
+            # EXISTS(SELECT max(x) ...) is always TRUE) and cross-join
+            # the count in
+            cnt_q = A.Query(
+                select_items=[A.SelectItem(
+                    A.FuncCall("count", [], False), "__cnt")],
+                relations=[A.SubqueryRef(subq, "__exists")])
+            node, cnt_var = self._bind_scalar_subquery(node, scope, cnt_q)
+            return node, call("gt", BOOLEAN, cnt_var,
+                              constant(0, BIGINT))
 
         # modified subquery: project the correlated inner expressions (and any
         # inner columns the mixed conjuncts need); the original select list of
@@ -782,9 +855,7 @@ class Planner:
             mark = self.new_var("mark", BOOLEAN)
             node = P.SemiJoinNode(self.new_id("semijoin"), node, sub_node,
                                   outer_v, sub_vars[0], mark)
-            pred: RowExpression = mark if not negated \
-                else call("not", BOOLEAN, mark)
-            return P.FilterNode(self.new_id("semifilter"), node, pred)
+            return node, mark
 
         # general path (mixed non-equi correlation, Q21): tag outer rows with
         # unique ids, inner-join against the subquery with the non-equi
@@ -826,19 +897,25 @@ class Planner:
         mark = self.new_var("mark", BOOLEAN)
         node = P.SemiJoinNode(self.new_id("semijoin"), probe_copy, matched,
                               id_var, id_var, mark)
-        pred = mark if not negated else call("not", BOOLEAN, mark)
-        return P.FilterNode(self.new_id("semifilter"), node, pred)
+        return node, mark
 
     def _apply_in_subquery(self, node: P.PlanNode, scope: Scope,
                            value_ast: A.Node, subq: A.Query,
                            negated: bool) -> P.PlanNode:
+        node, mark = self._bind_in_subquery(node, scope, value_ast, subq)
+        pred: RowExpression = mark if not negated \
+            else call("not", BOOLEAN, mark)
+        return P.FilterNode(self.new_id("semifilter"), node, pred)
+
+    def _bind_in_subquery(self, node: P.PlanNode, scope: Scope,
+                          value_ast: A.Node, subq: A.Query):
+        """Attach an IN-subquery membership marker column; returns
+        (new node, marker variable).  The marker is three-valued (NULL
+        probe key, or miss against a NULL-bearing build side -> NULL);
+        NOT over it is Kleene, per reference HashSemiJoinOperator."""
         inner_conjs, corr, mixed, _ = self._subquery_parts(subq, scope)
         if corr or mixed:
             raise PlanningError("correlated IN subquery not supported")
-        # The semi-join marker is three-valued (NULL probe key, or miss
-        # against a NULL-bearing build side → NULL); NOT over it is Kleene,
-        # so `x NOT IN (subquery)` drops rows whose membership is UNKNOWN,
-        # per SQL semantics (reference HashSemiJoinOperator).
         sub_node, _, sub_vars = self.plan_query_any(subq)
         if len(sub_vars) != 1:
             raise PlanningError("IN subquery must produce one column")
@@ -847,13 +924,31 @@ class Planner:
         mark = self.new_var("mark", BOOLEAN)
         node = P.SemiJoinNode(self.new_id("semijoin"), node, sub_node,
                               v, sub_vars[0], mark)
-        pred: RowExpression = mark if not negated \
-            else call("not", BOOLEAN, mark)
-        return P.FilterNode(self.new_id("semifilter"), node, pred)
+        return node, mark
 
     def _apply_scalar_compare(self, node: P.PlanNode, scope: Scope, op: str,
                               lhs_ast: A.Node, subq: A.Query,
                               negated: bool) -> P.PlanNode:
+        node, val_var = self._bind_scalar_subquery(node, scope, subq)
+        cmp = {"=": "eq", "<>": "neq", "<": "lt", "<=": "lte",
+               ">": "gt", ">=": "gte"}[op]
+        lhs = self.plan_expr(lhs_ast, scope)
+        pred: RowExpression = call(cmp, BOOLEAN, lhs, val_var)
+        if negated:
+            pred = call("not", BOOLEAN, pred)
+        return P.FilterNode(self.new_id("scalarfilter"), node, pred)
+
+    def _bind_scalar_subquery(self, node: P.PlanNode, scope: Scope,
+                              subq: A.Query, preserve: bool = False):
+        """Join the scalar subquery's single value onto `node` as a
+        column; returns (new node, value variable).  Correlated aggregate
+        subqueries decorrelate to a group-by join (reference
+        TransformCorrelatedScalarAggregationToJoin); uncorrelated ones
+        cross-join an EnforceSingleRow result.  preserve=True keeps outer
+        rows with no matching group (LEFT join, NULL value) — required
+        when the subquery value feeds an arbitrary expression (an OR
+        branch may still accept the row), vs. the direct-comparison path
+        where INNER is exact because the comparison rejects NULL."""
         inner_conjs, corr, mixed, _ = self._subquery_parts(subq, scope)
         if mixed:
             raise PlanningError("non-equi correlated scalar subquery")
@@ -884,8 +979,9 @@ class Planner:
                 cur, ov = self._ensure_var(cur, e, "corrkey")
                 criteria.append((ov, sv))
             outputs = list(cur.output_variables) + [val_var]
-            node = P.JoinNode(self.new_id("corrjoin"), P.INNER, cur, sub_node,
-                              criteria, outputs)
+            node = P.JoinNode(self.new_id("corrjoin"),
+                              P.LEFT if preserve else P.INNER, cur,
+                              sub_node, criteria, outputs)
         else:
             # uncorrelated scalar: enforce the one-row contract at runtime,
             # then cross join the row in via a constant-key equi join
@@ -906,13 +1002,7 @@ class Planner:
             node = P.JoinNode(self.new_id("scalarjoin"), P.INNER, left, right,
                               [(ck_l, ck_r)],
                               list(node.output_variables) + [val_var])
-        cmp = {"=": "eq", "<>": "neq", "<": "lt", "<=": "lte",
-               ">": "gt", ">=": "gte"}[op]
-        lhs = self.plan_expr(lhs_ast, scope)
-        pred: RowExpression = call(cmp, BOOLEAN, lhs, val_var)
-        if negated:
-            pred = call("not", BOOLEAN, pred)
-        return P.FilterNode(self.new_id("scalarfilter"), node, pred)
+        return node, val_var
 
     # ------------------------------------------------------------------
     # aggregation planning
@@ -1021,6 +1111,37 @@ class Planner:
         key_types = {_canon(k, scope): self.plan_expr(k, scope).type
                      for k in all_keys}
 
+        # grouping(e, ...) calls (reference GroupingOperationRewriter):
+        # within one branch each is a CONSTANT — bit i set when argument
+        # i is absent from the branch's grouping set
+        grouping_calls: List[A.FuncCall] = []
+        gseen = set()
+
+        def find_grouping(n):
+            if isinstance(n, A.FuncCall) and n.name == "grouping":
+                c = _canon(n, scope)
+                if c not in gseen:
+                    gseen.add(c)
+                    grouping_calls.append(n)
+                return
+            for f in (vars(n).values() if isinstance(n, A.Node) else []):
+                if isinstance(f, A.Node):
+                    find_grouping(f)
+                elif isinstance(f, list):
+                    for x in f:
+                        if isinstance(x, A.Node):
+                            find_grouping(x)
+                        elif isinstance(x, tuple):
+                            for y in x:
+                                if isinstance(y, A.Node):
+                                    find_grouping(y)
+        for item in query.select_items:
+            find_grouping(item.expr)
+        if query.having is not None:
+            find_grouping(query.having)
+        for oi in query.order_by:
+            find_grouping(oi.expr)
+
         # unified output variables
         union_vars: Dict[str, VariableReferenceExpression] = {}
         for k in all_keys:
@@ -1048,6 +1169,15 @@ class Planner:
                 uv = agg_union_vars.setdefault(
                     c, self.new_var("gsetagg", bv.type))
                 assigns[uv] = bv
+            for gc in grouping_calls:
+                c = _canon(gc, scope)
+                uv = agg_union_vars.setdefault(
+                    c, self.new_var("grouping", BIGINT))
+                bits = 0
+                for j, arg in enumerate(gc.args):
+                    if _canon(arg, scope) not in in_set:
+                        bits |= 1 << (len(gc.args) - 1 - j)
+                assigns[uv] = constant(bits, BIGINT)
             branches.append(P.ProjectNode(self.new_id("gset_proj"), bnode,
                                           assigns))
         outs = list(union_vars.values()) + list(agg_union_vars.values())
@@ -1273,13 +1403,20 @@ class Planner:
         return node, Scope(scope.relations, expr_vars)
 
     def _resolve_order_item(self, oi: A.OrderItem, scope, out_vars,
-                            alias_vars, extra_assign):
+                            alias_vars, extra_assign, alias_defs=None):
         e = oi.expr
         if isinstance(e, A.NumberLit):
             return out_vars[int(e.text) - 1]
         if isinstance(e, A.Ident) and len(e.parts) == 1 \
                 and e.parts[0].lower() in alias_vars:
             return alias_vars[e.parts[0].lower()]
+        # select aliases may appear INSIDE order-by expressions (TPC-DS
+        # `case when lochierarchy = 0 then ...`): substitute the alias's
+        # defining expression via expr_vars (bare-name canon); aliases
+        # shadow source columns
+        if alias_defs:
+            scope = Scope(scope.relations,
+                          {**(scope.expr_vars or {}), **alias_defs})
         expr = self.plan_expr(e, scope)
         if isinstance(expr, VariableReferenceExpression):
             # must be carried through the projection
@@ -1309,7 +1446,7 @@ class Planner:
             from ..common.types import UNKNOWN
             return constant(None, UNKNOWN)
         if isinstance(e, A.DateLit):
-            return constant(e.value, DATE)
+            return constant(_parse_date_str(e.value), DATE)
         if isinstance(e, A.BinaryOp):
             return self._plan_binary(e, scope)
         if isinstance(e, A.UnaryOp):
@@ -1344,6 +1481,15 @@ class Planner:
         if isinstance(e, A.CastExpr):
             arg = self.plan_expr(e.operand, scope)
             to = parse_type(e.type_name)
+            if isinstance(to, DateType) \
+                    and isinstance(arg, ConstantExpression) \
+                    and isinstance(arg.type, (VarcharType, CharType)) \
+                    and arg.value is not None:
+                # fold cast('yyyy-mm-dd' as date) — the shape every
+                # official TPC-DS date literal takes
+                return constant(_parse_date_str(arg.value), DATE)
+            if isinstance(to, DateType) and isinstance(arg.type, DateType):
+                return arg                      # cast(date as date): no-op
             return call("cast", to, arg)
         if isinstance(e, A.ExtractExpr):
             arg = self.plan_expr(e.operand, scope)
@@ -1379,15 +1525,48 @@ class Planner:
                  "/": "divide", "%": "modulus"}
         if e.op in arith:
             out_type = _arith_type(e.op, left.type, right.type)
+            if isinstance(left, ConstantExpression) \
+                    and isinstance(right, ConstantExpression) \
+                    and left.value is not None \
+                    and right.value is not None \
+                    and isinstance(left.value, int) \
+                    and isinstance(right.value, int) \
+                    and not isinstance(left.type, (DateType, DecimalType)) \
+                    and not isinstance(right.type, (DateType, DecimalType)):
+                # fold integer constant arithmetic (TPC-DS writes years as
+                # `1999 + 2` and IN-lists as `(2000, 2000 + 1, ...)`; the
+                # reference's ExpressionInterpreter folds these pre-plan)
+                if not (e.op in ("/", "%") and right.value == 0):
+                    def _tdiv(a, b):        # exact truncation toward zero
+                        q = abs(a) // abs(b)
+                        return q if (a >= 0) == (b >= 0) else -q
+                    v = {"+": lambda a, b: a + b,
+                         "-": lambda a, b: a - b,
+                         "*": lambda a, b: a * b,
+                         "/": _tdiv,
+                         "%": lambda a, b: a - _tdiv(a, b) * b}[e.op](
+                             left.value, right.value)
+                    return constant(v, out_type)
             return call(arith[e.op], out_type, left, right)
         raise PlanningError(f"operator {e.op!r}")
 
     def _fold_interval(self, op: str, left: RowExpression,
-                       iv: A.IntervalLit) -> ConstantExpression:
-        """date ± interval: constant-fold (intervals appear only on literal
-        dates in the TPC-H/DS suites)."""
+                       iv: A.IntervalLit) -> RowExpression:
+        """date ± interval: constant-fold literal dates; day-granular
+        intervals over arbitrary date expressions lower to integer
+        day-arithmetic (dates are epoch-day integers on device), the
+        shape official TPC-DS uses (`cast(... as date) + interval '60'
+        day` over columns)."""
+        if isinstance(left, ConstantExpression) \
+                and isinstance(left.type, VarcharType):
+            # unfolded cast('yyyy-mm-dd' as date) constants
+            left = constant(_parse_date_str(left.value), DATE)
         if not isinstance(left, ConstantExpression) \
                 or not isinstance(left.type, DateType):
+            if isinstance(left.type, DateType) and iv.unit == "day":
+                n = int(iv.value)
+                return call("add" if op == "+" else "subtract", DATE,
+                            left, constant(n, BIGINT))
             raise PlanningError("interval arithmetic on non-literal date")
         d = np.datetime64(left.value, "D")
         n = int(iv.value)
@@ -1885,6 +2064,16 @@ def _default_name(e: A.Node) -> str:
     if isinstance(e, A.FuncCall):
         return "_col_" + e.name
     return "_col"
+
+
+def _parse_date_str(text: str) -> str:
+    """Normalize 'yyyy-m-d' to zero-padded ISO before np.datetime64
+    (Presto accepts non-padded date literals; numpy does not)."""
+    parts = str(text).strip().split("-")
+    if len(parts) == 3:
+        y, m, d = parts
+        text = f"{int(y):04d}-{int(m):02d}-{int(d):02d}"
+    return str(np.datetime64(text, "D"))
 
 
 def _number_literal(text: str) -> ConstantExpression:

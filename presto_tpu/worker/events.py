@@ -47,6 +47,24 @@ class QueryCompletedEvent:
     error: Optional[str] = None
 
 
+@dataclass
+class TaskCompletedEvent:
+    """Per-task terminal event from the WORKER execution path — the stats
+    QueryMonitor.java:106 aggregates per task (splitCompletedEvent /
+    TaskInfo final stats): identity, outcome, and the task-level counters
+    the coordinator's UI drill-down reads."""
+    task_id: str
+    state: str                      # FINISHED | FAILED | CANCELED
+    create_time: float
+    end_time: float
+    wall_time_s: float
+    output_rows: int
+    output_pages: int
+    output_bytes: int
+    peak_memory_bytes: int
+    error: Optional[str] = None
+
+
 class EventListener:
     """Listener SPI (EventListener.java): override any subset."""
 
@@ -54,6 +72,9 @@ class EventListener:
         pass
 
     def query_completed(self, event: QueryCompletedEvent) -> None:
+        pass
+
+    def task_completed(self, event: TaskCompletedEvent) -> None:
         pass
 
 
@@ -76,6 +97,9 @@ class FileEventListener(EventListener):
 
     def query_completed(self, event: QueryCompletedEvent) -> None:
         self._write("query_completed", event)
+
+    def task_completed(self, event: TaskCompletedEvent) -> None:
+        self._write("task_completed", event)
 
 
 class EventListenerManager:
@@ -103,3 +127,6 @@ class EventListenerManager:
 
     def query_completed(self, event: QueryCompletedEvent) -> None:
         self._fire("query_completed", event)
+
+    def task_completed(self, event: TaskCompletedEvent) -> None:
+        self._fire("task_completed", event)

@@ -87,6 +87,87 @@ def execution_config_from_properties(props: Dict[str, str],
     return dataclasses.replace(cfg, **kw) if kw else cfg
 
 
+class SystemConfig:
+    """Typed accessors over config.properties — the shape of the native
+    worker's SystemConfig (presto_cpp/main/common/Configs.h:162: every key
+    is a named constant with a typed default; unknown keys are tolerated).
+    Defaults mirror Configs.cpp where the key has a reference default.
+
+    Keys the engine acts on are ALSO mapped into ExecutionConfig /
+    WorkerServer kwargs (execution_config_from_properties /
+    server_kwargs_from_etc); this accessor is the full config surface a
+    deployment reads and the /v1/info plumbing reports."""
+
+    # (key, type, default) — Configs.h:164-420 names
+    KEYS = [
+        ("presto.version", str, "presto-tpu-0.1"),
+        ("http-server.http.port", int, 8080),
+        ("http-server.reuse-port", bool, False),
+        ("http-server.bind-to-node-internal-address-only-enabled",
+         bool, False),
+        ("http-server.https.port", int, 8443),
+        ("http-server.https.enabled", bool, False),
+        ("discovery.uri", str, ""),
+        ("coordinator", bool, False),
+        ("node.environment", str, "test"),
+        ("node.id", str, ""),
+        ("node.location", str, ""),
+        ("node.pool", str, "DEFAULT"),               # NodePoolType.java
+        ("task.max-drivers-per-task", int, 16),
+        ("task.concurrent-lifespans-per-task", int, 1),
+        ("task.writer-count", int, 1),
+        ("task.partitioned-writer-count", int, 1),
+        ("task.max-partial-aggregation-memory", str, "16MB"),
+        ("task.batch-rows", int, 1 << 16),
+        ("task.fuse-pipelines", bool, True),
+        ("shutdown-onset-sec", int, 10),
+        ("system-memory-gb", int, 16),               # HBM per chip
+        ("system-mem-limit-gb", int, 16),
+        ("system-mem-pushback-enabled", bool, False),
+        ("query.max-memory-per-node", str, ""),
+        ("experimental.spill-enabled", bool, True),
+        ("experimental.spiller-spill-path", str, ""),
+        ("experimental.spiller-max-used-space", str, "8GB"),
+        ("exchange.compression-enabled", bool, False),
+        ("exchange.compression-codec", str, "LZ4"),
+        ("exchange.http-client.request-timeout", str, "10s"),
+        ("exchange.max-error-duration", str, "1m"),
+        ("announcement-interval-ms", int, 1000),
+        ("heartbeat-interval-ms", int, 1000),
+        ("async-data-cache-enabled", bool, False),
+        ("enable-serialized-page-checksum", bool, True),
+        ("native-sidecar", bool, False),
+        ("worker-overloaded-threshold-mem-gb", int, 0),
+        ("worker-overloaded-threshold-cpu-pct", int, 0),
+        ("worker-overloaded-task-queuing-enabled", bool, False),
+        ("register-test-functions", bool, False),
+        ("system-metrics-collection-enabled", bool, False),
+        ("internal-communication.shared-secret", str, ""),
+    ]
+
+    def __init__(self, props: Optional[Dict[str, str]] = None):
+        self._props = dict(props or {})
+        self._defaults = {k: d for k, _t, d in self.KEYS}
+        self._types = {k: t for k, t, _d in self.KEYS}
+
+    def known_keys(self):
+        return sorted(self._defaults)
+
+    def get(self, key: str):
+        if key not in self._defaults:
+            raise KeyError(f"unknown config key {key!r}")
+        raw = self._props.get(key)
+        if raw is None:
+            return self._defaults[key]
+        t = self._types[key]
+        if t is bool:
+            return _bool(raw)
+        return t(raw)
+
+    def to_dict(self) -> Dict[str, object]:
+        return {k: self.get(k) for k in self.known_keys()}
+
+
 def server_kwargs_from_etc(etc_dir: str) -> Tuple[dict, Dict[str, str]]:
     """etc/{config,node}.properties -> WorkerServer kwargs + raw props.
 
@@ -111,6 +192,9 @@ def server_kwargs_from_etc(etc_dir: str) -> Tuple[dict, Dict[str, str]]:
         kwargs["coordinator"] = _bool(props["coordinator"])
     if "discovery.uri" in props:
         kwargs["discovery_uri"] = props["discovery.uri"]
+    if "announcement-interval-ms" in props:
+        kwargs["announce_interval_s"] = \
+            int(props["announcement-interval-ms"]) / 1000.0
     # base on the server's tuned defaults (WorkerServer.__init__), not the
     # bare ExecutionConfig — file keys override, absence must not detune
     kwargs["config"] = execution_config_from_properties(

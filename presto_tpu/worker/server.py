@@ -450,7 +450,7 @@ class WorkerServer:
         self.port = self.httpd.server_port
         self.uri = f"http://127.0.0.1:{self.port}"
         self.node_id = node_id or f"node-{self.port}"
-        self.task_manager = TaskManager(self.uri, config)
+        self.task_manager = TaskManager(self.uri, config, events=events)
 
         # coordinator role: client statement intake (worker/statement.py)
         self.dispatch = None

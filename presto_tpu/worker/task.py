@@ -30,10 +30,12 @@ from .protocol import (DONE_STATES, FAILED, FINISHED, PLANNED, RUNNING,
 class TpuTask:
     """One task: state machine + executor thread + output buffers."""
 
-    def __init__(self, task_id: str, self_uri: str, config: ExecutionConfig):
+    def __init__(self, task_id: str, self_uri: str, config: ExecutionConfig,
+                 events=None):
         self.task_id = task_id
         self.self_uri = self_uri
         self.config = config
+        self.events = events
         self.state = PLANNED
         self.version = 0
         self.failures: List[str] = []
@@ -89,6 +91,21 @@ class TpuTask:
             if state in DONE_STATES:
                 self.done_at = time.monotonic()
             self._cond.notify_all()
+        if state in DONE_STATES and self.events is not None:
+            # task-level terminal event from the WORKER path (reference
+            # QueryMonitor per-task stats; listener isolation inside the
+            # manager keeps a broken listener from failing the task)
+            from .events import TaskCompletedEvent
+            now = time.time()
+            self.events.task_completed(TaskCompletedEvent(
+                task_id=self.task_id, state=state,
+                create_time=self.created_at, end_time=now,
+                wall_time_s=now - self.created_at,
+                output_rows=self.output_rows,
+                output_pages=self.output_pages,
+                output_bytes=self.output_bytes,
+                peak_memory_bytes=self.memory_peak,
+                error=failure.splitlines()[-1] if failure else None))
 
     def status(self) -> TaskStatus:
         with self._cond:
@@ -226,9 +243,10 @@ class TaskManager:
     TASK_TTL_S = 300.0
 
     def __init__(self, base_uri: str = "",
-                 config: Optional[ExecutionConfig] = None):
+                 config: Optional[ExecutionConfig] = None, events=None):
         self.base_uri = base_uri
         self.config = config or tuned_config()
+        self.events = events
         self.tasks: Dict[str, TpuTask] = {}
         self._lock = threading.Lock()
         self.tasks_created = 0
@@ -262,7 +280,7 @@ class TaskManager:
                 self.tasks_created += 1
                 task = TpuTask(update.task_id,
                                f"{self.base_uri}/v1/task/{update.task_id}",
-                               self.config)
+                               self.config, events=self.events)
                 self.tasks[update.task_id] = task
                 fresh = True
             else:

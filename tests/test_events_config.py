@@ -346,3 +346,92 @@ def test_etc_config_keeps_tuned_defaults(tmp_path):
     kwargs, _ = server_kwargs_from_etc(etc)
     assert kwargs["config"].batch_rows == 1 << 16
     assert kwargs["config"].join_out_capacity == 1 << 18
+
+
+# ---------------------------------------------------------------------------
+# round 4: typed SystemConfig accessor + worker task-level events
+# ---------------------------------------------------------------------------
+
+def test_system_config_typed_accessors():
+    from presto_tpu.worker.properties import SystemConfig
+    cfg = SystemConfig({"http-server.http.port": "9090",
+                        "experimental.spill-enabled": "false",
+                        "task.max-drivers-per-task": "8",
+                        "node.pool": "LEAF"})
+    assert cfg.get("http-server.http.port") == 9090
+    assert cfg.get("experimental.spill-enabled") is False
+    assert cfg.get("task.max-drivers-per-task") == 8
+    assert cfg.get("node.pool") == "LEAF"
+    # defaults (Configs.h-style typed defaults) for absent keys
+    assert cfg.get("exchange.compression-codec") == "LZ4"
+    assert cfg.get("shutdown-onset-sec") == 10
+    assert cfg.get("coordinator") is False
+    # surface breadth: the most-used Configs.h key set is mapped
+    assert len(cfg.known_keys()) >= 40
+    import pytest as _pytest
+    with _pytest.raises(KeyError):
+        cfg.get("no.such.key")
+    d = cfg.to_dict()
+    assert d["http-server.http.port"] == 9090
+
+
+def test_announcement_interval_key_mapped(tmp_path):
+    etc = _write_etc(tmp_path)
+    with open(f"{etc}/config.properties", "a") as f:
+        f.write("announcement-interval-ms=250\n")
+    kwargs, _ = server_kwargs_from_etc(etc)
+    assert kwargs["announce_interval_s"] == 0.25
+
+
+def test_task_completed_event_fires_from_worker_path():
+    """Task-level events come from the WORKER task execution path
+    (QueryMonitor.java:106 per-task stats), not only the statement
+    protocol: a task run through TaskManager fires task_completed with
+    the task's output counters."""
+    import base64
+    import json as _json
+    import time as _time
+
+    from presto_tpu.sql.planner import Planner
+    from presto_tpu.spi import plan as P
+    from presto_tpu.worker.events import EventListenerManager, EventListener
+    from presto_tpu.worker.protocol import (OutputBuffersSpec,
+                                            TaskUpdateRequest)
+    from presto_tpu.worker.task import TaskManager
+
+    got = []
+
+    class L(EventListener):
+        def task_completed(self, event):
+            got.append(event)
+
+    events = EventListenerManager()
+    events.register(L())
+    tm = TaskManager("http://127.0.0.1:0", events=events)
+    out = Planner(default_schema="sf0.01", default_catalog="tpch") \
+        .plan("SELECT count(*) FROM nation")
+    frag = P.PlanFragment(
+        "0", out, P.SOURCE_DISTRIBUTION,
+        P.PartitioningScheme(P.SINGLE_DISTRIBUTION, [],
+                             list(out.output_variables)),
+        [n.id for n in P.walk_plan(out)
+         if isinstance(n, P.TableScanNode)])
+    from presto_tpu.connectors import catalog as cat
+    splits = [s.to_dict() for s in cat.make_splits("nation", 0.01, 1)]
+    from presto_tpu.worker.protocol import TaskSource
+    upd = TaskUpdateRequest.make(
+        "evq.0.0.0.0", 0, frag,
+        [TaskSource.from_dict({"planNodeId": sid, "splits": splits,
+                               "noMoreSplits": True})
+         for sid in frag.partitioned_sources],
+        OutputBuffersSpec("PARTITIONED", 1))
+    tm.create_or_update(upd)
+    deadline = _time.time() + 60
+    while not got and _time.time() < deadline:
+        _time.sleep(0.05)
+    assert got, "no task_completed event fired"
+    ev = got[0]
+    assert ev.task_id == "evq.0.0.0.0"
+    assert ev.state == "FINISHED"
+    assert ev.output_rows == 1
+    assert ev.wall_time_s >= 0
