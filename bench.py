@@ -10,7 +10,7 @@ vs_baseline = speedup vs the single-threaded numpy reference interpreter
               the reference's single-node row-at-a-time engine, measured fresh
               each round so the ratio tracks engine improvements only.
 
-Env knobs: BENCH_SF (default 1), BENCH_RUNS (default 3), BENCH_QUERY (q1|q6).
+Env knobs: BENCH_SF (default 10), BENCH_RUNS (default 3), BENCH_QUERY (q1|q6).
 """
 import json
 import os
@@ -53,7 +53,7 @@ WHERE shipdate >= DATE '1994-01-01'
 
 
 def main():
-    sf = float(os.environ.get("BENCH_SF", "1"))
+    sf = float(os.environ.get("BENCH_SF", "10"))
     runs = int(os.environ.get("BENCH_RUNS", "3"))
     qname = os.environ.get("BENCH_QUERY", "q1")
     sql = {"q1": Q1, "q6": Q6}[qname]

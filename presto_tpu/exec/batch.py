@@ -242,7 +242,25 @@ def batch_to_page(batch: Batch, names, types) -> Page:
         from ..common.block import block_from_values
         return Page([block_from_values(t, []) for t in types], 0)
     if not combined:
-        host.update(jax.device_get(column_fetch()))
+        if keep.size <= (1 << 16) and keep.size * 4 <= batch.capacity:
+            # sparse large batch (an aggregation finalize holds a few
+            # live rows in a table-capacity layout): compact ON DEVICE
+            # and transfer only the live bucket — a full-capacity column
+            # fetch through a remote-device link costs ~10-100x the
+            # compact dispatch (this was most of TPC-H Q1's wall at SF10)
+            # reuse the process-wide compact jit + coarse bucket set
+            # (pipeline._COMPACT_BUCKETS) so this fetch site adds no new
+            # compiled shape variants
+            from .pipeline import _bucket_for, _jit_compact
+            bucket = _bucket_for(keep.size) \
+                or 1 << int(keep.size - 1).bit_length()
+            batch = _jit_compact(batch, bucket)
+            host = jax.device_get({"__mask": batch.mask,
+                                   **column_fetch()})
+            mask = host["__mask"]
+            keep = np.flatnonzero(mask)
+        else:
+            host.update(jax.device_get(column_fetch()))
     blocks = []
     for name, typ in zip(names, types):
         col = batch.columns[name]

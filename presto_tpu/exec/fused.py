@@ -204,7 +204,10 @@ class FusedChain:
         """Materialize every build side and construct lookup tables.
         Returns (aux, expands), or None when a join's fanout exceeds the
         expansion limits (caller falls back to the streaming executor)."""
-        aux: List = []
+        # aux[0] carries the scan's HBM-cached whole-table columns as a
+        # traced argument pytree (closure constants of this size would be
+        # inlined as XLA literals); join/semi lookup tables follow
+        aux: List = [self.scan_meta.get("cached_cols", {})]
         expands: List[int] = []
         for step in self.steps:
             kind = step[0]
@@ -247,12 +250,12 @@ class FusedChain:
             mk = meta["make"] if leaf_cap == self.cap \
                 else meta["make_factory"](leaf_cap)
             self._leaf_make[leaf_cap] = mk
-        outs, live = mk(pos, valid)
+        outs, live = mk(pos, valid, aux[0])
         dicts = meta["dicts"]
         batch = Batch({n: Column(v, None, dicts.get(n))
                        for n, v in outs.items()}, live)
         low = self.compiler.lowering
-        ai = 0
+        ji = 0                      # join/semi ordinal; aux[0] = scan cache
         for step in self.steps:
             kind = step[0]
             if kind == "filter":
@@ -264,12 +267,13 @@ class FusedChain:
                 batch = Batch({o: batch.columns[i] for o, i in step[1]},
                               batch.mask)
             elif kind == "join":
-                if expands[ai] == 1:
-                    batch = self._apply_join(batch, step[1], aux[ai], low)
+                if expands[ji] == 1:
+                    batch = self._apply_join(batch, step[1], aux[ji + 1],
+                                             low)
                 else:
                     batch = self._apply_join_expand(
-                        batch, step[1], aux[ai], expands[ai], low)
-                ai += 1
+                        batch, step[1], aux[ji + 1], expands[ji], low)
+                ji += 1
             elif kind == "uid":
                 # position-keyed unique ids: chunk [pos, pos+leaf_cap)
                 # owns id range [pos*K, (pos+leaf_cap)*K) where K is the
@@ -280,7 +284,7 @@ class FusedChain:
                 # _compile_AssignUniqueIdNode)
                 node = step[1]
                 kprod = 1
-                for j in range(ai):
+                for j in range(ji):
                     kprod *= expands[j]
                 cap_here = batch.mask.shape[0]
                 leaf_c = cap_here // kprod
@@ -298,7 +302,7 @@ class FusedChain:
             elif kind == "semi":
                 node = step[1]
                 key = node.source_join_variable.name
-                tbl, bhn = aux[ai]
+                tbl, bhn = aux[ji + 1]
                 hit, _ = (probe_direct(batch, tbl, key)
                           if isinstance(tbl, DirectTable)
                           else probe_unique(batch, tbl, (key,)))
@@ -311,7 +315,7 @@ class FusedChain:
                     nulls = nulls | pn
                 batch = batch.with_columns(
                     {node.semi_join_output.name: Column(hit, nulls)})
-                ai += 1
+                ji += 1
         return batch
 
     def _apply_join(self, batch: Batch, node: P.JoinNode, tbl, low) -> Batch:
