@@ -334,6 +334,10 @@ class PlanCompiler:
     # on-the-fly — which is also how SF100-class columns behave (each
     # exceeds the budget alone).
     DEV_COL_CACHE_BUDGET = 6 << 30
+    # per-column cap: building a column transiently holds ~2x its bytes
+    # (chunk parts + concatenated result), so multi-GB columns (SF100
+    # lineitem) must stay on-the-fly or the build itself OOMs HBM
+    DEV_COL_MAX_BYTES = 1 << 30
 
     _dev_col_cache: "Dict[tuple, jnp.ndarray]" = {}
     _dev_col_cache_bytes = [0]
@@ -355,7 +359,9 @@ class PlanCompiler:
             cls._dev_col_cache_bytes[0] -= arr.nbytes
         itemsize = 4 if as_i32 else 8
         need = (n_rows + pad) * itemsize
-        if cls._dev_col_cache_bytes[0] + need > cls.DEV_COL_CACHE_BUDGET:
+        if need > cls.DEV_COL_MAX_BYTES \
+                or cls._dev_col_cache_bytes[0] + need \
+                > cls.DEV_COL_CACHE_BUDGET:
             return None
         chunk = 1 << 22
 
@@ -746,8 +752,10 @@ class PlanCompiler:
             return ops.topn(batch, keys, n)
 
         def gen():
+            key_names = [k for k, _o in keys]
             buf = None
             for b in src.batches():
+                b = _encode_unordered_lazy_keys(b, key_names)
                 buf = first(b) if buf is None else step(buf, b)
             if buf is not None:
                 yield buf
@@ -761,6 +769,8 @@ class PlanCompiler:
             merged = self._materialize_node(node.source)
             if merged is None:
                 return
+            merged = _encode_unordered_lazy_keys(
+                merged, [k for k, _o in keys])
             yield _jits()[0](merged, tuple(keys))
         return BatchSource(gen, names, types)
 
@@ -2671,7 +2681,7 @@ class _StringHoister:
     def resolve(self, first_batch: Batch):
         active: Dict[str, Tuple] = {}
         for key, c in self.candidates.items():
-            col = first_batch.columns.get(c.arguments[0].name)
+            col = first_batch.columns.get(_hoistable_var(c).name)
             if col is not None and col.lazy is not None:
                 var = VariableReferenceExpression(
                     f"__hoist_{len(active)}_{abs(hash(key)) % 10**8}", c.type)
@@ -2688,13 +2698,32 @@ def _hoist_key(e: RowExpression) -> str:
     return json.dumps(e.to_dict(), sort_keys=True, default=str)
 
 
+def _hoistable_var(e: CallExpression):
+    """The single column argument of a host-hoistable string call, or
+    None.  like/substr take the column first; concat takes one column
+    anywhere among constant parts."""
+    name = canonical_name(e.display_name)
+    if name in ("like", "substr") and e.arguments and isinstance(
+            e.arguments[0], VariableReferenceExpression):
+        return e.arguments[0]
+    if name == "concat":
+        var_args = [a for a in e.arguments
+                    if isinstance(a, VariableReferenceExpression)]
+        from ..spi.expr import ConstantExpression as _CE
+        # a NULL constant part makes every result NULL — not hoistable
+        # as a string transform (str(None) would bake the text "None")
+        if len(var_args) == 1 and all(
+                isinstance(a, _CE) and a.value is not None
+                for a in e.arguments
+                if not isinstance(a, VariableReferenceExpression)):
+            return var_args[0]
+    return None
+
+
 def _find_string_calls(e: RowExpression, out: Dict[str, CallExpression]):
-    if isinstance(e, CallExpression):
-        name = canonical_name(e.display_name)
-        if name in ("like", "substr") and e.arguments and isinstance(
-                e.arguments[0], VariableReferenceExpression):
-            out[_hoist_key(e)] = e
-            return
+    if isinstance(e, CallExpression) and _hoistable_var(e) is not None:
+        out[_hoist_key(e)] = e
+        return
     for a in getattr(e, "arguments", None) or []:
         _find_string_calls(a, out)
 
@@ -2812,11 +2841,54 @@ def _py_substr(s: str, start: int, length) -> str:
     return s[i:i + length] if length is not None else s[i:]
 
 
+# whole-column codes for arbitrary per-string transforms (concat with
+# constant parts etc.), sharing the bounded-cache discipline
+_XFORM_DICT_CACHE: Dict[Tuple, Tuple[str, ...]] = {}
+_XFORM_CODES_CACHE: Dict[Tuple, np.ndarray] = {}
+
+
+def _column_xform_codes(cid, table, column, sf, tag, fn):
+    key = (cid, table, column, sf, tag)
+    cdict = _XFORM_DICT_CACHE.get(key)
+    codes_all = _XFORM_CODES_CACHE.get(key)
+    if cdict is None or codes_all is None:
+        n = catalog.table_row_count(table, sf, cid)
+        uniq = set()
+        for pos in range(0, n, 1 << 18):
+            cnt = min(1 << 18, n - pos)
+            strings = catalog.generate_values_at(
+                table, column, sf,
+                np.arange(pos, pos + cnt, dtype=np.int64), cid)
+            uniq.update(fn(x) for x in strings)
+        cdict = tuple(sorted(uniq))
+        index = {x: i for i, x in enumerate(cdict)}
+        codes_all = np.empty(n, dtype=np.int32)
+        for pos in range(0, n, 1 << 18):
+            cnt = min(1 << 18, n - pos)
+            strings = catalog.generate_values_at(
+                table, column, sf,
+                np.arange(pos, pos + cnt, dtype=np.int64), cid)
+            codes_all[pos:pos + cnt] = np.fromiter(
+                (index[fn(x)] for x in strings), dtype=np.int32, count=cnt)
+        _cache_put(_XFORM_DICT_CACHE, key, cdict)
+        _cache_put(_XFORM_CODES_CACHE, key, codes_all)
+    return cdict, codes_all
+
+
 def _host_string_column(call_expr: CallExpression, batch: Batch) -> Column:
-    arg = call_expr.arguments[0]
+    arg = _hoistable_var(call_expr)
     col = batch.columns[arg.name]
     cid, table, column, sf = col.lazy
     name = canonical_name(call_expr.display_name)
+    if name == "concat":
+        parts = tuple(None if isinstance(a, VariableReferenceExpression)
+                      else str(a.value) for a in call_expr.arguments)
+        fn = (lambda x, _p=parts: "".join(
+            x if p is None else p for p in _p))
+        cdict, codes_all = _column_xform_codes(
+            cid, table, column, sf, ("concat", parts), fn)
+        ids = np.clip(np.asarray(col.values), 0, len(codes_all) - 1)
+        return Column(jnp.asarray(codes_all[ids]), col.nulls, cdict)
     if name == "like":
         pattern = str(call_expr.arguments[1].value)
         mask_all = _column_like_mask(cid, table, column, sf, pattern)
@@ -2837,6 +2909,36 @@ def _add_hoisted(batch: Batch, hoisted: Dict[str, CallExpression]) -> Batch:
         return batch
     return batch.with_columns({name: _host_string_column(c, batch)
                                for name, c in hoisted.items()})
+
+
+_DEV_CODES_CACHE: Dict[Tuple, "jnp.ndarray"] = {}
+
+
+def _encode_unordered_lazy_keys(batch: Batch, keys: List[str]) -> Batch:
+    """Whole-column dictionary-encode any SORT-KEY column whose lazy row
+    ids do not already sort like values (sort_indices requires id order ==
+    lex order; see catalog.ROWID_ORDERED) — q30/q65-class ORDER BY over
+    open-domain strings.  The codes table is uploaded to the device once
+    and each batch is a device gather, so a STREAMED consumer (TopN) adds
+    no per-batch host sync."""
+    new_cols = {}
+    for k in keys:
+        col = batch.columns.get(k)
+        if col is None or col.lazy is None:
+            continue
+        cid, tbl, coln, sf = col.lazy
+        if (tbl, coln) in catalog.ROWID_ORDERED:
+            continue
+        cdict = _canonical_substr_dict(cid, tbl, coln, sf, 1, None)
+        ck = (cid, tbl, coln, sf)
+        codes_dev = _DEV_CODES_CACHE.get(ck)
+        if codes_dev is None:
+            codes_dev = jnp.asarray(
+                _column_substr_codes(cid, tbl, coln, sf, 1, None))
+            _cache_put(_DEV_CODES_CACHE, ck, codes_dev)
+        ids = jnp.clip(col.values, 0, codes_dev.shape[0] - 1)
+        new_cols[k] = Column(codes_dev[ids], col.nulls, cdict)
+    return batch.with_columns(new_cols) if new_cols else batch
 
 
 def _encode_lazy_keys(batch: Batch, keys: List[str]) -> Batch:

@@ -299,6 +299,34 @@ class Planner:
         if window_calls:
             node, scope = self.plan_windows(node, scope, window_calls)
 
+        # 3c. subquery expressions in SELECT items (TPC-DS q09's
+        # CASE WHEN (SELECT count..) > n THEN (SELECT avg..) shape): bind
+        # each to a joined-in value/marker column, registered under its
+        # canon so plan_expr resolves it like any pre-computed expression.
+        # Aggregated queries are excluded: the binds would have to happen
+        # below the aggregation, a rewrite the suites don't need.
+        if not query.group_by and not agg_calls:
+            sub_vars: Dict[str, RowExpression] = dict(scope.expr_vars or {})
+            found_subq = [False]
+
+            def bind_sel(n):
+                nonlocal node
+                if isinstance(n, A.ScalarSubquery):
+                    node, var = self._bind_scalar_subquery(
+                        node, scope, n.query, preserve=True)
+                    sub_vars[_canon(n, scope)] = var
+                    found_subq[0] = True
+                    return
+                if isinstance(n, (A.InSubquery, A.Exists)):
+                    return   # boolean forms in SELECT stay unsupported
+                _walk_ast_fields(n, bind_sel)
+
+            for item in query.select_items:
+                if not isinstance(item.expr, A.Star):
+                    bind_sel(item.expr)
+            if found_subq[0]:
+                scope = Scope(scope.relations, sub_vars)
+
         # 4. SELECT projection
         select_exprs: List[RowExpression] = []
         names: List[str] = []
@@ -693,17 +721,7 @@ class Planner:
                 expr_vars[_canon(n, scope)] = (
                     _mkcall("not", BOOLEAN, mark) if n.negated else mark)
                 return
-            for f in (vars(n).values() if isinstance(n, A.Node) else []):
-                if isinstance(f, A.Node):
-                    bind(f)
-                elif isinstance(f, list):
-                    for x in f:
-                        if isinstance(x, A.Node):
-                            bind(x)
-                        elif isinstance(x, tuple):
-                            for y in x:
-                                if isinstance(y, A.Node):
-                                    bind(y)
+            _walk_ast_fields(n, bind)
 
         bind(c)
         scope2 = Scope(scope.relations, expr_vars)
@@ -999,7 +1017,8 @@ class Planner:
             right = P.ProjectNode(
                 self.new_id("sjr"), sub_node,
                 {val_var: val_var, ck_r: constant(0, BIGINT)})
-            node = P.JoinNode(self.new_id("scalarjoin"), P.INNER, left, right,
+            node = P.JoinNode(self.new_id("scalarjoin"),
+                              P.LEFT if preserve else P.INNER, left, right,
                               [(ck_l, ck_r)],
                               list(node.output_variables) + [val_var])
         return node, val_var
@@ -1951,6 +1970,23 @@ def _collect_window_calls(query: A.Query) -> List[A.WindowCall]:
     for oi in query.order_by:
         walk(oi.expr)
     return out
+
+
+def _walk_ast_fields(n, visit) -> None:
+    """Visit every AST child of n (dataclass fields holding Nodes, lists
+    of Nodes, or tuples containing Nodes) — the shared traversal for
+    subquery discovery walkers."""
+    for f in (vars(n).values() if isinstance(n, A.Node) else []):
+        if isinstance(f, A.Node):
+            visit(f)
+        elif isinstance(f, list):
+            for x in f:
+                if isinstance(x, A.Node):
+                    visit(x)
+                elif isinstance(x, tuple):
+                    for y in x:
+                        if isinstance(y, A.Node):
+                            visit(y)
 
 
 def _collect_agg_calls(query: A.Query) -> List[A.FuncCall]:

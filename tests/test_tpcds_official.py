@@ -1,0 +1,82 @@
+"""Official TPC-DS query conformance (VERDICT r3 next #5: >= 40
+official-text queries, differential).
+
+Query texts are read AT TEST TIME from the reference tree's product-test
+corpus — the Presto-formatted official 99 (quoted identifiers, DECIMAL
+typed literals, set operations):
+  presto-product-tests/src/main/resources/sql-tests/testcases/tpcds/qNN.sql
+Each query runs on the engine and on the numpy oracle
+(exec/reference.py) over the identical generated sf0.01 catalog and the
+row sets must match (the H2-differential strategy of
+QueryAssertions.java:52 / presto-native-tests).
+
+DEFAULT_BANK lists the faster half of the passing corpus (~6 min on the
+CPU backend); PRESTO_TPU_TPCDS_FULL=1 additionally runs every other
+query validated by the round-4 sweep (100 of 103 files pass; known
+remaining gaps: q14_1 INTERSECT null matching, q41 non-equi correlated
+scalar subqueries, q90 decimal division-by-zero semantics).
+"""
+import os
+
+import pytest
+
+from presto_tpu.exec.pipeline import ExecutionConfig
+from presto_tpu.exec.runner import LocalQueryRunner, _assert_rows_equal
+
+CORPUS = ("/root/reference/presto-product-tests/src/main/resources/"
+          "sql-tests/testcases/tpcds")
+
+needs_corpus = pytest.mark.skipif(
+    not os.path.isdir(CORPUS), reason="reference corpus not present")
+
+# fastest ~50 of the sweep-validated set (sequential warm timings)
+DEFAULT_BANK = [
+    "q01", "q03", "q06", "q08", "q12", "q13", "q15", "q17", "q19", "q20",
+    "q21", "q24_1", "q24_2", "q25", "q29", "q32", "q34", "q36", "q37",
+    "q38", "q39_1", "q40", "q42", "q43", "q44", "q45", "q46", "q48",
+    "q50", "q51", "q52", "q53", "q54", "q55", "q56", "q61", "q62", "q63",
+    "q68", "q73", "q76", "q79", "q82", "q83", "q86", "q89", "q92", "q93",
+]
+
+# the rest of the sweep-validated corpus (slower: big CTE unions, rollups,
+# windowed rank queries) — run with PRESTO_TPU_TPCDS_FULL=1
+FULL_BANK = [
+    "q02", "q04", "q05", "q07", "q09", "q10", "q11", "q14_2", "q16",
+    "q18", "q22", "q23_1", "q23_2", "q26", "q27", "q28", "q30", "q31",
+    "q33", "q35", "q39_2", "q47", "q49", "q57", "q58", "q59", "q60",
+    "q64", "q65", "q66", "q67", "q69", "q70", "q71", "q72", "q74", "q75",
+    "q77", "q78", "q80", "q81", "q84", "q85", "q87", "q88", "q91", "q94",
+    "q95", "q96", "q97", "q98", "q99",
+]
+
+_FULL = os.environ.get("PRESTO_TPU_TPCDS_FULL") == "1"
+BANK = DEFAULT_BANK + (FULL_BANK if _FULL else [])
+
+
+@pytest.fixture(scope="module")
+def runner():
+    return LocalQueryRunner("sf0.01", catalog="tpcds",
+                            config=ExecutionConfig(
+                                batch_rows=1 << 14,
+                                join_out_capacity=1 << 16))
+
+
+def _load(name: str) -> str:
+    with open(os.path.join(CORPUS, f"{name}.sql")) as f:
+        return f.read().strip().rstrip(";")
+
+
+@needs_corpus
+@pytest.mark.parametrize("name", BANK)
+def test_tpcds_official_query(runner, name):
+    sql = _load(name)
+    got = runner.execute(sql)
+    exp = runner.execute_reference(sql)
+    _assert_rows_equal(got, exp, False)
+
+
+@needs_corpus
+def test_bank_covers_verdict_target():
+    # >= 40 official-text queries differentially, per the round-3 ask
+    assert len(DEFAULT_BANK) >= 40
+    assert len(set(DEFAULT_BANK) & set(FULL_BANK)) == 0
