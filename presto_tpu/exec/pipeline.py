@@ -151,6 +151,16 @@ class ExecutionConfig:
     # codec for COMPRESSED pages (reference exchange.compression-codec /
     # PagesSerdeFactory.java:69-80): LZ4 | SNAPPY | ZSTD | GZIP | ZLIB | NONE
     exchange_compression_codec: str = "LZ4"
+    # intra-task driver concurrency (reference task_concurrency /
+    # driver-per-split, SqlTaskExecution.java:548): leaf scans drain
+    # splits on this many threads through exec/local_exchange.py, and the
+    # worker task overlaps pipeline drain with page serialization.  >1
+    # overlaps HOST work with DEVICE dispatch; the chip itself serializes
+    # kernels either way.  NOTE: a pipeline the whole-program fuser
+    # accepts (fuse_pipelines=True, all-device scan chain) runs as ONE
+    # XLA program with no per-batch host work to overlap — driver threads
+    # apply to the STREAMING paths (host columns, windows, sorts, spills)
+    task_concurrency: int = 1
 
 
 def tuned_config(**overrides) -> "ExecutionConfig":
@@ -465,8 +475,7 @@ class PlanCompiler:
         make = make_factory(cap)
         dev_make = jax.jit(make)
 
-        def gen():
-            for split in splits:
+        def split_gen(split):
                 pos = split.start
                 while pos < split.end:
                     n = min(cap, split.end - pos)
@@ -527,6 +536,23 @@ class PlanCompiler:
                         mask = jnp.asarray(m)
                     yield Batch(cols, mask)
                     pos += n
+
+        def gen():
+            tc = self.ctx.config.task_concurrency
+            if tc > 1 and len(splits) > 1:
+                # driver-per-split leaf parallelism (LocalExchange +
+                # task_concurrency): split drains overlap host-side work;
+                # driver walls land in EXPLAIN ANALYZE stats
+                from .local_exchange import parallel_drain
+                dstats = None
+                if self.ctx.stats is not None:
+                    dstats = self.ctx.stats.setdefault(
+                        node.id, {"rows": 0, "wall_s": 0.0, "batches": 0})
+                yield from parallel_drain(
+                    [lambda s=s: split_gen(s) for s in splits], tc, dstats)
+                return
+            for split in splits:
+                yield from split_gen(split)
         src = BatchSource(gen, names, types)
         if not host and all(kind == "gen" for _n, _c, kind in dev):
             # whole-pipeline fusion metadata (see _fuse_scan_chain): the scan

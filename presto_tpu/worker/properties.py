@@ -82,6 +82,8 @@ def execution_config_from_properties(props: Dict[str, str],
         kw["exchange_compression_codec"] = codec
     if "task.batch-rows" in props:
         kw["batch_rows"] = int(props["task.batch-rows"])
+    if "task.max-drivers-per-task" in props:
+        kw["task_concurrency"] = int(props["task.max-drivers-per-task"])
     if "task.fuse-pipelines" in props:
         kw["fuse_pipelines"] = _bool(props["task.fuse-pipelines"])
     return dataclasses.replace(cfg, **kw) if kw else cfg

@@ -65,6 +65,11 @@ class TpuTask:
             "noMoreSplits": True,
             "stats": {
                 "createTime": self.created_at,
+                # drain-pipeline wall when task_concurrency > 1: serialize
+                # wall overlapping it is (elapsed - drain) — the local-
+                # exchange overlap surface (TaskStats per-pipeline walls)
+                "drainPipelineWallS": round(
+                    getattr(self, "_drain_wall", [0.0])[0], 4),
                 "elapsedTimeInNanos": int(
                     (_t.time() - self.created_at) * 1e9),
                 "outputPositions": self.output_rows,
@@ -202,9 +207,25 @@ class TpuTask:
             partitioned = (spec.type == "PARTITIONED" and n_parts > 1
                            and key_indices)
             compiler = PlanCompiler(ctx)
-            for page in compiler.run_to_pages(fragment.root):
+            pages = compiler.run_to_pages(fragment.root)
+            if ctx.config.task_concurrency > 1:
+                # overlap pipeline drain (device dispatch + page decode)
+                # with serialization + buffering — the two-pipeline shape
+                # the reference gets from separate drivers connected by a
+                # local exchange.  background_drain owns the thread
+                # lifecycle: cancelling the task closes the generator,
+                # which stops and unblocks the producer.
+                from ..exec.local_exchange import background_drain
+                drain_wall = [0.0]
+                pages = background_drain(pages, wall_out=drain_wall)
+                self._drain_wall = drain_wall
+            for page in pages:
                 self.memory_peak = ctx.memory.peak
                 if self.state in DONE_STATES:
+                    # deterministic shutdown of the drain pipeline (the
+                    # generator's close() stops background producers)
+                    if hasattr(pages, "close"):
+                        pages.close()
                     return
                 self.output_rows += page.position_count
                 compress = ctx.config.exchange_compression
