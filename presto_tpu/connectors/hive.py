@@ -1,13 +1,17 @@
 """File-based warehouse connector over Parquet ("hive" analog).
 
 The storage-backed counterpart of the generated tpch/tpcds connectors — the
-slim analog of the reference's presto-hive connector + presto-parquet reader
-(presto-hive/.../HiveConnector, presto-parquet/.../reader/ParquetReader.java:95)
+slim analog of the reference's presto-hive connector + presto-parquet and
+presto-orc readers/writers (presto-hive/.../HiveConnector,
+presto-parquet/.../reader/ParquetReader.java:95,
+presto-orc/.../OrcReader.java:64 — both formats ride pyarrow here, the
+way the reference rides its own columnar readers)
 with the table-write commit protocol of TableWriterOperator.java:78 /
 TableFinishOperator.java (stage part files in a hidden temp dir, atomic
 rename on finish).
 
-Layout: `<warehouse>/<table>/part-*.parquet`.  Each part file stores columns
+Layout: `<warehouse>/<table>/part-*.{parquet,orc}` (hive.storage-format
+selects the written format; reads accept either).  Each part file stores columns
 in the engine's device representation (decimals as scaled int64, dates as
 int32 days, varchars as strings) with the Presto type recorded in parquet
 field metadata (`presto_type`), so round-trips are exact; external parquet
@@ -104,6 +108,22 @@ def _np_dtype_for(typ: Type):
     return np.int64
 
 
+class _OrcPart:
+    """ORC part file with the slice of the ParquetFile surface the table
+    reader uses (presto-orc's OrcReader role; pyarrow's ORC reader
+    underneath).  ORC footers expose no per-stripe min/max through
+    pyarrow, so column_stats counts rows only for ORC parts."""
+
+    def __init__(self, path: str):
+        from pyarrow import orc
+        self._f = orc.ORCFile(path)
+        self.schema_arrow = self._f.schema
+        self.num_rows = self._f.nrows
+
+    def read(self, columns=None):
+        return self._f.read(columns=columns)
+
+
 class _Table:
     """One on-disk table: parquet parts + lazily built per-column state."""
 
@@ -121,17 +141,20 @@ class _Table:
     def _parts(self) -> List[str]:
         return sorted(os.path.join(self.path, f)
                       for f in os.listdir(self.path)
-                      if f.endswith(".parquet"))
+                      if f.endswith(".parquet") or f.endswith(".orc"))
 
     def _open(self):
         import pyarrow.parquet as pq
         with self._lock:
             if self._files is None:
-                self._files = [pq.ParquetFile(p) for p in self._parts()]
+                self._files = [
+                    _OrcPart(p) if p.endswith(".orc")
+                    else pq.ParquetFile(p) for p in self._parts()]
                 self._offsets = [0]
                 for f in self._files:
-                    self._offsets.append(self._offsets[-1]
-                                         + f.metadata.num_rows)
+                    n = (f.num_rows if isinstance(f, _OrcPart)
+                         else f.metadata.num_rows)
+                    self._offsets.append(self._offsets[-1] + n)
                 if self._files:
                     sch = self._files[0].schema_arrow
                     self._schema = [(f.name, _type_from_arrow(f))
@@ -176,36 +199,57 @@ class _Table:
             return got
         import pyarrow as pa
         typ = self.column_type(column)
-        chunks = []
-        for f in self._open():
-            chunks.append(f.read(columns=[column]).column(0))
-        arr = pa.concat_arrays([c.combine_chunks() if hasattr(c, "combine_chunks") else c
-                                for c in chunks]) if chunks else pa.array([])
-        nulls = None
-        if arr.null_count:
-            nulls = np.asarray(arr.is_null())
+        # decode PER PART: a table may mix parquet parts (decimals as
+        # scaled int64 + field metadata, dates as int32) with ORC parts
+        # (decimal128, date32) — each part normalizes to the device
+        # representation before the numpy concat, so mixed-format tables
+        # read correctly (pa.concat_arrays would reject the mixed types)
         if isinstance(typ, (VarcharType, CharType)):
-            vals = arr.to_pylist()
+            vals: list = []
+            null_chunks = []
+            for f in self._open():
+                arr = f.read(columns=[column]).column(0)
+                vals.extend(arr.to_pylist())
             uniq, index = self._dictionary(column, vals)
             codes = np.zeros(len(vals), dtype=np.int32)
-            for i, s in enumerate(vals):
-                if s is not None:
-                    codes[i] = index[s]
+            nm = np.zeros(len(vals), dtype=bool)
+            for i, sv in enumerate(vals):
+                if sv is None:
+                    nm[i] = True
+                else:
+                    codes[i] = index[sv]
+            nulls = nm if nm.any() else None
             out = (codes, uniq)
             self._col_cache[column] = (out, nulls)
             return (out, nulls)
-        if pa.types.is_decimal(arr.type):
-            scale = arr.type.scale
-            py = arr.to_pylist()
-            values = np.asarray(
-                [0 if v is None else int(v.scaleb(scale)) for v in py],
-                dtype=np.int64)
-        else:
-            if pa.types.is_date32(arr.type):
-                arr = arr.cast(_arrow().int32())
-            values = np.asarray(arr.fill_null(0)
-                                if arr.null_count else arr)
-            values = values.astype(_np_dtype_for(typ), copy=False)
+        val_chunks = []
+        null_chunks = []
+        any_nulls = False
+        for f in self._open():
+            arr = f.read(columns=[column]).column(0)
+            if hasattr(arr, "combine_chunks"):
+                arr = arr.combine_chunks()
+            if arr.null_count:
+                any_nulls = True
+                null_chunks.append(np.asarray(arr.is_null()))
+            else:
+                null_chunks.append(np.zeros(len(arr), dtype=bool))
+            if pa.types.is_decimal(arr.type):
+                scale = arr.type.scale
+                py = arr.to_pylist()
+                v = np.asarray(
+                    [0 if x is None else int(x.scaleb(scale)) for x in py],
+                    dtype=np.int64)
+            else:
+                if pa.types.is_date32(arr.type):
+                    arr = arr.cast(_arrow().int32())
+                v = np.asarray(arr.fill_null(0)
+                               if arr.null_count else arr)
+                v = v.astype(_np_dtype_for(typ), copy=False)
+            val_chunks.append(v)
+        values = (np.concatenate(val_chunks) if val_chunks
+                  else np.zeros(0, dtype=_np_dtype_for(typ)))
+        nulls = np.concatenate(null_chunks) if any_nulls else None
         self._col_cache[column] = (values, nulls)
         return (values, nulls)
 
@@ -236,6 +280,16 @@ class _Table:
         nulls = 0
         total = 0
         for f in self._open():
+            if isinstance(f, _OrcPart):
+                # no stripe min/max via pyarrow; nulls counted from the
+                # column data (stats are cached, tables read-mostly) so
+                # null_fraction stays truthful for ORC parts
+                total += f.num_rows
+                try:
+                    nulls += f.read(columns=[column]).column(0).null_count
+                except (KeyError, pa.lib.ArrowInvalid):
+                    return None
+                continue
             md = f.metadata
             try:
                 field = f.schema_arrow.field(column)
@@ -315,11 +369,13 @@ class _WriteHandle:
     commit in TableFinishOperator + metastore."""
 
     def __init__(self, conn: "HiveConnector", table: str,
-                 names: List[str], types: List[Type]):
+                 names: List[str], types: List[Type],
+                 storage_format: str = "PARQUET"):
         self.conn = conn
         self.table = table
         self.names = names
         self.types = types
+        self.storage_format = storage_format
         self.staging_id = uuid.uuid4().hex[:12]
         self.staging_dir = os.path.join(conn.warehouse,
                                         f".staging-{self.staging_id}")
@@ -357,20 +413,50 @@ class _WriteHandle:
                 arr = pa.array(np.asarray(flat.values, dtype=np.int32),
                                type=pa.int32(), mask=mask)
             elif isinstance(typ, DecimalType):
-                # store the scaled-integer device representation; exact
-                # round-trip (long decimals beyond int64 are rejected)
                 ints = flat.to_pylist()
-                arr = pa.array([None if v is None else int(v)
-                                for v in ints], type=pa.int64())
+                if self.storage_format == "ORC":
+                    # ORC keeps no arrow field metadata, so decimals must
+                    # carry their LOGICAL type (decimal128) in-band
+                    from decimal import Decimal
+                    arr = pa.array(
+                        [None if v is None
+                         else Decimal(int(v)).scaleb(-typ.scale)
+                         for v in ints],
+                        type=pa.decimal128(typ.precision, typ.scale))
+                else:
+                    # parquet: scaled-integer device representation with
+                    # the Presto type in field metadata; exact round-trip
+                    # (long decimals beyond int64 are rejected)
+                    arr = pa.array([None if v is None else int(v)
+                                    for v in ints], type=pa.int64())
             else:
                 arr = pa.array(np.asarray(flat.values, dtype=np.int64),
                                type=pa.int64(), mask=mask)
-            fields.append(pa.field(name, arr.type,
-                                   metadata={"presto_type": str(typ)}))
+            if self.storage_format == "ORC":
+                # ORC discards arrow field metadata: the LOGICAL type must
+                # ride in-band (date32 / decimal128 / exact int widths);
+                # CHAR reads back as VARCHAR (width metadata lost)
+                if isinstance(typ, DateType):
+                    arr = arr.cast(pa.date32())
+                elif isinstance(typ, TinyintType):
+                    arr = arr.cast(pa.int8())
+                elif isinstance(typ, SmallintType):
+                    arr = arr.cast(pa.int16())
+                fields.append(pa.field(name, arr.type))
+            else:
+                fields.append(pa.field(name, arr.type,
+                                       metadata={"presto_type": str(typ)}))
             cols.append(arr)
         table = pa.Table.from_arrays(cols, schema=pa.schema(fields))
-        path = os.path.join(self.staging_dir, f"part-{self._part}.parquet")
-        pq.write_table(table, path)
+        if self.storage_format == "ORC":
+            from pyarrow import orc as pa_orc
+            path = os.path.join(self.staging_dir,
+                                f"part-{self._part}.orc")
+            pa_orc.write_table(table, path)
+        else:
+            path = os.path.join(self.staging_dir,
+                                f"part-{self._part}.parquet")
+            pq.write_table(table, path)
         self._part += 1
         self.rows += page.position_count
         return page.position_count
@@ -399,7 +485,11 @@ class HiveConnector:
     ROWID_ORDERED = ROWID_ORDERED
     ROWID_DISTINCT = ROWID_DISTINCT
 
-    def __init__(self, warehouse: str):
+    def __init__(self, warehouse: str, storage_format: str = "PARQUET"):
+        if storage_format not in ("PARQUET", "ORC"):
+            raise ValueError(
+                f"unsupported hive.storage-format {storage_format!r}")
+        self.storage_format = storage_format
         self.warehouse = os.path.abspath(warehouse)
         os.makedirs(self.warehouse, exist_ok=True)
         self._tables: Dict[str, _Table] = {}
@@ -453,7 +543,16 @@ class HiveConnector:
 
     def begin_write(self, table: str, names: List[str],
                     types: List[Type]) -> _WriteHandle:
-        return _WriteHandle(self, table, names, types)
+        # an INSERT into an existing table keeps that table's part format
+        # (mixed-format tables read fine, but staying uniform keeps the
+        # footer-stats path and external readers simple)
+        fmt = self.storage_format
+        t = self._tables.get(table)
+        if t is not None:
+            parts = t._parts()
+            if parts:
+                fmt = "ORC" if parts[0].endswith(".orc") else "PARQUET"
+        return _WriteHandle(self, table, names, types, storage_format=fmt)
 
     def staged(self, staging_id: str) -> _WriteHandle:
         return self._staged[staging_id]

@@ -212,12 +212,19 @@ def parallel_drain(sources: List[Callable[[], Iterator]],
         finally:
             ex.producer_finished()
 
+    threads = []
     for _ in range(n_threads):
-        threading.Thread(target=run_driver, daemon=True,
-                         name="local-exchange-driver").start()
+        t = threading.Thread(target=run_driver, daemon=True,
+                             name="local-exchange-driver")
+        threads.append(t)
+        t.start()
     try:
         yield from ex.consume(0)
     finally:
         ex.close()
         if stats is not None:
+            # drivers observe the stop flag within one timed-put window;
+            # join briefly so every wall entry is final before snapshot
+            for t in threads:
+                t.join(timeout=1.0)
             stats["driver_walls"] = [round(w, 4) for w in walls]

@@ -109,6 +109,16 @@ def format_plan(node: P.PlanNode,
             line += (f"  {{rows: {s['rows']:,}, "
                      f"wall: {s['wall_s'] * 1e3:,.1f}ms, "
                      f"batches: {s['batches']}}}")
+            if s.get("driver_walls"):
+                # per-driver walls from task_concurrency leaf drains
+                # (local_exchange.parallel_drain): sum(driver walls) -
+                # stage wall is the measured overlap
+                dw = ", ".join(f"{w * 1e3:,.0f}ms"
+                               for w in s["driver_walls"])
+                line += f"  {{driver_walls: [{dw}]}}"
+            if s.get("dynamicFilterRowsDropped"):
+                line += (f"  {{dynamicFilterRowsDropped: "
+                         f"{s['dynamicFilterRowsDropped']:,}}}")
         lines.append(line)
         for ch in n.sources:
             walk(ch, depth + 1)

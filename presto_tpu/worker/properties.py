@@ -224,7 +224,10 @@ def register_catalogs_from_etc(etc_dir: str) -> Dict[str, str]:
             warehouse = props.get("hive.warehouse.dir",
                                   os.path.join(etc_dir, "warehouse"))
             registry.register_connector(
-                name, hive.HiveConnector(warehouse))
+                name, hive.HiveConnector(
+                    warehouse,
+                    storage_format=props.get("hive.storage-format",
+                                             "PARQUET").upper()))
         elif kind == "memory":
             from ..connectors.memory import MemoryConnector
             registry.register_connector(name, MemoryConnector())

@@ -189,3 +189,79 @@ def test_external_parquet_without_metadata(runner, tmp_path):
         "SELECT name, count(*) c, sum(price) p FROM ext GROUP BY name")
     got = runner.execute("SELECT sum(price) p FROM ext")
     assert str(got.rows[0][0]) == "3.75"
+
+
+# ---------------------------------------------------------------------------
+# round 4: ORC storage format (presto-orc analog; VERDICT r3 missing #8)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def orc_runner(tmp_path):
+    conn = hive.HiveConnector(str(tmp_path / "warehouse"),
+                              storage_format="ORC")
+    catalog.register_connector("hive", conn)
+    try:
+        yield LocalQueryRunner("sf0.01", config=ExecutionConfig(
+            batch_rows=1 << 13))
+    finally:
+        catalog.unregister_connector("hive")
+
+
+def test_orc_ctas_and_scan_parity(orc_runner):
+    orc_runner.execute(
+        "CREATE TABLE lineitem_orc AS SELECT l_orderkey, l_quantity, "
+        "l_extendedprice, l_shipdate, l_returnflag FROM lineitem "
+        "WHERE l_orderkey < 2000")
+    # parts on disk are .orc files
+    conn = catalog.module("hive")
+    tdir = os.path.join(conn.warehouse, "lineitem_orc")
+    assert all(f.endswith(".orc") for f in os.listdir(tdir))
+    orc_runner.assert_same_as_reference(
+        "SELECT l_returnflag, count(*), sum(l_quantity), "
+        "sum(l_extendedprice) FROM lineitem_orc GROUP BY l_returnflag")
+    # decimals round-trip exactly through decimal128 (ORC keeps no arrow
+    # field metadata, so the logical type rides in-band)
+    a = orc_runner.execute("SELECT sum(l_extendedprice) FROM lineitem_orc")
+    b = orc_runner.execute("SELECT sum(l_extendedprice) FROM lineitem "
+                           "WHERE l_orderkey < 2000")
+    assert a.rows == b.rows
+
+
+def test_orc_dates_and_filters(orc_runner):
+    orc_runner.execute(
+        "CREATE TABLE orders_orc AS SELECT o_orderkey, o_orderdate, "
+        "o_totalprice FROM orders WHERE o_orderkey < 4000")
+    orc_runner.assert_same_as_reference(
+        "SELECT count(*) FROM orders_orc "
+        "WHERE o_orderdate < date '1995-01-01'")
+
+
+def test_external_orc_file(orc_runner, tmp_path):
+    """ORC files written by another engine (plain arrow types) read
+    through the connector."""
+    import pyarrow as pa
+    from pyarrow import orc as pa_orc
+    from decimal import Decimal
+    tdir = tmp_path / "warehouse" / "extorc"
+    os.makedirs(tdir)
+    tbl = pa.table({
+        "k": pa.array([1, 2, 3], type=pa.int64()),
+        "price": pa.array([Decimal("1.50"), Decimal("2.25"), None],
+                          type=pa.decimal128(10, 2)),
+        "name": pa.array(["a", "b", "a"], type=pa.string()),
+    })
+    pa_orc.write_table(tbl, str(tdir / "part-0.orc"))
+    catalog.module("hive").refresh()
+    orc_runner.assert_same_as_reference(
+        "SELECT name, count(*) c, sum(price) p FROM extorc GROUP BY name")
+    got = orc_runner.execute("SELECT sum(price) FROM extorc")
+    assert str(got.rows[0][0]) == "3.75"
+
+
+def test_orc_insert_appends(orc_runner):
+    orc_runner.execute("CREATE TABLE t_orc AS SELECT n_nationkey, n_name "
+                       "FROM nation WHERE n_nationkey < 5")
+    orc_runner.execute("INSERT INTO t_orc SELECT n_nationkey, n_name "
+                       "FROM nation WHERE n_nationkey >= 20")
+    got = orc_runner.execute("SELECT count(*) FROM t_orc")
+    assert got.rows == [[10]]
