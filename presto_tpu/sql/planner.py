@@ -778,7 +778,8 @@ class Planner:
         inner_conjs: List[A.Node] = []
         corr_pairs: List[Tuple[A.Node, A.Node]] = []
         mixed: List[A.Node] = []
-        for conj in _conjuncts(subq.where):
+        for conj in _conjuncts(_extract_common_predicates(subq.where)
+                               if subq.where is not None else None):
             ids = _idents(conj)
             if all(ident_is_inner(i) for i in ids):
                 inner_conjs.append(conj)
@@ -1779,6 +1780,33 @@ def _used_columns(query: A.Query, table: str, alias: str) -> Optional[set]:
 
     walk(query)
     return None if star[0] else used
+
+
+def _extract_common_predicates(e):
+    """Factor conjuncts common to every OR branch out of the OR:
+    (A AND x) OR (A AND y) -> A AND (x OR y), recursively — the
+    reference's LogicalExpressionRewriter extract-common-predicates
+    identity.  Lets correlation equalities buried under ORs (TPC-DS q41)
+    classify as plain equi-correlations."""
+    if not (isinstance(e, A.BinaryOp) and e.op == "or"):
+        return e
+    left = _extract_common_predicates(e.left)
+    right = _extract_common_predicates(e.right)
+    lc = _conjuncts(left)
+    rc = _conjuncts(right)
+    lkeys = {_canon(x): x for x in lc}
+    rkeys = {_canon(x) for x in rc}
+    common = [x for k, x in lkeys.items() if k in rkeys]
+    if not common:
+        return A.BinaryOp("or", left, right)
+    ckeys = {_canon(x) for x in common}
+    rest_l = [x for x in lc if _canon(x) not in ckeys]
+    rest_r = [x for x in rc if _canon(x) not in ckeys]
+    if not rest_l or not rest_r:
+        # absorption: A OR (A AND y) == A
+        return _and_ast(common)
+    return _and_ast(common + [A.BinaryOp("or", _and_ast(rest_l),
+                                         _and_ast(rest_r))])
 
 
 def _conjuncts(e: Optional[A.Node]) -> List[A.Node]:
