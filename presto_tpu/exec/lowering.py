@@ -494,8 +494,15 @@ class Lowering:
                 return Column(q.astype(av.dtype), nulls)
             av = _rescale(av, sa, rs)
             bv = _rescale(bv, sb, rs)
-            op = {"add": jnp.add, "subtract": jnp.subtract,
-                  "modulus": jnp.mod}[name]
+            if name == "modulus":
+                # same contract as the integer path: dividend-sign result,
+                # NULL on a zero divisor (jnp.mod's divisor-sign
+                # convention differs from SQL's)
+                safe_b = jnp.where(bv == 0, 1, bv)
+                r = (jnp.sign(av)
+                     * (jnp.abs(av) % jnp.abs(safe_b))).astype(av.dtype)
+                return Column(r, _or_null(nulls, bv == 0))
+            op = {"add": jnp.add, "subtract": jnp.subtract}[name]
             return Column(op(av, bv), nulls)
 
         # integer domain

@@ -814,6 +814,7 @@ def _eval_call(expr: CallExpression, t: Table) -> Col:
         ai = [int(x) for x in av.tolist()]
         bi = [int(x) for x in bv.tolist()]
         out = np.empty(len(ai), dtype=object)
+        div0 = None
         for i in range(len(ai)):
             x, y = ai[i], bi[i]
             if name == "add":
@@ -824,12 +825,32 @@ def _eval_call(expr: CallExpression, t: Table) -> Col:
                 p = x * y  # scale sa+sb
                 out[i] = _round_to(p, sa + sb, rs)
             elif name == "divide":
+                if y == 0:
+                    # engine semantics: integer/decimal division by zero
+                    # yields NULL (a data-dependent raise cannot live
+                    # inside jit; the engine documents NULL instead)
+                    out[i] = 0
+                    div0 = np.zeros(len(ai), bool) if div0 is None else div0
+                    div0[i] = True
+                    continue
                 num = x * 10**(rs + sb - sa)
-                q = (abs(num) + abs(y) // 2) // abs(y) if y != 0 else 0
+                if isinstance(expr.type, DecimalType):
+                    # decimal divide rounds half-up at the result scale
+                    q = (abs(num) + abs(y) // 2) // abs(y)
+                else:
+                    # SQL integer division truncates toward zero
+                    q = abs(num) // abs(y)
                 out[i] = q * (1 if (num >= 0) == (y >= 0) else -1)
             elif name == "modulus":
+                if y == 0:
+                    out[i] = 0
+                    div0 = np.zeros(len(ai), bool) if div0 is None else div0
+                    div0[i] = True
+                    continue
                 xs, ys = x * 10**(rs - sa), y * 10**(rs - sb)
-                out[i] = int(np.sign(xs)) * (abs(xs) % abs(ys)) if ys else 0
+                out[i] = int(np.sign(xs)) * (abs(xs) % abs(ys))
+        if div0 is not None:
+            m = div0 if m is None else (m | div0)
         return (out, m)
     if name in ("eq", "neq", "lt", "lte", "gt", "gte"):
         a, b = _eval(args[0], t), _eval(args[1], t)

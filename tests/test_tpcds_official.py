@@ -12,9 +12,9 @@ QueryAssertions.java:52 / presto-native-tests).
 
 DEFAULT_BANK lists the faster half of the passing corpus (~6 min on the
 CPU backend); PRESTO_TPU_TPCDS_FULL=1 additionally runs every other
-query validated by the round-4 sweep (101 of 103 files pass; known
-remaining gaps: q14_1 INTERSECT null matching in its correlated-CTE
-shape, q90 decimal division-by-zero semantics).
+query validated by the round-4 sweep (102 of 103 files pass; the one
+known gap is q14_1's INTERSECT null matching in its correlated-CTE
+shape).
 """
 import os
 
@@ -46,7 +46,7 @@ FULL_BANK = [
     "q33", "q35", "q39_2", "q47", "q49", "q57", "q58", "q59", "q60",
     "q64", "q65", "q66", "q67", "q69", "q70", "q71", "q72", "q74", "q75",
     "q77", "q78", "q80", "q81", "q84", "q85", "q87", "q88", "q91", "q94",
-    "q95", "q96", "q97", "q98", "q99", "q41",
+    "q95", "q96", "q97", "q98", "q99", "q41", "q90",
 ]
 
 _FULL = os.environ.get("PRESTO_TPU_TPCDS_FULL") == "1"
