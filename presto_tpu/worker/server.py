@@ -133,6 +133,34 @@ class _Handler(BaseHTTPRequestHandler):
         length = int(self.headers.get("Content-Length", 0))
         return self.rfile.read(length)
 
+    def _body_json(self):
+        """Request body as a JSON value, honoring the binary transport:
+        Content-Type application/x-jackson-smile bodies (the
+        coordinator's HttpRemoteTask.java:915-931 negotiation) decode
+        through worker/smile.py; everything else parses as JSON text."""
+        raw = self._body()
+        ctype = (self.headers.get("Content-Type") or "").lower()
+        from . import smile
+        if smile.CONTENT_TYPE in ctype or raw[:3] == b":)\n":
+            return smile.decode(raw)
+        return json.loads(raw)
+
+    def _accepts_smile(self) -> bool:
+        from . import smile
+        return smile.CONTENT_TYPE in (self.headers.get("Accept")
+                                      or "").lower()
+
+    def _send_negotiated(self, code: int, obj) -> None:
+        """JSON by default; SMILE when the client's Accept asks for it
+        (the TaskStatus/TaskInfo hot path the reference serves in SMILE
+        for binary-transport coordinators)."""
+        if self._accepts_smile():
+            from . import smile
+            self._send(code, None, smile.encode(obj),
+                       headers={"Content-Type": smile.CONTENT_TYPE})
+        else:
+            self._send(code, obj)
+
     # -- endpoints --------------------------------------------------------
     def do_info(self, groups, query):
         s = self.server_ref
@@ -368,7 +396,7 @@ collapse}}td,th{{border:1px solid #ccc;padding:4px 8px;text-align:left}}
             # draining node refuses new work; the coordinator reroutes
             self._send(503, {"error": "node is shutting down"})
             return
-        body = json.loads(self._body())
+        body = self._body_json()
         if "outputIds" in body or "extraCredentials" in body:
             # reference-shaped request (HttpRemoteTask.java:883-936)
             from .protocol import from_reference_update
@@ -376,7 +404,7 @@ collapse}}td,th{{border:1px solid #ccc;padding:4px 8px;text-align:left}}
         else:
             update = TaskUpdateRequest.from_dict(body)
         status = self.server_ref.task_manager.create_or_update(update)
-        self._send(200, status.to_dict())
+        self._send_negotiated(200, status.to_dict())
 
     def do_task_status(self, groups, query):
         task = self.server_ref.task_manager.get(groups["task"])
@@ -384,16 +412,16 @@ collapse}}td,th{{border:1px solid #ccc;padding:4px 8px;text-align:left}}
             (query.get("currentState", [None])[0])
         max_wait = float(query.get("maxWaitMs", ["1000"])[0]) / 1000.0
         status = task.wait_status(current, max_wait)
-        self._send(200, status.to_dict())
+        self._send_negotiated(200, status.to_dict())
 
     def do_task_info(self, groups, query):
         task = self.server_ref.task_manager.get(groups["task"])
-        self._send(200, task.info())
+        self._send_negotiated(200, task.info())
 
     def do_task_delete(self, groups, query):
         task = self.server_ref.task_manager.get(groups["task"])
         task.cancel()
-        self._send(200, task.status().to_dict())
+        self._send_negotiated(200, task.status().to_dict())
 
     def do_results(self, groups, query):
         task = self.server_ref.task_manager.get(groups["task"])
