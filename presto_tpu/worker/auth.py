@@ -1,0 +1,163 @@
+"""Internal-communication JWT authentication.
+
+The analog of the native worker's InternalAuthenticationFilter
+(presto_cpp/main/http/filters/InternalAuthenticationFilter.cpp): every
+internal request carries an HS256 JWT in the `X-Presto-Internal-Bearer`
+header (HttpConstants.h:29); the signing key is SHA256(shared secret)
+(InternalAuthenticationFilter.cpp:133-144), the subject claim is the
+sender's nodeId and must be non-empty (:147-152), and the filter's
+decision table is exactly the reference's:
+
+  token present, JWT disabled  -> 401 (misconfiguration surface)
+  token absent,  JWT enabled   -> 401
+  token absent,  JWT disabled  -> pass
+  token present, JWT enabled   -> verify signature + exp + subject
+
+Config keys (Configs.h:711-717): internal-communication.jwt.enabled,
+internal-communication.shared-secret,
+internal-communication.jwt.expiration-seconds.
+"""
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import json
+import threading
+import time
+from typing import Optional
+
+BEARER_HEADER = "X-Presto-Internal-Bearer"
+DEFAULT_EXPIRATION_S = 300
+
+
+class AuthError(ValueError):
+    pass
+
+
+def _b64url(raw: bytes) -> str:
+    return base64.urlsafe_b64encode(raw).rstrip(b"=").decode()
+
+
+def _b64url_decode(text: str) -> bytes:
+    pad = -len(text) % 4
+    return base64.urlsafe_b64decode(text + "=" * pad)
+
+
+def _signing_key(secret: str) -> bytes:
+    # the reference signs with SHA256(shared secret), not the raw secret
+    return hashlib.sha256(secret.encode()).digest()
+
+
+def jwt_encode(secret: str, subject: str,
+               expiration_s: int = DEFAULT_EXPIRATION_S) -> str:
+    header = _b64url(json.dumps({"alg": "HS256", "typ": "JWT"},
+                                separators=(",", ":")).encode())
+    now = int(time.time())
+    payload = _b64url(json.dumps(
+        {"sub": subject, "iat": now, "exp": now + expiration_s},
+        separators=(",", ":")).encode())
+    signing_input = f"{header}.{payload}".encode()
+    sig = hmac.new(_signing_key(secret), signing_input,
+                   hashlib.sha256).digest()
+    return f"{header}.{payload}.{_b64url(sig)}"
+
+
+def jwt_verify(token: str, secret: str) -> dict:
+    """Signature + exp + non-empty subject, reference decision order.
+    Returns the claims on success; raises AuthError otherwise."""
+    parts = token.split(".")
+    if len(parts) != 3:
+        raise AuthError("malformed token")
+    header_b64, payload_b64, sig_b64 = parts
+    try:
+        header = json.loads(_b64url_decode(header_b64))
+        payload = json.loads(_b64url_decode(payload_b64))
+        sig = _b64url_decode(sig_b64)
+    except (ValueError, json.JSONDecodeError) as e:
+        raise AuthError(f"undecodable token: {e}") from e
+    if header.get("alg") != "HS256":
+        raise AuthError(f"unsupported alg {header.get('alg')!r}")
+    expect = hmac.new(_signing_key(secret),
+                      f"{header_b64}.{payload_b64}".encode(),
+                      hashlib.sha256).digest()
+    if not hmac.compare_digest(sig, expect):
+        raise AuthError("signature verification failed")
+    exp = payload.get("exp")
+    if exp is not None and time.time() > float(exp):
+        raise AuthError("token expired")
+    if not payload.get("sub"):
+        raise AuthError("missing subject (sender nodeId)")
+    return payload
+
+
+class InternalAuth:
+    """Per-node auth context: validates inbound bearers and mints
+    outbound ones (token cached until near expiry, the way the Java
+    JsonWebTokenManager reuses tokens)."""
+
+    def __init__(self, enabled: bool, secret: str, node_id: str,
+                 expiration_s: int = DEFAULT_EXPIRATION_S):
+        if enabled and not secret:
+            raise AuthError(
+                "internal-communication.jwt.enabled requires "
+                "internal-communication.shared-secret")
+        self.enabled = enabled
+        self.secret = secret
+        self.node_id = node_id
+        self.expiration_s = expiration_s
+        self._lock = threading.Lock()
+        self._token: Optional[str] = None
+        self._token_exp = 0.0
+
+    def check_inbound(self, token: Optional[str]):
+        """Reference decision table; returns None on pass or an error
+        string for a 401."""
+        if token and not self.enabled:
+            return "bearer token present but JWT is not enabled"
+        if not token and self.enabled:
+            return "missing internal bearer token"
+        if not token:
+            return None
+        try:
+            jwt_verify(token, self.secret)
+        except AuthError as e:
+            return str(e)
+        return None
+
+    def outbound_token(self) -> Optional[str]:
+        if not self.enabled:
+            return None
+        with self._lock:
+            now = time.time()
+            if self._token is None or now > self._token_exp - 30:
+                self._token = jwt_encode(self.secret, self.node_id,
+                                         self.expiration_s)
+                self._token_exp = now + self.expiration_s
+            return self._token
+
+
+_DISABLED = InternalAuth(False, "", "")
+_PROCESS_AUTH = _DISABLED
+
+
+def set_process_auth(auth: "InternalAuth") -> None:
+    """Install the process-wide outbound auth context (the cluster's
+    shared secret is one per deployment, so every in-process node shares
+    it — matching the reference's single SystemConfig)."""
+    global _PROCESS_AUTH
+    _PROCESS_AUTH = auth
+
+
+def clear_process_auth(auth: "InternalAuth") -> None:
+    """Uninstall `auth` iff it is the installed context (a shut-down
+    JWT server must not leave later plain clusters sending stale
+    bearers)."""
+    global _PROCESS_AUTH
+    if _PROCESS_AUTH is auth:
+        _PROCESS_AUTH = _DISABLED
+
+
+def outbound_headers() -> dict:
+    tok = _PROCESS_AUTH.outbound_token()
+    return {BEARER_HEADER: tok} if tok else {}

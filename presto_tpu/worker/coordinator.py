@@ -55,9 +55,11 @@ class HeartbeatFailureDetector:
             t.start()
 
     def _probe(self, uri: str):
+        from .auth import outbound_headers
         try:
-            with urllib.request.urlopen(uri + "/v1/info/state",
-                                        timeout=2.0) as resp:
+            req = urllib.request.Request(uri + "/v1/info/state",
+                                         headers=outbound_headers())
+            with urllib.request.urlopen(req, timeout=2.0) as resp:
                 return json.loads(resp.read())
         except (OSError, ValueError):
             return None
@@ -100,24 +102,29 @@ class RemoteTask:
         self.task_uri = f"{worker_uri}/v1/task/{task_id}"
 
     def update(self, request: TaskUpdateRequest) -> TaskStatus:
+        from .auth import outbound_headers
         body = json.dumps(request.to_dict()).encode()
         req = urllib.request.Request(
             self.task_uri, data=body, method="POST",
-            headers={"Content-Type": "application/json"})
+            headers={"Content-Type": "application/json",
+                     **outbound_headers()})
         with urllib.request.urlopen(req, timeout=30) as resp:
             return TaskStatus.from_dict(json.loads(resp.read()))
 
     def status(self, current_state: Optional[str] = None,
                max_wait_ms: int = 1000) -> TaskStatus:
+        from .auth import outbound_headers
         url = f"{self.task_uri}/status?maxWaitMs={max_wait_ms}"
-        req = urllib.request.Request(url)
+        req = urllib.request.Request(url, headers=outbound_headers())
         if current_state:
             req.add_header("X-Presto-Current-State", current_state)
         with urllib.request.urlopen(req, timeout=60) as resp:
             return TaskStatus.from_dict(json.loads(resp.read()))
 
     def cancel(self) -> None:
-        req = urllib.request.Request(self.task_uri, method="DELETE")
+        from .auth import outbound_headers
+        req = urllib.request.Request(self.task_uri, method="DELETE",
+                                     headers=outbound_headers())
         try:
             urllib.request.urlopen(req, timeout=10).close()
         except OSError:

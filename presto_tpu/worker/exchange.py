@@ -23,7 +23,9 @@ RETRY_LIMIT = 5
 
 def _request(url: str, method: str = "GET",
              timeout: float = REQUEST_TIMEOUT_S):
-    req = urllib.request.Request(url, method=method)
+    from .auth import outbound_headers
+    req = urllib.request.Request(url, method=method,
+                                 headers=outbound_headers())
     return urllib.request.urlopen(req, timeout=timeout)
 
 

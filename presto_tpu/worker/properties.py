@@ -145,6 +145,8 @@ class SystemConfig:
         ("register-test-functions", bool, False),
         ("system-metrics-collection-enabled", bool, False),
         ("internal-communication.shared-secret", str, ""),
+        ("internal-communication.jwt.enabled", bool, False),
+        ("internal-communication.jwt.expiration-seconds", int, 300),
     ]
 
     def __init__(self, props: Optional[Dict[str, str]] = None):
@@ -197,6 +199,13 @@ def server_kwargs_from_etc(etc_dir: str) -> Tuple[dict, Dict[str, str]]:
     if "announcement-interval-ms" in props:
         kwargs["announce_interval_s"] = \
             int(props["announcement-interval-ms"]) / 1000.0
+    if _bool(props.get("internal-communication.jwt.enabled", "false")):
+        kwargs["jwt_enabled"] = True
+        kwargs["jwt_secret"] = props.get(
+            "internal-communication.shared-secret", "")
+        if "internal-communication.jwt.expiration-seconds" in props:
+            kwargs["jwt_expiration_s"] = int(
+                props["internal-communication.jwt.expiration-seconds"])
     # base on the server's tuned defaults (WorkerServer.__init__), not the
     # bare ExecutionConfig — file keys override, absence must not detune
     kwargs["config"] = execution_config_from_properties(

@@ -32,6 +32,14 @@ from ..exec.pipeline import ExecutionConfig, tuned_config
 from .protocol import TaskUpdateRequest, make_announcement
 from .task import TaskManager
 
+# routes subject to the internal JWT filter (worker-to-worker and
+# coordinator-to-worker surfaces; client-facing statement/query/UI
+# endpoints authenticate separately in the reference, so enabling the
+# internal filter must not lock clients out)
+_INTERNAL = {"task_update", "task_status", "task_info", "task_delete",
+             "results", "results_ack", "results_destroy", "announce",
+             "service"}
+
 _ROUTES = [
     ("POST", re.compile(r"^/v1/statement$"), "statement_post"),
     ("GET", re.compile(
@@ -80,11 +88,22 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _dispatch(self, method: str):
         parsed = urlparse(self.path)
+        # internal JWT filter (InternalAuthenticationFilter.cpp decision
+        # table) runs before routing, like the reference's proxygen
+        # filter chain
         for m, rx, name in _ROUTES:
             if m != method:
                 continue
             match = rx.match(parsed.path)
             if match:
+                if name in _INTERNAL:
+                    # internal JWT filter (InternalAuthenticationFilter
+                    # decision table) guards the internal surfaces only
+                    err = self.server_ref.auth.check_inbound(
+                        self.headers.get("X-Presto-Internal-Bearer"))
+                    if err is not None:
+                        self._send(401, {"error": err})
+                        return
                 try:
                     getattr(self, "do_" + name)(
                         match.groupdict(), parse_qs(parsed.query))
@@ -464,7 +483,9 @@ class WorkerServer:
                  environment: str = "test",
                  config: Optional[ExecutionConfig] = None,
                  announce_interval_s: float = 1.0,
-                 resource_groups=None, events=None):
+                 resource_groups=None, events=None,
+                 jwt_enabled: bool = False, jwt_secret: str = "",
+                 jwt_expiration_s: int = 300):
         self.environment = environment
         self.coordinator = coordinator
         self.state = "ACTIVE"            # ACTIVE | SHUTTING_DOWN
@@ -478,6 +499,11 @@ class WorkerServer:
         self.port = self.httpd.server_port
         self.uri = f"http://127.0.0.1:{self.port}"
         self.node_id = node_id or f"node-{self.port}"
+        from .auth import InternalAuth, set_process_auth
+        self.auth = InternalAuth(jwt_enabled, jwt_secret, self.node_id,
+                                 jwt_expiration_s)
+        if jwt_enabled:
+            set_process_auth(self.auth)
         self.task_manager = TaskManager(self.uri, config, events=events)
 
         # coordinator role: client statement intake (worker/statement.py)
@@ -525,9 +551,11 @@ class WorkerServer:
         url = f"{discovery_uri}/v1/announcement/{self.node_id}"
         while not self._stop.is_set():
             try:
+                from .auth import outbound_headers
                 req = urllib.request.Request(
                     url, data=body, method="PUT",
-                    headers={"Content-Type": "application/json"})
+                    headers={"Content-Type": "application/json",
+                             **outbound_headers()})
                 urllib.request.urlopen(req, timeout=5).close()
             except OSError:
                 pass  # coordinator not up yet; retry next tick
@@ -595,6 +623,15 @@ class WorkerServer:
                             None) is self:
                 _catalog.unregister_connector("system")
             self._registered_system = False
+
+    def shutdown(self) -> None:
+        """Stop serving and release the process-wide auth context this
+        server installed (stale bearers must not leak into later
+        clusters in the same process)."""
+        from .auth import clear_process_auth
+        self._stop.set()
+        clear_process_auth(self.auth)
+        self.httpd.shutdown()
 
     def begin_shutdown(self) -> None:
         """Refuse new tasks, wait for running ones to drain, then stop the

@@ -1,0 +1,151 @@
+"""Internal-communication JWT auth (VERDICT r3 missing #6, TLS/JWT half:
+reference InternalAuthenticationFilter.cpp decision table, HS256 over
+SHA256(shared secret), X-Presto-Internal-Bearer header)."""
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from presto_tpu.worker import auth
+from presto_tpu.worker.server import WorkerServer
+
+
+@pytest.fixture(autouse=True)
+def _reset_process_auth():
+    yield
+    auth.set_process_auth(auth._DISABLED)
+
+
+def test_jwt_round_trip_and_claims():
+    tok = auth.jwt_encode("secret", "node-1", 60)
+    claims = auth.jwt_verify(tok, "secret")
+    assert claims["sub"] == "node-1"
+    assert claims["exp"] > time.time()
+
+
+def test_jwt_rejects_bad_signature_and_expiry():
+    tok = auth.jwt_encode("secret", "node-1", 60)
+    with pytest.raises(auth.AuthError, match="signature"):
+        auth.jwt_verify(tok, "other-secret")
+    old = auth.jwt_encode("secret", "node-1", -10)
+    with pytest.raises(auth.AuthError, match="expired"):
+        auth.jwt_verify(old, "secret")
+    # empty subject is rejected (reference :147-152)
+    import base64
+    h, p, s = auth.jwt_encode("secret", "x", 60).split(".")
+    import hashlib, hmac
+    payload = base64.urlsafe_b64encode(
+        json.dumps({"sub": "", "exp": time.time() + 60}).encode()
+    ).rstrip(b"=").decode()
+    sig = base64.urlsafe_b64encode(hmac.new(
+        hashlib.sha256(b"secret").digest(),
+        f"{h}.{payload}".encode(), hashlib.sha256).digest()
+    ).rstrip(b"=").decode()
+    with pytest.raises(auth.AuthError, match="subject"):
+        auth.jwt_verify(f"{h}.{payload}.{sig}", "secret")
+
+
+def test_signing_key_is_sha256_of_secret():
+    # the reference derives the HS256 key as SHA256(secret), not the raw
+    # secret (InternalAuthenticationFilter.cpp:133-144)
+    import hashlib
+    assert auth._signing_key("abc") == hashlib.sha256(b"abc").digest()
+
+
+def _get(url, token=None):
+    headers = {}
+    if token is not None:
+        headers[auth.BEARER_HEADER] = token
+    return urllib.request.urlopen(
+        urllib.request.Request(url, headers=headers), timeout=10)
+
+
+def test_worker_enforces_reference_decision_table():
+    w = WorkerServer(jwt_enabled=True, jwt_secret="cluster-secret")
+    threading.Thread(target=w.httpd.serve_forever, daemon=True).start()
+    try:
+        # token absent, enabled -> 401 (internal route)
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(f"{w.uri}/v1/task/x.0.0.0.0/status")
+        assert e.value.code == 401
+        # bad token -> 401
+        bad = auth.jwt_encode("wrong-secret", "n")
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(f"{w.uri}/v1/task/x.0.0.0.0/status", bad)
+        assert e.value.code == 401
+        # valid token -> routed (404: unknown task, but PAST the filter)
+        ok = auth.jwt_encode("cluster-secret", "coordinator-1")
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(f"{w.uri}/v1/task/x.0.0.0.0/status", ok)
+        assert e.value.code == 404
+        # client-facing endpoints stay reachable WITHOUT a token
+        assert json.load(_get(f"{w.uri}/v1/info"))["environment"]
+    finally:
+        w.shutdown()
+
+
+def test_worker_rejects_token_when_disabled():
+    # misconfiguration surface: token present but JWT disabled -> 401
+    w = WorkerServer()
+    threading.Thread(target=w.httpd.serve_forever, daemon=True).start()
+    try:
+        tok = auth.jwt_encode("whatever", "n")
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(f"{w.uri}/v1/task/x.0.0.0.0/status", tok)
+        assert e.value.code == 401
+        # and no token passes (404: past the filter, unknown task)
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(f"{w.uri}/v1/task/x.0.0.0.0/status")
+        assert e.value.code == 404
+    finally:
+        w.shutdown()
+
+
+def test_etc_config_maps_jwt_keys(tmp_path):
+    from presto_tpu.worker.properties import server_kwargs_from_etc
+    etc = tmp_path / "etc"
+    etc.mkdir()
+    (etc / "config.properties").write_text(
+        "internal-communication.jwt.enabled=true\n"
+        "internal-communication.shared-secret=s3cret\n"
+        "internal-communication.jwt.expiration-seconds=120\n")
+    kwargs, _ = server_kwargs_from_etc(str(etc))
+    assert kwargs["jwt_enabled"] is True
+    assert kwargs["jwt_secret"] == "s3cret"
+    assert kwargs["jwt_expiration_s"] == 120
+
+
+def test_jwt_enabled_cluster_runs_distributed_query():
+    """A fully JWT-enabled cluster (coordinator + workers sharing the
+    secret) schedules and completes a distributed query: every internal
+    call — announcements, task updates, status long-polls, exchange
+    pulls — carries and validates bearers."""
+    from presto_tpu.worker import HttpQueryRunner
+
+    secret = "cluster-secret-42"
+    coordinator = WorkerServer(coordinator=True, environment="test",
+                               jwt_enabled=True, jwt_secret=secret)
+    workers = [WorkerServer(discovery_uri=coordinator.uri,
+                            jwt_enabled=True, jwt_secret=secret)
+               for _ in range(2)]
+    threads = [threading.Thread(target=s.httpd.serve_forever, daemon=True)
+               for s in [coordinator] + workers]
+    for t in threads:
+        t.start()
+    try:
+        deadline = time.time() + 20
+        while len(coordinator.worker_uris()) < 2 \
+                and time.time() < deadline:
+            time.sleep(0.05)
+        assert len(coordinator.worker_uris()) == 2, \
+            "announcements rejected by the JWT filter"
+        runner = HttpQueryRunner([w.uri for w in workers], "sf0.01",
+                                 n_tasks=2)
+        res = runner.execute("SELECT count(*) FROM nation")
+        assert res.rows == [[25]]
+    finally:
+        for s in [coordinator] + workers:
+            s.shutdown()
