@@ -76,6 +76,8 @@ def jwt_verify(token: str, secret: str) -> dict:
         sig = _b64url_decode(sig_b64)
     except (ValueError, json.JSONDecodeError) as e:
         raise AuthError(f"undecodable token: {e}") from e
+    if not isinstance(header, dict) or not isinstance(payload, dict):
+        raise AuthError("malformed token segments")
     if header.get("alg") != "HS256":
         raise AuthError(f"unsupported alg {header.get('alg')!r}")
     expect = hmac.new(_signing_key(secret),
@@ -161,3 +163,31 @@ def clear_process_auth(auth: "InternalAuth") -> None:
 def outbound_headers() -> dict:
     tok = _PROCESS_AUTH.outbound_token()
     return {BEARER_HEADER: tok} if tok else {}
+
+
+_SSL_CONTEXT = [None]
+
+
+def set_internal_ca(ca_path: Optional[str]) -> None:
+    """Trust anchor for internal HTTPS calls (the deployment's internal
+    CA; reference https-supported-ciphers/cert plumbing).  None resets
+    to library defaults."""
+    import ssl
+    if ca_path is None:
+        _SSL_CONTEXT[0] = None
+    else:
+        ctx = ssl.create_default_context(cafile=ca_path)
+        # internal certs are issued per deployment, often for node ids
+        # rather than hostnames — the secret/JWT layer authenticates the
+        # PEER; TLS provides transport privacy
+        ctx.check_hostname = False
+        _SSL_CONTEXT[0] = ctx
+
+
+def urlopen_internal(req, timeout: float):
+    """urlopen with the internal CA context when configured."""
+    import urllib.request
+    ctx = _SSL_CONTEXT[0]
+    if ctx is not None:
+        return urllib.request.urlopen(req, timeout=timeout, context=ctx)
+    return urllib.request.urlopen(req, timeout=timeout)

@@ -55,11 +55,11 @@ class HeartbeatFailureDetector:
             t.start()
 
     def _probe(self, uri: str):
-        from .auth import outbound_headers
+        from .auth import outbound_headers, urlopen_internal
         try:
             req = urllib.request.Request(uri + "/v1/info/state",
                                          headers=outbound_headers())
-            with urllib.request.urlopen(req, timeout=2.0) as resp:
+            with urlopen_internal(req, timeout=2.0) as resp:
                 return json.loads(resp.read())
         except (OSError, ValueError):
             return None
@@ -108,7 +108,8 @@ class RemoteTask:
             self.task_uri, data=body, method="POST",
             headers={"Content-Type": "application/json",
                      **outbound_headers()})
-        with urllib.request.urlopen(req, timeout=30) as resp:
+        from .auth import urlopen_internal
+        with urlopen_internal(req, timeout=30) as resp:
             return TaskStatus.from_dict(json.loads(resp.read()))
 
     def status(self, current_state: Optional[str] = None,
@@ -118,7 +119,8 @@ class RemoteTask:
         req = urllib.request.Request(url, headers=outbound_headers())
         if current_state:
             req.add_header("X-Presto-Current-State", current_state)
-        with urllib.request.urlopen(req, timeout=60) as resp:
+        from .auth import urlopen_internal
+        with urlopen_internal(req, timeout=60) as resp:
             return TaskStatus.from_dict(json.loads(resp.read()))
 
     def cancel(self) -> None:
@@ -126,7 +128,8 @@ class RemoteTask:
         req = urllib.request.Request(self.task_uri, method="DELETE",
                                      headers=outbound_headers())
         try:
-            urllib.request.urlopen(req, timeout=10).close()
+            from .auth import urlopen_internal
+            urlopen_internal(req, timeout=10).close()
         except OSError:
             pass
 

@@ -23,10 +23,10 @@ RETRY_LIMIT = 5
 
 def _request(url: str, method: str = "GET",
              timeout: float = REQUEST_TIMEOUT_S):
-    from .auth import outbound_headers
+    from .auth import outbound_headers, urlopen_internal
     req = urllib.request.Request(url, method=method,
                                  headers=outbound_headers())
-    return urllib.request.urlopen(req, timeout=timeout)
+    return urlopen_internal(req, timeout=timeout)
 
 
 def pull_pages(location: str, codec: str = DEFAULT_CODEC) -> Iterator[Page]:

@@ -109,6 +109,9 @@ class SystemConfig:
          bool, False),
         ("http-server.https.port", int, 8443),
         ("http-server.https.enabled", bool, False),
+        ("https-cert-path", str, ""),
+        ("https-key-path", str, ""),
+        ("internal-communication.https.trust-store-path", str, ""),
         ("discovery.uri", str, ""),
         ("coordinator", bool, False),
         ("node.environment", str, "test"),
@@ -199,6 +202,17 @@ def server_kwargs_from_etc(etc_dir: str) -> Tuple[dict, Dict[str, str]]:
     if "announcement-interval-ms" in props:
         kwargs["announce_interval_s"] = \
             int(props["announcement-interval-ms"]) / 1000.0
+    if _bool(props.get("http-server.https.enabled", "false")):
+        kwargs["https_cert_path"] = props.get("https-cert-path")
+        kwargs["https_key_path"] = props.get("https-key-path")
+        if not kwargs["https_cert_path"]:
+            raise ValueError(
+                "http-server.https.enabled requires https-cert-path")
+    if props.get("internal-communication.https.trust-store-path"):
+        # applied by WorkerServer.__init__ (a parse must not mutate
+        # process-global SSL state)
+        kwargs["internal_ca_path"] = \
+            props["internal-communication.https.trust-store-path"]
     if _bool(props.get("internal-communication.jwt.enabled", "false")):
         kwargs["jwt_enabled"] = True
         kwargs["jwt_secret"] = props.get(

@@ -38,7 +38,7 @@ from .task import TaskManager
 # internal filter must not lock clients out)
 _INTERNAL = {"task_update", "task_status", "task_info", "task_delete",
              "results", "results_ack", "results_destroy", "announce",
-             "service"}
+             "service", "info_state_put"}
 
 _ROUTES = [
     ("POST", re.compile(r"^/v1/statement$"), "statement_post"),
@@ -485,7 +485,10 @@ class WorkerServer:
                  announce_interval_s: float = 1.0,
                  resource_groups=None, events=None,
                  jwt_enabled: bool = False, jwt_secret: str = "",
-                 jwt_expiration_s: int = 300):
+                 jwt_expiration_s: int = 300,
+                 https_cert_path: Optional[str] = None,
+                 https_key_path: Optional[str] = None,
+                 internal_ca_path: Optional[str] = None):
         self.environment = environment
         self.coordinator = coordinator
         self.state = "ACTIVE"            # ACTIVE | SHUTTING_DOWN
@@ -497,13 +500,40 @@ class WorkerServer:
         handler = type("Handler", (_Handler,), {"server_ref": self})
         self.httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
         self.port = self.httpd.server_port
-        self.uri = f"http://127.0.0.1:{self.port}"
+        scheme = "http"
+        if https_cert_path:
+            # TLS listener (reference https-cert-path / https-key-path,
+            # Configs.h:211-212; proxygen's TLS endpoint in the native
+            # worker).  One combined PEM is accepted when key_path is
+            # omitted, like the reference's kHttpsClientCertAndKeyPath.
+            # The handshake is deferred to the per-connection handler
+            # thread (do_handshake_on_connect=False + socket timeout):
+            # a peer that never sends its ClientHello must not stall the
+            # accept loop for everyone else.
+            import ssl
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(https_cert_path,
+                                https_key_path or None)
+            base_get_request = self.httpd.get_request
+
+            def tls_get_request():
+                sock, addr = base_get_request()
+                sock.settimeout(30)
+                return ctx.wrap_socket(sock, server_side=True,
+                                       do_handshake_on_connect=False), addr
+            self.httpd.get_request = tls_get_request
+            scheme = "https"
+        self.scheme = scheme
+        self.uri = f"{scheme}://127.0.0.1:{self.port}"
         self.node_id = node_id or f"node-{self.port}"
         from .auth import InternalAuth, set_process_auth
         self.auth = InternalAuth(jwt_enabled, jwt_secret, self.node_id,
                                  jwt_expiration_s)
         if jwt_enabled:
             set_process_auth(self.auth)
+        if internal_ca_path:
+            from .auth import set_internal_ca
+            set_internal_ca(internal_ca_path)
         self.task_manager = TaskManager(self.uri, config, events=events)
 
         # coordinator role: client statement intake (worker/statement.py)
@@ -551,12 +581,12 @@ class WorkerServer:
         url = f"{discovery_uri}/v1/announcement/{self.node_id}"
         while not self._stop.is_set():
             try:
-                from .auth import outbound_headers
+                from .auth import outbound_headers, urlopen_internal
                 req = urllib.request.Request(
                     url, data=body, method="PUT",
                     headers={"Content-Type": "application/json",
                              **outbound_headers()})
-                urllib.request.urlopen(req, timeout=5).close()
+                urlopen_internal(req, timeout=5).close()
             except OSError:
                 pass  # coordinator not up yet; retry next tick
             self._stop.wait(interval_s)
@@ -625,13 +655,10 @@ class WorkerServer:
             self._registered_system = False
 
     def shutdown(self) -> None:
-        """Stop serving and release the process-wide auth context this
-        server installed (stale bearers must not leak into later
-        clusters in the same process)."""
-        from .auth import clear_process_auth
-        self._stop.set()
-        clear_process_auth(self.auth)
-        self.httpd.shutdown()
+        """Stop serving (alias of close(): one shutdown path releases
+        the process-wide auth context, the listener socket, and running
+        tasks alike)."""
+        self.close()
 
     def begin_shutdown(self) -> None:
         """Refuse new tasks, wait for running ones to drain, then stop the
@@ -657,7 +684,9 @@ class WorkerServer:
         threading.Thread(target=drain, name="drain", daemon=True).start()
 
     def close(self) -> None:
+        from .auth import clear_process_auth
         self._stop.set()
+        clear_process_auth(self.auth)
         self._unregister_system()
         self.task_manager.cancel_all()
         self.httpd.shutdown()

@@ -149,3 +149,100 @@ def test_jwt_enabled_cluster_runs_distributed_query():
     finally:
         for s in [coordinator] + workers:
             s.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# TLS listener (reference https-cert-path / https-key-path)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def tls_cert(tmp_path_factory):
+    import subprocess
+    d = tmp_path_factory.mktemp("tls")
+    cert, key = str(d / "node.crt"), str(d / "node.key")
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", key, "-out", cert, "-days", "1",
+         "-subj", "/CN=127.0.0.1"], check=True, capture_output=True)
+    return cert, key
+
+
+def test_https_worker_end_to_end(tls_cert):
+    """Worker on TLS: announcements/status/results ride HTTPS with the
+    internal trust anchor; plain HTTP clients cannot connect."""
+    import ssl
+    cert, key = tls_cert
+    w = WorkerServer(https_cert_path=cert, https_key_path=key)
+    threading.Thread(target=w.httpd.serve_forever, daemon=True).start()
+    try:
+        assert w.uri.startswith("https://")
+        ctx = ssl.create_default_context(cafile=cert)
+        ctx.check_hostname = False
+        info = json.load(urllib.request.urlopen(
+            f"{w.uri}/v1/info", timeout=10, context=ctx))
+        assert info["environment"] == "test"
+        # untrusting client is refused by the TLS handshake
+        with pytest.raises(urllib.error.URLError):
+            urllib.request.urlopen(
+                f"{w.uri}/v1/info", timeout=10,
+                context=ssl.create_default_context())
+    finally:
+        w.shutdown()
+
+
+def test_https_with_jwt_combined(tls_cert):
+    """TLS transport + JWT authentication together (the reference's full
+    internal-communication posture)."""
+    import ssl
+    cert, key = tls_cert
+    w = WorkerServer(https_cert_path=cert, https_key_path=key,
+                     jwt_enabled=True, jwt_secret="s")
+    threading.Thread(target=w.httpd.serve_forever, daemon=True).start()
+    try:
+        ctx = ssl.create_default_context(cafile=cert)
+        ctx.check_hostname = False
+        tok = auth.jwt_encode("s", "peer")
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(urllib.request.Request(
+                f"{w.uri}/v1/task/x.0.0.0.0/status"),
+                timeout=10, context=ctx)
+        assert e.value.code == 401
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(urllib.request.Request(
+                f"{w.uri}/v1/task/x.0.0.0.0/status",
+                headers={auth.BEARER_HEADER: tok}),
+                timeout=10, context=ctx)
+        assert e.value.code == 404          # past the filter
+    finally:
+        w.shutdown()
+
+
+def test_etc_config_maps_https_keys(tmp_path, tls_cert):
+    from presto_tpu.worker.properties import server_kwargs_from_etc
+    cert, key = tls_cert
+    etc = tmp_path / "etc"
+    etc.mkdir()
+    (etc / "config.properties").write_text(
+        f"http-server.https.enabled=true\n"
+        f"https-cert-path={cert}\n"
+        f"https-key-path={key}\n")
+    kwargs, _ = server_kwargs_from_etc(str(etc))
+    assert kwargs["https_cert_path"] == cert
+    assert kwargs["https_key_path"] == key
+
+
+def test_shutdown_endpoint_requires_auth_when_enabled():
+    """PUT /v1/info/state is state-mutating: it must sit behind the
+    internal filter, or anyone can drain a JWT-protected worker."""
+    w = WorkerServer(jwt_enabled=True, jwt_secret="s")
+    threading.Thread(target=w.httpd.serve_forever, daemon=True).start()
+    try:
+        req = urllib.request.Request(
+            f"{w.uri}/v1/info/state", data=b'"SHUTTING_DOWN"',
+            method="PUT", headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req, timeout=10)
+        assert e.value.code == 401
+        assert w.state == "ACTIVE"
+    finally:
+        w.shutdown()
