@@ -15,10 +15,32 @@ _jax.config.update("jax_enable_x64", True)
 # Opt out with PRESTO_TPU_NO_COMPILE_CACHE=1.
 import os as _os
 
+def _host_fingerprint() -> str:
+    """Short id of this host's CPU capabilities.  XLA:CPU persists AOT
+    results whose machine features must match the executing host; loading
+    an entry compiled on a different CPU can SIGILL/segfault (observed as
+    cpu_aot_loader 'machine type ... doesn't match' faults).  Scoping the
+    cache directory per host-CPU makes foreign entries invisible."""
+    import hashlib
+    import platform
+    feats = platform.machine()
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith("flags"):
+                    feats += " ".join(sorted(line.split(":", 1)[1].split()))
+                    break
+    except OSError:
+        pass
+    return hashlib.sha256(feats.encode()).hexdigest()[:12]
+
+
 if not _os.environ.get("PRESTO_TPU_NO_COMPILE_CACHE"):
-    _cache_dir = _os.environ.get(
-        "JAX_COMPILATION_CACHE_DIR",
-        _os.path.expanduser("~/.cache/presto_tpu_xla"))
+    _cache_dir = _os.environ.get("JAX_COMPILATION_CACHE_DIR")
+    if _cache_dir is None:
+        _cache_dir = _os.path.join(
+            _os.path.expanduser("~/.cache/presto_tpu_xla"),
+            _host_fingerprint())
     try:
         _os.makedirs(_cache_dir, exist_ok=True)
         _jax.config.update("jax_compilation_cache_dir", _cache_dir)

@@ -148,3 +148,28 @@ def make_splits(table: str, sf: float, splits: int,
     per = (total + splits - 1) // splits
     return [TableSplit(cid, table, sf, i * per, min((i + 1) * per, total))
             for i in range(splits) if i * per < total]
+
+
+# ---------------------------------------------------------------------------
+# bucketing metadata for grouped (lifespan) execution — the
+# ConnectorMetadata bucketing surface the reference's
+# GroupedExecutionTagger consults (see connectors/tpch.py BUCKET_COLUMNS)
+# ---------------------------------------------------------------------------
+
+def bucket_column(table: str,
+                  connector_id: Optional[str] = None) -> Optional[str]:
+    """The column this table is range-bucketed on, or None."""
+    m = _CONNECTORS.get(connector_id) if connector_id \
+        else _module_for_table(table)
+    if m is None:
+        return None
+    return getattr(m, "BUCKET_COLUMNS", {}).get(table)
+
+
+def bucket_layout(sf: float, n_buckets: int,
+                  connector_id: Optional[str] = None):
+    """Co-bucketed lifespan layout (list of TableBucket), or None when the
+    connector has no bucketing."""
+    m = _CONNECTORS.get(connector_id)
+    fn = getattr(m, "bucket_layout", None) if m is not None else None
+    return None if fn is None else fn(sf, n_buckets)

@@ -688,6 +688,60 @@ def generate_page(table: str, sf: float, start: int, count: int,
                 count)
 
 
+# ---------------------------------------------------------------------------
+# co-bucketed layout for grouped (lifespan) execution
+#
+# The reference bounds memory for huge joins by processing one bucket
+# lifespan at a time when the joined tables are bucketed on the join key
+# (Lifespan.java:30-37, GroupedExecutionTagger.java, session
+# grouped_execution — SystemSessionProperties.java:105).  This generator
+# gets the same property FOR FREE: orders.orderkey == row index + 1, and
+# lineitem rows map to orders through fixed 7-order / 28-lineitem blocks
+# (_li_order_map), so an ORDERKEY RANGE is a contiguous ROW RANGE in both
+# tables — a bucket is just a pair of row-range splits, no repartitioning
+# pass needed.  exec/grouped.py consumes this layout.
+# ---------------------------------------------------------------------------
+
+# tables co-partitioned on the "orderkey" domain, and the bucketing column
+BUCKET_COLUMNS = {"orders": "orderkey", "lineitem": "orderkey"}
+
+
+@dataclass
+class TableBucket:
+    """One lifespan: key range [key_lo, key_hi) and the contiguous row
+    range it occupies in each co-bucketed table."""
+    key_lo: int
+    key_hi: int
+    rows: Dict[str, Tuple[int, int]]
+
+
+def bucket_layout(sf: float, n_buckets: int) -> List[TableBucket]:
+    """Split the orderkey domain into up to n_buckets aligned lifespans.
+    Buckets align to 7-order blocks (the lineitem row mapping's unit); the
+    last bucket absorbs the fixed-fanout tail orders."""
+    n_orders = _table_rows("orders", sf)
+    n_lineitem = _table_rows("lineitem", sf)
+    nblocks = n_orders // 7
+    if nblocks == 0 or n_buckets <= 1:
+        return [TableBucket(1, n_orders + 1,
+                            {"orders": (0, n_orders),
+                             "lineitem": (0, n_lineitem)})]
+    bpb = max(1, -(-nblocks // n_buckets))      # ceil(nblocks / K)
+    out: List[TableBucket] = []
+    b0 = 0
+    while b0 < nblocks:
+        b1 = min(b0 + bpb, nblocks)
+        o0, o1 = b0 * 7, b1 * 7
+        l0, l1 = b0 * 28, b1 * 28
+        if b1 == nblocks:           # tail orders: 4 lineitems each
+            o1 = n_orders
+            l1 = n_lineitem
+        out.append(TableBucket(o0 + 1, o1 + 1,
+                               {"orders": (o0, o1), "lineitem": (l0, l1)}))
+        b0 = b1
+    return out
+
+
 @dataclass(frozen=True)
 class TpchSplit:
     """A row-range shard of one table (reference TpchSplitManager splits by

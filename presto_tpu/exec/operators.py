@@ -1596,6 +1596,11 @@ def sort_indices(batch: Batch, keys: List[Tuple[str, str]]):
             key = -v if desc else v
             nullv = jnp.inf
         else:
+            # Promote narrow ints to int64 so the INT64_MAX null sentinel
+            # is representable: jnp.where would otherwise wrap it to -1 in
+            # an int32/int8 key and sort NULLS_LAST rows first.
+            if v.dtype != jnp.int64:
+                v = v.astype(jnp.int64)
             key = -v if desc else v
             nullv = INT64_MAX
         if col.nulls is not None:

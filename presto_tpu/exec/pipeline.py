@@ -151,6 +151,13 @@ class ExecutionConfig:
     # codec for COMPRESSED pages (reference exchange.compression-codec /
     # PagesSerdeFactory.java:69-80): LZ4 | SNAPPY | ZSTD | GZIP | ZLIB | NONE
     exchange_compression_codec: str = "LZ4"
+    # grouped (lifespan) execution over connector co-bucketed tables
+    # (reference Lifespan.java:30-37 / GroupedExecutionTagger /
+    # session grouped_execution; exec/grouped.py): 0 = auto (engage when
+    # the anchor keyspace exceeds AUTO_SPAN_THRESHOLD — the SF100-class
+    # joins whose whole-table builds exceed HBM), 1 = off, N>=2 = force N
+    # bucket lifespans
+    grouped_lifespans: int = 0
     # intra-task driver concurrency (reference task_concurrency /
     # driver-per-split, SqlTaskExecution.java:548): leaf scans drain
     # splits on this many threads through exec/local_exchange.py, and the
@@ -565,6 +572,9 @@ class PlanCompiler:
                 "splits": splits, "cap": cap, "cached_cols": cached_cols,
                 "dicts": {name: device_gen.dictionary(cid, table, colname)
                           for name, colname, _k in dev},
+                # lineage metadata for grouped (lifespan) execution
+                "table": table, "cid": cid, "sf": sf,
+                "colmap": {name: colname for name, colname, _k in dev},
             }
         return src
 
@@ -1758,6 +1768,16 @@ class PlanCompiler:
             pool = self.ctx.memory
             fused = get_fused()
             if fused is not None:
+                grouped = fused_cache.get("grouped", False)
+                if grouped is False:
+                    from .grouped import make_grouped_runner
+                    grouped = make_grouped_runner(
+                        self, node, fused, key_names, specs, _agg_exprs,
+                        basic_specs, bool(input_exprs2), cfg)
+                    fused_cache["grouped"] = grouped
+                if grouped is not None:
+                    yield from grouped.run()
+                    return
                 out = run_fused(fused)
                 if out is not None:
                     yield out

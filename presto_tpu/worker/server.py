@@ -24,6 +24,7 @@ import json
 import re
 import threading
 import time
+import weakref
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional
 from urllib.parse import parse_qs, urlparse
@@ -477,6 +478,10 @@ class WorkerServer:
     server also hosts the embedded discovery service, like the reference
     coordinator embeds Airlift discovery (PrestoServer.java:122)."""
 
+    # every not-yet-closed server in this process (weak: a dropped server
+    # must not be kept alive by the registry)
+    _live: "weakref.WeakSet" = weakref.WeakSet()
+
     def __init__(self, port: int = 0, node_id: Optional[str] = None,
                  coordinator: bool = False,
                  discovery_uri: Optional[str] = None,
@@ -565,6 +570,8 @@ class WorkerServer:
 
         self._announcer: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        self._closed = False
+        WorkerServer._live.add(self)
         if discovery_uri:
             self._announcer = threading.Thread(
                 target=self._announce_loop,
@@ -685,9 +692,29 @@ class WorkerServer:
 
     def close(self) -> None:
         from .auth import clear_process_auth
+        if self._closed:
+            return
+        self._closed = True
         self._stop.set()
-        clear_process_auth(self.auth)
-        self._unregister_system()
-        self.task_manager.cancel_all()
-        self.httpd.shutdown()
-        self.httpd.server_close()
+        try:
+            clear_process_auth(self.auth)
+            self._unregister_system()
+            self.task_manager.cancel_all()
+        finally:
+            # the listener MUST die even if task teardown raised — a
+            # leaked serve_forever thread would outlive the sweep
+            WorkerServer._live.discard(self)
+            self.httpd.shutdown()
+            self.httpd.server_close()
+
+    @classmethod
+    def close_all_live(cls) -> None:
+        """Close every still-open server in this process.  Test harness
+        sweep (reference DistributedQueryRunner.java:108 is closeable):
+        leaked serve_forever threads from unclosed fixtures otherwise
+        accumulate across a long pytest run."""
+        for server in list(cls._live):
+            try:
+                server.close()
+            except Exception:
+                pass

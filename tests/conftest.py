@@ -18,5 +18,21 @@ if "xla_force_host_platform_device_count" not in flags:
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax  # noqa: E402
+import pytest  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _close_leaked_worker_servers():
+    """Sweep worker/coordinator HTTP servers a module leaves open.
+
+    Autouse module fixtures are set up before a module's own fixtures, so
+    this teardown runs AFTER theirs (LIFO): properly closed clusters are
+    unaffected, while leaked serve_forever threads — which accumulated
+    into the hundreds over a full run and starved later tests — are
+    closed at each module boundary (reference test pattern:
+    DistributedQueryRunner.java:108 is closeable)."""
+    yield
+    from presto_tpu.worker.server import WorkerServer
+    WorkerServer.close_all_live()

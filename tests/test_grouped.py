@@ -1,0 +1,173 @@
+"""Grouped (lifespan) execution tests (exec/grouped.py).
+
+Reference semantics: Lifespan.java:30-37, GroupedExecutionTagger.java,
+session grouped_execution (SystemSessionProperties.java:105) — a join
+stage over co-bucketed tables executes one bucket at a time, bounding
+peak memory to ~1/K of the whole-table build.
+"""
+import numpy as np
+import pytest
+
+from presto_tpu.connectors import catalog, tpch
+from presto_tpu.exec.pipeline import ExecutionConfig
+from presto_tpu.exec.runner import LocalQueryRunner, _assert_rows_equal
+
+Q3 = """
+select l_orderkey, sum(l_extendedprice * (1 - l_discount)) as revenue,
+       o_orderdate, o_shippriority
+from customer, orders, lineitem
+where c_mktsegment = 'BUILDING' and c_custkey = o_custkey
+  and l_orderkey = o_orderkey and o_orderdate < date '1995-03-15'
+  and l_shipdate > date '1995-03-15'
+group by l_orderkey, o_orderdate, o_shippriority
+order by revenue desc, o_orderdate limit 10
+"""
+
+
+# ---------------------------------------------------------------------------
+# connector bucket layout invariants
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("sf,k", [(0.01, 1), (0.01, 4), (0.01, 7),
+                                  (0.1, 16), (1.0, 13)])
+def test_bucket_layout_tiles_tables(sf, k):
+    layout = tpch.bucket_layout(sf, k)
+    assert 1 <= len(layout) <= k
+    for table in ("orders", "lineitem"):
+        pos = 0
+        for b in layout:
+            lo, hi = b.rows[table]
+            assert lo == pos
+            assert hi > lo
+            pos = hi
+        assert pos == tpch.table_row_count(table, sf)
+    # key ranges tile [1, n_orders+1)
+    pos = 1
+    for b in layout:
+        assert b.key_lo == pos
+        pos = b.key_hi
+    assert pos == tpch.table_row_count("orders", sf) + 1
+
+
+def test_bucket_rows_match_key_ranges():
+    """Every row the layout assigns to a bucket must carry an orderkey
+    inside that bucket's key range — for orders AND for the block-mapped
+    lineitem rows (incl. the fixed-fanout tail)."""
+    sf = 0.01
+    layout = tpch.bucket_layout(sf, 5)
+    for b in layout:
+        o_lo, o_hi = b.rows["orders"]
+        ok = tpch.generate_column("orders", "orderkey", sf, o_lo,
+                                  o_hi - o_lo)
+        assert ok.min() >= b.key_lo and ok.max() < b.key_hi
+        l_lo, l_hi = b.rows["lineitem"]
+        lk = tpch.generate_column("lineitem", "orderkey", sf, l_lo,
+                                  l_hi - l_lo)
+        assert lk.min() >= b.key_lo and lk.max() < b.key_hi
+
+
+def test_catalog_bucket_metadata():
+    assert catalog.bucket_column("lineitem", "tpch") == "orderkey"
+    assert catalog.bucket_column("orders", "tpch") == "orderkey"
+    assert catalog.bucket_column("customer", "tpch") is None
+    assert catalog.bucket_layout(0.01, 4, "tpch") is not None
+
+
+# ---------------------------------------------------------------------------
+# engine execution
+# ---------------------------------------------------------------------------
+
+def _spy_runs(monkeypatch):
+    from presto_tpu.exec import grouped as G
+    calls = []
+    orig = G.GroupedRunner.run
+
+    def spy(self):
+        calls.append(self)
+        return orig(self)
+    monkeypatch.setattr(G.GroupedRunner, "run", spy)
+    return calls
+
+
+def test_q3_grouped_parity(monkeypatch):
+    calls = _spy_runs(monkeypatch)
+    r = LocalQueryRunner("sf0.01",
+                         config=ExecutionConfig(grouped_lifespans=4))
+    oracle = LocalQueryRunner("sf0.01")
+    got = r.execute(Q3)
+    exp = oracle.execute_reference(Q3)
+    _assert_rows_equal(got, exp, True)
+    assert len(calls) == 1 and len(calls[0].layout) == 4
+    # warm re-execution reuses the runner (no recompile) and stays correct
+    got2 = r.execute(Q3)
+    _assert_rows_equal(got2, exp, True)
+
+
+def test_q18_shape_grouped_parity(monkeypatch):
+    calls = _spy_runs(monkeypatch)
+    sql = """
+    select l_orderkey, o_orderdate, o_totalprice, sum(l_quantity) q
+    from lineitem join orders on l_orderkey = o_orderkey
+    group by l_orderkey, o_orderdate, o_totalprice
+    having sum(l_quantity) > 150
+    order by o_totalprice desc, o_orderdate limit 20
+    """
+    r = LocalQueryRunner("sf0.01",
+                         config=ExecutionConfig(grouped_lifespans=3))
+    oracle = LocalQueryRunner("sf0.01")
+    _assert_rows_equal(r.execute(sql), oracle.execute_reference(sql), True)
+    assert calls
+
+
+def test_dependency_violation_falls_back_to_sort(monkeypatch):
+    """A grouping key NOT functionally dependent on the anchor (l_partkey
+    varies within an orderkey) must flip the runner to per-bucket
+    sort-grouping and stay correct."""
+    calls = _spy_runs(monkeypatch)
+    sql = ("select l_orderkey, l_partkey, sum(l_quantity) "
+           "from lineitem group by l_orderkey, l_partkey")
+    r = LocalQueryRunner("sf0.01",
+                         config=ExecutionConfig(grouped_lifespans=3))
+    oracle = LocalQueryRunner("sf0.01")
+    _assert_rows_equal(r.execute(sql), oracle.execute_reference(sql), False)
+    assert calls and calls[0]._use_sortagg
+
+
+def test_auto_mode_stays_off_at_small_scale(monkeypatch):
+    """grouped_lifespans=0 (auto) must not engage below the span
+    threshold — sf0.01's 15k-order keyspace is far under it."""
+    calls = _spy_runs(monkeypatch)
+    r = LocalQueryRunner("sf0.01", config=ExecutionConfig())
+    assert r.config.grouped_lifespans == 0
+    oracle = LocalQueryRunner("sf0.01")
+    _assert_rows_equal(r.execute(Q3), oracle.execute_reference(Q3), True)
+    assert not calls
+
+
+def test_partial_split_coverage_not_grouped():
+    """A task owning a split subset (distributed stage) must not re-bucket
+    it (exec/grouped.py _full_coverage)."""
+    from presto_tpu.exec.grouped import _full_coverage
+    full = catalog.make_splits("lineitem", 0.01, 4, "tpch")
+    assert _full_coverage(full, "lineitem", 0.01, "tpch")
+    assert not _full_coverage(full[:2], "lineitem", 0.01, "tpch")
+    assert not _full_coverage(full[1:], "lineitem", 0.01, "tpch")
+
+
+def test_grouped_peak_build_rows_bounded(monkeypatch):
+    """The point of lifespans: no single bucketed build materialization
+    covers more than ~1/K of the build table."""
+    from presto_tpu.exec import grouped as G
+    seen = []
+    orig = G.GroupedRunner._bucket_aux
+
+    def spy(self, bucket):
+        o_lo, o_hi = bucket.rows["orders"]
+        seen.append(o_hi - o_lo)
+        return orig(self, bucket)
+    monkeypatch.setattr(G.GroupedRunner, "_bucket_aux", spy)
+    r = LocalQueryRunner("sf0.01",
+                         config=ExecutionConfig(grouped_lifespans=4))
+    r.execute(Q3)
+    total = tpch.table_row_count("orders", 0.01)
+    assert seen and max(seen) <= -(-total // 4) + 7

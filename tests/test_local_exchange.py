@@ -1,6 +1,7 @@
 """Intra-task local exchange + driver concurrency (VERDICT r3 next #6;
 reference LocalExchange.java:62, task_concurrency /
 SqlTaskExecution.java:548 driver-per-split)."""
+import threading
 import time
 
 import jax.numpy as jnp
@@ -75,21 +76,32 @@ def test_broadcast_replicates():
 
 
 def test_parallel_drain_overlaps_sources():
+    # Overlap is asserted structurally (peak simultaneous active sources
+    # observed from inside the iterators), not via wall-clock
+    # inequalities, which flaked under full-suite load.
+    active = [0]
+    peak = [0]
+    lock = threading.Lock()
+
     def slow(n):
         def it():
-            for i in range(3):
-                time.sleep(0.05)
-                yield (n, i)
+            with lock:
+                active[0] += 1
+                peak[0] = max(peak[0], active[0])
+            try:
+                for i in range(3):
+                    time.sleep(0.05)
+                    yield (n, i)
+            finally:
+                with lock:
+                    active[0] -= 1
         return it
     stats = {}
-    t0 = time.perf_counter()
     got = list(parallel_drain([slow(a) for a in range(4)], 4, stats))
-    wall = time.perf_counter() - t0
     assert sorted(got) == sorted((a, i) for a in range(4) for i in range(3))
-    # 4 sources x 0.15s of sleep: concurrent wall must beat the serial sum
-    assert wall < 0.45
+    assert peak[0] > 1                         # sources genuinely overlapped
     assert len(stats["driver_walls"]) == 4
-    assert sum(stats["driver_walls"]) > wall   # measured overlap
+    assert all(w > 0 for w in stats["driver_walls"])
 
 
 def test_parallel_drain_propagates_errors():

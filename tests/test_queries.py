@@ -683,6 +683,30 @@ def test_rollup_having_and_order(runner):
         order by c desc limit 5""", ordered=True)
 
 
+def test_sort_narrow_int_nulls_last():
+    """Regression (round-5 / q14_1): a narrow-int (int32) nullable sort
+    key must honor NULLS LAST — the INT64_MAX null sentinel used to wrap
+    to -1 when jnp.where cast it into the int32 key, so rollup-NULL rows
+    sorted FIRST under ASC (Presto default is NULLS LAST, ORDER BY docs /
+    TopNOperator.java:32)."""
+    import jax.numpy as jnp
+
+    from presto_tpu.exec import operators as ops
+    from presto_tpu.exec.operators import Batch, Column
+
+    vals = jnp.asarray([5, 3, 0, 8], dtype=jnp.int32)   # 0 is a null row
+    nulls = jnp.asarray([False, False, True, False])
+    b = Batch({"k": Column(vals, nulls)}, jnp.ones(4, dtype=bool))
+    out = ops.topn(b, [("k", "ASC_NULLS_LAST")], 4)
+    got = [(int(v), bool(n)) for v, n in
+           zip(out.columns["k"].values, out.columns["k"].null_mask())]
+    assert got == [(3, False), (5, False), (8, False), (0, True)]
+    out = ops.topn(b, [("k", "DESC_NULLS_FIRST")], 4)
+    got = [(int(v), bool(n)) for v, n in
+           zip(out.columns["k"].values, out.columns["k"].null_mask())]
+    assert got == [(0, True), (8, False), (5, False), (3, False)]
+
+
 # ---------------------------------------------------------------------------
 # RIGHT / FULL OUTER joins
 # ---------------------------------------------------------------------------

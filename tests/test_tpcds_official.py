@@ -10,14 +10,11 @@ Each query runs on the engine and on the numpy oracle
 row sets must match (the H2-differential strategy of
 QueryAssertions.java:52 / presto-native-tests).
 
-DEFAULT_BANK lists the faster half of the passing corpus (~6 min on the
-CPU backend); PRESTO_TPU_TPCDS_FULL=1 additionally runs every other
-query validated by the round-4 sweep (102 of 103 files pass; the one
-known gap is q14_1, where the PRE-LIMIT result multiset matches the
-oracle exactly (725 rows) but the engine's ORDER BY + LIMIT 100 cut
-places rollup-NULL key rows first instead of NULLS LAST — an ordering
-defect confined to that query's final TopN; minimal
-union+rollup+order+limit shapes sort correctly).
+ALL 103 official query files run by default (103/103 pass since round 5
+fixed the narrow-int NULLS_LAST sort sentinel — see
+tests/test_queries.py::test_sort_narrow_int_nulls_last).  Set
+PRESTO_TPU_TPCDS_FAST=1 to run only the fast half (~5 min) during local
+iteration.
 """
 import os
 
@@ -41,19 +38,19 @@ DEFAULT_BANK = [
     "q68", "q73", "q76", "q79", "q82", "q83", "q86", "q89", "q92", "q93",
 ]
 
-# the rest of the sweep-validated corpus (slower: big CTE unions, rollups,
-# windowed rank queries) — run with PRESTO_TPU_TPCDS_FULL=1
+# the rest of the corpus (slower: big CTE unions, rollups, windowed rank
+# queries)
 FULL_BANK = [
-    "q02", "q04", "q05", "q07", "q09", "q10", "q11", "q14_2", "q16",
-    "q18", "q22", "q23_1", "q23_2", "q26", "q27", "q28", "q30", "q31",
-    "q33", "q35", "q39_2", "q47", "q49", "q57", "q58", "q59", "q60",
-    "q64", "q65", "q66", "q67", "q69", "q70", "q71", "q72", "q74", "q75",
-    "q77", "q78", "q80", "q81", "q84", "q85", "q87", "q88", "q91", "q94",
-    "q95", "q96", "q97", "q98", "q99", "q41", "q90",
+    "q02", "q04", "q05", "q07", "q09", "q10", "q11", "q14_1", "q14_2",
+    "q16", "q18", "q22", "q23_1", "q23_2", "q26", "q27", "q28", "q30",
+    "q31", "q33", "q35", "q39_2", "q47", "q49", "q57", "q58", "q59",
+    "q60", "q64", "q65", "q66", "q67", "q69", "q70", "q71", "q72", "q74",
+    "q75", "q77", "q78", "q80", "q81", "q84", "q85", "q87", "q88", "q91",
+    "q94", "q95", "q96", "q97", "q98", "q99", "q41", "q90",
 ]
 
-_FULL = os.environ.get("PRESTO_TPU_TPCDS_FULL") == "1"
-BANK = DEFAULT_BANK + (FULL_BANK if _FULL else [])
+_FAST = os.environ.get("PRESTO_TPU_TPCDS_FAST") == "1"
+BANK = DEFAULT_BANK + ([] if _FAST else FULL_BANK)
 
 
 @pytest.fixture(scope="module")
