@@ -18,8 +18,8 @@ import numpy as np
 
 from .types import (
     BYTE_ARRAY, SHORT_ARRAY, INT_ARRAY, LONG_ARRAY, INT128_ARRAY,
-    VARIABLE_WIDTH, ARRAY, MAP, ROW, Type, DateType, DecimalType, DoubleType,
-    RealType, BooleanType, VarcharType, CharType, VarbinaryType,
+    VARIABLE_WIDTH, ARRAY, MAP, ROW, ArrayType, Type, DateType, DecimalType,
+    DoubleType, RealType, BooleanType, VarcharType, CharType, VarbinaryType,
 )
 
 _WIDTH_TO_ENCODING = {1: BYTE_ARRAY, 2: SHORT_ARRAY, 4: INT_ARRAY, 8: LONG_ARRAY}
@@ -421,6 +421,15 @@ def block_from_values(typ: Type, values: Sequence) -> Block:
         return VariableWidthBlock.from_bytes(values)
     if isinstance(typ, DecimalType) and not typ.is_short:
         return Int128Block.from_ints(values, nulls if has_null else None)
+    if isinstance(typ, ArrayType):
+        offsets = np.zeros(n + 1, dtype=np.int32)
+        flat: list = []
+        for i, v in enumerate(values):
+            if v is not None:
+                flat.extend(v)
+            offsets[i + 1] = len(flat)
+        return ArrayBlock(offsets, block_from_values(typ.element, flat),
+                          nulls if has_null else None)
 
     if isinstance(typ, DoubleType):
         dtype = np.float64
@@ -443,6 +452,15 @@ def block_from_values(typ: Type, values: Sequence) -> Block:
 def block_to_values(typ: Type, block: Block) -> list:
     """Decode a block to python values under `typ` semantics."""
     block = decode_to_flat(block)
+    if isinstance(typ, ArrayType) and isinstance(block, ArrayBlock):
+        elems = block_to_values(typ.element, block.elements)
+        out = []
+        for i in range(block.position_count):
+            if block.nulls is not None and block.nulls[i]:
+                out.append(None)
+            else:
+                out.append(elems[block.offsets[i]:block.offsets[i + 1]])
+        return out
     if isinstance(typ, (VarcharType, CharType)):
         return block.to_pylist()
     if isinstance(typ, VarbinaryType):

@@ -35,41 +35,59 @@ from ..common.types import (BooleanType, DateType, DecimalType, DoubleType,
 class Column:
     def __init__(self, values, nulls=None,
                  dictionary: Optional[Tuple[str, ...]] = None,
-                 lazy: Optional[Tuple] = None):
+                 lazy: Optional[Tuple] = None, lengths=None):
         self.values = values
         self.nulls = nulls
         self.dictionary = dictionary
         # late materialization: ("tpch", table, column, sf) — `values` are
         # global row indices; strings realized at output boundaries
         self.lazy = lazy
+        # ARRAY columns: values has shape (capacity, W) — W the static
+        # per-column element capacity — and `lengths` (capacity,) holds
+        # each row's live element count.  Fixed-width padding instead of
+        # offsets keeps shapes static for XLA (the ragged ArrayBlock form
+        # exists only at host/page boundaries; reference Block model:
+        # presto-common/.../block/ArrayBlock)
+        self.lengths = lengths
 
     def tree_flatten(self):
-        if self.nulls is None:
-            return (self.values,), ("no_nulls", self.dictionary, self.lazy)
-        return (self.values, self.nulls), ("nulls", self.dictionary, self.lazy)
+        tag = ("nulls" if self.nulls is not None else "no_nulls",
+               "len" if self.lengths is not None else "no_len")
+        children = (self.values,)
+        if self.nulls is not None:
+            children += (self.nulls,)
+        if self.lengths is not None:
+            children += (self.lengths,)
+        return children, (tag, self.dictionary, self.lazy)
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        tag, dictionary, lazy = aux
-        if tag == "no_nulls":
-            return cls(children[0], None, dictionary, lazy)
-        return cls(children[0], children[1], dictionary, lazy)
+        (ntag, ltag), dictionary, lazy = aux
+        i = 1
+        nulls = None
+        if ntag == "nulls":
+            nulls = children[i]
+            i += 1
+        lengths = children[i] if ltag == "len" else None
+        return cls(children[0], nulls, dictionary, lazy, lengths)
 
     def null_mask(self):
         if self.nulls is None:
-            return jnp.zeros(self.values.shape, dtype=bool)
+            return jnp.zeros(self.values.shape[:1], dtype=bool)
         return self.nulls
 
     def gather(self, idx) -> "Column":
         """Row gather preserving dictionary/lazy metadata."""
         return Column(self.values[idx],
                       None if self.nulls is None else self.nulls[idx],
-                      self.dictionary, self.lazy)
+                      self.dictionary, self.lazy,
+                      None if self.lengths is None else self.lengths[idx])
 
     def slice_rows(self, lo, hi) -> "Column":
         return Column(self.values[lo:hi],
                       None if self.nulls is None else self.nulls[lo:hi],
-                      self.dictionary, self.lazy)
+                      self.dictionary, self.lazy,
+                      None if self.lengths is None else self.lengths[lo:hi])
 
     def __repr__(self):
         d = f", dict[{len(self.dictionary)}]" if self.dictionary else ""
@@ -183,6 +201,32 @@ def block_to_column(typ: Type, block, capacity: int) -> Column:
         nulls = jnp.asarray(nm) if nm.any() else None
         return Column(jnp.asarray(vals), nulls)
 
+    from ..common.block import ArrayBlock
+    if isinstance(block, ArrayBlock):
+        # ragged ArrayBlock -> fixed-width (capacity, W) element matrix
+        from ..common.types import ArrayType
+        etyp = typ.element if isinstance(typ, ArrayType) else typ
+        inner = decode_to_flat(block.elements)
+        if not isinstance(inner, FixedWidthBlock):
+            raise NotImplementedError("nested/varchar array elements")
+        flat = _logical_np(etyp, inner.values)
+        offs = block.offsets.astype(np.int64)
+        lens = offs[1:] - offs[:-1]
+        W = max(1, 1 << int(max(1, lens.max(initial=1)) - 1).bit_length())
+        mat = np.zeros((capacity, W), dtype=flat.dtype)
+        nrows = len(lens)
+        live = np.arange(W)[None, :] < lens[:, None]
+        base = int(offs[0])                 # offsets are contiguous
+        mat[:nrows][live] = flat[base:base + int(lens.sum())]
+        lenbuf = np.zeros(capacity, dtype=np.int32)
+        lenbuf[:len(lens)] = lens
+        nulls = None
+        if block.nulls is not None:
+            nm = np.zeros(capacity, dtype=bool)
+            nm[:block.position_count] = block.nulls
+            nulls = jnp.asarray(nm)
+        return Column(jnp.asarray(mat), nulls, None, None,
+                      jnp.asarray(lenbuf))
     if not isinstance(block, FixedWidthBlock):
         raise NotImplementedError(
             f"device column from {type(block).__name__} not supported yet")
@@ -196,6 +240,20 @@ def block_to_column(typ: Type, block, capacity: int) -> Column:
         nm[:block.position_count] = block.nulls
         nulls = jnp.asarray(nm)
     return Column(jnp.asarray(padded), nulls)
+
+
+def _element_block(etyp: Type, flat: np.ndarray) -> FixedWidthBlock:
+    """Flat array-element values -> a storage-dtype FixedWidthBlock (the
+    same logical->storage rules as scalar columns in batch_to_page)."""
+    if isinstance(etyp, BooleanType):
+        flat = flat.astype(np.int8)
+    elif isinstance(etyp, (DoubleType, RealType)):
+        pass                        # float bits pass through
+    elif flat.dtype not in (np.int8, np.int16, np.int32, np.int64):
+        flat = flat.astype(etyp.np_dtype)
+    if isinstance(etyp, (IntegerType, DateType)):
+        flat = flat.astype(np.int32)
+    return FixedWidthBlock(flat)
 
 
 def page_to_batch(page: Page, names, types, capacity: int) -> Batch:
@@ -229,6 +287,8 @@ def batch_to_page(batch: Batch, names, types) -> Page:
             fetch["v." + name] = col.values
             if col.nulls is not None:
                 fetch["n." + name] = col.nulls
+            if col.lengths is not None:
+                fetch["l." + name] = col.lengths
         return fetch
 
     combined = batch.capacity <= (1 << 16)
@@ -287,6 +347,26 @@ def batch_to_page(batch: Batch, names, types) -> Page:
                 entries.append(None)
             dict_block = VB.from_strings(entries)
             blocks.append(HB(ids, dict_block))
+            continue
+        if col.lengths is not None:
+            # ARRAY column: (rows, W) padded element matrix + live lengths
+            # -> ragged ArrayBlock (offsets into a flat element block)
+            from ..common.block import ArrayBlock
+            from ..common.types import ArrayType
+            lens = host["l." + name][keep].astype(np.int64)
+            W = values.shape[1] if values.ndim > 1 else 0
+            lens = np.clip(lens, 0, W)
+            if nulls is not None:
+                lens = np.where(nulls, 0, lens)
+            elem2d = values.reshape(len(keep), W) if W else \
+                values.reshape(len(keep), 0)
+            live = np.arange(W)[None, :] < lens[:, None]
+            flat = elem2d[live]
+            offsets = np.zeros(len(keep) + 1, dtype=np.int32)
+            np.cumsum(lens, out=offsets[1:])
+            etyp = typ.element if isinstance(typ, ArrayType) else typ
+            blocks.append(ArrayBlock(offsets,
+                                     _element_block(etyp, flat), nulls))
             continue
         if isinstance(typ, (VarcharType, CharType)):
             raise NotImplementedError("varchar column without dictionary")

@@ -200,19 +200,30 @@ class FusedChain:
         return max(1 << 12, self.cap // kprod)
 
     # -- runtime: materialize build sides ---------------------------------
-    def prep(self) -> Optional[Tuple[tuple, Tuple[int, ...]]]:
+    def prep(self, defer: Optional[Callable] = None
+             ) -> Optional[Tuple[tuple, Tuple[int, ...], List[tuple]]]:
         """Materialize every build side and construct lookup tables.
-        Returns (aux, expands), or None when a join's fanout exceeds the
-        expansion limits (caller falls back to the streaming executor)."""
+        Returns (aux, expands, deferred), or None when a join's fanout
+        exceeds the expansion limits (caller falls back to the streaming
+        executor).  defer(step_index, JoinNode) -> True reserves the
+        join's aux slot instead of building it (grouped execution fills
+        those slots per bucket lifespan); deferred lists
+        (aux_index, step_index, JoinNode)."""
         # aux[0] carries the scan's HBM-cached whole-table columns as a
         # traced argument pytree (closure constants of this size would be
         # inlined as XLA literals); join/semi lookup tables follow
         aux: List = [self.scan_meta.get("cached_cols", {})]
         expands: List[int] = []
-        for step in self.steps:
+        deferred: List[tuple] = []
+        for si, step in enumerate(self.steps):
             kind = step[0]
             if kind == "join":
                 node = step[1]
+                if defer is not None and defer(si, node):
+                    aux.append(None)
+                    deferred.append((len(aux) - 1, si, node))
+                    expands.append(1)
+                    continue
                 res = self._build_for(
                     node.right, tuple(r.name for _l, r in node.criteria),
                     for_join=True)
@@ -236,7 +247,7 @@ class FusedChain:
             kprod *= k
         if kprod > MAX_EXPAND_PRODUCT:
             return None
-        return tuple(aux), tuple(expands)
+        return tuple(aux), tuple(expands), deferred
 
     def _build_for(self, build_node: P.PlanNode, keys: Tuple[str, ...],
                    for_join: bool):
@@ -552,7 +563,7 @@ def fused_materialize(compiler, node: P.PlanNode,
         return None
     if prep_res is None:
         return None
-    aux, expands = prep_res
+    aux, expands, _deferred = prep_res
     leaf_cap = chain.leaf_cap(expands)
     chunks = chain.chunks_for(expands)
     S = len(chunks)
@@ -631,7 +642,7 @@ def fused_stream(compiler, node: P.PlanNode):
         if prep_res is None:
             compiler._jit_cache[key] = None
             return None
-        aux, expands = prep_res
+        aux, expands, _deferred = prep_res
         leaf_cap = chain.leaf_cap(expands)
         chunks = chain.chunks_for(expands)
         try:

@@ -129,13 +129,18 @@ class GroupedRunner:
         self.leaf_cap = chain.leaf_cap(expands)
         self._progs: Dict[tuple, callable] = {}
         self._sort_progs: Dict[int, callable] = {}
-        self._fin = None
         # bucket-0 (aux, dup flags) built during eligibility; consumed by
         # the first run() so the build work is not repeated
         self._aux0 = None
         # per-bucket aggregation falls back to sort-grouping for every
-        # remaining bucket once one bucket's dependency check fails
-        self._use_sortagg = False
+        # remaining bucket once one bucket's dependency check fails; a
+        # fanout-expanding join breaks the stream's anchor clustering, and
+        # min/max need segmented scans the stream path doesn't do, so
+        # those start on the sort path directly
+        self._use_sortagg = (any(k != 1 for k in expands)
+                             or any(s.name not in ("sum", "avg", "count",
+                                                   "count_star")
+                                    for s in specs))
 
     # -- per-bucket pieces -------------------------------------------------
 
@@ -196,54 +201,37 @@ class GroupedRunner:
         return tuple(aux), dups
 
     def _get_prog(self, S: int):
+        """Streaming pre-grouped aggregation over the bucket's stacked
+        chain output: within a lifespan the probe stream is clustered by
+        the anchor key (the co-bucket layout maps key ranges to contiguous
+        row ranges), so segments replace both the scatter table and the
+        sort (operators.stream_group_aggregate)."""
         prog = self._progs.get(S)
         if prog is None:
             chain, expands, leaf_cap = self.chain, self.expands, self.leaf_cap
-            anchor, dep_names, G = self.anchor, self.dep_names, self.G
-            specs, agg_exprs = self.specs, self.agg_exprs_fn
+            anchor, dep_names = self.anchor, self.dep_names
+            key_names, specs = self.key_names, self.specs
+            agg_exprs = self.agg_exprs_fn
 
             @jax.jit
-            def prog(pos_arr, cnt_arr, state, aux, base):
-                def body(i, st):
-                    b = chain.make(pos_arr[i], cnt_arr[i], aux, expands,
-                                   leaf_cap)
-                    codes = b.columns[anchor].values.astype(jnp.int64) - base
-                    st = ops.agg_span_update(st, b, codes, agg_exprs(b),
-                                             specs, G)
-                    if dep_names:
-                        st = ops.depkey_update(
-                            st, b, codes,
-                            {k: b.columns[k] for k in dep_names}, G)
-                    return st
-                state = jax.lax.fori_loop(0, S, body, state)
-                dep_ok = (ops.depkey_verify(state, state["__seen"],
-                                            dep_names)
-                          if dep_names else jnp.ones((), dtype=bool))
-                live = jnp.sum(state["__seen"] > 0)
-                return state, dep_ok, live
+            def prog(pos_arr, cnt_arr, aux):
+                def step(pc):
+                    b = chain.make(pc[0], pc[1], aux, expands, leaf_cap)
+                    cols = {k: b.columns[k] for k in key_names}
+                    for out, col in agg_exprs(b).items():
+                        if col is not None:
+                            cols["$in_" + out] = col
+                    return Batch(cols, b.mask)
+                stacked = jax.lax.map(step, (pos_arr, cnt_arr))
+                flat = jax.tree_util.tree_map(
+                    lambda a: a.reshape((-1,) + a.shape[2:]), stacked)
+                inputs = {s.output: flat.columns.get("$in_" + s.output)
+                          for s in specs}
+                return ops.stream_group_aggregate(
+                    Batch({k: flat.columns[k] for k in key_names},
+                          flat.mask), anchor, dep_names, inputs, specs)
             self._progs[S] = prog
         return prog
-
-    def _get_fin(self):
-        if self._fin is None:
-            anchor, dep_names, G = self.anchor, self.dep_names, self.G
-            specs, key_names = self.specs, self.key_names
-            key_dtypes, key_dicts = self.key_dtypes, self.key_dicts
-
-            @jax.jit
-            def fin(state, base):
-                key_arrays = {anchor: (base + jnp.arange(G, dtype=jnp.int64))
-                              .astype(key_dtypes[anchor])}
-                key_nulls = {}
-                for k in dep_names:
-                    key_arrays[k] = ops._depkey_restore(
-                        state[f"__dep_{k}$min"], key_dtypes[k])
-                    key_nulls[k] = state[f"__dep_{k}$nulls"] > 0
-                return ops.agg_span_finalize(state, specs, key_names,
-                                             key_arrays, key_dicts,
-                                             None, key_nulls)
-            self._fin = fin
-        return self._fin
 
     def _get_sort_prog(self, S: int):
         prog = self._sort_progs.get(S)
@@ -300,23 +288,18 @@ class GroupedRunner:
                 aux, dups = self._bucket_aux(bucket)
             pos_arr = jnp.asarray([c[0] for c in chunks], dtype=jnp.int64)
             cnt_arr = jnp.asarray([c[1] for c in chunks], dtype=jnp.int64)
-            base = jnp.int64(bucket.key_lo)
             if not self._use_sortagg:
-                init = dict(ops.agg_span_init(self.G, self.specs))
-                if self.dep_names:
-                    init.update(ops.depkey_init(self.G, self.dep_names))
-                state, dep_ok, live = self._get_prog(len(chunks))(
-                    pos_arr, cnt_arr, init, aux, base)
+                out, dep_ok, live = self._get_prog(len(chunks))(
+                    pos_arr, cnt_arr, aux)
                 dep_ok, live = jax.device_get((dep_ok, live))
                 self._check_dups(dups)
                 if bool(dep_ok):
-                    out = self._get_fin()(state, base)
                     cap = _bucket_for(int(live))
                     if cap is not None and cap * 4 <= out.capacity:
                         out = _jit_compact(out, cap)
                     yield out
                     continue
-                # a grouping key varied within an anchor group: this and
+                # a grouping key varied within an anchor run: this and
                 # every later bucket take the per-bucket sort path
                 self._use_sortagg = True
             self._check_dups(dups)
@@ -410,52 +393,29 @@ def make_grouped_runner(compiler, node, chain, key_names, specs,
         return None
     G = 1 << (max_span - 1).bit_length()
 
-    # shared (bucket-invariant) builds once; bucketed builds are deferred
-    from .fused import MAX_EXPAND_PRODUCT, assemble_chain, build_lookup
-    shared_aux: List = [meta.get("cached_cols", {})]
-    expands: List[int] = []
-    per_bucket_builds: List[tuple] = []
+    # shared (bucket-invariant) builds once; bucketed builds defer to the
+    # per-bucket lifespan (FusedChain.prep owns the aux-slot layout).  A
+    # bucketed build must materialize through the fused path — its chunk
+    # layout re-derives from the per-bucket split override — so
+    # non-fusible bucketed builds are replicated instead.
+    from .fused import assemble_chain
+
+    def _defer(si, jn):
+        return (si in bucketed_joins
+                and assemble_chain(compiler, jn.right) is not None)
+
     try:
-        for si, step in enumerate(chain.steps):
-            kind = step[0]
-            if kind == "join":
-                jn = step[1]
-                # a bucketed build must materialize through the fused path
-                # (its chunk layout re-derives from the per-bucket split
-                # override); non-fusible builds are replicated instead
-                if si in bucketed_joins \
-                        and assemble_chain(compiler, jn.right) is not None:
-                    jn2, scan_node, btable, bkey_var = bucketed_joins[si]
-                    shared_aux.append(None)
-                    per_bucket_builds.append(
-                        (len(shared_aux) - 1, jn, scan_node, btable,
-                         bkey_var))
-                    expands.append(1)
-                else:
-                    res = build_lookup(
-                        compiler, jn.right,
-                        tuple(r.name for _l, r in jn.criteria),
-                        for_join=True)
-                    if res is None:
-                        return None
-                    tbl, k, _ = res
-                    shared_aux.append(tbl)
-                    expands.append(k)
-            elif kind == "semi":
-                sn = step[1]
-                fkey = sn.filtering_source_join_variable.name
-                tbl, _k, had_null = build_lookup(
-                    compiler, sn.filtering_source, (fkey,), for_join=False)
-                shared_aux.append((tbl, jnp.asarray(had_null)))
-                expands.append(1)
+        prep_res = chain.prep(defer=_defer)
     except NotImplementedError:
         return None
-    kprod = 1
-    for k in expands:
-        kprod *= k
-    if kprod > MAX_EXPAND_PRODUCT:
+    if prep_res is None:
         return None
-    expands = tuple(expands)
+    shared_aux, expands, deferred = prep_res
+    shared_aux = list(shared_aux)
+    per_bucket_builds = [
+        (ai, jn, bucketed_joins[si][1], bucketed_joins[si][2],
+         bucketed_joins[si][3])
+        for ai, si, jn in deferred]
 
     runner = GroupedRunner(compiler, chain, layout, anchor,
                            tuple(k for k in key_names if k != anchor),

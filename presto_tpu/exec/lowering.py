@@ -317,7 +317,122 @@ class Lowering:
         if name in ("date_trunc", "date_add", "date_diff", "day_of_week",
                     "day_of_year", "week"):
             return self._date_fn(name, expr, batch)
+        if name in ("array_constructor", "subscript", "element_at",
+                    "cardinality", "contains", "array_max", "array_min",
+                    "array_position", "repeat", "sequence"):
+            return self._array_fn(name, expr, batch)
         raise NotImplementedError(f"scalar function {expr.display_name!r}")
+
+    # -- array functions (fixed-width (capacity, W) representation) --------
+    def _array_fn(self, name: str, expr: CallExpression,
+                  batch: Batch) -> Column:
+        """Array kernels over the padded (capacity, W) element matrix
+        (reference ArrayFunctions.java / ArraySubscriptOperator.java;
+        element NULLs inside arrays are not represented yet — Presto's
+        out-of-bounds subscript ERROR is relaxed to NULL, element_at
+        semantics)."""
+        args = expr.arguments
+        if name == "array_constructor":
+            cols = [self.eval(a, batch) for a in args]
+            if not cols:
+                return Column(jnp.zeros((batch.capacity, 0),
+                                        dtype=jnp.int64),
+                              None, None, None,
+                              jnp.zeros(batch.capacity, dtype=jnp.int32))
+            if any(c.dictionary is not None or c.lazy is not None
+                   or c.lengths is not None for c in cols):
+                raise NotImplementedError(
+                    "array elements must be scalar numerics")
+            if any(c.nulls is not None for c in cols):
+                raise NotImplementedError(
+                    "NULL array elements not supported")
+            dt = jnp.result_type(*[c.values.dtype for c in cols])
+            vals = jnp.stack([c.values.astype(dt) for c in cols], axis=1)
+            lengths = jnp.full(batch.capacity, len(cols), dtype=jnp.int32)
+            return Column(vals, None, None, None, lengths)
+        arr = self.eval(args[0], batch)
+        if name == "repeat":
+            elem = arr      # repeat(x, n): x is scalar, n constant
+            if not isinstance(args[1], ConstantExpression):
+                raise NotImplementedError("repeat with non-constant count")
+            n = int(args[1].value)
+            vals = jnp.tile(elem.values[:, None], (1, max(n, 1)))
+            if n == 0:
+                vals = vals[:, :0]
+            return Column(vals, elem.nulls, None, None,
+                          jnp.full(batch.capacity, n, dtype=jnp.int32))
+        if name == "sequence":
+            if not all(isinstance(a, ConstantExpression) for a in args):
+                raise NotImplementedError(
+                    "sequence with non-constant bounds")
+            lo, hi = int(args[0].value), int(args[1].value)
+            step = int(args[2].value) if len(args) > 2 else 1
+            seq = jnp.arange(lo, hi + (1 if step > 0 else -1), step,
+                             dtype=jnp.int64)
+            vals = jnp.tile(seq[None, :], (batch.capacity, 1))
+            return Column(vals, None, None, None,
+                          jnp.full(batch.capacity, seq.shape[0],
+                                   dtype=jnp.int32))
+        if arr.lengths is None:
+            raise NotImplementedError(f"{name} on non-array input")
+        W = arr.values.shape[1]
+        lens = arr.lengths
+        if name == "cardinality":
+            return Column(lens.astype(jnp.int64), arr.nulls)
+        if name in ("subscript", "element_at"):
+            idx = self.eval(args[1], batch)
+            raw = idx.values.astype(jnp.int64)
+            if name == "element_at":
+                # element_at(-n) indexes from the end (ArrayFunctions.java)
+                raw = jnp.where(raw < 0, lens.astype(jnp.int64) + raw + 1,
+                                raw)
+            i0 = raw - 1                                   # 1-based
+            oob = (i0 < 0) | (i0 >= lens.astype(jnp.int64))
+            safe = jnp.clip(i0, 0, max(W - 1, 0))
+            if W == 0:
+                out = jnp.zeros(batch.capacity, dtype=arr.values.dtype)
+            else:
+                out = jnp.take_along_axis(
+                    arr.values, safe[:, None], axis=1)[:, 0]
+            nulls = oob | arr.null_mask()
+            if idx.nulls is not None:
+                nulls = nulls | idx.nulls
+            return Column(out, nulls)
+        live = jnp.arange(W, dtype=jnp.int32)[None, :] \
+            < lens[:, None]                                 # (cap, W)
+        if name == "contains":
+            x = self.eval(args[1], batch)
+            hit = jnp.any(live & (arr.values == x.values[:, None]), axis=1)
+            nulls = arr.nulls
+            if x.nulls is not None:
+                nulls = x.nulls if nulls is None else nulls | x.nulls
+            return Column(hit, nulls)
+        if name in ("array_max", "array_min"):
+            big = jnp.asarray(
+                jnp.inf if jnp.issubdtype(arr.values.dtype, jnp.floating)
+                else jnp.iinfo(arr.values.dtype).max, arr.values.dtype)
+            ident = big if name == "array_min" else (
+                -big if jnp.issubdtype(arr.values.dtype, jnp.floating)
+                else jnp.asarray(jnp.iinfo(arr.values.dtype).min,
+                                 arr.values.dtype))
+            masked = jnp.where(live, arr.values, ident)
+            red = jnp.min if name == "array_min" else jnp.max
+            out = red(masked, axis=1) if W else \
+                jnp.zeros(batch.capacity, dtype=arr.values.dtype)
+            empty = lens == 0
+            nulls = empty | arr.null_mask()
+            return Column(out, nulls)
+        if name == "array_position":
+            x = self.eval(args[1], batch)
+            eq = live & (arr.values == x.values[:, None])
+            first = jnp.argmax(eq, axis=1)
+            found = jnp.any(eq, axis=1)
+            out = jnp.where(found, first + 1, 0).astype(jnp.int64)
+            nulls = arr.nulls
+            if x.nulls is not None:
+                nulls = x.nulls if nulls is None else nulls | x.nulls
+            return Column(out, nulls)
+        raise NotImplementedError(name)
 
     # -- string functions over dictionary columns -------------------------
     def _string_fn(self, name: str, expr: CallExpression,

@@ -992,6 +992,143 @@ def percentile_union_value(points, counts, p: float):
     return val, total == 0
 
 
+def stream_group_aggregate(batch: Batch, anchor: str,
+                           dep_names: Tuple[str, ...],
+                           agg_inputs: Dict[str, Optional[Column]],
+                           specs: Tuple[AggSpec, ...]):
+    """Aggregation over a stream CLUSTERED by the anchor key: segments are
+    runs of equal anchor value over live rows, reduced with cumsums and
+    associative scans — no argsort, no scatters (the reference's
+    StreamingAggregationOperator.java for pre-grouped input; on TPU this
+    beats both the scatter table — ~100ms per million rows scattered —
+    and the sort path, which pays an O(n log^2 n) bitonic argsort).
+
+    Grouped (lifespan) execution feeds this: within a bucket the probe
+    stream arrives in bucket-key order (the co-bucket layout maps key
+    ranges to contiguous row ranges), so anchor runs are contiguous.
+    Other grouping keys must be constant within each anchor run; that is
+    VERIFIED in-program (segmented min==max + null uniformity, the
+    depkey_verify contract) and reported in the returned scalar.
+
+    Returns (out_batch, deps_ok, live_groups): out capacity == input
+    capacity with one live row per group at its segment start."""
+    mask = batch.mask
+    ac = batch.columns[anchor]
+    kv = ac.values.astype(jnp.int64)
+    n = kv.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    live = mask if ac.nulls is None else (mask & ~ac.nulls)
+    # previous LIVE row (interior masked rows must not split a run)
+    last_live = jax.lax.cummax(jnp.where(live, idx, jnp.int32(-1)))
+    prev_live = jnp.concatenate(
+        [jnp.full(1, -1, dtype=jnp.int32), last_live[:-1]])
+    prev_kv = kv[jnp.clip(prev_live, 0, n - 1)]
+    is_start = live & ((prev_live < 0) | (prev_kv != kv))
+    nxt = jnp.flip(jax.lax.cummin(jnp.flip(
+        jnp.where(is_start, idx, n))))
+    seg_end = jnp.concatenate([nxt[1:], jnp.full(1, n, dtype=jnp.int32)])
+    seg_end = jnp.where(live, seg_end, idx + 1)
+    s_lo = idx
+    s_hi = jnp.clip(seg_end, 0, n).astype(jnp.int32)
+    # each row's segment-start position (cummax of start indices)
+    seg_start_row = jax.lax.cummax(
+        jnp.where(is_start, idx, jnp.int32(0))).astype(jnp.int32)
+
+    # packed prefix sums: count + int/float value sums in one cumsum each
+    i64_items: List[jnp.ndarray] = []
+    f64_items: List[jnp.ndarray] = []
+    plan = []
+    for spec in specs:
+        if spec.name == "count_star":
+            contrib, x = live, None
+        else:
+            c = agg_inputs[spec.output]
+            contrib = live & ~c.null_mask()
+            x = c.values
+        cnt_idx = len(i64_items)
+        i64_items.append(contrib.astype(jnp.int64))
+        sum_idx = None
+        is_f64 = False
+        if spec.name in ("sum", "avg"):
+            dt = jnp.float64 if spec.is_float else jnp.int64
+            xv = jnp.where(contrib, x, 0).astype(dt)
+            is_f64 = spec.is_float
+            if is_f64:
+                sum_idx = len(f64_items)
+                f64_items.append(xv)
+            else:
+                sum_idx = len(i64_items)
+                i64_items.append(xv)
+        elif spec.name not in ("count", "count_star"):
+            # min/max (and anything else) would need a segmented scan;
+            # associative_scan proved pathologically slow on this backend,
+            # so those specs take the sort path instead
+            raise NotImplementedError(
+                f"stream aggregation for {spec.name}")
+        plan.append((spec, contrib, x, cnt_idx, sum_idx, is_f64))
+    # dependent keys: constancy is checked by comparing every live row to
+    # its segment-START row (one gather + elementwise — no segmented
+    # min/max machinery), plus per-segment null counts for uniformity
+    dep_plan = []
+    for k in dep_names:
+        c = batch.columns[k]
+        v = _depkey_as_int64(c)
+        dvalid = live if c.nulls is None else (live & ~c.nulls)
+        dep_plan.append((k, v, dvalid, len(i64_items)))
+        i64_items.append((live & ~dvalid).astype(jnp.int64))  # null count
+
+    def _seg(items, dt):
+        if not items:
+            return None
+        m = jnp.stack(items)
+        p = jnp.concatenate([jnp.zeros((len(items), 1), dtype=dt),
+                             jnp.cumsum(m, axis=1)], axis=1)
+        return p[:, s_hi] - p[:, s_lo]
+
+    seg_i = _seg(i64_items, jnp.int64)
+    seg_f = _seg(f64_items, jnp.float64)
+
+    cols: Dict[str, Column] = {anchor: ac}
+    for spec, contrib, x, cnt_idx, sum_idx, is_f64 in plan:
+        cnt = seg_i[cnt_idx]
+        if spec.name in ("count", "count_star"):
+            cols[spec.output] = Column(cnt, None)
+            continue
+        empty = cnt == 0
+        s = (seg_f if is_f64 else seg_i)[sum_idx]
+        if spec.name == "sum":
+            cols[spec.output] = Column(s, empty)
+        elif spec.is_float:
+            cols[spec.output] = Column(s / jnp.where(empty, 1, cnt),
+                                       empty)
+        else:
+            cols[spec.output] = Column(_decimal_avg(s, cnt, empty), empty)
+
+    # dependency verification: each live row's dep value (and null flag)
+    # must equal its segment start's
+    deps_ok = jnp.ones((), dtype=bool)
+    total = None
+    if dep_plan:
+        lv = jnp.concatenate(
+            [jnp.zeros(1, dtype=jnp.int64),
+             jnp.cumsum(live.astype(jnp.int64))])
+        total = lv[s_hi] - lv[s_lo]          # live rows per segment
+    for k, v, dvalid, nul_idx in dep_plan:
+        start_v = v[seg_start_row]
+        start_valid = dvalid[seg_start_row]
+        same = (dvalid == start_valid) & ((v == start_v) | ~dvalid)
+        deps_ok = deps_ok & jnp.all(jnp.where(live, same, True))
+        nc = seg_i[nul_idx]
+        dc = batch.columns[k]
+        # all-NULL segments surface as NULL keys
+        extra_null = nc == total
+        cols[k] = Column(dc.values,
+                         extra_null if dc.nulls is None
+                         else (dc.nulls | extra_null),
+                         dc.dictionary, dc.lazy)
+    return Batch(cols, is_start), deps_ok, jnp.sum(is_start)
+
+
 def sort_group_aggregate(batch: Batch, key_names: Tuple[str, ...],
                          agg_inputs: Dict[str, Optional[Column]],
                          specs: Tuple[AggSpec, ...],
@@ -1596,10 +1733,12 @@ def sort_indices(batch: Batch, keys: List[Tuple[str, str]]):
             key = -v if desc else v
             nullv = jnp.inf
         else:
-            # Promote narrow ints to int64 so the INT64_MAX null sentinel
-            # is representable: jnp.where would otherwise wrap it to -1 in
-            # an int32/int8 key and sort NULLS_LAST rows first.
-            if v.dtype != jnp.int64:
+            # Narrow ints promote to int64 when a sentinel or negation
+            # could wrap: the INT64_MAX null sentinel would truncate to -1
+            # in an int32 key (q14_1 NULLS LAST bug), and DESC negates the
+            # key, where -INT_MIN wraps to itself at the narrow width.
+            # ASC non-null keys keep their width (nothing can wrap).
+            if (col.nulls is not None or desc) and v.dtype != jnp.int64:
                 v = v.astype(jnp.int64)
             key = -v if desc else v
             nullv = INT64_MAX

@@ -193,6 +193,13 @@ class TaskContext:
     remote_batches: Dict[str, Callable[[], Iterator["Batch"]]] = field(default_factory=dict)
     # this task's index in its stage: namespaces AssignUniqueId across tasks
     task_index: int = 0
+    # per-STAGE shared jitted-program cache (scheduler-provided): the N
+    # tasks of a stage compile byte-identical step closures, and Python
+    # tracing is GIL-serialized — without sharing, an N-task stage pays
+    # N traces on one core (measured 8x the single-task wall on the
+    # 8-device dryrun).  The reference analog: tasks share the
+    # coordinator-shipped plan; here they share the XLA trace.
+    shared_jits: Optional[Dict] = None
     # HBM byte accounting for this task (created by PlanCompiler if absent)
     memory: Optional[MemoryPool] = None
     # EXPLAIN ANALYZE: node id -> {rows, wall_s, batches} (None = disabled)
@@ -236,6 +243,19 @@ class PlanCompiler:
         # batch buffers of shared (multi-consumer) sources; cleared per
         # execution (see _share)
         self._shared_states: List[dict] = []
+
+    def shared_jit(self, key, fn, **kw):
+        """jax.jit with a per-stage shared cache: tasks of one stage share
+        ONE traced program per (node id, purpose) key instead of each
+        re-tracing an identical closure (TaskContext.shared_jits).  Falls
+        back to a plain jit when no stage cache is installed."""
+        cache = self.ctx.shared_jits
+        if cache is None:
+            return jax.jit(fn, **kw)
+        ent = cache.get(key)
+        if ent is None:
+            ent = cache.setdefault(key, jax.jit(fn, **kw))
+        return ent
 
     # -- public -----------------------------------------------------------
     def compile(self, root: P.PlanNode) -> BatchSource:
@@ -480,7 +500,7 @@ class PlanCompiler:
             return make
 
         make = make_factory(cap)
-        dev_make = jax.jit(make)
+        dev_make = self.shared_jit((node.id, "scan_make", cap), make)
 
         def split_gen(split):
                 pos = split.start
@@ -611,11 +631,19 @@ class PlanCompiler:
             except BaseException:
                 handle.abort()
                 raise
-            rv, fv = node.outputs
+            rv, fv = node.outputs[:2]
             cols = {rv.name: Column(jnp.asarray(np.array([rows],
                                                          dtype=np.int64))),
                     fv.name: Column(jnp.asarray(np.zeros(1, np.int32)), None,
                                     (handle.staging_id,))}
+            if len(node.outputs) > 2:
+                # coordinator-shaped fragments carry a third
+                # tableCommitContext output (TableCommitContext.java); a
+                # task-wide single-commit context is constant
+                cols[node.outputs[2].name] = Column(
+                    jnp.asarray(np.zeros(1, np.int32)), None,
+                    ('{"lifespan":"TaskWide","pageSinkCommitStrategy":'
+                     '"NO_COMMIT"}',))
             yield Batch(cols, jnp.asarray(np.array([True])))
         return BatchSource(gen, names, types)
 
@@ -701,11 +729,11 @@ class PlanCompiler:
             if "step" not in cache:
                 (pred,), hoisted = hoister.resolve(first)
 
-                @jax.jit
-                def step(batch):
-                    return ops.apply_filter(batch, low.eval(pred, batch))
+                def step(batch, _pred=pred):
+                    return ops.apply_filter(batch, low.eval(_pred, batch))
 
-                cache["step"], cache["hoisted"] = step, hoisted
+                cache["step"] = self.shared_jit((node.id, "filter"), step)
+                cache["hoisted"] = hoisted
             step, hoisted = cache["step"], cache["hoisted"]
             for b in itertools.chain([first], it):
                 yield step(_add_hoisted(b, hoisted))
@@ -728,13 +756,13 @@ class PlanCompiler:
             if "step" not in cache:
                 exprs, hoisted = hoister.resolve(first)
 
-                @jax.jit
-                def step(batch):
+                def step(batch, _exprs=exprs):
                     cols = {v.name: low.eval(e, batch)
-                            for (v, _), e in zip(items, exprs)}
+                            for (v, _), e in zip(items, _exprs)}
                     return Batch(cols, batch.mask)
 
-                cache["step"], cache["hoisted"] = step, hoisted
+                cache["step"] = self.shared_jit((node.id, "project"), step)
+                cache["hoisted"] = hoisted
             step, hoisted = cache["step"], cache["hoisted"]
             for b in itertools.chain([first], it):
                 yield step(_add_hoisted(b, hoisted))
@@ -755,14 +783,77 @@ class PlanCompiler:
                 yield Batch(cols, b.mask)
         return BatchSource(gen, outer, types)
 
+    def _compile_UnnestNode(self, node: P.UnnestNode) -> BatchSource:
+        """One output row per array element; source columns replicated
+        (reference UnnestOperator.java).  With the fixed-width (cap, W)
+        array layout this is the same shape transform as the fused join
+        fanout expansion: output capacity = cap * W, slot i*W + j = (source
+        row i, element j); multiple arrays zip by position, shorter ones
+        null-padded (SQL UNNEST semantics)."""
+        src = self._compile(node.source)
+        names = [v.name for v in node.output_variables]
+        types = [v.type for v in node.output_variables]
+        rep_names = [v.name for v in node.replicate_variables]
+        pairs = [(av.name, elems[0].name)
+                 for av, elems in node.unnest_variables]
+        ord_name = (None if node.ordinality_variable is None
+                    else node.ordinality_variable.name)
+
+        def step(batch):
+            cap = batch.capacity
+            arrs = {an: batch.columns[an] for an, _en in pairs}
+            W = max([a.values.shape[1] for a in arrs.values()] + [1])
+            # rows per source row = max of the zipped arrays' lengths
+            rowlen = None
+            for a in arrs.values():
+                ln = jnp.where(a.null_mask(), 0, a.lengths)
+                rowlen = ln if rowlen is None else jnp.maximum(rowlen, ln)
+            j = jnp.arange(W, dtype=jnp.int32)
+            cols = {}
+            for rn in rep_names:
+                c = batch.columns[rn]
+                if c.lengths is not None:
+                    vals = jnp.repeat(c.values, W, axis=0)
+                else:
+                    vals = jnp.repeat(c.values, W)
+                cols[rn] = Column(
+                    vals,
+                    None if c.nulls is None else jnp.repeat(c.nulls, W),
+                    c.dictionary, c.lazy,
+                    None if c.lengths is None
+                    else jnp.repeat(c.lengths, W))
+            for an, en in pairs:
+                a = arrs[an]
+                aw = a.values.shape[1]
+                padded = (a.values if aw == W else jnp.pad(
+                    a.values, ((0, 0), (0, W - aw))))
+                vals = padded.reshape(cap * W)
+                ln = jnp.where(a.null_mask(), 0, a.lengths)
+                valid = (j[None, :] < ln[:, None]).reshape(cap * W)
+                cols[en] = Column(vals, ~valid)
+            if ord_name is not None:
+                cols[ord_name] = Column(
+                    jnp.tile(j.astype(jnp.int64) + 1, cap))
+            mask = (batch.mask[:, None]
+                    & (j[None, :] < rowlen[:, None])).reshape(cap * W)
+            return Batch(cols, mask)
+
+        step = self.shared_jit((node.id, "unnest"), step)
+
+        def gen():
+            for b in src.batches():
+                out = step(b)
+                yield out.select(names)
+        return BatchSource(gen, names, types)
+
     # -- limit / topn / sort ---------------------------------------------
     def _compile_LimitNode(self, node: P.LimitNode) -> BatchSource:
         src = self._compile(node.source)
         n = node.count
 
-        @jax.jit
-        def step(batch, consumed):
-            return ops.limit(batch, n, consumed)
+        step = self.shared_jit(
+            (node.id, "limit"),
+            lambda batch, consumed: ops.limit(batch, n, consumed))
 
         def gen():
             consumed = jnp.zeros((), dtype=jnp.int64)
@@ -778,14 +869,13 @@ class PlanCompiler:
         keys = [(v.name, order) for v, order in node.ordering_scheme.orderings]
         n = node.count
 
-        @jax.jit
-        def step(buffer, batch):
+        def _step(buffer, batch):
             merged = _concat_batches([buffer, batch])
             return ops.topn(merged, keys, n)
 
-        @jax.jit
-        def first(batch):
-            return ops.topn(batch, keys, n)
+        step = self.shared_jit((node.id, "topn_step"), _step)
+        first = self.shared_jit((node.id, "topn_first"),
+                                lambda batch: ops.topn(batch, keys, n))
 
         def gen():
             key_names = [k for k, _o in keys]
@@ -1033,7 +1123,6 @@ class PlanCompiler:
         def make_direct_update(G: int, strides: Tuple[int, ...]):
             fn = update_cache.get(("direct", G, strides))
             if fn is None:
-                @jax.jit
                 def fn(state, batch):
                     codes = None
                     for k, stride in zip(key_names, strides):
@@ -1049,13 +1138,14 @@ class PlanCompiler:
                     return ops.agg_direct_update(state, batch, codes,
                                                  agg_cols, specs, G,
                                                  use_pallas=cfg.pallas_agg)
+                fn = self.shared_jit((node.id, "agg_direct", G, strides),
+                                     fn)
                 update_cache[("direct", G, strides)] = fn
             return fn
 
         def make_update(num_slots: int, salt: int):
             fn = update_cache.get((num_slots, salt))
             if fn is None:
-                @jax.jit
                 def fn(state, batch):
                     key_cols = [batch.columns[k] for k in key_names]
                     agg_cols = {}
@@ -1067,6 +1157,8 @@ class PlanCompiler:
                     return ops.agg_update(state, batch, key_cols, agg_cols,
                                           specs, num_slots, salt, key_names,
                                           agg_cols2)
+                fn = self.shared_jit((node.id, "agg_upd", num_slots, salt),
+                                     fn)
                 update_cache[(num_slots, salt)] = fn
             return fn
 
@@ -1195,7 +1287,7 @@ class PlanCompiler:
                 if prep_res is None:
                     return None
                 fused_cache["prep"] = prep_res
-            aux, expands = prep_res
+            aux, expands, _deferred = prep_res
             leaf_cap = chain.leaf_cap(expands)
             chunks = chain.chunks_for(expands)
             try:
@@ -2202,14 +2294,15 @@ class PlanCompiler:
         filter_fn = (None if filter_expr is None
                      else (lambda pairs: low.eval(filter_expr, pairs)))
 
-        @jax.jit
-        def step(batch, table, matched=None):
+        def _jstep(batch, table, matched=None):
             joined, overflow, total, matched = ops.probe_join(
                 batch, table, probe_keys, build_out,
                 cfg.join_out_capacity,
                 join_type="LEFT" if full else node.join_type,
                 filter_fn=filter_fn, matched=matched)
             return joined, overflow, total, matched
+
+        step = self.shared_jit((node.id, "join_step"), _jstep)
 
         def shrink(joined, live):
             """Compact a joined batch whose out_capacity padding dominates:
@@ -2266,8 +2359,7 @@ class PlanCompiler:
                 names = tuple(rn for _ln, rn in numeric)
                 probe_names = tuple(ln for ln, _rn in numeric)
 
-                @jax.jit
-                def bounds(bb):
+                def _bounds(bb):
                     out = []
                     for rn in names:
                         c = bb.columns[rn]
@@ -2278,8 +2370,7 @@ class PlanCompiler:
                             jnp.max(jnp.where(m, v, jnp.iinfo(v.dtype).min))))
                     return out
 
-                @jax.jit
-                def apply(batch, bnds):
+                def _apply(batch, bnds):
                     keep = batch.mask
                     for (ln, lohis) in zip(probe_names, bnds):
                         lo, hi = lohis
@@ -2287,7 +2378,9 @@ class PlanCompiler:
                         keep = keep & (v >= lo) & (v <= hi)
                     return batch.with_mask(keep)
 
-                df_cache["fn"] = (bounds, apply)
+                df_cache["fn"] = (
+                    self.shared_jit((node.id, "df_bounds"), _bounds),
+                    self.shared_jit((node.id, "df_apply"), _apply))
             bounds, apply = df_cache["fn"]
             bnds = bounds(build_batch)
             return lambda batch: apply(batch, bnds)
@@ -2312,12 +2405,14 @@ class PlanCompiler:
                 batches = _apply_dyn_filter(batches, dyn_filter, stats_ent)
                 yield from _probe_stream_inner(table, batches, build_batch)
 
-            @jax.jit
-            def step_direct(batch, dt, matched):
+            def _jdirect(batch, dt, matched):
                 return ops.probe_join_direct(
                     batch, dt, probe_keys[0], build_out,
                     join_type="LEFT" if full else node.join_type,
                     filter_fn=filter_fn, matched=matched)
+
+            step_direct = self.shared_jit((node.id, "join_direct"),
+                                          _jdirect)
 
             def probe_stream_direct(dt, batches, build_batch,
                                     dyn_filter=None):
@@ -2435,6 +2530,19 @@ class PlanCompiler:
                         None if not collected else collected[0]
                         if len(collected) == 1
                         else _compact_concat(collected))
+                    if build_batch is not None \
+                            and self.ctx.shared_jits is not None:
+                        # stage-shared tracing: sibling tasks' build sides
+                        # differ by a few rows, which would retrace every
+                        # shared join program per task — normalize to a
+                        # power-of-two bucket so the stage converges on
+                        # one build shape (costs one live-count sync)
+                        live = int(jax.device_get(
+                            build_batch.mask.sum()))
+                        bucket = _bucket_for(live) \
+                            or 1 << max(0, live - 1).bit_length()
+                        if bucket != build_batch.capacity:
+                            build_batch = _jit_compact(build_batch, bucket)
                     probe = self._compile(probe_src_node)
                     if build_batch is None:
                         if node.join_type == P.INNER:

@@ -14,8 +14,9 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-from ..common.types import (BooleanType, CharType, DateType, DecimalType,
-                            DoubleType, RealType, Type, VarcharType)
+from ..common.types import (ArrayType, BooleanType, CharType, DateType,
+                            DecimalType, DoubleType, RealType, Type,
+                            VarcharType)
 from ..connectors import catalog, tpch
 from ..spi import plan as P
 from ..spi.expr import (CallExpression, ConstantExpression, RowExpression,
@@ -59,6 +60,9 @@ def _to_rows(table: Table, names, types) -> List[List]:
             v, m = table.cols[name]
             if m is not None and m[i]:
                 row.append(None)
+            elif isinstance(typ, ArrayType):
+                row.append(None if v[i] is None
+                           else [_py_element(typ.element, e) for e in v[i]])
             elif isinstance(typ, DecimalType):
                 row.append(Decimal(int(v[i])) / (10 ** typ.scale))
             elif isinstance(typ, DoubleType):
@@ -78,6 +82,24 @@ def _to_rows(table: Table, names, types) -> List[List]:
 # ---------------------------------------------------------------------------
 # node execution
 # ---------------------------------------------------------------------------
+
+def _py_element(etyp: Type, e):
+    """Array element -> plain python value (mirrors block_to_values)."""
+    if e is None:
+        return None
+    if isinstance(etyp, (DoubleType, RealType)):
+        return float(e)
+    if isinstance(etyp, BooleanType):
+        return bool(e)
+    if isinstance(etyp, (VarcharType, CharType)):
+        return str(e)
+    if isinstance(etyp, DateType):
+        return str(np.datetime64(int(e), "D"))
+    from decimal import Decimal
+    if isinstance(etyp, DecimalType):
+        return Decimal(int(e)) / (10 ** etyp.scale)
+    return int(e)
+
 
 def _exec(node: P.PlanNode) -> Table:
     fn = globals().get("_exec_" + type(node).__name__)
@@ -695,6 +717,25 @@ def _exec_JoinNode(node: P.JoinNode) -> Table:
     return Table(cols, sum(p.n for p in parts))
 
 
+def _exec_DistinctLimitNode(node: P.DistinctLimitNode) -> Table:
+    """First `count` distinct rows in scan order (DistinctLimitOperator)."""
+    src = _exec(node.source)
+    names = [v.name for v in node.distinct_variables]
+    seen = set()
+    take: List[int] = []
+    for i in range(src.n):
+        key = tuple(
+            None if (src.cols[n][1] is not None and src.cols[n][1][i])
+            else src.cols[n][0][i]
+            for n in names)
+        if key not in seen:
+            seen.add(key)
+            take.append(i)
+            if len(take) >= node.count:
+                break
+    return src.take(np.array(take, dtype=np.int64))
+
+
 def _exec_AssignUniqueIdNode(node: P.AssignUniqueIdNode) -> Table:
     t = _exec(node.source)
     cols = dict(t.cols)
@@ -797,6 +838,10 @@ def _numeric_domain(expr: RowExpression, col: Col, target_float: bool,
 def _eval_call(expr: CallExpression, t: Table) -> Col:
     name = canonical_name(expr.display_name)
     args = expr.arguments
+    if name in ("array_constructor", "subscript", "element_at",
+                "cardinality", "contains", "array_max", "array_min",
+                "array_position", "repeat", "sequence"):
+        return _eval_array_fn(name, expr, t)
     if name in ("add", "subtract", "multiply", "divide", "modulus"):
         a = _eval(args[0], t)
         b = _eval(args[1], t)
@@ -1050,6 +1095,138 @@ _REF_DOUBLE_FNS = {
     "cbrt": lambda x: _m.copysign(abs(x) ** (1 / 3), x),
     "degrees": _m.degrees, "radians": _m.radians, "power": _m.pow,
 }
+
+
+def _eval_array_fn(name: str, expr: CallExpression, t: Table) -> Col:
+    """Array functions over object arrays of python tuples (independent of
+    the engine's fixed-width device layout).  Subscript relaxes Presto's
+    out-of-bounds ERROR to NULL, matching the engine (element_at
+    semantics)."""
+    args = expr.arguments
+    if name == "array_constructor":
+        items = [_eval(a, t) for a in args]
+        out = np.empty(t.n, dtype=object)
+        for i in range(t.n):
+            out[i] = tuple(
+                None if (m is not None and m[i]) else v[i]
+                for v, m in items)
+        return (out, None)
+    if name == "repeat":
+        x = _eval(args[0], t)
+        counts = _eval(args[1], t)[0]
+        out = np.empty(t.n, dtype=object)
+        for i in range(t.n):
+            out[i] = (x[0][i],) * int(counts[i])
+        return (out, x[1])
+    if name == "sequence":
+        lo = _eval(args[0], t)[0]
+        hi = _eval(args[1], t)[0]
+        step = _eval(args[2], t)[0] if len(args) > 2 else np.ones(t.n)
+        out = np.empty(t.n, dtype=object)
+        for i in range(t.n):
+            s = int(step[i])
+            out[i] = tuple(range(int(lo[i]),
+                                 int(hi[i]) + (1 if s > 0 else -1), s))
+        return (out, None)
+    arr, am = _eval(args[0], t)
+    if name == "cardinality":
+        return (np.array([0 if v is None else len(v) for v in arr],
+                         dtype=np.int64), am)
+    if name in ("subscript", "element_at"):
+        idx, im = _eval(args[1], t)
+        out = np.zeros(t.n, dtype=object)
+        nulls = np.zeros(t.n, dtype=bool)
+        for i in range(t.n):
+            if (am is not None and am[i]) or (im is not None and im[i]):
+                nulls[i] = True
+                continue
+            k = int(idx[i])
+            a = arr[i]
+            if a is not None and name == "element_at" and k < 0:
+                k = len(a) + k + 1      # element_at(-n): from the end
+            if a is None or k < 1 or k > len(a):
+                nulls[i] = True
+            else:
+                out[i] = a[k - 1]
+        return (out, nulls)
+    if name == "contains":
+        x, xm = _eval(args[1], t)
+        hit = np.array([False if a is None else (x[i] in a)
+                        for i, a in enumerate(arr)])
+        m = am
+        if xm is not None:
+            m = xm if m is None else (m | xm)
+        return (hit, m)
+    if name in ("array_max", "array_min"):
+        f = max if name == "array_max" else min
+        out = np.zeros(t.n, dtype=object)
+        nulls = np.zeros(t.n, dtype=bool)
+        for i, a in enumerate(arr):
+            if a is None or (am is not None and am[i]) or not len(a):
+                nulls[i] = True
+            else:
+                out[i] = f(a)
+        return (out, nulls)
+    if name == "array_position":
+        x, xm = _eval(args[1], t)
+        out = np.zeros(t.n, dtype=np.int64)
+        for i, a in enumerate(arr):
+            if a is not None:
+                for j, v in enumerate(a):
+                    if v == x[i]:
+                        out[i] = j + 1
+                        break
+        m = am
+        if xm is not None:
+            m = xm if m is None else (m | xm)
+        return (out, m)
+    raise NotImplementedError(name)
+
+
+def _exec_UnnestNode(node: P.UnnestNode) -> Table:
+    """One row per zipped element position, source columns replicated
+    (UnnestOperator.java semantics: multiple arrays align by position,
+    shorter ones null-extended)."""
+    src = _exec(node.source)
+    rep = [v.name for v in node.replicate_variables]
+    arrays = [(av.name, elems[0].name)
+              for av, elems in node.unnest_variables]
+    take: List[int] = []
+    elem_cols = {en: [] for _an, en in arrays}
+    elem_nulls = {en: [] for _an, en in arrays}
+    ords: List[int] = []
+    for i in range(src.n):
+        rowlen = 0
+        vals = {}
+        for an, en in arrays:
+            v, m = src.cols[an]
+            a = None if (m is not None and m[i]) else v[i]
+            vals[en] = a
+            rowlen = max(rowlen, 0 if a is None else len(a))
+        for j in range(rowlen):
+            take.append(i)
+            ords.append(j + 1)
+            for _an, en in arrays:
+                a = vals[en]
+                if a is None or j >= len(a):
+                    elem_cols[en].append(0)
+                    elem_nulls[en].append(True)
+                else:
+                    elem_cols[en].append(a[j])
+                    elem_nulls[en].append(False)
+    idx = np.array(take, dtype=np.int64)
+    cols = {}
+    for name in rep:
+        v, m = src.cols[name]
+        cols[name] = (v[idx], None if m is None else m[idx])
+    for _an, en in arrays:
+        vals = np.array(elem_cols[en], dtype=object)
+        nulls = np.array(elem_nulls[en], dtype=bool)
+        cols[en] = (vals, nulls if nulls.any() else None)
+    if node.ordinality_variable is not None:
+        cols[node.ordinality_variable.name] = (
+            np.array(ords, dtype=np.int64), None)
+    return Table(cols, len(idx))
 
 
 def _eval_date_fn(name: str, expr: CallExpression, t: Table) -> Col:

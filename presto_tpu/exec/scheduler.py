@@ -286,12 +286,19 @@ class InProcessScheduler:
         import time as _time
         import jax
 
+        # one traced program per stage, shared by its tasks (the tasks
+        # compile byte-identical step closures; Python tracing is
+        # GIL-serialized, so without sharing an N-task stage pays N
+        # traces on one core — PlanCompiler.shared_jit)
+        stage_jits: Dict = {}
+
         def run_task(task_index: int):
             """One task's fragment execution; returns (batch-or-None for
             ICI stages, wall seconds)."""
             t0 = _time.perf_counter()
             ctx = TaskContext(config=self.config.exec_config,
-                              task_index=task_index)
+                              task_index=task_index,
+                              shared_jits=stage_jits)
             for node_id, splits in scan_splits.items():
                 ctx.splits[node_id] = splits[task_index::stage.n_tasks]
             for rnode in remote_nodes:

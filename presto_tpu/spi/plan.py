@@ -782,6 +782,8 @@ class UnnestNode(PlanNode):
     source: PlanNode
     replicate_variables: List[Variable]
     unnest_variables: List[Tuple[Variable, List[Variable]]]  # array var -> element vars
+    # WITH ORDINALITY output (reference UnnestNode.ordinalityVariable)
+    ordinality_variable: Optional[Variable] = None
 
     @property
     def sources(self):
@@ -792,6 +794,8 @@ class UnnestNode(PlanNode):
         out = list(self.replicate_variables)
         for _, elems in self.unnest_variables:
             out.extend(elems)
+        if self.ordinality_variable is not None:
+            out.append(self.ordinality_variable)
         return out
 
     def _to_dict(self):
@@ -799,14 +803,19 @@ class UnnestNode(PlanNode):
                 "replicateVariables": _vars_to_dict(self.replicate_variables),
                 "unnestVariables": [{"variable": v.to_dict(),
                                      "elements": _vars_to_dict(elems)}
-                                    for v, elems in self.unnest_variables]}
+                                    for v, elems in self.unnest_variables],
+                "ordinalityVariable":
+                    None if self.ordinality_variable is None
+                    else self.ordinality_variable.to_dict()}
 
     @classmethod
     def _from_dict(cls, d):
+        ov = d.get("ordinalityVariable")
         return cls(d["id"], PlanNode.from_dict(d["source"]),
                    _vars_from_dict(d["replicateVariables"]),
                    [(RowExpression.from_dict(e["variable"]), _vars_from_dict(e["elements"]))
-                    for e in d["unnestVariables"]])
+                    for e in d["unnestVariables"]],
+                   None if ov is None else RowExpression.from_dict(ov))
 
 
 # ---------------------------------------------------------------------------

@@ -124,6 +124,13 @@ def format_plan(node: P.PlanNode,
             walk(ch, depth + 1)
 
     walk(node, 0)
+    rule_stats = getattr(node, "rule_stats", None)
+    if rule_stats:
+        # per-rule hit counts from the iterative optimizer (sql/rules.py;
+        # the reference's optimizerInformation in the query plan JSON)
+        fired = ", ".join(f"{k}: {v}"
+                          for k, v in sorted(rule_stats.items()))
+        lines.append(f"Optimizer rules fired: {{{fired}}}")
     return "\n".join(lines)
 
 

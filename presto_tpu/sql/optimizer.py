@@ -1,16 +1,11 @@
-"""Cost-based plan rewrites over the logical plan.
+"""Whole-plan rewrites over the logical plan.
 
-The (much smaller) analog of the reference's PlanOptimizers pass list
-(PlanOptimizers.java:209).  Passes mutate the plan in place, like the
-fragmenter's distribution planner does.
-
-Current passes:
-  * determine_join_sides — put the smaller estimated side on the BUILD
-    (right) side of inner hash joins (reference
-    DetermineJoinDistributionType / ReorderJoins' side selection): the
-    executor builds its sorted lookup table from the right input, so a
-    large build side costs sort+memory where a probe-side scan would
-    stream.
+The analog of the reference's PlanOptimizers pass list
+(PlanOptimizers.java:209), split like the reference's Optimizer: local
+algebraic rewrites (filter/limit/projection merging, join-side choice)
+run through the iterative rule driver in sql/rules.py; the passes here
+need GLOBAL plan context (requirement union across decorrelated copies,
+dynamic-filter id allocation) and mutate the plan in place.
 """
 from __future__ import annotations
 
@@ -18,9 +13,6 @@ from typing import Dict, Set
 
 from ..spi import plan as P
 from ..spi.expr import free_variables
-from .stats import StatsCalculator
-
-SWAP_RATIO = 1.25     # hysteresis: only swap on a clear size difference
 
 
 # ---------------------------------------------------------------------------
@@ -184,20 +176,6 @@ def prune_unused_outputs(root: P.PlanNode) -> P.PlanNode:
     return root
 
 
-def determine_join_sides(root: P.PlanNode,
-                         calc: StatsCalculator = None) -> P.PlanNode:
-    calc = calc or StatsCalculator()
-    for n in P.walk_plan(root):
-        if isinstance(n, P.JoinNode) and n.join_type == P.INNER \
-                and n.criteria:
-            l = calc.rows(n.left)
-            r = calc.rows(n.right)
-            if l is not None and r is not None and r > l * SWAP_RATIO:
-                n.left, n.right = n.right, n.left
-                n.criteria = [(rv, lv) for lv, rv in n.criteria]
-    return root
-
-
 def plan_dynamic_filters(root: P.PlanNode) -> P.PlanNode:
     """Annotate inner hash joins with dynamic filters (reference
     DynamicFilterSourceOperator + LocalDynamicFilter planning): each
@@ -291,7 +269,15 @@ def hoist_join_filter_string_calls(root: P.PlanNode) -> P.PlanNode:
 
 
 def optimize(root: P.PlanNode) -> P.PlanNode:
+    """Reference Optimizer.java sequence, compressed: whole-plan passes
+    (hoisting, pruning, dynamic filters) around the iterative rule driver
+    (sql/rules.py).  Per-rule hit counts ride the root node for EXPLAIN
+    (the reference's optimizerInformation)."""
+    from .rules import DEFAULT_RULES, IterativeOptimizer
     root = hoist_join_filter_string_calls(root)
+    rule_stats: Dict[str, int] = {}
+    root = IterativeOptimizer(DEFAULT_RULES).run(root, rule_stats)
     root = prune_unused_outputs(root)
-    root = determine_join_sides(root)
-    return plan_dynamic_filters(root)
+    root = plan_dynamic_filters(root)
+    root.rule_stats = rule_stats
+    return root

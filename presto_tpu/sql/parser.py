@@ -31,7 +31,7 @@ TOKEN_RE = re.compile(r"""
   | (?P<string>'(?:[^']|'')*')
   | (?P<qident>"(?:[^"]|"")*")
   | (?P<ident>[A-Za-z_][A-Za-z0-9_$]*)
-  | (?P<op><>|!=|>=|<=|\|\||[(),.*/%<>=+\-;])
+  | (?P<op><>|!=|>=|<=|\|\||[(),.*/%<>=+\-;\[\]])
 """, re.VERBOSE)
 
 
@@ -108,6 +108,17 @@ class DateLit(Node):
 class IntervalLit(Node):
     value: str
     unit: str                 # day / month / year
+
+
+@dataclass
+class ArrayLit(Node):
+    items: List[Node]         # ARRAY[e1, e2, ...]
+
+
+@dataclass
+class Subscript(Node):
+    base: Node                # arr[idx] (1-based, SqlBase.g4 subscript)
+    index: Node
 
 
 @dataclass
@@ -232,6 +243,16 @@ class TableRef(Node):
 class SubqueryRef(Node):
     query: "Query"
     alias: str
+
+
+@dataclass
+class UnnestRef(Node):
+    """UNNEST(arr, ...) [WITH ORDINALITY] [AS alias(c1, c2, ...)] — a
+    lateral relation over the preceding FROM items (SqlBase.g4 unnest)."""
+    exprs: List[Node]
+    alias: Optional[str] = None
+    column_aliases: List[str] = field(default_factory=list)
+    ordinality: bool = False
 
 
 @dataclass
@@ -668,6 +689,10 @@ class Parser:
             rel = self.parse_relation()
             self.expect("op", ")")
             return rel
+        if self.peek().kind == "ident" \
+                and self.peek().value.lower() == "unnest" \
+                and self.peek(1).kind == "op" and self.peek(1).value == "(":
+            return self.parse_unnest()
         name = self.expect("ident").value
         # optional schema qualifier: schema.table
         while self.accept("op", "."):
@@ -678,6 +703,32 @@ class Parser:
         elif self.peek().kind == "ident":
             alias = self.next().value
         return TableRef(name, alias)
+
+    def parse_unnest(self) -> "UnnestRef":
+        """UNNEST(expr, ...) [WITH ORDINALITY] [AS a(c1, ...)]"""
+        self.next()                       # unnest
+        self.expect("op", "(")
+        exprs = [self.parse_expr()]
+        while self.accept("op", ","):
+            exprs.append(self.parse_expr())
+        self.expect("op", ")")
+        ordinality = False
+        if self.accept("keyword", "with"):
+            w = self.next()
+            if w.value.lower() != "ordinality":
+                raise SyntaxError(f"expected ORDINALITY at {w.pos}")
+            ordinality = True
+        alias, col_aliases = None, []
+        if self.accept("keyword", "as"):
+            alias = self.expect("ident").value
+        elif self.peek().kind == "ident":
+            alias = self.next().value
+        if alias is not None and self.accept("op", "("):
+            col_aliases.append(self._ident())
+            while self.accept("op", ","):
+                col_aliases.append(self._ident())
+            self.expect("op", ")")
+        return UnnestRef(exprs, alias, col_aliases, ordinality)
 
     # -- expressions (precedence climbing) -------------------------------
     def parse_expr(self) -> Node:
@@ -774,7 +825,29 @@ class Parser:
         return self.parse_primary()
 
     def parse_primary(self) -> Node:
+        e = self._parse_primary_base()
+        # postfix subscript binds tightest (SqlBase.g4 primaryExpression
+        # '[' valueExpression ']')
+        while self.peek().kind == "op" and self.peek().value == "[":
+            self.next()
+            idx = self.parse_expr()
+            self.expect("op", "]")
+            e = Subscript(e, idx)
+        return e
+
+    def _parse_primary_base(self) -> Node:
         t = self.peek()
+        if t.kind == "ident" and t.value.lower() == "array" \
+                and self.peek(1).kind == "op" and self.peek(1).value == "[":
+            self.next()
+            self.next()               # [
+            items: List[Node] = []
+            if not (self.peek().kind == "op" and self.peek().value == "]"):
+                items.append(self.parse_expr())
+                while self.accept("op", ","):
+                    items.append(self.parse_expr())
+            self.expect("op", "]")
+            return ArrayLit(items)
         if t.kind == "number":
             self.next()
             return NumberLit(t.value)

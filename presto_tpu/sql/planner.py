@@ -431,11 +431,26 @@ class Planner:
         for r in query.relations:
             flatten(r)
 
-        # plan each base relation
+        # plan each base relation; UNNEST items are lateral (they read the
+        # preceding relations' columns) so they defer to the join loop
         planned: List[Tuple[P.PlanNode, RelationScope, str, Optional[A.Node]]] = []
         for rel, jt, on in flat:
+            if isinstance(rel, A.UnnestRef):
+                if on is not None:
+                    raise PlanningError("UNNEST join cannot have ON")
+                planned.append((rel, None, jt, on))
+                continue
             node, rscope = self.plan_base_relation(rel, query)
             planned.append((node, rscope, jt, on))
+        has_unnest = any(isinstance(n, A.UnnestRef)
+                         for n, _s, _j, _o in planned)
+        if has_unnest and isinstance(planned[0][0], A.UnnestRef):
+            # bare FROM UNNEST(...): unnest over a one-row values source
+            v = self.new_var("dummy", BIGINT)
+            one = P.ValuesNode(self.new_id("values"), [v],
+                               [[constant(1, BIGINT)]])
+            planned.insert(0, (one, RelationScope("__values", {}), "INNER",
+                               None))
 
         # WHERE conjuncts for pushdown / join criteria.  Conjuncts holding
         # subqueries (EXISTS / IN / scalar comparisons) are set aside and
@@ -465,7 +480,7 @@ class Planner:
         remaining: List[A.Node] = []
         consumed_where: List[A.Node] = []
         for i, (node, rscope, jt, on) in enumerate(planned):
-            if i in null_producing:
+            if i in null_producing or rscope is None:
                 continue
             single_scope = Scope([rscope])
             preds = []
@@ -490,8 +505,9 @@ class Planner:
         # equi-conjunct with the joined prefix when any such relation
         # exists (reference ReorderJoins, reduced to the connectivity
         # heuristic).  Explicit JOIN ... ON syntax keeps its order.
-        if len(planned) > 2 and all(jt == "INNER" and on is None
-                                    for _n, _s, jt, on in planned):
+        if len(planned) > 2 and not has_unnest \
+                and all(jt == "INNER" and on is None
+                        for _n, _s, jt, on in planned):
             plain = [c for c in where_conjuncts if not _has_subquery(c)]
 
             def connects(i, chosen) -> bool:
@@ -522,6 +538,11 @@ class Planner:
         node, rscope, _, _ = planned[0]
         scopes = [rscope]
         for j, (next_node, next_scope, jt, on) in enumerate(planned[1:], 1):
+            if isinstance(next_node, A.UnnestRef):
+                node, u_scope = self._plan_unnest(node, Scope(scopes),
+                                                  next_node)
+                scopes.append(u_scope)
+                continue
             left_scope = Scope(scopes)
             right_scope = Scope([next_scope])
             conjs = list(_conjuncts(on))
@@ -644,6 +665,51 @@ class Planner:
                                    assignments)
             return node, RelationScope(alias, cols)
         raise PlanningError(f"unsupported relation {type(rel).__name__}")
+
+    def _plan_unnest(self, node: P.PlanNode, scope: Scope,
+                     uref: "A.UnnestRef"):
+        """Lateral UNNEST over the assembled FROM prefix: one output row
+        per array element, source columns replicated (reference
+        UnnestNode / UnnestOperator.java semantics)."""
+        from ..common.types import ArrayType, UNKNOWN
+        replicate = _scope_vars(scope)
+        proj: Dict = {v: v for v in replicate}
+        need_proj = False
+        unnest_vars: List[Tuple] = []
+        cols: Dict[str, VariableReferenceExpression] = {}
+        elem_i = 0
+        for ex_ast in uref.exprs:
+            ex = self.plan_expr(ex_ast, scope)
+            if not isinstance(ex.type, ArrayType):
+                raise PlanningError(
+                    f"UNNEST argument must be an array, got "
+                    f"{ex.type.signature}")
+            if isinstance(ex, VariableReferenceExpression):
+                av = ex
+            else:
+                av = self.new_var("unnest_arr", ex.type)
+                proj[av] = ex
+                need_proj = True
+            if elem_i < len(uref.column_aliases):
+                ename = uref.column_aliases[elem_i]
+            else:
+                ename = f"_col{elem_i}"
+            elem_i += 1
+            ev = self.new_var(ename, ex.type.element or UNKNOWN)
+            unnest_vars.append((av, [ev]))
+            cols[ename.lower()] = ev
+        ord_var = None
+        if uref.ordinality:
+            oname = (uref.column_aliases[elem_i]
+                     if elem_i < len(uref.column_aliases) else "ordinality")
+            ord_var = self.new_var(oname, BIGINT)
+            cols[oname.lower()] = ord_var
+        if need_proj:
+            node = P.ProjectNode(self.new_id("unnest_in"), node, proj)
+        node = P.UnnestNode(self.new_id("unnest"), node, replicate,
+                            unnest_vars, ord_var)
+        alias = (uref.alias or "unnest").lower()
+        return node, RelationScope(alias, cols)
 
     def _extract_criteria(self, conjuncts, left_scope: Scope,
                           right_scope: Scope):
@@ -1520,6 +1586,25 @@ class Planner:
         if isinstance(e, A.ExtractExpr):
             arg = self.plan_expr(e.operand, scope)
             return call(e.part, BIGINT, arg)
+        if isinstance(e, A.ArrayLit):
+            from ..common.types import ArrayType, UNKNOWN
+            items = [self.plan_expr(i, scope) for i in e.items]
+            et = UNKNOWN
+            for it in items:
+                if it.type.signature == "unknown":
+                    continue
+                if et.signature == "unknown":
+                    et = it.type
+                elif et.signature != it.type.signature:
+                    et = _arith_type("+", et, it.type)
+            return call("array_constructor", ArrayType(et), *items)
+        if isinstance(e, A.Subscript):
+            from ..common.types import ArrayType, UNKNOWN
+            base = self.plan_expr(e.base, scope)
+            idx = self.plan_expr(e.index, scope)
+            et = base.type.element if isinstance(base.type, ArrayType) \
+                else UNKNOWN
+            return call("subscript", et, base, idx)
         if isinstance(e, A.FuncCall):
             return self._plan_func(e, scope)
         if isinstance(e, (A.InSubquery, A.Exists, A.ScalarSubquery)):
@@ -1660,6 +1745,29 @@ class Planner:
             t = next((a.type for a in args if a.type.signature != "unknown"),
                      args[0].type)
             return special("COALESCE", t, *args)
+        # -- arrays (ArrayFunctions.java / ArraySubscriptOperator) --------
+        if name == "cardinality":
+            return call("cardinality", BIGINT, *args)
+        if name == "element_at":
+            from ..common.types import ArrayType, UNKNOWN
+            et = args[0].type.element \
+                if isinstance(args[0].type, ArrayType) else UNKNOWN
+            return call("element_at", et, *args)
+        if name == "contains":
+            return call("contains", BOOLEAN, *args)
+        if name in ("array_max", "array_min"):
+            from ..common.types import ArrayType, UNKNOWN
+            et = args[0].type.element \
+                if isinstance(args[0].type, ArrayType) else UNKNOWN
+            return call(name, et, *args)
+        if name == "array_position":
+            return call("array_position", BIGINT, *args)
+        if name == "repeat":
+            from ..common.types import ArrayType
+            return call("repeat", ArrayType(args[0].type), *args)
+        if name == "sequence":
+            from ..common.types import ArrayType
+            return call("sequence", ArrayType(args[0].type), *args)
         if name == "nullif":
             return special("NULL_IF", args[0].type, *args)
         if name == "round":

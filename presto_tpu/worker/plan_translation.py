@@ -32,6 +32,7 @@ Java-produced files directly).  Notable wire conventions:
 from __future__ import annotations
 
 import base64
+import contextvars
 from typing import Dict, List, Optional, Tuple
 
 from ..common.block import block_to_values
@@ -502,6 +503,69 @@ def _t_row_number(d: dict) -> P.PlanNode:
         d.get("maxRowCountPerPartition"))
 
 
+_TABLE_WRITE_INFO = contextvars.ContextVar("table_write_info",
+                                           default=None)
+
+
+def _write_target():
+    """The task update's TableWriteInfo writer target
+    (presto_protocol_core.h:726; ExecutionWriterTarget subtypes
+    CreateHandle/InsertHandle — ExecutionWriterTarget.java:30-35).
+    Returns (connector_id, table_name)."""
+    twi = _TABLE_WRITE_INFO.get() or {}
+    target = twi.get("writerTarget") or {}
+    handle = target.get("handle") or {}
+    cid = handle.get("connectorId")
+    stn = target.get("schemaTableName") or {}
+    table = stn.get("table")
+    if not cid or not table:
+        raise PlanTranslationError(
+            "TableWriterNode needs TaskUpdateRequest.tableWriteInfo "
+            "with a CreateHandle/InsertHandle writer target")
+    return cid, table
+
+
+def _t_table_writer(d: dict) -> P.PlanNode:
+    """TableWriterNode (presto_protocol_core.h:2279-2292,
+    TableWriterOperator.java:78).  The wire node carries the output
+    variables and column names; the TARGET rides the task update's
+    TableWriteInfo (the struct's own target is 'TODO' upstream too)."""
+    cid, table = _write_target()
+    outputs = [parse_variable(d["rowCountVariable"]),
+               parse_variable(d["fragmentVariable"])]
+    if d.get("tableCommitContextVariable"):
+        outputs.append(parse_variable(d["tableCommitContextVariable"]))
+    return P.TableWriterNode(
+        d["id"], _src(d), cid, table,
+        [str(c) for c in d.get("columnNames") or []], outputs)
+
+
+def _t_table_finish(d: dict) -> P.PlanNode:
+    """TableFinishNode (TableFinishNode.java:46-52,
+    TableFinishOperator.java): commits the staged fragments, emits the
+    row count."""
+    cid, table = _write_target()
+    return P.TableFinishNode(
+        d["id"], _src(d), cid, table,
+        [parse_variable(d["rowCountVariable"])])
+
+
+def _t_unnest(d: dict) -> P.PlanNode:
+    """UnnestNode (presto_protocol_core.h:2431-2438,
+    PrestoToVeloxQueryPlan's toVeloxQueryPlan(UnnestNode),
+    UnnestOperator.java): unnestVariables is a Jackson map keyed by the
+    serialized variable."""
+    unnest = []
+    for k, elems in (d.get("unnestVariables") or {}).items():
+        unnest.append((parse_map_key_variable(k),
+                       [parse_variable(e) for e in elems]))
+    ov = d.get("ordinalityVariable")
+    return P.UnnestNode(
+        d["id"], _src(d),
+        [parse_variable(v) for v in d.get("replicateVariables") or []],
+        unnest, None if ov is None else parse_variable(ov))
+
+
 _NODE_HANDLERS = {
     ".TableScanNode": _t_tablescan,
     ".FilterNode": _t_filter,
@@ -524,6 +588,9 @@ _NODE_HANDLERS = {
     ".ExchangeNode": _t_exchange,
     ".RemoteSourceNode": _t_remote_source,
     ".RowNumberNode": _t_row_number,
+    ".UnnestNode": _t_unnest,
+    ".TableWriterNode": _t_table_writer,
+    ".TableFinishNode": _t_table_finish,
 }
 
 
@@ -600,7 +667,17 @@ def is_reference_fragment(d: dict) -> bool:
             in d or "variables" in d)
 
 
-def translate_fragment(d: dict) -> P.PlanFragment:
+def translate_fragment(d: dict,
+                       table_write_info: Optional[dict] = None
+                       ) -> P.PlanFragment:
+    token = _TABLE_WRITE_INFO.set(table_write_info)
+    try:
+        return _translate_fragment_inner(d)
+    finally:
+        _TABLE_WRITE_INFO.reset(token)
+
+
+def _translate_fragment_inner(d: dict) -> P.PlanFragment:
     root = translate_node(d["root"])
     partitioning = _system_partitioning(d.get("partitioning"))
     scheme = _partitioning_scheme(d["partitioningScheme"])

@@ -76,6 +76,9 @@ class TaskUpdateRequest:
     sources: List[TaskSource]
     output_buffers: OutputBuffersSpec
     session: Dict[str, str] = field(default_factory=dict)
+    # reference TaskUpdateRequest.tableWriteInfo (presto_protocol_core.h:726):
+    # the writer target a TableWriterNode in the fragment commits into
+    table_write_info: Optional[dict] = None
 
     @staticmethod
     def make(task_id: str, task_index: int, fragment: P.PlanFragment,
@@ -93,15 +96,18 @@ class TaskUpdateRequest:
         if is_reference_fragment(d):
             # a Java-coordinator-shaped fragment (PrestoToVeloxQueryPlan
             # seam): translate the reference plan-node/RowExpression JSON
-            return translate_fragment(d)
+            return translate_fragment(d, self.table_write_info)
         return P.PlanFragment.from_dict(d)
 
     def to_dict(self):
-        return {"taskId": self.task_id, "taskIndex": self.task_index,
-                "fragment": self.fragment_b64,
-                "sources": [s.to_dict() for s in self.sources],
-                "outputBuffers": self.output_buffers.to_dict(),
-                "session": self.session}
+        out = {"taskId": self.task_id, "taskIndex": self.task_index,
+               "fragment": self.fragment_b64,
+               "sources": [s.to_dict() for s in self.sources],
+               "outputBuffers": self.output_buffers.to_dict(),
+               "session": self.session}
+        if self.table_write_info is not None:
+            out["tableWriteInfo"] = self.table_write_info
+        return out
 
     @staticmethod
     def from_dict(d):
@@ -109,7 +115,7 @@ class TaskUpdateRequest:
             d["taskId"], d.get("taskIndex", 0), d.get("fragment"),
             [TaskSource.from_dict(s) for s in d.get("sources", [])],
             OutputBuffersSpec.from_dict(d["outputBuffers"]),
-            d.get("session", {}))
+            d.get("session", {}), d.get("tableWriteInfo"))
 
 
 def from_reference_update(task_id: str, d: dict) -> "TaskUpdateRequest":
@@ -145,7 +151,7 @@ def from_reference_update(task_id: str, d: dict) -> "TaskUpdateRequest":
         else "PARTITIONED", n_buffers, [])
     session = dict(ref.session.systemProperties)
     return TaskUpdateRequest(task_id, task_index, ref.fragment, sources,
-                             ob, session)
+                             ob, session, ref.tableWriteInfo)
 
 
 @dataclass

@@ -170,11 +170,23 @@ class _Handler(BaseHTTPRequestHandler):
         return smile.CONTENT_TYPE in (self.headers.get("Accept")
                                       or "").lower()
 
-    def _send_negotiated(self, code: int, obj) -> None:
-        """JSON by default; SMILE when the client's Accept asks for it
-        (the TaskStatus/TaskInfo hot path the reference serves in SMILE
-        for binary-transport coordinators)."""
-        if self._accepts_smile():
+    def _accepts_thrift(self) -> bool:
+        from . import thrift
+        return thrift.CONTENT_TYPE in (self.headers.get("Accept")
+                                       or "").lower()
+
+    def _send_negotiated(self, code: int, obj,
+                         thrift_encoder=None) -> None:
+        """JSON by default; SMILE or Thrift when the client's Accept asks
+        for it (the TaskStatus/TaskInfo hot path the reference serves over
+        a negotiated binary transport — HttpRemoteTask.java:915-931 /
+        TaskResource.cpp:218-224).  Thrift needs a typed schema, so only
+        endpoints passing a thrift_encoder serve it."""
+        if thrift_encoder is not None and self._accepts_thrift():
+            from . import thrift
+            self._send(code, None, thrift_encoder(obj),
+                       headers={"Content-Type": thrift.CONTENT_TYPE})
+        elif self._accepts_smile():
             from . import smile
             self._send(code, None, smile.encode(obj),
                        headers={"Content-Type": smile.CONTENT_TYPE})
@@ -424,7 +436,9 @@ collapse}}td,th{{border:1px solid #ccc;padding:4px 8px;text-align:left}}
         else:
             update = TaskUpdateRequest.from_dict(body)
         status = self.server_ref.task_manager.create_or_update(update)
-        self._send_negotiated(200, status.to_dict())
+        from .thrift import task_status_to_thrift
+        self._send_negotiated(200, status.to_dict(),
+                              thrift_encoder=task_status_to_thrift)
 
     def do_task_status(self, groups, query):
         task = self.server_ref.task_manager.get(groups["task"])
@@ -432,7 +446,9 @@ collapse}}td,th{{border:1px solid #ccc;padding:4px 8px;text-align:left}}
             (query.get("currentState", [None])[0])
         max_wait = float(query.get("maxWaitMs", ["1000"])[0]) / 1000.0
         status = task.wait_status(current, max_wait)
-        self._send_negotiated(200, status.to_dict())
+        from .thrift import task_status_to_thrift
+        self._send_negotiated(200, status.to_dict(),
+                              thrift_encoder=task_status_to_thrift)
 
     def do_task_info(self, groups, query):
         task = self.server_ref.task_manager.get(groups["task"])
@@ -441,7 +457,9 @@ collapse}}td,th{{border:1px solid #ccc;padding:4px 8px;text-align:left}}
     def do_task_delete(self, groups, query):
         task = self.server_ref.task_manager.get(groups["task"])
         task.cancel()
-        self._send_negotiated(200, task.status().to_dict())
+        from .thrift import task_status_to_thrift
+        self._send_negotiated(200, task.status().to_dict(),
+                              thrift_encoder=task_status_to_thrift)
 
     def do_results(self, groups, query):
         task = self.server_ref.task_manager.get(groups["task"])

@@ -273,6 +273,127 @@ def _count_call(arg):
             "returnType": "bigint", "arguments": [_vj(arg)]}
 
 
+def test_table_writer_finish_wire_sample(tmp_path):
+    """A coordinator-shaped WRITE: TableWriterNode fragment executed with
+    TaskUpdateRequest.tableWriteInfo carrying the CreateHandle target
+    (presto_protocol_core.h:2279-2292 / :726, TableWriterOperator.java:78),
+    then a TableFinishNode (TableFinishNode.java:46-52) committing the
+    staged fragment via the connector's staged-rename path — after which
+    the written table scans back correctly."""
+    from presto_tpu.connectors import catalog as cat
+    from presto_tpu.connectors import hive
+    from presto_tpu.exec.pipeline import ExecutionConfig
+    from presto_tpu.exec.runner import LocalQueryRunner
+    from presto_tpu.worker.plan_translation import translate_fragment
+
+    conn = hive.HiveConnector(str(tmp_path / "warehouse"))
+    cat.register_connector("hive", conn)
+    try:
+        runner = LocalQueryRunner("sf0.01", config=ExecutionConfig(
+            batch_rows=1 << 13))
+        writer = {
+            "@type": "com.facebook.presto.sql.planner.plan.TableWriterNode",
+            "id": "writer",
+            "source": _nation_scan_json(["n_nationkey", "n_regionkey"]),
+            "rowCountVariable": _vj("rows"),
+            "fragmentVariable": _vj("frag", "varchar"),
+            "tableCommitContextVariable": _vj("ctx", "varchar"),
+            "columns": [_vj("n_nationkey"), _vj("n_regionkey")],
+            "columnNames": ["nationkey", "regionkey"],
+            "notNullColumnVariables": []}
+        frag = {
+            "id": "1", "root": writer,
+            "partitioning": {"connectorId": "$remote", "connectorHandle": {
+                "@type": "$remote", "partitioning": "SOURCE",
+                "function": "UNKNOWN"}},
+            "tableScanSchedulingOrder": ["scan"],
+            "partitioningScheme": {
+                "partitioning": {
+                    "handle": {"connectorId": "$remote",
+                               "connectorHandle": {
+                                   "@type": "$remote",
+                                   "partitioning": "SINGLE",
+                                   "function": "UNKNOWN"}},
+                    "arguments": []},
+                "outputLayout": [_vj("rows"), _vj("frag", "varchar"),
+                                 _vj("ctx", "varchar")]}}
+        twi = {"writerTarget": {
+            "@type": "CreateHandle",
+            "handle": {"connectorId": "hive",
+                       "transactionHandle": {"@type": "hive"},
+                       "connectorHandle": {"@type": "hive",
+                                           "tableName": "wt_nation"}},
+            "schemaTableName": {"schema": "default", "table": "wt_nation"}}}
+        tfrag = translate_fragment(json.loads(json.dumps(frag)), twi)
+        wnode = tfrag.root
+        assert isinstance(wnode, P.TableWriterNode)
+        assert wnode.connector_id == "hive"
+        assert wnode.table_name == "wt_nation"
+
+        # finish over the writer (the LogicalPlanner's
+        # createTableWriterPlan shape, collapsed into one task here):
+        # translated as a wire TableFinishNode with the writer as source
+        finish = {
+            "@type": "com.facebook.presto.spi.plan.TableFinishNode",
+            "id": "finish", "source": writer,
+            "rowCountVariable": _vj("total")}
+        frag2 = dict(frag)
+        frag2["root"] = finish
+        frag2["partitioningScheme"] = {
+            "partitioning": frag["partitioningScheme"]["partitioning"],
+            "outputLayout": [_vj("total")]}
+        fnode = translate_fragment(json.loads(json.dumps(frag2)), twi).root
+        assert isinstance(fnode, P.TableFinishNode)
+        got = _run_node(runner, fnode)
+        assert got.rows[0][0] == 25
+        # the committed table scans back (staged rename happened)
+        scanned = runner.execute("select count(*), sum(nationkey) "
+                                 "from wt_nation")
+        assert scanned.rows[0] == [25, 300]
+    finally:
+        cat.unregister_connector("hive")
+
+
+def test_unnest_node_wire_sample(runner):
+    """UnnestNode wire layout per presto_protocol_core.h:2431-2438
+    (replicateVariables, unnestVariables as a "name<type>"-keyed map,
+    ordinalityVariable), under the projection building the array the way
+    the coordinator plans CROSS JOIN UNNEST.  Oracle: the engine's own
+    UNNEST SQL."""
+    arr_call = {"@type": "call", "displayName": "ARRAY_CONSTRUCTOR",
+                "functionHandle": {"@type": "$static", "signature": {
+                    "name": "presto.default.array_constructor",
+                    "kind": "SCALAR", "returnType": "array(bigint)",
+                    "argumentTypes": ["bigint", "bigint"],
+                    "typeVariableConstraints": [],
+                    "longVariableConstraints": [], "variableArity": True}},
+                "returnType": "array(bigint)",
+                "arguments": [_vj("n_nationkey"), _vj("n_regionkey")]}
+    proj = {"@type": ".ProjectNode", "id": "mkarr",
+            "source": _nation_scan_json(["n_nationkey", "n_regionkey"]),
+            "assignments": {"assignments": {
+                "n_nationkey<bigint>": _vj("n_nationkey"),
+                "arr<array(bigint)>": arr_call}},
+            "locality": "LOCAL"}
+    unnest = {
+        "@type": "com.facebook.presto.spi.plan.UnnestNode",
+        "id": "unnest", "source": proj,
+        "replicateVariables": [_vj("n_nationkey")],
+        "unnestVariables": {"arr<array(bigint)>": [_vj("x")]},
+        "ordinalityVariable": _vj("ord")}
+    node = T.translate_node(json.loads(json.dumps(unnest)))
+    assert isinstance(node, P.UnnestNode)
+    assert node.ordinality_variable is not None
+    got = _run_node(runner, node)
+    want = runner.execute(
+        "SELECT n_nationkey, x, i FROM nation CROSS JOIN "
+        "UNNEST(ARRAY[n_nationkey, n_regionkey]) WITH ORDINALITY "
+        "AS u(x, i)")
+    key = lambda r: tuple((v is None, v) for v in r)   # noqa: E731
+    assert sorted((tuple(r) for r in got.rows), key=key) \
+        == sorted((tuple(r) for r in want.rows), key=key)
+
+
 def test_group_id_node_wire_sample(runner):
     """GroupIdNode wire layout per presto_protocol_core.h:1340-1349
     (groupingSets: List<List<Variable>>, groupingColumns: Map with

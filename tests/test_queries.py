@@ -705,6 +705,66 @@ def test_sort_narrow_int_nulls_last():
     got = [(int(v), bool(n)) for v, n in
            zip(out.columns["k"].values, out.columns["k"].null_mask())]
     assert got == [(0, True), (8, False), (5, False), (3, False)]
+    # DESC negates the key: -INT32_MIN wraps at the narrow width, so
+    # non-null narrow ints must also promote under DESC
+    vals = jnp.asarray([5, -2147483648, 7, 0], dtype=jnp.int32)
+    b = Batch({"k": Column(vals)}, jnp.ones(4, dtype=bool))
+    out = ops.topn(b, [("k", "DESC_NULLS_LAST")], 4)
+    assert [int(v) for v in out.columns["k"].values] \
+        == [7, 5, 0, -2147483648]
+
+
+# ---------------------------------------------------------------------------
+# arrays / UNNEST (round-5; reference ArrayFunctions.java,
+# ArraySubscriptOperator.java, UnnestOperator.java)
+# ---------------------------------------------------------------------------
+
+def test_array_literal_and_subscript(runner):
+    check(runner, "select array[1, 2, 3][2], array[10, 20][1]")
+    check(runner, "select array[n_nationkey, n_regionkey][1] from nation "
+                  "where n_nationkey < 5")
+
+
+def test_array_functions(runner):
+    check(runner, "select cardinality(array[1,2,3]), "
+                  "element_at(array[10,20], 2), "
+                  "element_at(array[10,20], 7)")
+    check(runner, "select contains(array[1,2,3], n_regionkey), "
+                  "array_max(array[n_nationkey, n_regionkey]), "
+                  "array_min(array[n_nationkey, n_regionkey]), "
+                  "array_position(array[2,4,6], n_regionkey * 2) "
+                  "from nation")
+
+
+def test_unnest_basic(runner):
+    check(runner, "select x from unnest(array[3,1,2]) as u(x)")
+    check(runner, "select x from unnest(sequence(1, 6)) as u(x) "
+                  "where x % 2 = 0")
+
+
+def test_unnest_zip_null_pads(runner):
+    # multiple arrays align by position; the shorter null-extends
+    check(runner, "select x, y from unnest(array[1,2], "
+                  "array[10,20,30]) as u(x, y)")
+
+
+def test_unnest_lateral_with_ordinality(runner):
+    check(runner, """
+        select n_name, x, i from nation
+        cross join unnest(array[n_nationkey, n_regionkey])
+            with ordinality as u(x, i)
+        where n_nationkey < 5 order by n_name, i""", ordered=True)
+
+
+def test_unnest_feeds_aggregation(runner):
+    check(runner, """
+        select sum(x), count(*) from nation
+        cross join unnest(array[n_nationkey, n_regionkey, 7]) as u(x)""")
+
+
+def test_array_output_column(runner):
+    check(runner, "select n_name, array[n_nationkey, n_regionkey] "
+                  "from nation where n_nationkey < 4")
 
 
 # ---------------------------------------------------------------------------

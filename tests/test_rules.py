@@ -1,0 +1,166 @@
+"""Iterative rule optimizer (sql/rules.py — the IterativeOptimizer.java:62
+analog): per-rule rewrites, fixpoint driving, hit stats in EXPLAIN."""
+import pytest
+
+from presto_tpu.common.types import BIGINT, BOOLEAN
+from presto_tpu.spi import plan as P
+from presto_tpu.spi.expr import (ConstantExpression,
+                                 VariableReferenceExpression, call, constant,
+                                 variable)
+from presto_tpu.sql.rules import DEFAULT_RULES, IterativeOptimizer
+from presto_tpu.exec.runner import LocalQueryRunner
+
+
+def _v(name):
+    return variable(name, BIGINT)
+
+
+def _scan(*cols):
+    vs = [_v(c) for c in cols]
+    return P.TableScanNode(
+        "scan", P.TableHandle("tpch", "tpch", "nation",
+                              (("scaleFactor", 0.01),)),
+        vs, {v: P.ColumnHandle(c.split("_", 1)[-1], BIGINT)
+             for v, c in zip(vs, cols)})
+
+
+def _opt(root, stats=None):
+    return IterativeOptimizer(DEFAULT_RULES).run(root, stats)
+
+
+def test_merge_filters_and_trivial():
+    scan = _scan("n_nationkey")
+    pred = call("gt", BOOLEAN, _v("n_nationkey"), constant(3, BIGINT))
+    plan = P.FilterNode("f1", P.FilterNode("f2", scan, pred),
+                        constant(True, BOOLEAN))
+    stats = {}
+    out = _opt(plan, stats)
+    # TRUE-filter removed, leaving the single real filter
+    assert isinstance(out, P.FilterNode)
+    assert out.source is scan
+    assert stats.get("RemoveTrivialFilters") == 1
+
+
+def test_false_filter_becomes_empty_values():
+    plan = P.FilterNode("f", _scan("n_nationkey"),
+                        constant(False, BOOLEAN))
+    out = _opt(plan)
+    assert isinstance(out, P.ValuesNode) and out.rows == []
+
+
+def test_merge_limits_and_zero_limit():
+    scan = _scan("n_nationkey")
+    out = _opt(P.LimitNode("l1", P.LimitNode("l2", scan, 5), 10))
+    assert isinstance(out, P.LimitNode) and out.count == 5
+    assert out.source is scan
+    out = _opt(P.LimitNode("l", scan, 0))
+    assert isinstance(out, P.ValuesNode)
+
+
+def test_create_topn():
+    scan = _scan("n_nationkey")
+    scheme = P.OrderingScheme([(_v("n_nationkey"), "ASC_NULLS_LAST")])
+    out = _opt(P.LimitNode("l", P.SortNode("s", scan, scheme), 7))
+    assert isinstance(out, P.TopNNode)
+    assert out.count == 7 and out.source is scan
+
+
+def test_push_limit_through_project():
+    scan = _scan("n_nationkey")
+    proj = P.ProjectNode("p", scan, {_v("x"): call(
+        "add", BIGINT, _v("n_nationkey"), constant(1, BIGINT))})
+    out = _opt(P.LimitNode("l", proj, 3))
+    assert isinstance(out, P.ProjectNode)
+    assert isinstance(out.source, P.LimitNode)
+
+
+def test_remove_identity_projection():
+    scan = _scan("n_nationkey", "n_regionkey")
+    ident = P.ProjectNode("p", scan,
+                          {v: v for v in scan.output_variables})
+    out = _opt(P.LimitNode("l", ident, 3))
+    assert isinstance(out.source, P.TableScanNode)
+
+
+def test_inline_rename_projections():
+    scan = _scan("n_nationkey")
+    inner = P.ProjectNode("p1", scan, {_v("a"): _v("n_nationkey")})
+    outer = P.ProjectNode("p2", inner, {_v("b"): call(
+        "add", BIGINT, _v("a"), constant(1, BIGINT))})
+    out = _opt(outer)
+    assert isinstance(out, P.ProjectNode) and out.source is scan
+    (v, e), = out.assignments.items()
+    assert v.name == "b"
+    assert e.arguments[0].name == "n_nationkey"   # substituted through
+
+
+def test_push_filter_through_rename_project():
+    scan = _scan("n_nationkey")
+    proj = P.ProjectNode("p", scan, {_v("a"): _v("n_nationkey")})
+    pred = call("gt", BOOLEAN, _v("a"), constant(3, BIGINT))
+    out = _opt(P.FilterNode("f", proj, pred))
+    assert isinstance(out, P.ProjectNode)
+    assert isinstance(out.source, P.FilterNode)
+    assert out.source.predicate.arguments[0].name == "n_nationkey"
+
+
+def test_swap_join_sides_puts_small_build_right():
+    big = _scan("l_orderkey")
+    big.table = P.TableHandle("tpch", "tpch", "lineitem",
+                              (("scaleFactor", 0.01),))
+    small = P.TableScanNode(
+        "scan2", P.TableHandle("tpch", "tpch", "nation",
+                               (("scaleFactor", 0.01),)),
+        [_v("n_nationkey")],
+        {_v("n_nationkey"): P.ColumnHandle("nationkey", BIGINT)})
+    join = P.JoinNode("j", P.INNER, small, big,
+                      [(_v("n_nationkey"), _v("l_orderkey"))],
+                      [_v("n_nationkey"), _v("l_orderkey")])
+    stats = {}
+    out = _opt(join, stats)
+    assert stats.get("SwapJoinSides") == 1
+    assert out.right.table.table_name == "nation"   # small side builds
+
+
+def test_merge_limit_with_distinct():
+    scan = _scan("n_regionkey")
+    agg = P.AggregationNode("a", scan, {}, [_v("n_regionkey")])
+    out = _opt(P.LimitNode("l", agg, 3))
+    assert isinstance(out, P.DistinctLimitNode)
+    assert out.count == 3
+
+
+def test_fixpoint_chains_rules():
+    """Limit(Limit(Project-identity(Sort))) collapses through three rules
+    in one run."""
+    scan = _scan("n_nationkey")
+    scheme = P.OrderingScheme([(_v("n_nationkey"), "ASC_NULLS_LAST")])
+    sort = P.SortNode("s", scan, scheme)
+    ident = P.ProjectNode("p", sort, {v: v for v in sort.output_variables})
+    plan = P.LimitNode("l1", P.LimitNode("l2", ident, 9), 4)
+    stats = {}
+    out = _opt(plan, stats)
+    assert isinstance(out, P.TopNNode) and out.count == 4
+    assert out.source is scan
+    assert stats.get("CreateTopN") == 1
+    assert sum(stats.values()) >= 3   # ident-project, topn, limit-merge
+
+
+def test_explain_reports_rule_hits():
+    r = LocalQueryRunner("sf0.01")
+    res = r.execute("explain select * from "
+                    "(select n_name from nation order by n_name) limit 3")
+    text = res.rows[0][0]
+    assert "Optimizer rules fired:" in text
+
+
+def test_rules_preserve_query_results():
+    r = LocalQueryRunner("sf0.01")
+    for sql, ordered in [
+        ("select n_name from nation where n_nationkey > 3 "
+         "order by n_name limit 4", True),
+        # limit >= the distinct count so the row SET is deterministic
+        ("select distinct o_orderstatus from orders limit 5", False),
+        ("select c_custkey + 1 from customer where c_custkey < 10", False),
+    ]:
+        r.assert_same_as_reference(sql, ordered=ordered)

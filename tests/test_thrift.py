@@ -1,0 +1,162 @@
+"""Thrift binary transport for the TaskStatus hot path (VERDICT r4 next
+#9: the third negotiated transport — HttpRemoteTask.java:915-931,
+TaskResource.cpp:218-224, presto_thrift.thrift:292-314).
+
+Layers: byte-level goldens hand-derived from the public Thrift binary
+protocol spec, schema round-trips (incl. the recursive
+ExecutionFailureInfo), forward-compatible unknown-field skipping, and a
+live worker serving TaskStatus three ways (JSON / SMILE / Thrift) from
+one endpoint."""
+import base64
+import json
+import struct
+import threading
+import time
+import urllib.request
+
+from presto_tpu.worker import smile, thrift
+
+
+# ---------------------------------------------------------------------------
+# spec goldens
+# ---------------------------------------------------------------------------
+
+def test_golden_minimal_status():
+    # field 3 (version, i64): type 0x0A, id 0x0003, value 7;
+    # field 4 (state enum/i32): type 0x08, id 0x0004, RUNNING=1; T_STOP
+    raw = thrift.encode_struct(thrift.TASK_STATUS,
+                               {"version": 7, "state": "RUNNING"})
+    assert raw == (b"\x0a\x00\x03" + struct.pack(">q", 7)
+                   + b"\x08\x00\x04" + struct.pack(">i", 1)
+                   + b"\x00")
+
+
+def test_golden_string_field():
+    raw = thrift.encode_struct(thrift.TASK_STATUS, {"selfUri": "http://x"})
+    assert raw == (b"\x0b\x00\x05" + struct.pack(">i", 8) + b"http://x"
+                   + b"\x00")
+
+
+def test_round_trip_full_status():
+    d = {"taskInstanceIdLeastSignificantBits": 1,
+         "taskInstanceIdMostSignificantBits": 2,
+         "version": 42, "state": "FAILED", "selfUri": "http://w:8080/t",
+         "completedDriverGroups": [{"grouped": True, "groupId": 3}],
+         "failures": [{"type": "X", "message": "boom",
+                       "stack": ["a", "b"],
+                       "errorCode": {"code": 1, "name": "GENERIC",
+                                     "type": "INTERNAL_ERROR",
+                                     "retriable": False},
+                       "cause": {"type": "Y", "message": "inner"}}],
+         "queuedPartitionedDrivers": 4, "runningPartitionedDrivers": 5,
+         "outputBufferUtilization": 0.25, "outputBufferOverutilized": True,
+         "physicalWrittenDataSizeInBytes": 10,
+         "memoryReservationInBytes": 11,
+         "systemMemoryReservationInBytes": 12, "fullGcCount": 0,
+         "fullGcTimeInMillis": 0,
+         "peakNodeTotalMemoryReservationInBytes": 13,
+         "totalCpuTimeInNanos": 14, "taskAgeInMillis": 15,
+         "queuedPartitionedSplitsWeight": 16,
+         "runningPartitionedSplitsWeight": 17}
+    raw = thrift.encode_struct(thrift.TASK_STATUS, d)
+    out, end = thrift.decode_struct(thrift.TASK_STATUS, memoryview(raw))
+    assert end == len(raw)
+    assert out["state"] == "FAILED"
+    assert out["failures"][0]["cause"]["message"] == "inner"
+    assert out["failures"][0]["errorCode"]["type"] == "INTERNAL_ERROR"
+    assert out["completedDriverGroups"] == [{"grouped": True, "groupId": 3}]
+    assert out["outputBufferUtilization"] == 0.25
+    for k, v in d.items():
+        if k not in ("failures", "completedDriverGroups"):
+            assert out[k] == v, k
+
+
+def test_unknown_fields_are_skipped():
+    """Forward compatibility: bytes carrying a field id this schema does
+    not know must decode cleanly (the reference's thrift evolution
+    contract)."""
+    known = thrift.encode_struct(thrift.TASK_STATUS, {"version": 9})
+    # splice an unknown string field id 99 before the stop byte
+    unknown = (b"\x0b\x00\x63" + struct.pack(">i", 3) + b"xyz")
+    raw = known[:-1] + unknown + b"\x00"
+    out, _ = thrift.decode_struct(thrift.TASK_STATUS, memoryview(raw))
+    assert out == {"version": 9}
+
+
+def test_json_bridge_maps_self_uri():
+    d = {"version": 1, "state": "RUNNING", "self": "http://w/t",
+         "failures": ["boom"], "memoryReservationInBytes": 5}
+    raw = thrift.task_status_to_thrift(d)
+    back = thrift.task_status_from_thrift(raw)
+    assert back["self"] == "http://w/t"
+    assert back["failures"][0]["message"] == "boom"
+    assert back["memoryReservationInBytes"] == 5
+
+
+# ---------------------------------------------------------------------------
+# live worker: one endpoint, three transports
+# ---------------------------------------------------------------------------
+
+def test_task_status_negotiates_three_transports():
+    from presto_tpu.connectors import catalog as cat
+    from presto_tpu.spi import plan as P
+    from presto_tpu.sql.planner import Planner
+    from presto_tpu.worker.server import WorkerServer
+
+    w = WorkerServer()
+    try:
+        out = Planner(default_schema="sf0.01", default_catalog="tpch") \
+            .plan("SELECT count(*) AS n FROM nation")
+        frag = P.PlanFragment(
+            "0", out, P.SOURCE_DISTRIBUTION,
+            P.PartitioningScheme(P.SINGLE_DISTRIBUTION, [],
+                                 list(out.output_variables)),
+            [n.id for n in P.walk_plan(out)
+             if isinstance(n, P.TableScanNode)])
+        body = {
+            "taskId": "thr.0.0.0.0",
+            "fragment": base64.b64encode(
+                json.dumps(frag.to_dict()).encode()).decode(),
+            "sources": [{"planNodeId": sid,
+                         "splits": [s.to_dict() for s in
+                                    cat.make_splits("nation", 0.01, 2)],
+                         "noMoreSplits": True}
+                        for sid in frag.partitioned_sources],
+            "outputBuffers": {"type": "PARTITIONED", "nBuffers": 1,
+                              "partitionKeys": []},
+        }
+        req = urllib.request.Request(
+            f"{w.uri}/v1/task/thr.0.0.0.0",
+            data=json.dumps(body).encode(), method="POST",
+            headers={"Content-Type": "application/json",
+                     "Accept": thrift.CONTENT_TYPE})
+        resp = urllib.request.urlopen(req)
+        assert resp.headers.get("Content-Type") == thrift.CONTENT_TYPE
+        st = thrift.task_status_from_thrift(resp.read())
+        assert st["state"] in ("PLANNED", "RUNNING", "FINISHED")
+
+        deadline = time.time() + 120
+        status_url = f"{w.uri}/v1/task/thr.0.0.0.0/status"
+        while time.time() < deadline:
+            r = urllib.request.urlopen(urllib.request.Request(
+                status_url, headers={"Accept": thrift.CONTENT_TYPE}))
+            st = thrift.task_status_from_thrift(r.read())
+            if st["state"] in ("FINISHED", "FAILED", "CANCELED"):
+                break
+            time.sleep(0.05)
+        assert st["state"] == "FINISHED"
+
+        # the SAME endpoint three ways: field-for-field agreement
+        as_json = json.loads(urllib.request.urlopen(urllib.request.Request(
+            status_url, headers={"Accept": "application/json"})).read())
+        as_smile = smile.decode(urllib.request.urlopen(
+            urllib.request.Request(
+                status_url,
+                headers={"Accept": smile.CONTENT_TYPE})).read())
+        assert as_json["state"] == as_smile["state"] == st["state"]
+        assert as_json["version"] == as_smile["version"] == st["version"]
+        assert as_json["self"] == as_smile["self"] == st["self"]
+        assert as_json["memoryReservationInBytes"] \
+            == st["memoryReservationInBytes"]
+    finally:
+        w.close()
