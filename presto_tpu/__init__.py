@@ -35,7 +35,29 @@ def _host_fingerprint() -> str:
     return hashlib.sha256(feats.encode()).hexdigest()[:12]
 
 
-if not _os.environ.get("PRESTO_TPU_NO_COMPILE_CACHE"):
+# The XLA:CPU backend persists AOT executables whose recorded machine
+# features can mismatch even the producing host's runtime detection
+# (cpu_aot_loader warns "could lead to execution errors such as SIGILL",
+# and full-suite runs twice segfaulted inside
+# compilation_cache.get_executable_and_time) — so the persistent cache
+# stays OFF for the CPU backend and ON for TPU, where compiles are the
+# expensive path it exists for.  The backend is taken from the FIRST
+# JAX_PLATFORMS entry when set; otherwise from the resolved default
+# backend (initializing it — every real process does so moments later).
+def _wants_persistent_cache() -> bool:
+    plat = (_os.environ.get("JAX_PLATFORMS")
+            or _os.environ.get("JAX_PLATFORM_NAME") or "")
+    first = plat.split(",")[0].strip().lower()
+    if first:
+        return first != "cpu"
+    try:
+        return _jax.default_backend() != "cpu"
+    except Exception:
+        return False
+
+
+if not _os.environ.get("PRESTO_TPU_NO_COMPILE_CACHE") \
+        and _wants_persistent_cache():
     _cache_dir = _os.environ.get("JAX_COMPILATION_CACHE_DIR")
     if _cache_dir is None:
         _cache_dir = _os.path.join(

@@ -43,6 +43,7 @@ def like_matcher(pattern: str, escape: Optional[str] = None):
     rx = re.compile("".join(out), re.DOTALL)
     return lambda s: rx.fullmatch(s) is not None
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -321,6 +322,73 @@ class Lowering:
                     "cardinality", "contains", "array_max", "array_min",
                     "array_position", "repeat", "sequence"):
             return self._array_fn(name, expr, batch)
+        # -- math/bitwise breadth (MathFunctions.java, BitwiseFunctions.java)
+        if name == "log":
+            b = self.eval(args[0], batch)
+            x = self.eval(args[1], batch)
+            bv = _to_common_numeric(b, args[0].type, DoubleType())
+            xv = _to_common_numeric(x, args[1].type, DoubleType())
+            return Column(jnp.log(xv) / jnp.log(bv), _combine_nulls(b, x))
+        if name == "atan2":
+            y = self.eval(args[0], batch)
+            x = self.eval(args[1], batch)
+            yv = _to_common_numeric(y, args[0].type, DoubleType())
+            xv = _to_common_numeric(x, args[1].type, DoubleType())
+            return Column(jnp.arctan2(yv, xv), _combine_nulls(y, x))
+        if name in ("is_nan", "is_finite", "is_infinite"):
+            c = self.eval(args[0], batch)
+            v = _to_common_numeric(c, args[0].type, DoubleType())
+            out = {"is_nan": jnp.isnan, "is_finite": jnp.isfinite,
+                   "is_infinite": jnp.isinf}[name](v)
+            return Column(out, c.nulls)
+        if name in ("bitwise_and", "bitwise_or", "bitwise_xor"):
+            a = self.eval(args[0], batch)
+            b = self.eval(args[1], batch)
+            op = {"bitwise_and": jnp.bitwise_and,
+                  "bitwise_or": jnp.bitwise_or,
+                  "bitwise_xor": jnp.bitwise_xor}[name]
+            return Column(op(a.values.astype(jnp.int64),
+                             b.values.astype(jnp.int64)),
+                          _combine_nulls(a, b))
+        if name == "bitwise_not":
+            c = self.eval(args[0], batch)
+            return Column(~c.values.astype(jnp.int64), c.nulls)
+        if name in ("bitwise_left_shift", "bitwise_right_shift",
+                    "bitwise_arithmetic_shift_right"):
+            a = self.eval(args[0], batch)
+            b = self.eval(args[1], batch)
+            av = a.values.astype(jnp.int64)
+            sh = jnp.clip(b.values.astype(jnp.int64), 0, 63)
+            if name == "bitwise_left_shift":
+                out = av << sh
+            elif name == "bitwise_arithmetic_shift_right":
+                out = av >> sh
+            else:       # logical right shift
+                out = jax.lax.shift_right_logical(av, sh)
+            return Column(out, _combine_nulls(a, b))
+        if name == "width_bucket":
+            x = self.eval(args[0], batch)
+            lo = self.eval(args[1], batch)
+            hi = self.eval(args[2], batch)
+            n = self.eval(args[3], batch)
+            xv = _to_common_numeric(x, args[0].type, DoubleType())
+            lov = _to_common_numeric(lo, args[1].type, DoubleType())
+            hiv = _to_common_numeric(hi, args[2].type, DoubleType())
+            nv = n.values.astype(jnp.int64)
+            span = jnp.where(hiv == lov, 1.0, hiv - lov)
+            v = (xv - lov) * nv / span
+            # 1-ulp tolerance before the floor: XLA's CPU fast-math may
+            # reassociate a*n/b as a*(n/b), landing a hair under exact
+            # bucket edges; the oracle applies the same nudge, making the
+            # edge definition shared rather than compiler-dependent
+            bucket = jnp.floor(v * (1 + 2.0 ** -40)).astype(jnp.int64) + 1
+            out = jnp.clip(bucket, 0, jnp.maximum(nv + 1, 0))
+            # Presto ERRORS on bucketCount <= 0; relaxed to NULL here
+            # (the documented error->NULL convention), oracle-mirrored
+            nulls = _combine_nulls(x, lo, hi, n)
+            bad = nv <= 0
+            nulls = bad if nulls is None else (nulls | bad)
+            return Column(out, nulls)
         raise NotImplementedError(f"scalar function {expr.display_name!r}")
 
     # -- array functions (fixed-width (capacity, W) representation) --------
@@ -457,9 +525,15 @@ class Lowering:
             mapped = [fn(s, *extra) for s in c.dictionary]
             return _reencode(c, mapped)
         fn, dtype = _STRING_TO_VALUE[name]
-        table = jnp.asarray(np.array([fn(s, *extra) for s in c.dictionary],
+        raw = [fn(s, *extra) for s in c.dictionary]
+        table = jnp.asarray(np.array([0 if v is None else v for v in raw],
                                      dtype=dtype))
-        return Column(table[c.values], c.nulls)
+        out_nulls = c.nulls
+        if any(v is None for v in raw):
+            null_tab = jnp.asarray(np.array([v is None for v in raw]))
+            out_nulls = null_tab[c.values] if out_nulls is None \
+                else (null_tab[c.values] | out_nulls)
+        return Column(table[c.values], out_nulls)
 
     def _concat(self, args, batch: Batch) -> Column:
         cols = [self.eval(a, batch) for a in args]
@@ -873,6 +947,7 @@ _DOUBLE_FNS = {
     "sin": jnp.sin, "cos": jnp.cos, "tan": jnp.tan,
     "asin": jnp.arcsin, "acos": jnp.arccos, "atan": jnp.arctan,
     "cbrt": jnp.cbrt, "degrees": jnp.degrees, "radians": jnp.radians,
+    "sinh": jnp.sinh, "cosh": jnp.cosh, "tanh": jnp.tanh,
     "power": None,     # binary; handled inline
 }
 
@@ -898,6 +973,85 @@ def _replace(s, find, repl=""):
     return s.replace(str(find), str(repl))
 
 
+# -- regexp / URL / JSON / split scalar kernels (pure python over
+# dictionary entries or host-materialized strings; the per-entry
+# semantics follow the reference's operator/scalar implementations:
+# RegexpFunctions (re2j semantics approximated by `re`),
+# UrlFunctions.java, JsonFunctions.java, StringFunctions.split_part).
+# A kernel may return None = SQL NULL; the dictionary remap carries it
+# into the null mask.
+
+def _re_compiled(pattern):
+    import re
+    return re.compile(str(pattern))
+
+
+def _regexp_like(s, pattern):
+    return _re_compiled(pattern).search(s) is not None
+
+
+def _regexp_extract(s, pattern, group=0):
+    m = _re_compiled(pattern).search(s)
+    if m is None:
+        return None
+    try:
+        return m.group(int(group))
+    except IndexError:
+        return None
+
+
+def _regexp_replace(s, pattern, repl=""):
+    import re
+    # Presto replacement references are $N / ${name}; python wants \N
+    py = re.sub(r"\$(\d+)", r"\\\1", str(repl))
+    py = re.sub(r"\$\{(\w+)\}", r"\\g<\1>", py)
+    return _re_compiled(pattern).sub(py, s)
+
+
+def _split_part(s, delim, index):
+    parts = s.split(str(delim))
+    i = int(index)
+    if i < 1 or i > len(parts):
+        return None
+    return parts[i - 1]
+
+
+def _url_parts(s):
+    from urllib.parse import urlparse
+    return urlparse(s)
+
+
+def _json_extract_scalar(s, path):
+    """Subset of the reference JsonExtract path language:
+    $.a.b[0].c — object fields and array subscripts."""
+    import json as _json
+    import re
+    try:
+        v = _json.loads(s)
+    except (ValueError, TypeError):
+        return None
+    p = str(path)
+    if not p.startswith("$"):
+        return None
+    for tok in re.findall(r"\.([A-Za-z_][\w]*)|\[(\d+)\]|\[\"([^\"]+)\"\]",
+                          p[1:]):
+        field, idx, qfield = tok
+        key = field or qfield
+        if key:
+            if not isinstance(v, dict) or key not in v:
+                return None
+            v = v[key]
+        else:
+            if not isinstance(v, list) or int(idx) >= len(v):
+                return None
+            v = v[int(idx)]
+    if isinstance(v, (dict, list)) or v is None:
+        return None          # scalar extraction only (reference contract)
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    return str(v)
+
+
 _STRING_TO_STRING = {
     "upper": lambda s: s.upper(),
     "lower": lambda s: s.lower(),
@@ -908,24 +1062,50 @@ _STRING_TO_STRING = {
     "replace": _replace,
     "lpad": _lpad,
     "rpad": _rpad,
+    "regexp_extract": _regexp_extract,
+    "regexp_replace": _regexp_replace,
+    "split_part": _split_part,
+    "url_extract_protocol": lambda s: _url_parts(s).scheme or None,
+    "url_extract_host": lambda s: _url_parts(s).hostname or None,
+    "url_extract_path": lambda s: _url_parts(s).path,
+    "url_extract_query": lambda s: _url_parts(s).query or None,
+    "url_extract_fragment": lambda s: _url_parts(s).fragment or None,
+    "json_extract_scalar": _json_extract_scalar,
 }
 
 _STRING_TO_VALUE = {
     # name -> (fn(entry, *const_args), numpy dtype)
     "strpos": (lambda s, sub: s.find(str(sub)) + 1, np.int64),
     "starts_with": (lambda s, p: s.startswith(str(p)), bool),
+    "ends_with": (lambda s, p: s.endswith(str(p)), bool),
+    "regexp_like": (_regexp_like, bool),
+    "codepoint": (lambda s: ord(s[0]) if s else None, np.int64),
+    "url_extract_port": (lambda s: _url_port(s), np.int64),
 }
+
+
+def _url_port(s):
+    try:
+        return _url_parts(s).port       # None when absent
+    except ValueError:                  # malformed port -> NULL (Presto
+        return None                     # UrlFunctions returns null)
 
 
 def _reencode(c: Column, mapped) -> Column:
     """Remap a dictionary column through transformed entries, dedup+sort the
     result so codes stay rank codes (grouping and order comparisons depend
-    on it)."""
-    uniq = tuple(sorted(set(mapped)))
+    on it).  None entries become NULL rows."""
+    uniq = tuple(sorted({s for s in mapped if s is not None}))
     index = {s: i for i, s in enumerate(uniq)}
-    remap = jnp.asarray(np.array([index[s] for s in mapped],
-                                 dtype=np.int32))
-    return Column(remap[c.values], c.nulls, uniq)
+    remap = jnp.asarray(np.array([0 if s is None else index[s]
+                                  for s in mapped], dtype=np.int32))
+    if any(s is None for s in mapped):
+        null_tab = jnp.asarray(np.array([s is None for s in mapped]))
+        nulls = null_tab[c.values]
+        if c.nulls is not None:
+            nulls = nulls | c.nulls
+        return Column(remap[c.values], nulls, uniq or ("",))
+    return Column(remap[c.values], c.nulls, uniq or ("",))
 
 
 def _civil_from_days(z):

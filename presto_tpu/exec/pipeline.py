@@ -33,7 +33,7 @@ from ..common.page import Page
 from ..common.types import (BIGINT, BOOLEAN, DOUBLE, DecimalType, DoubleType,
                             RealType, Type, VarcharType, CharType)
 from ..connectors import catalog, tpch
-from ..spi.expr import (CallExpression, RowExpression,
+from ..spi.expr import (CallExpression, ConstantExpression, RowExpression,
                         VariableReferenceExpression)
 from ..spi import plan as P
 from .batch import (Batch, Column, batch_to_page, page_to_batch,
@@ -140,10 +140,6 @@ class ExecutionConfig:
     # compile scan→filter/project→direct-agg chains into ONE XLA program
     # (fori_loop over split chunks): eliminates per-batch dispatch overhead
     fuse_pipelines: bool = True
-    # opt-in: route eligible integer direct aggregations (streaming and
-    # fused paths) through the Pallas MXU kernel (ops/pallas_agg.py)
-    # instead of XLA masked reductions
-    pallas_agg: bool = False
     # compress exchange pages on the wire (SerializedPage COMPRESSED
     # marker; opt-in like the reference's exchange.compression-enabled —
     # same-host exchanges have no bandwidth to save, cross-host ones do)
@@ -166,7 +162,11 @@ class ExecutionConfig:
     # kernels either way.  NOTE: a pipeline the whole-program fuser
     # accepts (fuse_pipelines=True, all-device scan chain) runs as ONE
     # XLA program with no per-batch host work to overlap — driver threads
-    # apply to the STREAMING paths (host columns, windows, sorts, spills)
+    # apply to the STREAMING paths (host columns, windows, sorts, spills).
+    # Measured on chip (round 5): a single-chip streaming group-by showed
+    # no wall-clock win at 4 drivers (5.50s vs 5.56s) because the device
+    # serializes kernels; the default stays 1, and >1 remains for
+    # multi-core HOST work (spill IO, page serde, host-generated columns)
     task_concurrency: int = 1
 
 
@@ -1136,8 +1136,7 @@ class PlanCompiler:
                         agg_cols[out] = (low.eval(expr, batch)
                                          if expr is not None else None)
                     return ops.agg_direct_update(state, batch, codes,
-                                                 agg_cols, specs, G,
-                                                 use_pallas=cfg.pallas_agg)
+                                                 agg_cols, specs, G)
                 fn = self.shared_jit((node.id, "agg_direct", G, strides),
                                      fn)
                 update_cache[("direct", G, strides)] = fn
@@ -1315,7 +1314,6 @@ class PlanCompiler:
             cnt_arr = jnp.asarray([c1 for _, c1 in chunks],
                                   dtype=jnp.int64)
             S = len(chunks)
-            use_pallas = cfg.pallas_agg
 
             def loop(key, update, init_state):
                 """fori_loop over scan chunks; the jitted program is cached
@@ -1353,7 +1351,7 @@ class PlanCompiler:
                 def update(st, b):
                     return ops.agg_direct_update(
                         st, b, stride_codes(b, strides, G),
-                        _agg_exprs(b), specs, G, use_pallas=use_pallas)
+                        _agg_exprs(b), specs, G)
                 state = loop(("direct",), update,
                              ops.agg_direct_init(G, specs))
                 return ops.agg_direct_finalize(
@@ -2852,13 +2850,22 @@ def _hoist_key(e: RowExpression) -> str:
     return json.dumps(e.to_dict(), sort_keys=True, default=str)
 
 
+# lazy-column-hoistable string-breadth functions: column first, constant
+# extras, never-NULL results (the xform caches carry no null channel)
+_HOIST_XFORM = ("regexp_replace",)
+_HOIST_PRED = ("regexp_like", "starts_with", "ends_with")
+
+
 def _hoistable_var(e: CallExpression):
     """The single column argument of a host-hoistable string call, or
     None.  like/substr take the column first; concat takes one column
     anywhere among constant parts."""
     name = canonical_name(e.display_name)
-    if name in ("like", "substr") and e.arguments and isinstance(
-            e.arguments[0], VariableReferenceExpression):
+    if name in ("like", "substr") + _HOIST_XFORM + _HOIST_PRED \
+            and e.arguments and isinstance(
+                e.arguments[0], VariableReferenceExpression) \
+            and all(isinstance(a, ConstantExpression)
+                    for a in e.arguments[1:]):
         return e.arguments[0]
     if name == "concat":
         var_args = [a for a in e.arguments
@@ -3029,11 +3036,50 @@ def _column_xform_codes(cid, table, column, sf, tag, fn):
     return cdict, codes_all
 
 
+_PRED_VALUE_CACHE: Dict[Tuple, np.ndarray] = {}
+
+
+def _column_pred_values(cid, table, column, sf, tag, fn, dtype):
+    """Per-row results of a value-returning string kernel over the whole
+    column (the _column_like_mask pattern, generalized)."""
+    key = (cid, table, column, sf, tag)
+    out = _PRED_VALUE_CACHE.get(key)
+    if out is None:
+        n = catalog.table_row_count(table, sf, cid)
+        out = np.empty(n, dtype=dtype)
+        for pos in range(0, n, 1 << 18):
+            cnt = min(1 << 18, n - pos)
+            strings = catalog.generate_values_at(
+                table, column, sf,
+                np.arange(pos, pos + cnt, dtype=np.int64), cid)
+            out[pos:pos + cnt] = np.fromiter(
+                (fn(x) for x in strings), dtype=dtype, count=cnt)
+        _cache_put(_PRED_VALUE_CACHE, key, out)
+    return out
+
+
 def _host_string_column(call_expr: CallExpression, batch: Batch) -> Column:
     arg = _hoistable_var(call_expr)
     col = batch.columns[arg.name]
     cid, table, column, sf = col.lazy
     name = canonical_name(call_expr.display_name)
+    from .lowering import _STRING_TO_STRING, _STRING_TO_VALUE
+    if name in _HOIST_XFORM:
+        extra = tuple(a.value for a in call_expr.arguments[1:])
+        kern = _STRING_TO_STRING[name]
+        cdict, codes_all = _column_xform_codes(
+            cid, table, column, sf, (name,) + extra,
+            lambda x, _k=kern, _e=extra: _k(x, *_e))
+        ids = np.clip(np.asarray(col.values), 0, len(codes_all) - 1)
+        return Column(jnp.asarray(codes_all[ids]), col.nulls, cdict)
+    if name in _HOIST_PRED:
+        extra = tuple(a.value for a in call_expr.arguments[1:])
+        kern, dtype = _STRING_TO_VALUE[name]
+        vals_all = _column_pred_values(
+            cid, table, column, sf, (name,) + extra,
+            lambda x, _k=kern, _e=extra: _k(x, *_e), dtype)
+        ids = np.clip(np.asarray(col.values), 0, len(vals_all) - 1)
+        return Column(jnp.asarray(vals_all[ids]), col.nulls)
     if name == "concat":
         parts = tuple(None if isinstance(a, VariableReferenceExpression)
                       else str(a.value) for a in call_expr.arguments)

@@ -1072,7 +1072,114 @@ def _eval_call(expr: CallExpression, t: Table) -> Col:
     if name in ("day_of_week", "day_of_year", "week", "date_trunc",
                 "date_add", "date_diff"):
         return _eval_date_fn(name, expr, t)
+    if name in ("regexp_like", "regexp_extract", "regexp_replace",
+                "split_part", "ends_with", "codepoint",
+                "url_extract_protocol", "url_extract_host",
+                "url_extract_path", "url_extract_query",
+                "url_extract_fragment", "url_extract_port",
+                "json_extract_scalar"):
+        return _eval_string_breadth(name, expr, t)
+    if name in ("log", "atan2"):
+        acol = _eval(args[0], t)
+        a = _numeric_domain(args[0], acol, True, 0)
+        bcol = _eval(args[1], t)
+        b = _numeric_domain(args[1], bcol, True, 0)
+        m = acol[1]
+        if bcol[1] is not None:
+            m = bcol[1] if m is None else (m | bcol[1])
+        if name == "log":
+            out = [_m.log(y) / _m.log(x) for x, y in zip(a, b)]
+        else:
+            out = [_m.atan2(x, y) for x, y in zip(a, b)]
+        return (np.array(out, dtype=np.float64), m)
+    if name in ("sinh", "cosh", "tanh"):
+        col = _eval(args[0], t)
+        a = _numeric_domain(args[0], col, True, 0)
+        fn = {"sinh": _m.sinh, "cosh": _m.cosh, "tanh": _m.tanh}[name]
+        return (np.array([fn(x) for x in a], dtype=np.float64), col[1])
+    if name in ("is_nan", "is_finite", "is_infinite"):
+        col = _eval(args[0], t)
+        a = _numeric_domain(args[0], col, True, 0)
+        fn = {"is_nan": _m.isnan, "is_finite": _m.isfinite,
+              "is_infinite": _m.isinf}[name]
+        return (np.array([fn(x) for x in a]), col[1])
+    if name.startswith("bitwise_") or name == "width_bucket":
+        cols = [_eval(a, t) for a in args]
+        m = None
+        for c in cols:
+            if c[1] is not None:
+                m = c[1] if m is None else (m | c[1])
+        av = [int(x) for x in cols[0][0]]
+        if name == "bitwise_not":
+            return (np.array([~x for x in av], dtype=np.int64), m)
+        if name == "width_bucket":
+            xs = _numeric_domain(args[0], cols[0], True, 0)
+            los = _numeric_domain(args[1], cols[1], True, 0)
+            his = _numeric_domain(args[2], cols[2], True, 0)
+            ns = [int(x) for x in cols[3][0]]
+            out = []
+            bad = np.zeros(t.n, dtype=bool)
+            for i, (x, lo, hi, n) in enumerate(zip(xs, los, his, ns)):
+                if n <= 0:         # error->NULL relaxation (engine mirror)
+                    bad[i] = True
+                    out.append(0)
+                    continue
+                span = (hi - lo) or 1.0
+                # 1-ulp edge tolerance shared with the engine (see
+                # lowering.py width_bucket)
+                v = (x - lo) * n / span
+                b = int(_m.floor(v * (1 + 2.0 ** -40))) + 1
+                out.append(max(0, min(b, n + 1)))
+            if bad.any():
+                m = bad if m is None else (m | bad)
+            return (np.array(out, dtype=np.int64), m)
+        bv = [int(x) for x in cols[1][0]]
+        ops_map = {
+            "bitwise_and": lambda x, y: x & y,
+            "bitwise_or": lambda x, y: x | y,
+            "bitwise_xor": lambda x, y: x ^ y,
+            "bitwise_left_shift": lambda x, y: _i64(x << min(max(y, 0), 63)),
+            "bitwise_arithmetic_shift_right":
+                lambda x, y: x >> min(max(y, 0), 63),
+            "bitwise_right_shift":
+                lambda x, y: (x & 0xFFFFFFFFFFFFFFFF) >> min(max(y, 0), 63),
+        }
+        fn = ops_map[name]
+        return (np.array([_i64(fn(x, y)) for x, y in zip(av, bv)],
+                         dtype=np.int64), m)
     raise NotImplementedError(f"reference fn {name}")
+
+
+def _i64(x: int) -> int:
+    """Wrap to signed 64-bit (python ints are unbounded)."""
+    x &= 0xFFFFFFFFFFFFFFFF
+    return x - (1 << 64) if x >= (1 << 63) else x
+
+
+def _eval_string_breadth(name: str, expr: CallExpression, t: Table) -> Col:
+    """regexp / URL / JSON / split scalar functions: row-at-a-time over
+    python strings, sharing the per-entry kernels with the engine's
+    dictionary path (exec/lowering.py — both sides wrap the same stdlib
+    primitives, like both reference engines wrap the same libc)."""
+    from .lowering import _STRING_TO_STRING, _STRING_TO_VALUE
+    args = expr.arguments
+    v, m = _eval(args[0], t)
+    extra = [a.value for a in args[1:]]
+    if name in _STRING_TO_VALUE:
+        fn, dtype = _STRING_TO_VALUE[name]
+        raw = [fn(str(x), *extra) for x in v]
+        nulls = np.array([r is None for r in raw])
+        out = np.array([0 if r is None else r for r in raw], dtype=dtype)
+        if nulls.any():
+            m = nulls if m is None else (m | nulls)
+        return (out, m)
+    fn = _STRING_TO_STRING[name]
+    raw = [fn(str(x), *extra) for x in v]
+    nulls = np.array([r is None for r in raw])
+    out = np.array(["" if r is None else r for r in raw], dtype=object)
+    if nulls.any():
+        m = nulls if m is None else (m | nulls)
+    return (out, m)
 
 
 def _ref_pad(s: str, extra, left: bool) -> str:

@@ -268,12 +268,42 @@ class DistributedQueryRunner(LocalQueryRunner):
             # writes run single-task through the local pipeline (the
             # reference's scaled-writer distribution is future work)
             return self._execute_ddl(ast)
-        from .scheduler import InProcessScheduler, SchedulerConfig
+        from .scheduler import InProcessScheduler
         subplan, names, types = self.plan_subplan(sql, ast=ast)
-        sched = InProcessScheduler(SchedulerConfig(
-            exec_config=self.config, source_tasks=self.n_tasks,
-            hash_tasks=self.n_tasks, mesh=self.mesh))
+        sched = InProcessScheduler(self._scheduler_config())
         return pages_to_result(sched.execute(subplan), names, types)
+
+    def _scheduler_config(self):
+        from .scheduler import SchedulerConfig
+        return SchedulerConfig(
+            exec_config=self.config, source_tasks=self.n_tasks,
+            hash_tasks=self.n_tasks, mesh=self.mesh)
+
+
+class BatchQueryRunner(DistributedQueryRunner):
+    """Batch-mode execution — the Presto-on-Spark analog (SURVEY.md §2.7:
+    PrestoSparkRunner.java:55 / PrestoSparkQueryExecutionFactory.java:164).
+    The same fragment DAG runs stage-by-stage with every inter-stage
+    exchange MATERIALIZED to local shuffle files (the Spark-shuffle /
+    presto_cpp ShuffleWrite analog) and per-task retry from those durable
+    inputs — batch fault tolerance instead of fail-fast MPP."""
+
+    def __init__(self, schema: str = "sf0.01", config=None,
+                 n_tasks: int = 2, catalog: str = "tpch",
+                 task_retries: int = 2, temp_dir=None,
+                 fault_injector=None):
+        super().__init__(schema, config, n_tasks=n_tasks, catalog=catalog)
+        self.task_retries = task_retries
+        self.temp_dir = temp_dir
+        self.fault_injector = fault_injector
+
+    def _scheduler_config(self):
+        cfg = super()._scheduler_config()
+        cfg.batch_mode = True
+        cfg.task_retries = self.task_retries
+        cfg.temp_dir = self.temp_dir
+        cfg.fault_injector = self.fault_injector
+        return cfg
 
 
 def _assert_rows_equal(got: QueryResult, exp: QueryResult, ordered: bool):

@@ -16,7 +16,7 @@ their dictionary codes) so producers with different dictionaries agree.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Dict, Iterator, List, Optional, Tuple
+from typing import Callable, Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
 
@@ -44,6 +44,22 @@ class SchedulerConfig:
     # the page path (SURVEY.md §5.8: HTTP stays for the coordinator and
     # cross-pod edges)
     mesh: object = None
+    # BATCH MODE — the Presto-on-Spark analog (SURVEY.md §2.7,
+    # PrestoSparkQueryExecutionFactory.java:164): stage outputs
+    # MATERIALIZE to local temp storage between stages (the Spark-shuffle
+    # analog of presto_cpp/main/operators/ShuffleWrite), so a failed task
+    # retries from durable inputs instead of failing the query —
+    # recoverable execution (RECOVERABLE_GROUPED_EXECUTION,
+    # SystemSessionProperties.java:106,493)
+    batch_mode: bool = False
+    # per-task retry attempts on failure (0 = fail-fast MPP, the
+    # streaming default)
+    task_retries: int = 0
+    # directory for materialized shuffle files (None = TemporaryDirectory)
+    temp_dir: Optional[str] = None
+    # test hook: fault_injector(stage_fragment_id, task_index, attempt)
+    # raises to simulate a task failure (ErrorClassifier-style retryable)
+    fault_injector: Optional[Callable] = None
 
 
 # ---------------------------------------------------------------------------
@@ -163,6 +179,46 @@ class OutputBuffers:
     def add(self, task: int, partition: int, page: Page) -> None:
         self.pages[task][partition].append(page)
 
+    def reset_task(self, task: int) -> None:
+        """Drop a task's staged output (retry must not duplicate rows)."""
+        self.pages[task] = {p: [] for p in self.pages[task]}
+
+    def materialize(self, stage_dir: str) -> None:
+        """Spill every (task, partition) page list to a shuffle file and
+        replace the in-memory lists with lazy file readers — the batch
+        (Presto-on-Spark) mode's durable-exchange step
+        (presto_cpp/main/operators/ShuffleWrite / LocalPersistentShuffle
+        semantics over SerializedPage framing)."""
+        import os
+
+        from ..common.serde import deserialize_page, serialize_page
+        os.makedirs(stage_dir, exist_ok=True)
+
+        class _FilePages:
+            def __init__(self, path: str, count: int):
+                self.path, self.count = path, count
+
+            def __iter__(self):
+                with open(self.path, "rb") as f:
+                    raw = f.read()
+                pos = 0
+                for _ in range(self.count):
+                    page, pos = deserialize_page(raw, pos)
+                    yield page
+
+            def __len__(self):
+                return self.count
+
+        for ti, parts in enumerate(self.pages):
+            for p, pages in parts.items():
+                if not isinstance(pages, list):
+                    continue
+                path = os.path.join(stage_dir, f"t{ti}_p{p}.shuffle")
+                with open(path, "wb") as f:
+                    for page in pages:
+                        f.write(serialize_page(page))
+                parts[p] = _FilePages(path, len(pages))
+
     def pages_for_consumer(self, consumer_task: int) -> List[Page]:
         part = 0 if self.broadcast else consumer_task
         out: List[Page] = []
@@ -232,6 +288,18 @@ class InProcessScheduler:
         return (0 if self.config.mesh is None
                 else self.config.mesh.shape[WORKER_AXIS])
 
+    def _batch_dir(self, fragment_id: str) -> str:
+        """Shuffle-file directory for one stage (batch mode)."""
+        import os
+        if self.config.temp_dir is None:
+            import tempfile
+            self._tmp = getattr(self, "_tmp", None) \
+                or tempfile.TemporaryDirectory(prefix="presto_tpu_shuffle_")
+            base = self._tmp.name
+        else:
+            base = self.config.temp_dir
+        return os.path.join(base, f"stage_{fragment_id}")
+
     def _run_stage(self, stage: StageInfo) -> None:
         for child in stage.children:
             self._run_stage(child)
@@ -249,7 +317,10 @@ class InProcessScheduler:
         mesh = self.config.mesh
         ici = (hashed and stage.n_partitions > 1
                and stage.n_tasks == stage.n_partitions
-               and stage.n_tasks == self._mesh_size())
+               and stage.n_tasks == self._mesh_size()
+               # batch mode wants every exchange durable on disk (retry
+               # re-reads it); device-resident shards are not durable
+               and not self.config.batch_mode)
 
         # split assignment per scan node: task i takes splits[i::n]
         scan_splits: Dict[str, List] = {}
@@ -335,6 +406,26 @@ class InProcessScheduler:
                             stage.buffers.add(task_index, 0, page)
             return out, _time.perf_counter() - t0
 
+        def run_task_retrying(task_index: int):
+            """Batch (Presto-on-Spark) mode: a failed task re-runs from
+            its materialized inputs (children already spilled their
+            shuffle files), the recoverable-execution contract
+            (PrestoSparkTaskExecutorFactory retry via Spark /
+            RECOVERABLE_GROUPED_EXECUTION).  Streaming mode keeps
+            fail-fast MPP semantics (task_retries=0)."""
+            attempts = 1 + max(0, self.config.task_retries)
+            for attempt in range(attempts):
+                try:
+                    if self.config.fault_injector is not None:
+                        self.config.fault_injector(
+                            frag.fragment_id, task_index, attempt)
+                    return run_task(task_index)
+                except Exception:
+                    stage.buffers.reset_task(task_index)
+                    if attempt + 1 >= attempts:
+                        raise
+            return None, 0.0
+
         # a stage's N tasks run CONCURRENTLY (reference
         # SqlStageExecution.scheduleTask / the worker TaskExecutor thread
         # pool): each task's host syncs release the GIL while waiting on
@@ -349,11 +440,11 @@ class InProcessScheduler:
         concurrent = stage.n_tasks > 1 and (
             pin or self.config.exec_config.memory_budget_bytes is None)
         if not concurrent:
-            results = [run_task(i) for i in range(stage.n_tasks)]
+            results = [run_task_retrying(i) for i in range(stage.n_tasks)]
         else:
             from concurrent.futures import ThreadPoolExecutor
             with ThreadPoolExecutor(max_workers=stage.n_tasks) as pool_ex:
-                results = list(pool_ex.map(run_task,
+                results = list(pool_ex.map(run_task_retrying,
                                            range(stage.n_tasks)))
         task_batches = [r[0] for r in results]
         stage.task_walls = [round(r[1], 4) for r in results]
@@ -365,6 +456,9 @@ class InProcessScheduler:
                 self._spill_batches_to_pages(
                     stage, task_batches, out_names, out_types,
                     key_indices)
+        if self.config.batch_mode and stage.device_out is None:
+            # durable inter-stage exchange (the Spark-shuffle analog)
+            stage.buffers.materialize(self._batch_dir(frag.fragment_id))
 
     # -- ICI exchange -----------------------------------------------------
     _exch_cache: Dict = {}

@@ -1811,8 +1811,30 @@ class Planner:
             return call(name, VarcharType(None), *args)
         if name == "strpos":
             return call("strpos", BIGINT, *args)
-        if name == "starts_with":
-            return call("starts_with", BOOLEAN, *args)
+        if name in ("starts_with", "ends_with", "regexp_like"):
+            return call(name, BOOLEAN, *args)
+        if name in ("regexp_extract", "regexp_replace", "split_part",
+                    "url_extract_protocol", "url_extract_host",
+                    "url_extract_path", "url_extract_query",
+                    "url_extract_fragment", "json_extract_scalar"):
+            return call(name, VarcharType(None), *args)
+        if name in ("codepoint", "url_extract_port"):
+            return call(name, BIGINT, *args)
+        # -- math/bitwise breadth (MathFunctions.java,
+        # BitwiseFunctions.java) ------------------------------------------
+        if name in ("log", "atan2", "sinh", "cosh", "tanh"):
+            return call(name, DOUBLE, *args)
+        if name in ("is_nan", "is_finite", "is_infinite"):
+            return call(name, BOOLEAN, *args)
+        if name in ("bitwise_and", "bitwise_or", "bitwise_xor",
+                    "bitwise_not", "bitwise_left_shift",
+                    "bitwise_right_shift",
+                    "bitwise_arithmetic_shift_right", "width_bucket"):
+            return call(name, BIGINT, *args)
+        if name == "infinity":
+            return ConstantExpression(float("inf"), DOUBLE)
+        if name == "nan":
+            return ConstantExpression(float("nan"), DOUBLE)
         # -- dates (DateTimeFunctions.java) -------------------------------
         if name in ("day_of_week", "dow"):
             return call("day_of_week", BIGINT, *args)

@@ -1,0 +1,97 @@
+"""Batch-mode execution — the Presto-on-Spark analog (SURVEY.md §2.7:
+PrestoSparkQueryExecutionFactory.java:164, PrestoSparkRunner.java:55) and
+recoverable execution (RECOVERABLE_GROUPED_EXECUTION,
+SystemSessionProperties.java:106,493): materialized inter-stage shuffle
+files + per-task retry from durable inputs."""
+import os
+
+import pytest
+
+from presto_tpu.exec.pipeline import ExecutionConfig
+from presto_tpu.exec.runner import (BatchQueryRunner, LocalQueryRunner,
+                                    _assert_rows_equal)
+
+Q_JOIN_AGG = """
+select o_orderstatus, count(*) c, sum(l_quantity) q
+from lineitem join orders on l_orderkey = o_orderkey
+where l_shipdate > date '1995-03-15'
+group by o_orderstatus
+"""
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return ExecutionConfig(batch_rows=1 << 13, join_out_capacity=1 << 15)
+
+
+def test_batch_mode_parity(cfg):
+    batch = BatchQueryRunner("sf0.01", config=cfg, n_tasks=2)
+    local = LocalQueryRunner("sf0.01", config=cfg)
+    got = batch.execute(Q_JOIN_AGG)
+    exp = local.execute_reference(Q_JOIN_AGG)
+    _assert_rows_equal(got, exp, False)
+
+
+def test_batch_mode_materializes_shuffle_files(cfg, tmp_path):
+    batch = BatchQueryRunner("sf0.01", config=cfg, n_tasks=2,
+                             temp_dir=str(tmp_path))
+    got = batch.execute(Q_JOIN_AGG)
+    assert got.rows
+    shuffle_files = [os.path.join(r, f)
+                     for r, _d, fs in os.walk(tmp_path)
+                     for f in fs if f.endswith(".shuffle")]
+    # every non-root stage spilled its exchange durably
+    assert len(shuffle_files) >= 2
+    assert any(os.path.getsize(f) > 0 for f in shuffle_files)
+
+
+def test_task_failure_retries_from_materialized_inputs(cfg):
+    """Inject one failure into a mid-plan task attempt: the task must
+    re-run from the already-materialized child shuffle and the query
+    result stay exact (the reference's ErrorClassifier retryable path)."""
+    failures = []
+
+    def inject(fragment_id, task_index, attempt):
+        # fail the FIRST attempt of one mid-stage task, exactly once
+        if attempt == 0 and task_index == 0 and fragment_id != "0" \
+                and not failures:
+            failures.append((fragment_id, task_index))
+            raise RuntimeError("injected executor loss")
+
+    batch = BatchQueryRunner("sf0.01", config=cfg, n_tasks=2,
+                             task_retries=2, fault_injector=inject)
+    local = LocalQueryRunner("sf0.01", config=cfg)
+    got = batch.execute(Q_JOIN_AGG)
+    assert failures, "the injector never fired"
+    _assert_rows_equal(got, local.execute_reference(Q_JOIN_AGG), False)
+
+
+def test_retries_exhausted_fails_query(cfg):
+    def always_fail(fragment_id, task_index, attempt):
+        raise RuntimeError("permanent task failure")
+
+    batch = BatchQueryRunner("sf0.01", config=cfg, n_tasks=2,
+                             task_retries=1, fault_injector=always_fail)
+    with pytest.raises(RuntimeError, match="permanent task failure"):
+        batch.execute("select count(*) from nation")
+
+
+def test_retry_does_not_duplicate_rows(cfg):
+    """A failed attempt that already buffered output must not double rows
+    after retry (OutputBuffers.reset_task)."""
+    calls = {}
+
+    def inject(fragment_id, task_index, attempt):
+        # fail every task's first attempt
+        key = (fragment_id, task_index)
+        if calls.setdefault(key, 0) == 0:
+            calls[key] = 1
+            raise RuntimeError("flaky")
+
+    batch = BatchQueryRunner("sf0.01", config=cfg, n_tasks=2,
+                             task_retries=3, fault_injector=inject)
+    local = LocalQueryRunner("sf0.01", config=cfg)
+    got = batch.execute("select count(*) c, sum(n_nationkey) s from nation")
+    _assert_rows_equal(
+        got, local.execute_reference(
+            "select count(*) c, sum(n_nationkey) s from nation"), False)

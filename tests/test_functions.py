@@ -127,3 +127,70 @@ def test_week_year_boundaries(runner):
         "SELECT orderdate, week(orderdate) w FROM orders "
         "WHERE month(orderdate) = 12 AND day(orderdate) >= 28 "
         "AND orderkey < 20000")
+
+
+# ---------------------------------------------------------------------------
+# round-5 breadth: regexp / URL / JSON / split (RegexpFunctions,
+# UrlFunctions.java, JsonFunctions.java), math/bitwise
+# (MathFunctions.java, BitwiseFunctions.java)
+# ---------------------------------------------------------------------------
+
+BREADTH_QUERIES = [
+    # regexp over a dictionary column
+    "SELECT shipmode, regexp_like(shipmode, '^A|L$') m FROM lineitem "
+    "WHERE orderkey < 30",
+    "SELECT regexp_extract(shipmode, '([A-Z]+) ?.*', 1) x, count(*) c "
+    "FROM lineitem WHERE orderkey < 200 GROUP BY 1",
+    "SELECT regexp_replace(shipmode, '[AEIOU]', '_') r FROM lineitem "
+    "WHERE orderkey < 30",
+    "SELECT split_part(shipinstruct, ' ', 1) a, "
+    "split_part(shipinstruct, ' ', 9) b FROM lineitem WHERE orderkey < 30",
+    "SELECT ends_with(shipmode, 'AIR') e, codepoint(returnflag) c "
+    "FROM lineitem WHERE orderkey < 30",
+    # math / bitwise
+    "SELECT log(2.0, quantity) l, atan2(discount, tax + 0.01) a "
+    "FROM lineitem WHERE orderkey < 30",
+    "SELECT sinh(discount) s, cosh(discount) c, tanh(discount) t "
+    "FROM lineitem WHERE orderkey < 30",
+    "SELECT is_nan(discount / discount) n, is_finite(extendedprice) f "
+    "FROM lineitem WHERE orderkey < 30",
+    "SELECT bitwise_and(orderkey, 255) a, bitwise_or(orderkey, 16) o, "
+    "bitwise_xor(orderkey, partkey) x, bitwise_not(orderkey) n "
+    "FROM lineitem WHERE orderkey < 30",
+    "SELECT bitwise_left_shift(orderkey, 3) l, "
+    "bitwise_right_shift(orderkey, 1) r, "
+    "bitwise_arithmetic_shift_right(0 - orderkey, 2) ar "
+    "FROM lineitem WHERE orderkey < 30",
+    "SELECT width_bucket(totalprice, 0.0, 600000.0, 10) w, count(*) c "
+    "FROM orders WHERE orderkey < 2000 GROUP BY 1",
+]
+
+
+@pytest.mark.parametrize("sql", BREADTH_QUERIES)
+def test_function_breadth(runner, sql):
+    runner.assert_same_as_reference(sql)
+
+
+def test_url_and_json_literals(runner):
+    runner.assert_same_as_reference(
+        "SELECT url_extract_protocol('https://api.example.com:8443/v1/q"
+        "?x=1#frag') p, url_extract_host('https://api.example.com:8443/"
+        "v1/q?x=1') h, url_extract_port('https://api.example.com:8443/') "
+        "n, url_extract_path('https://api.example.com:8443/v1/q') pa, "
+        "url_extract_query('https://e.com/p?a=1&b=2') q")
+    runner.assert_same_as_reference(
+        "SELECT json_extract_scalar('{\"a\": {\"b\": [1, 2, 3]}}', "
+        "'$.a.b[1]') x, json_extract_scalar('{\"s\": \"hi\"}', '$.s') y, "
+        "json_extract_scalar('{\"t\": true}', '$.t') z, "
+        "json_extract_scalar('{\"a\": 1}', '$.missing') w")
+
+
+def test_regexp_on_lazy_comment_column(runner):
+    """regexp functions over a late-materialized (open-domain) column take
+    the host-hoist path (_HOIST_XFORM/_HOIST_PRED)."""
+    runner.assert_same_as_reference(
+        "SELECT count(*) FROM orders WHERE orderkey < 2000 "
+        "AND regexp_like(comment, 'furious|pend')")
+    runner.assert_same_as_reference(
+        "SELECT regexp_replace(comment, '[aeiou]', '') r, count(*) c "
+        "FROM orders WHERE orderkey < 300 GROUP BY 1")

@@ -119,10 +119,10 @@ def test_q18_shape_grouped_parity(monkeypatch):
     assert calls
 
 
-def test_dependency_violation_falls_back_to_sort(monkeypatch):
-    """A grouping key NOT functionally dependent on the anchor (l_partkey
-    varies within an orderkey) must flip the runner to per-bucket
-    sort-grouping and stay correct."""
+def test_non_dependent_grouping_keys(monkeypatch):
+    """Grouping keys NOT functionally dependent on the anchor (l_partkey
+    varies within an orderkey): the per-bucket sort aggregation is fully
+    general over key tuples, so the grouped result must still match."""
     calls = _spy_runs(monkeypatch)
     sql = ("select l_orderkey, l_partkey, sum(l_quantity) "
            "from lineitem group by l_orderkey, l_partkey")
@@ -130,7 +130,7 @@ def test_dependency_violation_falls_back_to_sort(monkeypatch):
                          config=ExecutionConfig(grouped_lifespans=3))
     oracle = LocalQueryRunner("sf0.01")
     _assert_rows_equal(r.execute(sql), oracle.execute_reference(sql), False)
-    assert calls and calls[0]._use_sortagg
+    assert calls
 
 
 def test_auto_mode_stays_off_at_small_scale(monkeypatch):
@@ -152,57 +152,6 @@ def test_partial_split_coverage_not_grouped():
     assert _full_coverage(full, "lineitem", 0.01, "tpch")
     assert not _full_coverage(full[:2], "lineitem", 0.01, "tpch")
     assert not _full_coverage(full[1:], "lineitem", 0.01, "tpch")
-
-
-def test_stream_group_aggregate_op():
-    """operators.stream_group_aggregate: clustered-run segmentation with
-    interior masked rows, dependent-key pass-through, min/max/sum/avg,
-    and the constancy check that gates the streaming path."""
-    import jax
-    import jax.numpy as jnp
-
-    from presto_tpu.exec import operators as ops
-    from presto_tpu.exec.operators import AggSpec, Batch, Column
-
-    import pytest
-
-    #            runs: [5,5] (row1 masked inside), [7], [9,9]
-    anchor = jnp.asarray([5, 5, 5, 7, 9, 9], dtype=jnp.int64)
-    mask = jnp.asarray([True, False, True, True, True, True])
-    dep = Column(jnp.asarray([1, 99, 1, 2, 3, 3], dtype=jnp.int64))
-    x = Column(jnp.asarray([10, 10, 30, 7, 1, 5], dtype=jnp.int64))
-    b = Batch({"a": Column(anchor), "d": dep}, mask)
-    specs = (AggSpec("sum", "s", False, None),
-             AggSpec("avg", "av", False, None),
-             AggSpec("count_star", "c", False, None))
-    out, deps_ok, live = ops.stream_group_aggregate(
-        b, "a", ("d",), {"s": x, "av": x, "c": None}, specs)
-    deps_ok, live = jax.device_get((deps_ok, live))
-    assert bool(deps_ok) and int(live) == 3
-    rows = {}
-    m = jax.device_get(out.mask)
-    for i in range(6):
-        if m[i]:
-            rows[int(out.columns["a"].values[i])] = (
-                int(out.columns["d"].values[i]),
-                int(out.columns["s"].values[i]),
-                int(out.columns["av"].values[i]),
-                int(out.columns["c"].values[i]))
-    # masked row 1 contributes nothing and does not split the 5-run
-    assert rows == {5: (1, 40, 20, 2), 7: (2, 7, 7, 1),
-                    9: (3, 6, 3, 2)}
-
-    # dependency violation (d varies within the 9-run) must be detected
-    dep2 = Column(jnp.asarray([1, 1, 1, 2, 3, 4], dtype=jnp.int64))
-    b2 = Batch({"a": Column(anchor), "d": dep2}, mask)
-    _out, deps_ok2, _l = ops.stream_group_aggregate(
-        b2, "a", ("d",), {"s": x, "av": x, "c": None}, specs)
-    assert not bool(jax.device_get(deps_ok2))
-
-    # min/max need segmented scans: rejected (callers use the sort path)
-    with pytest.raises(NotImplementedError):
-        ops.stream_group_aggregate(
-            b, "a", (), {"mn": x}, (AggSpec("min", "mn", False, None),))
 
 
 def test_grouped_peak_build_rows_bounded(monkeypatch):
