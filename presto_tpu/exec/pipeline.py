@@ -1161,7 +1161,8 @@ class PlanCompiler:
                 update_cache[(num_slots, salt)] = fn
             return fn
 
-        def run_once(num_slots: int, salt: int, batches_fn=None):
+        def run_once(num_slots: int, salt: int, batches_fn=None,
+                     allow_direct: bool = True):
             batches = (self._compile(src_node).batches()
                        if batches_fn is None else batches_fn())
             state = None
@@ -1207,7 +1208,7 @@ class PlanCompiler:
                             key_dicts[k] = c.dictionary
                     # closed small domains: combined code IS the slot index
                     info = (_direct_mode_info(key_names, key_cols)
-                            if basic_specs else None)
+                            if basic_specs and allow_direct else None)
                     if info is not None:
                         doms, G, strides, kdts, _kd = info
                         direct = (doms, kdts)
@@ -1218,6 +1219,22 @@ class PlanCompiler:
                                              key_dtypes)
                 elif encode_keys:
                     batch = _encode_lazy_keys(batch, encode_keys)
+                if direct is not None and any(
+                        batch.columns[k].nulls is not None
+                        for k in key_names):
+                    # direct mode was chosen on a null-free first batch,
+                    # but this batch carries a NULL key (nullable storage
+                    # connectors): the code grid has no null slot, so
+                    # RESTART the whole aggregation on the hash path.
+                    # Close the abandoned iterator FIRST — source
+                    # generators release pool reservations in finally
+                    # blocks.  The restart replays through the _share tee
+                    # buffer like a collision retry does (same stats
+                    # double-count caveat under EXPLAIN ANALYZE).
+                    if hasattr(batches, "close"):
+                        batches.close()
+                    return run_once(num_slots, salt, batches_fn,
+                                    allow_direct=False)
                 state = update(state, batch)
             if state is None:
                 key_dtypes = [jnp.int64] * len(key_names)

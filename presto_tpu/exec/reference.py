@@ -128,6 +128,11 @@ def _exec_TableScanNode(node: P.TableScanNode) -> Table:
             arr = np.array(raw, dtype=object)
         else:
             arr = raw
+        if nulls is not None and arr.dtype == object:
+            # null strings surface as None VALUES too: grouping compares
+            # values, so a masked row must not alias its code-0 entry
+            arr = arr.copy()
+            arr[nulls] = None
         cols[v.name] = (arr, nulls)
     return Table(cols, n)
 
@@ -461,10 +466,18 @@ def _exec_AggregationNode(node: P.AggregationNode) -> Table:
     t = _exec(node.source)
     key_names = [v.name for v in node.grouping_keys]
     if key_names:
-        key_arrays = [t.cols[k][0] for k in key_names]
+        key_cols = [t.cols[k] for k in key_names]
         combo = np.empty(t.n, dtype=object)
         for i in range(t.n):
-            combo[i] = tuple(a[i] for a in key_arrays)
+            # group identity is null-aware and sortable: a NULL key
+            # (None value or set mask bit) is one group, distinct from
+            # every real value — (is_null, value) keeps np.unique's sort
+            # total even when a column mixes None with strings
+            combo[i] = tuple(
+                (True, "") if (a[i] is None
+                               or (m is not None and bool(m[i])))
+                else (False, a[i])
+                for a, m in key_cols)
         uniq, inverse = np.unique(combo, return_inverse=True)
         n_groups = len(uniq)
     else:

@@ -1,0 +1,351 @@
+"""The connector SPI: formal interfaces between the engine and storage.
+
+The analog of the reference plugin surface —
+presto-spi/.../spi/Plugin.java:42 (getConnectorFactories),
+spi/connector/ConnectorFactory.java, Connector.java,
+ConnectorMetadata.java:73 (tables/columns/statistics),
+ConnectorSplitManager.java:23 (splits),
+ConnectorPageSourceProvider.java:26 / ConnectorPageSource.java:23
+(page streams per split).
+
+Two adapters bridge to the engine's registry (connectors/catalog.py),
+whose built-ins predate this surface and are module-shaped:
+
+  * module_connector(cid, module) — view any registered duck-typed
+    connector module THROUGH these interfaces (metadata, splits, page
+    sources), so SPI consumers see one shape for every catalog.
+  * register_plugin(plugin, ...) — register third-party connectors
+    written AGAINST these interfaces: each factory's Connector is
+    wrapped in a module-shaped shim the engine's scan/metadata layers
+    consume, giving plugin authors the reference contract (implement
+    ConnectorMetadata + ConnectorSplitManager + PageSourceProvider and
+    every engine path — planner, pipeline, oracle, worker protocol —
+    just works).
+"""
+from __future__ import annotations
+
+import abc
+from dataclasses import dataclass
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..common.page import Page
+from ..common.types import Type
+
+
+# ---------------------------------------------------------------------------
+# interfaces
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class SchemaTableName:
+    schema: str
+    table: str
+
+
+class ConnectorMetadata(abc.ABC):
+    """Table/column metadata (ConnectorMetadata.java:73)."""
+
+    @abc.abstractmethod
+    def list_tables(self) -> List[str]:
+        ...
+
+    @abc.abstractmethod
+    def get_columns(self, table: str) -> List[Tuple[str, Type]]:
+        """Ordered (column name, type) pairs; KeyError for unknown."""
+        ...
+
+    def get_table_statistics(self, table: str, column: str,
+                             scale_factor: float):
+        """ColumnStats or None (getTableStatistics analog)."""
+        return None
+
+
+class ConnectorSplit(abc.ABC):
+    """An addressable shard of a table (ConnectorSplit); row-range splits
+    carry (start, end)."""
+
+
+@dataclass(frozen=True)
+class RowRangeSplit(ConnectorSplit):
+    table: str
+    start: int
+    end: int
+
+
+class ConnectorSplitManager(abc.ABC):
+    """ConnectorSplitManager.java:23."""
+
+    @abc.abstractmethod
+    def get_splits(self, table: str, scale_factor: float,
+                   desired_splits: int) -> List[ConnectorSplit]:
+        ...
+
+
+class ConnectorPageSource(abc.ABC):
+    """A finite stream of Pages for one split
+    (ConnectorPageSource.java:23)."""
+
+    @abc.abstractmethod
+    def pages(self) -> Iterator[Page]:
+        ...
+
+
+class ConnectorPageSourceProvider(abc.ABC):
+    """ConnectorPageSourceProvider.java:26."""
+
+    @abc.abstractmethod
+    def create_page_source(self, split: ConnectorSplit,
+                           columns: Optional[Sequence[str]],
+                           scale_factor: float) -> ConnectorPageSource:
+        ...
+
+
+class Connector(abc.ABC):
+    """One catalog's services (Connector.java)."""
+
+    @abc.abstractmethod
+    def get_metadata(self) -> ConnectorMetadata:
+        ...
+
+    @abc.abstractmethod
+    def get_split_manager(self) -> ConnectorSplitManager:
+        ...
+
+    @abc.abstractmethod
+    def get_page_source_provider(self) -> ConnectorPageSourceProvider:
+        ...
+
+
+class ConnectorFactory(abc.ABC):
+    """ConnectorFactory: name + create(config) -> Connector."""
+
+    @property
+    @abc.abstractmethod
+    def name(self) -> str:
+        ...
+
+    @abc.abstractmethod
+    def create(self, catalog_name: str, config: Dict[str, str]) -> Connector:
+        ...
+
+
+class Plugin:
+    """Plugin.java:42 — the unit third parties ship."""
+
+    def get_connector_factories(self) -> List[ConnectorFactory]:
+        return []
+
+
+# ---------------------------------------------------------------------------
+# adapter: duck-typed registered module -> SPI view
+# ---------------------------------------------------------------------------
+
+class _ModuleMetadata(ConnectorMetadata):
+    def __init__(self, module):
+        self._m = module
+
+    def list_tables(self):
+        return sorted(self._m.SCHEMAS)
+
+    def get_columns(self, table):
+        return list(self._m.SCHEMAS[table])
+
+    def get_table_statistics(self, table, column, scale_factor):
+        fn = getattr(self._m, "column_stats", None)
+        return None if fn is None else fn(table, column, scale_factor)
+
+
+class _ModuleSplitManager(ConnectorSplitManager):
+    def __init__(self, module):
+        self._m = module
+
+    def get_splits(self, table, scale_factor, desired_splits):
+        total = self._m.table_row_count(table, scale_factor)
+        per = max(1, (total + desired_splits - 1) // max(1, desired_splits))
+        return [RowRangeSplit(table, lo, min(lo + per, total))
+                for lo in range(0, total, per)]
+
+
+class _ModulePageSource(ConnectorPageSource):
+    def __init__(self, module, split: RowRangeSplit, columns, sf,
+                 page_rows: int = 1 << 16):
+        self._m, self._split = module, split
+        self._columns, self._sf, self._page_rows = columns, sf, page_rows
+
+    def pages(self):
+        from ..common.block import block_from_values
+        from ..connectors.catalog import HostColumn
+        m, s = self._m, self._split
+        cols = self._columns or [c for c, _t in m.SCHEMAS[s.table]]
+        pos = s.start
+        while pos < s.end:
+            n = min(self._page_rows, s.end - pos)
+            if hasattr(m, "generate_page"):
+                yield m.generate_page(s.table, self._sf, pos, n, cols)
+            else:
+                blocks = []
+                for c in cols:
+                    typ = m.column_type(s.table, c)
+                    raw = m.generate_column(s.table, c, self._sf, pos, n)
+                    if isinstance(raw, HostColumn):
+                        raw = raw.values
+                    if isinstance(raw, tuple):
+                        codes, values = raw
+                        blocks.append(block_from_values(
+                            typ, [values[k] for k in codes]))
+                    elif isinstance(raw, list):
+                        blocks.append(block_from_values(typ, raw))
+                    else:
+                        blocks.append(block_from_values(
+                            typ, np.asarray(raw).tolist()))
+                yield Page(blocks, n)
+            pos += n
+
+
+class _ModulePageSourceProvider(ConnectorPageSourceProvider):
+    def __init__(self, module):
+        self._m = module
+
+    def create_page_source(self, split, columns, scale_factor):
+        return _ModulePageSource(self._m, split, columns, scale_factor)
+
+
+class ModuleConnector(Connector):
+    """SPI view over a duck-typed registered connector module."""
+
+    def __init__(self, connector_id: str, module):
+        self.connector_id = connector_id
+        self._module = module
+
+    def get_metadata(self):
+        return _ModuleMetadata(self._module)
+
+    def get_split_manager(self):
+        return _ModuleSplitManager(self._module)
+
+    def get_page_source_provider(self):
+        return _ModulePageSourceProvider(self._module)
+
+
+def module_connector(connector_id: str) -> ModuleConnector:
+    """SPI view of a connector registered in the engine catalog."""
+    from ..connectors import catalog
+    return ModuleConnector(connector_id, catalog.module(connector_id))
+
+
+# ---------------------------------------------------------------------------
+# adapter: SPI Connector -> duck-typed module shim (register_plugin)
+# ---------------------------------------------------------------------------
+
+class _ConnectorModuleShim:
+    """Presents an SPI Connector as the module surface the engine's
+    catalog/scan layers consume — the inverse adapter, so connectors
+    written against the reference-shaped interfaces run end to end."""
+
+    def __init__(self, connector: Connector):
+        self._c = connector
+        meta = connector.get_metadata()
+        self.SCHEMAS = {t: list(meta.get_columns(t))
+                        for t in meta.list_tables()}
+        self.PREFIXES = {t: "" for t in self.SCHEMAS}
+        self.OPEN_DOMAIN = set()
+        self.ROWID_ORDERED = set()
+        self.ROWID_DISTINCT = set()
+        # engine operators assume a TABLE-STABLE dictionary per string
+        # column (codes comparable across batches/splits), so the shim
+        # builds one dictionary over the whole column and reuses it for
+        # every range (the hive connector's table-wide-dictionary rule)
+        self._dicts: Dict[Tuple[str, str, float], list] = {}
+
+    def column_type(self, table, column):
+        for c, t in self.SCHEMAS[table]:
+            if c == column:
+                return t
+        raise KeyError(f"{table}.{column}")
+
+    def table_row_count(self, table, sf):
+        # one maximal split describes the table extent
+        splits = self._c.get_split_manager().get_splits(table, sf, 1)
+        return max((s.end for s in splits
+                    if isinstance(s, RowRangeSplit)), default=0)
+
+    def column_stats(self, table, column, sf):
+        return self._c.get_metadata().get_table_statistics(table, column,
+                                                           sf)
+
+    def _read(self, table, columns, sf, start, count):
+        from ..common.block import block_to_values
+        provider = self._c.get_page_source_provider()
+        src = provider.create_page_source(
+            RowRangeSplit(table, start, start + count), columns, sf)
+        out = {c: [] for c in columns}
+        for page in src.pages():
+            for c, block in zip(columns, page.blocks):
+                out[c].extend(block_to_values(
+                    self.column_type(table, c), block))
+        return out
+
+    def generate_column(self, table, column, sf, start, count):
+        from ..connectors.catalog import HostColumn
+        vals = self._read(table, [column], sf, start, count)[column]
+        typ = self.column_type(table, column)
+        nulls = np.array([v is None for v in vals], dtype=bool)
+        if typ.signature.startswith(("varchar", "char")):
+            # dictionary-encode against the TABLE-STABLE dictionary: the
+            # scan's host path consumes (codes, values) pairs and engine
+            # operators compare codes across batches
+            key = (table, column, sf)
+            uniq = self._dicts.get(key)
+            if uniq is None:
+                total = self.table_row_count(table, sf)
+                allv = self._read(table, [column], sf, 0, total)[column]
+                uniq = sorted({v for v in allv if v is not None}) or [""]
+                self._dicts[key] = uniq
+            index = {v: i for i, v in enumerate(uniq)}
+            codes = np.array([0 if v is None else index[v] for v in vals],
+                             dtype=np.int32)
+            return HostColumn((codes, uniq),
+                              nulls if nulls.any() else None)
+        from ..common.types import (BooleanType, DateType, DecimalType,
+                                    DoubleType, RealType)
+        if isinstance(typ, DecimalType):
+            arr = np.array([0 if v is None else int(v * 10 ** typ.scale)
+                            for v in vals], dtype=np.int64)
+        elif isinstance(typ, (DoubleType, RealType)):
+            arr = np.array([0.0 if v is None else float(v) for v in vals],
+                           dtype=np.float64)
+        elif isinstance(typ, BooleanType):
+            arr = np.array([bool(v) for v in vals], dtype=bool)
+        elif isinstance(typ, DateType):
+            arr = np.array([0 if v is None
+                            else int(np.datetime64(v, "D").astype(np.int64))
+                            for v in vals], dtype=np.int64)
+        else:
+            arr = np.array([0 if v is None else int(v) for v in vals],
+                           dtype=np.int64)
+        return HostColumn(arr, nulls if nulls.any() else None)
+
+    def generate_values_at(self, table, column, sf, ids):
+        ids = np.asarray(ids, dtype=np.int64)
+        out = []
+        for i in ids:
+            out.extend(self._read(table, [column], sf, int(i), 1)[column])
+        return out
+
+
+def register_plugin(plugin: Plugin,
+                    config: Optional[Dict[str, str]] = None,
+                    catalog_prefix: str = "") -> List[str]:
+    """Install every connector factory a plugin ships (the PluginManager
+    analog).  Each factory registers under catalog_prefix + factory.name;
+    returns the registered catalog names."""
+    from ..connectors import catalog
+    registered = []
+    for factory in plugin.get_connector_factories():
+        name = catalog_prefix + factory.name
+        conn = factory.create(name, dict(config or {}))
+        catalog.register_connector(name, _ConnectorModuleShim(conn))
+        registered.append(name)
+    return registered
