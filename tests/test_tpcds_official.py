@@ -55,6 +55,12 @@ BANK = DEFAULT_BANK + ([] if _FAST else FULL_BANK)
 
 @pytest.fixture(scope="module")
 def runner():
+    # start the bank from a clean compile history: executables
+    # accumulated by EARLIER test modules otherwise count toward the
+    # ~55-compile XLA:CPU segfault this file's periodic clear works
+    # around (see test_tpcds_official_query)
+    import jax
+    jax.clear_caches()
     return LocalQueryRunner("sf0.01", catalog="tpcds",
                             config=ExecutionConfig(
                                 batch_rows=1 << 14,
@@ -66,9 +72,21 @@ def _load(name: str) -> str:
         return f.read().strip().rstrip(";")
 
 
+_ran = [0]
+
+
 @needs_corpus
 @pytest.mark.parametrize("name", BANK)
 def test_tpcds_official_query(runner, name):
+    # XLA:CPU deterministically segfaults compiling a later query after
+    # ~55 of these have compiled in one process (jax compile-history
+    # corruption; reproduced bisected — any single query passes alone).
+    # Dropping the accumulated executables every few queries keeps the
+    # full 103-query bank green in ONE pytest process.
+    _ran[0] += 1
+    if _ran[0] % 8 == 0:
+        import jax
+        jax.clear_caches()
     sql = _load(name)
     got = runner.execute(sql)
     exp = runner.execute_reference(sql)
