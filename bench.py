@@ -10,7 +10,15 @@ vs_baseline = speedup vs the single-threaded numpy reference interpreter
               the reference's single-node row-at-a-time engine, measured fresh
               each round so the ratio tracks engine improvements only.
 
-Env knobs: BENCH_SF (default 10), BENCH_RUNS (default 3), BENCH_QUERY (q1|q6).
+Env knobs: BENCH_SF (default 10), BENCH_RUNS (default 3),
+BENCH_QUERY (q1|q6|q3g).  Grouped-execution overlap mode:
+BENCH_GROUPED_LIFESPANS (0=auto, 1=off, N>=2 force N bucket lifespans)
+and BENCH_PREFETCH_DEPTH (lifespans staged ahead; 0 = serial) — when the
+run produced grouped runtime stats, the JSON line gains a
+"grouped" object with per-bucket gen/compute/run walls and the measured
+overlap fraction (1 - run / (gen + compute); 0 means fully serial).
+BENCH_QUERY=q3g is the grouped-eligible shape (TPC-H Q3 keyed on
+l_orderkey, the lineitem/orders bucket column).
 """
 import json
 import os
@@ -51,12 +59,26 @@ WHERE shipdate >= DATE '1994-01-01'
   AND quantity < 24
 """
 
+# grouped-eligible: aggregation keyed on the lineitem/orders bucket
+# column, so forced lifespans (BENCH_GROUPED_LIFESPANS >= 2) run the
+# bucket-at-a-time pipeline and expose the prefetch overlap stats
+Q3G = """
+SELECT l_orderkey, sum(l_extendedprice * (1 - l_discount)) AS revenue
+FROM orders, lineitem
+WHERE l_orderkey = o_orderkey AND o_orderdate < DATE '1995-03-15'
+  AND l_shipdate > DATE '1995-03-15'
+GROUP BY l_orderkey
+ORDER BY revenue DESC LIMIT 10
+"""
+
 
 def main():
     sf = float(os.environ.get("BENCH_SF", "10"))
     runs = int(os.environ.get("BENCH_RUNS", "3"))
     qname = os.environ.get("BENCH_QUERY", "q1")
-    sql = {"q1": Q1, "q6": Q6}[qname]
+    sql = {"q1": Q1, "q6": Q6, "q3g": Q3G}[qname]
+    grouped_lifespans = int(os.environ.get("BENCH_GROUPED_LIFESPANS", "0"))
+    prefetch_depth = int(os.environ.get("BENCH_PREFETCH_DEPTH", "1"))
 
     from presto_tpu.connectors import tpch
     from presto_tpu.exec.runner import LocalQueryRunner
@@ -65,7 +87,9 @@ def main():
     n_rows = tpch._table_rows("lineitem", sf)
     from presto_tpu.exec.pipeline import ExecutionConfig
     runner = LocalQueryRunner(schema=schema, config=ExecutionConfig(
-        batch_rows=1 << 20, join_out_capacity=1 << 21))
+        batch_rows=1 << 20, join_out_capacity=1 << 21,
+        grouped_lifespans=grouped_lifespans,
+        grouped_prefetch_depth=prefetch_depth))
 
     # Warmup: traces + compiles every pipeline shape bucket and faults the
     # generated lineitem columns into memory/HBM.
@@ -103,6 +127,7 @@ def main():
     col_bytes = {
         "q1": 8 + 8 + 8 + 8 + 4 + 4 + 4,   # qty,price,disc,tax,shipdate,rf,ls
         "q6": 4 + 8 + 8 + 8,               # shipdate,disc,price,qty
+        "q3g": 8 + 8 + 8 + 4,              # orderkey,price,disc,shipdate
     }[qname]
     achieved_gbps = rows_per_sec * col_bytes / 1e9
     hbm_peak_gbps = float(os.environ.get("BENCH_HBM_PEAK_GBPS", "819"))
@@ -123,6 +148,23 @@ def main():
         "hbm_peak_gbps": hbm_peak_gbps,
         "hbm_fraction": round(achieved_gbps / hbm_peak_gbps, 4),
     }
+    gstats = {k: v for k, v in (result.runtime_stats or {}).items()
+              if k.startswith("grouped")}
+    if gstats:
+        gen = gstats.get("groupedBucketGenWallNanos", {}).get("sum", 0)
+        comp = gstats.get("groupedBucketComputeWallNanos", {}).get("sum", 0)
+        run = gstats.get("groupedRunWallNanos", {}).get("sum", 0)
+        out["grouped"] = {
+            "lifespans": gstats.get(
+                "groupedBucketComputeWallNanos", {}).get("count", 0),
+            "prefetch_depth": prefetch_depth,
+            "gen_wall_s": round(gen / 1e9, 4),
+            "compute_wall_s": round(comp / 1e9, 4),
+            "run_wall_s": round(run / 1e9, 4),
+            # how much staging hid behind compute: 0 = fully serial
+            "overlap_fraction": round(1 - run / (gen + comp), 4)
+            if gen + comp else 0.0,
+        }
     print(json.dumps(out))
 
 

@@ -29,6 +29,14 @@ def main(argv=None) -> int:
                     help="run through the in-process distributed scheduler "
                          "with N tasks per stage")
     ap.add_argument("--batch-rows", type=int, default=1 << 20)
+    ap.add_argument("--grouped-lifespans", type=int, default=0,
+                    help="0=auto, 1=off, N>=2 force N bucket lifespans")
+    ap.add_argument("--grouped-prefetch-depth", type=int, default=1,
+                    help="lifespans staged ahead of the one computing "
+                         "(0 = strictly serial bucket loop)")
+    ap.add_argument("--grouped-stats", action="store_true",
+                    help="attach per-query grouped bucket gen/compute/run "
+                         "walls from runtime stats to each record")
     ap.add_argument("--json", default=None, help="write results file")
     args = ap.parse_args(argv)
 
@@ -40,7 +48,9 @@ def main(argv=None) -> int:
     nums = (sorted(int(x) for x in args.queries.split(","))
             if args.queries else sorted(suite))
     cfg = ExecutionConfig(batch_rows=args.batch_rows,
-                          join_out_capacity=1 << 21)
+                          join_out_capacity=1 << 21,
+                          grouped_lifespans=args.grouped_lifespans,
+                          grouped_prefetch_depth=args.grouped_prefetch_depth)
     schema = f"sf{args.sf:g}"
     if args.distributed:
         runner = DistributedQueryRunner(schema, config=cfg,
@@ -63,6 +73,11 @@ def main(argv=None) -> int:
                 rows = len(r.rows)
             rec = {"query": f"q{qnum:02d}", "sf": args.sf,
                    "best_s": min(runs), "runs_s": runs, "rows": rows}
+            if args.grouped_stats:
+                stats = getattr(r, "runtime_stats", None) or {}
+                rec["grouped_stats"] = {
+                    k: v for k, v in stats.items()
+                    if k.startswith("grouped")}
         except Exception as e:   # noqa: BLE001 — record and continue
             rec = {"query": f"q{qnum:02d}", "sf": args.sf,
                    "error": f"{type(e).__name__}: {e}"}

@@ -158,7 +158,15 @@ def make_splits(table: str, sf: float, splits: int,
 
 def bucket_column(table: str,
                   connector_id: Optional[str] = None) -> Optional[str]:
-    """The column this table is range-bucketed on, or None."""
+    """The column this table is range-bucketed on, or None.
+
+    Contract: a declared bucket column is NON-NULL.  Grouped execution
+    assigns each output group to exactly one lifespan by its bucket-key
+    value; a NULL key has no home bucket, so its group would be replayed
+    (and its aggregate duplicated) across lifespans.  The engine
+    re-checks this at eligibility time (exec/grouped.py rejects plans
+    whose anchor key can be null), but a connector must never declare a
+    nullable column here."""
     m = _CONNECTORS.get(connector_id) if connector_id \
         else _module_for_table(table)
     if m is None:
@@ -169,7 +177,11 @@ def bucket_column(table: str,
 def bucket_layout(sf: float, n_buckets: int,
                   connector_id: Optional[str] = None):
     """Co-bucketed lifespan layout (list of TableBucket), or None when the
-    connector has no bucketing."""
+    connector has no bucketing.  Each TableBucket's key range
+    [key_lo, key_hi) maps to the contiguous row range holding exactly
+    those (non-null — see bucket_column) keys in every co-bucketed
+    table; successive buckets tile both the key domain and each table's
+    rows."""
     m = _CONNECTORS.get(connector_id)
     fn = getattr(m, "bucket_layout", None) if m is not None else None
     return None if fn is None else fn(sf, n_buckets)

@@ -415,7 +415,11 @@ def _ds_web_sales(column: str, idx, sf: float):
 def _ds_web_returns(column: str, idx, sf: float):
     n_orders = DS._table_rows("web_sales", sf) // DS.LINES_PER_ORDER
     if column == "wr_order_number":
-        return _ds_uniform("web_returns", "order", idx, 1, max(1, n_orders))
+        # monotone in the row index (host mirror: tpcds._gen_web_returns)
+        # so order-number ranges are contiguous row ranges — the
+        # co-bucket property bucket_layout depends on
+        n_returns = DS._table_rows("web_returns", sf)
+        return (idx.astype(jnp.int64) * max(1, n_orders)) // n_returns + 1
     if column == "wr_returned_date_sk":
         return DS.JULIAN_BASE + _ds_uniform("web_returns", "ret", idx,
                                             DS.SALES_MIN, DS.SALES_MAX + 60)

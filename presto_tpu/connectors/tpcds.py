@@ -25,7 +25,7 @@ from ..common.types import (BIGINT, DATE, INTEGER, Type, DecimalType,
                             VarcharType)
 # hashing core shared with tpch; seeds are namespaced "tpcds.<table>" so the
 # two connectors' value streams stay independent
-from .tpch import _splitmix64, _stream_seed
+from .tpch import TableBucket, _splitmix64, _stream_seed
 
 
 def _hash(table: str, column: str, idx: np.ndarray) -> np.ndarray:
@@ -395,6 +395,56 @@ ROWID_DISTINCT = {
     ("reason", "r_reason_id"), ("time_dim", "t_time_id"),
     ("call_center", "cc_call_center_id"), ("web_page", "wp_web_page_id"),
 }
+
+
+# ---------------------------------------------------------------------------
+# co-bucketed layout for grouped (lifespan) execution (see tpch.py for the
+# model): web_sales rows map to order numbers through fixed
+# LINES_PER_ORDER blocks, and wr_order_number is generated monotone in the
+# row index, so a ws_order_number RANGE is a contiguous ROW RANGE in both
+# tables — a bucket is a pair of row-range splits, no repartitioning.
+# This is the layout BASELINE config #5 (TPC-DS Q95, whose 72M-row
+# web_sales self-join build exhausts HBM at SF100) needs to run one
+# lifespan at a time.  Bucket keys are NON-NULL by the catalog contract
+# (connectors/catalog.py bucket_column).
+# ---------------------------------------------------------------------------
+
+BUCKET_COLUMNS = {"web_sales": "ws_order_number",
+                  "web_returns": "wr_order_number"}
+
+
+def _wr_rows_below(key: int, n_orders: int, n_returns: int) -> int:
+    """Number of web_returns rows with wr_order_number < key.  The
+    generator maps row idx -> (idx*n_orders)//n_returns + 1, so the first
+    row at-or-above `key` is ceil((key-1)*n_returns/n_orders)."""
+    k = min(max(key - 1, 0), n_orders)
+    return min(n_returns, -(-(k * n_returns) // n_orders))
+
+
+def bucket_layout(sf: float, n_buckets: int) -> List[TableBucket]:
+    """Split the ws_order_number domain into up to n_buckets lifespans;
+    the last bucket absorbs any partial tail order of web_sales."""
+    n_ws = _table_rows("web_sales", sf)
+    n_wr = _table_rows("web_returns", sf)
+    n_orders = max(1, n_ws // LINES_PER_ORDER)
+    # distinct order numbers (a partial tail block still owns one key)
+    n_keys = -(-n_ws // LINES_PER_ORDER)
+    if n_buckets <= 1 or n_keys <= 1:
+        return [TableBucket(1, n_keys + 1, {"web_sales": (0, n_ws),
+                                            "web_returns": (0, n_wr)})]
+    per = max(1, -(-n_keys // n_buckets))           # ceil(n_keys / K)
+    out: List[TableBucket] = []
+    k0 = 1
+    while k0 <= n_keys:
+        k1 = min(k0 + per, n_keys + 1)
+        ws = ((k0 - 1) * LINES_PER_ORDER,
+              n_ws if k1 > n_keys else (k1 - 1) * LINES_PER_ORDER)
+        wr = (_wr_rows_below(k0, n_orders, n_wr),
+              _wr_rows_below(k1, n_orders, n_wr))
+        out.append(TableBucket(k0, k1,
+                               {"web_sales": ws, "web_returns": wr}))
+        k0 = k1
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -868,7 +918,15 @@ def _gen_web_sales(column: str, idx: np.ndarray, sf: float):
 def _gen_web_returns(column: str, idx: np.ndarray, sf: float):
     n_orders = _table_rows("web_sales", sf) // LINES_PER_ORDER
     if column == "wr_order_number":
-        return _uniform("web_returns", "order", idx, 1, max(1, n_orders))
+        # monotone in the row index so an order-number range is a
+        # contiguous web_returns row range (the co-bucket property
+        # bucket_layout depends on); strictly increasing whenever
+        # n_orders >= n_returns, so returned order numbers are also
+        # distinct.  The generator is self-consistent rather than
+        # dsdgen-bit-exact, so redefining the draw is fair game — every
+        # web_returns test is differential.
+        n_returns = _table_rows("web_returns", sf)
+        return (idx * max(1, n_orders)) // n_returns + 1
     if column == "wr_returned_date_sk":
         return _date_sk_from_offset(
             _uniform("web_returns", "ret", idx, SALES_MIN, SALES_MAX + 60))

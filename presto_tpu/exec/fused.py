@@ -205,10 +205,12 @@ class FusedChain:
         """Materialize every build side and construct lookup tables.
         Returns (aux, expands, deferred), or None when a join's fanout
         exceeds the expansion limits (caller falls back to the streaming
-        executor).  defer(step_index, JoinNode) -> True reserves the
-        join's aux slot instead of building it (grouped execution fills
-        those slots per bucket lifespan); deferred lists
-        (aux_index, step_index, JoinNode)."""
+        executor).  defer(step_index, JoinNode) -> k (falsy = build here)
+        reserves the join's aux slot instead of building it, with static
+        fanout k baked into the shared program (grouped execution fills
+        those slots per bucket lifespan: k == 1 means a unique-key direct
+        table, k > 1 a hash-sorted table probed with k-way expansion);
+        deferred lists (aux_index, step_index, JoinNode)."""
         # aux[0] carries the scan's HBM-cached whole-table columns as a
         # traced argument pytree (closure constants of this size would be
         # inlined as XLA literals); join/semi lookup tables follow
@@ -219,10 +221,11 @@ class FusedChain:
             kind = step[0]
             if kind == "join":
                 node = step[1]
-                if defer is not None and defer(si, node):
+                k_defer = defer(si, node) if defer is not None else 0
+                if k_defer:
                     aux.append(None)
                     deferred.append((len(aux) - 1, si, node))
-                    expands.append(1)
+                    expands.append(int(k_defer))
                     continue
                 res = self._build_for(
                     node.right, tuple(r.name for _l, r in node.criteria),

@@ -89,6 +89,41 @@ def _resolve_to_scan(node: P.PlanNode, var_name: str):
             return None
 
 
+def _materialize_bucket_build(compiler, jn, scan_node, btable: str,
+                              rows: Tuple[int, int]):
+    """Materialize a join's build subtree restricted to one bucket's row
+    range of its bucketed scan, through the FUSED path.
+
+    The compiler memoizes BatchSources per node id, so the scan's cached
+    source (which baked the previous bucket's splits into its fused_scan
+    metadata) is evicted around the materialization and restored after —
+    other consumers of the same node id keep their view, and the jitted
+    fmat program is reused across buckets (its chunk arrays are dynamic
+    arguments)."""
+    from .fused import _empty_build_batch, fused_materialize
+    cid = scan_node.table.connector_id
+    sf = dict(scan_node.table.extra).get("scaleFactor", 0.01)
+    ctx = compiler.ctx
+    saved_split = ctx.splits.get(scan_node.id)
+    saved_src = compiler._sources.pop(scan_node.id, None)
+    ctx.splits[scan_node.id] = [catalog.TableSplit(
+        cid, btable, sf, rows[0], rows[1])]
+    try:
+        b = fused_materialize(compiler, jn.right, cache=False)
+    finally:
+        if saved_split is None:
+            ctx.splits.pop(scan_node.id, None)
+        else:
+            ctx.splits[scan_node.id] = saved_split
+        if saved_src is None:
+            compiler._sources.pop(scan_node.id, None)
+        else:
+            compiler._sources[scan_node.id] = saved_src
+    if b is None:
+        b = _empty_build_batch(jn.right)
+    return b
+
+
 def _full_coverage(splits, table: str, sf: float, cid: str) -> bool:
     """Whether the scan's splits cover the whole table contiguously (a
     distributed task owning a split subset must not re-bucket it)."""
@@ -144,49 +179,33 @@ class GroupedRunner:
 
     def _bucket_aux(self, bucket):
         """aux tuple for this bucket: shared entries + freshly materialized
-        bucketed build tables (restricted to the bucket's row range).
-
-        The build subtree materializes through the FUSED path with the
-        build scan's splits overridden to the bucket's row range.  The
-        compiler memoizes BatchSources per node id, so the scan's cached
-        source (which baked the previous bucket's splits into its
-        fused_scan metadata) is evicted around each materialization and
-        restored after — other consumers of the same node id keep their
-        view, and the jitted fmat program is reused across buckets (its
-        chunk arrays are dynamic arguments)."""
+        bucketed build tables (restricted to the bucket's row range, via
+        _materialize_bucket_build).  A build whose reserved fanout is 1
+        becomes a direct-address table keyed off the bucket's key base;
+        a fanout-k build becomes a hash-sorted table probed with the
+        k-way expansion the shared program reserved at prep time."""
         from .fused import DirectTable, _direct_builder, _drop_null_keys, \
-            _empty_build_batch, fused_materialize
+            _max_run
         aux = list(self.shared_aux)
-        dups: List = []      # per-build duplicate-key flags (device bools)
-        for (ai, jn, scan_node, btable, bkey) in self.per_bucket_builds:
-            rows = bucket.rows[btable]
-            cid = scan_node.table.connector_id
-            sf = dict(scan_node.table.extra).get("scaleFactor", 0.01)
-            ctx = self.compiler.ctx
-            saved_split = ctx.splits.get(scan_node.id)
-            saved_src = self.compiler._sources.pop(scan_node.id, None)
-            ctx.splits[scan_node.id] = [catalog.TableSplit(
-                cid, btable, sf, rows[0], rows[1])]
-            try:
-                b = fused_materialize(self.compiler, jn.right, cache=False)
-            finally:
-                if saved_split is None:
-                    ctx.splits.pop(scan_node.id, None)
-                else:
-                    ctx.splits[scan_node.id] = saved_split
-                if saved_src is None:
-                    self.compiler._sources.pop(scan_node.id, None)
-                else:
-                    self.compiler._sources[scan_node.id] = saved_src
-            if b is None:
-                b = _empty_build_batch(jn.right)
+        # per-build overflow flags (device bools): key duplicated in a
+        # fanout-1 build, or multiplicity > k in a fanout-k build
+        dups: List = []
+        for (ai, jn, scan_node, btable, bkey, k) in self.per_bucket_builds:
+            b = _materialize_bucket_build(self.compiler, jn, scan_node,
+                                          btable, bucket.rows[btable])
             b = _drop_null_keys(b, (bkey,))
-            col = b.columns[bkey]
-            slots, dup = _direct_builder(self.G)(
-                col.values, b.mask, jnp.int64(bucket.key_lo))
-            dups.append(dup)
-            aux[ai] = DirectTable(slots, jnp.int64(bucket.key_lo),
-                                  dict(b.columns))
+            if k == 1:
+                col = b.columns[bkey]
+                slots, dup = _direct_builder(self.G)(
+                    col.values, b.mask, jnp.int64(bucket.key_lo))
+                dups.append(dup)
+                aux[ai] = DirectTable(slots, jnp.int64(bucket.key_lo),
+                                      dict(b.columns))
+            else:
+                from .pipeline import _jits
+                tbl = _jits()[1](b, (bkey,))
+                dups.append(_max_run(tbl) > k)
+                aux[ai] = tbl
         return tuple(aux), dups
 
     def _get_sort_prog(self, S: int):
@@ -221,36 +240,97 @@ class GroupedRunner:
     @staticmethod
     def _check_dups(dup_flags) -> None:
         if dup_flags and any(bool(d) for d in jax.device_get(dup_flags)):
-            # a bucketed build's keys repeat inside this bucket: the
-            # direct-address table would keep one arbitrary row per key,
-            # and earlier lifespans already streamed downstream, so the
-            # only correct move is to fail loudly (the single-lifespan
-            # path handles duplicate build keys via fanout expansion)
+            # a bucketed build's key multiplicity exceeds what the shared
+            # program reserved for this bucket (duplicates against a
+            # direct table, or a run longer than the fanout-k expansion):
+            # the probe would keep an arbitrary subset of matches, and
+            # earlier lifespans already streamed downstream, so the only
+            # correct move is to fail loudly (the single-lifespan path
+            # handles any fanout via replicated builds)
             raise NotImplementedError(
-                "grouped execution: bucketed build key is not unique "
-                "within a lifespan")
+                "grouped execution: bucketed build key multiplicity "
+                "exceeds the reserved fanout within a lifespan")
+
+    def _stage_bucket(self, bi: int, aux0):
+        """Host-stage one bucket: split arithmetic, build materialization
+        (device dispatches + small sync), chunk arrays.  Returns the
+        ready-to-dispatch entry, or None for an empty bucket."""
+        bucket = self.layout[bi]
+        chunks = self._bucket_chunks(bucket.rows[self.probe_table])
+        if not chunks:
+            return None
+        if bi == 0 and aux0 is not None:
+            aux, dups = aux0
+        else:
+            aux, dups = self._bucket_aux(bucket)
+        pos_arr = jnp.asarray([c[0] for c in chunks], dtype=jnp.int64)
+        cnt_arr = jnp.asarray([c[1] for c in chunks], dtype=jnp.int64)
+        return len(chunks), pos_arr, cnt_arr, aux, dups
 
     def run(self):
-        from .pipeline import _bucket_for, _jit_compact
-        for bi, bucket in enumerate(self.layout):
-            rows = bucket.rows[self.probe_table]
-            chunks = self._bucket_chunks(rows)
-            if not chunks:
-                continue
-            if bi == 0 and self._aux0 is not None:
-                aux, dups = self._aux0
-                self._aux0 = None       # one-shot: don't pin HBM across runs
-            else:
-                aux, dups = self._bucket_aux(bucket)
-            pos_arr = jnp.asarray([c[0] for c in chunks], dtype=jnp.int64)
-            cnt_arr = jnp.asarray([c[1] for c in chunks], dtype=jnp.int64)
+        """Pipelined lifespan loop: keep up to grouped_prefetch_depth
+        buckets STAGED (builds materialized, chunk arrays device-put)
+        beyond the one being consumed, so bucket k+1's host reads and
+        host->HBM transfers overlap bucket k's device compute — JAX async
+        dispatch executes device programs in dispatch order, so staging
+        ahead keeps the device queue full while downstream drains bucket
+        k.  Depth 0 reproduces the strictly serial pre-pipelining loop.
+
+        With lifespan sharding (TaskContext.grouped_shard = (i, n)) this
+        task runs only buckets i, i+n, ... — the scheduler hands every
+        task full splits and disjoint bucket subsets.
+
+        RuntimeStats (when the runner wired a sink into the context):
+        groupedBucketGenWallNanos  — host wall staging each bucket
+        groupedBucketComputeWallNanos — wall from dispatching a bucket's
+        program until downstream finished consuming it
+        groupedRunWallNanos — whole loop; overlap shows as run wall <
+        gen.sum + compute.sum."""
+        import time
+        from collections import deque
+        ctx = self.compiler.ctx
+        depth = max(0, getattr(ctx.config, "grouped_prefetch_depth", 1))
+        stats = getattr(ctx, "runtime_stats", None)
+        aux0 = self._aux0
+        self._aux0 = None           # one-shot: don't pin HBM across runs
+        indices = range(len(self.layout))
+        shard = getattr(ctx, "grouped_shard", None)
+        if shard is not None:
+            indices = range(shard[0], len(self.layout), shard[1])
+        t_run = time.perf_counter_ns()
+        it = iter(indices)
+        staged = deque()
+        exhausted = False
+        while True:
+            while not exhausted and len(staged) <= depth:
+                bi = next(it, None)
+                if bi is None:
+                    exhausted = True
+                    break
+                t0 = time.perf_counter_ns()
+                ent = self._stage_bucket(bi, aux0)
+                if stats is not None:
+                    stats.add("groupedBucketGenWallNanos",
+                              time.perf_counter_ns() - t0)
+                if ent is not None:
+                    staged.append(ent)
+            if not staged:
+                break
+            S, pos_arr, cnt_arr, aux, dups = staged.popleft()
             self._check_dups(dups)
             # per-bucket SORT aggregation: measured fastest on chip for
             # the SF100 shapes (argsort+segment scans beat both the
             # scatter table, ~100ms per scattered million rows, and a
             # streaming pre-grouped formulation whose extra segment
             # gathers outweighed the argsort it avoided)
-            yield self._get_sort_prog(len(chunks))(pos_arr, cnt_arr, aux)
+            t0 = time.perf_counter_ns()
+            yield self._get_sort_prog(S)(pos_arr, cnt_arr, aux)
+            if stats is not None:
+                stats.add("groupedBucketComputeWallNanos",
+                          time.perf_counter_ns() - t0)
+        if stats is not None:
+            stats.add("groupedRunWallNanos",
+                      time.perf_counter_ns() - t_run)
 
 
 def make_grouped_runner(compiler, node, chain, key_names, specs,
@@ -264,7 +344,10 @@ def make_grouped_runner(compiler, node, chain, key_names, specs,
         return None
     if not basic_specs:
         return None
-    if getattr(node, "step", P.SINGLE) != P.SINGLE:
+    # PARTIAL is safe: each bucket's exact aggregate is a valid partial
+    # state for the decomposable basic aggs, and the FINAL stage merges
+    # per-bucket rows the same way it merges per-task rows
+    if getattr(node, "step", P.SINGLE) not in (P.SINGLE, P.PARTIAL):
         return None
     K_conf = cfg.grouped_lifespans
     if K_conf == 1:
@@ -345,11 +428,33 @@ def make_grouped_runner(compiler, node, chain, key_names, specs,
     # bucketed build must materialize through the fused path — its chunk
     # layout re-derives from the per-bucket split override — so
     # non-fusible bucketed builds are replicated instead.
-    from .fused import assemble_chain
+    #
+    # Fanout probing: the shared program must reserve a STATIC expansion
+    # factor per deferred join, so probe bucket 0's build now and size k
+    # from its maximum key run (k==1 -> direct table; k>1 -> hash table
+    # probed with k-way expansion, e.g. a self-join on the bucket key).
+    # Later buckets exceeding k fail loudly at runtime (_check_dups).
+    from .fused import MAX_EXPAND, _drop_null_keys, _max_run, \
+        assemble_chain
+
+    fanouts: Dict[int, int] = {}
+    for si, (jn, scan_node, t2, bkey) in bucketed_joins.items():
+        if assemble_chain(compiler, jn.right) is None:
+            continue                    # not fusible: replicate instead
+        try:
+            b0 = _materialize_bucket_build(compiler, jn, scan_node, t2,
+                                           layout[0].rows[t2])
+        except NotImplementedError:
+            continue
+        b0 = _drop_null_keys(b0, (bkey,))
+        from .pipeline import _jits
+        kmax = int(jax.device_get(_max_run(_jits()[1](b0, (bkey,)))))
+        if kmax > MAX_EXPAND:
+            continue                    # too wide to reserve: replicate
+        fanouts[si] = 1 if kmax <= 1 else 1 << (kmax - 1).bit_length()
 
     def _defer(si, jn):
-        return (si in bucketed_joins
-                and assemble_chain(compiler, jn.right) is not None)
+        return fanouts.get(si, 0)
 
     try:
         prep_res = chain.prep(defer=_defer)
@@ -361,7 +466,7 @@ def make_grouped_runner(compiler, node, chain, key_names, specs,
     shared_aux = list(shared_aux)
     per_bucket_builds = [
         (ai, jn, bucketed_joins[si][1], bucketed_joins[si][2],
-         bucketed_joins[si][3])
+         bucketed_joins[si][3], fanouts[si])
         for ai, si, jn in deferred]
 
     runner = GroupedRunner(compiler, chain, layout, anchor,
@@ -395,6 +500,82 @@ def make_grouped_runner(compiler, node, chain, key_names, specs,
             key_dicts[k] = c.dictionary
     if probe.columns[anchor].dictionary is not None:
         return None
+    if probe.columns[anchor].nulls is not None:
+        # nullable bucket key: a NULL anchor has no home bucket, so its
+        # group would be duplicated across lifespans (catalog.py
+        # bucket_column contract) — keep the single-lifespan path
+        return None
     runner.key_dtypes = key_dtypes
     runner.key_dicts = key_dicts
     return runner
+
+
+# wrappers a fragment plants above its aggregation that don't change
+# whether the agg itself can run grouped
+_PEELABLE = (P.ProjectNode, P.FilterNode, P.SortNode, P.TopNNode,
+             P.LimitNode)
+
+_SHARDABLE_AGGS = {"sum", "avg", "count", "count_star", "min", "max"}
+
+
+def stage_shards_lifespans(root: P.PlanNode, cfg) -> bool:
+    """Plan-time predicate for the scheduler: may the tasks of this
+    SOURCE-distributed fragment be given FULL splits plus disjoint
+    round-robin lifespan subsets (TaskContext.grouped_shard) instead of
+    the usual split round-robin?
+
+    Mirrors make_grouped_runner's STATIC eligibility conditions (the
+    ones decidable without compiling): one bucketed scan, a grouped
+    basic aggregation keyed on its bucket column, config gates, and the
+    force/auto lifespan-count decision.  A misprediction is safe in
+    both directions — if grouped execution then fails to engage at
+    runtime, shard 0 runs the ordinary fallback over the full splits
+    and the other shards contribute nothing (pipeline.py gen()); if it
+    would have engaged but this predicate said no, tasks fall back to
+    split subsets, which _full_coverage rejects, and each task runs the
+    ordinary single-lifespan path over its subset."""
+    from .lowering import canonical_name
+    if not cfg.grouped_lifespan_sharding or not cfg.fuse_pipelines:
+        return False
+    if cfg.grouped_lifespans == 1 or cfg.memory_budget_bytes is not None:
+        return False
+    node = root
+    while isinstance(node, _PEELABLE):
+        node = node.source
+    if not isinstance(node, P.AggregationNode):
+        return False
+    if getattr(node, "step", P.SINGLE) not in (P.SINGLE, P.PARTIAL):
+        return False
+    if not node.grouping_keys:
+        return False
+    for agg in node.aggregations.values():
+        if agg.distinct or agg.mask is not None:
+            return False
+        fname = canonical_name(agg.call.display_name)
+        if fname == "count" and not agg.call.arguments:
+            fname = "count_star"
+        if fname not in _SHARDABLE_AGGS:
+            return False
+    # exactly one scan subtree: broadcast build sides arrive as
+    # RemoteSources in a SOURCE fragment, so >1 scan means a co-located
+    # join shape the runtime walker would have to re-verify per task
+    scans = [n for n in P.walk_plan(node)
+             if isinstance(n, P.TableScanNode)]
+    if len(scans) != 1:
+        return False
+    scan = scans[0]
+    table = scan.table.table_name
+    cid = scan.table.connector_id
+    bcol = catalog.bucket_column(table, cid)
+    if bcol is None:
+        return False
+    if not any((_resolve_to_scan(node.source, k.name) or (None, None))
+               == (scan, bcol) for k in node.grouping_keys):
+        return False
+    if cfg.grouped_lifespans >= 2:
+        return True
+    sf = dict(scan.table.extra).get("scaleFactor", 0.01)
+    layout1 = catalog.bucket_layout(sf, 1, cid)
+    if not layout1:
+        return False
+    return layout1[-1].key_hi - layout1[0].key_lo > AUTO_SPAN_THRESHOLD

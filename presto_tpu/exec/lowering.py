@@ -358,14 +358,24 @@ class Lowering:
             a = self.eval(args[0], batch)
             b = self.eval(args[1], batch)
             av = a.values.astype(jnp.int64)
-            sh = jnp.clip(b.values.astype(jnp.int64), 0, 63)
+            shv = b.values.astype(jnp.int64)
+            # int64 shift semantics: counts >= 64 shift everything out
+            # (0 for left/logical-right; arithmetic-right saturates to
+            # the sign fill); Presto ERRORS on negative counts, relaxed
+            # to NULL here (error->NULL convention, width_bucket-style)
+            big = shv >= 64
+            sh = jnp.clip(shv, 0, 63)
             if name == "bitwise_left_shift":
-                out = av << sh
+                out = jnp.where(big, 0, av << sh)
             elif name == "bitwise_arithmetic_shift_right":
-                out = av >> sh
+                out = av >> jnp.where(big, 63, sh)
             else:       # logical right shift
-                out = jax.lax.shift_right_logical(av, sh)
-            return Column(out, _combine_nulls(a, b))
+                out = jnp.where(big, 0,
+                                jax.lax.shift_right_logical(av, sh))
+            nulls = _combine_nulls(a, b)
+            bad = shv < 0
+            nulls = bad if nulls is None else (nulls | bad)
+            return Column(out, nulls)
         if name == "width_bucket":
             x = self.eval(args[0], batch)
             lo = self.eval(args[1], batch)
@@ -423,7 +433,10 @@ class Lowering:
             elem = arr      # repeat(x, n): x is scalar, n constant
             if not isinstance(args[1], ConstantExpression):
                 raise NotImplementedError("repeat with non-constant count")
-            n = int(args[1].value)
+            # negative count clamps to the empty array (Presto ERRORS;
+            # relaxed per the error->NULL/identity convention, and the
+            # oracle clamps identically)
+            n = max(int(args[1].value), 0)
             vals = jnp.tile(elem.values[:, None], (1, max(n, 1)))
             if n == 0:
                 vals = vals[:, :0]

@@ -1888,7 +1888,8 @@ def compact(batch: Batch, out_capacity: Optional[int] = None) -> Batch:
 
     cols = {name: Column(scat(c.values),
                          None if c.nulls is None else scat(c.nulls),
-                         c.dictionary, c.lazy)
+                         c.dictionary, c.lazy,
+                         None if c.lengths is None else scat(c.lengths))
             for name, c in batch.columns.items()}
     mask = jnp.zeros(cap, dtype=bool).at[idx].set(batch.mask, mode="drop")
     return Batch(cols, mask)

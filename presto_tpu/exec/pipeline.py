@@ -154,6 +154,19 @@ class ExecutionConfig:
     # joins whose whole-table builds exceed HBM), 1 = off, N>=2 = force N
     # bucket lifespans
     grouped_lifespans: int = 0
+    # lifespans staged AHEAD of the one the device is computing: bucket
+    # k+1's split reads / on-the-fly column generation and host->HBM
+    # transfers dispatch while bucket k's program runs (JAX async
+    # dispatch keeps the device queue full).  0 = strictly serial — each
+    # bucket's host work blocks on the previous bucket's consumption
+    grouped_prefetch_depth: int = 1
+    # distributed grouped stages: when a source stage is grouped-eligible
+    # (exec/grouped.py stage_shards_lifespans), give every task the FULL
+    # split set plus a disjoint round-robin subset of the bucket layout
+    # (task i runs lifespans i, i+N, ...) — K lifespans spread across N
+    # tasks/chips instead of replayed per task; per-bucket partial
+    # aggregates merge at the FINAL stage exactly as same-task buckets do
+    grouped_lifespan_sharding: bool = True
     # intra-task driver concurrency (reference task_concurrency /
     # driver-per-split, SqlTaskExecution.java:548): leaf scans drain
     # splits on this many threads through exec/local_exchange.py, and the
@@ -204,6 +217,16 @@ class TaskContext:
     memory: Optional[MemoryPool] = None
     # EXPLAIN ANALYZE: node id -> {rows, wall_s, batches} (None = disabled)
     stats: Optional[Dict[str, dict]] = None
+    # lifespan sharding (exec/grouped.py stage_shards_lifespans): when set
+    # to (shard_index, shard_count), this task owns bucket lifespans
+    # shard_index, shard_index+shard_count, ... of the grouped layout;
+    # its scans hold the FULL split set, and if grouped execution fails
+    # to engage at runtime only shard 0 runs the compiled fallback (the
+    # aggregation gen() guard) so no rows are duplicated
+    grouped_shard: Optional[Tuple[int, int]] = None
+    # runner-provided RuntimeStats sink (utils/runtime_stats.py): grouped
+    # execution records per-bucket generation/compute walls here
+    runtime_stats: Optional[object] = None
 
 
 def _var_types(variables) -> List[Type]:
@@ -1874,6 +1897,7 @@ class PlanCompiler:
         def gen():
             pool = self.ctx.memory
             fused = get_fused()
+            grouped = None
             if fused is not None:
                 grouped = fused_cache.get("grouped", False)
                 if grouped is False:
@@ -1885,6 +1909,15 @@ class PlanCompiler:
                 if grouped is not None:
                     yield from grouped.run()
                     return
+            shard = self.ctx.grouped_shard
+            if shard is not None and shard[0] != 0:
+                # the scheduler promised this stage disjoint lifespan
+                # subsets over FULL splits, but grouped execution did not
+                # engage at runtime: shard 0 alone runs the fallback over
+                # everything; the other shards contribute nothing, so no
+                # group is double-counted
+                return
+            if fused is not None:
                 out = run_fused(fused)
                 if out is not None:
                     yield out
@@ -3188,8 +3221,17 @@ def _concat_batches(batches: List[Batch]) -> Batch:
             nulls = jnp.concatenate([b.columns[n].null_mask() for b in batches])
         else:
             nulls = None
+        # ARRAY columns: lengths ride along like nulls (all batches of a
+        # stream share a column's representation, so lengths are either
+        # present everywhere or nowhere)
+        if first.lengths is not None:
+            lengths = jnp.concatenate([b.columns[n].lengths
+                                       for b in batches])
+        else:
+            lengths = None
         # dictionaries must agree (scan layer guarantees table-stable dicts)
-        cols[n] = Column(values, nulls, first.dictionary, first.lazy)
+        cols[n] = Column(values, nulls, first.dictionary, first.lazy,
+                         lengths)
     mask = jnp.concatenate([b.mask for b in batches])
     return Batch(cols, mask)
 

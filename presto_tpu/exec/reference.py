@@ -1147,15 +1147,31 @@ def _eval_call(expr: CallExpression, t: Table) -> Col:
                 m = bad if m is None else (m | bad)
             return (np.array(out, dtype=np.int64), m)
         bv = [int(x) for x in cols[1][0]]
+        if name in ("bitwise_left_shift", "bitwise_right_shift",
+                    "bitwise_arithmetic_shift_right"):
+            # int64 shift semantics shared with the engine (lowering.py):
+            # counts >= 64 shift everything out (arithmetic-right
+            # saturates to the sign fill); negative counts -> NULL
+            out = []
+            bad = np.zeros(t.n, dtype=bool)
+            for i, (x, y) in enumerate(zip(av, bv)):
+                if y < 0:
+                    bad[i] = True
+                    out.append(0)
+                elif name == "bitwise_left_shift":
+                    out.append(_i64(x << y) if y < 64 else 0)
+                elif name == "bitwise_arithmetic_shift_right":
+                    out.append(x >> min(y, 63))
+                else:
+                    out.append((x & 0xFFFFFFFFFFFFFFFF) >> y
+                               if y < 64 else 0)
+            if bad.any():
+                m = bad if m is None else (m | bad)
+            return (np.array([_i64(x) for x in out], dtype=np.int64), m)
         ops_map = {
             "bitwise_and": lambda x, y: x & y,
             "bitwise_or": lambda x, y: x | y,
             "bitwise_xor": lambda x, y: x ^ y,
-            "bitwise_left_shift": lambda x, y: _i64(x << min(max(y, 0), 63)),
-            "bitwise_arithmetic_shift_right":
-                lambda x, y: x >> min(max(y, 0), 63),
-            "bitwise_right_shift":
-                lambda x, y: (x & 0xFFFFFFFFFFFFFFFF) >> min(max(y, 0), 63),
         }
         fn = ops_map[name]
         return (np.array([_i64(fn(x, y)) for x, y in zip(av, bv)],
@@ -1236,7 +1252,8 @@ def _eval_array_fn(name: str, expr: CallExpression, t: Table) -> Col:
         counts = _eval(args[1], t)[0]
         out = np.empty(t.n, dtype=object)
         for i in range(t.n):
-            out[i] = (x[0][i],) * int(counts[i])
+            # negative counts clamp to empty (engine mirror, lowering.py)
+            out[i] = (x[0][i],) * max(int(counts[i]), 0)
         return (out, x[1])
     if name == "sequence":
         lo = _eval(args[0], t)[0]

@@ -96,6 +96,8 @@ class LocalQueryRunner:
         output, compiler = entry
         names = output.column_names
         types = [v.type for v in output.outputs]
+        # operators add fine-grained counters (grouped bucket walls, ...)
+        compiler.ctx.runtime_stats = stats
         with stats.record_wall("queryExecute"):
             result = pages_to_result(compiler.run_to_pages(output), names,
                                      types)
@@ -126,6 +128,7 @@ class LocalQueryRunner:
         output, compiler = entry
         names = output.column_names
         types = [v.type for v in output.outputs]
+        compiler.ctx.runtime_stats = stats
         columns = [{"name": n, "type": str(t)}
                    for n, t in zip(names, types)]
 

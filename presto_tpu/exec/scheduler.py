@@ -363,6 +363,18 @@ class InProcessScheduler:
         # traces on one core — PlanCompiler.shared_jit)
         stage_jits: Dict = {}
 
+        # lifespan sharding: a grouped-eligible source stage gives every
+        # task the FULL split set plus a disjoint round-robin subset of
+        # the bucket layout — K lifespans spread over N tasks instead of
+        # each task re-bucketing a split subset (which _full_coverage
+        # would reject, forfeiting grouped execution entirely)
+        from .grouped import stage_shards_lifespans
+        grouped_shards = (
+            stage.n_tasks > 1
+            and frag.partitioning == P.SOURCE_DISTRIBUTION
+            and stage_shards_lifespans(frag.root,
+                                       self.config.exec_config))
+
         def run_task(task_index: int):
             """One task's fragment execution; returns (batch-or-None for
             ICI stages, wall seconds)."""
@@ -370,8 +382,11 @@ class InProcessScheduler:
             ctx = TaskContext(config=self.config.exec_config,
                               task_index=task_index,
                               shared_jits=stage_jits)
+            if grouped_shards:
+                ctx.grouped_shard = (task_index, stage.n_tasks)
             for node_id, splits in scan_splits.items():
-                ctx.splits[node_id] = splits[task_index::stage.n_tasks]
+                ctx.splits[node_id] = (list(splits) if grouped_shards
+                                       else splits[task_index::stage.n_tasks])
             for rnode in remote_nodes:
                 sources = [child_by_fid[fid] for fid in
                            rnode.source_fragment_ids]

@@ -328,10 +328,20 @@ class _ConnectorModuleShim:
         return HostColumn(arr, nulls if nulls.any() else None)
 
     def generate_values_at(self, table, column, sf, ids):
+        # coalesce contiguous id runs into one ranged _read each: lazy
+        # row-id gathers come in mostly-sequential batches, and a
+        # storage connector's per-call overhead (seek, page decode)
+        # dwarfs the cost of the extra rows in a run
         ids = np.asarray(ids, dtype=np.int64)
         out = []
-        for i in ids:
-            out.extend(self._read(table, [column], sf, int(i), 1)[column])
+        i, n = 0, len(ids)
+        while i < n:
+            j = i + 1
+            while j < n and ids[j] == ids[j - 1] + 1:
+                j += 1
+            out.extend(self._read(table, [column], sf, int(ids[i]),
+                                  j - i)[column])
+            i = j
         return out
 
 

@@ -86,6 +86,14 @@ def execution_config_from_properties(props: Dict[str, str],
         kw["task_concurrency"] = int(props["task.max-drivers-per-task"])
     if "task.fuse-pipelines" in props:
         kw["fuse_pipelines"] = _bool(props["task.fuse-pipelines"])
+    if "task.grouped-lifespans" in props:
+        kw["grouped_lifespans"] = int(props["task.grouped-lifespans"])
+    if "task.grouped-prefetch-depth" in props:
+        kw["grouped_prefetch_depth"] = int(
+            props["task.grouped-prefetch-depth"])
+    if "task.grouped-lifespan-sharding" in props:
+        kw["grouped_lifespan_sharding"] = _bool(
+            props["task.grouped-lifespan-sharding"])
     return dataclasses.replace(cfg, **kw) if kw else cfg
 
 
@@ -125,6 +133,9 @@ class SystemConfig:
         ("task.max-partial-aggregation-memory", str, "16MB"),
         ("task.batch-rows", int, 1 << 16),
         ("task.fuse-pipelines", bool, True),
+        ("task.grouped-lifespans", int, 0),
+        ("task.grouped-prefetch-depth", int, 1),
+        ("task.grouped-lifespan-sharding", bool, True),
         ("shutdown-onset-sec", int, 10),
         ("system-memory-gb", int, 16),               # HBM per chip
         ("system-mem-limit-gb", int, 16),

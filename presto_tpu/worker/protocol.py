@@ -258,4 +258,14 @@ def apply_session_properties(config, session: Dict[str, str]):
                 f"unsupported exchange_compression_codec {codec!r}; "
                 f"supported: {', '.join(supported_codecs())}")
         kw["exchange_compression_codec"] = codec
+    # grouped (lifespan) execution knobs (reference grouped_execution /
+    # concurrent_lifespans_per_task session properties)
+    if "grouped_lifespans" in session:
+        kw["grouped_lifespans"] = int(session["grouped_lifespans"])
+    if "grouped_prefetch_depth" in session:
+        kw["grouped_prefetch_depth"] = int(
+            session["grouped_prefetch_depth"])
+    if "grouped_lifespan_sharding" in session:
+        kw["grouped_lifespan_sharding"] = (
+            str(session["grouped_lifespan_sharding"]).lower() == "true")
     return dataclasses.replace(config, **kw) if kw else config

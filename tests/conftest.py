@@ -36,3 +36,10 @@ def _close_leaked_worker_servers():
     yield
     from presto_tpu.worker.server import WorkerServer
     WorkerServer.close_all_live()
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: heavy end-to-end cases excluded from the tier-1 budget "
+        "(run with -m slow)")

@@ -194,3 +194,90 @@ def test_regexp_on_lazy_comment_column(runner):
     runner.assert_same_as_reference(
         "SELECT regexp_replace(comment, '[aeiou]', '') r, count(*) c "
         "FROM orders WHERE orderkey < 300 GROUP BY 1")
+
+
+# ---------------------------------------------------------------------------
+# int64 shift edge semantics (MathFunctions.java bitwiseLeftShift /
+# bitwiseRightShift / bitwiseRightShiftArithmetic): counts >= 64 shift
+# everything out, negative counts follow the error->NULL relaxation —
+# mirrored engine (exec/lowering.py) and oracle (exec/reference.py)
+# ---------------------------------------------------------------------------
+
+SHIFT_EDGE_QUERIES = [
+    # counts at and past the width
+    "SELECT orderkey, bitwise_left_shift(orderkey, 64) a, "
+    "bitwise_left_shift(orderkey, 100) b FROM orders WHERE orderkey < 30",
+    "SELECT orderkey, bitwise_right_shift(orderkey, 64) a, "
+    "bitwise_right_shift(0 - orderkey, 70) b FROM orders "
+    "WHERE orderkey < 30",
+    "SELECT orderkey, bitwise_arithmetic_shift_right(0 - orderkey, 64) a, "
+    "bitwise_arithmetic_shift_right(orderkey, 65) b FROM orders "
+    "WHERE orderkey < 30",
+    # negative counts -> NULL
+    "SELECT orderkey, bitwise_left_shift(orderkey, -1) a, "
+    "bitwise_right_shift(orderkey, -2) b, "
+    "bitwise_arithmetic_shift_right(orderkey, -3) c FROM orders "
+    "WHERE orderkey < 30",
+    # per-row mixed signs / magnitudes through a column count
+    "SELECT orderkey, bitwise_left_shift(orderkey, orderkey - 15) s "
+    "FROM orders WHERE orderkey < 40",
+    "SELECT orderkey, bitwise_right_shift(orderkey, orderkey * 3) s "
+    "FROM orders WHERE orderkey < 40",
+]
+
+
+@pytest.mark.parametrize("sql", SHIFT_EDGE_QUERIES)
+def test_shift_edge_semantics(runner, sql):
+    runner.assert_same_as_reference(sql)
+
+
+def test_shift_edge_values(runner):
+    res = runner.execute(
+        "SELECT bitwise_left_shift(orderkey, 64) a, "
+        "bitwise_right_shift(orderkey, 64) b, "
+        "bitwise_arithmetic_shift_right(0 - orderkey, 64) c, "
+        "bitwise_left_shift(orderkey, -1) d "
+        "FROM orders WHERE orderkey = 7")
+    assert res.rows == [[0, 0, -1, None]]
+
+
+def test_repeat_negative_count_clamps_to_empty(runner):
+    runner.assert_same_as_reference(
+        "SELECT orderkey, cardinality(repeat(orderkey, -3)) c "
+        "FROM orders WHERE orderkey < 10")
+    res = runner.execute(
+        "SELECT cardinality(repeat(orderkey, -1)) a, "
+        "cardinality(repeat(orderkey, 0)) b, "
+        "cardinality(repeat(orderkey, 2)) c "
+        "FROM orders WHERE orderkey = 3")
+    assert res.rows == [[0, 0, 2]]
+
+
+def test_compact_and_concat_preserve_array_lengths():
+    """ops.compact and pipeline._concat_batches must carry Column.lengths
+    (ARRAY columns) alongside values/nulls."""
+    import jax.numpy as jnp
+    from presto_tpu.exec import operators as ops
+    from presto_tpu.exec.batch import Batch, Column
+    from presto_tpu.exec.pipeline import _concat_batches
+
+    vals = jnp.arange(12, dtype=jnp.int64).reshape(6, 2)
+    lens = jnp.array([2, 1, 2, 0, 1, 2], dtype=jnp.int32)
+    mask = jnp.array([True, False, True, True, False, True])
+    b = Batch({"a": Column(vals, None, None, None, lens)}, mask)
+
+    out = ops.compact(b)
+    assert out.columns["a"].lengths is not None
+    live = [int(x) for x in out.columns["a"].lengths[:int(mask.sum())]]
+    assert live == [2, 2, 0, 2]
+
+    cat = _concat_batches([b, out])
+    assert cat.columns["a"].lengths is not None
+    assert cat.columns["a"].lengths.shape == (12,)
+    assert [int(x) for x in cat.columns["a"].lengths[:6]] == \
+        [int(x) for x in lens]
+
+    # scalar columns stay lengths-free through both paths
+    s = Batch({"x": Column(jnp.arange(6, dtype=jnp.int64))}, mask)
+    assert ops.compact(s).columns["x"].lengths is None
+    assert _concat_batches([s, s]).columns["x"].lengths is None

@@ -171,3 +171,95 @@ def test_grouped_peak_build_rows_bounded(monkeypatch):
     r.execute(Q3)
     total = tpch.table_row_count("orders", 0.01)
     assert seen and max(seen) <= -(-total // 4) + 7
+
+
+# ---------------------------------------------------------------------------
+# prefetch (double-buffering) + lifespan sharding
+# ---------------------------------------------------------------------------
+
+def test_prefetch_defaults_on():
+    assert ExecutionConfig().grouped_prefetch_depth == 1
+    assert ExecutionConfig().grouped_lifespan_sharding is True
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("depth", [0, 1, 2])
+def test_q3_grouped_prefetch_depths(monkeypatch, depth):
+    """Parity is depth-invariant: depth 0 is the strictly serial bucket
+    loop, depth >= 1 stages the next lifespan's generation + transfer
+    while the current one computes."""
+    calls = _spy_runs(monkeypatch)
+    r = LocalQueryRunner("sf0.01", config=ExecutionConfig(
+        grouped_lifespans=4, grouped_prefetch_depth=depth))
+    oracle = LocalQueryRunner("sf0.01")
+    _assert_rows_equal(r.execute(Q3), oracle.execute_reference(Q3), True)
+    assert len(calls) == 1 and len(calls[0].layout) == 4
+
+
+@pytest.mark.slow
+def test_lifespan_sharding_distributed(monkeypatch):
+    """A grouped SOURCE stage with n_tasks > 1 hands each task a disjoint
+    round-robin subset of lifespans over the FULL split set."""
+    from presto_tpu.exec import grouped as G
+    from presto_tpu.exec.runner import DistributedQueryRunner
+    shards = []
+    orig = G.GroupedRunner.run
+
+    def spy(self):
+        shards.append(getattr(self.compiler.ctx, "grouped_shard", None))
+        return orig(self)
+    monkeypatch.setattr(G.GroupedRunner, "run", spy)
+    r = DistributedQueryRunner("sf0.01", config=ExecutionConfig(
+        grouped_lifespans=4), n_tasks=2)
+    oracle = LocalQueryRunner("sf0.01")
+    _assert_rows_equal(r.execute(Q3), oracle.execute_reference(Q3), True)
+    assert sorted(s for s in shards if s is not None) == [(0, 2), (1, 2)]
+
+
+@pytest.mark.slow
+def test_sharded_fallback_when_grouped_declines(monkeypatch):
+    """If the sharding predictor said yes but make_grouped_runner declines
+    at runtime, shard 0 runs the ordinary full-split path and the other
+    shards produce nothing — no duplicated rows either way."""
+    from presto_tpu.exec import grouped as G
+    from presto_tpu.exec.runner import DistributedQueryRunner
+    monkeypatch.setattr(G, "make_grouped_runner", lambda *a, **k: None)
+    r = DistributedQueryRunner("sf0.01", config=ExecutionConfig(
+        grouped_lifespans=4), n_tasks=2)
+    oracle = LocalQueryRunner("sf0.01")
+    _assert_rows_equal(r.execute(Q3), oracle.execute_reference(Q3), True)
+
+
+def test_stage_shards_lifespans_predictor():
+    from presto_tpu.exec.grouped import stage_shards_lifespans
+    from presto_tpu.sql.parser import parse_sql
+    from presto_tpu.sql.planner import Planner
+
+    def root_for(sql):
+        out = Planner(default_schema="sf0.01") \
+            .plan_query_to_output(parse_sql(sql))
+        return out.source
+
+    cfg = ExecutionConfig(grouped_lifespans=4)
+    grouped_sql = ("select l_orderkey, sum(l_quantity) q from lineitem "
+                   "group by l_orderkey")
+    assert stage_shards_lifespans(root_for(grouped_sql), cfg)
+    # non-bucket grouping key -> no
+    assert not stage_shards_lifespans(root_for(
+        "select l_partkey, sum(l_quantity) q from lineitem "
+        "group by l_partkey"), cfg)
+    # global aggregation (no grouping keys) -> no
+    assert not stage_shards_lifespans(root_for(
+        "select sum(l_quantity) q from lineitem"), cfg)
+    # distinct aggregate -> no
+    assert not stage_shards_lifespans(root_for(
+        "select l_orderkey, count(distinct l_partkey) c from lineitem "
+        "group by l_orderkey"), cfg)
+    # knob off -> no
+    assert not stage_shards_lifespans(
+        root_for(grouped_sql),
+        ExecutionConfig(grouped_lifespans=4,
+                        grouped_lifespan_sharding=False))
+    # lifespans forced off -> no
+    assert not stage_shards_lifespans(
+        root_for(grouped_sql), ExecutionConfig(grouped_lifespans=1))

@@ -67,3 +67,23 @@ def test_runtime_stats_in_query_info():
         assert "queryExecuteWallNanos" in info["runtimeStats"]
     finally:
         server.close()
+
+
+def test_grouped_bucket_walls_exposed():
+    """Grouped execution reports per-bucket generation and compute walls
+    plus the whole-run wall, keyed by lifespan count."""
+    from presto_tpu.exec.pipeline import ExecutionConfig
+    from presto_tpu.exec.runner import LocalQueryRunner
+    r = LocalQueryRunner("sf0.01",
+                         config=ExecutionConfig(grouped_lifespans=4))
+    res = r.execute(
+        "select l_orderkey, sum(l_quantity) q from lineitem "
+        "group by l_orderkey order by q desc limit 5")
+    stats = res.runtime_stats
+    assert stats["groupedBucketGenWallNanos"]["count"] == 4
+    assert stats["groupedBucketComputeWallNanos"]["count"] == 4
+    assert stats["groupedBucketGenWallNanos"]["sum"] > 0
+    assert stats["groupedBucketComputeWallNanos"]["sum"] > 0
+    assert stats["groupedRunWallNanos"]["count"] == 1
+    assert stats["groupedRunWallNanos"]["sum"] >= \
+        stats["groupedBucketComputeWallNanos"]["sum"]

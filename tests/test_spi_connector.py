@@ -164,3 +164,33 @@ def test_plugin_connector_joins_builtin_catalog(letters_catalog):
         "join nation n on l.id = n.n_nationkey where l.id <= 2 "
         "order by l.id")
     assert res.rows == [["alpha", "ARGENTINA"], ["beta", "BRAZIL"]]
+
+
+def test_generate_values_at_coalesces_contiguous_runs(letters_catalog,
+                                                      monkeypatch):
+    """Lazy row-id gathers must issue one ranged _read per contiguous id
+    run, not one call per row."""
+    shim = catalog._CONNECTORS[letters_catalog]
+    calls = []
+    real_read = type(shim)._read
+
+    def spying_read(self, table, columns, sf, start, count):
+        calls.append((start, count))
+        return real_read(self, table, columns, sf, start, count)
+
+    monkeypatch.setattr(type(shim), "_read", spying_read)
+
+    vals = shim.generate_values_at("letters", "name", 0.01, [0, 1, 2, 4])
+    assert vals == [r[1] for r in _ROWS[:3]] + [_ROWS[4][1]]
+    assert calls == [(0, 3), (4, 1)]
+
+    calls.clear()
+    vals = shim.generate_values_at("letters", "id", 0.01, [3])
+    assert vals == [_ROWS[3][0]]
+    assert calls == [(3, 1)]
+
+    calls.clear()
+    vals = shim.generate_values_at("letters", "id", 0.01,
+                                   list(range(5)))
+    assert vals == [r[0] for r in _ROWS]
+    assert calls == [(0, 5)]

@@ -197,3 +197,138 @@ def test_q51_shape_cumulative_windows(runner):
           and d_date between date '2000-01-01' and date '2000-02-01'
           and ss_item_sk < 50
         group by ss_item_sk, d_date""")
+
+
+# ---------------------------------------------------------------------------
+# ws_order_number co-bucket layout + grouped (lifespan) execution of the
+# Q95-core shapes (BASELINE config 5 blocker)
+# ---------------------------------------------------------------------------
+
+import numpy as np
+
+from presto_tpu.exec.pipeline import ExecutionConfig
+
+
+def _spy_runs(monkeypatch):
+    from presto_tpu.exec import grouped as G
+    calls = []
+    orig = G.GroupedRunner.run
+
+    def spy(self):
+        calls.append(self)
+        return orig(self)
+    monkeypatch.setattr(G.GroupedRunner, "run", spy)
+    return calls
+
+
+@pytest.mark.parametrize("sf", [0.01])
+@pytest.mark.parametrize("k", [1, 2, 4, 7])
+def test_tpcds_bucket_layout_tiles_tables(sf, k):
+    layout = tpcds.bucket_layout(sf, k)
+    assert 1 <= len(layout) <= k
+    n_ws = tpcds.table_row_count("web_sales", sf)
+    n_wr = tpcds.table_row_count("web_returns", sf)
+    n_keys = -(-n_ws // tpcds.LINES_PER_ORDER)
+    assert layout[0].key_lo == 1
+    assert layout[-1].key_hi == n_keys + 1
+    assert layout[0].rows["web_sales"][0] == 0
+    assert layout[-1].rows["web_sales"][1] == n_ws
+    assert layout[0].rows["web_returns"][0] == 0
+    assert layout[-1].rows["web_returns"][1] == n_wr
+    for prev, cur in zip(layout, layout[1:]):
+        assert cur.key_lo == prev.key_hi
+        for t in ("web_sales", "web_returns"):
+            assert cur.rows[t][0] == prev.rows[t][1]
+    for b in layout:
+        assert b.key_lo < b.key_hi
+        lo, hi = b.rows["web_sales"]
+        assert lo < hi                       # every bucket owns sales rows
+        lo, hi = b.rows["web_returns"]
+        assert lo <= hi                      # returns may be empty
+
+
+@pytest.mark.parametrize("k", [2, 5])
+def test_tpcds_bucket_rows_match_key_ranges(k):
+    sf = 0.01
+    for b in tpcds.bucket_layout(sf, k):
+        for table, col in tpcds.BUCKET_COLUMNS.items():
+            lo, hi = b.rows[table]
+            if lo == hi:
+                continue
+            keys = tpcds.generate_column(table, col, sf, lo, hi - lo)
+            assert keys.min() >= b.key_lo and keys.max() < b.key_hi
+
+
+def test_tpcds_catalog_bucket_metadata():
+    assert catalog.bucket_column("web_sales", "tpcds") == "ws_order_number"
+    assert catalog.bucket_column("web_returns", "tpcds") == \
+        "wr_order_number"
+    assert catalog.bucket_column("store_sales", "tpcds") is None
+    assert catalog.bucket_layout(0.01, 4, "tpcds") is not None
+
+
+Q95_SEMI_CORE = """
+select ws_order_number, count(*) c, sum(ws_ext_ship_cost) s
+from web_sales
+where ws_order_number in (select wr_order_number from web_returns)
+group by ws_order_number
+order by ws_order_number
+"""
+
+Q95_JOIN_CORE = """
+select ws_order_number, sum(wr_return_amt) amt
+from web_sales join web_returns on ws_order_number = wr_order_number
+group by ws_order_number
+order by ws_order_number
+"""
+
+Q95_SELF_JOIN_CORE = """
+select ws1.ws_order_number, count(*) c
+from web_sales ws1 join web_sales ws2
+  on ws1.ws_order_number = ws2.ws_order_number
+where ws1.ws_warehouse_sk <> ws2.ws_warehouse_sk
+group by ws1.ws_order_number
+order by ws1.ws_order_number
+"""
+
+
+@pytest.mark.parametrize("sql", [Q95_SEMI_CORE, Q95_JOIN_CORE,
+                                 Q95_SELF_JOIN_CORE],
+                         ids=["semi", "join", "self_join"])
+@pytest.mark.slow
+def test_q95_core_grouped_parity(monkeypatch, sql):
+    calls = _spy_runs(monkeypatch)
+    r = LocalQueryRunner("sf0.01", catalog="tpcds",
+                         config=ExecutionConfig(grouped_lifespans=4))
+    got = r.execute(sql)
+    exp = r.execute_reference(sql)
+    from presto_tpu.exec.runner import _assert_rows_equal
+    _assert_rows_equal(got, exp, True)
+    assert len(calls) == 1 and len(calls[0].layout) == 4
+
+
+@pytest.mark.slow
+def test_q95_core_grouped_auto_engages(monkeypatch):
+    # with thresholds shrunk to toy scale, auto mode (grouped_lifespans=0)
+    # must pick a multi-bucket layout by itself
+    from presto_tpu.exec import grouped as G
+    calls = _spy_runs(monkeypatch)
+    monkeypatch.setattr(G, "AUTO_SPAN_THRESHOLD", 1024)
+    monkeypatch.setattr(G, "TARGET_BUCKET_SPAN", 512)
+    r = LocalQueryRunner("sf0.01", catalog="tpcds",
+                         config=ExecutionConfig(grouped_lifespans=0))
+    got = r.execute(Q95_JOIN_CORE)
+    exp = r.execute_reference(Q95_JOIN_CORE)
+    from presto_tpu.exec.runner import _assert_rows_equal
+    _assert_rows_equal(got, exp, True)
+    assert len(calls) == 1 and len(calls[0].layout) >= 2
+
+
+@pytest.mark.slow
+def test_q95_official_stays_correct_with_forced_lifespans(runner):
+    # the official Q95 carries count(distinct ...) so grouped execution
+    # must decline, and the forced-lifespan config must not disturb it
+    sql = Q95.format(end="2002-12-31", company="")
+    r = LocalQueryRunner("sf0.01", catalog="tpcds",
+                         config=ExecutionConfig(grouped_lifespans=4))
+    r.assert_same_as_reference(sql, ordered=False)
