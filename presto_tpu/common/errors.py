@@ -1,0 +1,153 @@
+"""Typed error classification shared by the local batch scheduler and the
+distributed HTTP runtime.
+
+The analog of presto-spark-base's ErrorClassifier.java (which decides
+whether a Spark executor loss / task failure may retry) and of the
+reference coordinator's remote-task error budget + error-type taxonomy
+(ErrorType.java: USER_ERROR | INTERNAL_ERROR | INSUFFICIENT_RESOURCES |
+EXTERNAL, carried in ExecutionFailureInfo.errorCode).  One place decides
+which failures are RETRYABLE (transport loss, worker death, 503 refusal,
+oom-kill, injected chaos) and which are the user's (bad SQL, bad session
+property) and must fail fast with no retry attempt.
+
+Worker tasks tag their failure messages with ``[ERROR_TYPE]`` so
+classification survives the string-typed failure chain: a producer's
+USER_ERROR propagated through a consumer's exchange pull stays
+non-retryable at the coordinator.
+"""
+from __future__ import annotations
+
+import re
+from typing import Optional
+
+# reference ErrorType.java values (also the thrift ERROR_TYPE enum)
+USER_ERROR = "USER_ERROR"
+INTERNAL_ERROR = "INTERNAL_ERROR"
+INSUFFICIENT_RESOURCES = "INSUFFICIENT_RESOURCES"
+EXTERNAL = "EXTERNAL"
+
+# USER_ERROR never retries; everything infrastructure-shaped may.
+# INTERNAL_ERROR stays retryable like the batch scheduler's executor-loss
+# path (presto-spark re-runs lost tasks from durable inputs); an engine
+# bug then fails after the attempt budget instead of masquerading as
+# permanently transient.
+RETRYABLE_TYPES = {INTERNAL_ERROR, INSUFFICIENT_RESOURCES, EXTERNAL}
+
+_TYPE_TAG = re.compile(r"\[(USER_ERROR|INTERNAL_ERROR|"
+                       r"INSUFFICIENT_RESOURCES|EXTERNAL)\]")
+# producer buffer locations embedded in failure text:
+# http://host:port/v1/task/{taskId}/results/{bufferId}
+_LOCATION_TASK = re.compile(r"/v1/task/([^/\s]+)/results/")
+
+
+class PrestoQueryError(RuntimeError):
+    """Base typed query error; subclasses pin the reference error type."""
+    error_type = INTERNAL_ERROR
+
+
+class PrestoUserError(PrestoQueryError):
+    """The query (or its session) is wrong; retrying cannot help."""
+    error_type = USER_ERROR
+
+
+class InjectedTaskFailure(PrestoQueryError):
+    """Chaos-injected task failure (retryable, like an executor loss)."""
+    error_type = INTERNAL_ERROR
+
+
+class WorkerLostError(PrestoQueryError):
+    """A worker stopped answering (process death / network partition)."""
+    error_type = EXTERNAL
+
+    def __init__(self, worker_uri: str, message: str = ""):
+        super().__init__(message or f"worker {worker_uri} lost")
+        self.worker_uri = worker_uri
+
+
+class TaskLostError(PrestoQueryError):
+    """A task the coordinator created is gone (404: the worker restarted
+    and lost its registry) — reschedule, don't surface KeyError."""
+    error_type = EXTERNAL
+
+    def __init__(self, task_id: str, worker_uri: str = ""):
+        super().__init__(f"task {task_id} lost"
+                         + (f" (worker {worker_uri})" if worker_uri else ""))
+        self.task_id = task_id
+        self.worker_uri = worker_uri
+
+
+class ExchangeLostError(PrestoQueryError):
+    """An exchange source stayed unreachable past the error budget (or its
+    task vanished mid-stream).  Carries the producer location so the
+    coordinator can map the loss back to the producing task and retry it
+    instead of failing the query (reference exchange.max-error-duration)."""
+    error_type = EXTERNAL
+
+    def __init__(self, location: str, last_token: int = 0,
+                 message: str = ""):
+        super().__init__(
+            message or f"exchange source {location} lost "
+                       f"(last delivered token {last_token})")
+        self.location = location
+        self.last_token = last_token
+
+
+class RemoteTaskError(PrestoQueryError):
+    """A producer task reported failure through its buffer (HTTP 500 on a
+    results pull).  The error type is parsed from the producer's tagged
+    message so non-retryability propagates across task chains."""
+
+    def __init__(self, location: str, detail: str):
+        super().__init__(f"exchange source {location} failed: {detail}")
+        self.location = location
+        self.error_type = parse_error_type(detail, INTERNAL_ERROR)
+
+
+def parse_error_type(text: str, default: str = INTERNAL_ERROR) -> str:
+    """First ``[ERROR_TYPE]`` tag embedded in a failure message."""
+    m = _TYPE_TAG.search(text or "")
+    return m.group(1) if m else default
+
+
+def producer_task_from_text(text: str) -> Optional[str]:
+    """Task id of a producer buffer location mentioned in failure text
+    (.../v1/task/{taskId}/results/...), for mapping an exchange loss back
+    to the producing task."""
+    m = _LOCATION_TASK.search(text or "")
+    return m.group(1) if m else None
+
+
+# exceptions that mean the QUERY is wrong, not the cluster
+_USER_EXC = (ValueError, TypeError, KeyError, NotImplementedError,
+             ZeroDivisionError)
+
+
+def classify_exception(exc: BaseException) -> str:
+    """Exception -> reference error type.  Typed errors carry their own;
+    untyped ones classify by shape, with FileNotFoundError (missing user
+    data) split off from the transport OSErrors."""
+    et = getattr(exc, "error_type", None)
+    if isinstance(et, str) and et:
+        return et
+    import urllib.error
+    if isinstance(exc, urllib.error.HTTPError):
+        return EXTERNAL if exc.code in (408, 429, 500, 502, 503, 504) \
+            else USER_ERROR
+    if type(exc).__name__ == "MemoryExceededError" \
+            or isinstance(exc, MemoryError):
+        return INSUFFICIENT_RESOURCES
+    if isinstance(exc, (FileNotFoundError, IsADirectoryError)):
+        return USER_ERROR
+    if isinstance(exc, (ConnectionError, TimeoutError, OSError)):
+        return EXTERNAL
+    if isinstance(exc, _USER_EXC):
+        return USER_ERROR
+    return parse_error_type(str(exc), INTERNAL_ERROR)
+
+
+def is_retryable(exc: BaseException) -> bool:
+    return classify_exception(exc) in RETRYABLE_TYPES
+
+
+def is_retryable_type(error_type: str) -> bool:
+    return (error_type or INTERNAL_ERROR) in RETRYABLE_TYPES

@@ -181,6 +181,22 @@ class ExecutionConfig:
     # serializes kernels; the default stays 1, and >1 remains for
     # multi-core HOST work (spill IO, page serde, host-generated columns)
     task_concurrency: int = 1
+    # -- fault tolerance (distributed HTTP runtime) -----------------------
+    # per-lineage retry attempts for FAILED/lost remote tasks (reference
+    # presto-spark ErrorClassifier retries; 0 = fail-fast streaming MPP).
+    # >0 additionally makes worker output buffers RETAIN acknowledged
+    # pages until task teardown, so a restarted consumer replays its
+    # input from token 0 — memory-for-replayability; the durable
+    # alternative is the batch scheduler's shuffle staging
+    remote_task_retry_attempts: int = 2
+    # how long an exchange client keeps retrying an unreachable source
+    # (exponential backoff + jitter) before declaring the producer lost
+    # (reference exchange.max-error-duration, Configs.h)
+    exchange_max_error_duration_s: float = 60.0
+    # chaos hook: probability a task fails at start.  The roll is
+    # deterministic per task id, so a retry (new attempt id) rolls
+    # independently and chaos tests replay exactly
+    fault_injection_probability: float = 0.0
 
 
 def tuned_config(**overrides) -> "ExecutionConfig":

@@ -427,7 +427,11 @@ class InProcessScheduler:
             shuffle files), the recoverable-execution contract
             (PrestoSparkTaskExecutorFactory retry via Spark /
             RECOVERABLE_GROUPED_EXECUTION).  Streaming mode keeps
-            fail-fast MPP semantics (task_retries=0)."""
+            fail-fast MPP semantics (task_retries=0).  Retry is gated by
+            the shared error classifier (ErrorClassifier.java analog):
+            USER_ERROR — bad SQL, bad input — fails fast; only
+            infrastructure-shaped failures consume retry attempts."""
+            from ..common.errors import is_retryable
             attempts = 1 + max(0, self.config.task_retries)
             for attempt in range(attempts):
                 try:
@@ -435,9 +439,9 @@ class InProcessScheduler:
                         self.config.fault_injector(
                             frag.fragment_id, task_index, attempt)
                     return run_task(task_index)
-                except Exception:
+                except Exception as e:
                     stage.buffers.reset_task(task_index)
-                    if attempt + 1 >= attempts:
+                    if attempt + 1 >= attempts or not is_retryable(e):
                         raise
             return None, 0.0
 

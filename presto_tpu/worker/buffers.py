@@ -21,13 +21,23 @@ class PageBuffer:
     """One buffer id: an append-only sequence of serialized pages with
     client-driven compaction and producer backpressure (the reference's
     OutputBufferMemoryManager bounds buffered bytes and blocks the
-    producer; acknowledges free memory and unblock it)."""
+    producer; acknowledges free memory and unblock it).
 
-    def __init__(self, max_buffered_bytes: int = DEFAULT_MAX_BUFFERED_BYTES):
+    With `retain=True` (fault-tolerant streaming: remote task retry
+    enabled) acknowledged pages stay resident instead of being freed, so
+    a RESTARTED consumer task can replay the stream from token 0 exactly
+    — the streaming analog of the batch scheduler's durable shuffle
+    files, paid in buffer memory.  Backpressure still counts only
+    UNacknowledged bytes, matching the non-retain threshold behavior."""
+
+    def __init__(self, max_buffered_bytes: int = DEFAULT_MAX_BUFFERED_BYTES,
+                 retain: bool = False):
         self._pages: List[bytes] = []
         self._base = 0                    # sequence number of _pages[0]
-        self._bytes = 0                   # bytes currently retained
+        self._bytes = 0                   # UNacknowledged bytes (backpressure)
         self._max_bytes = max_buffered_bytes
+        self._retain = retain
+        self._acked = 0                   # retain mode: acknowledge watermark
         self._complete = False
         self._destroyed = False
         self._error: Optional[str] = None
@@ -81,6 +91,16 @@ class PageBuffer:
 
     def acknowledge(self, token: int) -> None:
         with self._cond:
+            if self._retain:
+                # advance the watermark and release backpressure, but keep
+                # the pages for replay by a retried consumer
+                upto = max(self._acked, min(token, len(self._pages)))
+                if upto > self._acked:
+                    self._bytes -= sum(len(p) for p in
+                                       self._pages[self._acked:upto])
+                    self._acked = upto
+                    self._cond.notify_all()
+                return
             drop = max(0, min(token - self._base, len(self._pages)))
             if drop:
                 self._bytes -= sum(len(p) for p in self._pages[:drop])
@@ -88,8 +108,13 @@ class PageBuffer:
                 self._base += drop
                 self._cond.notify_all()  # unblock a backpressured producer
 
-    def destroy(self) -> None:
+    def destroy(self, force: bool = True) -> None:
+        # a retained buffer survives the consumer's end-of-stream DELETE
+        # (a retried consumer may still need to replay it); only task
+        # teardown (cancel/evict -> destroy_all) reclaims it
         with self._cond:
+            if self._retain and not force:
+                return
             self._pages = []
             self._bytes = 0
             self._complete = True
@@ -101,9 +126,11 @@ class OutputBufferManager:
     """All buffers of one task.  PARTITIONED routes page partition p to
     buffer p; BROADCAST replicates every page into each consumer's buffer."""
 
-    def __init__(self, buffer_type: str, n_buffers: int):
+    def __init__(self, buffer_type: str, n_buffers: int,
+                 retain: bool = False):
         self.buffer_type = buffer_type
-        self.buffers = [PageBuffer() for _ in range(max(1, n_buffers))]
+        self.buffers = [PageBuffer(retain=retain)
+                        for _ in range(max(1, n_buffers))]
 
     def add(self, partition: int, page_bytes: bytes) -> None:
         if self.buffer_type == "BROADCAST":
@@ -127,8 +154,9 @@ class OutputBufferManager:
         self.buffers[buffer_id].acknowledge(token)
 
     def destroy(self, buffer_id: int) -> None:
-        self.buffers[buffer_id].destroy()
+        # consumer-driven destroy: honored immediately unless retained
+        self.buffers[buffer_id].destroy(force=False)
 
     def destroy_all(self) -> None:
         for b in self.buffers:
-            b.destroy()
+            b.destroy(force=True)

@@ -7,26 +7,48 @@ The analog of the reference coordinator's scheduling + remote-task stack
 stage): fragments are assigned round-robin to discovered workers, each task
 gets its splits + upstream buffer locations in a TaskUpdateRequest, and the
 coordinator pulls the root stage's buffers over the same results protocol.
+
+Fault tolerance (reference HttpRemoteTask error budgets + presto-spark's
+ErrorClassifier-driven task retry): every failure observed at the
+coordinator — a FAILED task status, a 404 on a task the coordinator
+created, a worker dropping off the failure detector, an exchange source
+exhausting its error budget — is classified by error type.  USER_ERROR
+fails the query fast with no retry; everything infrastructure-shaped
+restarts the failed task under a per-task attempt budget
+(remote_task_retry_attempts), on a surviving worker, with the SAME task-id
+lineage and the SAME splits.  Because consumer TaskSources bake in producer
+locations, restarting a producer restarts every ancestor stage up to the
+root; the root's restart resets the coordinator's collected pages, and
+retained producer buffers replay from token 0, so output stays
+exactly-once.
 """
 from __future__ import annotations
 
 import itertools
 import json
+import re
 import threading
 import time
 import urllib.error
 import urllib.request
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Set, Tuple
 
+from ..common.errors import (PrestoQueryError, PrestoUserError,
+                             ExchangeLostError, RemoteTaskError,
+                             WorkerLostError, is_retryable_type,
+                             parse_error_type)
 from ..connectors import catalog, tpch
 from ..exec.pipeline import ExecutionConfig
 from ..exec.runner import LocalQueryRunner, QueryResult, pages_to_result
 from ..spi import plan as P
 from .exchange import pull_pages
 from .protocol import (DONE_STATES, FAILED, OutputBuffersSpec, TaskSource,
-                       TaskStatus, TaskUpdateRequest)
+                       TaskStatus, TaskUpdateRequest, parse_duration)
 
 _query_counter = itertools.count()
+
+_RETRY_SUFFIX = re.compile(r"\.r\d+$")
+_RESULT_LOCATIONS = re.compile(r"/v1/task/([^/\s]+)/results/")
 
 
 class HeartbeatFailureDetector:
@@ -89,6 +111,15 @@ class HeartbeatFailureDetector:
             return [u for u in self.worker_uris
                     if self._streak[u] >= self.threshold]
 
+    def snapshot(self) -> Dict[str, dict]:
+        """Per-worker probe state for /v1/status and /v1/metrics."""
+        with self._lock:
+            return {u: {"streak": self._streak[u],
+                        "draining": u in self._draining,
+                        "alive": (self._streak[u] < self.threshold
+                                  and u not in self._draining)}
+                    for u in self.worker_uris}
+
     def close(self) -> None:
         self._stop.set()
 
@@ -113,14 +144,15 @@ class RemoteTask:
             return TaskStatus.from_dict(json.loads(resp.read()))
 
     def status(self, current_state: Optional[str] = None,
-               max_wait_ms: int = 1000) -> TaskStatus:
+               max_wait_ms: int = 1000,
+               timeout_s: float = 60.0) -> TaskStatus:
         from .auth import outbound_headers
         url = f"{self.task_uri}/status?maxWaitMs={max_wait_ms}"
         req = urllib.request.Request(url, headers=outbound_headers())
         if current_state:
             req.add_header("X-Presto-Current-State", current_state)
         from .auth import urlopen_internal
-        with urlopen_internal(req, timeout=60) as resp:
+        with urlopen_internal(req, timeout=timeout_s) as resp:
             return TaskStatus.from_dict(json.loads(resp.read()))
 
     def cancel(self) -> None:
@@ -139,11 +171,404 @@ class RemoteTask:
 
 class _Stage:
     def __init__(self, fragment: P.PlanFragment, children: List["_Stage"],
-                 n_tasks: int):
+                 n_tasks: int, stage_path: str = "0"):
         self.fragment = fragment
         self.children = children
         self.n_tasks = n_tasks
-        self.tasks: List[RemoteTask] = []
+        self.stage_path = stage_path
+        self.parent: Optional["_Stage"] = None
+        for c in children:
+            c.parent = self
+        # filled by _QueryExecution._prepare: immutable per query, reused
+        # verbatim on task restart (same splits, same buffer spec)
+        self.spec: Optional[OutputBuffersSpec] = None
+        self.scan_splits: Dict[str, List[catalog.TableSplit]] = {}
+        self.remote_nodes: List[P.RemoteSourceNode] = []
+        self.tasks: List[Optional[RemoteTask]] = [None] * n_tasks
+
+    def postorder(self) -> List["_Stage"]:
+        out: List[_Stage] = []
+        for c in self.children:
+            out.extend(c.postorder())
+        out.append(self)
+        return out
+
+
+class _FailureSignal(Exception):
+    """Internal control flow: the status watcher observed task failures;
+    unwind the root pull and let the retry loop classify them."""
+
+    def __init__(self, events: List[dict]):
+        super().__init__(f"{len(events)} task failure(s) observed")
+        self.events = events
+
+
+class _StatusWatcher:
+    """Background poller over every live task's /status (the coordinator
+    side of the reference's continuous task-status long-poll in
+    HttpRemoteTask).  Feeds failures to the query's retry loop the moment
+    they happen, so the root pull aborts early instead of draining all
+    pages first.  Transport errors build a per-worker streak; two straight
+    misses — or the failure detector dropping the worker — count every
+    unfinished task there as lost."""
+
+    TRANSPORT_STREAK = 2
+
+    def __init__(self, execution: "_QueryExecution",
+                 interval_s: float = 0.15):
+        self._exec = execution
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self._events: List[dict] = []
+        self._streaks: Dict[str, int] = {}
+        self._done: Set[str] = set()
+        self._thread = threading.Thread(target=self._loop,
+                                        args=(interval_s,),
+                                        name="status-watcher", daemon=True)
+        self._thread.start()
+
+    def events(self) -> List[dict]:
+        with self._lock:
+            return list(self._events)
+
+    def _emit(self, **event) -> None:
+        with self._lock:
+            self._events.append(event)
+
+    def _loop(self, interval_s: float) -> None:
+        while not self._stop.is_set():
+            dead_workers = set()
+            det = self._exec.runner.failure_detector
+            if det is not None:
+                dead_workers.update(det.failed())
+            for task in self._exec.current_tasks():
+                if self._stop.is_set():
+                    return
+                if task.task_id in self._done:
+                    continue
+                if task.worker_uri in dead_workers:
+                    self._emit(kind="worker_lost", task_id=task.task_id,
+                               worker_uri=task.worker_uri,
+                               message=f"worker {task.worker_uri} dropped "
+                                       "by failure detector")
+                    continue
+                try:
+                    st = task.status(max_wait_ms=0, timeout_s=2.0)
+                except urllib.error.HTTPError as e:
+                    if e.code in (404, 410):
+                        # the worker restarted and lost its task registry:
+                        # the task is gone, not the query (TaskLostError)
+                        self._emit(kind="task_lost", task_id=task.task_id,
+                                   worker_uri=task.worker_uri,
+                                   message=f"task {task.task_id} not found "
+                                           f"on {task.worker_uri} "
+                                           f"({e.code})")
+                    else:
+                        self._bump_streak(task)
+                except (urllib.error.URLError, TimeoutError, OSError,
+                        ValueError):
+                    self._bump_streak(task)
+                else:
+                    self._streaks[task.worker_uri] = 0
+                    if st.state == FAILED:
+                        msg = st.failures[0] if st.failures else "unknown"
+                        self._emit(kind="failed", task_id=task.task_id,
+                                   worker_uri=task.worker_uri,
+                                   error_type=st.error_type, message=msg)
+                    elif st.state in DONE_STATES:
+                        self._done.add(task.task_id)
+            self._stop.wait(interval_s)
+
+    def _bump_streak(self, task: RemoteTask) -> None:
+        n = self._streaks.get(task.worker_uri, 0) + 1
+        self._streaks[task.worker_uri] = n
+        if n >= self.TRANSPORT_STREAK:
+            self._emit(kind="worker_lost", task_id=task.task_id,
+                       worker_uri=task.worker_uri,
+                       message=f"worker {task.worker_uri} unreachable "
+                               f"({n} consecutive status probes failed)")
+
+    def close(self) -> None:
+        self._stop.set()
+
+
+class _QueryExecution:
+    """One query's distributed run: scheduling, the failure watcher, and
+    the classify-restart loop (the coordinator analog of presto-spark's
+    per-task retry over durable shuffle — here over retained buffers)."""
+
+    def __init__(self, runner: "HttpQueryRunner", root: _Stage, qid: str):
+        self.runner = runner
+        self.root = root
+        self.qid = qid
+        self.stages = root.postorder()
+        cfg = runner.config
+        self.max_attempts = int(runner.session.get(
+            "remote_task_retry_attempts", cfg.remote_task_retry_attempts))
+        self.max_error_s = parse_duration(runner.session.get(
+            "exchange_max_error_duration",
+            cfg.exchange_max_error_duration_s))
+        self.session = dict(runner.session)
+        if self.max_attempts > 0:
+            # workers must retain acknowledged buffer pages so a restarted
+            # consumer can replay its inputs from token 0
+            self.session.setdefault("remote_task_retry_attempts",
+                                    str(self.max_attempts))
+        self.codec = str(self.session.get(
+            "exchange_compression_codec",
+            cfg.exchange_compression_codec)).upper()
+        self.id_attempt: Dict[str, int] = {}    # lineage -> id generation
+        self.budget_used: Dict[str, int] = {}   # lineage -> retries charged
+        self.suspects: Set[str] = set()         # workers seen failing
+        self.retries = 0
+        self.all_tasks: List[RemoteTask] = []   # every attempt, for cleanup
+        self.lineage_index: Dict[str, Tuple[_Stage, int]] = {}
+        self._watcher: Optional[_StatusWatcher] = None
+
+    # -- identity ---------------------------------------------------------
+    def lineage(self, stage: _Stage, ti: int) -> str:
+        return f"{self.qid}.{stage.stage_path.replace('.', '_')}.{ti}"
+
+    def task_id_for(self, lineage: str) -> str:
+        """Retry attempts keep the base lineage and add `.rN` (same task,
+        attempt N — the worker counts these in tasks_retried)."""
+        attempt = self.id_attempt.get(lineage, 0)
+        return lineage if attempt == 0 else f"{lineage}.r{attempt}"
+
+    def current_tasks(self) -> List[RemoteTask]:
+        return [t for s in self.stages for t in s.tasks if t is not None]
+
+    # -- scheduling -------------------------------------------------------
+    def _prepare(self, stage: _Stage, consumer_tasks: int) -> None:
+        """Fix a stage's buffer spec, split assignment, and remote-source
+        set once; restarts reuse them verbatim."""
+        frag = stage.fragment
+        scheme = frag.output_partitioning_scheme
+        if scheme.handle == P.FIXED_HASH_DISTRIBUTION:
+            stage.spec = OutputBuffersSpec(
+                "PARTITIONED", consumer_tasks,
+                [a.name for a in scheme.arguments])
+        elif scheme.handle == P.FIXED_BROADCAST_DISTRIBUTION:
+            stage.spec = OutputBuffersSpec("BROADCAST", consumer_tasks)
+        else:  # SINGLE: one buffer, one consumer
+            stage.spec = OutputBuffersSpec("PARTITIONED", 1)
+        # split assignment (reference SourcePartitionedScheduler)
+        for node in P.walk_plan(frag.root):
+            if isinstance(node, P.TableScanNode):
+                th = node.table
+                sf = dict(th.extra).get("scaleFactor", 0.01)
+                n_splits = max(stage.n_tasks,
+                               self.runner.config.splits_per_scan)
+                stage.scan_splits[node.id] = catalog.make_splits(
+                    th.table_name, sf, n_splits, th.connector_id)
+        stage.remote_nodes = [n for n in P.walk_plan(frag.root)
+                              if isinstance(n, P.RemoteSourceNode)]
+        for ti in range(stage.n_tasks):
+            self.lineage_index[self.lineage(stage, ti)] = (stage, ti)
+
+    def _make_sources(self, stage: _Stage, ti: int) -> List[TaskSource]:
+        sources = []
+        for node_id, splits in stage.scan_splits.items():
+            own = [s.to_dict() for s in splits[ti::stage.n_tasks]]
+            sources.append(TaskSource(node_id, own))
+        child_by_fid = {c.fragment.fragment_id: c for c in stage.children}
+        for rnode in stage.remote_nodes:
+            locations = []
+            for fid in rnode.source_fragment_ids:
+                child = child_by_fid[fid]
+                child_scheme = \
+                    child.fragment.output_partitioning_scheme.handle
+                buffer_id = 0 if child_scheme == P.SINGLE_DISTRIBUTION \
+                    else ti
+                for ct in child.tasks:
+                    locations.append(
+                        {"remote": True,
+                         "location": ct.result_location(buffer_id)})
+            sources.append(TaskSource(rnode.id, locations))
+        return sources
+
+    def _place_task(self, stage: _Stage, ti: int) -> RemoteTask:
+        """Create one task attempt on a live, non-suspect worker.  A 503
+        (draining) or a transport error reroutes to the next candidate
+        (reference SqlStageExecution retrying placement on node refusal)."""
+        lineage = self.lineage(stage, ti)
+        task_id = self.task_id_for(lineage)
+        req = TaskUpdateRequest.make(task_id, ti, stage.fragment,
+                                     self._make_sources(stage, ti),
+                                     stage.spec, session=self.session)
+        live = self.runner._live_uris()
+        preferred = [u for u in live if u not in self.suspects] or live
+        worker = preferred[next(self.runner._rr) % len(preferred)]
+        candidates = [worker] + [u for u in preferred if u != worker] \
+            + [u for u in live if u not in preferred]
+        last_err: Optional[Exception] = None
+        for cand in candidates:
+            task = RemoteTask(cand, task_id)
+            try:
+                task.update(req)
+            except urllib.error.HTTPError as e:
+                if e.code != 503:
+                    raise
+                last_err = e
+            except (urllib.error.URLError, TimeoutError, OSError) as e:
+                # the worker died between discovery and placement
+                self.suspects.add(cand)
+                last_err = e
+            else:
+                stage.tasks[ti] = task
+                self.all_tasks.append(task)
+                return task
+        raise WorkerLostError(
+            worker, f"no worker accepted task {task_id}: {last_err}")
+
+    def schedule_all(self) -> None:
+        for stage in self.stages:
+            consumer = stage.parent.n_tasks if stage.parent else 1
+            self._prepare(stage, consumer)
+        for stage in self.stages:  # postorder: producers before consumers
+            for ti in range(stage.n_tasks):
+                self._place_task(stage, ti)
+
+    # -- the retry loop ---------------------------------------------------
+    def run(self) -> List:
+        self.schedule_all()
+        while True:
+            self._watcher = _StatusWatcher(self)
+            try:
+                pages: List = []
+                for task in self.root.tasks:
+                    for page in pull_pages(
+                            task.result_location(0), codec=self.codec,
+                            max_error_duration_s=self.max_error_s,
+                            should_abort=self._raise_pending_failures):
+                        pages.append(page)
+                self._raise_pending_failures()
+                return pages
+            except (ExchangeLostError, RemoteTaskError,
+                    _FailureSignal) as e:
+                failed = self._classify_failure(e)
+                self._restart(failed, cause=e)
+            finally:
+                self._watcher.close()
+
+    def _raise_pending_failures(self) -> None:
+        """should_abort hook for the root pull: unwind as soon as the
+        watcher has seen ANY task fail, instead of discovering it after
+        all pages are drained."""
+        events = self._watcher.events() if self._watcher else []
+        if events:
+            raise _FailureSignal(events)
+
+    def _lineage_of_task(self, task_id: str) -> Optional[str]:
+        base = _RETRY_SUFFIX.sub("", task_id)
+        return base if base in self.lineage_index else None
+
+    def _culprit_lineage(self, text: str, fallback_task_id: str
+                         ) -> Optional[str]:
+        """Failure text may embed producer buffer locations (a consumer
+        failing on its exchange pull quotes the source).  The DEEPEST
+        mentioned task is the true culprit; its restart set covers every
+        ancestor including the quoting consumer."""
+        for tid in reversed(_RESULT_LOCATIONS.findall(text or "")):
+            lin = self._lineage_of_task(tid)
+            if lin is not None:
+                return lin
+        return self._lineage_of_task(fallback_task_id)
+
+    def _classify_failure(self, exc: Exception) -> Set[str]:
+        """Failure -> set of lineages to charge and restart.  Raises a
+        typed query error for anything non-retryable."""
+        failed: Set[str] = set()
+        if isinstance(exc, RemoteTaskError):
+            if not is_retryable_type(exc.error_type):
+                # only USER_ERROR is non-retryable: surface the typed
+                # user error so upper layers also skip query-level retry
+                raise PrestoUserError(
+                    f"query failed [{exc.error_type}]: {exc}") from exc
+            self._add_culprit(failed, str(exc), exc.location)
+        elif isinstance(exc, ExchangeLostError):
+            worker = exc.location.split("/v1/task/", 1)[0]
+            self.suspects.add(worker)
+            self._add_culprit(failed, str(exc), exc.location)
+        else:
+            assert isinstance(exc, _FailureSignal)
+            for ev in exc.events:
+                kind = ev["kind"]
+                if kind == "failed":
+                    et = ev.get("error_type") or parse_error_type(
+                        ev.get("message", ""))
+                    if not is_retryable_type(et):
+                        raise PrestoUserError(
+                            f"task {ev['task_id']} failed [{et}]: "
+                            f"{ev['message']}") from exc
+                    self._add_culprit(failed, ev.get("message", ""),
+                                      ev["task_id"])
+                else:  # task_lost / worker_lost
+                    self.suspects.add(ev["worker_uri"])
+                    lin = self._lineage_of_task(ev["task_id"])
+                    if lin is not None:
+                        failed.add(lin)
+        if not failed:
+            raise PrestoQueryError(
+                f"query failed (unattributable): {exc}") from exc
+        return failed
+
+    def _add_culprit(self, failed: Set[str], text: str,
+                     fallback: str) -> None:
+        # fallback may be a buffer location or a bare task id
+        tid = fallback.rsplit("/v1/task/", 1)[-1].split("/", 1)[0]
+        lin = self._culprit_lineage(text, tid)
+        if lin is not None:
+            failed.add(lin)
+
+    def _restart(self, lineages: Set[str], cause: Exception) -> None:
+        """Restart every failed lineage plus ALL tasks of every ancestor
+        stage (consumer locations are baked into TaskSources, so a new
+        producer attempt invalidates its consumers; the root's restart
+        resets the collected output — exactly-once).  Only the originally
+        failed lineages are charged against the attempt budget."""
+        if self.max_attempts <= 0:
+            raise PrestoQueryError(
+                f"query failed (task retry disabled): {cause}") from cause
+        for lin in sorted(lineages):
+            used = self.budget_used.get(lin, 0) + 1
+            if used > self.max_attempts:
+                raise PrestoQueryError(
+                    f"task {lin} failed after {self.max_attempts} retry "
+                    f"attempt(s): {cause}") from cause
+            self.budget_used[lin] = used
+        self.retries += len(lineages)
+        restart: Dict[int, Set[int]] = {}  # id(stage) -> task indices
+        stage_by_id = {id(s): s for s in self.stages}
+        for lin in lineages:
+            stage, ti = self.lineage_index[lin]
+            restart.setdefault(id(stage), set()).add(ti)
+            anc = stage.parent
+            while anc is not None:
+                restart[id(anc)] = set(range(anc.n_tasks))
+                anc = anc.parent
+        # cancel superseded attempts first so workers stop computing and
+        # release buffer memory (retained buffers only die on teardown)
+        for sid, indices in restart.items():
+            stage = stage_by_id[sid]
+            for ti in indices:
+                old = stage.tasks[ti]
+                if old is not None:
+                    threading.Thread(target=old.cancel, daemon=True).start()
+                stage.tasks[ti] = None
+                self.id_attempt[self.lineage(stage, ti)] = \
+                    self.id_attempt.get(self.lineage(stage, ti), 0) + 1
+        for stage in self.stages:  # postorder: new producers first
+            if id(stage) not in restart:
+                continue
+            for ti in sorted(restart[id(stage)]):
+                self._place_task(stage, ti)
+
+    def close(self) -> None:
+        if self._watcher is not None:
+            self._watcher.close()
+        for t in self.all_tasks:
+            t.cancel()
 
 
 class HttpQueryRunner(LocalQueryRunner):
@@ -165,6 +590,10 @@ class HttpQueryRunner(LocalQueryRunner):
         self.broadcast_threshold = broadcast_threshold
         self.session = session or {}
         self._rr = itertools.count()
+        # lifetime counters across queries (surfaced via /v1/metrics when
+        # this runner backs a coordinator's statement endpoint)
+        self.tasks_retried = 0
+        self.queries_failed = 0
 
     def _live_uris(self) -> List[str]:
         """Schedulable workers (reference NodeScheduler.createNodeSelector
@@ -185,120 +614,30 @@ class HttpQueryRunner(LocalQueryRunner):
         cfg = FragmenterConfig(broadcast_threshold=self.broadcast_threshold)
         return plan_distributed(output, cfg), names, types
 
-    def _build_stages(self, subplan: P.SubPlan) -> _Stage:
-        children = [self._build_stages(c) for c in subplan.children]
+    def _build_stages(self, subplan: P.SubPlan,
+                      stage_path: str = "0") -> _Stage:
+        children = [self._build_stages(c, f"{stage_path}.{i}")
+                    for i, c in enumerate(subplan.children)]
         frag = subplan.fragment
         if frag.partitioning in (P.SOURCE_DISTRIBUTION,
                                  P.FIXED_HASH_DISTRIBUTION):
             n_tasks = self.n_tasks
         else:
             n_tasks = 1
-        return _Stage(frag, children, n_tasks)
+        return _Stage(frag, children, n_tasks, stage_path)
 
     # -- execution --------------------------------------------------------
     def execute(self, sql: str) -> QueryResult:
         subplan, names, types = self.plan_subplan(sql)
         root = self._build_stages(subplan)
         qid = f"q{next(_query_counter)}_{int(time.time() * 1000) % 100000}"
-        all_tasks: List[RemoteTask] = []
+        execution = _QueryExecution(self, root, qid)
         try:
-            self._schedule(root, qid, consumer_tasks=1, all_tasks=all_tasks)
-            # decode with the session codec, else the coordinator's own
-            # configured codec — workers compress every output buffer,
-            # including the root stage this pull reads, with the same
-            # cluster config (reference: one PagesSerdeFactory per cluster)
-            codec = str(self.session.get(
-                "exchange_compression_codec",
-                self.config.exchange_compression_codec)).upper()
-            pages = []
-            for task in root.tasks:
-                pages.extend(pull_pages(task.result_location(0),
-                                        codec=codec))
-            self._check_failures(all_tasks)
+            pages = execution.run()
             return pages_to_result(iter(pages), names, types)
+        except Exception:
+            self.queries_failed += 1
+            raise
         finally:
-            for t in all_tasks:
-                t.cancel()
-
-    def _schedule(self, stage: _Stage, qid: str, consumer_tasks: int,
-                  all_tasks: List[RemoteTask], stage_path: str = "0") -> None:
-        # children first: their task locations feed this stage's sources
-        for i, child in enumerate(stage.children):
-            self._schedule(child, qid, stage.n_tasks, all_tasks,
-                           f"{stage_path}.{i}")
-
-        frag = stage.fragment
-        scheme = frag.output_partitioning_scheme
-        if scheme.handle == P.FIXED_HASH_DISTRIBUTION:
-            spec = OutputBuffersSpec(
-                "PARTITIONED", consumer_tasks,
-                [a.name for a in scheme.arguments])
-        elif scheme.handle == P.FIXED_BROADCAST_DISTRIBUTION:
-            spec = OutputBuffersSpec("BROADCAST", consumer_tasks)
-        else:  # SINGLE: one buffer, one consumer
-            spec = OutputBuffersSpec("PARTITIONED", 1)
-
-        # split assignment (reference SourcePartitionedScheduler)
-        scan_splits: Dict[str, List[catalog.TableSplit]] = {}
-        for node in P.walk_plan(frag.root):
-            if isinstance(node, P.TableScanNode):
-                th = node.table
-                sf = dict(th.extra).get("scaleFactor", 0.01)
-                n_splits = max(stage.n_tasks, self.config.splits_per_scan)
-                scan_splits[node.id] = catalog.make_splits(
-                    th.table_name, sf, n_splits, th.connector_id)
-        remote_nodes = [n for n in P.walk_plan(frag.root)
-                        if isinstance(n, P.RemoteSourceNode)]
-        child_by_fid = {c.fragment.fragment_id: c for c in stage.children}
-
-        live = self._live_uris()
-        for ti in range(stage.n_tasks):
-            worker = live[next(self._rr) % len(live)]
-            task_id = f"{qid}.{stage_path.replace('.', '_')}.{ti}"
-            sources = []
-            for node_id, splits in scan_splits.items():
-                own = [s.to_dict() for s in splits[ti::stage.n_tasks]]
-                sources.append(TaskSource(node_id, own))
-            for rnode in remote_nodes:
-                locations = []
-                for fid in rnode.source_fragment_ids:
-                    child = child_by_fid[fid]
-                    child_scheme = \
-                        child.fragment.output_partitioning_scheme.handle
-                    buffer_id = 0 if child_scheme == P.SINGLE_DISTRIBUTION \
-                        else ti
-                    for ct in child.tasks:
-                        locations.append(
-                            {"remote": True,
-                             "location": ct.result_location(buffer_id)})
-                sources.append(TaskSource(rnode.id, locations))
-            req = TaskUpdateRequest.make(task_id, ti, frag, sources,
-                                         spec, session=self.session)
-            # a draining worker answers 503 (server.py do_task_update):
-            # reroute the task to the next live worker (reference
-            # SqlStageExecution retrying placement on node refusal)
-            candidates = [worker] + [u for u in live if u != worker]
-            task = None
-            last_err = None
-            for cand in candidates:
-                task = RemoteTask(cand, task_id)
-                try:
-                    task.update(req)
-                    break
-                except urllib.error.HTTPError as e:
-                    if e.code != 503:
-                        raise
-                    last_err = e
-                    task = None
-            if task is None:
-                raise RuntimeError(
-                    f"no worker accepted task {task_id}: {last_err}")
-            stage.tasks.append(task)
-            all_tasks.append(task)
-
-    def _check_failures(self, tasks: List[RemoteTask]) -> None:
-        for t in tasks:
-            st = t.status(max_wait_ms=0)
-            if st.state == FAILED:
-                raise RuntimeError(
-                    f"task {t.task_id} failed: {st.failures[:1]}")
+            self.tasks_retried += execution.retries
+            execution.close()

@@ -5,20 +5,32 @@ The analog of the reference's ExchangeClient/PageBufferClient
 PrestoExchangeSource (presto_cpp/main/PrestoExchangeSource.cpp:171): loop
 GET {location}/{token} -> acknowledge -> repeat until the complete flag,
 then DELETE the buffer.
+
+Transient transport failures RESUME from the last delivered token under an
+exponential-backoff-with-jitter loop bounded by a real error budget
+(reference exchange.max-error-duration / PageBufferClient's backoff).
+When the budget expires — or the producer task vanishes outright (404) —
+a typed ExchangeLostError carries the producer location upward so the
+coordinator can map it back to the producing task and retry that task
+instead of failing the query.
 """
 from __future__ import annotations
 
+import random
 import time
 import urllib.error
 import urllib.request
-from typing import Iterator, List
+from typing import Callable, Iterator, List, Optional
 
+from ..common.errors import ExchangeLostError, RemoteTaskError
 from ..common.page import Page
 from ..common.serde import DEFAULT_CODEC, deserialize_pages
 
 DEFAULT_MAX_WAIT_S = 1.0
 REQUEST_TIMEOUT_S = 30.0
-RETRY_LIMIT = 5
+DEFAULT_MAX_ERROR_DURATION_S = 60.0
+_BACKOFF_BASE_S = 0.05
+_BACKOFF_CAP_S = 2.0
 
 
 def _request(url: str, method: str = "GET",
@@ -29,14 +41,24 @@ def _request(url: str, method: str = "GET",
     return urlopen_internal(req, timeout=timeout)
 
 
-def pull_pages(location: str, codec: str = DEFAULT_CODEC) -> Iterator[Page]:
+def pull_pages(location: str, codec: str = DEFAULT_CODEC,
+               max_error_duration_s: float = DEFAULT_MAX_ERROR_DURATION_S,
+               should_abort: Optional[Callable[[], None]] = None
+               ) -> Iterator[Page]:
     """Stream every page from one upstream buffer location
     (http://host:port/v1/task/{taskId}/results/{bufferId}).  `codec`
     decodes COMPRESSED pages; it is cluster config shared with the
-    producer, like the reference exchange.compression-codec."""
+    producer, like the reference exchange.compression-codec.
+
+    `should_abort` is polled once per pull round (it raises to abort) —
+    the coordinator's early-failure hook, so a root-stage pull stops as
+    soon as any task reports FAILED instead of draining to completion."""
     token = 0
-    retries = 0
+    error_since: Optional[float] = None
+    attempt = 0
     while True:
+        if should_abort is not None:
+            should_abort()
         url = f"{location}/{token}?maxWaitMs={int(DEFAULT_MAX_WAIT_S * 1000)}"
         try:
             with _request(url) as resp:
@@ -48,18 +70,30 @@ def pull_pages(location: str, codec: str = DEFAULT_CODEC) -> Iterator[Page]:
                     resp.headers.get("X-Presto-Page-End-Sequence-Id")
                     or resp.headers.get("X-Presto-Page-Next-Token", token))
                 body = resp.read()
-            retries = 0
+            error_since, attempt = None, 0
         except urllib.error.HTTPError as e:
-            # 500 carries a producer-side failure: propagate, don't retry
             detail = e.read().decode(errors="replace")
-            raise RuntimeError(
-                f"exchange source {location} failed: {detail}") from e
-        except (urllib.error.URLError, TimeoutError) as e:
-            retries += 1
-            if retries > RETRY_LIMIT:
-                raise RuntimeError(
-                    f"exchange source {location} unreachable") from e
-            time.sleep(min(2.0, 0.1 * (2 ** retries)))
+            if e.code in (404, 410):
+                # the producer task is GONE (worker restarted and lost its
+                # task registry): not transient — the task must be rebuilt
+                raise ExchangeLostError(
+                    location, token,
+                    f"exchange source {location} vanished ({e.code}) at "
+                    f"token {token}: producer task lost") from e
+            if e.code == 503:
+                # draining/overloaded producer: transient, budgeted retry
+                error_since, attempt = _backoff(
+                    location, token, error_since, attempt,
+                    max_error_duration_s, e)
+                continue
+            # 500 carries a producer-side failure: propagate typed (the
+            # [ERROR_TYPE] tag in the detail decides retryability upstream)
+            raise RemoteTaskError(location, detail) from e
+        except (urllib.error.URLError, TimeoutError, ConnectionError,
+                OSError) as e:
+            error_since, attempt = _backoff(
+                location, token, error_since, attempt,
+                max_error_duration_s, e)
             continue
         if body:
             for page in deserialize_pages(body, codec=codec):
@@ -67,21 +101,45 @@ def pull_pages(location: str, codec: str = DEFAULT_CODEC) -> Iterator[Page]:
         if next_token != token:
             try:
                 _request(f"{location}/{next_token}/acknowledge").close()
-            except (urllib.error.URLError, TimeoutError):
+            except (urllib.error.URLError, TimeoutError, OSError):
                 pass  # acknowledge is an optimization; the pull re-fetches
             token = next_token
         if complete:
             try:
                 _request(location, method="DELETE").close()
-            except (urllib.error.URLError, TimeoutError):
+            except (urllib.error.URLError, TimeoutError, OSError):
                 pass
             return
 
 
-def remote_page_reader(locations: List[str], codec: str = DEFAULT_CODEC):
+def _backoff(location: str, token: int, error_since: Optional[float],
+             attempt: int, max_error_duration_s: float,
+             cause: Exception) -> tuple:
+    """One budgeted retry step: raise ExchangeLostError once errors have
+    persisted past the budget, else sleep exp-backoff + jitter (reference
+    PageBufferClient backoff under exchange.max-error-duration)."""
+    now = time.monotonic()
+    if error_since is None:
+        error_since = now
+    if now - error_since >= max_error_duration_s:
+        raise ExchangeLostError(
+            location, token,
+            f"exchange source {location} unreachable for "
+            f"{now - error_since:.1f}s (budget {max_error_duration_s}s) "
+            f"at token {token}: {cause}") from cause
+    delay = min(_BACKOFF_CAP_S, _BACKOFF_BASE_S * (2 ** attempt))
+    # full jitter keeps a fleet of consumers from re-probing in lockstep
+    time.sleep(delay * (0.5 + random.random() * 0.5))
+    return error_since, attempt + 1
+
+
+def remote_page_reader(locations: List[str], codec: str = DEFAULT_CODEC,
+                       max_error_duration_s: float =
+                       DEFAULT_MAX_ERROR_DURATION_S):
     """A TaskContext.remote_pages callable: pages from every upstream task
     feeding one RemoteSourceNode."""
     def read() -> Iterator[Page]:
         for loc in locations:
-            yield from pull_pages(loc, codec=codec)
+            yield from pull_pages(loc, codec=codec,
+                                  max_error_duration_s=max_error_duration_s)
     return read

@@ -16,7 +16,7 @@ import os
 from typing import Dict, Optional, Tuple
 
 from ..exec.pipeline import ExecutionConfig, tuned_config
-from .protocol import parse_data_size
+from .protocol import parse_data_size, parse_duration
 
 
 def load_properties(path: str) -> Dict[str, str]:
@@ -94,6 +94,19 @@ def execution_config_from_properties(props: Dict[str, str],
     if "task.grouped-lifespan-sharding" in props:
         kw["grouped_lifespan_sharding"] = _bool(
             props["task.grouped-lifespan-sharding"])
+    if "exchange.max-error-duration" in props:
+        kw["exchange_max_error_duration_s"] = parse_duration(
+            props["exchange.max-error-duration"])
+    if "task.remote-task-retry-attempts" in props:
+        kw["remote_task_retry_attempts"] = int(
+            props["task.remote-task-retry-attempts"])
+    if "task.fault-injection-probability" in props:
+        p = float(props["task.fault-injection-probability"])
+        if not 0.0 <= p <= 1.0:
+            raise ValueError(
+                f"task.fault-injection-probability must be in [0, 1], "
+                f"got {p}")
+        kw["fault_injection_probability"] = p
     return dataclasses.replace(cfg, **kw) if kw else cfg
 
 
@@ -136,6 +149,8 @@ class SystemConfig:
         ("task.grouped-lifespans", int, 0),
         ("task.grouped-prefetch-depth", int, 1),
         ("task.grouped-lifespan-sharding", bool, True),
+        ("task.remote-task-retry-attempts", int, 2),
+        ("task.fault-injection-probability", float, 0.0),
         ("shutdown-onset-sec", int, 10),
         ("system-memory-gb", int, 16),               # HBM per chip
         ("system-mem-limit-gb", int, 16),

@@ -163,15 +163,24 @@ class TaskStatus:
     failures: List[str] = field(default_factory=list)
     memory_reservation: int = 0
     completed_drivers: int = 0
+    # reference ErrorType.java classification of the FIRST failure
+    # (ExecutionFailureInfo.errorCode.type): the coordinator's retry
+    # decision — USER_ERROR never retries, infra errors may
+    error_type: str = ""
 
     def to_dict(self):
         # reference-shaped TaskStatus fields (presto_protocol_core.h:2358:
         # failures are ExecutionFailureInfo-shaped dicts) merged with the
         # compact extra fields in-repo clients read
+        from ..common.errors import is_retryable_type
         from .presto_protocol import TaskStatus as RefStatus
+        et = self.error_type or "INTERNAL_ERROR"
         ref = RefStatus(
             version=self.version, state=self.state, self_uri=self.self_uri,
-            failures=[{"message": f, "type": "TASK_FAILURE"}
+            failures=[{"message": f, "type": "TASK_FAILURE",
+                       "errorCode": {"name": "GENERIC_" + et, "code": 0,
+                                     "type": et,
+                                     "retriable": is_retryable_type(et)}}
                       for f in self.failures],
             memoryReservationInBytes=self.memory_reservation).to_json()
         ref.update({"taskId": self.task_id,
@@ -182,10 +191,16 @@ class TaskStatus:
     def from_dict(d):
         failures = [f["message"] if isinstance(f, dict) else f
                     for f in d.get("failures", [])]
+        error_type = ""
+        for f in d.get("failures", []):
+            if isinstance(f, dict):
+                error_type = (f.get("errorCode") or {}).get("type", "")
+                break
         return TaskStatus(d["taskId"], d["state"], d["version"], d["self"],
                           failures,
                           d.get("memoryReservationInBytes", 0),
-                          d.get("completedDrivers", 0))
+                          d.get("completedDrivers", 0),
+                          error_type=error_type)
 
 
 def make_announcement(node_id: str, uri: str, environment: str = "test",
@@ -224,6 +239,23 @@ def parse_data_size(s) -> int:
         if s.endswith(unit):
             return int(float(s[:-len(unit)]) * mult)
     return int(s)
+
+
+_DURATION_UNITS = {"ns": 1e-9, "us": 1e-6, "ms": 1e-3, "s": 1.0,
+                   "m": 60.0, "h": 3600.0, "d": 86400.0}
+
+
+def parse_duration(s) -> float:
+    """'1m' / '10s' / '500ms' / plain number -> seconds (reference
+    io.airlift.units.Duration parsing)."""
+    if isinstance(s, (int, float)):
+        return float(s)
+    s = str(s).strip()
+    for unit, mult in sorted(_DURATION_UNITS.items(),
+                             key=lambda x: -len(x[0])):
+        if s.endswith(unit):
+            return float(s[:-len(unit)]) * mult
+    return float(s)
 
 
 def apply_session_properties(config, session: Dict[str, str]):
@@ -268,4 +300,19 @@ def apply_session_properties(config, session: Dict[str, str]):
     if "grouped_lifespan_sharding" in session:
         kw["grouped_lifespan_sharding"] = (
             str(session["grouped_lifespan_sharding"]).lower() == "true")
+    # fault-tolerance knobs (coordinator propagates its retry mode so
+    # workers enable replayable output buffers; reference
+    # exchange.max-error-duration / presto-spark retry budget)
+    if "remote_task_retry_attempts" in session:
+        kw["remote_task_retry_attempts"] = int(
+            session["remote_task_retry_attempts"])
+    if "exchange_max_error_duration" in session:
+        kw["exchange_max_error_duration_s"] = parse_duration(
+            session["exchange_max_error_duration"])
+    if "fault_injection_probability" in session:
+        p = float(session["fault_injection_probability"])
+        if not 0.0 <= p <= 1.0:
+            raise ValueError(
+                f"fault_injection_probability must be in [0, 1], got {p}")
+        kw["fault_injection_probability"] = p
     return dataclasses.replace(config, **kw) if kw else config

@@ -220,6 +220,7 @@ class _Handler(BaseHTTPRequestHandler):
         coordinator's memory manager and UI poll)."""
         s = self.server_ref
         c = s.task_manager.counts()
+        det = s.failure_detector
         self._send(200, {
             "nodeId": s.node_id,
             "nodeVersion": {"version": "presto-tpu-0.1"},
@@ -229,7 +230,10 @@ class _Handler(BaseHTTPRequestHandler):
             "uptime": f"{time.time() - s.started_at:.0f}s",
             "tasks": c["by_state"],
             "totalTasks": c["created"],
+            "tasksFailed": c["failed"],
+            "tasksRetried": c["retried"],
             "heapUsed": c["memory_peak"],   # HBM peak, heap-shaped field
+            **({"failureDetector": det.snapshot()} if det else {}),
         })
 
     def do_metrics(self, groups, query):
@@ -242,6 +246,10 @@ class _Handler(BaseHTTPRequestHandler):
             f"presto_tpu_uptime_seconds {time.time() - s.started_at:.1f}",
             "# TYPE presto_tpu_tasks_created_total counter",
             f"presto_tpu_tasks_created_total {c['created']}",
+            "# TYPE presto_tpu_tasks_failed_total counter",
+            f"presto_tpu_tasks_failed_total {c['failed']}",
+            "# TYPE presto_tpu_task_retries_total counter",
+            f"presto_tpu_task_retries_total {c['retried']}",
             "# TYPE presto_tpu_task_memory_peak_bytes gauge",
             f"presto_tpu_task_memory_peak_bytes {c['memory_peak']}",
             "# TYPE presto_tpu_tasks gauge",
@@ -249,6 +257,18 @@ class _Handler(BaseHTTPRequestHandler):
         for state, n in sorted(c["by_state"].items()):
             lines.append(
                 'presto_tpu_tasks{state="%s"} %d' % (state.lower(), n))
+        det = s.failure_detector
+        if det is not None:
+            lines.append("# TYPE presto_tpu_worker_probe_failures gauge")
+            lines.append("# TYPE presto_tpu_worker_alive gauge")
+            for uri, w in sorted(det.snapshot().items()):
+                lines.append(
+                    'presto_tpu_worker_probe_failures{worker="%s"} %d'
+                    % (uri, w["streak"]))
+                lines.append(
+                    'presto_tpu_worker_alive{worker="%s",draining="%s"} %d'
+                    % (uri, str(w["draining"]).lower(),
+                       1 if w["alive"] else 0))
         self._send(200, None, ("\n".join(lines) + "\n").encode(),
                    headers={"Content-Type":
                             "text/plain; version=0.0.4; charset=utf-8"})
@@ -558,6 +578,12 @@ class WorkerServer:
             from .auth import set_internal_ca
             set_internal_ca(internal_ca_path)
         self.task_manager = TaskManager(self.uri, config, events=events)
+        # terminal-task eviction must not depend on new tasks arriving
+        # (reference PeriodicTaskManager)
+        self.task_manager.start_reaper()
+        # coordinator role: liveness probing over discovered workers,
+        # attached lazily when the first distributed statement runs
+        self.failure_detector = None
 
         # coordinator role: client statement intake (worker/statement.py)
         self.dispatch = None
@@ -640,17 +666,23 @@ class WorkerServer:
             runner = self._runner_cache.get(key)
             if runner is None:
                 if uris:
-                    from .coordinator import HttpQueryRunner
+                    from .coordinator import (HeartbeatFailureDetector,
+                                              HttpQueryRunner)
+                    det = HeartbeatFailureDetector(list(uris))
                     runner = HttpQueryRunner(list(uris), schema=q.schema,
                                              config=cfg, session=q.session,
+                                             failure_detector=det,
                                              catalog=q.catalog)
+                    self.failure_detector = det
                 else:
                     from ..exec.runner import LocalQueryRunner
                     runner = LocalQueryRunner(q.schema, config=cfg,
                                               catalog=q.catalog)
                 self._runner_cache[key] = runner
                 while len(self._runner_cache) > 16:
-                    self._runner_cache.pop(next(iter(self._runner_cache)))
+                    old = self._runner_cache.pop(
+                        next(iter(self._runner_cache)))
+                    self._close_runner(old)
         if not uris and hasattr(runner, "execute_streaming"):
             # single-node SELECTs stream chunk-by-chunk: the coordinator
             # never materializes the full result (reference Query.java
@@ -667,8 +699,16 @@ class WorkerServer:
         if q.sql.lstrip()[:6].lower() in ("create", "insert") \
                 or q.sql.lstrip()[:4].lower() == "drop":
             with self._runner_lock:
+                for r in self._runner_cache.values():
+                    self._close_runner(r)
                 self._runner_cache.clear()
         return result
+
+    @staticmethod
+    def _close_runner(runner) -> None:
+        det = getattr(runner, "failure_detector", None)
+        if det is not None:
+            det.close()
 
     def _unregister_system(self) -> None:
         if getattr(self, "_registered_system", False):
@@ -717,6 +757,10 @@ class WorkerServer:
         try:
             clear_process_auth(self.auth)
             self._unregister_system()
+            with self._runner_lock:
+                for r in self._runner_cache.values():
+                    self._close_runner(r)
+                self._runner_cache.clear()
             self.task_manager.cancel_all()
         finally:
             # the listener MUST die even if task teardown raised — a

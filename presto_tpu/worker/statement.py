@@ -467,18 +467,26 @@ class DispatchManager:
 
 def _is_retryable(e: Exception) -> bool:
     """Worker/connection failures are retryable; planning, semantic, and
-    storage errors are the user's (reference ErrorClassifier semantics).
-    NOT every OSError qualifies: urllib HTTPError (4xx from a worker) and
-    FileNotFoundError are permanent."""
-    import urllib.error
-    if isinstance(e, (urllib.error.HTTPError, FileNotFoundError)):
-        return False
-    if isinstance(e, (ConnectionError, TimeoutError)):
-        return True
+    storage errors are the user's.  Delegates to the shared error
+    classifier (common/errors.py, the ErrorClassifier.java analog) so the
+    statement layer, the HTTP coordinator, and the batch scheduler agree
+    on one taxonomy.  Planning errors raised coordinator-side (before any
+    task ran) arrive untyped; the classifier's USER_ERROR shape check
+    (ValueError/TypeError/KeyError/...) keeps them fail-fast, and query
+    text that only references a dead cluster stays retryable."""
+    from ..common.errors import INTERNAL_ERROR, classify_exception
+    et = classify_exception(e)
+    if et != INTERNAL_ERROR:
+        from ..common.errors import is_retryable_type
+        return is_retryable_type(et)
+    # untagged INTERNAL_ERROR: an engine exception whose retryability the
+    # type system cannot prove — retry only message shapes known to be
+    # cluster-transient (the pre-classifier behavior)
     msg = str(e).lower()
     return any(s in msg for s in ("connection refused", "no live workers",
                                   "node is shutting down", "timed out",
-                                  "remote task failed"))
+                                  "remote task failed",
+                                  "retry attempt", "unreachable"))
 
 
 def _json_value(v):

@@ -13,8 +13,10 @@ from __future__ import annotations
 
 import threading
 import traceback
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional
 
+from ..common.errors import (INTERNAL_ERROR, InjectedTaskFailure,
+                             classify_exception)
 from ..common.serde import serialize_page
 from ..connectors import catalog, tpch
 from ..exec.pipeline import (ExecutionConfig, PlanCompiler, TaskContext,
@@ -31,14 +33,16 @@ class TpuTask:
     """One task: state machine + executor thread + output buffers."""
 
     def __init__(self, task_id: str, self_uri: str, config: ExecutionConfig,
-                 events=None):
+                 events=None, manager=None):
         self.task_id = task_id
         self.self_uri = self_uri
         self.config = config
         self.events = events
+        self.manager = manager
         self.state = PLANNED
         self.version = 0
         self.failures: List[str] = []
+        self.error_type = ""              # reference ErrorType of failure[0]
         self.buffers: Optional[OutputBufferManager] = None
         self.done_at: Optional[float] = None
         self.memory_peak = 0
@@ -84,7 +88,8 @@ class TpuTask:
         }
 
     # -- state ------------------------------------------------------------
-    def _set_state(self, state: str, failure: Optional[str] = None) -> None:
+    def _set_state(self, state: str, failure: Optional[str] = None,
+                   error_type: str = "") -> None:
         import time
         with self._cond:
             if self.state in DONE_STATES:
@@ -93,9 +98,13 @@ class TpuTask:
             self.version += 1
             if failure:
                 self.failures.append(failure)
+                if not self.error_type:
+                    self.error_type = error_type or INTERNAL_ERROR
             if state in DONE_STATES:
                 self.done_at = time.monotonic()
             self._cond.notify_all()
+        if state == FAILED and self.manager is not None:
+            self.manager.tasks_failed += 1  # lifetime counter (metrics)
         if state in DONE_STATES and self.events is not None:
             # task-level terminal event from the WORKER path (reference
             # QueryMonitor per-task stats; listener isolation inside the
@@ -116,7 +125,8 @@ class TpuTask:
         with self._cond:
             return TaskStatus(self.task_id, self.state, self.version,
                               self.self_uri, list(self.failures),
-                              memory_reservation=self.memory_peak)
+                              memory_reservation=self.memory_peak,
+                              error_type=self.error_type)
 
     def wait_status(self, current_state: Optional[str],
                     max_wait_s: float) -> TaskStatus:
@@ -140,15 +150,28 @@ class TpuTask:
             # drop undelivered pages and unblock a backpressured producer
             self.buffers.destroy_all()
 
+    def fail(self, message: str, error_type: str = INTERNAL_ERROR) -> None:
+        """Force-fail a RUNNING task (TaskManager.abort chaos hook): the
+        executor thread observes the terminal state at its next page and
+        stops; consumers see the tagged error on their next pull."""
+        if self.buffers:
+            self.buffers.set_error(
+                f"task {self.task_id} failed [{error_type}]: {message}")
+        self._set_state(FAILED, message, error_type)
+
     # -- execution ----------------------------------------------------------
     def start(self, update: TaskUpdateRequest) -> None:
         try:
             fragment = update.fragment()
             spec = update.output_buffers
-            self.buffers = OutputBufferManager(spec.type, spec.n_buffers)
             from ..exec.memory import MemoryPool
             from .protocol import apply_session_properties
             cfg = apply_session_properties(self.config, update.session)
+            # retry mode makes buffers replayable: a retried consumer
+            # re-reads from token 0, so acknowledged pages must survive
+            self.buffers = OutputBufferManager(
+                spec.type, spec.n_buffers,
+                retain=cfg.remote_task_retry_attempts > 0)
             ctx = TaskContext(config=cfg, task_index=update.task_index,
                               memory=MemoryPool(cfg.memory_budget_bytes))
             from .plan_translation import translate_split
@@ -159,20 +182,24 @@ class TpuTask:
                 if remote:
                     ctx.remote_pages[source.plan_node_id] = \
                         remote_page_reader(
-                            remote, codec=cfg.exchange_compression_codec)
+                            remote, codec=cfg.exchange_compression_codec,
+                            max_error_duration_s=
+                            cfg.exchange_max_error_duration_s)
                 if conn:
                     ctx.splits[source.plan_node_id] = [
                         catalog.TableSplit.from_dict(s) for s in conn]
-        except Exception:
+        except Exception as e:
             # a malformed update (bad fragment, bad session property) must
             # fail the task, not strand it in PLANNED (the coordinator
             # sees FAILED on its next status poll, TaskResource.cpp:242-255)
+            error_type = classify_exception(e)
             message = traceback.format_exc()
             if self.buffers is None:
                 self.buffers = OutputBufferManager("PARTITIONED", 1)
             self.buffers.set_error(
-                f"task {self.task_id} failed to start:\n{message}")
-            self._set_state(FAILED, message)
+                f"task {self.task_id} failed to start "
+                f"[{error_type}]:\n{message}")
+            self._set_state(FAILED, message, error_type)
             return
 
         self._set_state(RUNNING)
@@ -181,11 +208,29 @@ class TpuTask:
             name=f"task-{self.task_id}", daemon=True)
         self._thread.start()
 
+    def _inject_fault(self, ctx: TaskContext) -> None:
+        """Chaos hooks (the HTTP-worker mirror of the batch scheduler's
+        SchedulerConfig.fault_injector): a manager-level injector callable
+        and a config/session probability.  The probabilistic roll is a
+        DETERMINISTIC hash of the task id, so a given chaos run replays
+        exactly and a retry (new attempt id) rolls independently."""
+        if self.manager is not None and self.manager.fault_injector:
+            self.manager.fault_injector(self.task_id)
+        p = ctx.config.fault_injection_probability
+        if p > 0.0:
+            import hashlib
+            h = int.from_bytes(hashlib.sha256(
+                self.task_id.encode()).digest()[:8], "big")
+            if h % 1_000_000 < p * 1_000_000:
+                raise InjectedTaskFailure(
+                    f"injected task failure (p={p}, task {self.task_id})")
+
     def _run(self, fragment: P.PlanFragment, spec, ctx: TaskContext) -> None:
         try:
             self.plan_nodes = [
                 {"planNodeId": n.id, "operatorType": type(n).__name__}
                 for n in P.walk_plan(fragment.root)]
+            self._inject_fault(ctx)
             out_vars = fragment.root.output_variables
             out_types = [v.type for v in out_vars]
             out_names = [v.name for v in out_vars]
@@ -250,18 +295,26 @@ class TpuTask:
             self.memory_peak = ctx.memory.peak
             self.buffers.set_complete()
             self._set_state(FINISHED)
-        except Exception:
+        except Exception as e:
+            # tag the failure with its reference error type so consumers
+            # (and the coordinator behind them) can decide retryability —
+            # a propagated USER_ERROR stays non-retryable end to end
+            error_type = classify_exception(e)
             message = traceback.format_exc()
-            self.buffers.set_error(f"task {self.task_id} failed:\n{message}")
-            self._set_state(FAILED, message)
+            self.buffers.set_error(
+                f"task {self.task_id} failed [{error_type}]:\n{message}")
+            self._set_state(FAILED, message, error_type)
 
 
 class TaskManager:
     """Task registry (reference SqlTaskManager.java:103).  Terminal tasks
-    are evicted after a grace period (the reference's task info cleanup in
-    PeriodicTaskManager) so a long-lived worker does not leak memory."""
+    are evicted after a grace period — both inline on task creation and by
+    a periodic reaper thread (the reference's PeriodicTaskManager task
+    cleanup), so a worker that stops receiving new tasks still frees
+    terminal tasks and their retained buffers."""
 
     TASK_TTL_S = 300.0
+    REAPER_INTERVAL_S = 15.0
 
     def __init__(self, base_uri: str = "",
                  config: Optional[ExecutionConfig] = None, events=None):
@@ -271,6 +324,12 @@ class TaskManager:
         self.tasks: Dict[str, TpuTask] = {}
         self._lock = threading.Lock()
         self.tasks_created = 0
+        self.tasks_failed = 0     # lifetime, survives eviction (metrics)
+        self.tasks_retried = 0    # coordinator retry attempts seen (.rN ids)
+        # chaos hook: fault_injector(task_id) raises to fail the task at
+        # start (the worker mirror of SchedulerConfig.fault_injector)
+        self.fault_injector: Optional[Callable[[str], None]] = None
+        self._reaper_stop: Optional[threading.Event] = None
 
     def counts(self) -> Dict[str, int]:
         """Live task-state counts + lifetime counters (metrics/status)."""
@@ -281,7 +340,9 @@ class TaskManager:
                 by_state[t.state] = by_state.get(t.state, 0) + 1
                 mem_peak = max(mem_peak, t.memory_peak)
             return {"created": self.tasks_created, "by_state": by_state,
-                    "memory_peak": mem_peak}
+                    "memory_peak": mem_peak,
+                    "failed": self.tasks_failed,
+                    "retried": self.tasks_retried}
 
     def _evict_locked(self) -> None:
         import time
@@ -293,15 +354,44 @@ class TaskManager:
                 self.tasks[tid].buffers.destroy_all()
             del self.tasks[tid]
 
+    def evict_terminal(self) -> None:
+        with self._lock:
+            self._evict_locked()
+
+    def start_reaper(self, interval_s: Optional[float] = None) -> None:
+        """Periodic terminal-task eviction (reference PeriodicTaskManager):
+        without it a worker that stops receiving create_or_update calls
+        never evicts done tasks or frees their buffers."""
+        if self._reaper_stop is not None:
+            return
+        stop = threading.Event()
+        self._reaper_stop = stop
+        interval = interval_s or self.REAPER_INTERVAL_S
+
+        def loop():
+            while not stop.wait(interval):
+                self.evict_terminal()
+        threading.Thread(target=loop, name="task-reaper",
+                         daemon=True).start()
+
+    def stop_reaper(self) -> None:
+        if self._reaper_stop is not None:
+            self._reaper_stop.set()
+            self._reaper_stop = None
+
     def create_or_update(self, update: TaskUpdateRequest) -> TaskStatus:
+        import re
         with self._lock:
             self._evict_locked()
             task = self.tasks.get(update.task_id)
             if task is None:
                 self.tasks_created += 1
+                if re.search(r"\.r\d+$", update.task_id):
+                    # coordinator retry lineage suffix (taskId.rATTEMPT)
+                    self.tasks_retried += 1
                 task = TpuTask(update.task_id,
                                f"{self.base_uri}/v1/task/{update.task_id}",
-                               self.config, events=self.events)
+                               self.config, events=self.events, manager=self)
                 self.tasks[update.task_id] = task
                 fresh = True
             else:
@@ -316,6 +406,14 @@ class TaskManager:
             raise KeyError(task_id)
         return task
 
+    def abort(self, task_id: str,
+              message: str = "aborted by chaos hook") -> None:
+        """Force-fail one running task (chaos testing: the deterministic
+        'kill this task mid-query' lever next to the probabilistic
+        injection)."""
+        self.get(task_id).fail(message)
+
     def cancel_all(self) -> None:
+        self.stop_reaper()
         for t in list(self.tasks.values()):
             t.cancel()
