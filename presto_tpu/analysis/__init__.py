@@ -1,0 +1,74 @@
+"""Static plan analysis: sanity / type validation passes + host-sync lint.
+
+The analog of the reference coordinator's plan sanity framework
+(presto-main-base/.../sql/planner/sanity/PlanChecker.java, which runs
+ValidateDependenciesChecker, NoDuplicatePlanNodeIdsChecker, TypeValidator
+and friends after planning, after optimization, and after fragmentation).
+A buggy optimizer rule or fragmenter rewrite surfaces here as a typed
+diagnostic instead of a wrong answer only a TPC-H oracle diff can catch.
+
+Validation is gated by the ``plan_validation`` session property /
+``task.plan-validation`` config key:
+
+- ``on`` (default): validate after planning, after the whole optimizer
+  run, and after fragmentation; ERROR diagnostics raise
+  ``PlanValidationError`` (non-retryable ``PLAN_VALIDATION``).
+- ``strict``: additionally validate after EVERY iterative-rule firing,
+  attributing the violation to the rule that introduced it.
+- ``off``: no validation.
+
+The mode is carried in a thread-local (planning has no config object in
+scope); runners seed it from ``ExecutionConfig.plan_validation``.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+VALIDATION_ON = "on"
+VALIDATION_STRICT = "strict"
+VALIDATION_OFF = "off"
+VALIDATION_MODES = (VALIDATION_ON, VALIDATION_STRICT, VALIDATION_OFF)
+
+_state = threading.local()
+
+
+def validation_mode() -> str:
+    return getattr(_state, "mode", VALIDATION_ON)
+
+
+@contextlib.contextmanager
+def use_validation_mode(mode: str):
+    """Scope the plan-validation mode for the current thread (the planner
+    and optimizer run synchronously on the planning thread)."""
+    if mode not in VALIDATION_MODES:
+        raise ValueError(
+            f"plan_validation must be one of {VALIDATION_MODES}, "
+            f"got {mode!r}")
+    prev = getattr(_state, "mode", None)
+    _state.mode = mode
+    try:
+        yield
+    finally:
+        if prev is None:
+            del _state.mode
+        else:
+            _state.mode = prev
+
+
+from .checker import (  # noqa: E402
+    ALL_CHECK_CODES, CHECK_DANGLING_VARIABLE, CHECK_DUPLICATE_NODE_ID,
+    CHECK_EXCHANGE_LAYOUT, CHECK_FRAGMENT_BOUNDARY, CHECK_GROUPED_EXECUTION,
+    CHECK_JOIN_KEY_TYPE, CHECK_PARTITIONING, CHECK_TYPE_MISMATCH,
+    PlanChecker, PlanDiagnostic, check_plan, check_subplan, validate_plan,
+    validate_subplan)
+
+__all__ = [
+    "ALL_CHECK_CODES", "CHECK_DANGLING_VARIABLE", "CHECK_DUPLICATE_NODE_ID",
+    "CHECK_EXCHANGE_LAYOUT", "CHECK_FRAGMENT_BOUNDARY",
+    "CHECK_GROUPED_EXECUTION", "CHECK_JOIN_KEY_TYPE", "CHECK_PARTITIONING",
+    "CHECK_TYPE_MISMATCH", "PlanChecker", "PlanDiagnostic",
+    "VALIDATION_MODES", "VALIDATION_OFF", "VALIDATION_ON",
+    "VALIDATION_STRICT", "check_plan", "check_subplan", "use_validation_mode",
+    "validate_plan", "validate_subplan", "validation_mode",
+]

@@ -1,0 +1,807 @@
+"""PlanChecker: pluggable sanity & type validation over PlanNode trees and
+SubPlan/PlanFragment graphs.
+
+The reference runs a fixed list of checkers at three pipeline stages
+(sql/planner/sanity/PlanChecker.java: intermediatePlanSanityChecker after
+planning and optimization, finalPlanSanityChecker / fragment checks after
+fragmentation).  Each check here walks the plan and emits typed
+``PlanDiagnostic``s (check code, node path, severity); the wiring in
+sql/planner.py, sql/rules.py, and sql/fragmenter.py raises ERROR
+diagnostics as fail-fast ``PlanValidationError`` (common/errors.py,
+``PLAN_VALIDATION``: non-retryable — retrying a malformed plan cannot
+help).
+
+Check codes
+-----------
+- ``DANGLING_VARIABLE``   (ValidateDependenciesChecker): a node references
+  a variable none of its sources produce, or a node's declared outputs are
+  not grounded in its sources.
+- ``DUPLICATE_NODE_ID``   (NoDuplicatePlanNodeIdsChecker): two structurally
+  DIFFERENT nodes share a plan-node id.  Structurally identical copies
+  sharing an id are this engine's decorrelation contract (sql/rules.py
+  node-identity note) and are allowed.
+- ``TYPE_MISMATCH``       (TypeValidator): an expression's output type does
+  not match the declared variable type — project assignments, filter
+  predicates (must be boolean), scan column assignments, aggregation
+  call/output and intermediate (PARTIAL/FINAL) types.
+- ``JOIN_KEY_TYPE``       (TypeValidator equi-clause check): join /
+  semi-join key pairs with incompatible types.
+- ``EXCHANGE_LAYOUT``     exchange/union column alignment: each input row
+  of an ExchangeNode (and each UnionNode branch) must supply every output
+  column with a matching type.
+- ``PARTITIONING``        PartitioningScheme consistency: partitioning
+  arguments and output layout must exist in the producing node's outputs
+  with matching types.
+- ``FRAGMENT_BOUNDARY``   RemoteSourceNode fragment ids must name real
+  child fragments of the consuming fragment, every child fragment must
+  have a consumer, and the producer's output layout must align with the
+  consumer's declared columns (name AND type).
+- ``GROUPED_EXECUTION``   a fragment claiming grouped lifespan sharding
+  (exec/grouped.py stage_shards_lifespans) must actually be the shape the
+  scheduler assumes: SOURCE-distributed with its single scan receiving
+  splits.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from ..common.types import Type
+from ..spi import plan as P
+from ..spi.expr import VariableReferenceExpression, free_variables
+
+CHECK_DANGLING_VARIABLE = "DANGLING_VARIABLE"
+CHECK_DUPLICATE_NODE_ID = "DUPLICATE_NODE_ID"
+CHECK_TYPE_MISMATCH = "TYPE_MISMATCH"
+CHECK_JOIN_KEY_TYPE = "JOIN_KEY_TYPE"
+CHECK_EXCHANGE_LAYOUT = "EXCHANGE_LAYOUT"
+CHECK_PARTITIONING = "PARTITIONING"
+CHECK_FRAGMENT_BOUNDARY = "FRAGMENT_BOUNDARY"
+CHECK_GROUPED_EXECUTION = "GROUPED_EXECUTION"
+
+ALL_CHECK_CODES = (
+    CHECK_DANGLING_VARIABLE, CHECK_DUPLICATE_NODE_ID, CHECK_TYPE_MISMATCH,
+    CHECK_JOIN_KEY_TYPE, CHECK_EXCHANGE_LAYOUT, CHECK_PARTITIONING,
+    CHECK_FRAGMENT_BOUNDARY, CHECK_GROUPED_EXECUTION,
+)
+
+ERROR = "ERROR"
+WARNING = "WARNING"
+
+
+@dataclass(frozen=True)
+class PlanDiagnostic:
+    code: str
+    severity: str
+    node_id: str
+    path: str           # root-to-node chain of node kinds, "/"-separated
+    message: str
+    stage: str = ""     # post-plan | post-optimize | post-fragment | rule:<n>
+
+    def __str__(self):
+        stage = f" [{self.stage}]" if self.stage else ""
+        return (f"{self.severity} {self.code}{stage} at {self.path} "
+                f"(id={self.node_id}): {self.message}")
+
+
+# ---------------------------------------------------------------------------
+# type compatibility
+# ---------------------------------------------------------------------------
+
+_INT_FAMILY = {"tinyint", "smallint", "integer", "bigint"}
+_FLOAT_FAMILY = {"real", "double"}
+_CHARISH = {"varchar", "char"}
+
+
+def _base(sig: str) -> str:
+    return sig.split("(", 1)[0]
+
+
+def types_compatible(a: Type, b: Type) -> bool:
+    """Physical compatibility, not equality: the engine freely widens
+    within the integer and float families and tolerates varchar/char and
+    decimal-precision drift (blocks carry their own widths), but a
+    cross-family mismatch means a rewrite dropped or retyped a column."""
+    sa, sb = a.signature, b.signature
+    if sa == sb:
+        return True
+    ba, bb = _base(sa), _base(sb)
+    if ba in _INT_FAMILY and bb in _INT_FAMILY:
+        return True
+    if ba in _FLOAT_FAMILY and bb in _FLOAT_FAMILY:
+        return True
+    if ba in _CHARISH and bb in _CHARISH:
+        return True
+    if ba == "decimal" and bb == "decimal":
+        # precision drift is layout-safe; a scale change rescales values
+        from ..common.types import DecimalType
+        if isinstance(a, DecimalType) and isinstance(b, DecimalType):
+            return a.scale == b.scale
+        return True
+    if ba == "unknown" or bb == "unknown":
+        return True     # NULL literal: coerces to any type
+    return False
+
+
+# ---------------------------------------------------------------------------
+# check context
+# ---------------------------------------------------------------------------
+
+@dataclass
+class _Ctx:
+    stage: str = ""
+    diags: List[PlanDiagnostic] = field(default_factory=list)
+
+    def add(self, code: str, node: P.PlanNode, path: str, message: str,
+            severity: str = ERROR) -> None:
+        self.diags.append(PlanDiagnostic(
+            code, severity, getattr(node, "id", "?"), path, message,
+            self.stage))
+
+
+def _kind(node: P.PlanNode) -> str:
+    return type(node).__name__.replace("Node", "")
+
+
+# ---------------------------------------------------------------------------
+# individual checks (each pluggable into PlanChecker)
+# ---------------------------------------------------------------------------
+
+class Check:
+    """One sanity pass over a plan tree."""
+    code: str = "?"
+
+    def run(self, root: P.PlanNode, ctx: _Ctx) -> None:
+        raise NotImplementedError
+
+
+class NoDuplicatePlanNodeIds(Check):
+    """Same id on two structurally DIFFERENT nodes.  Decorrelated deep
+    copies deliberately share ids (the pipeline compiler memoizes per id,
+    sql/rules.py); those copies are structurally identical, so equality of
+    ``structural_key`` separates the contract from the bug."""
+    code = CHECK_DUPLICATE_NODE_ID
+
+    def run(self, root, ctx):
+        by_id: Dict[str, List[P.PlanNode]] = {}
+        seen_objs: Set[int] = set()
+
+        def walk(node):
+            if id(node) in seen_objs:   # DAG share: one node, not a dup
+                return
+            seen_objs.add(id(node))
+            by_id.setdefault(node.id, []).append(node)
+            for s in node.sources:
+                walk(s)
+
+        walk(root)
+        for nid, nodes in by_id.items():
+            if len(nodes) < 2:
+                continue
+            keys = {P.structural_key(n) for n in nodes}
+            if len(keys) > 1:
+                kinds = ", ".join(sorted({_kind(n) for n in nodes}))
+                ctx.add(self.code, nodes[0], kinds,
+                        f"plan-node id {nid!r} is shared by "
+                        f"{len(nodes)} structurally different nodes "
+                        f"({kinds})")
+
+
+class ValidateDependencies(Check):
+    """Every variable a node references must be produced by its sources
+    (scoped per side for joins), and every declared output must be
+    grounded — the reference's ValidateDependenciesChecker."""
+    code = CHECK_DANGLING_VARIABLE
+
+    def run(self, root, ctx):
+        _walk_scoped(root, ctx, self._visit)
+
+    # -- helpers ----------------------------------------------------------
+    @staticmethod
+    def _produced(*nodes: P.PlanNode) -> Dict[str, Type]:
+        out: Dict[str, Type] = {}
+        for n in nodes:
+            for v in n.output_variables:
+                out[v.name] = v.type
+        return out
+
+    def _require(self, ctx, node, path, scope: Dict[str, Type],
+                 vars_: Iterable[VariableReferenceExpression],
+                 what: str) -> None:
+        for v in vars_:
+            if v.name not in scope:
+                ctx.add(self.code, node, path,
+                        f"{what} references {v.name!r} which no source "
+                        f"produces")
+            elif not types_compatible(v.type, scope[v.name]):
+                ctx.add(CHECK_TYPE_MISMATCH, node, path,
+                        f"{what} reads {v.name!r} as {v.type.signature} "
+                        f"but the source produces "
+                        f"{scope[v.name].signature}")
+
+    def _require_exprs(self, ctx, node, path, scope, exprs, what):
+        for e in exprs:
+            if e is None:
+                continue
+            self._require(ctx, node, path, scope, free_variables(e), what)
+
+    # -- node dispatch ----------------------------------------------------
+    def _visit(self, node: P.PlanNode, path: str, ctx: _Ctx) -> None:
+        t = type(node).__name__
+        m = getattr(self, "_visit_" + t, None)
+        if m is not None:
+            m(node, path, ctx)
+            return
+        # default: declared outputs must come from the (single) source
+        if node.sources:
+            scope = self._produced(*node.sources)
+            self._require(ctx, node, path, scope,
+                          self._own_outputs(node), "output")
+
+    @staticmethod
+    def _own_outputs(node: P.PlanNode):
+        """Outputs the node passes through (excluding ones it mints)."""
+        minted = set()
+        for attr in ("marker", "id_variable", "semi_join_output",
+                     "group_id_variable"):
+            v = getattr(node, attr, None)
+            if v is not None:
+                minted.add(v.name)
+        return [v for v in node.output_variables if v.name not in minted]
+
+    def _visit_TableScanNode(self, node, path, ctx):
+        # match by name: Variable hashes on (name, type), so a type-drifted
+        # output would miss a keyed lookup and misreport as unassigned
+        by_name = {v.name: ch for v, ch in node.assignments.items()}
+        for v in node.outputs:
+            ch = by_name.get(v.name)
+            if ch is None:
+                ctx.add(self.code, node, path,
+                        f"scan output {v.name!r} has no column assignment")
+            elif not types_compatible(v.type, ch.type):
+                ctx.add(CHECK_TYPE_MISMATCH, node, path,
+                        f"scan output {v.name!r} declared "
+                        f"{v.type.signature} but column {ch.name!r} is "
+                        f"{ch.type.signature}")
+
+    def _visit_FilterNode(self, node, path, ctx):
+        scope = self._produced(node.source)
+        self._require_exprs(ctx, node, path, scope, [node.predicate],
+                            "predicate")
+        if _base(node.predicate.type.signature) not in ("boolean", "unknown"):
+            ctx.add(CHECK_TYPE_MISMATCH, node, path,
+                    f"filter predicate has type "
+                    f"{node.predicate.type.signature}, expected boolean")
+
+    def _visit_ProjectNode(self, node, path, ctx):
+        scope = self._produced(node.source)
+        for v, e in node.assignments.items():
+            self._require_exprs(ctx, node, path, scope, [e],
+                                f"assignment {v.name!r}")
+            if not types_compatible(v.type, e.type):
+                ctx.add(CHECK_TYPE_MISMATCH, node, path,
+                        f"projection {v.name!r} declared "
+                        f"{v.type.signature} but expression produces "
+                        f"{e.type.signature}")
+
+    def _visit_AggregationNode(self, node, path, ctx):
+        scope = self._produced(node.source)
+        self._require(ctx, node, path, scope, node.grouping_keys,
+                      "grouping key")
+        for v, agg in node.aggregations.items():
+            self._require_exprs(ctx, node, path, scope,
+                                list(agg.call.arguments), f"aggregate "
+                                f"{v.name!r}")
+            if agg.mask is not None:
+                self._require(ctx, node, path, scope, [agg.mask],
+                              f"aggregate mask of {v.name!r}")
+            if not types_compatible(v.type, agg.call.type):
+                ctx.add(CHECK_TYPE_MISMATCH, node, path,
+                        f"aggregate {v.name!r} declared "
+                        f"{v.type.signature} but call "
+                        f"{agg.call.display_name} returns "
+                        f"{agg.call.type.signature}")
+            self._check_agg_call(node, path, ctx, v, agg)
+
+    def _check_agg_call(self, node, path, ctx, v, agg):
+        """Intermediate/final type rules for the decomposable aggregates
+        the fragmenter splits (sum/count/min/max; avg is rewritten away at
+        the split).  count is always bigint; min/max preserve their input
+        type; sum widens within its family (int->bigint, real->double,
+        decimal(p,s)->decimal(38,s))."""
+        from ..common.types import BigintType
+        name = agg.call.display_name.lower().split(".")[-1]
+        args = agg.call.arguments
+        if name == "count":
+            if not isinstance(agg.call.type, BigintType):
+                ctx.add(CHECK_TYPE_MISMATCH, node, path,
+                        f"count aggregate {v.name!r} must be bigint, got "
+                        f"{agg.call.type.signature}")
+        elif name in ("min", "max") and args:
+            if not types_compatible(agg.call.type, args[0].type):
+                ctx.add(CHECK_TYPE_MISMATCH, node, path,
+                        f"{name} aggregate {v.name!r} returns "
+                        f"{agg.call.type.signature} from a "
+                        f"{args[0].type.signature} input")
+        elif name == "sum" and args:
+            rb = _base(agg.call.type.signature)
+            ab = _base(args[0].type.signature)
+            ok = (rb == ab
+                  or (rb in _INT_FAMILY and ab in _INT_FAMILY)
+                  or (rb in _FLOAT_FAMILY and ab in _FLOAT_FAMILY)
+                  or (rb == "decimal" and ab == "decimal")
+                  or ab == "unknown")
+            if not ok:
+                ctx.add(CHECK_TYPE_MISMATCH, node, path,
+                        f"sum aggregate {v.name!r} returns "
+                        f"{agg.call.type.signature} from a "
+                        f"{args[0].type.signature} input (cross-family)")
+
+    def _visit_JoinNode(self, node, path, ctx):
+        lscope = self._produced(node.left)
+        rscope = self._produced(node.right)
+        both = dict(rscope)
+        both.update(lscope)
+        for l, r in node.criteria:
+            self._require(ctx, node, path, lscope, [l],
+                          "join criteria (left)")
+            self._require(ctx, node, path, rscope, [r],
+                          "join criteria (right)")
+            if not types_compatible(l.type, r.type):
+                ctx.add(CHECK_JOIN_KEY_TYPE, node, path,
+                        f"equi-join key types differ: {l.name} is "
+                        f"{l.type.signature}, {r.name} is "
+                        f"{r.type.signature}")
+        self._require_exprs(ctx, node, path, both, [node.filter],
+                            "join filter")
+        self._require(ctx, node, path, both, node.outputs, "join output")
+        for probe_name in node.dynamic_filters:
+            if probe_name not in lscope:
+                ctx.add(self.code, node, path,
+                        f"dynamic filter probe column {probe_name!r} is "
+                        f"not produced by the probe (left) side")
+
+    def _visit_SemiJoinNode(self, node, path, ctx):
+        sscope = self._produced(node.source)
+        fscope = self._produced(node.filtering_source)
+        self._require(ctx, node, path, sscope,
+                      [node.source_join_variable], "semi-join source key")
+        self._require(ctx, node, path, fscope,
+                      [node.filtering_source_join_variable],
+                      "semi-join filtering key")
+        if not types_compatible(node.source_join_variable.type,
+                                node.filtering_source_join_variable.type):
+            ctx.add(CHECK_JOIN_KEY_TYPE, node, path,
+                    f"semi-join key types differ: "
+                    f"{node.source_join_variable.name} is "
+                    f"{node.source_join_variable.type.signature}, "
+                    f"{node.filtering_source_join_variable.name} is "
+                    f"{node.filtering_source_join_variable.type.signature}")
+        if _base(node.semi_join_output.type.signature) != "boolean":
+            ctx.add(CHECK_TYPE_MISMATCH, node, path,
+                    f"semi-join output {node.semi_join_output.name!r} "
+                    f"must be boolean, got "
+                    f"{node.semi_join_output.type.signature}")
+
+    def _visit_SortNode(self, node, path, ctx):
+        self._require(ctx, node, path, self._produced(node.source),
+                      [v for v, _o in node.ordering_scheme.orderings],
+                      "sort key")
+
+    _visit_TopNNode = _visit_SortNode
+
+    def _visit_DistinctLimitNode(self, node, path, ctx):
+        self._require(ctx, node, path, self._produced(node.source),
+                      node.distinct_variables, "distinct key")
+
+    def _visit_MarkDistinctNode(self, node, path, ctx):
+        self._require(ctx, node, path, self._produced(node.source),
+                      node.distinct_variables, "distinct key")
+
+    def _visit_OutputNode(self, node, path, ctx):
+        scope = self._produced(node.source)
+        self._require(ctx, node, path, scope, node.outputs, "output column")
+        if len(node.column_names) != len(node.outputs):
+            ctx.add(self.code, node, path,
+                    f"output has {len(node.column_names)} column names "
+                    f"for {len(node.outputs)} variables")
+
+    def _visit_WindowNode(self, node, path, ctx):
+        scope = self._produced(node.source)
+        self._require(ctx, node, path, scope, node.partition_by,
+                      "window partition key")
+        if node.ordering_scheme:
+            self._require(ctx, node, path, scope,
+                          [v for v, _o in node.ordering_scheme.orderings],
+                          "window order key")
+        for v, wf in node.window_functions.items():
+            self._require_exprs(ctx, node, path, scope, [wf.call],
+                                f"window function {v.name!r}")
+            if not types_compatible(v.type, wf.call.type):
+                ctx.add(CHECK_TYPE_MISMATCH, node, path,
+                        f"window function {v.name!r} declared "
+                        f"{v.type.signature} but call returns "
+                        f"{wf.call.type.signature}")
+
+    def _visit_GroupIdNode(self, node, path, ctx):
+        scope = self._produced(node.source)
+        self._require(ctx, node, path, scope,
+                      list(node.grouping_columns.values()),
+                      "grouping input column")
+        self._require(ctx, node, path, scope, node.aggregation_arguments,
+                      "aggregation argument")
+        out_names = {v.name for v in node.grouping_columns}
+        for s in node.grouping_sets:
+            for v in s:
+                if v.name not in out_names:
+                    ctx.add(self.code, node, path,
+                            f"grouping set references {v.name!r} which is "
+                            f"not a grouping output column")
+
+    def _visit_UnnestNode(self, node, path, ctx):
+        scope = self._produced(node.source)
+        self._require(ctx, node, path, scope, node.replicate_variables,
+                      "replicate column")
+        self._require(ctx, node, path, scope,
+                      [v for v, _e in node.unnest_variables],
+                      "unnest input")
+
+    def _visit_UnionNode(self, node, path, ctx):
+        for i, src in enumerate(node.inputs):
+            scope = self._produced(src)
+            for v in node.outputs:
+                if v.name not in scope:
+                    ctx.add(CHECK_EXCHANGE_LAYOUT, node, path,
+                            f"union branch {i} does not produce output "
+                            f"column {v.name!r}")
+                elif not types_compatible(v.type, scope[v.name]):
+                    ctx.add(CHECK_EXCHANGE_LAYOUT, node, path,
+                            f"union branch {i} produces {v.name!r} as "
+                            f"{scope[v.name].signature}, union declares "
+                            f"{v.type.signature}")
+
+    def _visit_ExchangeNode(self, node, path, ctx):
+        layout = node.partitioning_scheme.output_layout
+        if node.inputs and len(node.inputs) != len(node.exchange_sources):
+            ctx.add(CHECK_EXCHANGE_LAYOUT, node, path,
+                    f"exchange has {len(node.exchange_sources)} sources "
+                    f"but {len(node.inputs)} input rows")
+        for i, src in enumerate(node.exchange_sources):
+            scope = self._produced(src)
+            row = node.inputs[i] if i < len(node.inputs) else None
+            if row is None:
+                continue
+            if len(row) != len(layout):
+                ctx.add(CHECK_EXCHANGE_LAYOUT, node, path,
+                        f"exchange input row {i} has {len(row)} columns "
+                        f"for a {len(layout)}-column output layout")
+                continue
+            for j, (iv, ov) in enumerate(zip(row, layout)):
+                if iv.name not in scope:
+                    ctx.add(self.code, node, path,
+                            f"exchange input {iv.name!r} (row {i}, col "
+                            f"{j}) is not produced by source {i}")
+                elif not types_compatible(iv.type, ov.type):
+                    ctx.add(CHECK_EXCHANGE_LAYOUT, node, path,
+                            f"exchange column {j}: input {iv.name!r} is "
+                            f"{iv.type.signature} but layout declares "
+                            f"{ov.name!r} {ov.type.signature}")
+        _check_partitioning_scheme(node.partitioning_scheme, node, path, ctx)
+
+    def _visit_ValuesNode(self, node, path, ctx):
+        for r, row in enumerate(node.rows):
+            if len(row) != len(node.outputs):
+                ctx.add(self.code, node, path,
+                        f"values row {r} has {len(row)} expressions for "
+                        f"{len(node.outputs)} outputs")
+                continue
+            for v, e in zip(node.outputs, row):
+                if not types_compatible(v.type, e.type):
+                    ctx.add(CHECK_TYPE_MISMATCH, node, path,
+                            f"values column {v.name!r} declared "
+                            f"{v.type.signature} but row {r} supplies "
+                            f"{e.type.signature}")
+
+    def _visit_RemoteSourceNode(self, node, path, ctx):
+        pass    # fragment-boundary checks own remote sources
+
+    def _visit_TableWriterNode(self, node, path, ctx):
+        pass    # writer mints its (rows, fragment) outputs
+
+    _visit_TableFinishNode = _visit_TableWriterNode
+
+
+def _check_partitioning_scheme(scheme: P.PartitioningScheme,
+                               node: P.PlanNode, path: str,
+                               ctx: _Ctx) -> None:
+    layout = {v.name: v.type for v in scheme.output_layout}
+    for a in scheme.arguments:
+        if a.name not in layout:
+            ctx.add(CHECK_PARTITIONING, node, path,
+                    f"partitioning column {a.name!r} is not in the "
+                    f"output layout")
+        elif not types_compatible(a.type, layout[a.name]):
+            ctx.add(CHECK_PARTITIONING, node, path,
+                    f"partitioning column {a.name!r} is "
+                    f"{a.type.signature} but the layout carries "
+                    f"{layout[a.name].signature}")
+    if scheme.handle == P.FIXED_HASH_DISTRIBUTION and not scheme.arguments:
+        ctx.add(CHECK_PARTITIONING, node, path,
+                "FIXED_HASH partitioning with no partitioning columns")
+
+
+def _walk_scoped(root: P.PlanNode, ctx: _Ctx, visit) -> None:
+    """Pre-order walk carrying the root-to-node kind path; DAG-shared
+    subtrees (decorrelated copies materialized as one object) visit once."""
+    seen: Set[int] = set()
+
+    def walk(node: P.PlanNode, path: str) -> None:
+        here = f"{path}/{_kind(node)}" if path else _kind(node)
+        if id(node) in seen:
+            return
+        seen.add(id(node))
+        visit(node, here, ctx)
+        for s in node.sources:
+            walk(s, here)
+
+    walk(root, "")
+
+
+# ---------------------------------------------------------------------------
+# fragment-graph checks
+# ---------------------------------------------------------------------------
+
+class FragmentCheck:
+    code: str = "?"
+
+    def run(self, subplan: P.SubPlan, ctx: _Ctx, exec_config=None) -> None:
+        raise NotImplementedError
+
+
+class ValidateFragmentBoundaries(FragmentCheck):
+    """Every RemoteSourceNode must name real child fragments, every child
+    fragment must have a consumer (its output buffer would otherwise fill
+    and stall), and the producer's output partitioning layout must align
+    column-for-column with the consumer's declared outputs."""
+    code = CHECK_FRAGMENT_BOUNDARY
+
+    def run(self, subplan, ctx, exec_config=None):
+        self._visit(subplan, ctx)
+
+    def _visit(self, sp: P.SubPlan, ctx: _Ctx) -> None:
+        frag = sp.fragment
+        children = {c.fragment.fragment_id: c.fragment for c in sp.children}
+        consumed: Set[str] = set()
+        path = f"Fragment[{frag.fragment_id}]"
+        for node in P.walk_plan(frag.root):
+            if isinstance(node, P.ExchangeNode) and node.scope == P.REMOTE:
+                ctx.add(self.code, node, f"{path}/{_kind(node)}",
+                        "REMOTE exchange survived fragmentation")
+            if not isinstance(node, P.RemoteSourceNode):
+                continue
+            for fid in node.source_fragment_ids:
+                child = children.get(fid)
+                if child is None:
+                    ctx.add(self.code, node, f"{path}/RemoteSource",
+                            f"remote source names fragment {fid!r} which "
+                            f"is not a child of fragment "
+                            f"{frag.fragment_id!r}")
+                    continue
+                consumed.add(fid)
+                self._check_layout(node, child, path, ctx)
+        for fid in children:
+            if fid not in consumed:
+                ctx.add(self.code, sp.children[0].fragment.root
+                        if sp.children else frag.root, path,
+                        f"child fragment {fid!r} has no consuming remote "
+                        f"source in fragment {frag.fragment_id!r}")
+        for c in sp.children:
+            self._visit(c, ctx)
+
+    @staticmethod
+    def _check_layout(node: P.RemoteSourceNode, child: P.PlanFragment,
+                      path: str, ctx: _Ctx) -> None:
+        produced = child.output_partitioning_scheme.output_layout
+        if len(produced) != len(node.outputs):
+            ctx.add(CHECK_FRAGMENT_BOUNDARY, node, f"{path}/RemoteSource",
+                    f"fragment {child.fragment_id!r} produces "
+                    f"{len(produced)} columns but the consumer declares "
+                    f"{len(node.outputs)}")
+            return
+        for j, (pv, cv) in enumerate(zip(produced, node.outputs)):
+            if pv.name != cv.name:
+                ctx.add(CHECK_FRAGMENT_BOUNDARY, node,
+                        f"{path}/RemoteSource",
+                        f"fragment boundary column {j} is {pv.name!r} on "
+                        f"the producer but {cv.name!r} on the consumer "
+                        f"(column-order drift)")
+            elif not types_compatible(pv.type, cv.type):
+                ctx.add(CHECK_FRAGMENT_BOUNDARY, node,
+                        f"{path}/RemoteSource",
+                        f"fragment boundary column {j} ({pv.name!r}) is "
+                        f"{pv.type.signature} on the producer but "
+                        f"{cv.type.signature} on the consumer")
+        # the producer fragment's root must actually yield that layout
+        root_out = {v.name: v.type
+                    for v in child.root.output_variables}
+        for pv in produced:
+            if pv.name not in root_out:
+                ctx.add(CHECK_FRAGMENT_BOUNDARY, node,
+                        f"{path}/RemoteSource",
+                        f"fragment {child.fragment_id!r} declares output "
+                        f"{pv.name!r} its root does not produce")
+
+
+class ValidateFragmentPartitioning(FragmentCheck):
+    """A fragment's declared partitioning must match its body: scans only
+    in SOURCE-distributed fragments, partitioned_sources listing exactly
+    the scan node ids, and the output partitioning columns grounded in the
+    root's outputs."""
+    code = CHECK_PARTITIONING
+
+    def run(self, subplan, ctx, exec_config=None):
+        for sp in self._walk(subplan):
+            frag = sp.fragment
+            path = f"Fragment[{frag.fragment_id}]"
+            scan_ids = [n.id for n in P.walk_plan(frag.root)
+                        if isinstance(n, P.TableScanNode)]
+            if scan_ids and frag.partitioning != P.SOURCE_DISTRIBUTION:
+                ctx.add(self.code, frag.root, path,
+                        f"fragment contains table scans but is "
+                        f"{frag.partitioning}-partitioned")
+            if sorted(scan_ids) != sorted(frag.partitioned_sources):
+                ctx.add(self.code, frag.root, path,
+                        f"partitioned_sources {frag.partitioned_sources} "
+                        f"do not match the fragment's scan ids {scan_ids}")
+            _check_partitioning_scheme(
+                frag.output_partitioning_scheme, frag.root, path, ctx)
+            root_out = {v.name for v in frag.root.output_variables}
+            for v in frag.output_partitioning_scheme.output_layout:
+                if v.name not in root_out:
+                    ctx.add(self.code, frag.root, path,
+                            f"output layout column {v.name!r} is not "
+                            f"produced by the fragment root")
+
+    @staticmethod
+    def _walk(sp: P.SubPlan):
+        yield sp
+        for c in sp.children:
+            yield from ValidateFragmentPartitioning._walk(c)
+
+
+class ValidateGroupedExecution(FragmentCheck):
+    """If the scheduler's plan-time predicate (exec/grouped.py
+    stage_shards_lifespans) claims a fragment may shard lifespans, the
+    fragment must be the shape that claim assumes: SOURCE-distributed with
+    exactly one scan, and that scan registered to receive splits.  A
+    mispredicted claim on a non-SOURCE fragment would hand disjoint
+    lifespan subsets to tasks that never see splits."""
+    code = CHECK_GROUPED_EXECUTION
+
+    def run(self, subplan, ctx, exec_config=None):
+        if exec_config is None:
+            from ..exec.pipeline import ExecutionConfig
+            exec_config = ExecutionConfig()
+        from ..exec.grouped import stage_shards_lifespans
+        for sp in ValidateFragmentPartitioning._walk(subplan):
+            frag = sp.fragment
+            try:
+                claims = stage_shards_lifespans(frag.root, exec_config)
+            except Exception as e:  # predicate must never throw at plan time
+                ctx.add(self.code, frag.root,
+                        f"Fragment[{frag.fragment_id}]",
+                        f"stage_shards_lifespans raised "
+                        f"{type(e).__name__}: {e}")
+                continue
+            if not claims:
+                continue
+            path = f"Fragment[{frag.fragment_id}]"
+            scans = [n for n in P.walk_plan(frag.root)
+                     if isinstance(n, P.TableScanNode)]
+            if frag.partitioning != P.SOURCE_DISTRIBUTION:
+                ctx.add(self.code, frag.root, path,
+                        f"fragment claims grouped lifespan sharding but "
+                        f"is {frag.partitioning}-partitioned")
+            if len(scans) != 1:
+                ctx.add(self.code, frag.root, path,
+                        f"fragment claims grouped lifespan sharding with "
+                        f"{len(scans)} scans (needs exactly 1)")
+            elif scans[0].id not in frag.partitioned_sources:
+                ctx.add(self.code, frag.root, path,
+                        f"grouped-sharded scan {scans[0].id!r} is not in "
+                        f"partitioned_sources")
+
+
+# ---------------------------------------------------------------------------
+# the pluggable checker
+# ---------------------------------------------------------------------------
+
+DEFAULT_CHECKS: Tuple[Check, ...] = (
+    NoDuplicatePlanNodeIds(),
+    ValidateDependencies(),
+)
+
+DEFAULT_FRAGMENT_CHECKS: Tuple[FragmentCheck, ...] = (
+    ValidateFragmentBoundaries(),
+    ValidateFragmentPartitioning(),
+    ValidateGroupedExecution(),
+)
+
+
+class PlanChecker:
+    """Runs a pluggable list of checks over a plan tree (post-plan /
+    post-optimize) or a fragment graph (post-fragment: per-fragment tree
+    checks plus boundary checks)."""
+
+    def __init__(self, checks: Optional[Iterable[Check]] = None,
+                 fragment_checks: Optional[
+                     Iterable[FragmentCheck]] = None):
+        self.checks = tuple(checks) if checks is not None \
+            else DEFAULT_CHECKS
+        self.fragment_checks = tuple(fragment_checks) \
+            if fragment_checks is not None else DEFAULT_FRAGMENT_CHECKS
+
+    def check_plan(self, root: P.PlanNode,
+                   stage: str = "") -> List[PlanDiagnostic]:
+        ctx = _Ctx(stage)
+        for check in self.checks:
+            check.run(root, ctx)
+        return ctx.diags
+
+    def check_subplan(self, subplan: P.SubPlan, stage: str = "",
+                      exec_config=None) -> List[PlanDiagnostic]:
+        ctx = _Ctx(stage)
+        for sp in ValidateFragmentPartitioning._walk(subplan):
+            inner = _Ctx(stage)
+            for check in self.checks:
+                check.run(sp.fragment.root, inner)
+            fid = sp.fragment.fragment_id
+            ctx.diags.extend(PlanDiagnostic(
+                d.code, d.severity, d.node_id,
+                f"Fragment[{fid}]/{d.path}", d.message, d.stage)
+                for d in inner.diags)
+        for check in self.fragment_checks:
+            check.run(subplan, ctx, exec_config=exec_config)
+        return ctx.diags
+
+
+_DEFAULT = PlanChecker()
+
+
+def check_plan(root: P.PlanNode, stage: str = "") -> List[PlanDiagnostic]:
+    return _DEFAULT.check_plan(root, stage)
+
+
+def check_subplan(subplan: P.SubPlan, stage: str = "",
+                  exec_config=None) -> List[PlanDiagnostic]:
+    return _DEFAULT.check_subplan(subplan, stage, exec_config=exec_config)
+
+
+def _raise_if_errors(diags: List[PlanDiagnostic], stage: str) -> None:
+    errors = [d for d in diags if d.severity == ERROR]
+    if not errors:
+        return
+    from ..common.errors import PlanValidationError
+    head = "; ".join(str(d) for d in errors[:5])
+    more = f" (+{len(errors) - 5} more)" if len(errors) > 5 else ""
+    raise PlanValidationError(
+        f"plan validation failed at {stage}: {head}{more}",
+        diagnostics=errors)
+
+
+def validate_plan(root: P.PlanNode, stage: str) -> None:
+    """Check and raise PlanValidationError on ERROR diagnostics; honors
+    the thread-local validation mode (off -> no-op)."""
+    from . import VALIDATION_OFF, validation_mode
+    if validation_mode() == VALIDATION_OFF:
+        return
+    _raise_if_errors(check_plan(root, stage), stage)
+
+
+def validate_subplan(subplan: P.SubPlan, stage: str = "post-fragment",
+                     exec_config=None) -> None:
+    from . import VALIDATION_OFF, validation_mode
+    if validation_mode() == VALIDATION_OFF:
+        return
+    _raise_if_errors(check_subplan(subplan, stage,
+                                   exec_config=exec_config), stage)

@@ -1,0 +1,334 @@
+"""AST lint for host-device synchronisation hazards in JAX execution code.
+
+The TPU execution paper's premise is that operator pipelines stay on
+device: every implicit device->host transfer (a `.item()`, an `int()`
+of a traced scalar, a Python `if` on a device boolean) inserts a
+blocking round trip that serialises the pipeline exactly where the
+paper's overlap comes from.  This lint walks Python source with `ast`
+and flags the hazard shapes:
+
+  SYNC001  explicit host sync: `jax.device_get(...)`, `.item()`,
+           `.block_until_ready()`.  These are sometimes *required*
+           (adaptive re-plans, duplicate-key probes) but each site must
+           be acknowledged with the allowlist pragma so new ones can't
+           creep in silently.
+  SYNC002  `int()` / `float()` / `bool()` applied to a device value —
+           an implicit transfer hidden inside a cast.
+  SYNC003  `np.asarray()` / `np.array()` applied to a device value —
+           an implicit transfer hidden inside a conversion.
+  SYNC004  Python `if` / `while` branching on a device boolean — forces
+           the trace to materialise the predicate on host.
+
+"Device value" is tracked with a deliberately shallow per-scope
+dataflow: names assigned from `jnp.*` / `lax.*` calls (or expressions
+over such names) are device; `jax.device_get(...)` results are host.
+The tracking is heuristic — the lint is a tripwire for review, not a
+type system — so precision is tuned to zero false positives on the
+shipped tree rather than completeness.
+
+Legitimate sync points carry the pragma on any line of the statement:
+
+    kmax = int(jax.device_get(_max_run(table)))  # lint: allow-host-sync
+
+Run as a module (exits nonzero when any finding survives the pragmas):
+
+    python -m presto_tpu.analysis.lint presto_tpu
+"""
+from __future__ import annotations
+
+import ast
+import io
+import sys
+import tokenize
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Iterable, List, Set
+
+PRAGMA = "lint: allow-host-sync"
+
+SYNC_EXPLICIT = "SYNC001"
+SYNC_CAST = "SYNC002"
+SYNC_ASARRAY = "SYNC003"
+SYNC_BRANCH = "SYNC004"
+
+ALL_LINT_CODES = (SYNC_EXPLICIT, SYNC_CAST, SYNC_ASARRAY, SYNC_BRANCH)
+
+# Call prefixes whose results live on device.  `jax.` alone is NOT in the
+# list: most of the jax namespace (jit, vmap, tree_util) returns host
+# objects; the array-producing submodules are named explicitly.
+_DEVICE_PREFIXES = ("jnp.", "jax.numpy.", "lax.", "jax.lax.")
+# Calls that move a value to host (their result is safe to branch on).
+_HOST_CALLS = {"jax.device_get"}
+# numpy conversion entry points that force a device->host copy when fed
+# a device array.
+_NUMPY_CONVERTERS = {"np.asarray", "np.array", "numpy.asarray",
+                     "numpy.array"}
+# Attribute reads on a device array that are host metadata, not data.
+_HOST_ATTRS = {"shape", "dtype", "ndim", "size", "nbytes"}
+# jnp/lax functions that return host metadata (Python bools, dtype
+# objects, iinfo records), not device arrays.
+_METADATA_FUNCS = {"issubdtype", "isdtype", "iinfo", "finfo", "dtype",
+                   "result_type", "promote_types", "shape", "ndim", "size"}
+
+
+@dataclass(frozen=True)
+class LintFinding:
+    path: str
+    line: int
+    col: int
+    code: str
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}:{self.col} {self.code} {self.message}"
+
+
+def _dotted(node: ast.AST) -> str:
+    """`a.b.c` for a Name/Attribute chain, '' for anything else."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _allowed_lines(source: str) -> Set[int]:
+    """Line numbers carrying the allowlist pragma comment."""
+    allowed: Set[int] = set()
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+            if tok.type == tokenize.COMMENT and PRAGMA in tok.string:
+                allowed.add(tok.start[0])
+    except tokenize.TokenizeError:
+        pass
+    return allowed
+
+
+class _Linter(ast.NodeVisitor):
+    """One pass over a module; `_device` is a stack of per-scope sets of
+    names currently bound to device values (function scopes copy their
+    enclosing scope so closures over device arrays stay tracked)."""
+
+    def __init__(self, path: str, allowed: Set[int]):
+        self.path = path
+        self.allowed = allowed
+        self.findings: List[LintFinding] = []
+        self._device: List[Set[str]] = [set()]
+
+    # -- reporting --------------------------------------------------------
+    def _flag(self, node: ast.AST, code: str, message: str) -> None:
+        first = getattr(node, "lineno", 0)
+        last = getattr(node, "end_lineno", first) or first
+        if any(ln in self.allowed for ln in range(first, last + 1)):
+            return
+        self.findings.append(LintFinding(
+            self.path, first, getattr(node, "col_offset", 0), code, message))
+
+    # -- device-value dataflow --------------------------------------------
+    def _scope(self) -> Set[str]:
+        return self._device[-1]
+
+    def _is_device(self, node: ast.AST) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in self._scope()
+        if isinstance(node, ast.Call):
+            name = _dotted(node.func)
+            if name in _HOST_CALLS:
+                return False
+            if name.startswith(_DEVICE_PREFIXES):
+                return name.rsplit(".", 1)[-1] not in _METADATA_FUNCS
+            # method call on a device value (x.sum(), x.astype(...))
+            if isinstance(node.func, ast.Attribute):
+                if node.func.attr in ("item", "tolist", "block_until_ready"):
+                    return False  # those syncs are flagged where they occur
+                return self._is_device(node.func.value)
+            return False
+        if isinstance(node, ast.Attribute):
+            if node.attr in _HOST_ATTRS:
+                return False
+            return self._is_device(node.value)
+        if isinstance(node, ast.Subscript):
+            return self._is_device(node.value)
+        if isinstance(node, ast.BinOp):
+            return self._is_device(node.left) or self._is_device(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self._is_device(node.operand)
+        if isinstance(node, ast.BoolOp):
+            return any(self._is_device(v) for v in node.values)
+        if isinstance(node, ast.Compare):
+            return (self._is_device(node.left)
+                    or any(self._is_device(c) for c in node.comparators))
+        if isinstance(node, ast.IfExp):
+            return self._is_device(node.body) or self._is_device(node.orelse)
+        return False
+
+    def _bind(self, target: ast.AST, device: bool) -> None:
+        if isinstance(target, ast.Name):
+            (self._scope().add if device
+             else self._scope().discard)(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._bind(elt, device)
+        elif isinstance(target, ast.Starred):
+            self._bind(target.value, device)
+
+    # -- scopes ------------------------------------------------------------
+    def _visit_function(self, node) -> None:
+        self._device.append(set(self._scope()))
+        for arg_default in node.args.defaults + node.args.kw_defaults:
+            if arg_default is not None:
+                self.visit(arg_default)
+        for stmt in node.body:
+            self.visit(stmt)
+        self._device.pop()
+
+    visit_FunctionDef = _visit_function
+    visit_AsyncFunctionDef = _visit_function
+
+    # -- bindings ----------------------------------------------------------
+    def visit_Assign(self, node: ast.Assign) -> None:
+        self.visit(node.value)
+        if (isinstance(node.value, ast.Tuple)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], (ast.Tuple, ast.List))
+                and len(node.targets[0].elts) == len(node.value.elts)):
+            for tgt, val in zip(node.targets[0].elts, node.value.elts):
+                self._bind(tgt, self._is_device(val))
+        else:
+            device = self._is_device(node.value)
+            for tgt in node.targets:
+                self._bind(tgt, device)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        if node.value is not None:
+            self.visit(node.value)
+            self._bind(node.target, self._is_device(node.value))
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self.visit(node.value)
+        if self._is_device(node.value):
+            self._bind(node.target, True)
+
+    def visit_For(self, node: ast.For) -> None:
+        self.visit(node.iter)
+        # iterating a device array yields device rows
+        self._bind(node.target, self._is_device(node.iter))
+        for stmt in node.body + node.orelse:
+            self.visit(stmt)
+
+    def visit_comprehension(self, node: ast.comprehension) -> None:
+        self.visit(node.iter)
+        self._bind(node.target, self._is_device(node.iter))
+        for cond in node.ifs:
+            self.visit(cond)
+
+    # -- hazards -----------------------------------------------------------
+    def visit_Call(self, node: ast.Call) -> None:
+        name = _dotted(node.func)
+        if name in _HOST_CALLS:
+            self._flag(node, SYNC_EXPLICIT,
+                       f"{name}() is an explicit device->host transfer; "
+                       f"acknowledge with `# {PRAGMA}` if intended")
+        elif isinstance(node.func, ast.Attribute) and not node.args:
+            if node.func.attr == "item":
+                self._flag(node, SYNC_EXPLICIT,
+                           ".item() blocks on a device->host copy; "
+                           f"acknowledge with `# {PRAGMA}` if intended")
+            elif node.func.attr == "block_until_ready":
+                self._flag(node, SYNC_EXPLICIT,
+                           ".block_until_ready() stalls the host; "
+                           f"acknowledge with `# {PRAGMA}` if intended")
+        if (name in ("int", "float", "bool") and len(node.args) == 1
+                and not node.keywords and self._is_device(node.args[0])):
+            self._flag(node, SYNC_CAST,
+                       f"{name}() on a device value forces a blocking "
+                       f"transfer; device_get first (with the pragma) or "
+                       f"keep the value on device")
+        if (name in _NUMPY_CONVERTERS and node.args
+                and self._is_device(node.args[0])):
+            self._flag(node, SYNC_ASARRAY,
+                       f"{name}() on a device array copies to host; use "
+                       f"jnp.asarray to stay on device or device_get "
+                       f"explicitly")
+        self.generic_visit(node)
+
+    def visit_If(self, node: ast.If) -> None:
+        if self._is_device(node.test):
+            self._flag(node.test, SYNC_BRANCH,
+                       "Python branch on a device boolean blocks until the "
+                       "value is on host; use lax.cond / jnp.where, or "
+                       "device_get with the pragma")
+        self.generic_visit(node)
+
+    def visit_While(self, node: ast.While) -> None:
+        if self._is_device(node.test):
+            self._flag(node.test, SYNC_BRANCH,
+                       "Python loop condition on a device value blocks every "
+                       "iteration; use lax.while_loop, or device_get with "
+                       "the pragma")
+        self.generic_visit(node)
+
+
+def lint_source(source: str, path: str = "<string>") -> List[LintFinding]:
+    """Lint one module's source; returns surviving findings."""
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [LintFinding(path, e.lineno or 0, e.offset or 0,
+                            "SYNTAX", f"cannot parse: {e.msg}")]
+    linter = _Linter(path, _allowed_lines(source))
+    linter.visit(tree)
+    return sorted(linter.findings, key=lambda f: (f.line, f.col))
+
+
+def lint_file(path: str) -> List[LintFinding]:
+    text = Path(path).read_text(encoding="utf-8")
+    return lint_source(text, str(path))
+
+
+def lint_paths(paths: Iterable[str]) -> List[LintFinding]:
+    """Lint files and directory trees (``*.py``, recursively)."""
+    findings: List[LintFinding] = []
+    for p in paths:
+        path = Path(p)
+        if path.is_dir():
+            for f in sorted(path.rglob("*.py")):
+                findings.extend(lint_file(str(f)))
+        else:
+            findings.extend(lint_file(str(p)))
+    return findings
+
+
+def lint_or_raise(paths: Iterable[str]) -> None:
+    """Programmatic gate: raise the same non-retryable PLAN_VALIDATION
+    error the plan checker uses, so a build step embedding the lint
+    fails through the one typed channel."""
+    findings = lint_paths(paths)
+    if findings:
+        from ..common.errors import PlanValidationError
+        head = "; ".join(str(f) for f in findings[:5])
+        more = f" (+{len(findings) - 5} more)" if len(findings) > 5 else ""
+        raise PlanValidationError(
+            f"host-sync lint failed: {head}{more}", diagnostics=findings)
+
+
+def main(argv=None) -> int:
+    args = list(sys.argv[1:] if argv is None else argv)
+    if not args:
+        print("usage: python -m presto_tpu.analysis.lint <path> [path ...]",
+              file=sys.stderr)
+        return 2
+    findings = lint_paths(args)
+    for f in findings:
+        print(f)
+    if findings:
+        print(f"{len(findings)} host-sync hazard(s)")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -25,16 +25,22 @@ USER_ERROR = "USER_ERROR"
 INTERNAL_ERROR = "INTERNAL_ERROR"
 INSUFFICIENT_RESOURCES = "INSUFFICIENT_RESOURCES"
 EXTERNAL = "EXTERNAL"
+# INTERNAL_ERROR subcategory for plan-validation failures (the analog of
+# the reference's PLAN_VALIDATION error-code names raised by
+# sql/planner/sanity): the plan itself is malformed, so unlike a lost
+# executor the same failure reproduces on every attempt — never retried.
+PLAN_VALIDATION = "PLAN_VALIDATION"
 
 # USER_ERROR never retries; everything infrastructure-shaped may.
 # INTERNAL_ERROR stays retryable like the batch scheduler's executor-loss
 # path (presto-spark re-runs lost tasks from durable inputs); an engine
 # bug then fails after the attempt budget instead of masquerading as
-# permanently transient.
+# permanently transient.  PLAN_VALIDATION is the deterministic exception:
+# replanning the same query yields the same malformed plan.
 RETRYABLE_TYPES = {INTERNAL_ERROR, INSUFFICIENT_RESOURCES, EXTERNAL}
 
 _TYPE_TAG = re.compile(r"\[(USER_ERROR|INTERNAL_ERROR|"
-                       r"INSUFFICIENT_RESOURCES|EXTERNAL)\]")
+                       r"INSUFFICIENT_RESOURCES|EXTERNAL|PLAN_VALIDATION)\]")
 # producer buffer locations embedded in failure text:
 # http://host:port/v1/task/{taskId}/results/{bufferId}
 _LOCATION_TASK = re.compile(r"/v1/task/([^/\s]+)/results/")
@@ -48,6 +54,17 @@ class PrestoQueryError(RuntimeError):
 class PrestoUserError(PrestoQueryError):
     """The query (or its session) is wrong; retrying cannot help."""
     error_type = USER_ERROR
+
+
+class PlanValidationError(PrestoQueryError):
+    """A plan failed a sanity/type check (presto_tpu/analysis).  Message
+    carries the ``[PLAN_VALIDATION]`` tag so non-retryability survives the
+    string-typed failure chain across task boundaries."""
+    error_type = PLAN_VALIDATION
+
+    def __init__(self, message: str, diagnostics=None):
+        super().__init__(f"[{PLAN_VALIDATION}] {message}")
+        self.diagnostics = list(diagnostics or [])
 
 
 class InjectedTaskFailure(PrestoQueryError):

@@ -295,7 +295,7 @@ def batch_to_page(batch: Batch, names, types) -> Page:
     fetch = {"__mask": batch.mask}
     if combined:
         fetch.update(column_fetch())
-    host = jax.device_get(fetch)
+    host = jax.device_get(fetch)  # lint: allow-host-sync
     mask = host["__mask"]
     keep = np.flatnonzero(mask)
     if keep.size == 0:
@@ -315,12 +315,12 @@ def batch_to_page(batch: Batch, names, types) -> Page:
             bucket = _bucket_for(keep.size) \
                 or 1 << int(keep.size - 1).bit_length()
             batch = _jit_compact(batch, bucket)
-            host = jax.device_get({"__mask": batch.mask,
+            host = jax.device_get({"__mask": batch.mask,  # lint: allow-host-sync
                                    **column_fetch()})
             mask = host["__mask"]
             keep = np.flatnonzero(mask)
         else:
-            host.update(jax.device_get(column_fetch()))
+            host.update(jax.device_get(column_fetch()))  # lint: allow-host-sync
     blocks = []
     for name, typ in zip(names, types):
         col = batch.columns[name]

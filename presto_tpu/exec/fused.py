@@ -117,7 +117,7 @@ def _build_has_null_key(batch: Batch, key_names: Tuple[str, ...]) -> bool:
         c = batch.columns[k]
         if c.nulls is not None:
             m = m | jnp.any(batch.mask & c.nulls)
-    return bool(jax.device_get(m))
+    return bool(jax.device_get(m))  # lint: allow-host-sync
 
 
 def _drop_null_keys(batch: Batch, key_names: Tuple[str, ...]) -> Batch:
@@ -431,7 +431,7 @@ def try_direct_table(batch: Batch, key: str,
     col = batch.columns[key]
     if col.values.dtype not in (jnp.int64, jnp.int32, jnp.int16):
         return None
-    vmin, vmax, live = jax.device_get(_key_stats(col.values, batch.mask))
+    vmin, vmax, live = jax.device_get(_key_stats(col.values, batch.mask))  # lint: allow-host-sync
     span = int(vmax) - int(vmin) + 1
     if not (int(live) > 0 and span <= DIRECT_TABLE_MAX
             and span <= max(1024, DIRECT_TABLE_SPAN_RATIO * int(live))):
@@ -439,7 +439,7 @@ def try_direct_table(batch: Batch, key: str,
     size = 1 << (span - 1).bit_length()
     slots, dup = _direct_builder(size)(col.values, batch.mask,
                                        jnp.int64(int(vmin)))
-    if not allow_dup and bool(jax.device_get(dup)):
+    if not allow_dup and bool(jax.device_get(dup)):  # lint: allow-host-sync
         return None
     return DirectTable(slots, jnp.int64(int(vmin)), dict(batch.columns))
 
@@ -466,7 +466,7 @@ def build_lookup(compiler, build_node: P.PlanNode, keys: Tuple[str, ...],
     table = _jits()[1](batch, keys)
     if not for_join:
         return table, 1, had_null
-    kmax = int(jax.device_get(_max_run(table)))
+    kmax = int(jax.device_get(_max_run(table)))  # lint: allow-host-sync
     if kmax <= 1:
         return table, 1, False
     if kmax > MAX_EXPAND:

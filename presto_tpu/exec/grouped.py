@@ -239,7 +239,7 @@ class GroupedRunner:
 
     @staticmethod
     def _check_dups(dup_flags) -> None:
-        if dup_flags and any(bool(d) for d in jax.device_get(dup_flags)):
+        if dup_flags and any(bool(d) for d in jax.device_get(dup_flags)):  # lint: allow-host-sync
             # a bucketed build's key multiplicity exceeds what the shared
             # program reserved for this bucket (duplicates against a
             # direct table, or a run longer than the fanout-k expansion):
@@ -448,7 +448,7 @@ def make_grouped_runner(compiler, node, chain, key_names, specs,
             continue
         b0 = _drop_null_keys(b0, (bkey,))
         from .pipeline import _jits
-        kmax = int(jax.device_get(_max_run(_jits()[1](b0, (bkey,)))))
+        kmax = int(jax.device_get(_max_run(_jits()[1](b0, (bkey,)))))  # lint: allow-host-sync
         if kmax > MAX_EXPAND:
             continue                    # too wide to reserve: replicate
         fanouts[si] = 1 if kmax <= 1 else 1 << (kmax - 1).bit_length()
@@ -481,7 +481,7 @@ def make_grouped_runner(compiler, node, chain, key_names, specs,
         aux0, dups0 = runner._bucket_aux(layout[0])
     except NotImplementedError:
         return None
-    if dups0 and any(bool(d) for d in jax.device_get(dups0)):
+    if dups0 and any(bool(d) for d in jax.device_get(dups0)):  # lint: allow-host-sync
         return None     # non-unique bucketed build key: single lifespan
     runner._aux0 = (aux0, dups0)
     try:

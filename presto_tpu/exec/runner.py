@@ -52,9 +52,16 @@ class LocalQueryRunner:
         # the compiled pipeline so its jitted steps stay warm
         self._plan_cache: Dict[str, tuple] = {}
 
+    def _validation(self):
+        """Scope plan validation (presto_tpu/analysis) to this runner's
+        configured mode for the duration of a planning call."""
+        from ..analysis import use_validation_mode
+        return use_validation_mode(self.config.plan_validation)
+
     def plan(self, sql: str):
-        return Planner(default_schema=self.schema,
-                       default_catalog=self.catalog).plan(sql)
+        with self._validation():
+            return Planner(default_schema=self.schema,
+                           default_catalog=self.catalog).plan(sql)
 
     _PLAN_CACHE_MAX = 64
 
@@ -63,7 +70,7 @@ class LocalQueryRunner:
         fresh; callers re-insert via _recache after a successful run."""
         entry = self._plan_cache.pop(sql, None)
         if entry is None:
-            with stats.record_wall("queryPlan"):
+            with stats.record_wall("queryPlan"), self._validation():
                 output = Planner(default_schema=self.schema,
                                  default_catalog=self.catalog) \
                     .plan_query_to_output(ast)
@@ -172,8 +179,9 @@ class LocalQueryRunner:
             # generated table of the same name does not shadow the target
             if any(ast.table in cat.module(cid).SCHEMAS for cid in writable):
                 return QueryResult(["rows"], [BIGINT], [[0]])
-        output = Planner(default_schema=self.schema,
-                         default_catalog=self.catalog).plan_write(ast)
+        with self._validation():
+            output = Planner(default_schema=self.schema,
+                             default_catalog=self.catalog).plan_write(ast)
         compiler = PlanCompiler(TaskContext(config=self.config))
         names = output.column_names
         types = [v.type for v in output.outputs]
@@ -184,12 +192,16 @@ class LocalQueryRunner:
     def _explain(self, ast) -> QueryResult:
         """EXPLAIN: plan text.  EXPLAIN ANALYZE: execute with per-node
         instrumentation and annotate the plan (reference PlanPrinter /
-        ExplainAnalyzeOperator)."""
+        ExplainAnalyzeOperator).  EXPLAIN (TYPE VALIDATE): run the plan
+        checker at every stage and print the diagnostic list."""
         from ..common.types import VarcharType
         from ..sql.explain import format_plan
-        output = Planner(default_schema=self.schema,
-                         default_catalog=self.catalog) \
-            .plan_query_to_output(ast.query)
+        if ast.explain_type == "VALIDATE":
+            return self._explain_validate(ast)
+        with self._validation():
+            output = Planner(default_schema=self.schema,
+                             default_catalog=self.catalog) \
+                .plan_query_to_output(ast.query)
         stats = None
         if ast.analyze:
             stats = {}
@@ -198,6 +210,40 @@ class LocalQueryRunner:
             for _page in compiler.run_to_pages(output):
                 pass
         text = format_plan(output, stats)
+        return QueryResult(["Query Plan"], [VarcharType(max(1, len(text)))],
+                           [[text]])
+
+    def _fragmenter_config(self):
+        from ..sql.fragmenter import FragmenterConfig
+        return FragmenterConfig()
+
+    def _explain_validate(self, ast) -> QueryResult:
+        """EXPLAIN (TYPE VALIDATE): run every checker stage (post-plan,
+        post-optimize, post-fragment) with fail-fast raising DISABLED so
+        the full diagnostic list is reported instead of the first error —
+        the debugging surface for a plan the validator rejects."""
+        from ..analysis import (VALIDATION_OFF, check_plan, check_subplan,
+                                use_validation_mode)
+        from ..common.types import VarcharType
+        from ..sql.explain import format_validation
+        from ..sql.fragmenter import plan_distributed
+        from ..sql.optimizer import optimize
+        from ..spi import plan as P
+        planner = Planner(default_schema=self.schema,
+                          default_catalog=self.catalog)
+        with use_validation_mode(VALIDATION_OFF):
+            node, names, out_vars = planner.plan_query_any(ast.query)
+            out = P.OutputNode(planner.new_id("output"), node, names,
+                               out_vars)
+            sections = [("post-plan", check_plan(out, "post-plan"))]
+            out = optimize(out)
+            sections.append(("post-optimize",
+                             check_plan(out, "post-optimize")))
+            subplan = plan_distributed(out, self._fragmenter_config())
+            sections.append(("post-fragment",
+                             check_subplan(subplan, "post-fragment",
+                                           exec_config=self.config)))
+        text = format_validation(sections)
         return QueryResult(["Query Plan"], [VarcharType(max(1, len(text)))],
                            [[text]])
 
@@ -234,17 +280,24 @@ class DistributedQueryRunner(LocalQueryRunner):
         self.mesh = mesh
 
     def plan_subplan(self, sql: str, ast=None):
-        from ..sql.fragmenter import FragmenterConfig, plan_distributed
-        if ast is not None:
-            output = Planner(default_schema=self.schema,
-                             default_catalog=self.catalog) \
-                .plan_query_to_output(ast)
-        else:
-            output = self.plan(sql)
-        names = output.column_names
-        types = [v.type for v in output.outputs]
-        cfg = FragmenterConfig(broadcast_threshold=self.broadcast_threshold)
-        return plan_distributed(output, cfg), names, types
+        from ..sql.fragmenter import plan_distributed
+        with self._validation():
+            if ast is not None:
+                output = Planner(default_schema=self.schema,
+                                 default_catalog=self.catalog) \
+                    .plan_query_to_output(ast)
+            else:
+                output = self.plan(sql)
+            names = output.column_names
+            types = [v.type for v in output.outputs]
+            subplan = plan_distributed(output, self._fragmenter_config(),
+                                       exec_config=self.config)
+        return subplan, names, types
+
+    def _fragmenter_config(self):
+        from ..sql.fragmenter import FragmenterConfig
+        return FragmenterConfig(
+            broadcast_threshold=self.broadcast_threshold)
 
     def _explain_distributed(self, ast) -> QueryResult:
         """EXPLAIN over the fragmented (distributed) plan — the analog of
@@ -252,12 +305,15 @@ class DistributedQueryRunner(LocalQueryRunner):
         the fragment text (per-task stats are not merged)."""
         from ..common.types import VarcharType
         from ..sql.explain import format_subplan
-        from ..sql.fragmenter import FragmenterConfig, plan_distributed
-        output = Planner(default_schema=self.schema,
-                         default_catalog=self.catalog) \
-            .plan_query_to_output(ast.query)
-        cfg = FragmenterConfig(broadcast_threshold=self.broadcast_threshold)
-        subplan = plan_distributed(output, cfg)
+        from ..sql.fragmenter import plan_distributed
+        if ast.explain_type == "VALIDATE":
+            return self._explain_validate(ast)
+        with self._validation():
+            output = Planner(default_schema=self.schema,
+                             default_catalog=self.catalog) \
+                .plan_query_to_output(ast.query)
+            subplan = plan_distributed(output, self._fragmenter_config(),
+                                       exec_config=self.config)
         text = format_subplan(subplan)
         return QueryResult(["Query Plan"], [VarcharType(max(1, len(text)))],
                            [[text]])

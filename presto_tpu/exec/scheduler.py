@@ -500,7 +500,7 @@ class InProcessScheduler:
         devices = list(mesh.devices.flat)
         n = stage.n_tasks
 
-        lives = [0 if b is None else int(jax.device_get(b.mask.sum()))
+        lives = [0 if b is None else int(jax.device_get(b.mask.sum()))  # lint: allow-host-sync
                  for b in task_batches]
         template = next((b for b in task_batches if b is not None), None)
         if template is None:
@@ -557,7 +557,7 @@ class InProcessScheduler:
                 exch = make_partitioned_exchange(mesh, keys, quota)
                 self._exch_cache[key] = exch
             out, overflow = exch(gbatch)
-            if not bool(jax.device_get(overflow)):
+            if not bool(jax.device_get(overflow)):  # lint: allow-host-sync
                 break
             if quota >= B:
                 raise RuntimeError("ICI exchange overflow at full quota")

@@ -957,8 +957,11 @@ def structural_key(node: PlanNode) -> str:
                 if k == "id":
                     out[k] = ""
                 elif k == "dynamicFilters" and isinstance(v, dict):
-                    # ids are planner counters; values are variable names
-                    out[k] = sorted(rename.get(n, n) for n in v.values())
+                    # keys are probe variable names (renamed like any other
+                    # variable); values are planner-counter filter ids,
+                    # blanked like node ids — two decorrelated copies
+                    # differing only in filter numbering are the same plan
+                    out[k] = sorted(rename.get(n, n) for n in v)
                 else:
                     out[k] = canon(v)
             return out

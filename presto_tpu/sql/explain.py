@@ -134,6 +134,26 @@ def format_plan(node: P.PlanNode,
     return "\n".join(lines)
 
 
+def format_validation(diags_by_stage) -> str:
+    """EXPLAIN (TYPE VALIDATE) body: one section per checker stage with
+    its diagnostic list, "PASSED" for clean stages (the reference's
+    VALIDATE explain prints nothing on success; listing each stage shows
+    WHICH passes ran)."""
+    lines: List[str] = []
+    total = 0
+    for stage, diags in diags_by_stage:
+        lines.append(f"== {stage} ==")
+        if not diags:
+            lines.append("PASSED")
+        else:
+            total += len(diags)
+            lines.extend(f"  {d}" for d in diags)
+        lines.append("")
+    lines.append(f"{total} diagnostic(s)"
+                 if total else "plan validation PASSED")
+    return "\n".join(lines)
+
+
 def format_subplan(subplan, stats: Optional[Dict[str, dict]] = None) -> str:
     """Fragmented (distributed) plan: one section per fragment."""
     lines: List[str] = []

@@ -496,11 +496,26 @@ class Fragmenter:
             node.exchange_sources = [
                 self._rewrite(s, children, props)
                 for s in node.exchange_sources]
+        if isinstance(node, P.UnionNode):
+            # branches carry their own REMOTE gathers (ExchangeInserter
+            # _visit_UnionNode); skipping them left whole distributed
+            # branches — scans included — inlined in the consuming
+            # fragment (caught by the FRAGMENT_BOUNDARY checker)
+            node.inputs = [self._rewrite(s, children, props)
+                           for s in node.inputs]
         return node
 
 
 def plan_distributed(root: P.OutputNode,
-                     config: Optional[FragmenterConfig] = None) -> P.SubPlan:
-    """Full distribution pipeline: exchange insertion then fragmentation."""
+                     config: Optional[FragmenterConfig] = None,
+                     exec_config=None) -> P.SubPlan:
+    """Full distribution pipeline: exchange insertion then fragmentation,
+    then the final sanity pass (per-fragment tree checks + fragment
+    boundary / partitioning / grouped-execution checks).  `exec_config`
+    feeds the grouped-execution eligibility predicate; None uses the
+    default ExecutionConfig."""
     rewritten = ExchangeInserter(config).rewrite(root)
-    return Fragmenter().fragment(rewritten)
+    sub = Fragmenter().fragment(rewritten)
+    from ..analysis import validate_subplan
+    validate_subplan(sub, "post-fragment", exec_config=exec_config)
+    return sub

@@ -295,10 +295,13 @@ class Query(Node):
 
 @dataclass
 class Explain(Node):
-    """EXPLAIN [ANALYZE] <query> (reference sql/tree/Explain.java; text
-    format only)."""
+    """EXPLAIN [ANALYZE] [(TYPE t)] <query> (reference sql/tree/Explain.java
+    + ExplainType.java; text format only).  explain_type is "" for plain
+    EXPLAIN; "VALIDATE" prints the plan-checker diagnostic list instead of
+    the plan (presto_tpu/analysis)."""
     query: Node                            # Query | SetOp
     analyze: bool = False
+    explain_type: str = ""                 # "" | VALIDATE | LOGICAL | DISTRIBUTED
 
 
 @dataclass
@@ -400,10 +403,25 @@ class Parser:
         word = self._peek_word()
         if word == "explain":
             self.next()
+            explain_type = ""
+            if self.accept("op", "("):
+                # EXPLAIN ( TYPE t ) — reference ExplainType.java options
+                self._expect_word("type")
+                t = self.next()
+                if t.kind not in ("ident", "keyword"):
+                    raise SyntaxError(
+                        f"expected explain type, got {t.value!r} at {t.pos}")
+                explain_type = t.value.upper()
+                if explain_type not in ("LOGICAL", "DISTRIBUTED",
+                                        "VALIDATE"):
+                    raise SyntaxError(
+                        f"unsupported explain type {explain_type!r} "
+                        f"(LOGICAL | DISTRIBUTED | VALIDATE)")
+                self.expect("op", ")")
             analyze = self._peek_word() == "analyze"
             if analyze:
                 self.next()
-            q = Explain(self.parse_query(), analyze)
+            q = Explain(self.parse_query(), analyze, explain_type)
         elif word == "create":
             self.next()
             self._expect_word("table")

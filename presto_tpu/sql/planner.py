@@ -101,8 +101,15 @@ class Planner:
     def plan_query_to_output(self, query) -> P.OutputNode:
         node, names, out_vars = self.plan_query_any(query)
         out = P.OutputNode(self.new_id("output"), node, names, out_vars)
+        # sanity gates around the optimizer (the reference PlanChecker's
+        # intermediate passes); mode comes from the plan_validation
+        # session property via the analysis thread-local
+        from ..analysis import validate_plan
+        validate_plan(out, "post-plan")
         from .optimizer import optimize
-        return optimize(out)
+        out = optimize(out)
+        validate_plan(out, "post-optimize")
+        return out
 
     def plan_write(self, ast) -> P.OutputNode:
         """CREATE TABLE AS / INSERT INTO -> TableWriter + TableFinish plan

@@ -119,6 +119,13 @@ class IterativeOptimizer:
         ctx = RuleContext()
         budget = [EXPLORATION_BUDGET]
         stats = stats if stats is not None else {}
+        # plan_validation=strict: validate the replacement subtree after
+        # every firing so a violation is attributed to the rule that
+        # introduced it (the whole tree is mid-rewrite bottom-up, so only
+        # the subtree is consistent here; parent-level breakage is caught
+        # by the post-optimize pass)
+        from ..analysis import VALIDATION_STRICT, validation_mode
+        strict = validation_mode() == VALIDATION_STRICT
 
         def explore(node: P.PlanNode) -> P.PlanNode:
             for s in list(node.sources):
@@ -133,6 +140,9 @@ class IterativeOptimizer:
                     if out is not None and out is not node:
                         budget[0] -= 1
                         stats[rule.name] = stats.get(rule.name, 0) + 1
+                        if strict:
+                            from ..analysis import validate_plan
+                            validate_plan(out, f"rule:{rule.name}")
                         node = explore(out)
                         progress = True
                         break

@@ -612,7 +612,9 @@ class HttpQueryRunner(LocalQueryRunner):
         names = output.column_names
         types = [v.type for v in output.outputs]
         cfg = FragmenterConfig(broadcast_threshold=self.broadcast_threshold)
-        return plan_distributed(output, cfg), names, types
+        with self._validation():
+            sub = plan_distributed(output, cfg, exec_config=self.config)
+        return sub, names, types
 
     def _build_stages(self, subplan: P.SubPlan,
                       stage_path: str = "0") -> _Stage:

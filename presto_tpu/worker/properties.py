@@ -107,6 +107,14 @@ def execution_config_from_properties(props: Dict[str, str],
                 f"task.fault-injection-probability must be in [0, 1], "
                 f"got {p}")
         kw["fault_injection_probability"] = p
+    if "task.plan-validation" in props:
+        mode = props["task.plan-validation"].strip().lower()
+        from ..analysis import VALIDATION_MODES
+        if mode not in VALIDATION_MODES:
+            raise ValueError(
+                f"task.plan-validation must be one of {VALIDATION_MODES}, "
+                f"got {mode!r}")
+        kw["plan_validation"] = mode
     return dataclasses.replace(cfg, **kw) if kw else cfg
 
 
@@ -151,6 +159,7 @@ class SystemConfig:
         ("task.grouped-lifespan-sharding", bool, True),
         ("task.remote-task-retry-attempts", int, 2),
         ("task.fault-injection-probability", float, 0.0),
+        ("task.plan-validation", str, "on"),
         ("shutdown-onset-sec", int, 10),
         ("system-memory-gb", int, 16),               # HBM per chip
         ("system-mem-limit-gb", int, 16),

@@ -315,4 +315,14 @@ def apply_session_properties(config, session: Dict[str, str]):
             raise ValueError(
                 f"fault_injection_probability must be in [0, 1], got {p}")
         kw["fault_injection_probability"] = p
+    if "plan_validation" in session:
+        mode = str(session["plan_validation"]).strip().lower()
+        from ..analysis import VALIDATION_MODES
+        if mode not in VALIDATION_MODES:
+            # reject at task creation like a bad codec: a clear USER_ERROR
+            # beats a silent fall-through to the default mode
+            raise ValueError(
+                f"plan_validation must be one of {VALIDATION_MODES}, "
+                f"got {mode!r}")
+        kw["plan_validation"] = mode
     return dataclasses.replace(config, **kw) if kw else config

@@ -1,0 +1,369 @@
+"""PlanCheck conformance: every check code fires on a hand-built broken
+plan, and no check fires on any TPC-H / TPC-DS suite plan at any of the
+three wired stages (post-plan, post-optimize, post-fragment).
+
+Reference: sql/planner/sanity/PlanChecker.java and its checker suite
+(ValidateDependenciesChecker, NoDuplicatePlanNodeIdsChecker,
+TypeValidator) — the point of the tests is the same as the reference's
+TestValidateDependenciesChecker etc.: a checker that never fires is
+indistinguishable from no checker.
+"""
+import pytest
+
+from presto_tpu.analysis import (CHECK_DANGLING_VARIABLE,
+                                 CHECK_DUPLICATE_NODE_ID,
+                                 CHECK_EXCHANGE_LAYOUT,
+                                 CHECK_FRAGMENT_BOUNDARY,
+                                 CHECK_GROUPED_EXECUTION,
+                                 CHECK_JOIN_KEY_TYPE, CHECK_PARTITIONING,
+                                 CHECK_TYPE_MISMATCH, VALIDATION_OFF,
+                                 check_plan, check_subplan,
+                                 use_validation_mode, validate_plan,
+                                 validation_mode)
+from presto_tpu.benchmarks.tpch_queries import ALL as TPCH_QUERIES
+from presto_tpu.common.errors import (PLAN_VALIDATION, PlanValidationError,
+                                      is_retryable, is_retryable_type,
+                                      parse_error_type)
+from presto_tpu.common.types import (BigintType, BooleanType, DoubleType,
+                                     VarcharType)
+from presto_tpu.spi import plan as P
+from presto_tpu.spi.expr import ConstantExpression
+from presto_tpu.spi.expr import VariableReferenceExpression as V
+from presto_tpu.sql.fragmenter import plan_distributed
+from presto_tpu.sql.planner import Planner
+
+from test_tpcds_queries import QUERIES as TPCDS_QUERIES
+
+BIGINT = BigintType()
+DOUBLE = DoubleType()
+BOOLEAN = BooleanType()
+VARCHAR = VarcharType()
+
+
+def _values(nid, **cols):
+    return P.ValuesNode(nid, [V(n, t) for n, t in cols.items()])
+
+
+def _codes(diags):
+    return {d.code for d in diags}
+
+
+# ---------------------------------------------------------------------------
+# one intentional violation per check code
+# ---------------------------------------------------------------------------
+
+def test_clean_plan_has_no_diagnostics():
+    vals = _values("v0", a=BIGINT)
+    out = P.OutputNode("o0", vals, ["a"], [V("a", BIGINT)])
+    assert check_plan(out) == []
+
+
+def test_dangling_variable_fires():
+    vals = _values("v0", a=BIGINT)
+    proj = P.ProjectNode("p0", vals, {V("x", BIGINT): V("ghost", BIGINT)})
+    diags = check_plan(proj)
+    assert CHECK_DANGLING_VARIABLE in _codes(diags)
+    assert any("ghost" in d.message for d in diags)
+
+
+def test_duplicate_node_id_fires_on_structurally_different_nodes():
+    vals = _values("n1", a=BOOLEAN)
+    filt = P.FilterNode("n1", vals, V("a", BOOLEAN))
+    diags = check_plan(filt)
+    assert CHECK_DUPLICATE_NODE_ID in _codes(diags)
+
+
+def test_duplicate_node_id_allows_structurally_identical_copies():
+    """Decorrelated deep copies deliberately share plan-node ids (the
+    pipeline compiler memoizes per id); only structurally DIFFERENT
+    nodes sharing an id are a bug."""
+    left = _values("shared", a=BIGINT)
+    right = _values("shared", a=BIGINT)
+    union = P.UnionNode("u0", [left, right], [V("a", BIGINT)])
+    assert check_plan(union) == []
+
+
+def test_structural_key_ignores_dynamic_filter_ids():
+    """Regression for the duplicate-id false positives on TPC-H q21 /
+    TPC-DS q16: plan_dynamic_filters numbers filter ids per join
+    INSTANCE after rule-driven deep copies, so two decorrelated copies
+    differ only in `df_N_i` bookkeeping.  structural_key must blank the
+    ids (like node ids) while canonicalizing the probe-column names."""
+    def join(df):
+        return P.JoinNode(
+            "j0", P.INNER, _values("l0", a=BIGINT), _values("r0", b=BIGINT),
+            [(V("a", BIGINT), V("b", BIGINT))], [V("a", BIGINT)],
+            dynamic_filters=df)
+
+    assert (P.structural_key(join({"a": "df_3_0"}))
+            == P.structural_key(join({"a": "df_11_0"})))
+    # but a different probe COLUMN is a different plan
+    j2 = P.JoinNode(
+        "j0", P.INNER, _values("l0", a=BIGINT, c=BIGINT),
+        _values("r0", b=BIGINT), [(V("a", BIGINT), V("b", BIGINT))],
+        [V("a", BIGINT)], dynamic_filters={"c": "df_3_0"})
+    assert P.structural_key(join({"a": "df_3_0"})) != P.structural_key(j2)
+
+
+def test_type_mismatch_fires():
+    vals = _values("v0", a=BIGINT)
+    proj = P.ProjectNode(
+        "p0", vals, {V("x", VARCHAR): ConstantExpression(1, BIGINT)})
+    diags = check_plan(proj)
+    assert CHECK_TYPE_MISMATCH in _codes(diags)
+
+
+def test_filter_predicate_must_be_boolean():
+    vals = _values("v0", a=BIGINT)
+    filt = P.FilterNode("f0", vals, V("a", BIGINT))
+    assert CHECK_TYPE_MISMATCH in _codes(check_plan(filt))
+
+
+def test_join_key_type_fires():
+    join = P.JoinNode(
+        "j0", P.INNER, _values("l0", a=BIGINT), _values("r0", b=VARCHAR),
+        [(V("a", BIGINT), V("b", VARCHAR))], [V("a", BIGINT)])
+    assert CHECK_JOIN_KEY_TYPE in _codes(check_plan(join))
+
+
+def test_int_family_widening_is_compatible():
+    """bigint vs integer keys are layout-compatible, not a diagnostic."""
+    from presto_tpu.common.types import IntegerType
+    join = P.JoinNode(
+        "j0", P.INNER, _values("l0", a=BIGINT),
+        _values("r0", b=IntegerType()),
+        [(V("a", BIGINT), V("b", IntegerType()))], [V("a", BIGINT)])
+    assert check_plan(join) == []
+
+
+def test_exchange_layout_fires_on_union_branch_drift():
+    union = P.UnionNode(
+        "u0", [_values("v0", a=BIGINT), _values("v1", b=BIGINT)],
+        [V("a", BIGINT)])
+    diags = check_plan(union)
+    assert CHECK_EXCHANGE_LAYOUT in _codes(diags)
+
+
+def test_exchange_layout_fires_on_column_type_drift():
+    src = _values("v0", a=VARCHAR)
+    ex = P.ExchangeNode(
+        "e0", P.GATHER, P.LOCAL,
+        P.PartitioningScheme(P.SINGLE_DISTRIBUTION, [], [V("x", BIGINT)]),
+        [src], [[V("a", VARCHAR)]])
+    assert CHECK_EXCHANGE_LAYOUT in _codes(check_plan(ex))
+
+
+def test_partitioning_fires_on_ungrounded_hash_column():
+    src = _values("v0", a=BIGINT)
+    ex = P.ExchangeNode(
+        "e0", P.REPARTITION, P.LOCAL,
+        P.PartitioningScheme(P.FIXED_HASH_DISTRIBUTION,
+                             [V("ghost", BIGINT)], [V("a", BIGINT)]),
+        [src], [[V("a", BIGINT)]])
+    assert CHECK_PARTITIONING in _codes(check_plan(ex))
+
+
+def test_partitioning_fires_on_hash_without_columns():
+    src = _values("v0", a=BIGINT)
+    ex = P.ExchangeNode(
+        "e0", P.REPARTITION, P.LOCAL,
+        P.PartitioningScheme(P.FIXED_HASH_DISTRIBUTION, [],
+                             [V("a", BIGINT)]),
+        [src], [[V("a", BIGINT)]])
+    assert CHECK_PARTITIONING in _codes(check_plan(ex))
+
+
+def _single_fragment(fid, root, layout):
+    return P.PlanFragment(
+        fid, root, P.SINGLE_DISTRIBUTION,
+        P.PartitioningScheme(P.SINGLE_DISTRIBUTION, [], layout))
+
+
+def test_fragment_boundary_fires_on_unknown_fragment():
+    remote = P.RemoteSourceNode("r0", ["99"], [V("a", BIGINT)])
+    sub = P.SubPlan(_single_fragment("0", remote, [V("a", BIGINT)]), [])
+    assert CHECK_FRAGMENT_BOUNDARY in _codes(check_subplan(sub))
+
+
+def test_fragment_boundary_fires_on_column_order_drift():
+    child_root = _values("v0", a=BIGINT, b=BIGINT)
+    child = P.SubPlan(_single_fragment(
+        "1", child_root, [V("b", BIGINT), V("a", BIGINT)]), [])
+    remote = P.RemoteSourceNode(
+        "r0", ["1"], [V("a", BIGINT), V("b", BIGINT)])
+    sub = P.SubPlan(_single_fragment("0", remote, [V("a", BIGINT)]),
+                    [child])
+    diags = check_subplan(sub)
+    assert CHECK_FRAGMENT_BOUNDARY in _codes(diags)
+    assert any("drift" in d.message for d in diags)
+
+
+def test_fragment_boundary_fires_on_unconsumed_child():
+    child = P.SubPlan(_single_fragment(
+        "1", _values("v0", a=BIGINT), [V("a", BIGINT)]), [])
+    root = _values("v1", b=BIGINT)
+    sub = P.SubPlan(_single_fragment("0", root, [V("b", BIGINT)]), [child])
+    diags = check_subplan(sub)
+    assert CHECK_FRAGMENT_BOUNDARY in _codes(diags)
+    assert any("no consuming" in d.message for d in diags)
+
+
+def test_grouped_execution_fires_on_corrupted_fragment():
+    """Plan a genuinely grouped-eligible stage, then corrupt the
+    fragment's distribution: the claim (stage_shards_lifespans) no longer
+    matches the fragment the scheduler would run."""
+    from presto_tpu.exec.pipeline import ExecutionConfig
+    cfg = ExecutionConfig(grouped_lifespans=4)
+    root = Planner("sf0.01", "tpch").plan(
+        "SELECT l_orderkey, count(*) FROM lineitem GROUP BY l_orderkey")
+    sub = plan_distributed(root, exec_config=cfg)
+    from presto_tpu.exec.grouped import stage_shards_lifespans
+    eligible = [sp for sp in _walk_subplans(sub)
+                if stage_shards_lifespans(sp.fragment.root, cfg)]
+    assert eligible, "fixture query must be grouped-eligible"
+    assert check_subplan(sub, exec_config=cfg) == []
+    eligible[0].fragment.partitioning = P.SINGLE_DISTRIBUTION
+    eligible[0].fragment.partitioned_sources = []
+    diags = check_subplan(sub, exec_config=cfg)
+    assert CHECK_GROUPED_EXECUTION in _codes(diags)
+    # and PARTITIONING notices the scan stranded in a SINGLE fragment
+    assert CHECK_PARTITIONING in _codes(diags)
+
+
+def _walk_subplans(sp):
+    yield sp
+    for c in sp.children:
+        yield from _walk_subplans(c)
+
+
+def test_union_branches_are_fragmented():
+    """Regression for the FRAGMENT_BOUNDARY violation the checker caught
+    on distributed set operations: Fragmenter._rewrite skipped
+    UnionNode.inputs, so the REMOTE gathers the ExchangeInserter puts
+    under each distributed branch survived fragmentation and the whole
+    union — scans included — ran inlined in the consuming fragment."""
+    from presto_tpu.sql.fragmenter import FragmenterConfig
+    root = Planner("sf0.01", "tpch").plan(
+        "SELECT o_orderstatus FROM orders "
+        "UNION ALL SELECT o_orderpriority FROM orders")
+    sub = plan_distributed(root, FragmenterConfig())
+    assert check_subplan(sub) == []
+    frags = sub.all_fragments()
+    assert len(frags) >= 3  # consumer + one SOURCE fragment per branch
+    for node in P.walk_plan(sub.fragment.root):
+        assert not (isinstance(node, P.ExchangeNode)
+                    and node.scope == P.REMOTE)
+
+
+# ---------------------------------------------------------------------------
+# modes, error taxonomy, wiring surfaces
+# ---------------------------------------------------------------------------
+
+def _broken_plan():
+    vals = _values("v0", a=BIGINT)
+    return P.ProjectNode("p0", vals, {V("x", BIGINT): V("ghost", BIGINT)})
+
+
+def test_validate_plan_raises_plan_validation_error():
+    with pytest.raises(PlanValidationError) as ei:
+        validate_plan(_broken_plan(), "post-plan")
+    assert ei.value.diagnostics
+    assert "[PLAN_VALIDATION]" in str(ei.value)
+
+
+def test_validation_mode_off_silences():
+    with use_validation_mode(VALIDATION_OFF):
+        assert validation_mode() == VALIDATION_OFF
+        validate_plan(_broken_plan(), "post-plan")  # no raise
+    assert validation_mode() == "on"
+
+
+def test_validation_mode_rejects_unknown():
+    with pytest.raises(ValueError):
+        with use_validation_mode("loud"):
+            pass
+
+
+def test_plan_validation_is_not_retryable():
+    """Satellite: a malformed plan re-plans identically on retry, so the
+    dispatcher's retry gate must fail fast (contrast EXTERNAL)."""
+    assert not is_retryable_type(PLAN_VALIDATION)
+    assert not is_retryable(PlanValidationError("bad plan"))
+    # the tag survives string-typed failure chains across the HTTP hop
+    assert parse_error_type(
+        "task q.0.0 failed [PLAN_VALIDATION]: bad plan") == PLAN_VALIDATION
+
+
+def test_strict_mode_validates_each_rule_firing():
+    """strict validates the replacement subtree after every iterative
+    rule firing; a healthy plan passes all of them."""
+    with use_validation_mode("strict"):
+        root = Planner("sf0.01", "tpch").plan(TPCH_QUERIES[3])
+    assert check_plan(root) == []
+
+
+def test_session_property_controls_validation():
+    from presto_tpu.exec.pipeline import ExecutionConfig
+    from presto_tpu.worker.protocol import apply_session_properties
+    cfg = apply_session_properties(ExecutionConfig(),
+                                   {"plan_validation": "strict"})
+    assert cfg.plan_validation == "strict"
+    with pytest.raises(ValueError):
+        apply_session_properties(ExecutionConfig(),
+                                 {"plan_validation": "shouty"})
+
+
+def test_config_property_controls_validation():
+    from presto_tpu.worker.properties import execution_config_from_properties
+    cfg = execution_config_from_properties({"task.plan-validation": "off"})
+    assert cfg.plan_validation == "off"
+    with pytest.raises(ValueError):
+        execution_config_from_properties({"task.plan-validation": "nope"})
+
+
+def test_explain_type_validate_surface():
+    from presto_tpu.exec.runner import LocalQueryRunner
+    r = LocalQueryRunner("sf0.01")
+    res = r.execute("EXPLAIN (TYPE VALIDATE) "
+                    "SELECT count(*) FROM lineitem WHERE l_quantity < 10")
+    text = res.rows[0][0]
+    for stage in ("post-plan", "post-optimize", "post-fragment"):
+        assert f"== {stage} ==" in text
+    assert "plan validation PASSED" in text
+
+
+def test_explain_type_validate_rejects_bad_type():
+    from presto_tpu.sql.parser import parse_sql
+    with pytest.raises(Exception):
+        parse_sql("EXPLAIN (TYPE SIDEWAYS) SELECT 1")
+
+
+# ---------------------------------------------------------------------------
+# suite conformance: zero diagnostics at all three stages
+# ---------------------------------------------------------------------------
+
+def _assert_all_stages_clean(sql, schema, catalog):
+    planner = Planner(schema, catalog)
+    from presto_tpu.sql.optimizer import optimize
+    import presto_tpu.sql.parser as A
+    node, names, out_vars = planner.plan_query_any(A.parse_sql(sql))
+    out = P.OutputNode(planner.new_id("output"), node, names, out_vars)
+    for stage, root in (("post-plan", out), ("post-optimize", None)):
+        if root is None:
+            out = optimize(out)
+            root = out
+        diags = check_plan(root, stage)
+        assert diags == [], "\n".join(str(d) for d in diags)
+    sub = plan_distributed(out)
+    diags = check_subplan(sub, "post-fragment")
+    assert diags == [], "\n".join(str(d) for d in diags)
+
+
+@pytest.mark.parametrize("qid", sorted(TPCH_QUERIES))
+def test_tpch_suite_plans_validate(qid):
+    _assert_all_stages_clean(TPCH_QUERIES[qid], "sf0.01", "tpch")
+
+
+@pytest.mark.parametrize("qid", sorted(TPCDS_QUERIES))
+def test_tpcds_suite_plans_validate(qid):
+    _assert_all_stages_clean(TPCDS_QUERIES[qid], "sf0.01", "tpcds")

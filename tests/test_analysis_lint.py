@@ -1,0 +1,196 @@
+"""Host-sync lint conformance (tier-1): the shipped tree is clean — every
+device->host transfer is an acknowledged, pragma'd sync point — and each
+hazard shape is detected on a fixture.
+
+The lint is the second prong of the PlanCheck work: plan validation
+catches the coordinator inserting a malformed stage; this catches the
+executor silently serialising the pipeline with an implicit transfer.
+"""
+import os
+import subprocess
+import sys
+
+import pytest
+
+from presto_tpu.analysis.lint import (ALL_LINT_CODES, PRAGMA, SYNC_ASARRAY,
+                                      SYNC_BRANCH, SYNC_CAST, SYNC_EXPLICIT,
+                                      lint_or_raise, lint_paths, lint_source)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _codes(findings):
+    return {f.code for f in findings}
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 gate: shipped tree is clean
+# ---------------------------------------------------------------------------
+
+def test_shipped_tree_is_clean():
+    findings = lint_paths([os.path.join(REPO, "presto_tpu")])
+    assert findings == [], "\n".join(str(f) for f in findings)
+
+
+def test_module_entry_point_exit_codes(tmp_path):
+    """`python -m presto_tpu.analysis.lint` is the CI surface: 0 on the
+    shipped tree, nonzero on a traced-.item() fixture."""
+    clean = subprocess.run(
+        [sys.executable, "-m", "presto_tpu.analysis.lint", "presto_tpu"],
+        cwd=REPO, capture_output=True, text=True)
+    assert clean.returncode == 0, clean.stdout + clean.stderr
+
+    fixture = tmp_path / "bad.py"
+    fixture.write_text(
+        "import jax.numpy as jnp\n"
+        "def f(x):\n"
+        "    return jnp.sum(x).item()\n")
+    bad = subprocess.run(
+        [sys.executable, "-m", "presto_tpu.analysis.lint", str(fixture)],
+        cwd=REPO, capture_output=True, text=True)
+    assert bad.returncode == 1
+    assert "SYNC001" in bad.stdout
+
+
+# ---------------------------------------------------------------------------
+# hazard shapes
+# ---------------------------------------------------------------------------
+
+def test_item_call_flagged():
+    findings = lint_source(
+        "import jax.numpy as jnp\n"
+        "def f(x):\n"
+        "    s = jnp.sum(x)\n"
+        "    return s.item()\n")
+    assert _codes(findings) == {SYNC_EXPLICIT}
+
+
+def test_device_get_flagged():
+    findings = lint_source(
+        "import jax\n"
+        "def f(x):\n"
+        "    return jax.device_get(x)\n")
+    assert _codes(findings) == {SYNC_EXPLICIT}
+
+
+def test_block_until_ready_flagged():
+    findings = lint_source(
+        "def f(x):\n"
+        "    return x.block_until_ready()\n")
+    assert _codes(findings) == {SYNC_EXPLICIT}
+
+
+def test_cast_of_traced_value_flagged():
+    findings = lint_source(
+        "import jax.numpy as jnp\n"
+        "def f(x):\n"
+        "    return float(jnp.mean(x)), int(jnp.sum(x))\n")
+    assert _codes(findings) == {SYNC_CAST}
+    assert len(findings) == 2
+
+
+def test_cast_tracks_assigned_names():
+    findings = lint_source(
+        "import jax.numpy as jnp\n"
+        "def f(x):\n"
+        "    total = jnp.sum(x) + 1\n"
+        "    return int(total)\n")
+    assert _codes(findings) == {SYNC_CAST}
+
+
+def test_np_asarray_on_device_value_flagged():
+    findings = lint_source(
+        "import jax.numpy as jnp\n"
+        "import numpy as np\n"
+        "def f(x):\n"
+        "    y = jnp.where(x > 0, x, 0)\n"
+        "    return np.asarray(y)\n")
+    assert _codes(findings) == {SYNC_ASARRAY}
+
+
+def test_branch_on_device_bool_flagged():
+    findings = lint_source(
+        "import jax.numpy as jnp\n"
+        "def f(x):\n"
+        "    if jnp.any(x > 0):\n"
+        "        return 1\n"
+        "    while jnp.all(x):\n"
+        "        pass\n")
+    assert _codes(findings) == {SYNC_BRANCH}
+    assert len(findings) == 2
+
+
+# ---------------------------------------------------------------------------
+# precision: host values and metadata must NOT be flagged
+# ---------------------------------------------------------------------------
+
+def test_device_get_result_is_host():
+    """device_get moves the value to host: casting/branching on its
+    result is the sanctioned pattern, only the device_get itself needs
+    the pragma."""
+    findings = lint_source(
+        "import jax\n"
+        "def f(x):\n"
+        "    v = jax.device_get(x)  # lint: allow-host-sync\n"
+        "    if int(v) > 0:\n"
+        "        return float(v)\n")
+    assert findings == []
+
+
+def test_dtype_metadata_is_host():
+    findings = lint_source(
+        "import jax.numpy as jnp\n"
+        "def f(x):\n"
+        "    if jnp.issubdtype(x.dtype, jnp.floating):\n"
+        "        return int(x.shape[0]) + int(jnp.iinfo(x.dtype).max)\n")
+    assert findings == []
+
+
+def test_plain_python_casts_not_flagged():
+    findings = lint_source(
+        "def f(args):\n"
+        "    return int(args[1].value), float('3')\n")
+    assert findings == []
+
+
+def test_pragma_suppresses():
+    findings = lint_source(
+        "import jax\n"
+        "def f(x):\n"
+        "    return bool(jax.device_get(x))  # lint: allow-host-sync\n")
+    assert findings == []
+
+
+def test_pragma_covers_multiline_statement():
+    findings = lint_source(
+        "import jax\n"
+        "def f(x, y):\n"
+        "    return jax.device_get(  # lint: allow-host-sync\n"
+        "        (x, y))\n")
+    assert findings == []
+
+
+def test_syntax_error_is_reported_not_raised():
+    findings = lint_source("def f(:\n")
+    assert [f.code for f in findings] == ["SYNTAX"]
+
+
+def test_lint_routes_through_error_taxonomy(tmp_path):
+    """lint_or_raise fails through the same non-retryable PLAN_VALIDATION
+    channel as the plan checker."""
+    from presto_tpu.common.errors import PlanValidationError, is_retryable
+    fixture = tmp_path / "bad.py"
+    fixture.write_text("import jax.numpy as jnp\n"
+                       "def f(x):\n"
+                       "    return jnp.sum(x).item()\n")
+    with pytest.raises(PlanValidationError) as ei:
+        lint_or_raise([str(fixture)])
+    assert ei.value.diagnostics
+    assert not is_retryable(ei.value)
+    lint_or_raise([os.path.join(REPO, "presto_tpu")])  # clean: no raise
+
+
+def test_all_codes_are_exercised_above():
+    assert set(ALL_LINT_CODES) == {SYNC_EXPLICIT, SYNC_CAST, SYNC_ASARRAY,
+                                   SYNC_BRANCH}
+    assert PRAGMA == "lint: allow-host-sync"

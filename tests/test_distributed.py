@@ -512,6 +512,12 @@ def test_error_classifier_taxonomy():
     assert not is_retryable(
         RuntimeError("remote said [USER_ERROR] bad query"))
     assert is_retryable(RuntimeError("remote said [EXTERNAL] net down"))
+    # a malformed plan re-plans identically: PLAN_VALIDATION fails fast
+    from presto_tpu.common.errors import PLAN_VALIDATION, PlanValidationError
+    assert classify_exception(PlanValidationError("bad")) == PLAN_VALIDATION
+    assert not is_retryable(PlanValidationError("bad"))
+    assert parse_error_type(
+        "task q.0.0 failed [PLAN_VALIDATION]: bad") == PLAN_VALIDATION
     assert producer_task_from_text(
         "exchange source http://h:1/v1/task/q1.0_0.1.r2/results/3 "
         "vanished") == "q1.0_0.1.r2"
