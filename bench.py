@@ -11,7 +11,17 @@ vs_baseline = speedup vs the single-threaded numpy reference interpreter
               each round so the ratio tracks engine improvements only.
 
 Env knobs: BENCH_SF (default 10), BENCH_RUNS (default 3),
-BENCH_QUERY (q1|q6|q3g).  Grouped-execution overlap mode:
+BENCH_QUERY (q1|q6|q3g|xchg).
+
+BENCH_QUERY=xchg is the shuffle benchmark: a hash-exchange-heavy
+aggregation over a real loopback HTTP cluster (BENCH_XCHG_WORKERS
+workers, default 2; BENCH_XCHG_TASKS tasks per stage, default 4; sf
+defaults to 0.1).  It reports bytes moved on the wire, the exchange
+compression ratio, pull/decode walls, and the network/compute overlap
+fraction (1 - consumer wait / client drain wall), plus
+vs_sequential_client = sequential-client wall / concurrent-client wall
+for the same query — the headline of the concurrent ExchangeClient
+round.  Grouped-execution overlap mode:
 BENCH_GROUPED_LIFESPANS (0=auto, 1=off, N>=2 force N bucket lifespans)
 and BENCH_PREFETCH_DEPTH (lifespans staged ahead; 0 = serial) — when the
 run produced grouped runtime stats, the JSON line gains a
@@ -72,10 +82,101 @@ ORDER BY revenue DESC LIMIT 10
 """
 
 
+# shuffle-heavy: high-cardinality group-by forces a partial agg -> hash
+# exchange -> final agg plan, so most of the partial-agg output crosses
+# the wire between stages
+XCHG = """
+SELECT l_orderkey, count(*) AS cnt, sum(l_quantity) AS qty,
+       sum(l_extendedprice) AS price
+FROM lineitem
+GROUP BY l_orderkey
+"""
+
+
+def bench_xchg(runs):
+    sf = float(os.environ.get("BENCH_SF", "0.1"))
+    n_workers = int(os.environ.get("BENCH_XCHG_WORKERS", "2"))
+    n_tasks = int(os.environ.get("BENCH_XCHG_TASKS", "4"))
+
+    from presto_tpu.connectors import tpch
+    from presto_tpu.worker.coordinator import HttpQueryRunner
+    from presto_tpu.worker.exchange import EXCHANGE_METRICS
+    from presto_tpu.worker.server import WorkerServer
+
+    schema = f"sf{sf:g}"
+    n_rows = tpch._table_rows("lineitem", sf)
+    workers = [WorkerServer() for _ in range(n_workers)]
+    try:
+        uris = [w.uri for w in workers]
+        session = {"exchange_compression": "true"}
+        runner = HttpQueryRunner(uris, schema, n_tasks=n_tasks,
+                                 session=session)
+        runner.execute(XCHG)              # warmup: compiles + faults data
+
+        EXCHANGE_METRICS.reset()
+        best = float("inf")
+        for _ in range(runs):
+            t0 = time.perf_counter()
+            result = runner.execute(XCHG)
+            best = min(best, time.perf_counter() - t0)
+        assert result.rows, "benchmark query returned no rows"
+        x = EXCHANGE_METRICS.snapshot()
+
+        # sequential-client baseline: same cluster, same query, pullers
+        # forced to one thread (drains one upstream location at a time)
+        seq = HttpQueryRunner(uris, schema, n_tasks=n_tasks,
+                              session={**session,
+                                       "exchange_client_threads": "1"})
+        seq.execute(XCHG)                 # warmup
+        seq_best = float("inf")
+        for _ in range(runs):
+            t0 = time.perf_counter()
+            seq.execute(XCHG)
+            seq_best = min(seq_best, time.perf_counter() - t0)
+
+        drain = x["drain_wall_s"]
+        out = {
+            "metric": f"xchg_sf{sf:g}_rows_per_sec",
+            "value": round(n_rows / best, 1),
+            "unit": "rows/s",
+            "wall_s": round(best, 4),
+            "vs_sequential_client": round(seq_best / best, 3),
+            "exchange": {
+                "workers": n_workers,
+                "tasks_per_stage": n_tasks,
+                "clients": x["clients"],
+                "pages_moved": x["pages"],
+                "bytes_moved": x["bytes"],
+                "uncompressed_bytes": x["uncompressed_bytes"],
+                "compression_ratio": round(
+                    x["uncompressed_bytes"] / x["bytes"], 3)
+                if x["bytes"] else 0.0,
+                "responses": x["responses"],
+                "pull_wall_s": round(x["pull_wall_s"], 4),
+                "decode_wall_s": round(x["decode_wall_s"], 4),
+                "wait_wall_s": round(x["wait_wall_s"], 4),
+                "drain_wall_s": round(drain, 4),
+                # fraction of client-open time the consumers were NOT
+                # blocked waiting on the network: shuffle hidden behind
+                # compute (and behind sibling pulls)
+                "overlap_fraction": round(
+                    max(0.0, 1.0 - x["wait_wall_s"] / drain), 4)
+                if drain else 0.0,
+                "buffered_peak_bytes": x["buffered_bytes_peak"],
+            },
+        }
+        print(json.dumps(out))
+    finally:
+        for w in workers:
+            w.close()
+
+
 def main():
-    sf = float(os.environ.get("BENCH_SF", "10"))
-    runs = int(os.environ.get("BENCH_RUNS", "3"))
     qname = os.environ.get("BENCH_QUERY", "q1")
+    runs = int(os.environ.get("BENCH_RUNS", "3"))
+    if qname == "xchg":
+        return bench_xchg(runs)
+    sf = float(os.environ.get("BENCH_SF", "10"))
     sql = {"q1": Q1, "q6": Q6, "q3g": Q3G}[qname]
     grouped_lifespans = int(os.environ.get("BENCH_GROUPED_LIFESPANS", "0"))
     prefetch_depth = int(os.environ.get("BENCH_PREFETCH_DEPTH", "1"))

@@ -18,6 +18,13 @@ and flags the hazard shapes:
            an implicit transfer hidden inside a conversion.
   SYNC004  Python `if` / `while` branching on a device boolean — forces
            the trace to materialise the predicate on host.
+  SYNC005  blocking network I/O (`urllib.request.urlopen` and friends)
+           called from a pipeline compute module (`exec/`, `common/`,
+           `ops/`, `connectors/`) — a synchronous HTTP round trip in
+           operator code serialises the pipeline worse than any device
+           sync.  Network I/O belongs in the worker layer; the exchange
+           client (worker/exchange.py) is the sanctioned home and is
+           allow-listed.
 
 "Device value" is tracked with a deliberately shallow per-scope
 dataflow: names assigned from `jnp.*` / `lax.*` calls (or expressions
@@ -50,8 +57,23 @@ SYNC_EXPLICIT = "SYNC001"
 SYNC_CAST = "SYNC002"
 SYNC_ASARRAY = "SYNC003"
 SYNC_BRANCH = "SYNC004"
+SYNC_NETWORK = "SYNC005"
 
-ALL_LINT_CODES = (SYNC_EXPLICIT, SYNC_CAST, SYNC_ASARRAY, SYNC_BRANCH)
+ALL_LINT_CODES = (SYNC_EXPLICIT, SYNC_CAST, SYNC_ASARRAY, SYNC_BRANCH,
+                  SYNC_NETWORK)
+
+# SYNC005 scope: pipeline compute packages where a blocking HTTP round
+# trip would serialise operator execution.  Matching is on path markers,
+# not imports: `urllib.parse` / `urllib.error` usage is metadata and
+# stays legal everywhere — only the blocking CALLS below are hazards.
+_NETWORK_PATH_MARKERS = ("presto_tpu/exec/", "presto_tpu/common/",
+                         "presto_tpu/ops/", "presto_tpu/parallel/",
+                         "presto_tpu/connectors/")
+# the worker exchange client is THE sanctioned network home; everything
+# else in the marked packages must stay network-free by construction
+_NETWORK_ALLOWLIST = ("presto_tpu/worker/exchange.py",)
+_NETWORK_CALLS = {"urllib.request.urlopen", "urllib.request.urlretrieve",
+                  "request.urlopen", "urlopen", "urlopen_internal"}
 
 # Call prefixes whose results live on device.  `jax.` alone is NOT in the
 # list: most of the jax namespace (jit, vmap, tree_util) returns host
@@ -117,6 +139,11 @@ class _Linter(ast.NodeVisitor):
         self.allowed = allowed
         self.findings: List[LintFinding] = []
         self._device: List[Set[str]] = [set()]
+        import os
+        norm = path.replace(os.sep, "/")
+        self._network_scoped = (
+            any(m in norm for m in _NETWORK_PATH_MARKERS)
+            and not any(norm.endswith(a) for a in _NETWORK_ALLOWLIST))
 
     # -- reporting --------------------------------------------------------
     def _flag(self, node: ast.AST, code: str, message: str) -> None:
@@ -253,6 +280,12 @@ class _Linter(ast.NodeVisitor):
                        f"{name}() on a device array copies to host; use "
                        f"jnp.asarray to stay on device or device_get "
                        f"explicitly")
+        if self._network_scoped and name in _NETWORK_CALLS:
+            self._flag(node, SYNC_NETWORK,
+                       f"{name}() is blocking network I/O in a pipeline "
+                       f"compute module; route it through the worker "
+                       f"exchange client (worker/exchange.py) or "
+                       f"acknowledge with `# {PRAGMA}`")
         self.generic_visit(node)
 
     def visit_If(self, node: ast.If) -> None:

@@ -345,15 +345,18 @@ def deserialize_page(buf: bytes, pos: int = 0, codec: str = DEFAULT_CODEC):
     if markers & ENCRYPTED:
         raise NotImplementedError("encrypted pages not supported")
     if markers & CHECKSUMMED:
-        # checksum covers the wire form (compressed bytes if compressed)
-        actual = _checksum(bytes(data), markers, position_count,
+        # checksum covers the wire form (compressed bytes if compressed);
+        # zlib.crc32 accepts the memoryview directly — no body copy
+        actual = _checksum(data, markers, position_count,
                            uncompressed_size)
         if actual != (checksum & 0xFFFFFFFF):
             raise ValueError(
                 f"page checksum mismatch: {actual:#x} != {checksum:#x}")
     if markers & COMPRESSED:
+        # every codec backend (pyarrow, zlib, the pure lz4 block fallback)
+        # takes buffer-like input: hand it the view, copy nothing
         data = memoryview(compression.decompress(
-            codec, bytes(data), uncompressed_size))
+            codec, data, uncompressed_size))
         if len(data) != uncompressed_size:
             raise ValueError(
                 f"decompressed size {len(data)} != header "

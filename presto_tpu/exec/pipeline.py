@@ -193,6 +193,22 @@ class ExecutionConfig:
     # (exponential backoff + jitter) before declaring the producer lost
     # (reference exchange.max-error-duration, Configs.h)
     exchange_max_error_duration_s: float = 60.0
+    # concurrent pullers per ExchangeClient (reference
+    # exchange.client-threads, ExchangeClientConfig.java): each upstream
+    # location gets its own puller (capped here), so pulls + LZ4 decode
+    # parallelize across producers and the consuming pipeline computes
+    # while pages stream in
+    exchange_client_threads: int = 4
+    # bound on bytes buffered inside one ExchangeClient (reference
+    # exchange.max-buffer-size): pullers park when the arrival queue holds
+    # this much decoded data — producer backpressure end to end
+    exchange_max_buffer_bytes: int = 32 << 20
+    # target response size for the results endpoint (reference
+    # exchange.max-response-size): producers coalesce small serialized
+    # pages up to ~this many bytes per pull round, and the client sends it
+    # as an X-Presto-Max-Size cap, so tiny-page stages stop paying a
+    # request round trip per page
+    exchange_max_response_bytes: int = 1 << 20
     # chaos hook: probability a task fails at start.  The roll is
     # deterministic per task id, so a retry (new attempt id) rolls
     # independently and chaos tests replay exactly

@@ -395,7 +395,9 @@ class InProcessScheduler:
                         sources, task_index, rnode)
                 else:
                     ctx.remote_pages[rnode.id] = _remote_reader(
-                        sources, task_index)
+                        sources, task_index,
+                        client_threads=
+                        self.config.exec_config.exchange_client_threads)
             compiler = PlanCompiler(ctx)
             dev_ctx = (jax.default_device(devices[task_index])
                        if pin else contextlib.nullcontext())
@@ -658,20 +660,33 @@ def _device_dicts_agree(sources: List[StageInfo]) -> bool:
     return True
 
 
-def _remote_reader(sources: List[StageInfo], consumer_task: int):
+def _remote_reader(sources: List[StageInfo], consumer_task: int,
+                   client_threads: int = 1):
     """Page reader; ICI children (device_out) are converted to pages
-    lazily so mixed device/page source sets lose no rows."""
+    lazily so mixed device/page source sets lose no rows.  With
+    client_threads > 1 the sources drain concurrently through the
+    local-exchange arrival-order queue (the in-process mirror of the
+    HTTP ExchangeClient; cross-source page order carries no semantics —
+    ordering, if any, is applied inside the consuming fragment)."""
+    def _source_pages(src: StageInfo) -> Iterator[Page]:
+        if src.device_out is not None:
+            from .batch import batch_to_page
+            b = src.device_out[consumer_task]
+            if b is not None:
+                types = [v.type for v in
+                         src.fragment.root.output_variables]
+                page = batch_to_page(b, src.out_names, types)
+                if page.position_count:
+                    yield page
+            return
+        yield from src.buffers.pages_for_consumer(consumer_task)
+
     def read() -> Iterator[Page]:
-        for src in sources:
-            if src.device_out is not None:
-                from .batch import batch_to_page
-                b = src.device_out[consumer_task]
-                if b is not None:
-                    types = [v.type for v in
-                             src.fragment.root.output_variables]
-                    page = batch_to_page(b, src.out_names, types)
-                    if page.position_count:
-                        yield page
-                continue
-            yield from src.buffers.pages_for_consumer(consumer_task)
+        if client_threads > 1 and len(sources) > 1:
+            from .local_exchange import parallel_drain
+            thunks = [(lambda s=src: _source_pages(s)) for src in sources]
+            yield from parallel_drain(thunks, client_threads)
+        else:
+            for src in sources:
+                yield from _source_pages(src)
     return read

@@ -31,17 +31,33 @@ class PageBuffer:
     UNacknowledged bytes, matching the non-retain threshold behavior."""
 
     def __init__(self, max_buffered_bytes: int = DEFAULT_MAX_BUFFERED_BYTES,
-                 retain: bool = False):
+                 retain: bool = False, coalesce_target_bytes: int = 0):
         self._pages: List[bytes] = []
         self._base = 0                    # sequence number of _pages[0]
         self._bytes = 0                   # UNacknowledged bytes (backpressure)
         self._max_bytes = max_buffered_bytes
         self._retain = retain
         self._acked = 0                   # retain mode: acknowledge watermark
+        # coalescing (exchange.max-response-size): small serialized pages
+        # accumulate in _pending until ~target bytes, then flush as ONE
+        # buffer entry so tiny-page stages stop paying a pull round trip
+        # per page.  SerializedPages are self-delimiting, so concatenation
+        # is transparent to every consumer.  A get() that would otherwise
+        # wait flushes first — coalescing never withholds available data.
+        self._coalesce_target = max(0, int(coalesce_target_bytes))
+        self._pending: List[bytes] = []
+        self._pending_bytes = 0
         self._complete = False
         self._destroyed = False
         self._error: Optional[str] = None
         self._cond = threading.Condition()
+
+    def _flush_pending_locked(self) -> None:
+        if self._pending:
+            self._pages.append(b"".join(self._pending))
+            self._pending = []
+            self._pending_bytes = 0
+            self._cond.notify_all()
 
     def add(self, page_bytes: bytes) -> None:
         with self._cond:
@@ -50,13 +66,24 @@ class PageBuffer:
                 self._cond.wait(1.0)
             if self._destroyed:
                 return
-            self._pages.append(page_bytes)
-            self._bytes += len(page_bytes)
-            self._cond.notify_all()
+            self._bytes += len(page_bytes)  # pending counts for backpressure
+            if self._coalesce_target > 0:
+                self._pending.append(page_bytes)
+                self._pending_bytes += len(page_bytes)
+                if self._pending_bytes >= self._coalesce_target:
+                    self._flush_pending_locked()
+                else:
+                    # wake a parked long-poll getter: a caught-up consumer
+                    # demand-flushes rather than sleeping out its maxWait
+                    self._cond.notify_all()
+            else:
+                self._pages.append(page_bytes)
+                self._cond.notify_all()
 
     def set_complete(self) -> None:
         with self._cond:
-            self._complete = True
+            self._flush_pending_locked()  # flush boundaries are now final:
+            self._complete = True         # replay after retry is identical
             self._cond.notify_all()
 
     def set_error(self, message: str) -> None:
@@ -65,19 +92,35 @@ class PageBuffer:
             self._complete = True
             self._cond.notify_all()
 
-    def get(self, token: int, max_wait_s: float
+    def get(self, token: int, max_wait_s: float,
+            max_bytes: Optional[int] = None
             ) -> Tuple[List[bytes], int, bool]:
         """Pages from `token` on; blocks up to max_wait_s for data.
-        Returns (pages, next_token, buffer_complete).  Raises on task
-        failure (propagates the producer's error to the consumer)."""
+        Returns (pages, next_token, buffer_complete).  `max_bytes` caps the
+        response size (always at least one page) — the consumer's
+        X-Presto-Max-Size.  Raises on task failure (propagates the
+        producer's error to the consumer)."""
         deadline = None
         with self._cond:
             while True:
                 if self._error is not None:
                     raise BufferError(self._error)
                 end = self._base + len(self._pages)
+                if token >= end and self._pending:
+                    # the consumer caught up to the coalescer: flush the
+                    # partial batch rather than make it wait for more data
+                    self._flush_pending_locked()
+                    end = self._base + len(self._pages)
                 if token < end or self._complete:
                     pages = self._pages[max(0, token - self._base):]
+                    if max_bytes is not None and len(pages) > 1:
+                        taken, size = [], 0
+                        for p in pages:
+                            if taken and size + len(p) > max_bytes:
+                                break
+                            taken.append(p)
+                            size += len(p)
+                        pages = taken
                     next_token = max(token, self._base) + len(pages)
                     at_end = self._complete and next_token >= end
                     return pages, next_token, at_end
@@ -116,6 +159,8 @@ class PageBuffer:
             if self._retain and not force:
                 return
             self._pages = []
+            self._pending = []
+            self._pending_bytes = 0
             self._bytes = 0
             self._complete = True
             self._destroyed = True
@@ -127,9 +172,10 @@ class OutputBufferManager:
     buffer p; BROADCAST replicates every page into each consumer's buffer."""
 
     def __init__(self, buffer_type: str, n_buffers: int,
-                 retain: bool = False):
+                 retain: bool = False, coalesce_target_bytes: int = 0):
         self.buffer_type = buffer_type
-        self.buffers = [PageBuffer(retain=retain)
+        self.buffers = [PageBuffer(retain=retain,
+                                   coalesce_target_bytes=coalesce_target_bytes)
                         for _ in range(max(1, n_buffers))]
 
     def add(self, partition: int, page_bytes: bytes) -> None:
@@ -147,8 +193,10 @@ class OutputBufferManager:
         for b in self.buffers:
             b.set_error(message)
 
-    def get(self, buffer_id: int, token: int, max_wait_s: float):
-        return self.buffers[buffer_id].get(token, max_wait_s)
+    def get(self, buffer_id: int, token: int, max_wait_s: float,
+            max_bytes: Optional[int] = None):
+        return self.buffers[buffer_id].get(token, max_wait_s,
+                                           max_bytes=max_bytes)
 
     def acknowledge(self, buffer_id: int, token: int) -> None:
         self.buffers[buffer_id].acknowledge(token)

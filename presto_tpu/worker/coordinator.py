@@ -41,9 +41,11 @@ from ..connectors import catalog, tpch
 from ..exec.pipeline import ExecutionConfig
 from ..exec.runner import LocalQueryRunner, QueryResult, pages_to_result
 from ..spi import plan as P
-from .exchange import pull_pages
+from ..utils.runtime_stats import RuntimeStats
+from .exchange import ExchangeClient
 from .protocol import (DONE_STATES, FAILED, OutputBuffersSpec, TaskSource,
-                       TaskStatus, TaskUpdateRequest, parse_duration)
+                       TaskStatus, TaskUpdateRequest, parse_data_size,
+                       parse_duration)
 
 _query_counter = itertools.count()
 
@@ -317,6 +319,15 @@ class _QueryExecution:
         self.codec = str(self.session.get(
             "exchange_compression_codec",
             cfg.exchange_compression_codec)).upper()
+        # concurrent root-pull client knobs (exchange.client-threads /
+        # .max-buffer-size / .max-response-size and session equivalents)
+        self.client_threads = int(self.session.get(
+            "exchange_client_threads", cfg.exchange_client_threads))
+        self.max_buffer_bytes = parse_data_size(self.session.get(
+            "exchange_max_buffer_size", cfg.exchange_max_buffer_bytes))
+        self.max_response_bytes = parse_data_size(self.session.get(
+            "exchange_max_response_size", cfg.exchange_max_response_bytes))
+        self.stats = RuntimeStats()             # root-pull exchange stats
         self.id_attempt: Dict[str, int] = {}    # lineage -> id generation
         self.budget_used: Dict[str, int] = {}   # lineage -> retries charged
         self.suspects: Set[str] = set()         # workers seen failing
@@ -434,14 +445,21 @@ class _QueryExecution:
         self.schedule_all()
         while True:
             self._watcher = _StatusWatcher(self)
+            # one concurrent client over every root-task buffer (reference
+            # Query.java holding an ExchangeClient on the root stage): a
+            # restart discards this client and builds a fresh one, and the
+            # producers' retained buffers replay from token 0 — so a
+            # half-drained attempt stays exactly-once
+            client = ExchangeClient(
+                [task.result_location(0) for task in self.root.tasks],
+                codec=self.codec, max_error_duration_s=self.max_error_s,
+                should_abort=self._raise_pending_failures,
+                client_threads=self.client_threads,
+                max_buffer_bytes=self.max_buffer_bytes,
+                max_response_bytes=self.max_response_bytes,
+                stats=self.stats)
             try:
-                pages: List = []
-                for task in self.root.tasks:
-                    for page in pull_pages(
-                            task.result_location(0), codec=self.codec,
-                            max_error_duration_s=self.max_error_s,
-                            should_abort=self._raise_pending_failures):
-                        pages.append(page)
+                pages = list(client.pages())
                 self._raise_pending_failures()
                 return pages
             except (ExchangeLostError, RemoteTaskError,
@@ -449,6 +467,7 @@ class _QueryExecution:
                 failed = self._classify_failure(e)
                 self._restart(failed, cause=e)
             finally:
+                client.close()
                 self._watcher.close()
 
     def _raise_pending_failures(self) -> None:
@@ -634,9 +653,12 @@ class HttpQueryRunner(LocalQueryRunner):
         root = self._build_stages(subplan)
         qid = f"q{next(_query_counter)}_{int(time.time() * 1000) % 100000}"
         execution = _QueryExecution(self, root, qid)
+        self.last_execution = execution
         try:
             pages = execution.run()
-            return pages_to_result(iter(pages), names, types)
+            result = pages_to_result(iter(pages), names, types)
+            result.runtime_stats = execution.stats.to_dict()
+            return result
         except Exception:
             self.queries_failed += 1
             raise

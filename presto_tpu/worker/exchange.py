@@ -1,22 +1,45 @@
-"""HTTP exchange source: pulls SerializedPages from upstream task buffers.
+"""HTTP exchange client: pulls SerializedPages from upstream task buffers.
 
 The analog of the reference's ExchangeClient/PageBufferClient
 (presto-main-base/.../operator/ExchangeClient.java:72) and the native
-PrestoExchangeSource (presto_cpp/main/PrestoExchangeSource.cpp:171): loop
-GET {location}/{token} -> acknowledge -> repeat until the complete flag,
-then DELETE the buffer.
+PrestoExchangeSource (presto_cpp/main/PrestoExchangeSource.cpp:171).
 
-Transient transport failures RESUME from the last delivered token under an
-exponential-backoff-with-jitter loop bounded by a real error budget
-(reference exchange.max-error-duration / PageBufferClient's backoff).
-When the budget expires — or the producer task vanishes outright (404) —
-a typed ExchangeLostError carries the producer location upward so the
-coordinator can map it back to the producing task and retry that task
-instead of failing the query.
+Two layers:
+
+  * `pull_pages` — the per-location protocol loop: GET {location}/{token}
+    -> acknowledge -> repeat until the complete flag, then DELETE the
+    buffer.  Transient transport failures RESUME from the last delivered
+    token under an exponential-backoff-with-jitter loop bounded by a real
+    error budget (reference exchange.max-error-duration).  When the budget
+    expires — or the producer task vanishes outright (404) — a typed
+    ExchangeLostError carries the producer location upward so the
+    coordinator can map it back to the producing task and retry that task
+    instead of failing the query.
+
+  * `ExchangeClient` — the concurrent consumer: one puller per upstream
+    location (capped by exchange.client-threads), each running the
+    protocol loop above with its OWN token/backoff state, feeding a single
+    bounded arrival-order queue (exchange.max-buffer-size bytes).  Pullers
+    park when the buffer is full (producer backpressure), acknowledges are
+    fire-and-forget on a separate thread, and page deserialization/LZ4
+    decode happens IN the puller threads — so decode parallelizes across
+    producers and the consuming pipeline computes on page k while pages
+    k+1... are in flight.  Every puller sends an X-Presto-Max-Size cap so
+    producers coalesce tiny pages into ~max-response-size bodies.
+
+Fault-tolerance semantics are unchanged under concurrency: per-location
+token resume, 404/410 -> ExchangeLostError (producer lineage), 500 ->
+RemoteTaskError with the producer's [ERROR_TYPE] tag, and exactly-once via
+replayable retained buffers (a restarted consumer re-creates the client
+and replays every location from token 0).
 """
 from __future__ import annotations
 
+import collections
+import queue
 import random
+import struct
+import threading
 import time
 import urllib.error
 import urllib.request
@@ -24,44 +47,135 @@ from typing import Callable, Iterator, List, Optional
 
 from ..common.errors import ExchangeLostError, RemoteTaskError
 from ..common.page import Page
-from ..common.serde import DEFAULT_CODEC, deserialize_pages
+from ..common.serde import DEFAULT_CODEC, deserialize_page, deserialize_pages
 
 DEFAULT_MAX_WAIT_S = 1.0
 REQUEST_TIMEOUT_S = 30.0
 DEFAULT_MAX_ERROR_DURATION_S = 60.0
+DEFAULT_CLIENT_THREADS = 4            # exchange.client-threads
+DEFAULT_MAX_BUFFER_BYTES = 32 << 20   # exchange.max-buffer-size
+DEFAULT_MAX_RESPONSE_BYTES = 1 << 20  # exchange.max-response-size
 _BACKOFF_BASE_S = 0.05
 _BACKOFF_CAP_S = 2.0
 
+_PAGE_HEADER = struct.Struct("<ibiiq")
+
+
+class ExchangeAbortedError(RuntimeError):
+    """Raised through should_abort when the consuming task is already
+    terminal: the pull must stop, not drain a doomed query."""
+
+
+class _Stop(BaseException):
+    """Internal puller-thread unwind on client close (BaseException so it
+    cannot be swallowed by a broad `except Exception`)."""
+
 
 def _request(url: str, method: str = "GET",
-             timeout: float = REQUEST_TIMEOUT_S):
+             timeout: float = REQUEST_TIMEOUT_S, headers: dict = None):
     from .auth import outbound_headers, urlopen_internal
-    req = urllib.request.Request(url, method=method,
-                                 headers=outbound_headers())
+    h = outbound_headers()
+    if headers:
+        h.update(headers)
+    req = urllib.request.Request(url, method=method, headers=h)
     return urlopen_internal(req, timeout=timeout)
 
 
-def pull_pages(location: str, codec: str = DEFAULT_CODEC,
-               max_error_duration_s: float = DEFAULT_MAX_ERROR_DURATION_S,
-               should_abort: Optional[Callable[[], None]] = None
-               ) -> Iterator[Page]:
-    """Stream every page from one upstream buffer location
-    (http://host:port/v1/task/{taskId}/results/{bufferId}).  `codec`
-    decodes COMPRESSED pages; it is cluster config shared with the
-    producer, like the reference exchange.compression-codec.
+class ExchangeMetrics:
+    """Process-wide exchange counters for /v1/metrics (one worker per
+    process in deployment; tests reset() before asserting).  The buffered
+    gauge aggregates across every live ExchangeClient in the process, so
+    its peak proves backpressure actually bounded resident bytes."""
 
-    `should_abort` is polled once per pull round (it raises to abort) —
-    the coordinator's early-failure hook, so a root-stage pull stops as
-    soon as any task reports FAILED instead of draining to completion."""
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.reset()
+
+    def reset(self) -> None:
+        with self._lock:
+            self.pages = 0
+            self.bytes = 0                # wire (possibly compressed) bytes
+            self.uncompressed_bytes = 0
+            self.responses = 0
+            self.pull_wall_s = 0.0        # HTTP request walls, all pullers
+            self.decode_wall_s = 0.0      # deserialize/decompress walls
+            self.wait_wall_s = 0.0        # consumer blocked on empty buffer
+            self.drain_wall_s = 0.0       # client open -> close
+            self.buffered_bytes = 0
+            self.buffered_bytes_peak = 0
+            self.clients = 0
+
+    def on_page(self, nbytes: int, uncompressed: int,
+                decode_wall_s: float) -> None:
+        with self._lock:
+            self.pages += 1
+            self.bytes += nbytes
+            self.uncompressed_bytes += uncompressed
+            self.decode_wall_s += decode_wall_s
+
+    def on_response(self, wall_s: float) -> None:
+        with self._lock:
+            self.responses += 1
+            self.pull_wall_s += wall_s
+
+    def buffered_delta(self, delta: int) -> None:
+        with self._lock:
+            self.buffered_bytes += delta
+            if self.buffered_bytes > self.buffered_bytes_peak:
+                self.buffered_bytes_peak = self.buffered_bytes
+
+    def on_client_close(self, wait_wall_s: float, drain_wall_s: float
+                        ) -> None:
+        with self._lock:
+            self.clients += 1
+            self.wait_wall_s += wait_wall_s
+            self.drain_wall_s += drain_wall_s
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "pages": self.pages, "bytes": self.bytes,
+                "uncompressed_bytes": self.uncompressed_bytes,
+                "responses": self.responses,
+                "pull_wall_s": self.pull_wall_s,
+                "decode_wall_s": self.decode_wall_s,
+                "wait_wall_s": self.wait_wall_s,
+                "drain_wall_s": self.drain_wall_s,
+                "buffered_bytes": self.buffered_bytes,
+                "buffered_bytes_peak": self.buffered_bytes_peak,
+                "clients": self.clients,
+            }
+
+
+EXCHANGE_METRICS = ExchangeMetrics()
+
+
+def _pull_rounds(location: str,
+                 max_error_duration_s: float = DEFAULT_MAX_ERROR_DURATION_S,
+                 should_abort: Optional[Callable[[], None]] = None,
+                 sleep: Callable[[float], None] = time.sleep,
+                 max_response_bytes: Optional[int] = None,
+                 acknowledge: Optional[Callable[[str], None]] = None,
+                 on_round: Optional[Callable[[float], None]] = None,
+                 ) -> Iterator[bytes]:
+    """The per-location protocol loop, yielding each non-empty response
+    BODY (one or more concatenated SerializedPages).  Handles token
+    resume, the budgeted jittered backoff, acknowledges (via the
+    `acknowledge` callback when given, else inline best-effort), and the
+    final DELETE.  `sleep` is injectable so a closing client can interrupt
+    a backoff wait."""
     token = 0
     error_since: Optional[float] = None
     attempt = 0
+    extra = ({"X-Presto-Max-Size": str(int(max_response_bytes))}
+             if max_response_bytes else None)
     while True:
         if should_abort is not None:
             should_abort()
         url = f"{location}/{token}?maxWaitMs={int(DEFAULT_MAX_WAIT_S * 1000)}"
+        t0 = time.perf_counter()
         try:
-            with _request(url) as resp:
+            with _request(url, headers=extra) as resp:
                 complete = resp.headers.get(
                     "X-Presto-Buffer-Complete", "false") == "true"
                 # reference name first (PrestoHeaders.PRESTO_PAGE_NEXT_TOKEN
@@ -84,7 +198,7 @@ def pull_pages(location: str, codec: str = DEFAULT_CODEC,
                 # draining/overloaded producer: transient, budgeted retry
                 error_since, attempt = _backoff(
                     location, token, error_since, attempt,
-                    max_error_duration_s, e)
+                    max_error_duration_s, e, sleep=sleep)
                 continue
             # 500 carries a producer-side failure: propagate typed (the
             # [ERROR_TYPE] tag in the detail decides retryability upstream)
@@ -93,16 +207,21 @@ def pull_pages(location: str, codec: str = DEFAULT_CODEC,
                 OSError) as e:
             error_since, attempt = _backoff(
                 location, token, error_since, attempt,
-                max_error_duration_s, e)
+                max_error_duration_s, e, sleep=sleep)
             continue
+        if on_round is not None:
+            on_round(time.perf_counter() - t0)
         if body:
-            for page in deserialize_pages(body, codec=codec):
-                yield page
+            yield body
         if next_token != token:
-            try:
-                _request(f"{location}/{next_token}/acknowledge").close()
-            except (urllib.error.URLError, TimeoutError, OSError):
-                pass  # acknowledge is an optimization; the pull re-fetches
+            ack_url = f"{location}/{next_token}/acknowledge"
+            if acknowledge is not None:
+                acknowledge(ack_url)     # fire-and-forget (ack thread)
+            else:
+                try:
+                    _request(ack_url).close()
+                except (urllib.error.URLError, TimeoutError, OSError):
+                    pass  # acknowledge is an optimization; pull re-fetches
             token = next_token
         if complete:
             try:
@@ -112,9 +231,31 @@ def pull_pages(location: str, codec: str = DEFAULT_CODEC,
             return
 
 
+def pull_pages(location: str, codec: str = DEFAULT_CODEC,
+               max_error_duration_s: float = DEFAULT_MAX_ERROR_DURATION_S,
+               should_abort: Optional[Callable[[], None]] = None,
+               max_response_bytes: Optional[int] = None
+               ) -> Iterator[Page]:
+    """Stream every page from one upstream buffer location
+    (http://host:port/v1/task/{taskId}/results/{bufferId}), sequentially.
+    `codec` decodes COMPRESSED pages; it is cluster config shared with the
+    producer, like the reference exchange.compression-codec.
+
+    `should_abort` is polled once per pull round (it raises to abort).
+    This is the single-location building block; multi-location consumers
+    use ExchangeClient for concurrency + bounded buffering."""
+    for body in _pull_rounds(location,
+                             max_error_duration_s=max_error_duration_s,
+                             should_abort=should_abort,
+                             max_response_bytes=max_response_bytes):
+        for page in deserialize_pages(body, codec=codec):
+            yield page
+
+
 def _backoff(location: str, token: int, error_since: Optional[float],
              attempt: int, max_error_duration_s: float,
-             cause: Exception) -> tuple:
+             cause: Exception,
+             sleep: Callable[[float], None] = time.sleep) -> tuple:
     """One budgeted retry step: raise ExchangeLostError once errors have
     persisted past the budget, else sleep exp-backoff + jitter (reference
     PageBufferClient backoff under exchange.max-error-duration)."""
@@ -129,17 +270,250 @@ def _backoff(location: str, token: int, error_since: Optional[float],
             f"at token {token}: {cause}") from cause
     delay = min(_BACKOFF_CAP_S, _BACKOFF_BASE_S * (2 ** attempt))
     # full jitter keeps a fleet of consumers from re-probing in lockstep
-    time.sleep(delay * (0.5 + random.random() * 0.5))
+    sleep(delay * (0.5 + random.random() * 0.5))
     return error_since, attempt + 1
+
+
+class ExchangeClient:
+    """Concurrent multi-location exchange consumer (ExchangeClient.java:72
+    shape): `pages()` yields decoded pages in ARRIVAL order across all
+    locations while puller threads keep the bounded buffer full.
+
+    Backpressure: a puller parks before enqueueing a page that would push
+    buffered bytes past `max_buffer_bytes` (a page is always admitted into
+    an EMPTY buffer so one oversized page cannot deadlock the stream) —
+    so resident bytes stay <= max(max_buffer_bytes, largest page).
+
+    Errors from any puller (ExchangeLostError / RemoteTaskError / whatever
+    `should_abort` raises) surface on the consumer immediately — a stalled
+    sibling location cannot delay failure propagation."""
+
+    def __init__(self, locations: List[str], codec: str = DEFAULT_CODEC,
+                 max_error_duration_s: float = DEFAULT_MAX_ERROR_DURATION_S,
+                 should_abort: Optional[Callable[[], None]] = None,
+                 client_threads: int = DEFAULT_CLIENT_THREADS,
+                 max_buffer_bytes: int = DEFAULT_MAX_BUFFER_BYTES,
+                 max_response_bytes: int = DEFAULT_MAX_RESPONSE_BYTES,
+                 stats=None):
+        self._codec = codec
+        self._max_error_s = max_error_duration_s
+        self._should_abort = should_abort
+        self._max_buffer = max(1, int(max_buffer_bytes))
+        self._max_response = int(max_response_bytes) or None
+        self._stats = stats               # utils.runtime_stats.RuntimeStats
+        self._cond = threading.Condition()
+        self._queue: "collections.deque" = collections.deque()
+        self._buffered = 0
+        self._buffered_peak = 0
+        self._remaining = len(locations)  # locations not yet complete
+        self._error: Optional[BaseException] = None
+        self._closed = False
+        self._stop_event = threading.Event()
+        # client-level counters (flushed into `stats` at close)
+        self._pull_wall = 0.0
+        self._decode_wall = 0.0
+        self._wait_wall = 0.0
+        self._pages = 0
+        self._bytes = 0
+        self._uncompressed = 0
+        self._t0 = time.perf_counter()
+        self._location_q: "queue.SimpleQueue" = queue.SimpleQueue()
+        for loc in locations:
+            self._location_q.put(loc)
+        self._ack_q: "queue.SimpleQueue" = queue.SimpleQueue()
+        self._threads: List[threading.Thread] = []
+        if locations:
+            threading.Thread(target=self._ack_loop, daemon=True,
+                             name="exchange-ack").start()
+            n = max(1, min(int(client_threads), len(locations)))
+            for i in range(n):
+                t = threading.Thread(target=self._puller, daemon=True,
+                                     name=f"exchange-puller-{i}")
+                t.start()
+                self._threads.append(t)
+
+    # -- puller side -------------------------------------------------------
+    def _abort_check(self) -> None:
+        if self._closed or self._error is not None:
+            raise _Stop()
+        if self._should_abort is not None:
+            self._should_abort()
+
+    def _sleep(self, delay: float) -> None:
+        if self._stop_event.wait(delay):
+            raise _Stop()
+
+    def _on_round(self, wall_s: float) -> None:
+        with self._cond:
+            self._pull_wall += wall_s
+        EXCHANGE_METRICS.on_response(wall_s)
+
+    def _puller(self) -> None:
+        """Drain locations off the shared queue (cap: client_threads
+        pullers active at once) until none remain; each location resumes
+        from its own token with its own backoff budget."""
+        try:
+            while True:
+                try:
+                    loc = self._location_q.get_nowait()
+                except queue.Empty:
+                    return
+                for body in _pull_rounds(
+                        loc, max_error_duration_s=self._max_error_s,
+                        should_abort=self._abort_check, sleep=self._sleep,
+                        max_response_bytes=self._max_response,
+                        acknowledge=self._ack_q.put,
+                        on_round=self._on_round):
+                    self._decode_and_offer(body)
+                with self._cond:
+                    self._remaining -= 1
+                    if self._remaining <= 0:
+                        self._cond.notify_all()
+        except _Stop:
+            return
+        except BaseException as e:
+            self._fail(e)
+
+    def _decode_and_offer(self, body: bytes) -> None:
+        """Deserialize (and LZ4-decode) each page IN the puller thread,
+        then enqueue under backpressure."""
+        view = memoryview(body)
+        pos, n = 0, len(view)
+        while pos < n:
+            _, _, uncompressed, _, _ = _PAGE_HEADER.unpack_from(view, pos)
+            t0 = time.perf_counter()
+            page, nxt = deserialize_page(view, pos, codec=self._codec)
+            dt = time.perf_counter() - t0
+            nbytes = nxt - pos
+            pos = nxt
+            with self._cond:
+                self._decode_wall += dt
+                self._uncompressed += uncompressed
+            EXCHANGE_METRICS.on_page(nbytes, uncompressed, dt)
+            self._offer(page, nbytes)
+
+    def _offer(self, page: Page, nbytes: int) -> None:
+        with self._cond:
+            while (self._buffered
+                   and self._buffered + nbytes > self._max_buffer
+                   and self._error is None and not self._closed):
+                self._cond.wait(0.2)     # producer backpressure: park
+            if self._closed or self._error is not None:
+                raise _Stop()
+            self._queue.append((page, nbytes))
+            self._buffered += nbytes
+            if self._buffered > self._buffered_peak:
+                self._buffered_peak = self._buffered
+            self._pages += 1
+            self._bytes += nbytes
+            self._cond.notify_all()
+        EXCHANGE_METRICS.buffered_delta(nbytes)
+
+    def _fail(self, exc: BaseException) -> None:
+        with self._cond:
+            if self._error is None:
+                self._error = exc
+            self._cond.notify_all()
+
+    def _ack_loop(self) -> None:
+        """Fire-and-forget acknowledges: frees producer buffer memory off
+        the pull critical path (the reference sends these async too)."""
+        while True:
+            url = self._ack_q.get()
+            if url is None or self._closed:
+                return
+            try:
+                _request(url, timeout=10.0).close()
+            except (urllib.error.URLError, TimeoutError, OSError):
+                pass  # optional: an unacked page is re-served, not lost
+
+    # -- consumer side -----------------------------------------------------
+    def pages(self) -> Iterator[Page]:
+        """Arrival-order page stream; raises the first puller error (or
+        whatever should_abort raises).  Closes the client when the
+        generator is exhausted or closed."""
+        try:
+            while True:
+                with self._cond:
+                    while (not self._queue and self._error is None
+                           and self._remaining > 0 and not self._closed):
+                        if self._should_abort is not None:
+                            self._should_abort()
+                        t0 = time.perf_counter()
+                        self._cond.wait(0.1)
+                        self._wait_wall += time.perf_counter() - t0
+                    if self._error is not None:
+                        raise self._error
+                    if self._queue:
+                        page, nbytes = self._queue.popleft()
+                        self._buffered -= nbytes
+                        self._cond.notify_all()  # unpark parked pullers
+                    else:            # complete (or closed underneath us)
+                        if self._should_abort is not None:
+                            self._should_abort()
+                        return
+                EXCHANGE_METRICS.buffered_delta(-nbytes)
+                yield page
+        finally:
+            self.close()
+
+    def close(self) -> None:
+        with self._cond:
+            if self._closed:
+                return
+            self._closed = True
+            leftover = self._buffered
+            self._queue.clear()
+            self._buffered = 0
+            self._cond.notify_all()
+        self._stop_event.set()
+        self._ack_q.put(None)            # wake the ack thread so it exits
+        if leftover:
+            EXCHANGE_METRICS.buffered_delta(-leftover)
+        drain_wall = time.perf_counter() - self._t0
+        EXCHANGE_METRICS.on_client_close(self._wait_wall, drain_wall)
+        if self._stats is not None:
+            nano = 1e9
+            self._stats.add("exchangeClientPullWallNanos",
+                            self._pull_wall * nano, "NANO")
+            self._stats.add("exchangeClientDecodeWallNanos",
+                            self._decode_wall * nano, "NANO")
+            self._stats.add("exchangeClientWaitWallNanos",
+                            self._wait_wall * nano, "NANO")
+            self._stats.add("exchangeClientDrainWallNanos",
+                            drain_wall * nano, "NANO")
+            self._stats.add("exchangeClientBytes", self._bytes, "BYTE")
+            self._stats.add("exchangeClientUncompressedBytes",
+                            self._uncompressed, "BYTE")
+            self._stats.add("exchangeClientPages", self._pages, "NONE")
+            self._stats.add("exchangeClientBufferedPeakBytes",
+                            self._buffered_peak, "BYTE")
+
+    @property
+    def buffered_peak(self) -> int:
+        with self._cond:
+            return self._buffered_peak
 
 
 def remote_page_reader(locations: List[str], codec: str = DEFAULT_CODEC,
                        max_error_duration_s: float =
-                       DEFAULT_MAX_ERROR_DURATION_S):
+                       DEFAULT_MAX_ERROR_DURATION_S,
+                       should_abort: Optional[Callable[[], None]] = None,
+                       client_threads: int = DEFAULT_CLIENT_THREADS,
+                       max_buffer_bytes: int = DEFAULT_MAX_BUFFER_BYTES,
+                       max_response_bytes: int = DEFAULT_MAX_RESPONSE_BYTES,
+                       stats=None):
     """A TaskContext.remote_pages callable: pages from every upstream task
-    feeding one RemoteSourceNode."""
+    feeding one RemoteSourceNode, pulled concurrently through an
+    ExchangeClient.  `should_abort` raises to stop the pull early (worker
+    tasks pass their own terminal-state check so a doomed query's remote
+    sources stop instead of draining to completion)."""
     def read() -> Iterator[Page]:
-        for loc in locations:
-            yield from pull_pages(loc, codec=codec,
-                                  max_error_duration_s=max_error_duration_s)
+        client = ExchangeClient(
+            locations, codec=codec,
+            max_error_duration_s=max_error_duration_s,
+            should_abort=should_abort, client_threads=client_threads,
+            max_buffer_bytes=max_buffer_bytes,
+            max_response_bytes=max_response_bytes, stats=stats)
+        yield from client.pages()        # pages() closes the client
     return read

@@ -97,6 +97,17 @@ def execution_config_from_properties(props: Dict[str, str],
     if "exchange.max-error-duration" in props:
         kw["exchange_max_error_duration_s"] = parse_duration(
             props["exchange.max-error-duration"])
+    if "exchange.client-threads" in props:
+        n = int(props["exchange.client-threads"])
+        if n < 1:
+            raise ValueError(f"exchange.client-threads must be >= 1, got {n}")
+        kw["exchange_client_threads"] = n
+    if "exchange.max-buffer-size" in props:
+        kw["exchange_max_buffer_bytes"] = parse_data_size(
+            props["exchange.max-buffer-size"])
+    if "exchange.max-response-size" in props:
+        kw["exchange_max_response_bytes"] = parse_data_size(
+            props["exchange.max-response-size"])
     if "task.remote-task-retry-attempts" in props:
         kw["remote_task_retry_attempts"] = int(
             props["task.remote-task-retry-attempts"])
@@ -172,6 +183,9 @@ class SystemConfig:
         ("exchange.compression-codec", str, "LZ4"),
         ("exchange.http-client.request-timeout", str, "10s"),
         ("exchange.max-error-duration", str, "1m"),
+        ("exchange.client-threads", int, 4),
+        ("exchange.max-buffer-size", str, "32MB"),
+        ("exchange.max-response-size", str, "1MB"),
         ("announcement-interval-ms", int, 1000),
         ("heartbeat-interval-ms", int, 1000),
         ("async-data-cache-enabled", bool, False),

@@ -309,6 +309,20 @@ def apply_session_properties(config, session: Dict[str, str]):
     if "exchange_max_error_duration" in session:
         kw["exchange_max_error_duration_s"] = parse_duration(
             session["exchange_max_error_duration"])
+    # concurrent exchange client knobs (reference exchange.client-threads /
+    # exchange.max-buffer-size / exchange.max-response-size)
+    if "exchange_client_threads" in session:
+        n = int(session["exchange_client_threads"])
+        if n < 1:
+            raise ValueError(
+                f"exchange_client_threads must be >= 1, got {n}")
+        kw["exchange_client_threads"] = n
+    if "exchange_max_buffer_size" in session:
+        kw["exchange_max_buffer_bytes"] = int(parse_data_size(
+            session["exchange_max_buffer_size"]))
+    if "exchange_max_response_size" in session:
+        kw["exchange_max_response_bytes"] = int(parse_data_size(
+            session["exchange_max_response_size"]))
     if "fault_injection_probability" in session:
         p = float(session["fault_injection_probability"])
         if not 0.0 <= p <= 1.0:

@@ -269,6 +269,38 @@ class _Handler(BaseHTTPRequestHandler):
                     'presto_tpu_worker_alive{worker="%s",draining="%s"} %d'
                     % (uri, str(w["draining"]).lower(),
                        1 if w["alive"] else 0))
+        # exchange-client section: process-wide (one worker per process in
+        # a real deployment; in-process test clusters aggregate, so tests
+        # reset() the singleton before asserting)
+        from .exchange import EXCHANGE_METRICS
+        x = EXCHANGE_METRICS.snapshot()
+        lines += [
+            "# TYPE presto_tpu_exchange_pages_total counter",
+            f"presto_tpu_exchange_pages_total {x['pages']}",
+            "# TYPE presto_tpu_exchange_bytes_total counter",
+            f"presto_tpu_exchange_bytes_total {x['bytes']}",
+            "# TYPE presto_tpu_exchange_uncompressed_bytes_total counter",
+            "presto_tpu_exchange_uncompressed_bytes_total "
+            f"{x['uncompressed_bytes']}",
+            "# TYPE presto_tpu_exchange_responses_total counter",
+            f"presto_tpu_exchange_responses_total {x['responses']}",
+            "# TYPE presto_tpu_exchange_clients_total counter",
+            f"presto_tpu_exchange_clients_total {x['clients']}",
+            "# TYPE presto_tpu_exchange_pull_wall_seconds_total counter",
+            f"presto_tpu_exchange_pull_wall_seconds_total "
+            f"{x['pull_wall_s']:.6f}",
+            "# TYPE presto_tpu_exchange_decode_wall_seconds_total counter",
+            f"presto_tpu_exchange_decode_wall_seconds_total "
+            f"{x['decode_wall_s']:.6f}",
+            "# TYPE presto_tpu_exchange_wait_wall_seconds_total counter",
+            f"presto_tpu_exchange_wait_wall_seconds_total "
+            f"{x['wait_wall_s']:.6f}",
+            "# TYPE presto_tpu_exchange_buffered_bytes gauge",
+            f"presto_tpu_exchange_buffered_bytes {x['buffered_bytes']}",
+            "# TYPE presto_tpu_exchange_buffered_bytes_peak gauge",
+            "presto_tpu_exchange_buffered_bytes_peak "
+            f"{x['buffered_bytes_peak']}",
+        ]
         self._send(200, None, ("\n".join(lines) + "\n").encode(),
                    headers={"Content-Type":
                             "text/plain; version=0.0.4; charset=utf-8"})
@@ -484,8 +516,19 @@ collapse}}td,th{{border:1px solid #ccc;padding:4px 8px;text-align:left}}
     def do_results(self, groups, query):
         task = self.server_ref.task_manager.get(groups["task"])
         max_wait = float(query.get("maxWaitMs", ["1000"])[0]) / 1000.0
+        # X-Presto-Max-Size (PrestoHeaders.java:57): the consumer caps how
+        # many bytes one response may carry; absent means uncapped
+        max_size = self.headers.get("X-Presto-Max-Size")
+        max_bytes = None
+        if max_size:
+            from .protocol import parse_data_size
+            try:
+                max_bytes = parse_data_size(max_size)
+            except (ValueError, TypeError):
+                max_bytes = None
         pages, next_token, complete = task.buffers.get(
-            int(groups["buffer"]), int(groups["token"]), max_wait)
+            int(groups["buffer"]), int(groups["token"]), max_wait,
+            max_bytes=max_bytes)
         body = b"".join(pages)
         # reference header names (PrestoHeaders.java:51-52 /
         # presto_protocol_core.cpp:82-84): the Java ExchangeClient reads

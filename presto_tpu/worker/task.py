@@ -54,6 +54,8 @@ class TpuTask:
         self.output_pages = 0
         self.output_bytes = 0
         self.plan_nodes: List[dict] = []
+        from ..utils.runtime_stats import RuntimeStats
+        self.stats = RuntimeStats()       # exchange-client walls/bytes etc.
         self._cond = threading.Condition()
         self._thread: Optional[threading.Thread] = None
 
@@ -81,6 +83,7 @@ class TpuTask:
                 "bufferedPages": self.output_pages,
                 "peakTotalMemoryInBytes": self.memory_peak,
                 "state": self.state,
+                "runtimeStats": self.stats.to_dict(),
             },
             "pipelines": [{
                 "operators": self.plan_nodes,
@@ -159,6 +162,16 @@ class TpuTask:
                 f"task {self.task_id} failed [{error_type}]: {message}")
         self._set_state(FAILED, message, error_type)
 
+    def _exchange_abort(self) -> None:
+        """should_abort hook for this task's exchange clients: once the
+        task is terminal (FAILED sibling propagated, canceled, finished)
+        every remote-source pull stops promptly instead of draining."""
+        if self.state in DONE_STATES:
+            from .exchange import ExchangeAbortedError
+            raise ExchangeAbortedError(
+                f"task {self.task_id} is {self.state}; aborting exchange "
+                f"pull")
+
     # -- execution ----------------------------------------------------------
     def start(self, update: TaskUpdateRequest) -> None:
         try:
@@ -171,7 +184,8 @@ class TpuTask:
             # re-reads from token 0, so acknowledged pages must survive
             self.buffers = OutputBufferManager(
                 spec.type, spec.n_buffers,
-                retain=cfg.remote_task_retry_attempts > 0)
+                retain=cfg.remote_task_retry_attempts > 0,
+                coalesce_target_bytes=cfg.exchange_max_response_bytes)
             ctx = TaskContext(config=cfg, task_index=update.task_index,
                               memory=MemoryPool(cfg.memory_budget_bytes))
             from .plan_translation import translate_split
@@ -180,11 +194,20 @@ class TpuTask:
                 remote = [s["location"] for s in splits if s.get("remote")]
                 conn = [s for s in splits if not s.get("remote")]
                 if remote:
+                    # should_abort: a sibling failure (or cancel) puts this
+                    # task in a terminal state, and the exchange pull must
+                    # stop with it instead of draining a doomed query
                     ctx.remote_pages[source.plan_node_id] = \
                         remote_page_reader(
                             remote, codec=cfg.exchange_compression_codec,
                             max_error_duration_s=
-                            cfg.exchange_max_error_duration_s)
+                            cfg.exchange_max_error_duration_s,
+                            should_abort=self._exchange_abort,
+                            client_threads=cfg.exchange_client_threads,
+                            max_buffer_bytes=cfg.exchange_max_buffer_bytes,
+                            max_response_bytes=
+                            cfg.exchange_max_response_bytes,
+                            stats=self.stats)
                 if conn:
                     ctx.splits[source.plan_node_id] = [
                         catalog.TableSplit.from_dict(s) for s in conn]

@@ -14,7 +14,8 @@ import pytest
 
 from presto_tpu.analysis.lint import (ALL_LINT_CODES, PRAGMA, SYNC_ASARRAY,
                                       SYNC_BRANCH, SYNC_CAST, SYNC_EXPLICIT,
-                                      lint_or_raise, lint_paths, lint_source)
+                                      SYNC_NETWORK, lint_or_raise, lint_paths,
+                                      lint_source)
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -120,6 +121,50 @@ def test_branch_on_device_bool_flagged():
     assert len(findings) == 2
 
 
+_NET_FIXTURE = ("import urllib.request\n"
+                "def fetch(url):\n"
+                "    return urllib.request.urlopen(url).read()\n")
+
+
+def test_network_call_in_compute_module_flagged():
+    findings = lint_source(_NET_FIXTURE,
+                           path="presto_tpu/exec/bad_net.py")
+    assert _codes(findings) == {SYNC_NETWORK}
+
+
+def test_network_call_outside_compute_paths_not_flagged():
+    # worker-layer code (incl. the sanctioned exchange client) may do
+    # blocking HTTP; the lint scopes SYNC005 to pipeline compute packages
+    for path in ("presto_tpu/worker/exchange.py",
+                 "presto_tpu/worker/coordinator.py",
+                 "tools/fetch.py"):
+        assert lint_source(_NET_FIXTURE, path=path) == []
+
+
+def test_network_parse_and_error_usage_not_flagged():
+    # urllib.parse / urllib.error are metadata, not blocking I/O — they
+    # appear legitimately in exec/lowering.py and common/errors.py
+    findings = lint_source(
+        "from urllib.parse import urlparse\n"
+        "import urllib.error\n"
+        "def f(u):\n"
+        "    try:\n"
+        "        return urlparse(u).netloc\n"
+        "    except urllib.error.URLError:\n"
+        "        return ''\n",
+        path="presto_tpu/exec/lowering.py")
+    assert findings == []
+
+
+def test_network_pragma_suppresses():
+    findings = lint_source(
+        "import urllib.request\n"
+        "def fetch(url):\n"
+        "    return urllib.request.urlopen(url)  # lint: allow-host-sync\n",
+        path="presto_tpu/common/whatever.py")
+    assert findings == []
+
+
 # ---------------------------------------------------------------------------
 # precision: host values and metadata must NOT be flagged
 # ---------------------------------------------------------------------------
@@ -192,5 +237,5 @@ def test_lint_routes_through_error_taxonomy(tmp_path):
 
 def test_all_codes_are_exercised_above():
     assert set(ALL_LINT_CODES) == {SYNC_EXPLICIT, SYNC_CAST, SYNC_ASARRAY,
-                                   SYNC_BRANCH}
+                                   SYNC_BRANCH, SYNC_NETWORK}
     assert PRAGMA == "lint: allow-host-sync"

@@ -377,3 +377,25 @@ def test_lz4_golden_block_decodes():
 def test_compression_ratio_gate_is_reference_value():
     from presto_tpu.common.serde import MINIMUM_COMPRESSION_RATIO
     assert MINIMUM_COMPRESSION_RATIO == 0.9  # PagesSerde.java:44
+
+
+def test_deserialize_accepts_memoryview_zero_copy():
+    """The exchange client walks response bodies as memoryviews; serde
+    must accept buffer input end-to-end (checksummed, compressed, and
+    plain) without requiring a bytes copy of the body."""
+    pages = [Page([long_array_block([1, 2, 3]), int_array_block([7, 8, 9])]),
+             Page([long_array_block(list(range(4096)))])]
+    for compress in (False, True):
+        wire = serialize_pages(pages, compress=compress)
+        for buf in (memoryview(wire), bytearray(wire), wire):
+            got = deserialize_pages(buf)
+            assert len(got) == 2
+            assert got[0].blocks[0].to_pylist() == [1, 2, 3]
+            assert got[1].blocks[0].to_pylist() == list(range(4096))
+    # offset deserialization over a view slices without materializing
+    wire = serialize_pages(pages)
+    view = memoryview(wire)
+    first, pos = deserialize_page(view, 0)
+    second, end = deserialize_page(view, pos)
+    assert end == len(wire)
+    assert second.blocks[0].position_count == 4096
