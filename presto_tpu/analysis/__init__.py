@@ -59,15 +59,16 @@ def use_validation_mode(mode: str):
 from .checker import (  # noqa: E402
     ALL_CHECK_CODES, CHECK_DANGLING_VARIABLE, CHECK_DUPLICATE_NODE_ID,
     CHECK_EXCHANGE_LAYOUT, CHECK_FRAGMENT_BOUNDARY, CHECK_GROUPED_EXECUTION,
-    CHECK_JOIN_KEY_TYPE, CHECK_PARTITIONING, CHECK_TYPE_MISMATCH,
-    PlanChecker, PlanDiagnostic, check_plan, check_subplan, validate_plan,
-    validate_subplan)
+    CHECK_JOIN_KEY_TYPE, CHECK_PARTITIONING, CHECK_SCAN_PUSHDOWN,
+    CHECK_TYPE_MISMATCH, PlanChecker, PlanDiagnostic, check_plan,
+    check_subplan, validate_plan, validate_subplan)
 
 __all__ = [
     "ALL_CHECK_CODES", "CHECK_DANGLING_VARIABLE", "CHECK_DUPLICATE_NODE_ID",
     "CHECK_EXCHANGE_LAYOUT", "CHECK_FRAGMENT_BOUNDARY",
     "CHECK_GROUPED_EXECUTION", "CHECK_JOIN_KEY_TYPE", "CHECK_PARTITIONING",
-    "CHECK_TYPE_MISMATCH", "PlanChecker", "PlanDiagnostic",
+    "CHECK_SCAN_PUSHDOWN", "CHECK_TYPE_MISMATCH", "PlanChecker",
+    "PlanDiagnostic",
     "VALIDATION_MODES", "VALIDATION_OFF", "VALIDATION_ON",
     "VALIDATION_STRICT", "check_plan", "check_subplan", "use_validation_mode",
     "validate_plan", "validate_subplan", "validation_mode",

@@ -58,11 +58,12 @@ CHECK_EXCHANGE_LAYOUT = "EXCHANGE_LAYOUT"
 CHECK_PARTITIONING = "PARTITIONING"
 CHECK_FRAGMENT_BOUNDARY = "FRAGMENT_BOUNDARY"
 CHECK_GROUPED_EXECUTION = "GROUPED_EXECUTION"
+CHECK_SCAN_PUSHDOWN = "SCAN_PUSHDOWN"
 
 ALL_CHECK_CODES = (
     CHECK_DANGLING_VARIABLE, CHECK_DUPLICATE_NODE_ID, CHECK_TYPE_MISMATCH,
     CHECK_JOIN_KEY_TYPE, CHECK_EXCHANGE_LAYOUT, CHECK_PARTITIONING,
-    CHECK_FRAGMENT_BOUNDARY, CHECK_GROUPED_EXECUTION,
+    CHECK_FRAGMENT_BOUNDARY, CHECK_GROUPED_EXECUTION, CHECK_SCAN_PUSHDOWN,
 )
 
 ERROR = "ERROR"
@@ -712,6 +713,73 @@ class ValidateGroupedExecution(FragmentCheck):
                         f"partitioned_sources")
 
 
+class ValidateScanPushdown(Check):
+    """A scan claiming pushed-down predicates must be able to prove the
+    claim: every entry must be range/equality-shaped over a column the
+    scan actually assigns with a plain-numeric literal, and — because the
+    storage layer skips whole chunks on the strength of these entries
+    while relying on the residual filter for exactness — the entry must
+    re-derive from a conjunct of the scan's DIRECT parent FilterNode.  A
+    claim with no parent filter (or not re-derivable from it) means some
+    rewrite moved/edited the filter after plan_scan_pushdown ran, and
+    chunk skipping would silently drop rows."""
+    code = CHECK_SCAN_PUSHDOWN
+
+    def run(self, root, ctx):
+        seen: Set[int] = set()
+
+        def walk(node, path, parent):
+            if id(node) in seen:
+                return
+            seen.add(id(node))
+            here = f"{path}/{_kind(node)}" if path else _kind(node)
+            if isinstance(node, P.TableScanNode) \
+                    and getattr(node, "pushdown", None):
+                self._check_scan(node, here, parent, ctx)
+            for s in node.sources:
+                walk(s, here, node)
+
+        walk(root, "", None)
+
+    def _check_scan(self, scan, path, parent, ctx):
+        from ..storage.pushdown import PUSHDOWN_OPS, extract_pushdown
+        assigned = {c.name for c in scan.assignments.values()}
+        for e in scan.pushdown:
+            col = e.get("column") if isinstance(e, dict) else None
+            op = e.get("op") if isinstance(e, dict) else None
+            val = e.get("value") if isinstance(e, dict) else None
+            if col not in assigned:
+                ctx.add(self.code, scan, path,
+                        f"pushed-down predicate names column {col!r} "
+                        f"which the scan does not assign")
+                continue
+            if op not in PUSHDOWN_OPS:
+                ctx.add(self.code, scan, path,
+                        f"pushed-down predicate on {col!r} has op {op!r} "
+                        f"(not range/equality-shaped: {PUSHDOWN_OPS})")
+                continue
+            if isinstance(val, bool) or not isinstance(val, (int, float)):
+                ctx.add(self.code, scan, path,
+                        f"pushed-down predicate on {col!r} has "
+                        f"non-numeric literal {val!r}")
+        if not isinstance(parent, P.FilterNode):
+            ctx.add(self.code, scan, path,
+                    f"scan claims {len(scan.pushdown)} pushed-down "
+                    f"predicate(s) but its parent is "
+                    f"{_kind(parent) if parent is not None else 'the root'}"
+                    f", not a Filter — the residual filter that makes "
+                    f"chunk skipping safe is missing")
+            return
+        var_to_col = {v.name: c.name for v, c in scan.assignments.items()}
+        derivable = extract_pushdown(parent.predicate, var_to_col)
+        for e in scan.pushdown:
+            if isinstance(e, dict) and e not in derivable:
+                ctx.add(self.code, scan, path,
+                        f"pushed-down predicate {e!r} does not appear "
+                        f"among the parent filter's range/equality "
+                        f"conjuncts")
+
+
 # ---------------------------------------------------------------------------
 # the pluggable checker
 # ---------------------------------------------------------------------------
@@ -719,6 +787,7 @@ class ValidateGroupedExecution(FragmentCheck):
 DEFAULT_CHECKS: Tuple[Check, ...] = (
     NoDuplicatePlanNodeIds(),
     ValidateDependencies(),
+    ValidateScanPushdown(),
 )
 
 DEFAULT_FRAGMENT_CHECKS: Tuple[FragmentCheck, ...] = (

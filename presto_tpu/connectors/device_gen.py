@@ -629,6 +629,27 @@ _DICTS: Dict[Tuple[str, str, str], tuple] = {
 }
 
 
+# resident-storage encoding hints (presto_tpu/storage/encodings.py):
+# columns KNOWN monotone in the row index from the generator structure
+# ("rle" — run-length encodes without paying the empirical run probe's
+# stricter compression bar) or known degenerate ("rle" constants).  The
+# store falls back to empirical selection for unhinted columns.
+_ENCODING_HINTS: Dict[Tuple[str, str, str], str] = {
+    # lineitem rows are grouped by order: orderkey is monotone (~4-row
+    # runs); orders/part/etc. keys are 1-row runs and stay unhinted
+    ("tpch", "lineitem", "orderkey"): "rle",
+    ("tpch", "orders", "shippriority"): "rle",     # constant 0
+    # tpcds co-bucket layouts: sales/returns rows grouped by order
+    ("tpcds", "web_sales", "ws_order_number"): "rle",
+    ("tpcds", "web_returns", "wr_order_number"): "rle",
+    ("tpcds", "store_sales", "ss_ticket_number"): "rle",
+}
+
+
+def encoding_hint(connector: str, table: str, column: str) -> Optional[str]:
+    return _ENCODING_HINTS.get((connector, table, column))
+
+
 def supported(connector: str, table: str, column: str) -> bool:
     entry = _TABLES.get((connector, table))
     return entry is not None and column in entry[1]

@@ -191,6 +191,15 @@ class FusedChain:
             while p < split.end:
                 chunks.append((p, min(cap, split.end - p)))
                 p += cap
+        zm = self.scan_meta.get("zone_maps")
+        pd = self.scan_meta.get("pushdown")
+        if zm and pd:
+            # zone-map chunk skipping: host numpy over build-time stats.
+            # The pruned list is DETERMINISTIC per compiled plan (the
+            # pushed-down constants are plan constants), so the chunk
+            # count baked into cached fori_loop programs stays stable
+            from ..storage import prune_chunks
+            chunks, _skipped = prune_chunks(chunks, zm, pd)
         return chunks
 
     def leaf_cap(self, expands: Tuple[int, ...]) -> int:

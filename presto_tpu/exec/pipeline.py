@@ -219,6 +219,22 @@ class ExecutionConfig:
     # optimizer-rule firing; "off" disables.  Violations raise the
     # non-retryable PLAN_VALIDATION error
     plan_validation: str = "on"
+    # -- HBM-resident columnar storage (presto_tpu/storage) ---------------
+    # scans materialize device-generated columns once per process into an
+    # encoded resident cache with zone maps; False = regenerate per chunk
+    storage_enabled: bool = True
+    # LRU budget for resident encoded bytes (charged to the store's
+    # MemoryPool; over-budget columns fall back to on-the-fly generation)
+    storage_budget_bytes: Optional[int] = 6 << 30
+    # a column whose PLAIN bytes exceed this is never materialized (the
+    # build transiently holds ~2x plain bytes)
+    storage_max_column_bytes: int = 1 << 30
+    # zone-map granularity in rows: chunk pruning aggregates the zones
+    # covering each scan chunk, so finer zones prune better and cost
+    # (n_rows / zone_rows) host floats per column
+    storage_zone_rows: int = 1 << 16
+    # dictionary/RLE encodings for resident columns; False = plain only
+    storage_encodings: bool = True
 
 
 def tuned_config(**overrides) -> "ExecutionConfig":
@@ -418,68 +434,12 @@ class PlanCompiler:
         return BatchSource(gen, src.names, src.types)
 
     # -- leaves -----------------------------------------------------------
-    # HBM-resident cache of device-generated columns.  Generating a column
-    # is a uint64 splitmix hash per row — 64-bit integer multiplies are
-    # EMULATED on the TPU vector unit and dominate fused-scan wall clock
-    # (measured at SF10: shipdate generation alone cost 3x the whole
-    # aggregation).  Generated connector data is immutable, so whole-table
-    # columns are materialized into HBM ONCE and every scan chunk becomes a
-    # dynamic_slice — the reference analog is Velox reading an in-memory
-    # columnar table instead of recomputing it (writes never touch
-    # generated catalogs).  The budget is a HIGH-WATER MARK with no
-    # eviction: evicting would free nothing (compiled plans keep the
-    # arrays referenced), so once full, further columns simply stay
-    # on-the-fly — which is also how SF100-class columns behave (each
-    # exceeds the budget alone).
-    DEV_COL_CACHE_BUDGET = 6 << 30
-    # per-column cap: building a column transiently holds ~2x its bytes
-    # (chunk parts + concatenated result), so multi-GB columns (SF100
-    # lineitem) must stay on-the-fly or the build itself OOMs HBM
-    DEV_COL_MAX_BYTES = 1 << 30
-
-    _dev_col_cache: "Dict[tuple, jnp.ndarray]" = {}
-    _dev_col_cache_bytes = [0]
-
-    @classmethod
-    def _device_column_cached(cls, cid, table, colname, sf, n_rows,
-                              pad, as_i32):
-        from ..connectors import device_gen
-        key = (cid, table, colname, float(sf), bool(as_i32))
-        arr = cls._dev_col_cache.get(key)
-        if arr is not None:
-            if arr.shape[0] >= n_rows + pad:
-                return arr
-            # built under a smaller batch capacity: rebuild with the
-            # larger tail padding (chunk slices must never clamp; the old
-            # array stays pinned by already-compiled plans — bounded
-            # overshoot, same column)
-            cls._dev_col_cache.pop(key)
-            cls._dev_col_cache_bytes[0] -= arr.nbytes
-        itemsize = 4 if as_i32 else 8
-        need = (n_rows + pad) * itemsize
-        if need > cls.DEV_COL_MAX_BYTES \
-                or cls._dev_col_cache_bytes[0] + need \
-                > cls.DEV_COL_CACHE_BUDGET:
-            return None
-        chunk = 1 << 22
-
-        @jax.jit
-        def gen_chunk(pos):
-            idx = pos + jnp.arange(chunk, dtype=jnp.int64)
-            v = device_gen.column(cid, table, colname, sf, idx)
-            return v.astype(jnp.int32) if as_i32 and v.dtype == jnp.int64 \
-                else v
-
-        parts = [gen_chunk(jnp.int64(p))
-                 for p in range(0, n_rows, chunk)]
-        arr = jnp.concatenate(parts)[:n_rows]
-        # zero tail padding: chunk slices never clamp-shift at the table
-        # edge (dynamic_slice clamping would silently misalign live rows)
-        arr = jnp.concatenate(
-            [arr, jnp.zeros(pad, dtype=arr.dtype)])
-        cls._dev_col_cache[key] = arr
-        cls._dev_col_cache_bytes[0] += arr.nbytes
-        return arr
+    # HBM-resident storage of device-generated columns lives in
+    # presto_tpu/storage: generating a column is a uint64 splitmix hash
+    # per row — 64-bit integer multiplies are EMULATED on the TPU vector
+    # unit and dominate fused-scan wall clock — so whole-table columns
+    # materialize ONCE into an encoded LRU cache with zone maps, and
+    # every scan chunk becomes a slice_decode.
 
     def _compile_TableScanNode(self, node: P.TableScanNode) -> BatchSource:
         names = [v.name for v in node.outputs]
@@ -515,20 +475,35 @@ class PlanCompiler:
                          == "INT_ARRAY")
                for _n, colname, kind in dev if kind == "gen"}
 
-        # HBM-cached whole-table columns (see _device_column_cached): the
+        # HBM-resident whole-table columns (presto_tpu/storage): the
         # decision is made at trace time, so cache eligible columns BEFORE
         # the kernels compile.  Budgeted runs keep the pure-kernel path
-        # (cache residency is outside their accounting).
-        cached_cols: Dict[str, jnp.ndarray] = {}
-        if self.ctx.memory.budget is None and dev:
+        # (cache residency is outside their accounting).  A column the
+        # store cannot fit (tight storage budget, SF100-class size) comes
+        # back None and stays on-the-fly — graceful degradation, never
+        # MemoryExceededError.
+        cfg = self.ctx.config
+        cached_cols: Dict[str, object] = {}
+        zone_maps: Dict[str, object] = {}
+        if self.ctx.memory.budget is None and dev and cfg.storage_enabled:
+            from ..storage import get_store
+            store = get_store(cfg.storage_budget_bytes,
+                              cfg.storage_max_column_bytes)
             n_rows = catalog.table_row_count(table, sf, cid)
             for _name, colname, kind in dev:
                 if kind != "gen":
                     continue
-                arr = self._device_column_cached(
-                    cid, table, colname, sf, n_rows, cap, i32[colname])
-                if arr is not None:
-                    cached_cols[colname] = arr
+                ent = store.get_or_build(
+                    cid, table, colname, sf, n_rows, cap, i32[colname],
+                    zone_rows=cfg.storage_zone_rows,
+                    encodings=cfg.storage_encodings)
+                if ent is not None:
+                    cached_cols[colname] = ent.column
+                    zone_maps[colname] = ent.zones
+        # advisory chunk-skip metadata: conjuncts the optimizer pushed
+        # down (plan_scan_pushdown) — the parent FilterNode still runs,
+        # so pruning only has to be conservative, not exact
+        pushdown = [dict(e) for e in getattr(node, "pushdown", ())]
 
         def make_factory(cap2):
             """Pure scan kernel at an arbitrary chunk capacity (fused join
@@ -551,7 +526,10 @@ class PlanCompiler:
                         continue
                     arr = cached.get(colname)
                     if arr is not None:
-                        v = jax.lax.dynamic_slice(arr, (pos,), (cap2,))
+                        # ResidentColumn: encoded HBM bytes stream out,
+                        # decode (dict gather / RLE searchsorted) runs in
+                        # vector registers — late materialization
+                        v = arr.slice_decode(pos, cap2)
                     else:
                         v = device_gen.column(cid, table, colname, sf, idx)
                         if v.dtype == jnp.int64 and i32[colname]:
@@ -563,10 +541,23 @@ class PlanCompiler:
         make = make_factory(cap)
         dev_make = self.shared_jit((node.id, "scan_make", cap), make)
 
+        def split_chunks(split):
+            out = []
+            p = split.start
+            while p < split.end:
+                out.append((p, min(cap, split.end - p)))
+                p += cap
+            if zone_maps and pushdown:
+                # zone-map chunk skipping (host numpy over build-time
+                # stats); the FilterNode above re-filters survivors, so
+                # skipping is free of correctness burden beyond the
+                # conservative unsatisfiability rules
+                from ..storage import prune_chunks
+                out, _skipped = prune_chunks(out, zone_maps, pushdown)
+            return out
+
         def split_gen(split):
-                pos = split.start
-                while pos < split.end:
-                    n = min(cap, split.end - pos)
+                for pos, n in split_chunks(split):
                     cols = {}
                     if dev:
                         douts, dmask = dev_make(jnp.int64(pos),
@@ -623,7 +614,6 @@ class PlanCompiler:
                         m[:n] = True
                         mask = jnp.asarray(m)
                     yield Batch(cols, mask)
-                    pos += n
 
         def gen():
             tc = self.ctx.config.task_concurrency
@@ -656,6 +646,10 @@ class PlanCompiler:
                 # lineage metadata for grouped (lifespan) execution
                 "table": table, "cid": cid, "sf": sf,
                 "colmap": {name: colname for name, colname, _k in dev},
+                # zone-map chunk skipping inside FusedChain.chunks_for:
+                # host-side stats keyed by connector column name, matched
+                # against the scan's pushed-down conjuncts
+                "zone_maps": zone_maps, "pushdown": pushdown,
             }
         return src
 

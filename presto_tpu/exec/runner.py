@@ -239,11 +239,30 @@ class LocalQueryRunner:
             out = optimize(out)
             sections.append(("post-optimize",
                              check_plan(out, "post-optimize")))
+            # scan-pushdown decisions: collected BEFORE fragmentation
+            # (plan_distributed moves the scans into fragment subplans,
+            # mutating this tree); appended OUTSIDE format_validation so
+            # informational entries don't count as diagnostics
+            seen, decisions = set(), []
+            for n in P.walk_plan(out):
+                if id(n) in seen or not isinstance(n, P.TableScanNode):
+                    continue
+                seen.add(id(n))
+                tname = f"{n.table.connector_id}.{n.table.table_name}"
+                if getattr(n, "pushdown", None):
+                    for e in n.pushdown:
+                        decisions.append(
+                            f"  {tname} [{n.id}]: "
+                            f"{e['column']} {e['op']} {e['value']}")
+                else:
+                    decisions.append(f"  {tname} [{n.id}]: (no pushdown)")
             subplan = plan_distributed(out, self._fragmenter_config())
             sections.append(("post-fragment",
                              check_subplan(subplan, "post-fragment",
                                            exec_config=self.config)))
         text = format_validation(sections)
+        text += "\n\n== scan-pushdown ==\n" + "\n".join(
+            decisions if decisions else ["  (no table scans)"])
         return QueryResult(["Query Plan"], [VarcharType(max(1, len(text)))],
                            [[text]])
 

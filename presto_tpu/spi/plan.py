@@ -153,23 +153,34 @@ class TableScanNode(PlanNode):
     table: TableHandle
     outputs: List[Variable] = field(default_factory=list)
     assignments: Dict[Variable, ColumnHandle] = field(default_factory=dict)
+    # range/equality conjuncts pushed down from the parent FilterNode by
+    # sql/optimizer.plan_scan_pushdown: [{"column", "op", "value"}, ...]
+    # with op in storage.pushdown.PUSHDOWN_OPS.  ADVISORY — consumed for
+    # zone-map chunk skipping; the filter itself stays in the plan.
+    # Validated by analysis/checker.py (SCAN_PUSHDOWN).
+    pushdown: List[dict] = field(default_factory=list)
 
     @property
     def output_variables(self):
         return self.outputs
 
     def _to_dict(self):
-        return {"table": self.table.to_dict(),
-                "outputVariables": _vars_to_dict(self.outputs),
-                "assignments": [{"variable": v.to_dict(), "column": c.to_dict()}
-                                for v, c in self.assignments.items()]}
+        d = {"table": self.table.to_dict(),
+             "outputVariables": _vars_to_dict(self.outputs),
+             "assignments": [{"variable": v.to_dict(), "column": c.to_dict()}
+                             for v, c in self.assignments.items()]}
+        if self.pushdown:
+            # emitted only when present: golden plan JSON stays stable
+            d["pushdown"] = [dict(e) for e in self.pushdown]
+        return d
 
     @classmethod
     def _from_dict(cls, d):
         return cls(d["id"], TableHandle.from_dict(d["table"]),
                    _vars_from_dict(d["outputVariables"]),
                    {RowExpression.from_dict(e["variable"]): ColumnHandle.from_dict(e["column"])
-                    for e in d["assignments"]})
+                    for e in d["assignments"]},
+                   [dict(e) for e in d.get("pushdown", [])])
 
 
 @_node

@@ -22,8 +22,13 @@ def _vars(vs, limit: int = 6) -> str:
 
 def _details(node: P.PlanNode) -> str:
     if isinstance(node, P.TableScanNode):
-        return (f"table = {node.table.connector_id}.{node.table.table_name}"
-                f" [{_vars(node.outputs)}]")
+        s = (f"table = {node.table.connector_id}.{node.table.table_name}"
+             f" [{_vars(node.outputs)}]")
+        pd = getattr(node, "pushdown", None)
+        if pd:
+            s += ", pushdown = [" + ", ".join(
+                f"{e['column']} {e['op']} {e['value']}" for e in pd) + "]"
+        return s
     if isinstance(node, P.FilterNode):
         return f"predicate = {node.predicate}"
     if isinstance(node, P.ProjectNode):

@@ -193,6 +193,28 @@ def plan_dynamic_filters(root: P.PlanNode) -> P.PlanNode:
     return root
 
 
+def plan_scan_pushdown(root: P.PlanNode) -> P.PlanNode:
+    """Record range/equality-shaped conjuncts of a filter sitting directly
+    on a table scan as the scan's pushdown metadata (the reference analog
+    is PickTableLayout/TupleDomain pushdown into the connector).
+
+    The FilterNode is NOT removed: pushdown here is advisory, consumed by
+    the resident-storage scan for zone-map chunk skipping
+    (storage/pushdown.py), and the residual exact filter preserves
+    semantics unconditionally.  Runs after the iterative rules so filter
+    merging/pushdown has already parked each scan's conjunction directly
+    above it."""
+    from ..storage.pushdown import extract_pushdown
+    for node in P.walk_plan(root):
+        if not isinstance(node, P.FilterNode) \
+                or not isinstance(node.source, P.TableScanNode):
+            continue
+        scan = node.source
+        var_to_col = {v.name: c.name for v, c in scan.assignments.items()}
+        scan.pushdown = extract_pushdown(node.predicate, var_to_col)
+    return root
+
+
 def hoist_join_filter_string_calls(root: P.PlanNode) -> P.PlanNode:
     """Rewrite substr/like calls inside JOIN ON-filters into columns
     projected below the join when their argument is an open-domain
@@ -279,5 +301,6 @@ def optimize(root: P.PlanNode) -> P.PlanNode:
     root = IterativeOptimizer(DEFAULT_RULES).run(root, rule_stats)
     root = prune_unused_outputs(root)
     root = plan_dynamic_filters(root)
+    root = plan_scan_pushdown(root)
     root.rule_stats = rule_stats
     return root

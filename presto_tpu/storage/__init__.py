@@ -1,0 +1,23 @@
+"""HBM-resident encoded columnar storage (see store.py for the design).
+
+Public surface:
+- ResidentColumn / encode_column / ZoneMaps / build_zone_maps (encodings)
+- ResidentStore / get_store / STORAGE_METRICS / reset_storage_metrics
+- extract_pushdown / prune_chunks / PUSHDOWN_OPS (pushdown)
+"""
+from .encodings import (DICT_MAX_NDV, ResidentColumn, ZoneMaps,
+                        build_zone_maps, encode_column)
+from .pushdown import (PUSHDOWN_OPS, entry_unsatisfiable, extract_pushdown,
+                       prune_chunks, split_conjuncts)
+from .store import (DEFAULT_MAX_COLUMN_BYTES, DEFAULT_STORAGE_BUDGET,
+                    DEFAULT_ZONE_ROWS, STORAGE_METRICS, ResidentEntry,
+                    ResidentStore, get_store, reset_storage_metrics)
+
+__all__ = [
+    "DICT_MAX_NDV", "ResidentColumn", "ZoneMaps", "build_zone_maps",
+    "encode_column", "PUSHDOWN_OPS", "entry_unsatisfiable",
+    "extract_pushdown", "prune_chunks", "split_conjuncts",
+    "DEFAULT_MAX_COLUMN_BYTES", "DEFAULT_STORAGE_BUDGET",
+    "DEFAULT_ZONE_ROWS", "STORAGE_METRICS", "ResidentEntry",
+    "ResidentStore", "get_store", "reset_storage_metrics",
+]

@@ -1,0 +1,185 @@
+"""Scan predicate pushdown metadata and zone-map chunk pruning.
+
+`plan_scan_pushdown` (sql/optimizer.py) records on each TableScanNode
+the conjuncts of its parent FilterNode that are range/equality-shaped
+(``col <op> literal`` with op in eq/lt/lte/gt/gte, or BETWEEN) as plain
+``{"column", "op", "value"}`` dicts — serializable, checker-visible
+(analysis/checker.py SCAN_PUSHDOWN), and consumed at execution by
+`prune_chunks` to skip whole scan chunks whose zone-map [min, max]
+cannot satisfy the conjunction.
+
+Pruning is ADVISORY: the FilterNode stays in the plan and re-filters
+every surviving row exactly, so over-inclusion is harmless and the only
+correctness obligation here is conservatism — a chunk is skipped ONLY
+when no value in its zone range can pass.  All decisions are host-side
+numpy over stats captured at build time (encodings.build_zone_maps);
+nothing here touches the device.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+PUSHDOWN_OPS = ("eq", "lt", "lte", "gt", "gte")
+
+_CMP_ALIASES = {
+    "lt": "lt", "less_than": "lt",
+    "lte": "lte", "less_than_or_equal": "lte",
+    "gt": "gt", "greater_than": "gt",
+    "gte": "gte", "greater_than_or_equal": "gte",
+    "eq": "eq", "equal": "eq",
+}
+_FLIP = {"lt": "gt", "lte": "gte", "gt": "lt", "gte": "lte", "eq": "eq"}
+
+
+def _literal(expr, var) -> Optional[float]:
+    """The constant's numeric value in STORED-column units, or None.
+
+    Mirrors exec/lowering.constant_device_value, which is what the
+    residual filter itself compares against, but only when the units
+    provably line up with the column `var` is bound to:
+
+    - decimal constants are unscaled ints at the constant's scale;
+      accepted only against a decimal column of the SAME scale (decimal
+      device columns are stored unscaled at their declared scale);
+    - date constants become epoch-day ints, accepted against date
+      columns (stored as epoch-day i32);
+    - plain int/float constants are accepted against non-decimal
+      columns (a raw int against an unscaled decimal column would be
+      off by 10^scale and make pruning unsound).
+    """
+    from ..common.types import DateType, DecimalType
+    from ..spi.expr import ConstantExpression
+    if not isinstance(expr, ConstantExpression) or expr.value is None:
+        return None
+    vt = getattr(var, "type", None)
+    if isinstance(expr.type, (DecimalType, DateType)):
+        if isinstance(expr.type, DecimalType) and not (
+                isinstance(vt, DecimalType)
+                and vt.scale == expr.type.scale
+                # a float typed decimal would be truncated, not scaled
+                and not isinstance(expr.value, float)):
+            return None
+        if isinstance(expr.type, DateType) and not isinstance(vt, DateType):
+            return None
+        from ..exec.lowering import constant_device_value
+        v = constant_device_value(expr.value, expr.type)
+        return v if isinstance(v, int) else None
+    v = expr.value
+    if isinstance(v, bool) or not isinstance(v, (int, float)):
+        return None
+    if isinstance(vt, DecimalType):
+        return None
+    return v
+
+
+def split_conjuncts(expr) -> List:
+    """Flatten an AND tree into its conjuncts."""
+    from ..spi.expr import SpecialFormExpression
+    if isinstance(expr, SpecialFormExpression) and expr.form == "AND":
+        out: List = []
+        for a in expr.arguments:
+            out.extend(split_conjuncts(a))
+        return out
+    return [expr]
+
+
+def conjunct_to_entries(expr, var_to_col: Dict[str, str]) -> List[dict]:
+    """Pushdown entries for ONE conjunct ([] when it isn't range-shaped)."""
+    from ..exec.lowering import canonical_name
+    from ..spi.expr import (CallExpression, ConstantExpression,
+                            VariableReferenceExpression)
+    if not isinstance(expr, CallExpression):
+        return []
+    name = canonical_name(expr.display_name)
+    args = expr.arguments
+    if name == "between" and len(args) == 3 \
+            and isinstance(args[0], VariableReferenceExpression):
+        col = var_to_col.get(args[0].name)
+        lo, hi = _literal(args[1], args[0]), _literal(args[2], args[0])
+        if col is None or lo is None or hi is None:
+            return []
+        return [{"column": col, "op": "gte", "value": lo},
+                {"column": col, "op": "lte", "value": hi}]
+    op = _CMP_ALIASES.get(name)
+    if op is None or len(args) != 2:
+        return []
+    a, b = args
+    if isinstance(a, ConstantExpression) \
+            and isinstance(b, VariableReferenceExpression):
+        a, b = b, a
+        op = _FLIP[op]
+    if not isinstance(a, VariableReferenceExpression):
+        return []
+    col = var_to_col.get(a.name)
+    v = _literal(b, a)
+    if col is None or v is None:
+        return []
+    return [{"column": col, "op": op, "value": v}]
+
+
+def extract_pushdown(predicate, var_to_col: Dict[str, str]) -> List[dict]:
+    """All range/equality-shaped conjuncts of `predicate`, as entries."""
+    out: List[dict] = []
+    for c in split_conjuncts(predicate):
+        out.extend(conjunct_to_entries(c, var_to_col))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# chunk pruning
+# ---------------------------------------------------------------------------
+
+def entry_unsatisfiable(op: str, value, zmin, zmax) -> bool:
+    """True when NO value in [zmin, zmax] can satisfy ``col <op> value``.
+
+    Empty zones carry reduction-identity bounds (zmin > zmax), which is
+    unsatisfiable for every op — correct, since a zone with no values
+    has no row that can pass."""
+    if zmin > zmax:
+        return True
+    if op == "eq":
+        return value < zmin or value > zmax
+    if op == "lt":
+        return zmin >= value
+    if op == "lte":
+        return zmin > value
+    if op == "gt":
+        return zmax <= value
+    if op == "gte":
+        return zmax < value
+    return False
+
+
+def prune_chunks(chunks: List[Tuple[int, int]], zone_maps: Dict,
+                 pushdown: List[dict]):
+    """Drop chunks no pushed-down conjunct combination can satisfy.
+
+    Returns (kept_chunks, skipped_count).  A conjunction skips a chunk
+    when ANY single conjunct is unsatisfiable over the chunk's
+    aggregated zone bounds.  At least one chunk is always kept: fused
+    consumers bake len(chunks) into compiled fori_loop programs and a
+    zero-chunk scan would leave them nothing to fold over (the residual
+    filter turns the survivor into zero rows anyway).
+    """
+    from .store import STORAGE_METRICS
+    kept: List[Tuple[int, int]] = []
+    for pos, count in chunks:
+        skip = False
+        for e in pushdown:
+            zm = zone_maps.get(e["column"])
+            if zm is None:
+                continue
+            bounds = zm.chunk_bounds(pos, count)
+            if bounds is None:
+                continue
+            if entry_unsatisfiable(e["op"], e["value"], *bounds):
+                skip = True
+                break
+        if not skip:
+            kept.append((pos, count))
+    if not kept and chunks:
+        kept = [chunks[0]]
+    skipped = len(chunks) - len(kept)
+    STORAGE_METRICS["chunks_total"] += len(chunks)
+    STORAGE_METRICS["chunks_skipped"] += skipped
+    return kept, skipped

@@ -1,0 +1,202 @@
+"""Process-wide HBM-resident columnar store with LRU eviction.
+
+Generating a connector column is a uint64 splitmix hash per row —
+64-bit integer multiplies are EMULATED on the TPU vector unit and
+dominate fused-scan wall clock (measured at SF10: shipdate generation
+alone cost 3x the whole aggregation).  Generated connector data is
+immutable, so whole-table columns are materialized into HBM ONCE,
+encoded (encodings.py), zone-mapped, and every scan chunk becomes a
+`slice_decode` — the reference analog is Velox reading an in-memory
+columnar table instead of recomputing it.
+
+Residency is charged to an `exec.memory.MemoryPool` (the same
+accounting type task execution uses, so the cache composes with memory
+arbitration/spill work):
+
+- insertion evicts least-recently-used entries until the new column's
+  encoded bytes fit the `storage` budget;
+- a column that cannot fit even alone is simply NOT cached — the scan
+  falls back to on-the-fly generation.  The budget degrades throughput,
+  never correctness, and never raises MemoryExceededError.
+
+Eviction releases the store's reference and accounting immediately;
+the arrays themselves leave HBM when the last compiled plan holding
+them is dropped (plans receive resident columns as traced arguments,
+not closures, so nothing is baked into executables).
+"""
+from __future__ import annotations
+
+import functools
+from collections import OrderedDict
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..exec.memory import MemoryPool
+from .encodings import (ResidentColumn, ZoneMaps, build_zone_maps,
+                        encode_column)
+
+DEFAULT_STORAGE_BUDGET = 6 << 30
+# building a column transiently holds ~2x its plain bytes (chunk parts
+# + concatenated result), so multi-GB columns (SF100 lineitem) must stay
+# on-the-fly or the build itself OOMs HBM
+DEFAULT_MAX_COLUMN_BYTES = 1 << 30
+DEFAULT_ZONE_ROWS = 1 << 16
+# columns at or under this row count take the host-side stats path at
+# build time (one device_get, numpy selection); larger columns keep all
+# probes on device so a SF10+ build never round-trips gigabytes
+HOST_STATS_ROWS = 1 << 20
+
+# process-wide observability counters, consumed by bench.py and tests;
+# chunks_total/chunks_skipped are bumped by pushdown.prune_chunks every
+# time a chunk list is enumerated, so the skip FRACTION stays exact even
+# though repeated enumerations inflate both counters proportionally
+STORAGE_METRICS: Dict[str, int] = {}
+
+
+def reset_storage_metrics() -> None:
+    STORAGE_METRICS.update({
+        "cache_hits": 0, "cache_misses": 0, "columns_built": 0,
+        "build_rejected": 0, "evictions": 0, "resident_bytes": 0,
+        "encoded_bytes": 0, "plain_bytes": 0,
+        "chunks_total": 0, "chunks_skipped": 0,
+    })
+
+
+reset_storage_metrics()
+
+
+class ResidentEntry:
+    """One cached column: encoded device arrays + host-side zone maps."""
+
+    __slots__ = ("column", "zones", "nbytes", "pad")
+
+    def __init__(self, column: ResidentColumn, zones: ZoneMaps,
+                 pad: int):
+        self.column = column
+        self.zones = zones
+        self.nbytes = column.nbytes
+        self.pad = pad
+
+
+class ResidentStore:
+    """LRU cache of ResidentEntry keyed (connector, table, column, sf,
+    as_i32), charged to its own MemoryPool."""
+
+    def __init__(self, budget: Optional[int] = DEFAULT_STORAGE_BUDGET,
+                 max_column_bytes: int = DEFAULT_MAX_COLUMN_BYTES):
+        self.pool = MemoryPool(budget)
+        self.max_column_bytes = max_column_bytes
+        self.entries: "OrderedDict[tuple, ResidentEntry]" = OrderedDict()
+
+    # -- lookup / build ---------------------------------------------------
+    def get_or_build(self, cid: str, table: str, colname: str, sf: float,
+                     n_rows: int, pad: int, as_i32: bool,
+                     zone_rows: int = DEFAULT_ZONE_ROWS,
+                     encodings: bool = True) -> Optional[ResidentEntry]:
+        key = (cid, table, colname, float(sf), bool(as_i32))
+        ent = self.entries.get(key)
+        if ent is not None:
+            if ent.pad >= pad:
+                self.entries.move_to_end(key)
+                STORAGE_METRICS["cache_hits"] += 1
+                return ent
+            # built under a smaller batch capacity: rebuild with the
+            # larger tail padding (chunk slices must never clamp)
+            self._evict(key)
+        STORAGE_METRICS["cache_misses"] += 1
+        itemsize = 4 if as_i32 else 8
+        if (n_rows + pad) * itemsize > self.max_column_bytes:
+            STORAGE_METRICS["build_rejected"] += 1
+            return None
+        arr = _build_full(cid, table, colname, sf, n_rows, pad, as_i32)
+        from ..connectors import device_gen
+        hint = device_gen.encoding_hint(cid, table, colname)
+        # for small columns, pull the padded column to the host once and
+        # run encoding selection + zone reduction in numpy — dozens of
+        # tiny per-column device programs collapse into one transfer
+        host = None
+        if n_rows <= HOST_STATS_ROWS:
+            # build-time stat transfer, once per column per process
+            host = jax.device_get(arr)  # lint: allow-host-sync
+        col = encode_column(arr, n_rows, encodings=encodings, hint=hint,
+                            host=host)
+        zones = build_zone_maps(arr, n_rows, zone_rows, host=host)
+        del arr, host
+        ent = ResidentEntry(col, zones, pad)
+        while not self.pool.try_reserve(ent.nbytes):
+            if not self.entries:
+                STORAGE_METRICS["build_rejected"] += 1
+                return None
+            oldest = next(iter(self.entries))
+            self._evict(oldest)
+        self.entries[key] = ent
+        STORAGE_METRICS["columns_built"] += 1
+        STORAGE_METRICS["encoded_bytes"] += ent.nbytes
+        STORAGE_METRICS["plain_bytes"] += col.logical_nbytes
+        STORAGE_METRICS["resident_bytes"] = self.pool.reserved
+        return ent
+
+    def _evict(self, key: tuple) -> None:
+        ent = self.entries.pop(key)
+        self.pool.free(ent.nbytes)
+        STORAGE_METRICS["evictions"] += 1
+        STORAGE_METRICS["resident_bytes"] = self.pool.reserved
+
+    def clear(self) -> None:
+        for key in list(self.entries):
+            ent = self.entries.pop(key)
+            self.pool.free(ent.nbytes)
+        STORAGE_METRICS["resident_bytes"] = self.pool.reserved
+
+
+@functools.lru_cache(maxsize=None)
+def _gen_fn(cid: str, table: str, colname: str, sf: float, chunk: int,
+            as_i32: bool):
+    """Jitted whole-chunk generator, cached so pad-growth rebuilds and
+    differently-budgeted stores reuse the compiled executable."""
+    from ..connectors import device_gen
+
+    @jax.jit
+    def gen_chunk(pos):
+        idx = pos + jnp.arange(chunk, dtype=jnp.int64)
+        v = device_gen.column(cid, table, colname, sf, idx)
+        return v.astype(jnp.int32) if as_i32 and v.dtype == jnp.int64 \
+            else v
+
+    return gen_chunk
+
+
+def _build_full(cid: str, table: str, colname: str, sf: float,
+                n_rows: int, pad: int, as_i32: bool):
+    """Materialize one whole column on device via the jitted counter-hash
+    generator, zero tail padding appended (chunk slices never clamp-shift
+    at the table edge — dynamic_slice clamping would silently misalign
+    live rows).  The chunk is the next power of two covering the table
+    (capped at 4M rows): tiny catalog tables don't pay a 4M-row hash,
+    and pow2 bucketing keeps compile-cache reuse across similar sizes."""
+    chunk = 1 << max(10, min(22, (max(n_rows, 1) - 1).bit_length()))
+    gen_chunk = _gen_fn(cid, table, colname, float(sf), chunk, bool(as_i32))
+    parts = [gen_chunk(jnp.int64(p)) for p in range(0, n_rows, chunk)]
+    arr = jnp.concatenate(parts)[:n_rows]
+    return jnp.concatenate([arr, jnp.zeros(pad, dtype=arr.dtype)])
+
+
+# ---------------------------------------------------------------------------
+# store registry: one store per (budget, max_column_bytes) configuration,
+# so a test running under a deliberately tiny budget never pollutes (or
+# borrows from) the default 6 GiB process store
+# ---------------------------------------------------------------------------
+
+_STORES: Dict[tuple, ResidentStore] = {}
+
+
+def get_store(budget: Optional[int] = DEFAULT_STORAGE_BUDGET,
+              max_column_bytes: int = DEFAULT_MAX_COLUMN_BYTES
+              ) -> ResidentStore:
+    key = (budget, max_column_bytes)
+    st = _STORES.get(key)
+    if st is None:
+        st = _STORES[key] = ResidentStore(budget, max_column_bytes)
+    return st

@@ -16,6 +16,7 @@ from presto_tpu.analysis import (CHECK_DANGLING_VARIABLE,
                                  CHECK_FRAGMENT_BOUNDARY,
                                  CHECK_GROUPED_EXECUTION,
                                  CHECK_JOIN_KEY_TYPE, CHECK_PARTITIONING,
+                                 CHECK_SCAN_PUSHDOWN,
                                  CHECK_TYPE_MISMATCH, VALIDATION_OFF,
                                  check_plan, check_subplan,
                                  use_validation_mode, validate_plan,
@@ -336,6 +337,88 @@ def test_explain_type_validate_rejects_bad_type():
     from presto_tpu.sql.parser import parse_sql
     with pytest.raises(Exception):
         parse_sql("EXPLAIN (TYPE SIDEWAYS) SELECT 1")
+
+
+# ---------------------------------------------------------------------------
+# SCAN_PUSHDOWN: a scan's pushed-down predicates must re-derive from its
+# direct parent filter (storage/pushdown.py skips chunks on their word)
+# ---------------------------------------------------------------------------
+
+def _pushdown_plan(pushdown, predicate=None, with_filter=True):
+    from presto_tpu.spi.expr import call
+    v = V("l_orderkey_0", BIGINT)
+    scan = P.TableScanNode(
+        "s0", P.TableHandle("tpch", "tpch", "lineitem",
+                            (("scaleFactor", 0.01),)),
+        [v], {v: P.ColumnHandle("orderkey", BIGINT)}, list(pushdown))
+    if not with_filter:
+        return P.OutputNode("o0", scan, ["l_orderkey"], [v])
+    if predicate is None:
+        predicate = call("lt", BOOLEAN, v, ConstantExpression(5, BIGINT))
+    filt = P.FilterNode("f0", scan, predicate)
+    return P.OutputNode("o0", filt, ["l_orderkey"], [v])
+
+
+def test_scan_pushdown_valid_claim_passes():
+    out = _pushdown_plan([{"column": "orderkey", "op": "lt", "value": 5}])
+    assert check_plan(out) == []
+
+
+def test_scan_pushdown_fires_on_bad_op():
+    out = _pushdown_plan([{"column": "orderkey", "op": "neq", "value": 5}])
+    diags = check_plan(out)
+    assert CHECK_SCAN_PUSHDOWN in _codes(diags)
+    assert any("neq" in d.message for d in diags)
+
+
+def test_scan_pushdown_fires_on_unassigned_column():
+    out = _pushdown_plan([{"column": "shipdate", "op": "lt", "value": 5}])
+    diags = check_plan(out)
+    assert CHECK_SCAN_PUSHDOWN in _codes(diags)
+    assert any("does not assign" in d.message for d in diags)
+
+
+def test_scan_pushdown_fires_on_non_numeric_literal():
+    out = _pushdown_plan([{"column": "orderkey", "op": "lt", "value": "x"}])
+    diags = check_plan(out)
+    assert CHECK_SCAN_PUSHDOWN in _codes(diags)
+    assert any("non-numeric" in d.message for d in diags)
+
+
+def test_scan_pushdown_fires_without_parent_filter():
+    out = _pushdown_plan([{"column": "orderkey", "op": "lt", "value": 5}],
+                         with_filter=False)
+    diags = check_plan(out)
+    assert CHECK_SCAN_PUSHDOWN in _codes(diags)
+    assert any("not a Filter" in d.message for d in diags)
+
+
+def test_scan_pushdown_fires_when_not_derivable_from_filter():
+    # the filter says > 5; a claimed < 5 pushdown would skip chunks the
+    # residual filter still wants
+    from presto_tpu.spi.expr import call
+    v = V("l_orderkey_0", BIGINT)
+    pred = call("gt", BOOLEAN, v, ConstantExpression(5, BIGINT))
+    out = _pushdown_plan([{"column": "orderkey", "op": "lt", "value": 5}],
+                         predicate=pred)
+    diags = check_plan(out)
+    assert CHECK_SCAN_PUSHDOWN in _codes(diags)
+    assert any("does not appear" in d.message for d in diags)
+
+
+def test_optimizer_populates_pushdown_that_validates():
+    """plan_scan_pushdown's own output must satisfy the checker, and the
+    VALIDATE explain must surface the per-scan decisions."""
+    from presto_tpu.exec.runner import LocalQueryRunner
+    r = LocalQueryRunner("sf0.01")
+    res = r.execute(
+        "EXPLAIN (TYPE VALIDATE) SELECT count(*) FROM lineitem "
+        "WHERE l_orderkey < 40 AND l_shipdate >= DATE '1994-01-01'")
+    text = res.rows[0][0]
+    assert "plan validation PASSED" in text
+    assert "== scan-pushdown ==" in text
+    assert "orderkey lt 40" in text
+    assert "shipdate gte 8766" in text     # epoch days, column units
 
 
 # ---------------------------------------------------------------------------
