@@ -11,7 +11,15 @@ vs_baseline = speedup vs the single-threaded numpy reference interpreter
               each round so the ratio tracks engine improvements only.
 
 Env knobs: BENCH_SF (default 10), BENCH_RUNS (default 3),
-BENCH_QUERY (q1|q6|q6z|q3g|xchg).
+BENCH_QUERY (q1|q6|q6z|q3g|xchg|serve).
+
+BENCH_QUERY=serve is the serving-tier benchmark: BENCH_SERVE_CLIENTS
+concurrent statement-protocol clients (default 4) each issuing
+BENCH_SERVE_REQUESTS parameterized EXECUTEs (default 15) over repeated
+TPC-H shapes against one coordinator.  Reports p50/p99 latency, QPS,
+and the canonical plan-cache hit rate (>= 0.9 expected after warmup —
+everything after the first compile of each shape skips
+parse/plan/optimize and XLA compilation).
 
 BENCH_QUERY=q6z is Q6 plus a selective orderkey range predicate
 (cutting the bottom BENCH_Q6Z_FRACTION of the key domain, default 2%).
@@ -180,6 +188,102 @@ def bench_xchg(runs):
             w.close()
 
 
+SERVE_SHAPES = [
+    # (name, template, [value tuples cycled by the clients])
+    ("q6p",
+     "SELECT sum(l_extendedprice * l_discount) FROM lineitem "
+     "WHERE l_discount BETWEEN ? AND ? AND l_quantity < ?",
+     [("0.05", "0.07", "24"), ("0.04", "0.06", "25"),
+      ("0.06", "0.08", "23"), ("0.03", "0.05", "30")]),
+    ("scanp",
+     "SELECT count(*), sum(l_extendedprice) FROM lineitem "
+     "WHERE l_quantity < ? AND l_orderkey < ?",
+     [("10", "1000"), ("20", "2000"), ("30", "3000"), ("15", "1500")]),
+]
+
+
+def bench_serve(runs):
+    """Serving-tier benchmark: N concurrent clients hammering repeated
+    parameterized shapes through the statement protocol.  The canonical
+    plan cache + prepared fast path should absorb everything after the
+    warmup (plan_cache_hit_rate >= 0.9), leaving execution as the cost."""
+    sf = float(os.environ.get("BENCH_SF", "0.1"))
+    n_clients = int(os.environ.get("BENCH_SERVE_CLIENTS", "4"))
+    per_client = int(os.environ.get("BENCH_SERVE_REQUESTS", "15"))
+
+    import threading
+
+    from presto_tpu.client import StatementClient
+    from presto_tpu.serving import (GLOBAL_PLAN_CACHE, PREPARED_REGISTRY,
+                                    SERVING_METRICS)
+    from presto_tpu.worker.server import WorkerServer
+
+    schema = f"sf{sf:g}"
+    server = WorkerServer(coordinator=True)
+    try:
+        warm = StatementClient(server.uri, schema=schema)
+        for name, template, values in SERVE_SHAPES:
+            warm.prepared[name] = template
+            for vals in values[:1]:     # one compile per shape
+                warm.execute(f"EXECUTE {name} USING {', '.join(vals)}")
+        SERVING_METRICS.reset()
+
+        latencies, lat_lock = [], threading.Lock()
+
+        def client_loop(cid):
+            c = StatementClient(server.uri, schema=schema,
+                                source=f"bench-{cid}")
+            c.prepared = {n: t for n, t, _ in SERVE_SHAPES}
+            mine = []
+            for i in range(per_client):
+                name, _t, values = SERVE_SHAPES[(cid + i) % len(SERVE_SHAPES)]
+                vals = values[(cid * per_client + i) % len(values)]
+                t0 = time.perf_counter()
+                r = c.execute(f"EXECUTE {name} USING {', '.join(vals)}")
+                mine.append(time.perf_counter() - t0)
+                assert r.rows, "serve query returned no rows"
+            with lat_lock:
+                latencies.extend(mine)
+
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=client_loop, args=(i,))
+                   for i in range(n_clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+
+        latencies.sort()
+        n = len(latencies)
+        sv = SERVING_METRICS.snapshot()
+        out = {
+            "metric": f"serve_sf{sf:g}_qps",
+            "value": round(n / wall, 2),
+            "unit": "queries/s",
+            "wall_s": round(wall, 4),
+            "serve": {
+                "clients": n_clients,
+                "requests": n,
+                "p50_latency_ms": round(latencies[n // 2] * 1000, 2),
+                "p99_latency_ms": round(
+                    latencies[min(n - 1, int(n * 0.99))] * 1000, 2),
+                "plan_cache_hit_rate": round(SERVING_METRICS.hit_rate(), 4),
+                "plan_cache_hits": sv["planCacheHits"],
+                "plan_cache_misses": sv["planCacheMisses"],
+                "executable_builds": sv["executableBuilds"],
+                "prepared_fast_path": sv["preparedFastPath"],
+                "prepared_replans": sv["preparedReplans"],
+                "plan_cache_entries": GLOBAL_PLAN_CACHE.info()["entries"],
+                "prepared_statements":
+                    PREPARED_REGISTRY.info()["statements"],
+            },
+        }
+        print(json.dumps(out))
+    finally:
+        server.close()
+
+
 def _backend_diagnostic(qname, exc):
     """Structured JSON on backend-init failure: the opaque rc=1 of
     BENCH_r05.json becomes an actionable record (what failed, on which
@@ -211,6 +315,8 @@ def main():
         return 1
     if qname == "xchg":
         return bench_xchg(runs)
+    if qname == "serve":
+        return bench_serve(runs)
     sf = float(os.environ.get("BENCH_SF", "10"))
     sql = {"q1": Q1, "q6": Q6, "q6z": Q6, "q3g": Q3G}[qname]
     if qname == "q6z":

@@ -758,6 +758,10 @@ class ValidateScanPushdown(Check):
                         f"pushed-down predicate on {col!r} has op {op!r} "
                         f"(not range/equality-shaped: {PUSHDOWN_OPS})")
                 continue
+            if isinstance(val, (list, tuple)) and len(val) == 2 \
+                    and val[0] == "param" and isinstance(val[1], int) \
+                    and not isinstance(val[1], bool) and val[1] >= 0:
+                continue        # bound-parameter marker, resolved at prune
             if isinstance(val, bool) or not isinstance(val, (int, float)):
                 ctx.add(self.code, scan, path,
                         f"pushed-down predicate on {col!r} has "

@@ -68,7 +68,8 @@ ALL_LINT_CODES = (SYNC_EXPLICIT, SYNC_CAST, SYNC_ASARRAY, SYNC_BRANCH,
 # stays legal everywhere — only the blocking CALLS below are hazards.
 _NETWORK_PATH_MARKERS = ("presto_tpu/exec/", "presto_tpu/common/",
                          "presto_tpu/ops/", "presto_tpu/parallel/",
-                         "presto_tpu/connectors/", "presto_tpu/storage/")
+                         "presto_tpu/connectors/", "presto_tpu/storage/",
+                         "presto_tpu/serving/")
 # the worker exchange client is THE sanctioned network home; everything
 # else in the marked packages must stay network-free by construction
 _NETWORK_ALLOWLIST = ("presto_tpu/worker/exchange.py",)

@@ -48,10 +48,16 @@ class StatementClient:
         self.catalog = catalog
         self.schema = schema
         self.session: Dict[str, str] = dict(session or {})
+        # server-side prepared statements, replayed as headers on every
+        # request and updated from X-Presto-Added-Prepare /
+        # X-Presto-Deallocated-Prepare responses (StatementClientV1's
+        # preparedStatements map)
+        self.prepared: Dict[str, str] = {}
         self.timeout_s = timeout_s
 
     def _request(self, url: str, method: str = "GET",
                  data: Optional[bytes] = None, _hops: int = 0) -> dict:
+        from urllib.parse import quote_plus, unquote_plus
         headers = {
             "X-Presto-User": self.user,
             "X-Presto-Source": self.source,
@@ -61,11 +67,22 @@ class StatementClient:
         if self.session:
             headers["X-Presto-Session"] = ",".join(
                 f"{k}={v}" for k, v in self.session.items())
+        if self.prepared:
+            headers["X-Presto-Prepared-Statement"] = ",".join(
+                f"{quote_plus(k)}={quote_plus(v)}"
+                for k, v in self.prepared.items())
         req = urllib.request.Request(url, data=data, method=method,
                                      headers=headers)
         try:
             with urllib.request.urlopen(req, timeout=30) as resp:
                 body = resp.read()
+                added = resp.headers.get("X-Presto-Added-Prepare")
+                if added and "=" in added:
+                    k, v = added.split("=", 1)
+                    self.prepared[unquote_plus(k)] = unquote_plus(v)
+                dealloc = resp.headers.get("X-Presto-Deallocated-Prepare")
+                if dealloc:
+                    self.prepared.pop(unquote_plus(dealloc), None)
         except urllib.error.HTTPError as e:
             if e.code in (307, 308) and "Location" in e.headers:
                 if _hops >= 5:

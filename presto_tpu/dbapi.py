@@ -45,22 +45,30 @@ class OperationalError(DatabaseError):
 
 def connect(uri: str, user: str = "user", catalog: str = "tpch",
             schema: str = "sf0.01",
-            session: Optional[Dict[str, str]] = None) -> "Connection":
-    return Connection(uri, user, catalog, schema, session)
+            session: Optional[Dict[str, str]] = None,
+            server_side_binding: bool = True) -> "Connection":
+    """`server_side_binding=False` falls back to the legacy client-side
+    textual '?' substitution; the default binds parameters on the server
+    through EXECUTE ... USING, which lets the coordinator's canonical plan
+    cache reuse one compiled executable across parameter values."""
+    return Connection(uri, user, catalog, schema, session,
+                      server_side_binding)
 
 
 class Connection:
     def __init__(self, uri: str, user: str, catalog: str, schema: str,
-                 session: Optional[Dict[str, str]]):
+                 session: Optional[Dict[str, str]],
+                 server_side_binding: bool = True):
         self._client = StatementClient(uri, user=user, catalog=catalog,
                                        schema=schema, session=session,
                                        source="presto-tpu-dbapi")
+        self.server_side_binding = server_side_binding
         self._closed = False
 
     def cursor(self) -> "Cursor":
         if self._closed:
             raise InterfaceError("connection is closed")
-        return Cursor(self._client)
+        return Cursor(self._client, self.server_side_binding)
 
     def close(self) -> None:
         self._closed = True
@@ -120,8 +128,10 @@ def _quote(v) -> str:
 class Cursor:
     arraysize = 1
 
-    def __init__(self, client: StatementClient):
+    def __init__(self, client: StatementClient,
+                 server_side_binding: bool = True):
         self._client = client
+        self._server_side_binding = server_side_binding
         self._rows: List[Sequence] = []
         self._pos = 0
         self.description = None
@@ -138,10 +148,23 @@ class Cursor:
                 raise ProgrammingError(
                     f"statement has {len(parts) - 1} placeholders but "
                     f"{len(parameters)} parameters were given")
-            sql = "".join(
-                p + (_quote(v) if i < len(parameters) else "")
-                for i, (p, v) in enumerate(
-                    zip(parts, list(parameters) + [None])))
+            if self._server_side_binding:
+                # register the '?' template in the client's prepared map
+                # (replayed as a header each request — no PREPARE round
+                # trip needed) and bind values server-side so the
+                # coordinator's canonical plan cache reuses one compiled
+                # executable across parameter values
+                import hashlib
+                name = "stmt_" + hashlib.sha1(
+                    sql.encode()).hexdigest()[:12]
+                self._client.prepared[name] = sql
+                sql = (f"EXECUTE {name} USING "
+                       + ", ".join(_quote(v) for v in parameters))
+            else:
+                sql = "".join(
+                    p + (_quote(v) if i < len(parameters) else "")
+                    for i, (p, v) in enumerate(
+                        zip(parts, list(parameters) + [None])))
         try:
             result = self._client.execute(sql)
         except QueryError as e:

@@ -101,6 +101,13 @@ class Batch:
     def __init__(self, columns: Dict[str, Column], mask):
         self.columns = columns
         self.mask = mask
+        # Bound-parameter vector (serving tier): a tuple of device scalars
+        # read by Lowering for BoundParameterExpression.  NOT part of the
+        # pytree: parameterized steps take the vector as an explicit jit
+        # argument and attach it inside the trace (Batch.with_params), so a
+        # flatten/unflatten round trip intentionally drops it — params never
+        # bake into a cached executable.
+        self.params = None
 
     def tree_flatten(self):
         names = tuple(sorted(self.columns))
@@ -127,6 +134,11 @@ class Batch:
 
     def with_mask(self, mask) -> "Batch":
         return Batch(self.columns, mask)
+
+    def with_params(self, params) -> "Batch":
+        out = Batch(self.columns, self.mask)
+        out.params = params
+        return out
 
     def row_count(self):
         return jnp.sum(self.mask)

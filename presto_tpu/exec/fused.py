@@ -175,6 +175,24 @@ class FusedChain:
         self.steps = steps
         self.scan_meta = scan_meta
         self.cap = scan_meta["cap"]
+        # parameterized probe expressions ride the traced aux pytree (last
+        # element) so re-executions with different bound constants reuse
+        # the compiled program; parameterized BUILD subtrees and pushdown
+        # markers instead force per-execution refresh of cached prep/chunk
+        # state (see fused_stream / run_fused)
+        from .lowering import expr_has_params
+        self.has_params = any(
+            (s[0] == "filter" and expr_has_params(s[1]))
+            or (s[0] == "project"
+                and any(expr_has_params(e) for _v, e in s[1]))
+            for s in steps)
+        self.build_params = any(
+            '"@type": "parameter"' in P.structural_key(
+                s[1].right if s[0] == "join" else s[1].filtering_source)
+            for s in steps if s[0] in ("join", "semi"))
+        self.params_pushdown = any(
+            isinstance(e.get("value"), (list, tuple))
+            for e in scan_meta.get("pushdown") or ())
         self.chunks = self.chunks_for((1,) * sum(
             1 for s in steps if s[0] in ("join", "semi")))
         self.total_rows = sum(n for _, n in self.chunks)
@@ -195,11 +213,14 @@ class FusedChain:
         pd = self.scan_meta.get("pushdown")
         if zm and pd:
             # zone-map chunk skipping: host numpy over build-time stats.
-            # The pruned list is DETERMINISTIC per compiled plan (the
-            # pushed-down constants are plan constants), so the chunk
-            # count baked into cached fori_loop programs stays stable
+            # For plan constants the pruned list is DETERMINISTIC per
+            # compiled plan; ["param", i] marker entries resolve against
+            # the CURRENT execution's parameter fingerprint, so consumers
+            # that bake chunk counts into cached programs must recompute
+            # this list per execution when self.params_pushdown is set
             from ..storage import prune_chunks
-            chunks, _skipped = prune_chunks(chunks, zm, pd)
+            chunks, _skipped = prune_chunks(
+                chunks, zm, pd, self.compiler.ctx.params_fingerprint)
         return chunks
 
     def leaf_cap(self, expands: Tuple[int, ...]) -> int:
@@ -259,6 +280,11 @@ class FusedChain:
             kprod *= k
         if kprod > MAX_EXPAND_PRODUCT:
             return None
+        if self.has_params:
+            # LAST so join/semi aux indexing (aux[ji + 1]) is unaffected;
+            # traced, so a different parameter vector re-runs the same
+            # compiled program instead of retracing
+            aux.append(self.compiler.ctx.params)
         return tuple(aux), tuple(expands), deferred
 
     def _build_for(self, build_node: P.PlanNode, keys: Tuple[str, ...],
@@ -278,13 +304,22 @@ class FusedChain:
         batch = Batch({n: Column(v, None, dicts.get(n))
                        for n, v in outs.items()}, live)
         low = self.compiler.lowering
+        params = aux[-1] if self.has_params else None
+
+        def _pb(b):
+            # bound-parameter vector rides along for expression lowering
+            # (Batch.params is not a pytree child, so every derived Batch
+            # above dropped it)
+            return b.with_params(params) if self.has_params else b
         ji = 0                      # join/semi ordinal; aux[0] = scan cache
         for step in self.steps:
             kind = step[0]
             if kind == "filter":
-                batch = ops.apply_filter(batch, low.eval(step[1], batch))
+                batch = ops.apply_filter(batch,
+                                         low.eval(step[1], _pb(batch)))
             elif kind == "project":
-                batch = Batch({v.name: low.eval(e, batch)
+                pb = _pb(batch)
+                batch = Batch({v.name: low.eval(e, pb)
                                for v, e in step[1]}, batch.mask)
             elif kind == "rename":
                 batch = Batch({o: batch.columns[i] for o, i in step[1]},
@@ -559,9 +594,13 @@ def fused_materialize(compiler, node: P.PlanNode,
         return None     # budgeted runs keep the accounted streaming path
     # keyed STRUCTURALLY so replayed subtrees (scalar-subquery re-plans,
     # decorrelated copies — fresh node ids, same shape) share one
-    # materialization; on a hit from a twin, columns rename positionally
-    ckey = ("fmat_result", P.structural_key(node),
-            compiler._splits_fingerprint(node))
+    # materialization; on a hit from a twin, columns rename positionally.
+    # Parameterized subtrees append the execution's parameter fingerprint:
+    # the cached batch is a function of the bound constants
+    sk = P.structural_key(node)
+    ckey = ("fmat_result", sk, compiler._splits_fingerprint(node))
+    if '"@type": "parameter"' in sk:
+        ckey += (compiler.ctx.params_fingerprint,)
     if cache and ckey in compiler._jit_cache:
         cached, names = compiler._jit_cache[ckey]
         return _renamed_batch(cached, names,
@@ -668,9 +707,34 @@ def fused_stream(compiler, node: P.PlanNode):
         @jax.jit
         def step(pos, valid, aux):
             return chain.make(pos, valid, aux, expands, leaf_cap)
-        ent = (step, aux, chunks)
+        ent = (step, aux, chunks, chain, expands,
+               compiler.ctx.params_fingerprint)
         compiler._jit_cache[key] = ent
-    step, aux, chunks = ent
+    step, aux, chunks, chain, expands, ent_fp = ent
+
+    # re-executions with different bound parameters: cached aux carries
+    # the FIRST execution's parameter vector (and possibly stale build
+    # tables / chunk lists) — refresh what depends on the params.  The
+    # jitted step takes aux as a traced argument, so none of this retraces
+    # unless a parameterized build's fanout changed.
+    cur_fp = compiler.ctx.params_fingerprint
+    if chain.build_params and cur_fp != ent_fp:
+        try:
+            prep_res = chain.prep()
+        except NotImplementedError:
+            prep_res = None
+        if prep_res is None or prep_res[1] != expands:
+            # build no longer fusible (or its fanout changed) under the
+            # new constants: drop the entry and rebuild from scratch
+            compiler._jit_cache.pop(key, None)
+            return fused_stream(compiler, node)
+        aux = prep_res[0]
+        compiler._jit_cache[key] = (step, aux, chunks, chain, expands,
+                                    cur_fp)
+    if chain.has_params:
+        aux = aux[:-1] + (compiler.ctx.params,)
+    if chain.params_pushdown:
+        chunks = chain.chunks_for(expands)
 
     def gen():
         for pos, cnt in chunks:

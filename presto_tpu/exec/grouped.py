@@ -161,6 +161,10 @@ class GroupedRunner:
         self.key_dicts = key_dicts
         self.probe_table = probe_table
         self.leaf_cap = chain.leaf_cap(expands)
+        # parameter fingerprint the shared aux / bucket-0 probe / fanout
+        # reservations were built under; the caller rebuilds the runner
+        # when a parameterized BUILD subtree sees a different fingerprint
+        self.params_fp = compiler.ctx.params_fingerprint
         self._sort_progs: Dict[int, callable] = {}
         # bucket-0 (aux, dup flags) built during eligibility; consumed by
         # the first run() so the build work is not repeated
@@ -263,6 +267,10 @@ class GroupedRunner:
             aux, dups = aux0
         else:
             aux, dups = self._bucket_aux(bucket)
+        if self.chain.has_params:
+            # shared aux carries the params vector bound when the runner
+            # was built — swap in this execution's (traced arg: no retrace)
+            aux = tuple(aux)[:-1] + (self.compiler.ctx.params,)
         pos_arr = jnp.asarray([c[0] for c in chunks], dtype=jnp.int64)
         cnt_arr = jnp.asarray([c[1] for c in chunks], dtype=jnp.int64)
         return len(chunks), pos_arr, cnt_arr, aux, dups
@@ -344,6 +352,12 @@ def make_grouped_runner(compiler, node, chain, key_names, specs,
         return None
     if not basic_specs:
         return None
+    # parameterized chains are fine here: probe-side params ride the last
+    # aux slot and _stage_bucket swaps in each execution's vector, and
+    # bucketed builds re-materialize per run with the current params.
+    # Shared builds / fanout reservations ARE frozen at build time, so
+    # the caller rebuilds the runner when chain.build_params and the
+    # fingerprint moved (see the gen() guard in pipeline.py).
     # PARTIAL is safe: each bucket's exact aggregate is a valid partial
     # state for the decomposable basic aggs, and the FINAL stage merges
     # per-bucket rows the same way it merges per-task rows

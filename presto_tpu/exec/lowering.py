@@ -50,7 +50,8 @@ import numpy as np
 from ..common.types import (BIGINT, BOOLEAN, DATE, DOUBLE, INTEGER, BooleanType,
                             CharType, DateType, DecimalType, DoubleType,
                             IntegerType, RealType, Type, VarcharType)
-from ..spi.expr import (CallExpression, ConstantExpression, RowExpression,
+from ..spi.expr import (BoundParameterExpression, CallExpression,
+                        ConstantExpression, RowExpression,
                         SpecialFormExpression, VariableReferenceExpression)
 from .batch import Batch, Column
 
@@ -170,6 +171,17 @@ def constant_device_value(value, typ: Type):
 # main lowering
 # ---------------------------------------------------------------------------
 
+def expr_has_params(expr: RowExpression) -> bool:
+    """Whether a RowExpression tree contains serving-tier bound-parameter
+    leaves (pipeline/fused use this at compile time to decide whether a
+    step takes the parameter vector as a jit argument)."""
+    if isinstance(expr, BoundParameterExpression):
+        return True
+    if isinstance(expr, (CallExpression, SpecialFormExpression)):
+        return any(expr_has_params(a) for a in expr.arguments)
+    return False
+
+
 class Lowering:
     """Compiles a RowExpression tree to a function Batch -> Column."""
 
@@ -190,6 +202,8 @@ class Lowering:
             return self._call(expr, batch)
         if isinstance(expr, SpecialFormExpression):
             return self._special(expr, batch)
+        if isinstance(expr, BoundParameterExpression):
+            return self._parameter(expr, batch)
         raise NotImplementedError(type(expr).__name__)
 
     # -- constants --------------------------------------------------------
@@ -208,6 +222,16 @@ class Lowering:
             # string literal: single-entry dictionary, code 0 everywhere
             return Column(jnp.zeros(cap, dtype=jnp.int32), None, (str(v),))
         arr = jnp.full(cap, v, dtype=_jnp_dtype(expr.type))
+        return Column(arr, None)
+
+    def _parameter(self, expr: BoundParameterExpression, batch: Batch) -> Column:
+        if batch.params is None:
+            raise RuntimeError(
+                f"BoundParameterExpression ?{expr.index} evaluated on a batch "
+                "with no bound-parameter vector attached (serving bug: the "
+                "step was compiled without params plumbing)")
+        v = batch.params[expr.index]
+        arr = jnp.full(batch.capacity, v, dtype=_jnp_dtype(expr.type))
         return Column(arr, None)
 
     # -- calls ------------------------------------------------------------

@@ -39,7 +39,7 @@ from ..spi import plan as P
 from .batch import (Batch, Column, batch_to_page, page_to_batch,
                     pages_to_batches)
 from . import operators as ops
-from .lowering import Lowering, canonical_name
+from .lowering import Lowering, canonical_name, expr_has_params
 from .memory import (MemoryExceededError, MemoryPool, PartitionedSpillStore,
                      batch_bytes)
 
@@ -281,6 +281,14 @@ class TaskContext:
     # runner-provided RuntimeStats sink (utils/runtime_stats.py): grouped
     # execution records per-bucket generation/compute walls here
     runtime_stats: Optional[object] = None
+    # serving tier (sql/canonical.py): the bound-parameter vector for this
+    # execution.  `params` holds device scalars that ride parameterized
+    # steps as jit arguments (so one executable serves every binding);
+    # `params_fingerprint` holds the host values, appended to
+    # value-sensitive result-cache keys (materialized builds) whenever the
+    # cached subtree contains parameter leaves
+    params: Optional[Tuple] = None
+    params_fingerprint: Optional[Tuple] = None
 
 
 def _var_types(variables) -> List[Type]:
@@ -553,7 +561,8 @@ class PlanCompiler:
                 # skipping is free of correctness burden beyond the
                 # conservative unsatisfiability rules
                 from ..storage import prune_chunks
-                out, _skipped = prune_chunks(out, zone_maps, pushdown)
+                out, _skipped = prune_chunks(out, zone_maps, pushdown,
+                                             self.ctx.params_fingerprint)
             return out
 
         def split_gen(split):
@@ -783,11 +792,19 @@ class PlanCompiler:
                 return
             if "step" not in cache:
                 (pred,), hoisted = hoister.resolve(first)
-
-                def step(batch, _pred=pred):
-                    return ops.apply_filter(batch, low.eval(_pred, batch))
-
-                cache["step"] = self.shared_jit((node.id, "filter"), step)
+                if expr_has_params(pred):
+                    # bound parameters ride as an explicit jit argument so
+                    # the trace is reused across constant bindings
+                    def pstep(batch, params, _pred=pred):
+                        return ops.apply_filter(
+                            batch, low.eval(_pred, batch.with_params(params)))
+                    jitted = self.shared_jit((node.id, "filter"), pstep)
+                    cache["step"] = \
+                        lambda b, _j=jitted: _j(b, self.ctx.params)
+                else:
+                    def step(batch, _pred=pred):
+                        return ops.apply_filter(batch, low.eval(_pred, batch))
+                    cache["step"] = self.shared_jit((node.id, "filter"), step)
                 cache["hoisted"] = hoisted
             step, hoisted = cache["step"], cache["hoisted"]
             for b in itertools.chain([first], it):
@@ -810,13 +827,21 @@ class PlanCompiler:
                 return
             if "step" not in cache:
                 exprs, hoisted = hoister.resolve(first)
-
-                def step(batch, _exprs=exprs):
-                    cols = {v.name: low.eval(e, batch)
-                            for (v, _), e in zip(items, _exprs)}
-                    return Batch(cols, batch.mask)
-
-                cache["step"] = self.shared_jit((node.id, "project"), step)
+                if any(expr_has_params(e) for e in exprs):
+                    def pstep(batch, params, _exprs=exprs):
+                        pb = batch.with_params(params)
+                        cols = {v.name: low.eval(e, pb)
+                                for (v, _), e in zip(items, _exprs)}
+                        return Batch(cols, batch.mask)
+                    jitted = self.shared_jit((node.id, "project"), pstep)
+                    cache["step"] = \
+                        lambda b, _j=jitted: _j(b, self.ctx.params)
+                else:
+                    def step(batch, _exprs=exprs):
+                        cols = {v.name: low.eval(e, batch)
+                                for (v, _), e in zip(items, _exprs)}
+                        return Batch(cols, batch.mask)
+                    cache["step"] = self.shared_jit((node.id, "project"), step)
                 cache["hoisted"] = hoisted
             step, hoisted = cache["step"], cache["hoisted"]
             for b in itertools.chain([first], it):
@@ -1348,8 +1373,16 @@ class PlanCompiler:
             # build tables are deterministic per plan (generated connectors
             # are immutable; writes clear the runner's plan cache), so prep
             # results persist across re-executions — the warm path costs
-            # zero host syncs for builds
+            # zero host syncs for builds.  Parameterized BUILD subtrees are
+            # the exception: their tables are a function of the bound
+            # constants, so prep re-runs when the fingerprint moved.
+            pfp = (self.ctx.params_fingerprint
+                   if (chain.has_params or chain.build_params
+                       or chain.params_pushdown) else None)
             prep_res = fused_cache.get("prep")
+            if prep_res is not None and chain.build_params \
+                    and fused_cache.get("prep_fp") != pfp:
+                prep_res = None
             if prep_res is None:
                 try:
                     prep_res = chain.prep()
@@ -1358,7 +1391,13 @@ class PlanCompiler:
                 if prep_res is None:
                     return None
                 fused_cache["prep"] = prep_res
+                fused_cache["prep_fp"] = pfp
             aux, expands, _deferred = prep_res
+            if chain.has_params:
+                # cached prep carries the FIRST execution's parameter
+                # vector in the last aux slot — swap in the current one
+                # (traced argument: no retrace)
+                aux = aux[:-1] + (self.ctx.params,)
             leaf_cap = chain.leaf_cap(expands)
             chunks = chain.chunks_for(expands)
             try:
@@ -1385,7 +1424,6 @@ class PlanCompiler:
                                   dtype=jnp.int64)
             cnt_arr = jnp.asarray([c1 for _, c1 in chunks],
                                   dtype=jnp.int64)
-            S = len(chunks)
 
             def loop(key, update, init_state):
                 """fori_loop over scan chunks; the jitted program is cached
@@ -1399,7 +1437,11 @@ class PlanCompiler:
                             b = chain.make(pos_arr[i], cnt_arr[i], aux,
                                            expands, leaf_cap)
                             return update(st, b)
-                        return jax.lax.fori_loop(0, S, body, state)
+                        # chunk count from the traced shape, NOT a closure
+                        # constant: param-aware pruning may change it
+                        # between executions (shape change -> retrace)
+                        return jax.lax.fori_loop(0, pos_arr.shape[0],
+                                                 body, state)
                     fused_cache[key] = run_all
                 return run_all(pos_arr, cnt_arr, init_state, aux)
 
@@ -1491,11 +1533,13 @@ class PlanCompiler:
                             return (los, his)
                         k = len(cand_names)
                         return jax.lax.fori_loop(
-                            0, S, body,
+                            0, pos_arr.shape[0], body,
                             (jnp.full(k, ops.INT64_MAX, dtype=jnp.int64),
                              jnp.full(k, ops.INT64_MIN, dtype=jnp.int64)))
                     fused_cache[("span_probe", cand_names, expands)] = spanp
-                span_key = ("span_range", cand_names, expands)
+                # data-dependent (not shape-only) results are a function
+                # of the bound parameters: key them by fingerprint
+                span_key = ("span_range", cand_names, expands, pfp)
                 if span_key in fused_cache:
                     ranges = fused_cache[span_key]
                 else:
@@ -1518,7 +1562,7 @@ class PlanCompiler:
                                 else 2)
                         viable.append((rank, -span, ci, span, lo))
                 viable.sort()
-                anchor_key = ("span_anchor", cand_names, expands)
+                anchor_key = ("span_anchor", cand_names, expands, pfp)
                 cached_anchor = fused_cache.get(anchor_key)
                 if cached_anchor is not None:
                     # -1 = every candidate failed once; don't re-pay the
@@ -1555,8 +1599,8 @@ class PlanCompiler:
                                         st, b, codes,
                                         {k: b.columns[k]
                                          for k in dep_names}, G)
-                                state = jax.lax.fori_loop(0, S, body,
-                                                          state)
+                                state = jax.lax.fori_loop(
+                                    0, pos_arr.shape[0], body, state)
                                 dep_ok = ops.depkey_verify(
                                     state, state["__seen"], dep_names)
                                 return state, dep_ok
@@ -1932,6 +1976,13 @@ class PlanCompiler:
             grouped = None
             if fused is not None:
                 grouped = fused_cache.get("grouped", False)
+                if grouped is not False and grouped is not None \
+                        and fused.build_params \
+                        and grouped.params_fp != self.ctx.params_fingerprint:
+                    # parameterized build tables (shared builds, bucket-0
+                    # fanout probe) were sized under the old constants —
+                    # rebuild the runner for this fingerprint
+                    grouped = False
                 if grouped is False:
                     from .grouped import make_grouped_runner
                     grouped = make_grouped_runner(
@@ -2316,8 +2367,14 @@ class PlanCompiler:
             return b
         skey = None
         if cache and self.ctx.memory.budget is None:
-            skey = ("mat_result", P.structural_key(node),
-                    self._splits_fingerprint(node))
+            sk = P.structural_key(node)
+            skey = ("mat_result", sk, self._splits_fingerprint(node))
+            if '"@type": "parameter"' in sk:
+                # parameterized subtree (an optimizer rule moved a probe
+                # side into a build): the structural key is value-free, so
+                # the cached result must be pinned to this execution's
+                # bound values
+                skey += (self.ctx.params_fingerprint,)
             ent = self._jit_cache.get(skey)
             if ent is not None:
                 cached, names = ent

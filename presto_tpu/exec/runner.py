@@ -24,6 +24,10 @@ class QueryResult:
     rows: List[List]
     # per-query RuntimeStats map (§5.1; populated by the runners)
     runtime_stats: dict = None
+    # statement-protocol side channel: PREPARE sets (name, text) so the
+    # server can answer with X-Presto-Added-Prepare; DEALLOCATE the name
+    added_prepare: tuple = None
+    deallocated_prepare: str = None
 
     def sorted_rows(self):
         return sorted(self.rows, key=lambda r: tuple(
@@ -40,17 +44,41 @@ def pages_to_result(pages, names, types) -> "QueryResult":
     return QueryResult(names, types, rows)
 
 
+@dataclass
+class _Execution:
+    """One checked-out canonical-cache execution: the optimized template,
+    an exclusively-owned compiler, and how to give both back (insert on
+    miss, checkin on hit) after a SUCCESSFUL run — a failed run may leave
+    the compiler's memory pool / partial state poisoned, so nothing is
+    returned to the cache."""
+    output: object                      # optimized OutputNode template
+    compiler: PlanCompiler
+    key: str
+    fresh: bool                         # miss: insert; hit: checkin
+    slot_types: list
+
+
 class LocalQueryRunner:
     def __init__(self, schema: str = "sf0.01",
                  config: Optional[ExecutionConfig] = None,
-                 catalog: str = "tpch", tracer_provider=None):
+                 catalog: str = "tpch", tracer_provider=None,
+                 plan_cache=None):
+        from ..serving import GLOBAL_PLAN_CACHE
         self.schema = schema
         self.catalog = catalog
         self.tracer_provider = tracer_provider   # utils.runtime_stats
         self.config = config or tuned_config()
-        # plan cache: SQL -> (OutputNode, PlanCompiler); re-executions reuse
-        # the compiled pipeline so its jitted steps stay warm
-        self._plan_cache: Dict[str, tuple] = {}
+        # canonical plan/executable cache (presto_tpu/serving): keyed by
+        # catalog + schema + config fingerprint + the structural key of
+        # the PARAMETERIZED pre-optimizer plan, so re-executions with
+        # different literal constants reuse the optimized template and
+        # the compiled pipeline (jitted steps stay warm).  Process-global
+        # by default; tests pass their own PlanCache for isolation.
+        self.plan_cache = plan_cache if plan_cache is not None \
+            else GLOBAL_PLAN_CACHE
+        # session-scoped prepared statements (name -> SQL text); the HTTP
+        # path passes its header map per call instead
+        self._prepared: Dict[str, str] = {}
 
     def _validation(self):
         """Scope plan validation (presto_tpu/analysis) to this runner's
@@ -63,27 +91,134 @@ class LocalQueryRunner:
             return Planner(default_schema=self.schema,
                            default_catalog=self.catalog).plan(sql)
 
-    _PLAN_CACHE_MAX = 64
+    # -- canonical plan cache ---------------------------------------------
 
-    def _plan_or_cached(self, sql: str, ast, stats):
-        """Pop the cached (output plan, compiler) for `sql` or plan it
-        fresh; callers re-insert via _recache after a successful run."""
-        entry = self._plan_cache.pop(sql, None)
-        if entry is None:
-            with stats.record_wall("queryPlan"), self._validation():
-                output = Planner(default_schema=self.schema,
-                                 default_catalog=self.catalog) \
-                    .plan_query_to_output(ast)
-                entry = (output,
-                         PlanCompiler(TaskContext(config=self.config)))
-        return entry
+    def _checkout(self, ast, stats, bound_params=None,
+                  record_fast=None) -> _Execution:
+        """Plan `ast` to the parameterized template, then check the
+        canonical cache: a hit skips optimize (and, when a pooled compiler
+        is available, every compiled XLA step); a miss optimizes and
+        builds a compiler.  Either way the returned compiler's context
+        carries the execution's bound-parameter vector."""
+        from ..serving import SERVING_METRICS
+        from ..sql.canonical import cache_key_from_parts, parameterize
+        from ..spi import plan as P
+        with stats.record_wall("queryPlan"), self._validation():
+            planner = Planner(default_schema=self.schema,
+                              default_catalog=self.catalog,
+                              bound_params=bound_params)
+            unopt = planner.plan_query_unoptimized(ast)
+        pp = parameterize(unopt)
+        # structural key taken BEFORE optimization (the optimizer mutates
+        # the template in place) — it must match what the prepared fast
+        # path re-derives from its recorded template_key
+        template_sk = P.structural_key(pp.template)
+        key = cache_key_from_parts(template_sk, self.config, self.catalog,
+                                   self.schema)
+        hit = self.plan_cache.checkout(key)
+        if hit is not None:
+            output, slot_types, compiler = hit
+            if compiler is None:
+                # pooled compilers all checked out by concurrent
+                # executions: rebuild one from the cached template —
+                # parse/plan/optimize were still skipped
+                compiler = PlanCompiler(TaskContext(config=self.config))
+                SERVING_METRICS.incr("executable_builds")
+            exe = _Execution(output, compiler, key, False,
+                             list(slot_types))
+        else:
+            with stats.record_wall("queryOptimize"), self._validation():
+                output = Planner.optimize_output(pp.template)
+            compiler = PlanCompiler(TaskContext(config=self.config))
+            SERVING_METRICS.incr("executable_builds")
+            exe = _Execution(output, compiler, key, True,
+                             [s.type for s in pp.slots])
+        if record_fast is not None and pp.origins_complete:
+            from ..serving.prepared import FastPath
+            record_fast(FastPath(
+                template_sk,
+                [(s.origin, s.type,
+                  None if s.origin is not None else s.value)
+                 for s in pp.slots]))
+        self._bind(exe, [s.value for s in pp.slots])
+        return exe
 
-    def _recache(self, sql: str, entry) -> None:
-        self._plan_cache[sql] = entry
-        while len(self._plan_cache) > self._PLAN_CACHE_MAX:
-            self._plan_cache.pop(next(iter(self._plan_cache)))
+    def _bind(self, exe: _Execution, values) -> None:
+        from ..sql.canonical import device_params
+        if exe.slot_types:
+            dev, host = device_params(values, exe.slot_types)
+            exe.compiler.ctx.params = dev
+            exe.compiler.ctx.params_fingerprint = host
+        else:
+            exe.compiler.ctx.params = None
+            exe.compiler.ctx.params_fingerprint = None
 
-    def execute(self, sql: str) -> QueryResult:
+    def _release(self, exe: _Execution) -> None:
+        """Return the compiler to the cache after a successful run."""
+        if exe.fresh:
+            self.plan_cache.insert(exe.key, exe.output, exe.slot_types,
+                                   exe.compiler)
+        else:
+            self.plan_cache.checkin(exe.key, exe.compiler)
+
+    # -- prepared statements ----------------------------------------------
+
+    def _prepared_text(self, name: str, prepared) -> str:
+        text = (prepared or {}).get(name) or self._prepared.get(name)
+        if text is None:
+            raise KeyError(f"prepared statement {name!r} does not exist")
+        return text
+
+    def _execute_prepared(self, ast, stats, prepared) -> _Execution:
+        """EXECUTE name USING v1, ... -> a ready _Execution.  The fast
+        path (statement seen before, all origins extracted) rebuilds the
+        cache key from recorded slots and skips parse+plan entirely; any
+        mismatch — unbindable value, NULL, cold cache — replans with the
+        USING values bound into the planner."""
+        from ..serving import PREPARED_REGISTRY, SERVING_METRICS
+        from ..sql.canonical import (BindError, cache_key_from_parts,
+                                     literal_value)
+        text = self._prepared_text(ast.name, prepared)
+        ps = PREPARED_REGISTRY.get_or_parse(text)
+        if len(ast.values) != ps.param_count:
+            raise ValueError(
+                f"prepared statement {ast.name!r} expects "
+                f"{ps.param_count} parameters, got {len(ast.values)}")
+        fast = ps.fast
+        if fast is not None:
+            try:
+                raw = [literal_value(v) for v in ast.values]
+                values = fast.bind(raw)
+            except BindError:
+                values = None
+            if values is not None:
+                key = cache_key_from_parts(fast.template_key, self.config,
+                                           self.catalog, self.schema)
+                hit = self.plan_cache.checkout(key)
+                if hit is not None:
+                    output, slot_types, compiler = hit
+                    if compiler is None:
+                        compiler = PlanCompiler(
+                            TaskContext(config=self.config))
+                        SERVING_METRICS.incr("executable_builds")
+                    exe = _Execution(output, compiler, key, False,
+                                     list(slot_types))
+                    self._bind(exe, values)
+                    SERVING_METRICS.incr("prepared_fast_path")
+                    return exe
+        # full pipeline with the USING values bound into the planner;
+        # record the fast path for the NEXT execution of this statement
+        SERVING_METRICS.incr("prepared_replans")
+        return self._checkout(ps.statement, stats,
+                              bound_params=list(ast.values),
+                              record_fast=ps.record_fast_path)
+
+    # -- execution --------------------------------------------------------
+
+    def execute(self, sql: str, prepared: Optional[Dict[str, str]] = None
+                ) -> QueryResult:
+        from ..common.types import BOOLEAN
+        from ..serving import PREPARED_REGISTRY
         from ..sql import parser as A
         from ..utils.runtime_stats import RuntimeStats
         stats = RuntimeStats()
@@ -97,10 +232,24 @@ class LocalQueryRunner:
             return self._explain(ast)
         if isinstance(ast, (A.CreateTableAs, A.InsertInto, A.DropTable)):
             return self._execute_ddl(ast)
-        entry = self._plan_or_cached(sql, ast, stats)
+        if isinstance(ast, A.Prepare):
+            self._prepared[ast.name] = ast.text
+            PREPARED_REGISTRY.get_or_parse(ast.text)   # warm the memo
+            res = QueryResult(["result"], [BOOLEAN], [[True]])
+            res.added_prepare = (ast.name, ast.text)
+            return res
+        if isinstance(ast, A.Deallocate):
+            self._prepared.pop(ast.name, None)
+            res = QueryResult(["result"], [BOOLEAN], [[True]])
+            res.deallocated_prepare = ast.name
+            return res
+        if isinstance(ast, A.ExecuteStmt):
+            exe = self._execute_prepared(ast, stats, prepared)
+        else:
+            exe = self._checkout(ast, stats)
         if tracer:
             tracer.add_point("query planned")
-        output, compiler = entry
+        output, compiler = exe.output, exe.compiler
         names = output.column_names
         types = [v.type for v in output.outputs]
         # operators add fine-grained counters (grouped bucket walls, ...)
@@ -111,28 +260,30 @@ class LocalQueryRunner:
         result.runtime_stats = stats.to_dict()
         if tracer:
             tracer.end_trace("query finished")
-        # cache only after a successful run (a failed run may leave the
-        # compiler's memory pool / partial state poisoned); bounded LRU
-        self._recache(sql, entry)
+        self._release(exe)
         return result
 
-    def execute_streaming(self, sql: str):
+    def execute_streaming(self, sql: str,
+                          prepared: Optional[Dict[str, str]] = None):
         """(columns-meta, row iterator) for a plain SELECT — pages are
         decoded and yielded as they are produced, so callers (the
         statement protocol) never hold the full result set (reference
         Query.java:116 streams from the root-stage ExchangeClient).
         Returns None for statements that need materialized execution
-        (DDL / EXPLAIN)."""
+        (DDL / EXPLAIN / PREPARE / DEALLOCATE)."""
         from ..sql import parser as A
         from ..utils.runtime_stats import RuntimeStats
         stats = RuntimeStats()
         with stats.record_wall("queryParse"):
             ast = A.parse_sql(sql)
         if isinstance(ast, (A.Explain, A.CreateTableAs, A.InsertInto,
-                            A.DropTable)):
+                            A.DropTable, A.Prepare, A.Deallocate)):
             return None
-        entry = self._plan_or_cached(sql, ast, stats)
-        output, compiler = entry
+        if isinstance(ast, A.ExecuteStmt):
+            exe = self._execute_prepared(ast, stats, prepared)
+        else:
+            exe = self._checkout(ast, stats)
+        output, compiler = exe.output, exe.compiler
         names = output.column_names
         types = [v.type for v in output.outputs]
         compiler.ctx.runtime_stats = stats
@@ -147,8 +298,8 @@ class LocalQueryRunner:
                             for t, b in zip(types, page.blocks)]
                     for i in range(page.position_count):
                         yield [c[i] for c in cols]
-            # cache only after a fully successful drain (mirrors execute)
-            self._recache(sql, entry)
+            # release only after a fully successful drain (mirrors execute)
+            self._release(exe)
         return columns, rows(), stats
 
     def _execute_ddl(self, ast) -> QueryResult:
@@ -171,7 +322,7 @@ class LocalQueryRunner:
                 raise KeyError(f"unknown or non-droppable table "
                                f"{ast.table!r}")
             # cached plans may reference the dropped table
-            self._plan_cache.clear()
+            self._invalidate_plans()
             cat.module(cid).drop_table(ast.table)
             return QueryResult(["rows"], [BIGINT], [[0]])
         if isinstance(ast, A.CreateTableAs) and ast.if_not_exists:
@@ -186,8 +337,16 @@ class LocalQueryRunner:
         names = output.column_names
         types = [v.type for v in output.outputs]
         # writes invalidate any cached plans that scanned the target table
-        self._plan_cache.clear()
+        self._invalidate_plans()
         return pages_to_result(compiler.run_to_pages(output), names, types)
+
+    def _invalidate_plans(self) -> None:
+        """DDL changed table contents: every cached plan/executable (and
+        every recorded prepared fast path, whose template keys assume the
+        old tables) may be stale."""
+        from ..serving import PREPARED_REGISTRY
+        self.plan_cache.invalidate_all()
+        PREPARED_REGISTRY.invalidate_fast_paths()
 
     def _explain(self, ast) -> QueryResult:
         """EXPLAIN: plan text.  EXPLAIN ANALYZE: execute with per-node

@@ -1,0 +1,18 @@
+"""Serving tier: prepared statements, the canonical plan/executable cache,
+and admission support for heavy repeated-shape traffic.
+
+The pieces:
+  sql/canonical.py   plan parameterization + cache keys (lives in sql/ so
+                     the planner layer owns plan rewriting)
+  serving/cache.py   LRU of (optimized template, PlanCompiler) entries
+  serving/prepared.py  PREPARE/EXECUTE registry + the skip-parse-and-plan
+                     fast path
+  serving/metrics.py process-wide counters for /v1/metrics and /v1/status
+  worker/statement.py  weighted fair-share + memory-headroom admission
+"""
+from .cache import GLOBAL_PLAN_CACHE, PlanCache
+from .metrics import SERVING_METRICS
+from .prepared import PREPARED_REGISTRY, PreparedRegistry
+
+__all__ = ["GLOBAL_PLAN_CACHE", "PlanCache", "SERVING_METRICS",
+           "PREPARED_REGISTRY", "PreparedRegistry"]

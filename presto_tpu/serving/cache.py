@@ -1,0 +1,136 @@
+"""Canonical plan/executable cache.
+
+Entries are keyed by `sql.canonical.plan_cache_key` — catalog + schema +
+execution-config fingerprint + the structural key of the PARAMETERIZED
+pre-optimizer plan — and hold the optimized template plus a small pool of
+PlanCompiler instances.  Compilers are checked out exclusively (a
+TaskContext holds per-execution state: params, memory pool, runtime
+stats) and returned after a successful drain, mirroring the pop/recache
+discipline of the old exact-SQL-text cache it replaces
+(exec/runner.py:53 before this change).
+
+Why a pool and not one compiler: the statement path executes concurrent
+queries against one runner; two executions sharing a compiler would race
+on ctx.params.  When the pool is empty a hit still returns the optimized
+template — the caller rebuilds only the compiler (cheap construction;
+XLA executables re-specialize lazily), never re-running
+parse→plan→optimize.
+
+Invalidation: DDL (tables changed) clears everything; session-property /
+config / catalog changes need no invalidation because they are part of
+the key.  Eviction is LRU by last checkout/insert.
+"""
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import List, Optional, Tuple
+
+from .metrics import SERVING_METRICS
+
+DEFAULT_PLAN_CACHE_ENTRIES = 128
+_POOL_PER_ENTRY = 4             # compilers retained per entry
+
+
+class _Entry:
+    __slots__ = ("template", "slot_types", "pool")
+
+    def __init__(self, template, slot_types):
+        self.template = template          # optimized OutputNode
+        self.slot_types = slot_types      # parameter slot types, in order
+        self.pool: List[object] = []      # idle PlanCompiler instances
+
+
+class PlanCache:
+    def __init__(self, max_entries: int = DEFAULT_PLAN_CACHE_ENTRIES):
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[str, _Entry]" = OrderedDict()
+        self.max_entries = max_entries
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.invalidations = 0
+
+    # -- configuration ----------------------------------------------------
+    def set_max_entries(self, n: int) -> None:
+        with self._lock:
+            self.max_entries = max(1, int(n))
+            self._evict_locked()
+
+    # -- lookup -----------------------------------------------------------
+    def checkout(self, key: str) -> Optional[Tuple[object, list, object]]:
+        """Hit -> (optimized template, slot types, compiler-or-None); the
+        compiler, when present, is exclusively owned until checkin()."""
+        with self._lock:
+            ent = self._entries.get(key)
+            if ent is None:
+                self.misses += 1
+                SERVING_METRICS.incr("plan_cache_misses")
+                return None
+            self._entries.move_to_end(key)
+            self.hits += 1
+            SERVING_METRICS.incr("plan_cache_hits")
+            compiler = ent.pool.pop() if ent.pool else None
+            return ent.template, ent.slot_types, compiler
+
+    def insert(self, key: str, template, slot_types, compiler) -> None:
+        with self._lock:
+            ent = self._entries.get(key)
+            if ent is None:
+                ent = _Entry(template, slot_types)
+                self._entries[key] = ent
+            self._entries.move_to_end(key)
+            if compiler is not None \
+                    and len(ent.pool) < _POOL_PER_ENTRY:
+                ent.pool.append(compiler)
+            self._evict_locked()
+
+    def checkin(self, key: str, compiler) -> None:
+        """Return a compiler after a successful execution; dropped when the
+        entry was evicted/invalidated meanwhile (a stale compiler must not
+        resurrect a dead key)."""
+        with self._lock:
+            ent = self._entries.get(key)
+            if ent is not None and compiler is not None \
+                    and len(ent.pool) < _POOL_PER_ENTRY:
+                ent.pool.append(compiler)
+
+    def contains(self, key: str) -> bool:
+        with self._lock:
+            return key in self._entries
+
+    # -- invalidation -----------------------------------------------------
+    def invalidate_all(self) -> int:
+        """Drop every entry (DDL changed table contents: any cached plan —
+        and any compiler-internal materialization — may be stale)."""
+        with self._lock:
+            n = len(self._entries)
+            self._entries.clear()
+            if n:
+                self.invalidations += n
+                SERVING_METRICS.incr("plan_cache_invalidations", n)
+            return n
+
+    def _evict_locked(self) -> None:
+        while len(self._entries) > self.max_entries:
+            self._entries.popitem(last=False)
+            self.evictions += 1
+            SERVING_METRICS.incr("plan_cache_evictions")
+
+    # -- observability ----------------------------------------------------
+    def info(self) -> dict:
+        with self._lock:
+            return {
+                "entries": len(self._entries),
+                "maxEntries": self.max_entries,
+                "hits": self.hits,
+                "misses": self.misses,
+                "evictions": self.evictions,
+                "invalidations": self.invalidations,
+            }
+
+
+# One cache per process (the statement path builds ≤16 runners per
+# coordinator but the same shapes flow through all of them; config /
+# catalog / schema live in the key so sharing is safe).
+GLOBAL_PLAN_CACHE = PlanCache()

@@ -1,0 +1,58 @@
+"""Process-wide serving-tier counters for /v1/metrics and /v1/status.
+
+Same shape as worker/exchange.py's ExchangeMetrics: one worker per process
+in deployment, tests reset() before asserting.  The cache counters are fed
+by serving/cache.py; the prepared counters by exec/runner.py's
+PREPARE/EXECUTE handling; compiler builds by the runner's canonical plan
+path (a build is the expensive event the cache exists to avoid — the
+acceptance gate asserts it does NOT move on a warm repeated shape).
+"""
+from __future__ import annotations
+
+import threading
+
+
+class ServingMetrics:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.reset()
+
+    def reset(self) -> None:
+        with self._lock:
+            self.plan_cache_hits = 0
+            self.plan_cache_misses = 0
+            self.plan_cache_evictions = 0
+            self.plan_cache_invalidations = 0
+            # PlanCompiler constructions on the serving path.  A hit whose
+            # pooled compiler is checked out by a concurrent execution
+            # rebuilds one from the cached optimized template (counted
+            # here, not as a miss: parse/plan/optimize were still skipped).
+            self.executable_builds = 0
+            self.prepared_registered = 0
+            self.prepared_fast_path = 0     # EXECUTE skipped parse+plan
+            self.prepared_replans = 0       # EXECUTE took the full pipeline
+
+    def incr(self, name: str, delta: int = 1) -> None:
+        with self._lock:
+            setattr(self, name, getattr(self, name) + delta)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "planCacheHits": self.plan_cache_hits,
+                "planCacheMisses": self.plan_cache_misses,
+                "planCacheEvictions": self.plan_cache_evictions,
+                "planCacheInvalidations": self.plan_cache_invalidations,
+                "executableBuilds": self.executable_builds,
+                "preparedRegistered": self.prepared_registered,
+                "preparedFastPath": self.prepared_fast_path,
+                "preparedReplans": self.prepared_replans,
+            }
+
+    def hit_rate(self) -> float:
+        with self._lock:
+            total = self.plan_cache_hits + self.plan_cache_misses
+            return self.plan_cache_hits / total if total else 0.0
+
+
+SERVING_METRICS = ServingMetrics()

@@ -55,6 +55,8 @@ class RowExpression:
                 d["arguments"], RowExpression.from_dict(d["body"]))
         if kind == "input":
             return InputReferenceExpression(d["field"], parse_type(d["type"]))
+        if kind == "parameter":
+            return BoundParameterExpression(d["index"], parse_type(d["type"]))
         raise ValueError(f"unknown RowExpression @type {kind!r}")
 
 
@@ -62,6 +64,11 @@ class RowExpression:
 class ConstantExpression(RowExpression):
     value: Any
     type: Type
+    # Provenance for prepared-statement binding: which `?` slot (by ordinal)
+    # this literal came from.  Deliberately excluded from equality, repr and
+    # to_dict so it can never leak into structural keys or serialized plans;
+    # a folded constant simply loses its origin and stays a fixed literal.
+    origin: Optional[int] = field(default=None, compare=False, repr=False)
 
     def to_dict(self):
         value = self.value
@@ -164,6 +171,25 @@ class InputReferenceExpression(RowExpression):
     def to_dict(self):
         return {"@type": "input", "field": self.field,
                 "type": self.type.signature}
+
+
+@dataclass
+class BoundParameterExpression(RowExpression):
+    """A literal extracted into the bound-parameter vector by the serving
+    tier's plan canonicalizer (sql/canonical.py).  Not a ConstantExpression
+    subclass on purpose: constant folding, hoisting, trivial-filter removal
+    and scan pushdown all test `isinstance(_, ConstantExpression)` and must
+    treat a parameter as opaque.  Lowering reads `batch.params[index]`."""
+
+    index: int
+    type: Type
+
+    def to_dict(self):
+        return {"@type": "parameter", "index": self.index,
+                "type": self.type.signature}
+
+    def __str__(self):
+        return f"?{self.index}:{self.type}"
 
 
 # ---------------------------------------------------------------------------

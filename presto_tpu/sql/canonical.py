@@ -1,0 +1,264 @@
+"""Plan canonicalization + parameterization for the serving tier.
+
+The serving plan cache (presto_tpu/serving/cache.py) wants the same cache
+entry for `WHERE l_discount < 0.05` and `WHERE l_discount < 0.07`: the
+compiled XLA executable is identical if the literal rides as a jit ARGUMENT
+instead of baking into the trace.  `parameterize` rewrites an analyzed
+(pre-optimizer) plan, extracting eligible literal constants out of filter
+predicates and project assignments into a bound-parameter vector; each
+occurrence becomes a BoundParameterExpression leaf that lowering evaluates
+as `batch.params[index]`.  The cache key is then the structural key of the
+TEMPLATE — canonical plan structure, value-free for the extracted slots —
+plus an execution-config fingerprint, so a session-property change can
+never serve a stale plan.
+
+Eligibility is a strict whitelist.  Only constants that are *data* to the
+executable may move: arguments of plain comparisons and +-* arithmetic,
+of numeric/date/boolean type.  Everything else (LIKE patterns, round
+digits, cast targets, IN lists, string literals, LIMIT counts, interval
+foldings) stays literal in the template, keeping its value inside the key
+— a changed value simply replans, which is always correct.
+
+This mirrors the reference's prepared-statement parameter rewriting
+(presto-main-base ParameterRewriter / QueryPreparer), moved down to the
+plan level where the TPU executable cache needs it.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from decimal import Decimal, InvalidOperation
+from typing import Any, List, Optional, Tuple
+
+import numpy as np
+
+from ..common.types import (BigintType, BooleanType, DateType, DecimalType,
+                            DoubleType, IntegerType, RealType, Type)
+from ..spi import plan as P
+from ..spi.expr import (BoundParameterExpression, CallExpression,
+                        ConstantExpression, RowExpression,
+                        SpecialFormExpression)
+
+# Calls whose constant arguments are safe to turn into runtime parameters:
+# lowering evaluates every argument of these dynamically (no host-side
+# constant requirement).  divide/modulus are excluded on purpose — a
+# parameterized denominator would move the division-by-zero decision from
+# plan time to device time.
+_ALLOWED_OPS = frozenset({
+    "eq", "neq", "lt", "lte", "gt", "gte",
+    "between", "add", "subtract", "multiply",
+})
+
+_ALLOWED_TYPES = (IntegerType, BigintType, DoubleType, RealType,
+                  DateType, DecimalType, BooleanType)
+
+
+class BindError(ValueError):
+    """An EXECUTE value does not fit the cached template's slot (type or
+    range mismatch); the caller falls back to a full replan."""
+
+
+@dataclass
+class ParamSlot:
+    value: Any                  # plan-unit value (int / Decimal / str date)
+    type: Type
+    origin: Optional[int]       # `?` ordinal this literal came from, or None
+
+
+@dataclass
+class ParameterizedPlan:
+    template: P.OutputNode      # plan with BoundParameterExpression leaves
+    slots: List[ParamSlot]
+    # True when every origin-tagged literal landed in a slot: the prepared
+    # fast path may bind new USING values directly.  False means some `?`
+    # was folded into a fixed constant or sits in a non-extractable
+    # position — new values must replan (still correct: the leftover value
+    # stays inside the cache key).
+    origins_complete: bool
+
+
+def parameterize(plan: P.OutputNode) -> ParameterizedPlan:
+    """Extract eligible literals from `plan` (mutated in place) into a
+    bound-parameter vector."""
+    slots: List[ParamSlot] = []
+
+    def eligible(c: ConstantExpression) -> bool:
+        return c.value is not None and isinstance(c.type, _ALLOWED_TYPES)
+
+    def rewrite(e: RowExpression) -> RowExpression:
+        from ..exec.lowering import canonical_name
+        if isinstance(e, CallExpression):
+            extract = canonical_name(e.display_name) in _ALLOWED_OPS
+            args = []
+            for a in e.arguments:
+                if extract and isinstance(a, ConstantExpression) \
+                        and eligible(a):
+                    idx = len(slots)
+                    slots.append(ParamSlot(a.value, a.type, a.origin))
+                    args.append(BoundParameterExpression(idx, a.type))
+                else:
+                    args.append(rewrite(a))
+            return CallExpression(e.display_name, e.type, args,
+                                  e.function_handle)
+        if isinstance(e, SpecialFormExpression):
+            return SpecialFormExpression(
+                e.form, e.type, [rewrite(a) for a in e.arguments])
+        return e
+
+    leftover_origins = False
+    for node in P.walk_plan(plan):
+        if isinstance(node, P.FilterNode):
+            node.predicate = rewrite(node.predicate)
+        elif isinstance(node, P.ProjectNode):
+            node.assignments = {v: rewrite(x)
+                                for v, x in node.assignments.items()}
+    # any origin-tagged literal still in the template blocks the prepared
+    # fast path for that statement (its value is baked into the key)
+    for node in P.walk_plan(plan):
+        for e in _node_expressions(node):
+            if _has_tagged_constant(e):
+                leftover_origins = True
+    return ParameterizedPlan(plan, slots, not leftover_origins)
+
+
+def _node_expressions(node: P.PlanNode):
+    if isinstance(node, P.FilterNode):
+        yield node.predicate
+    elif isinstance(node, P.ProjectNode):
+        yield from node.assignments.values()
+
+
+def _has_tagged_constant(e: RowExpression) -> bool:
+    if isinstance(e, ConstantExpression):
+        return e.origin is not None
+    if isinstance(e, (CallExpression, SpecialFormExpression)):
+        return any(_has_tagged_constant(a) for a in e.arguments)
+    return False
+
+
+def has_parameters(key: str) -> bool:
+    """Whether a structural key covers a subtree containing bound-parameter
+    leaves (used by materialization caches to add a value fingerprint)."""
+    return '"@type": "parameter"' in key
+
+
+# ---------------------------------------------------------------------------
+# cache key
+# ---------------------------------------------------------------------------
+
+def config_fingerprint(config) -> str:
+    """Execution-config identity for the cache key.  Walks dataclass fields
+    by NAME so adding a knob changes every key (never aliases old entries),
+    and a session-property override always lands in a different entry."""
+    import dataclasses
+    return repr(sorted(
+        (f.name, getattr(config, f.name))
+        for f in dataclasses.fields(config)))
+
+
+def cache_key_from_parts(structural: str, config, catalog: str,
+                         schema: str) -> str:
+    """Cache key from a precomputed structural key (the prepared fast path
+    stores the structural key and re-derives the full key per request, so
+    session-property and catalog changes always re-key)."""
+    return "\x00".join((
+        str(catalog), str(schema),
+        config_fingerprint(config),
+        structural,
+    ))
+
+
+def plan_cache_key(template: P.OutputNode, config, catalog: str,
+                   schema: str) -> str:
+    return cache_key_from_parts(P.structural_key(template), config,
+                                catalog, schema)
+
+
+# ---------------------------------------------------------------------------
+# value binding
+# ---------------------------------------------------------------------------
+
+def literal_value(node) -> Any:
+    """EXECUTE ... USING literal AST -> plain python value in plan units
+    (int / Decimal / float / bool / str / None), mirroring the planner's
+    literal typing so the fast path and the replan path agree."""
+    from . import parser as A
+    if isinstance(node, A.NumberLit):
+        if "." in node.text:
+            return Decimal(node.text)
+        return int(node.text)
+    if isinstance(node, A.UnaryOp) and node.op == "-":
+        v = literal_value(node.operand)
+        if isinstance(v, (int, Decimal, float)) \
+                and not isinstance(v, bool):
+            return -v
+        raise BindError(f"cannot negate {v!r}")
+    if isinstance(node, A.StringLit):
+        return node.value
+    if isinstance(node, A.BoolLit):
+        return node.value
+    if isinstance(node, A.NullLit):
+        return None
+    if isinstance(node, A.DateLit):
+        from .planner import _parse_date_str
+        return _parse_date_str(node.value)
+    raise BindError(f"unsupported EXECUTE value {type(node).__name__}")
+
+
+def bind_literal(value: Any, typ: Type) -> Any:
+    """Coerce a raw literal value onto a template slot's type, raising
+    BindError when the value would have planned to a DIFFERENT type than
+    the cached template records (forcing the caller to replan)."""
+    if value is None:
+        raise BindError("NULL parameter values replan")
+    if isinstance(typ, BooleanType):
+        if isinstance(value, bool):
+            return value
+        raise BindError(f"boolean slot, got {value!r}")
+    if isinstance(value, bool):
+        raise BindError(f"{typ} slot, got boolean {value!r}")
+    if isinstance(typ, IntegerType):
+        if isinstance(value, int) and -2**31 <= value < 2**31:
+            return value
+        raise BindError(f"integer slot, got {value!r}")
+    if isinstance(typ, BigintType):
+        if isinstance(value, int) and -2**63 <= value < 2**63:
+            return value
+        raise BindError(f"bigint slot, got {value!r}")
+    if isinstance(typ, (DoubleType, RealType)):
+        if isinstance(value, (int, float, Decimal)):
+            return float(value)
+        raise BindError(f"double slot, got {value!r}")
+    if isinstance(typ, DecimalType):
+        if isinstance(value, (int, Decimal)):
+            try:
+                d = Decimal(value)
+                scaled = d.scaleb(typ.scale)
+            except InvalidOperation as exc:
+                raise BindError(str(exc))
+            if scaled != scaled.to_integral_value():
+                raise BindError(
+                    f"value {value!r} does not fit decimal scale "
+                    f"{typ.scale}")
+            return d
+        raise BindError(f"decimal slot, got {value!r}")
+    if isinstance(typ, DateType):
+        if isinstance(value, str):
+            try:
+                return str(np.datetime64(value, "D"))
+            except ValueError:
+                raise BindError(f"bad date literal {value!r}")
+        raise BindError(f"date slot, got {value!r}")
+    raise BindError(f"unsupported slot type {typ}")
+
+
+def device_params(values: List[Any],
+                  types: List[Type]) -> Tuple[Tuple, Tuple]:
+    """Plan-unit slot values -> (device scalar tuple for ctx.params, host
+    fingerprint tuple for value-sensitive cache keys)."""
+    import jax.numpy as jnp
+    from ..exec.lowering import _jnp_dtype, constant_device_value
+    host = tuple(constant_device_value(v, t)
+                 for v, t in zip(values, types))
+    dev = tuple(jnp.asarray(h, dtype=_jnp_dtype(t))
+                for h, t in zip(host, types))
+    return dev, host

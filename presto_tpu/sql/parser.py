@@ -31,7 +31,7 @@ TOKEN_RE = re.compile(r"""
   | (?P<string>'(?:[^']|'')*')
   | (?P<qident>"(?:[^"]|"")*")
   | (?P<ident>[A-Za-z_][A-Za-z0-9_$]*)
-  | (?P<op><>|!=|>=|<=|\|\||[(),.*/%<>=+\-;\[\]])
+  | (?P<op><>|!=|>=|<=|\|\||[(),.*/%<>=+\-;\[\]?])
 """, re.VERBOSE)
 
 
@@ -108,6 +108,14 @@ class DateLit(Node):
 class IntervalLit(Node):
     value: str
     unit: str                 # day / month / year
+
+
+@dataclass
+class ParamLit(Node):
+    """A `?` placeholder inside a prepared statement (reference
+    sql/tree/Parameter.java).  `index` is the 0-based ordinal in text
+    order; EXECUTE ... USING binds values positionally."""
+    index: int
 
 
 @dataclass
@@ -329,6 +337,31 @@ class DropTable(Node):
 
 
 @dataclass
+class Prepare(Node):
+    """PREPARE name FROM <statement> (reference sql/tree/Prepare.java).
+    `text` is the inner statement's SQL text (what travels in the
+    X-Presto-Prepared-Statement header); `statement` its parsed AST."""
+    name: str
+    text: str
+    statement: Node
+    param_count: int = 0
+
+
+@dataclass
+class ExecuteStmt(Node):
+    """EXECUTE name [USING expr, ...] (reference sql/tree/Execute.java).
+    USING values must plan to literals; they bind `?` slots positionally."""
+    name: str
+    values: List[Node] = field(default_factory=list)
+
+
+@dataclass
+class Deallocate(Node):
+    """DEALLOCATE [PREPARE] name (reference sql/tree/Deallocate.java)."""
+    name: str
+
+
+@dataclass
 class SetOp(Node):
     """UNION / INTERSECT / EXCEPT.  ORDER BY / LIMIT apply to the whole
     set operation (trailing clauses of the last branch are hoisted here)."""
@@ -348,8 +381,10 @@ class SetOp(Node):
 
 class Parser:
     def __init__(self, sql: str):
+        self.sql = sql
         self.tokens = tokenize(sql)
         self.i = 0
+        self._param_count = 0    # `?` placeholders seen, in text order
 
     # -- token helpers ----------------------------------------------------
     def peek(self, k=0) -> Token:
@@ -448,6 +483,34 @@ class Parser:
                 self._expect_word("exists")
                 ie = True
             q = DropTable(self._ident(), ie)
+        elif word == "prepare":
+            self.next()
+            name = self.expect("ident").value.lower()
+            self._expect_word("from")
+            # the rest of the text IS the inner statement; a sub-parse
+            # validates it and counts its `?` slots
+            inner = self.sql[self.peek().pos:].rstrip()
+            if inner.endswith(";"):
+                inner = inner[:-1].rstrip()
+            sub = Parser(inner)
+            stmt = sub.parse()
+            q = Prepare(name, inner, stmt, sub._param_count)
+            self.i = len(self.tokens) - 1   # sub-parser consumed the rest
+        elif word == "execute":
+            self.next()
+            name = self.expect("ident").value.lower()
+            values: List[Node] = []
+            if self._peek_word() == "using":
+                self.next()
+                values.append(self.parse_expr())
+                while self.accept("op", ","):
+                    values.append(self.parse_expr())
+            q = ExecuteStmt(name, values)
+        elif word == "deallocate":
+            self.next()
+            if self._peek_word() == "prepare":
+                self.next()
+            q = Deallocate(self.expect("ident").value.lower())
         else:
             q = self.parse_query()
         self.accept("op", ";")
@@ -866,6 +929,11 @@ class Parser:
                     items.append(self.parse_expr())
             self.expect("op", "]")
             return ArrayLit(items)
+        if t.kind == "op" and t.value == "?":
+            self.next()
+            p = ParamLit(self._param_count)
+            self._param_count += 1
+            return p
         if t.kind == "number":
             self.next()
             return NumberLit(t.value)

@@ -79,10 +79,14 @@ class Planner:
     """Plans one session's queries; allocates globally unique variable names."""
 
     def __init__(self, default_schema: str = "sf0.01",
-                 default_catalog: str = "tpch"):
+                 default_catalog: str = "tpch",
+                 bound_params: Optional[List[A.Node]] = None):
         self._counter = itertools.count()
         self.default_sf = _schema_sf(default_schema)
         self.default_catalog = default_catalog
+        # EXECUTE ... USING literal AST nodes, bound positionally to `?`
+        # slots (A.ParamLit); None = statement may not contain parameters
+        self.bound_params = bound_params
         # CTEs keep their AST: each reference is planned fresh so two uses of
         # the same CTE get distinct variables (a shared plan would alias them)
         self._ctes: Dict[str, A.Query] = {}
@@ -99,6 +103,12 @@ class Planner:
         return self.plan_query_to_output(query)
 
     def plan_query_to_output(self, query) -> P.OutputNode:
+        return self.optimize_output(self.plan_query_unoptimized(query))
+
+    def plan_query_unoptimized(self, query) -> P.OutputNode:
+        """Analyzed-but-unoptimized plan: the form the serving tier
+        canonicalizes (sql/canonical.py) before the optimizer runs, so the
+        plan-cache key is independent of value-specific rule firings."""
         node, names, out_vars = self.plan_query_any(query)
         out = P.OutputNode(self.new_id("output"), node, names, out_vars)
         # sanity gates around the optimizer (the reference PlanChecker's
@@ -106,6 +116,11 @@ class Planner:
         # session property via the analysis thread-local
         from ..analysis import validate_plan
         validate_plan(out, "post-plan")
+        return out
+
+    @staticmethod
+    def optimize_output(out: P.OutputNode) -> P.OutputNode:
+        from ..analysis import validate_plan
         from .optimizer import optimize
         out = optimize(out)
         validate_plan(out, "post-optimize")
@@ -1546,6 +1561,23 @@ class Planner:
             return constant(None, UNKNOWN)
         if isinstance(e, A.DateLit):
             return constant(_parse_date_str(e.value), DATE)
+        if isinstance(e, A.ParamLit):
+            if self.bound_params is None:
+                raise PlanningError(
+                    "query contains `?` parameters; PREPARE it and run "
+                    "EXECUTE ... USING <values>")
+            if e.index >= len(self.bound_params):
+                raise PlanningError(
+                    f"no value bound for parameter ?{e.index + 1} "
+                    f"(only {len(self.bound_params)} provided)")
+            v = self.plan_expr(self.bound_params[e.index], scope)
+            if not isinstance(v, ConstantExpression):
+                raise PlanningError(
+                    "EXECUTE ... USING values must be literals")
+            # origin tags the literal with its `?` ordinal so the serving
+            # canonicalizer can map cache-template slots back to USING
+            # positions (the prepared-statement fast path)
+            return ConstantExpression(v.value, v.type, origin=e.index)
         if isinstance(e, A.BinaryOp):
             return self._plan_binary(e, scope)
         if isinstance(e, A.UnaryOp):
@@ -2347,11 +2379,13 @@ def _unify_comparison(left: RowExpression, right: RowExpression):
         if _is_decimal(lt) and isinstance(rt, (IntegerType, BigintType)):
             from decimal import Decimal
             return left, ConstantExpression(Decimal(right.value),
-                                            DecimalType(38, lt.scale))
+                                            DecimalType(38, lt.scale),
+                                            origin=right.origin)
         if _is_decimal(lt) and _is_decimal(rt):
             return left, right
         if isinstance(lt, DateType) and isinstance(rt, (VarcharType, CharType)):
-            return left, ConstantExpression(right.value, DATE)
+            return left, ConstantExpression(right.value, DATE,
+                                            origin=right.origin)
     return left, right
 
 
@@ -2392,7 +2426,8 @@ def _coerce_to(e: RowExpression, target: Type) -> RowExpression:
         if isinstance(target, DecimalType) and isinstance(
                 e.value, int) and not isinstance(e.value, bool):
             from decimal import Decimal
-            return constant(Decimal(e.value), target)
+            return ConstantExpression(Decimal(e.value), target,
+                                      origin=e.origin)
     return call("cast", target, e)
 
 

@@ -72,6 +72,43 @@ def _literal(expr, var) -> Optional[float]:
     return v
 
 
+def _param_marker(expr, var) -> Optional[list]:
+    """``["param", index]`` when a bound-parameter operand's plan type
+    lines up with the column's stored units, else None.
+
+    Same unit gates as `_literal`; the VALUE is resolved at prune time
+    from the execution's parameter fingerprint, which already carries
+    device-unit host scalars (decimals unscaled at the plan scale, dates
+    as epoch days — see sql/canonical.device_params).  The marker is a
+    list, not a tuple, so it survives the TableScanNode JSON round trip
+    unchanged and the checker's re-derivation equality keeps holding.
+    """
+    from ..common.types import BooleanType, DateType, DecimalType
+    from ..spi.expr import BoundParameterExpression
+    if not isinstance(expr, BoundParameterExpression):
+        return None
+    vt = getattr(var, "type", None)
+    if isinstance(expr.type, DecimalType):
+        if not (isinstance(vt, DecimalType)
+                and vt.scale == expr.type.scale):
+            return None
+    elif isinstance(expr.type, DateType):
+        if not isinstance(vt, DateType):
+            return None
+    elif isinstance(expr.type, BooleanType) or isinstance(vt, DecimalType):
+        return None
+    return ["param", expr.index]
+
+
+def _operand_value(expr, var):
+    """Pushdown value for one comparison operand: a plain number (plan
+    constant), a ``["param", index]`` marker, or None (not pushable)."""
+    v = _literal(expr, var)
+    if v is not None:
+        return v
+    return _param_marker(expr, var)
+
+
 def split_conjuncts(expr) -> List:
     """Flatten an AND tree into its conjuncts."""
     from ..spi.expr import SpecialFormExpression
@@ -86,7 +123,8 @@ def split_conjuncts(expr) -> List:
 def conjunct_to_entries(expr, var_to_col: Dict[str, str]) -> List[dict]:
     """Pushdown entries for ONE conjunct ([] when it isn't range-shaped)."""
     from ..exec.lowering import canonical_name
-    from ..spi.expr import (CallExpression, ConstantExpression,
+    from ..spi.expr import (BoundParameterExpression, CallExpression,
+                            ConstantExpression,
                             VariableReferenceExpression)
     if not isinstance(expr, CallExpression):
         return []
@@ -95,7 +133,8 @@ def conjunct_to_entries(expr, var_to_col: Dict[str, str]) -> List[dict]:
     if name == "between" and len(args) == 3 \
             and isinstance(args[0], VariableReferenceExpression):
         col = var_to_col.get(args[0].name)
-        lo, hi = _literal(args[1], args[0]), _literal(args[2], args[0])
+        lo = _operand_value(args[1], args[0])
+        hi = _operand_value(args[2], args[0])
         if col is None or lo is None or hi is None:
             return []
         return [{"column": col, "op": "gte", "value": lo},
@@ -104,14 +143,14 @@ def conjunct_to_entries(expr, var_to_col: Dict[str, str]) -> List[dict]:
     if op is None or len(args) != 2:
         return []
     a, b = args
-    if isinstance(a, ConstantExpression) \
+    if isinstance(a, (ConstantExpression, BoundParameterExpression)) \
             and isinstance(b, VariableReferenceExpression):
         a, b = b, a
         op = _FLIP[op]
     if not isinstance(a, VariableReferenceExpression):
         return []
     col = var_to_col.get(a.name)
-    v = _literal(b, a)
+    v = _operand_value(b, a)
     if col is None or v is None:
         return []
     return [{"column": col, "op": op, "value": v}]
@@ -150,8 +189,24 @@ def entry_unsatisfiable(op: str, value, zmin, zmax) -> bool:
     return False
 
 
+def resolve_entry_value(value, params):
+    """A pushdown entry's comparison value for pruning: plain numbers
+    pass through; ``["param", index]`` markers resolve from the
+    execution's parameter fingerprint (device-unit host scalars).
+    Returns None when the marker cannot be resolved — the caller must
+    then keep the chunk (conservatism over cleverness)."""
+    if isinstance(value, (list, tuple)):
+        if len(value) == 2 and value[0] == "param" and params is not None \
+                and isinstance(value[1], int) and 0 <= value[1] < len(params):
+            v = params[value[1]]
+            if not isinstance(v, bool) and isinstance(v, (int, float)):
+                return v
+        return None
+    return value
+
+
 def prune_chunks(chunks: List[Tuple[int, int]], zone_maps: Dict,
-                 pushdown: List[dict]):
+                 pushdown: List[dict], params: Optional[Tuple] = None):
     """Drop chunks no pushed-down conjunct combination can satisfy.
 
     Returns (kept_chunks, skipped_count).  A conjunction skips a chunk
@@ -160,6 +215,10 @@ def prune_chunks(chunks: List[Tuple[int, int]], zone_maps: Dict,
     consumers bake len(chunks) into compiled fori_loop programs and a
     zero-chunk scan would leave them nothing to fold over (the residual
     filter turns the survivor into zero rows anyway).
+
+    `params` is the execution's host-side parameter fingerprint; entries
+    whose value is a ``["param", index]`` marker resolve against it and
+    prune nothing when it is absent.
     """
     from .store import STORAGE_METRICS
     kept: List[Tuple[int, int]] = []
@@ -169,10 +228,13 @@ def prune_chunks(chunks: List[Tuple[int, int]], zone_maps: Dict,
             zm = zone_maps.get(e["column"])
             if zm is None:
                 continue
+            value = resolve_entry_value(e["value"], params)
+            if value is None:
+                continue
             bounds = zm.chunk_bounds(pos, count)
             if bounds is None:
                 continue
-            if entry_unsatisfiable(e["op"], e["value"], *bounds):
+            if entry_unsatisfiable(e["op"], value, *bounds):
                 skip = True
                 break
         if not skip:

@@ -199,6 +199,11 @@ class SystemConfig:
         ("internal-communication.shared-secret", str, ""),
         ("internal-communication.jwt.enabled", bool, False),
         ("internal-communication.jwt.expiration-seconds", int, 300),
+        # serving tier (coordinator role): canonical plan/executable cache
+        # and fair-share admission (presto_tpu/serving/)
+        ("serving.plan-cache-entries", int, 128),
+        ("serving.total-concurrency", int, 0),       # 0 = per-group only
+        ("serving.admission-headroom-fraction", float, 0.8),
     ]
 
     def __init__(self, props: Optional[Dict[str, str]] = None):
@@ -269,6 +274,19 @@ def server_kwargs_from_etc(etc_dir: str) -> Tuple[dict, Dict[str, str]]:
         if "internal-communication.jwt.expiration-seconds" in props:
             kwargs["jwt_expiration_s"] = int(
                 props["internal-communication.jwt.expiration-seconds"])
+    if "serving.plan-cache-entries" in props:
+        kwargs["plan_cache_entries"] = int(
+            props["serving.plan-cache-entries"])
+    if "serving.total-concurrency" in props:
+        n = int(props["serving.total-concurrency"])
+        kwargs["total_concurrency"] = n if n > 0 else None
+    if "serving.admission-headroom-fraction" in props:
+        f = float(props["serving.admission-headroom-fraction"])
+        if not 0.0 < f <= 1.0:
+            raise ValueError(
+                "serving.admission-headroom-fraction must be in (0, 1], "
+                f"got {f}")
+        kwargs["admission_headroom_fraction"] = f
     # base on the server's tuned defaults (WorkerServer.__init__), not the
     # bare ExecutionConfig — file keys override, absence must not detune
     kwargs["config"] = execution_config_from_properties(

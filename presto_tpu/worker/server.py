@@ -234,7 +234,24 @@ class _Handler(BaseHTTPRequestHandler):
             "tasksRetried": c["retried"],
             "heapUsed": c["memory_peak"],   # HBM peak, heap-shaped field
             **({"failureDetector": det.snapshot()} if det else {}),
+            **self._serving_status(),
         })
+
+    def _serving_status(self) -> dict:
+        """Serving-tier section of /v1/status (coordinator role): plan /
+        executable cache counters, prepared-statement registry, per-group
+        admission state."""
+        s = self.server_ref
+        if s.dispatch is None:
+            return {}
+        from ..serving import (GLOBAL_PLAN_CACHE, PREPARED_REGISTRY,
+                               SERVING_METRICS)
+        return {"serving": {
+            "planCache": GLOBAL_PLAN_CACHE.info(),
+            "preparedStatements": PREPARED_REGISTRY.info(),
+            "metrics": SERVING_METRICS.snapshot(),
+            "resourceGroups": s.dispatch.resource_groups.info(),
+        }}
 
     def do_metrics(self, groups, query):
         """Prometheus text exposition (reference
@@ -301,6 +318,48 @@ class _Handler(BaseHTTPRequestHandler):
             "presto_tpu_exchange_buffered_bytes_peak "
             f"{x['buffered_bytes_peak']}",
         ]
+        # serving tier: canonical plan/executable cache + prepared
+        # statements + per-resource-group admission state
+        from ..serving import GLOBAL_PLAN_CACHE, SERVING_METRICS
+        sv = SERVING_METRICS.snapshot()
+        pc = GLOBAL_PLAN_CACHE.info()
+        lines += [
+            "# TYPE presto_tpu_serving_plan_cache_hits_total counter",
+            f"presto_tpu_serving_plan_cache_hits_total {sv['planCacheHits']}",
+            "# TYPE presto_tpu_serving_plan_cache_misses_total counter",
+            "presto_tpu_serving_plan_cache_misses_total "
+            f"{sv['planCacheMisses']}",
+            "# TYPE presto_tpu_serving_plan_cache_evictions_total counter",
+            "presto_tpu_serving_plan_cache_evictions_total "
+            f"{sv['planCacheEvictions']}",
+            "# TYPE presto_tpu_serving_plan_cache_invalidations_total counter",
+            "presto_tpu_serving_plan_cache_invalidations_total "
+            f"{sv['planCacheInvalidations']}",
+            "# TYPE presto_tpu_serving_plan_cache_entries gauge",
+            f"presto_tpu_serving_plan_cache_entries {pc['entries']}",
+            "# TYPE presto_tpu_serving_executable_builds_total counter",
+            f"presto_tpu_serving_executable_builds_total "
+            f"{sv['executableBuilds']}",
+            "# TYPE presto_tpu_serving_prepared_fast_path_total counter",
+            "presto_tpu_serving_prepared_fast_path_total "
+            f"{sv['preparedFastPath']}",
+            "# TYPE presto_tpu_serving_prepared_replans_total counter",
+            f"presto_tpu_serving_prepared_replans_total "
+            f"{sv['preparedReplans']}",
+        ]
+        if s.dispatch is not None:
+            lines += [
+                "# TYPE presto_tpu_serving_group_running gauge",
+                "# TYPE presto_tpu_serving_group_queued gauge",
+            ]
+            for name, g in sorted(s.dispatch.resource_groups.info().items()):
+                if name.startswith("__"):
+                    continue
+                lines.append('presto_tpu_serving_group_running{group="%s"'
+                             ',weight="%g"} %d'
+                             % (name, g["weight"], g["running"]))
+                lines.append('presto_tpu_serving_group_queued{group="%s"} %d'
+                             % (name, g["queued"]))
         self._send(200, None, ("\n".join(lines) + "\n").encode(),
                    headers={"Content-Type":
                             "text/plain; version=0.0.4; charset=utf-8"})
@@ -341,6 +400,36 @@ class _Handler(BaseHTTPRequestHandler):
                     session[k.strip()] = v.strip()
         return session
 
+    def _prepared_headers(self):
+        """X-Presto-Prepared-Statement: name=urlencoded-sql, repeatable and
+        comma-joinable (reference PrestoHeaders.PRESTO_PREPARED_STATEMENT:
+        the client replays its prepared map on every request, keeping the
+        server stateless across coordinator restarts)."""
+        from urllib.parse import unquote_plus
+        prepared = {}
+        for raw in self.headers.get_all("X-Presto-Prepared-Statement") or []:
+            for pair in raw.split(","):
+                if "=" in pair:
+                    k, v = pair.split("=", 1)
+                    prepared[unquote_plus(k.strip())] = \
+                        unquote_plus(v.strip())
+        return prepared
+
+    @staticmethod
+    def _prepare_headers_out(q) -> Dict[str, str]:
+        """Response headers the client folds back into its prepared map
+        (reference PRESTO_ADDED_PREPARE / PRESTO_DEALLOCATED_PREPARE)."""
+        from urllib.parse import quote_plus
+        hdrs = {}
+        if getattr(q, "added_prepare", None):
+            name, text = q.added_prepare
+            hdrs["X-Presto-Added-Prepare"] = \
+                f"{quote_plus(name)}={quote_plus(text)}"
+        if getattr(q, "deallocated_prepare", None):
+            hdrs["X-Presto-Deallocated-Prepare"] = \
+                quote_plus(q.deallocated_prepare)
+        return hdrs
+
     def do_statement_post(self, groups, query):
         d = self._dispatch_mgr()
         if d is None:
@@ -352,9 +441,11 @@ class _Handler(BaseHTTPRequestHandler):
             source=self.headers.get("X-Presto-Source", ""),
             session=self._session_headers(),
             catalog=self.headers.get("X-Presto-Catalog", "tpch"),
-            schema=self.headers.get("X-Presto-Schema", "sf0.01"))
+            schema=self.headers.get("X-Presto-Schema", "sf0.01"),
+            prepared=self._prepared_headers())
         self._send(200, d.queued_response(q, 0, self.server_ref.uri,
-                                          wait_s=0.0))
+                                          wait_s=0.0),
+                   headers=self._prepare_headers_out(q))
 
     def _statement_query(self, d, groups):
         try:
@@ -374,7 +465,8 @@ class _Handler(BaseHTTPRequestHandler):
         q = self._statement_query(d, groups)
         if q is not None:
             self._send(200, d.queued_response(
-                q, int(groups["token"]), self.server_ref.uri))
+                q, int(groups["token"]), self.server_ref.uri),
+                headers=self._prepare_headers_out(q))
 
     def do_statement_executing(self, groups, query):
         d = self._dispatch_mgr()
@@ -383,7 +475,8 @@ class _Handler(BaseHTTPRequestHandler):
         q = self._statement_query(d, groups)
         if q is not None:
             self._send(200, d.executing_response(
-                q, int(groups["token"]), self.server_ref.uri))
+                q, int(groups["token"]), self.server_ref.uri),
+                headers=self._prepare_headers_out(q))
 
     def do_statement_cancel(self, groups, query):
         d = self._dispatch_mgr()
@@ -574,7 +667,11 @@ class WorkerServer:
                  jwt_expiration_s: int = 300,
                  https_cert_path: Optional[str] = None,
                  https_key_path: Optional[str] = None,
-                 internal_ca_path: Optional[str] = None):
+                 internal_ca_path: Optional[str] = None,
+                 plan_cache_entries: Optional[int] = None,
+                 total_concurrency: Optional[int] = None,
+                 admission_headroom_fraction: Optional[float] = None,
+                 admission_memory_pool=None):
         self.environment = environment
         self.coordinator = coordinator
         self.state = "ACTIVE"            # ACTIVE | SHUTTING_DOWN
@@ -633,7 +730,18 @@ class WorkerServer:
         self._runner_cache: Dict = {}
         self._runner_lock = threading.Lock()
         if coordinator:
-            from .statement import DispatchManager
+            from .statement import DispatchManager, ResourceGroupManager
+            if plan_cache_entries is not None:
+                from ..serving import GLOBAL_PLAN_CACHE
+                GLOBAL_PLAN_CACHE.set_max_entries(plan_cache_entries)
+            if resource_groups is None and (
+                    total_concurrency is not None
+                    or admission_memory_pool is not None):
+                resource_groups = ResourceGroupManager(
+                    total_concurrency=total_concurrency,
+                    memory_pool=admission_memory_pool,
+                    **({"headroom_fraction": admission_headroom_fraction}
+                       if admission_headroom_fraction is not None else {}))
             self.dispatch = DispatchManager(self._execute_statement,
                                             resource_groups, events=events)
 
@@ -730,7 +838,7 @@ class WorkerServer:
             # single-node SELECTs stream chunk-by-chunk: the coordinator
             # never materializes the full result (reference Query.java
             # pumps the root-stage buffer)
-            sr = runner.execute_streaming(q.sql)
+            sr = runner.execute_streaming(q.sql, prepared=q.prepared)
             if sr is not None:
                 from .statement import StreamingResult, _json_value
                 columns, row_iter, stats = sr
@@ -738,7 +846,10 @@ class WorkerServer:
                     columns,
                     ([_json_value(v) for v in row] for row in row_iter),
                     stats)
-        result = runner.execute(q.sql)
+        if not uris:
+            result = runner.execute(q.sql, prepared=q.prepared)
+        else:
+            result = runner.execute(q.sql)
         if q.sql.lstrip()[:6].lower() in ("create", "insert") \
                 or q.sql.lstrip()[:4].lower() == "drop":
             with self._runner_lock:

@@ -45,11 +45,21 @@ class QueryQueueFullError(RuntimeError):
     pass
 
 
+class QueryMemoryLimitError(RuntimeError):
+    """The query's memory estimate can NEVER be admitted (it exceeds the
+    admission pool's total headroom) — immediate rejection, the reference
+    coordinator's INSUFFICIENT_RESOURCES."""
+
+
 @dataclass
 class ResourceGroupSpec:
     name: str
     hard_concurrency_limit: int = 10
     max_queued: int = 100
+    # fair-share weight (reference schedulingWeight): under a global
+    # concurrency cap, a group with weight 2 is admitted twice as often
+    # as a weight-1 group when both have queued work
+    weight: float = 1.0
 
 
 @dataclass
@@ -68,17 +78,48 @@ class Selector:
 
 
 class ResourceGroupManager:
-    """Admission control (InternalResourceGroupManager.java:84, FIFO
-    scheduling policy)."""
+    """Admission control (InternalResourceGroupManager.java:84).
+
+    Per-group: FIFO up to hard_concurrency_limit running, max_queued
+    waiting, reject beyond.  Across groups, two serving-tier additions:
+
+    - WEIGHTED FAIR SHARE (reference WEIGHTED_FAIR scheduling policy):
+      under a global `total_concurrency` cap, each admission advances the
+      group's virtual time by 1/weight; when capacity frees, the eligible
+      group with the LEAST virtual time admits next.  Two groups with
+      equal weights hammering the coordinator interleave ~1:1 regardless
+      of arrival order; a weight-3 group gets ~3x the admissions.
+
+    - MEMORY HEADROOM (reference ClusterMemoryManager / resource-group
+      softMemoryLimit): admission holds each query's memory estimate
+      against `memory_pool` (exec/memory.MemoryPool) capped at
+      headroom_fraction * budget.  An estimate that can never fit rejects
+      immediately (QueryMemoryLimitError); one that is only temporarily
+      blocked queues until running queries release their claim.
+    """
+
+    DEFAULT_QUERY_MEMORY_ESTIMATE = 64 << 20
 
     def __init__(self, groups: Optional[List[ResourceGroupSpec]] = None,
-                 selectors: Optional[List[Selector]] = None):
+                 selectors: Optional[List[Selector]] = None,
+                 total_concurrency: Optional[int] = None,
+                 memory_pool=None, headroom_fraction: float = 0.8,
+                 query_memory_estimate: Optional[int] = None):
         self.groups = {g.name: g for g in (groups or [])}
         if "global" not in self.groups:
             self.groups["global"] = ResourceGroupSpec("global")
         self.selectors = list(selectors or [])
+        self.total_concurrency = total_concurrency
+        self.memory_pool = memory_pool
+        self.headroom_fraction = headroom_fraction
+        self.query_memory_estimate = (
+            query_memory_estimate if query_memory_estimate is not None
+            else self.DEFAULT_QUERY_MEMORY_ESTIMATE)
         self._running: Dict[str, set] = {n: set() for n in self.groups}
         self._queues: Dict[str, deque] = {n: deque() for n in self.groups}
+        self._vtime: Dict[str, float] = {n: 0.0 for n in self.groups}
+        self._total_running = 0
+        self._mem_admitted = 0
         self._lock = threading.Lock()
 
     def select(self, user: str, source: str) -> str:
@@ -87,14 +128,56 @@ class ResourceGroupManager:
                 return s.group
         return "global"
 
+    # -- admission --------------------------------------------------------
+    def _mem_cap(self) -> Optional[int]:
+        if self.memory_pool is None or self.memory_pool.budget is None:
+            return None
+        return int(self.memory_pool.budget * self.headroom_fraction)
+
+    def _estimate(self, query: "ManagedQuery") -> int:
+        est = getattr(query, "memory_estimate", None)
+        return est if est is not None else self.query_memory_estimate
+
+    def _can_run_locked(self, g: str, est: int) -> bool:
+        if len(self._running[g]) >= self.groups[g].hard_concurrency_limit:
+            return False
+        if self.total_concurrency is not None \
+                and self._total_running >= self.total_concurrency:
+            return False
+        cap = self._mem_cap()
+        if cap is not None and self._mem_admitted + est > cap:
+            return False
+        return True
+
+    def _admit_locked(self, query: "ManagedQuery", est: int) -> None:
+        g = query.resource_group
+        self._running[g].add(query.query_id)
+        self._total_running += 1
+        self._mem_admitted += est
+        query._admitted_bytes = est
+        # virtual-time fair queueing: each admission costs 1/weight of
+        # virtual service, so min-vtime selection interleaves groups in
+        # proportion to their weights
+        self._vtime[g] += 1.0 / max(self.groups[g].weight, 1e-9)
+
     def admit(self, query: "ManagedQuery") -> bool:
-        """True = run now; False = queued.  Raises when the queue is full
-        (reference QUERY_QUEUE_FULL)."""
+        """True = run now; False = queued.  Raises QueryQueueFullError on
+        a full queue (reference QUERY_QUEUE_FULL) and
+        QueryMemoryLimitError when the memory estimate exceeds the
+        admission pool's total headroom (can never run)."""
         g = query.resource_group
         spec = self.groups[g]
+        est = self._estimate(query)
         with self._lock:
-            if len(self._running[g]) < spec.hard_concurrency_limit:
-                self._running[g].add(query.query_id)
+            cap = self._mem_cap()
+            if cap is not None and est > cap:
+                raise QueryMemoryLimitError(
+                    f"query memory estimate {est} bytes exceeds the "
+                    f"admission headroom {cap} bytes "
+                    f"({self.headroom_fraction:g} of pool budget "
+                    f"{self.memory_pool.budget})")
+            if self._can_run_locked(g, est):
+                self._admit_locked(query, est)
                 return True
             if len(self._queues[g]) >= spec.max_queued:
                 raise QueryQueueFullError(
@@ -103,17 +186,35 @@ class ResourceGroupManager:
             self._queues[g].append(query)
             return False
 
-    def release(self, query: "ManagedQuery") -> Optional["ManagedQuery"]:
-        """Free the slot; pop the next queued query of the group, if any."""
-        g = query.resource_group
+    def release(self, query: "ManagedQuery") -> List["ManagedQuery"]:
+        """Free the slot + memory claim; admit every now-eligible queued
+        query, fair-share order (least virtual time first).  Returns the
+        admitted queries — one release can unblock several when it was
+        the memory claim, not a concurrency slot, that gated them."""
         with self._lock:
-            self._running[g].discard(query.query_id)
-            while self._queues[g]:
-                nxt = self._queues[g].popleft()
-                if nxt.state == QUEUED:
-                    self._running[g].add(nxt.query_id)
-                    return nxt
-            return None
+            g = query.resource_group
+            if query.query_id in self._running[g]:
+                self._running[g].discard(query.query_id)
+                self._total_running -= 1
+                self._mem_admitted -= getattr(
+                    query, "_admitted_bytes", self._estimate(query))
+            admitted: List["ManagedQuery"] = []
+            while True:
+                best = None
+                for name, qd in self._queues.items():
+                    while qd and qd[0].state != QUEUED:
+                        qd.popleft()      # cancelled while queued
+                    if not qd or not self._can_run_locked(
+                            name, self._estimate(qd[0])):
+                        continue
+                    if best is None \
+                            or self._vtime[name] < self._vtime[best]:
+                        best = name
+                if best is None:
+                    return admitted
+                nxt = self._queues[best].popleft()
+                self._admit_locked(nxt, self._estimate(nxt))
+                admitted.append(nxt)
 
     def remove_queued(self, query: "ManagedQuery") -> None:
         with self._lock:
@@ -124,12 +225,21 @@ class ResourceGroupManager:
 
     def info(self) -> dict:
         with self._lock:
-            return {n: {"running": len(self._running[n]),
-                        "queued": len(self._queues[n]),
-                        "hardConcurrencyLimit":
-                            self.groups[n].hard_concurrency_limit,
-                        "maxQueued": self.groups[n].max_queued}
-                    for n in self.groups}
+            out = {n: {"running": len(self._running[n]),
+                       "queued": len(self._queues[n]),
+                       "hardConcurrencyLimit":
+                           self.groups[n].hard_concurrency_limit,
+                       "maxQueued": self.groups[n].max_queued,
+                       "weight": self.groups[n].weight,
+                       "virtualTime": self._vtime[n]}
+                   for n in self.groups}
+            out["__admission"] = {
+                "totalRunning": self._total_running,
+                "totalConcurrency": self.total_concurrency,
+                "memoryAdmittedBytes": self._mem_admitted,
+                "memoryHeadroomBytes": self._mem_cap(),
+            }
+            return out
 
 
 @dataclass
@@ -153,6 +263,11 @@ class ManagedQuery:
     catalog: str
     schema: str
     resource_group: str = "global"
+    # server-side prepared statements visible to this request
+    # (X-Presto-Prepared-Statement headers, QueryPreparer analog)
+    prepared: Dict[str, str] = field(default_factory=dict)
+    added_prepare: Optional[tuple] = None       # (name, text) from PREPARE
+    deallocated_prepare: Optional[str] = None   # name from DEALLOCATE
     slug: str = field(default_factory=lambda: uuid.uuid4().hex[:12])
     state: str = QUEUED
     error: Optional[str] = None
@@ -165,6 +280,8 @@ class ManagedQuery:
     done: threading.Event = field(default_factory=threading.Event)
     _cancelled: bool = False
     _admitted: bool = False     # holds a resource-group running slot
+    memory_estimate: Optional[int] = None   # admission claim, bytes
+    _admitted_bytes: int = 0    # what admission actually reserved
     # streaming result state (StreamingResult executors)
     _row_iter: object = None
     _stats_src: object = None
@@ -227,12 +344,19 @@ class DispatchManager:
 
     def submit(self, sql: str, user: str = "user", source: str = "",
                session: Optional[Dict[str, str]] = None,
-               catalog: str = "tpch", schema: str = "sf0.01") -> ManagedQuery:
+               catalog: str = "tpch", schema: str = "sf0.01",
+               prepared: Optional[Dict[str, str]] = None) -> ManagedQuery:
         self._reap_abandoned()
         qid = f"{time.strftime('%Y%m%d_%H%M%S')}_{next(_query_ids):05d}"
         q = ManagedQuery(qid, sql, user, source, dict(session or {}),
-                         catalog, schema)
+                         catalog, schema, prepared=dict(prepared or {}))
         q.resource_group = self.resource_groups.select(user, source)
+        est = (session or {}).get("query_memory_bytes")
+        if est is not None:
+            try:
+                q.memory_estimate = max(0, int(est))
+            except (TypeError, ValueError):
+                pass
         from .events import QueryCreatedEvent
         self.events.query_created(QueryCreatedEvent(
             query_id=qid, sql=sql, user=user, source=source,
@@ -250,9 +374,10 @@ class DispatchManager:
             if self.resource_groups.admit(q):
                 q._admitted = True
                 self._start(q)
-        except QueryQueueFullError as e:
+        except (QueryQueueFullError, QueryMemoryLimitError) as e:
             # through _finish so the completed event fires (the reference
-            # emits an immediate-failure event for queue rejection)
+            # emits an immediate-failure event for queue rejection /
+            # INSUFFICIENT_RESOURCES)
             self._finish(q, FAILED, str(e))
         return q
 
@@ -287,6 +412,9 @@ class DispatchManager:
                 q.rows = [[_json_value(v) for v in row]
                           for row in result.rows]
                 q.runtime_stats = getattr(result, "runtime_stats", None)
+                q.added_prepare = getattr(result, "added_prepare", None)
+                q.deallocated_prepare = getattr(
+                    result, "deallocated_prepare", None)
                 self._finish(q, CANCELED if q._cancelled else FINISHED,
                              None)
                 return
@@ -330,8 +458,7 @@ class DispatchManager:
         # only a query that held a running slot frees one; cancelling a
         # QUEUED query must not over-admit past hardConcurrencyLimit
         if q._admitted:
-            nxt = self.resource_groups.release(q)
-            if nxt is not None:
+            for nxt in self.resource_groups.release(q):
                 nxt._admitted = True
                 self._start(nxt)
 

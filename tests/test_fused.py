@@ -99,8 +99,12 @@ def test_chain_assembles_for_join_query():
         return r
     F.FusedChain.prep = spy
     try:
+        # isolated plan cache: the process-global one may hold a warm
+        # compiler for this exact shape (prep legitimately skipped)
+        from presto_tpu.serving import PlanCache
         r = LocalQueryRunner("sf0.01", config=ExecutionConfig(
-            batch_rows=1 << 14, join_out_capacity=1 << 16))
+            batch_rows=1 << 14, join_out_capacity=1 << 16),
+            plan_cache=PlanCache())
         r.assert_same_as_reference(FANOUT1_JOIN_AGG)
     finally:
         F.FusedChain.prep = orig

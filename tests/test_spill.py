@@ -201,10 +201,16 @@ def test_no_reservation_leak_on_failure():
 
 
 def test_plan_cache_not_poisoned_and_bounded():
-    r = LocalQueryRunner("sf0.01")
+    from presto_tpu.serving import PlanCache
+    cache = PlanCache(max_entries=8)
+    r = LocalQueryRunner("sf0.01", plan_cache=cache)
     for i in range(70):
         r.execute(f"select count(*) from region where r_regionkey < {i % 7}")
-    assert len(r._plan_cache) <= r._PLAN_CACHE_MAX
+    info = cache.info()
+    assert info["entries"] <= cache.max_entries
+    # the literal is parameterized out, so all 70 share ONE canonical
+    # entry: everything after the first execution is a hit
+    assert info["hits"] >= 60
     # repeated executes reuse one compiler (warm path)
     a = r.execute("select count(*) from nation")
     b = r.execute("select count(*) from nation")
