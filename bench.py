@@ -54,6 +54,14 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
+# BENCH_XCHG_DEVICES=N virtualizes N host devices so the xchg benchmark's
+# ICI-fabric pass has a mesh even on CPU (must land before jax init).
+if os.environ.get("BENCH_XCHG_DEVICES"):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count="
+        + os.environ["BENCH_XCHG_DEVICES"]).strip()
+
 # Honor JAX_PLATFORMS=cpu even under the axon TPU plugin, which ignores the
 # env var (same dance as tests/conftest.py / __graft_entry__.py).
 if "cpu" in os.environ.get("JAX_PLATFORMS", ""):
@@ -182,6 +190,55 @@ def bench_xchg(runs):
                 "buffered_peak_bytes": x["buffered_bytes_peak"],
             },
         }
+
+        # --- fabric comparison: the same shuffle through the in-process
+        # mesh scheduler with the ICI all_to_all fabric (needs >= 2
+        # devices; BENCH_XCHG_DEVICES=N virtualizes a CPU mesh).  Both
+        # fabrics must return identical rows; ici moves ~0 host bytes and
+        # reports the chunked compute/collective overlap fraction.
+        import jax
+        devs = jax.devices()
+        out["fabrics"] = {
+            "http": {
+                "wall_s": round(best, 4),
+                "bytes_moved": x["bytes"],
+                "host_bytes": x["bytes"],
+                "wait_wall_s": round(x["wait_wall_s"], 4),
+                "drain_wall_s": round(drain, 4),
+            },
+        }
+        if len(devs) >= 2:
+            from presto_tpu.exec.pipeline import ExecutionConfig
+            from presto_tpu.exec.runner import (DistributedQueryRunner,
+                                                _assert_rows_equal)
+            from presto_tpu.parallel.fabric import FABRIC_METRICS
+            from presto_tpu.parallel.mesh import make_mesh
+            mesh = make_mesh(len(devs))
+            ici = DistributedQueryRunner(
+                schema, config=ExecutionConfig(exchange_fabric="ici"),
+                n_tasks=len(devs), mesh=mesh)
+            ici.execute(XCHG)             # warmup: compiles the exchange
+            FABRIC_METRICS.reset()
+            ici_best = float("inf")
+            for _ in range(runs):
+                t0 = time.perf_counter()
+                ici_result = ici.execute(XCHG)
+                ici_best = min(ici_best, time.perf_counter() - t0)
+            _assert_rows_equal(ici_result, result, ordered=False)
+            fi = FABRIC_METRICS.snapshot()["ici"]
+            out["fabrics"]["ici"] = {
+                "wall_s": round(ici_best, 4),
+                "devices": len(devs),
+                "exchanges": fi["exchanges"],
+                "chunks": fi["chunks"],
+                "bytes_moved": fi["bytes_moved"],
+                "host_bytes": fi["host_bytes"],
+                "dispatch_wall_s": round(fi["exchange_wall_s"], 4),
+                "wait_wall_s": round(fi["wait_wall_s"], 4),
+                "drain_wall_s": round(fi["compute_wall_s"], 4),
+            }
+            out["ici_overlap_fraction"] = round(
+                fi["overlap_fraction"], 4)
         print(json.dumps(out))
     finally:
         for w in workers:

@@ -40,6 +40,11 @@ Check codes
   (exec/grouped.py stage_shards_lifespans) must actually be the shape the
   scheduler assumes: SOURCE-distributed with its single scan receiving
   splits.
+- ``EXCHANGE_FABRIC``     a remote-exchange edge annotated with a fabric
+  (parallel/fabric.py) must be a shape that fabric can carry: ICI edges
+  hash-partitioned between multi-taskable stages (the scheduler pins
+  tasks 1:1 to mesh devices), and no RemoteSourceNode mixing ici and
+  http sources (an HTTP edge must not feed a device-resident read).
 """
 from __future__ import annotations
 
@@ -59,11 +64,13 @@ CHECK_PARTITIONING = "PARTITIONING"
 CHECK_FRAGMENT_BOUNDARY = "FRAGMENT_BOUNDARY"
 CHECK_GROUPED_EXECUTION = "GROUPED_EXECUTION"
 CHECK_SCAN_PUSHDOWN = "SCAN_PUSHDOWN"
+CHECK_EXCHANGE_FABRIC = "EXCHANGE_FABRIC"
 
 ALL_CHECK_CODES = (
     CHECK_DANGLING_VARIABLE, CHECK_DUPLICATE_NODE_ID, CHECK_TYPE_MISMATCH,
     CHECK_JOIN_KEY_TYPE, CHECK_EXCHANGE_LAYOUT, CHECK_PARTITIONING,
     CHECK_FRAGMENT_BOUNDARY, CHECK_GROUPED_EXECUTION, CHECK_SCAN_PUSHDOWN,
+    CHECK_EXCHANGE_FABRIC,
 )
 
 ERROR = "ERROR"
@@ -713,6 +720,73 @@ class ValidateGroupedExecution(FragmentCheck):
                         f"partitioned_sources")
 
 
+class ValidateExchangeFabric(FragmentCheck):
+    """A remote-exchange edge annotated with a fabric (fragmenter
+    annotate_exchange_fabrics / scheduler _plan_fabrics writing
+    PartitioningScheme.fabric) must be a shape the fabric can carry.
+    ICI rides a hash all_to_all between stages whose tasks the
+    scheduler pins 1:1 to mesh devices, so an ici edge must be
+    FIXED_HASH-partitioned and both endpoint fragments multi-taskable
+    (SOURCE or FIXED_HASH distribution); and a RemoteSourceNode's
+    source set must not mix ici with http — the device reader consumes
+    all-device or nothing, so an http edge feeding it would drop rows.
+    Un-annotated edges (fabric None) are out of scope: annotation is
+    optional and runtime resolution re-derives it."""
+    code = CHECK_EXCHANGE_FABRIC
+
+    _MULTI_TASK = (P.SOURCE_DISTRIBUTION, P.FIXED_HASH_DISTRIBUTION)
+
+    def run(self, subplan, ctx, exec_config=None):
+        from ..parallel.fabric import FABRIC_ICI, FABRICS
+        for sp in ValidateFragmentPartitioning._walk(subplan):
+            frag = sp.fragment
+            path = f"Fragment[{frag.fragment_id}]"
+            children = {c.fragment.fragment_id: c.fragment
+                        for c in sp.children}
+            for node in P.walk_plan(frag.root):
+                if not isinstance(node, P.RemoteSourceNode):
+                    continue
+                fabrics = set()
+                for fid in node.source_fragment_ids:
+                    child = children.get(fid)
+                    if child is None:
+                        continue    # FRAGMENT_BOUNDARY owns that diag
+                    scheme = child.output_partitioning_scheme
+                    fabric = getattr(scheme, "fabric", None)
+                    if fabric is None:
+                        continue
+                    fabrics.add(fabric)
+                    if fabric not in FABRICS or fabric == "auto":
+                        ctx.add(self.code, node, f"{path}/RemoteSource",
+                                f"fragment {fid!r} output annotated with "
+                                f"unknown fabric {fabric!r}")
+                        continue
+                    if fabric != FABRIC_ICI:
+                        continue
+                    if scheme.handle != P.FIXED_HASH_DISTRIBUTION:
+                        ctx.add(self.code, node, f"{path}/RemoteSource",
+                                f"ici fabric on a {scheme.handle} edge "
+                                f"from fragment {fid!r} (the all_to_all "
+                                f"carries only hash partitioning)")
+                    if child.partitioning not in self._MULTI_TASK:
+                        ctx.add(self.code, node, f"{path}/RemoteSource",
+                                f"ici fabric from a {child.partitioning}"
+                                f"-partitioned producer fragment {fid!r} "
+                                f"(tasks cannot pin 1:1 to mesh devices)")
+                    if frag.partitioning not in self._MULTI_TASK:
+                        ctx.add(self.code, node, f"{path}/RemoteSource",
+                                f"ici fabric into a {frag.partitioning}"
+                                f"-partitioned consumer fragment "
+                                f"{frag.fragment_id!r} (tasks cannot pin "
+                                f"1:1 to mesh devices)")
+                known = fabrics - {None}
+                if len(known) > 1:
+                    ctx.add(self.code, node, f"{path}/RemoteSource",
+                            f"remote source mixes fabrics {sorted(known)}"
+                            f": an http edge must not feed the "
+                            f"device-resident (ici) read path")
+
+
 class ValidateScanPushdown(Check):
     """A scan claiming pushed-down predicates must be able to prove the
     claim: every entry must be range/equality-shaped over a column the
@@ -798,6 +872,7 @@ DEFAULT_FRAGMENT_CHECKS: Tuple[FragmentCheck, ...] = (
     ValidateFragmentBoundaries(),
     ValidateFragmentPartitioning(),
     ValidateGroupedExecution(),
+    ValidateExchangeFabric(),
 )
 
 

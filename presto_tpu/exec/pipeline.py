@@ -235,6 +235,22 @@ class ExecutionConfig:
     storage_zone_rows: int = 1 << 16
     # dictionary/RLE encodings for resident columns; False = plain only
     storage_encodings: bool = True
+    # -- exchange fabric (parallel/fabric.py) -----------------------------
+    # which fabric hashed remote-exchange edges ride (reference analog:
+    # a per-edge shuffle-transport choice): "auto" picks the ICI
+    # all_to_all whenever producer+consumer stages can be pinned 1:1 to
+    # one mesh (the scheduler CHOOSES task counts to fit), "http" forces
+    # the PR 4 ExchangeClient page path, "ici" requests ICI and falls
+    # back to http (with a recorded fallback) when the edge is
+    # ineligible.  Config key exchange.fabric / session exchange_fabric
+    exchange_fabric: str = "auto"
+    # chunk granularity of the chunked ICI exchange (exchange.ici-chunk-rows):
+    # each producer's rows split into fixed-size chunks whose collectives
+    # dispatch back-to-back with NO host sync between them, so chunk k+1's
+    # all_to_all is in flight while the consumer computes on chunk k.
+    # Fixed chunk shapes also mean ONE compiled exchange program reused
+    # across stages (no re-padding to a fresh per-stage global max)
+    ici_chunk_rows: int = 1 << 12
 
 
 def tuned_config(**overrides) -> "ExecutionConfig":

@@ -416,6 +416,12 @@ class LocalQueryRunner:
                 else:
                     decisions.append(f"  {tname} [{n.id}]: (no pushdown)")
             subplan = plan_distributed(out, self._fragmenter_config())
+            from ..parallel.mesh import mesh_size
+            from ..sql.fragmenter import annotate_exchange_fabrics
+            annotate_exchange_fabrics(
+                subplan, exec_config=self.config,
+                mesh_size=mesh_size(getattr(self, "mesh", None)),
+                batch_mode=getattr(self, "_batch_mode", False))
             sections.append(("post-fragment",
                              check_subplan(subplan, "post-fragment",
                                            exec_config=self.config)))
@@ -457,6 +463,22 @@ class DistributedQueryRunner(LocalQueryRunner):
         # count equals the mesh size run as ICI all_to_all collectives
         self.mesh = mesh
 
+    # materialized exchanges can't stay device-resident; overridden by
+    # BatchQueryRunner so fabric resolution demotes its edges to http
+    _batch_mode = False
+
+    def _annotate_fabrics(self, subplan):
+        """Resolve and stamp each remote-exchange edge's fabric on the
+        fragment output schemes (sql/fragmenter.annotate_exchange_fabrics)
+        so EXPLAIN / EXPLAIN (TYPE VALIDATE) show the same choice the
+        scheduler will make at runtime."""
+        from ..parallel.mesh import mesh_size
+        from ..sql.fragmenter import annotate_exchange_fabrics
+        return annotate_exchange_fabrics(
+            subplan, exec_config=self.config,
+            mesh_size=mesh_size(self.mesh),
+            batch_mode=self._batch_mode)
+
     def plan_subplan(self, sql: str, ast=None):
         from ..sql.fragmenter import plan_distributed
         with self._validation():
@@ -470,6 +492,7 @@ class DistributedQueryRunner(LocalQueryRunner):
             types = [v.type for v in output.outputs]
             subplan = plan_distributed(output, self._fragmenter_config(),
                                        exec_config=self.config)
+            self._annotate_fabrics(subplan)
         return subplan, names, types
 
     def _fragmenter_config(self):
@@ -492,6 +515,7 @@ class DistributedQueryRunner(LocalQueryRunner):
                 .plan_query_to_output(ast.query)
             subplan = plan_distributed(output, self._fragmenter_config(),
                                        exec_config=self.config)
+            self._annotate_fabrics(subplan)
         text = format_subplan(subplan)
         return QueryResult(["Query Plan"], [VarcharType(max(1, len(text)))],
                            [[text]])
@@ -508,7 +532,11 @@ class DistributedQueryRunner(LocalQueryRunner):
         from .scheduler import InProcessScheduler
         subplan, names, types = self.plan_subplan(sql, ast=ast)
         sched = InProcessScheduler(self._scheduler_config())
-        return pages_to_result(sched.execute(subplan), names, types)
+        result = pages_to_result(sched.execute(subplan), names, types)
+        # fabric-tagged exchange stats (bytes / walls per fabric) collected
+        # while the result drained
+        result.runtime_stats = sched.stats.to_dict()
+        return result
 
     def _scheduler_config(self):
         from .scheduler import SchedulerConfig
@@ -524,6 +552,8 @@ class BatchQueryRunner(DistributedQueryRunner):
     exchange MATERIALIZED to local shuffle files (the Spark-shuffle /
     presto_cpp ShuffleWrite analog) and per-task retry from those durable
     inputs — batch fault tolerance instead of fail-fast MPP."""
+
+    _batch_mode = True
 
     def __init__(self, schema: str = "sf0.01", config=None,
                  n_tasks: int = 2, catalog: str = "tpch",

@@ -145,6 +145,33 @@ def partition_targets(page: Page, types: List[Type], key_indices: List[int],
     return (h % np.uint64(n_parts)).astype(np.int64)
 
 
+class StageAbortedError(RuntimeError):
+    """A sibling task of the same stage failed terminally: this task (or
+    this in-flight exchange drain) stops early instead of finishing work
+    whose stage is already doomed — the in-process analog of the worker
+    protocol's should_abort propagation."""
+
+
+def _block_bytes(b: Block) -> int:
+    """Host bytes one block occupies on the exchange wire (the page-split
+    path's analog of the ICI path's device-buffer accounting)."""
+    if isinstance(b, DictionaryBlock):
+        n = b.ids.nbytes + _block_bytes(b.dictionary)
+    elif isinstance(b, VariableWidthBlock):
+        n = b.data.nbytes + b.offsets.nbytes
+    elif isinstance(b, FixedWidthBlock):
+        n = b.values.nbytes
+    else:  # RunLengthBlock and friends: count the payload if it has one
+        inner = getattr(b, "value", None)
+        n = _block_bytes(inner) if inner is not None else 0
+    nulls = getattr(b, "nulls", None)
+    return n + (nulls.nbytes if nulls is not None else 0)
+
+
+def _page_bytes(page: Page) -> int:
+    return sum(_block_bytes(b) for b in page.blocks)
+
+
 def split_page(page: Page, targets: np.ndarray, n_parts: int) -> List[Page]:
     out = []
     for p in range(n_parts):
@@ -234,11 +261,21 @@ class StageInfo:
     n_tasks: int = 1
     n_partitions: int = 1      # consumer task count (output fan-out)
     buffers: Optional[OutputBuffers] = None
-    # ICI exchange result: consumer task -> device-resident Batch (rows
-    # whose hash targets that consumer), plus the producer's output
-    # column order for positional renaming at the consumer
+    # ICI exchange result: consumer task -> list of device-resident chunk
+    # Batches (rows whose hash targets that consumer, one Batch per
+    # exchange chunk), plus the producer's output column order for
+    # positional renaming at the consumer
     device_out: Optional[list] = None
     out_names: Optional[List[str]] = None
+    # resolved fabric of this stage's OUTPUT edge ("http" | "ici",
+    # parallel/fabric.py; None for the root stage) + why, set by
+    # _plan_fabrics before partition assignment
+    fabric: Optional[str] = None
+    fabric_reason: Optional[str] = None
+    # set when the first task of this stage fails terminally: sibling
+    # tasks and in-flight exchange consumers abort promptly instead of
+    # draining a doomed stage (threading.Event)
+    abort: object = None
     # concurrency telemetry: per-task wall seconds and the stage wall —
     # overlap quality = stage_wall / sum(task_walls)
     task_walls: Optional[List[float]] = None
@@ -252,6 +289,12 @@ class InProcessScheduler:
 
     def __init__(self, config: Optional[SchedulerConfig] = None):
         self.config = config or SchedulerConfig()
+        from ..utils.runtime_stats import RuntimeStats
+        # per-query fabric-tagged exchange stats (bytes moved, dispatch /
+        # wait / drain walls), merged into QueryResult.runtime_stats by
+        # DistributedQueryRunner — the RuntimeStats face of the same
+        # surface FABRIC_METRICS exposes process-wide
+        self.stats = RuntimeStats()
 
     # -- planning the stage tree -----------------------------------------
     def _build_stages(self, subplan: P.SubPlan) -> StageInfo:
@@ -264,6 +307,51 @@ class InProcessScheduler:
         else:
             n_tasks = 1
         return StageInfo(frag, children, n_tasks)
+
+    def _plan_fabrics(self, stage: StageInfo) -> None:
+        """Resolve the fabric of every remote-exchange edge and CHOOSE
+        task counts to fit the mesh: an ICI edge needs producer and
+        consumer tasks pinned 1:1 to mesh devices, so both endpoint
+        stages of an eligible hashed edge get n_tasks = mesh size
+        (generalizing the old eligibility test, which only engaged when
+        the configured task count happened to equal the mesh size).
+        Runs BEFORE _assign_partitions so the chosen counts drive the
+        output fan-out.  Mirrors sql/fragmenter.annotate_exchange_fabrics
+        (both call parallel/fabric.resolve_fabric) and honors a
+        pre-annotated scheme.fabric, writing the resolution back for
+        EXPLAIN/stats parity."""
+        from ..parallel.fabric import FABRIC_HTTP, FABRIC_ICI, resolve_fabric
+        msize = self._mesh_size()
+        requested = self.config.exec_config.exchange_fabric
+        child_by_fid = {c.fragment.fragment_id: c for c in stage.children}
+        for node in P.walk_plan(stage.fragment.root):
+            if not isinstance(node, P.RemoteSourceNode):
+                continue
+            edges = []
+            for fid in node.source_fragment_ids:
+                child = child_by_fid.get(fid)
+                if child is None:
+                    continue
+                scheme = child.fragment.output_partitioning_scheme
+                fabric, why = resolve_fabric(
+                    scheme.fabric or requested, handle=scheme.handle,
+                    producer_partitioning=child.fragment.partitioning,
+                    consumer_partitioning=stage.fragment.partitioning,
+                    mesh_size=msize, batch_mode=self.config.batch_mode)
+                edges.append((child, scheme, fabric, why))
+            # a multi-source reader consumes all-device or nothing: mixed
+            # resolutions demote every edge of this reader to http
+            if len({f for _, _, f, _ in edges}) > 1:
+                edges = [(c, s, FABRIC_HTTP, "mixed-fabric source set")
+                         for c, s, _, w in edges]
+            for child, scheme, fabric, why in edges:
+                child.fabric = scheme.fabric = fabric
+                child.fabric_reason = why
+                if fabric == FABRIC_ICI:
+                    child.n_tasks = msize
+                    stage.n_tasks = msize
+        for child in stage.children:
+            self._plan_fabrics(child)
 
     def _assign_partitions(self, stage: StageInfo,
                            consumer_tasks: int) -> None:
@@ -279,14 +367,14 @@ class InProcessScheduler:
     # -- execution --------------------------------------------------------
     def execute(self, subplan: P.SubPlan) -> Iterator[Page]:
         root = self._build_stages(subplan)
+        self._plan_fabrics(root)
         self._assign_partitions(root, 1)
         self._run_stage(root)
         yield from root.buffers.pages_for_consumer(0)
 
     def _mesh_size(self) -> int:
-        from ..parallel.mesh import WORKER_AXIS
-        return (0 if self.config.mesh is None
-                else self.config.mesh.shape[WORKER_AXIS])
+        from ..parallel.mesh import mesh_size
+        return mesh_size(self.config.mesh)
 
     def _batch_dir(self, fragment_id: str) -> str:
         """Shuffle-file directory for one stage (batch mode)."""
@@ -311,16 +399,18 @@ class InProcessScheduler:
         hashed = scheme.handle == P.FIXED_HASH_DISTRIBUTION
         stage.out_names = out_names
 
-        # ICI eligibility: hashed fan-out, tasks 1:1 with mesh devices
-        # (SURVEY.md §5.8: intra-pod hash exchange rides ICI; gather /
-        # broadcast / cross-process edges keep the page path)
+        # fabric resolution happened in _plan_fabrics (SURVEY.md §5.8:
+        # intra-pod hash exchange rides ICI; gather / broadcast /
+        # cross-process edges keep the page path).  The task-count
+        # re-check is defensive: _plan_fabrics chose n_tasks to fit the
+        # mesh, so an ICI stage that no longer matches is a planner bug
+        # better demoted than crashed
+        from ..parallel.fabric import FABRIC_ICI, FABRIC_METRICS
         mesh = self.config.mesh
-        ici = (hashed and stage.n_partitions > 1
+        ici = (stage.fabric == FABRIC_ICI and hashed
+               and stage.n_partitions > 1
                and stage.n_tasks == stage.n_partitions
-               and stage.n_tasks == self._mesh_size()
-               # batch mode wants every exchange durable on disk (retry
-               # re-reads it); device-resident shards are not durable
-               and not self.config.batch_mode)
+               and stage.n_tasks == self._mesh_size())
 
         # split assignment per scan node: task i takes splits[i::n]
         scan_splits: Dict[str, List] = {}
@@ -354,8 +444,14 @@ class InProcessScheduler:
                    if pin or ici else [None] * stage.n_tasks)
 
         import contextlib
+        import threading
         import time as _time
         import jax
+
+        # first terminal task failure aborts siblings and any in-flight
+        # ICI consumption promptly (the in-process analog of the worker
+        # protocol's should_abort propagation)
+        stage.abort = abort = threading.Event()
 
         # one traced program per stage, shared by its tasks (the tasks
         # compile byte-identical step closures; Python tracing is
@@ -392,7 +488,8 @@ class InProcessScheduler:
                            rnode.source_fragment_ids]
                 if device_inputs[rnode.id] and pin:
                     ctx.remote_batches[rnode.id] = _device_reader(
-                        sources, task_index, rnode)
+                        sources, task_index, rnode, abort=abort,
+                        stats=self.stats)
                 else:
                     ctx.remote_pages[rnode.id] = _remote_reader(
                         sources, task_index,
@@ -402,6 +499,7 @@ class InProcessScheduler:
             dev_ctx = (jax.default_device(devices[task_index])
                        if pin else contextlib.nullcontext())
             out = None
+            split_wall, split_bytes = 0.0, 0
             with dev_ctx:
                 if ici:
                     from .pipeline import _compact_concat
@@ -410,7 +508,12 @@ class InProcessScheduler:
                     out = _compact_concat(batches) if batches else None
                 else:
                     for page in compiler.run_to_pages(frag.root):
+                        if abort.is_set():
+                            raise StageAbortedError(
+                                f"sibling task of stage "
+                                f"{frag.fragment_id} failed")
                         if hashed and stage.n_partitions > 1:
+                            s0 = _time.perf_counter()
                             targets = partition_targets(
                                 page, out_types, key_indices,
                                 stage.n_partitions)
@@ -419,8 +522,21 @@ class InProcessScheduler:
                                                stage.n_partitions)):
                                 if sub is not None:
                                     stage.buffers.add(task_index, p, sub)
+                            split_wall += _time.perf_counter() - s0
+                            split_bytes += _page_bytes(page)
                         else:
                             stage.buffers.add(task_index, 0, page)
+            if split_bytes or split_wall:
+                # stats parity with the ICI path: the hashed page path IS
+                # the http fabric in-process (its pages move host-side,
+                # and cross-process they ride the ExchangeClient wire)
+                FABRIC_METRICS.record(
+                    "http", exchanges=1, chunks=1, bytes_moved=split_bytes,
+                    host_bytes=split_bytes, exchange_wall_s=split_wall)
+                self.stats.add("exchangeFabricHttpBytes", split_bytes,
+                               "BYTE")
+                self.stats.add("exchangeFabricHttpExchangeWallNanos",
+                               split_wall * 1e9, "NANO")
             return out, _time.perf_counter() - t0
 
         def run_task_retrying(task_index: int):
@@ -436,14 +552,22 @@ class InProcessScheduler:
             from ..common.errors import is_retryable
             attempts = 1 + max(0, self.config.task_retries)
             for attempt in range(attempts):
+                if abort.is_set():
+                    raise StageAbortedError(
+                        f"sibling task of stage {frag.fragment_id} failed")
                 try:
                     if self.config.fault_injector is not None:
                         self.config.fault_injector(
                             frag.fragment_id, task_index, attempt)
                     return run_task(task_index)
+                except StageAbortedError:
+                    raise               # echo of a sibling's failure
                 except Exception as e:
                     stage.buffers.reset_task(task_index)
                     if attempt + 1 >= attempts or not is_retryable(e):
+                        # terminal: stop siblings and any in-flight ICI
+                        # consumers of this stage promptly
+                        abort.set()
                         raise
             return None, 0.0
 
@@ -473,7 +597,15 @@ class InProcessScheduler:
         if ici:
             keys = tuple(out_names[i] for i in key_indices)
             if not self._ici_exchange(stage, task_batches, keys):
-                # metadata mismatch across tasks: fall back to pages
+                # metadata disagreement across tasks (dictionaries /
+                # schema / ARRAY columns): demote this edge to the page
+                # fabric — correctness over the fast path
+                from ..parallel.fabric import FABRIC_HTTP
+                FABRIC_METRICS.record("ici", fallbacks=1)
+                self.stats.add("exchangeFabricIciFallbacks", 1)
+                stage.fabric = FABRIC_HTTP
+                stage.fabric_reason = \
+                    "runtime fallback: task batch metadata disagreed"
                 self._spill_batches_to_pages(
                     stage, task_batches, out_names, out_types,
                     key_indices)
@@ -486,46 +618,71 @@ class InProcessScheduler:
 
     def _ici_exchange(self, stage: StageInfo, task_batches: List,
                       keys: Tuple[str, ...]) -> bool:
-        """all_to_all the per-task output batches across the mesh; on
-        success stage.device_out[consumer] holds that consumer's rows
-        device-resident.  Returns False when per-task batch metadata
-        (dictionaries / null-ness / schema) disagrees — the caller then
-        falls back to the page exchange."""
+        """all_to_all the per-task output batches across the mesh in
+        fixed-size row chunks; on success stage.device_out[consumer]
+        holds that consumer's rows device-resident as a list of chunk
+        Batches.  Returns False when per-task batch metadata
+        (dictionaries / null-ness / schema / ARRAY columns) disagrees
+        with what the exchange kernel can carry — the caller then falls
+        back to the page exchange.
+
+        Chunking is what buys compute/collective overlap: with quota ==
+        chunk rows, bucket overflow is STATICALLY impossible (a chunk of
+        C rows per device can never put more than C rows in one bucket),
+        so every chunk's collective is dispatched back-to-back with zero
+        host syncs and JAX async dispatch keeps chunk k+1 on the wire
+        while the consumer computes on chunk k (_device_reader measures
+        the wait it actually eats).  The compiled exchange is keyed on
+        (devices, keys, chunk rows) — NOT per-stage row counts — so one
+        program and its donated staging buffers are reused across chunks
+        and stages instead of re-padding to a fresh global max."""
+        import time as _time
+
         import jax
-        import jax.numpy as jnp
         from jax.sharding import NamedSharding, PartitionSpec
-        from ..exec import operators as ops
         from ..exec.batch import Batch, Column
         from ..parallel.exchange import make_partitioned_exchange
+        from ..parallel.fabric import FABRIC_METRICS
         from ..parallel.mesh import WORKER_AXIS
         mesh = self.config.mesh
         devices = list(mesh.devices.flat)
         n = stage.n_tasks
 
-        lives = [0 if b is None else int(jax.device_get(b.mask.sum()))  # lint: allow-host-sync
-                 for b in task_batches]
         template = next((b for b in task_batches if b is not None), None)
         if template is None:
-            stage.device_out = [None] * n
+            stage.device_out = [[] for _ in range(n)]
             return True
         # schema/metadata must agree across tasks (scan dictionaries are
-        # table-stable, so they normally do)
+        # table-stable, so they normally do); ARRAY columns carry a
+        # ragged `lengths` companion the exchange kernel doesn't ship
+        if any(c.lengths is not None for c in template.columns.values()):
+            return False
         tstruct = _batch_meta(template)
         for b in task_batches:
             if b is not None and _batch_meta(b) != tstruct:
                 return False
 
-        B = max(256, 1 << (max(max(lives), 1) - 1).bit_length())
+        t0 = _time.perf_counter()
+        # ONE device->host transfer covers every task's live-row count
+        # (the _compact_concat idiom) — the only host sync on this path;
+        # the old per-task device_get loop serialized n round-trips
+        present = [b for b in task_batches if b is not None]
+        counts = jax.device_get(  # lint: allow-host-sync
+            [b.mask.sum() for b in present])
+        max_live = max((int(c) for c in counts), default=0)
+
+        C = max(1, int(self.config.exec_config.ici_chunk_rows))
+        n_chunks = max(1, -(-max_live // C))
+        B = n_chunks * C
+
         from .pipeline import _jit_compact
         norm = []
         for i, b in enumerate(task_batches):
             with jax.default_device(devices[i]):
-                if b is None:
-                    nb = _zeros_like_batch(template, B)
-                elif b.capacity == B:
-                    nb = b
-                else:
-                    nb = _jit_compact(b, B)
+                # compact packs live rows into a contiguous prefix, so
+                # the fixed-size chunk slices below tile the live set
+                nb = (_zeros_like_batch(template, B) if b is None
+                      else _jit_compact(b, B))
             norm.append(nb)
 
         sharding = NamedSharding(mesh, PartitionSpec(WORKER_AXIS))
@@ -533,54 +690,64 @@ class InProcessScheduler:
         def to_global(arrays):
             arrays = [jax.device_put(a, devices[i])
                       for i, a in enumerate(arrays)]
-            shape = (n * B,) + arrays[0].shape[1:]
+            shape = (n * C,) + arrays[0].shape[1:]
             return jax.make_array_from_single_device_arrays(
                 shape, sharding, arrays)
 
-        cols = {}
-        for name, c in template.columns.items():
-            values = to_global([nb.columns[name].values for nb in norm])
-            nulls = (to_global([nb.columns[name].null_mask()
-                                for nb in norm])
-                     if c.nulls is not None else None)
-            cols[name] = Column(values, nulls, c.dictionary, c.lazy)
-        gbatch = Batch(cols, to_global([nb.mask for nb in norm]))
+        key = (tuple(devices), keys, C)
+        exch = self._exch_cache.get(key)
+        if exch is None:
+            exch = make_partitioned_exchange(mesh, keys, quota=C,
+                                             donate=True)
+            self._exch_cache[key] = exch
 
-        # quota retry: start near the balanced share, double on overflow
-        # (the device-side overflow flag is the module's promised
-        # split-and-retry recovery; quota == B always fits)
-        quota = max(64, 1 << ((2 * max(max(lives), 1) // n) | 1)
-                    .bit_length())
-        quota = min(quota, B)
-        while True:
-            key = (tuple(devices), keys, quota, B)
-            exch = self._exch_cache.get(key)
-            if exch is None:
-                exch = make_partitioned_exchange(mesh, keys, quota)
-                self._exch_cache[key] = exch
-            out, overflow = exch(gbatch)
-            if not bool(jax.device_get(overflow)):  # lint: allow-host-sync
-                break
-            if quota >= B:
-                raise RuntimeError("ICI exchange overflow at full quota")
-            quota = min(B, quota * 2)
+        abort = stage.abort
+        chunk_outs = []
+        bytes_moved = 0
+        for k in range(n_chunks):
+            if abort is not None and abort.is_set():
+                raise StageAbortedError(
+                    f"stage {stage.fragment.fragment_id} aborted "
+                    f"mid-exchange")
+            lo, hi = k * C, (k + 1) * C
+            cols = {}
+            for name, c in template.columns.items():
+                values = to_global(
+                    [nb.columns[name].values[lo:hi] for nb in norm])
+                nulls = (to_global([nb.columns[name].null_mask()[lo:hi]
+                                    for nb in norm])
+                         if c.nulls is not None else None)
+                cols[name] = Column(values, nulls, c.dictionary, c.lazy)
+                bytes_moved += values.nbytes + (
+                    nulls.nbytes if nulls is not None else 0)
+            gmask = to_global([nb.mask[lo:hi] for nb in norm])
+            bytes_moved += gmask.nbytes
+            # overflow is statically impossible at quota == C, so the
+            # flag is DROPPED without a host read — nothing in this loop
+            # blocks, which is the whole overlap story
+            out, _overflow = exch(Batch(cols, gmask))
+            chunk_outs.append(out)
 
-        shard_cap = n * quota
-        by_dev = {}
-        first_col = next(iter(out.columns.values())).values
-        for s in first_col.addressable_shards:
-            by_dev[s.device] = None
-        stage.device_out = []
-        for i in range(n):
-            ccols = {}
-            for name, c in out.columns.items():
-                ccols[name] = Column(
-                    _shard_on(c.values, devices[i]),
-                    (_shard_on(c.nulls, devices[i])
-                     if c.nulls is not None else None),
-                    c.dictionary, c.lazy)
-            stage.device_out.append(
-                Batch(ccols, _shard_on(out.mask, devices[i])))
+        stage.device_out = [[] for _ in range(n)]
+        for out in chunk_outs:
+            for i in range(n):
+                ccols = {}
+                for name, c in out.columns.items():
+                    ccols[name] = Column(
+                        _shard_on(c.values, devices[i]),
+                        (_shard_on(c.nulls, devices[i])
+                         if c.nulls is not None else None),
+                        c.dictionary, c.lazy)
+                stage.device_out[i].append(
+                    Batch(ccols, _shard_on(out.mask, devices[i])))
+        wall = _time.perf_counter() - t0
+        FABRIC_METRICS.record("ici", exchanges=1, chunks=n_chunks,
+                              bytes_moved=bytes_moved,
+                              exchange_wall_s=wall)
+        self.stats.add("exchangeFabricIciBytes", bytes_moved, "BYTE")
+        self.stats.add("exchangeFabricIciChunks", n_chunks)
+        self.stats.add("exchangeFabricIciDispatchWallNanos",
+                       wall * 1e9, "NANO")
         return True
 
     def _spill_batches_to_pages(self, stage: StageInfo, task_batches,
@@ -602,8 +769,9 @@ class InProcessScheduler:
 
 def _batch_meta(b) -> tuple:
     return tuple(sorted(
-        (name, str(c.values.dtype), c.nulls is not None, c.dictionary,
-         c.lazy) for name, c in b.columns.items()))
+        (name, str(c.values.dtype), c.nulls is not None,
+         c.lengths is not None, c.dictionary, c.lazy)
+        for name, c in b.columns.items()))
 
 
 def _zeros_like_batch(template, B: int):
@@ -624,21 +792,52 @@ def _shard_on(arr, device):
     raise RuntimeError(f"no shard on {device}")
 
 
-def _device_reader(sources: List[StageInfo], consumer_task: int, rnode):
-    """Consumer-side ICI input: the device-resident shard for this task,
-    renamed positionally to the RemoteSourceNode's output variables."""
+def _device_reader(sources: List[StageInfo], consumer_task: int, rnode,
+                   abort=None, stats=None):
+    """Consumer-side ICI input: this task's device-resident shard of each
+    exchange chunk, renamed positionally to the RemoteSourceNode's output
+    variables.
+
+    Chunks were dispatched asynchronously by the producer stage
+    (_ici_exchange), so the first touch of each chunk may have to wait
+    for its collective.  The wait is measured by non-blocking is_ready()
+    polling (so a sibling abort is honored promptly instead of being
+    stuck in a blocking device sync) and reported against the
+    generator's total drain wall: overlap = 1 - wait / drain, the
+    fabric=ici half of the stats-parity story."""
+    import time as _time
+
     from ..exec.batch import Batch
+    from ..parallel.fabric import FABRIC_METRICS
     names = [v.name for v in rnode.outputs]
 
     def read():
-        for src in sources:
-            b = src.device_out[consumer_task]
-            if b is None:
-                continue
-            prod = src.out_names
-            cols = {names[j]: b.columns[prod[j]]
-                    for j in range(len(names))}
-            yield Batch(cols, b.mask)
+        drain0 = _time.perf_counter()
+        wait = 0.0
+        try:
+            for src in sources:
+                prod = src.out_names
+                for b in src.device_out[consumer_task] or ():
+                    w0 = _time.perf_counter()
+                    while not b.mask.is_ready():
+                        if abort is not None and abort.is_set():
+                            raise StageAbortedError(
+                                "stage aborted while draining ICI "
+                                "exchange")
+                        _time.sleep(0)
+                    wait += _time.perf_counter() - w0
+                    cols = {names[j]: b.columns[prod[j]]
+                            for j in range(len(names))}
+                    yield Batch(cols, b.mask)
+        finally:
+            drain = _time.perf_counter() - drain0
+            FABRIC_METRICS.record("ici", compute_wall_s=drain,
+                                  wait_wall_s=wait)
+            if stats is not None:
+                stats.add("exchangeFabricIciDrainWallNanos",
+                          drain * 1e9, "NANO")
+                stats.add("exchangeFabricIciWaitWallNanos",
+                          wait * 1e9, "NANO")
     return read
 
 
@@ -649,14 +848,13 @@ def _device_dicts_agree(sources: List[StageInfo]) -> bool:
     lazy metadata."""
     seen: Dict[int, tuple] = {}
     for src in sources:
-        for b in src.device_out or []:
-            if b is None:
-                continue
-            cols = [b.columns[n] for n in src.out_names]
-            for j, c in enumerate(cols):
-                meta = (c.dictionary, c.lazy)
-                if seen.setdefault(j, meta) != meta:
-                    return False
+        for chunks in src.device_out or []:
+            for b in chunks or ():
+                cols = [b.columns[n] for n in src.out_names]
+                for j, c in enumerate(cols):
+                    meta = (c.dictionary, c.lazy)
+                    if seen.setdefault(j, meta) != meta:
+                        return False
     return True
 
 
@@ -671,10 +869,9 @@ def _remote_reader(sources: List[StageInfo], consumer_task: int,
     def _source_pages(src: StageInfo) -> Iterator[Page]:
         if src.device_out is not None:
             from .batch import batch_to_page
-            b = src.device_out[consumer_task]
-            if b is not None:
-                types = [v.type for v in
-                         src.fragment.root.output_variables]
+            types = [v.type for v in
+                     src.fragment.root.output_variables]
+            for b in src.device_out[consumer_task] or ():
                 page = batch_to_page(b, src.out_names, types)
                 if page.position_count:
                     yield page

@@ -3,14 +3,25 @@ reference's HTTP pull shuffle between hash-partitioned stages
 (PartitionedOutputOperator.java:58 -> ExchangeClient.java:72; SURVEY.md §5.8).
 
 Where both producer and consumer stages run on chips of the same pod slice,
-the shuffle is a single jitted `all_to_all` under shard_map: each device
-buckets its rows by target partition (hash of the partition keys mod the
-worker count), pads buckets to a fixed quota (static shapes for XLA), and the
+the shuffle is a jitted `all_to_all` under shard_map: each device buckets
+its rows by target partition (hash of the partition keys mod the worker
+count), pads buckets to a fixed quota (static shapes for XLA), and the
 collective transposes the bucket axis across the mesh.  Bucket overflow is
 detected on device and surfaced to the host driver, which splits the batch
 and retries — same recovery discipline as the join's output capacity.
 
-Cross-pod edges and TPU<->Java edges keep the HTTP exchange (worker/).
+The scheduler's chunked mode (exec/scheduler.py _ici_exchange,
+exchange.ici-chunk-rows) calls the exchange once per fixed-size row chunk
+with quota == chunk rows: a chunk of C rows can never put more than C rows
+in one bucket, so overflow is STATICALLY impossible and the driver
+dispatches every chunk's collective back-to-back with no host sync — chunk
+k+1 rides the wire while the consumer computes on chunk k (JAX async
+dispatch), and the fixed chunk shape means one compiled exchange program
+(and its donated input staging buffers) is reused across chunks and
+stages instead of re-padding to a fresh per-stage global max.
+
+Cross-pod edges and TPU<->Java edges keep the HTTP exchange (worker/);
+fabric selection lives in parallel/fabric.py.
 """
 from __future__ import annotations
 
@@ -19,7 +30,10 @@ from typing import Dict, List, Tuple
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+try:                                    # moved out of experimental in 0.6
+    from jax import shard_map
+except ImportError:
+    from jax.experimental.shard_map import shard_map
 from jax.sharding import PartitionSpec as P
 
 from ..exec.batch import Batch, Column
@@ -96,9 +110,15 @@ def exchange_step(batch: Batch, key_names: Tuple[str, ...], n_parts: int,
 
 
 def make_partitioned_exchange(mesh, key_names: Tuple[str, ...],
-                              quota: int, salt: int = 0):
+                              quota: int, salt: int = 0,
+                              donate: bool = False):
     """Build a jitted shard_map shuffle: Batch (row-sharded) -> Batch
-    (row-sharded, rows placed on their hash-target device)."""
+    (row-sharded, rows placed on their hash-target device).
+
+    donate=True marks the input batch's buffers donatable (the chunked
+    caller's per-chunk staging slices are dead after the collective, so
+    XLA may reuse their memory for the bucketed layout / output where
+    layouts permit)."""
     n_parts = mesh.shape[WORKER_AXIS]
 
     def fn(batch: Batch):
@@ -107,4 +127,4 @@ def make_partitioned_exchange(mesh, key_names: Tuple[str, ...],
     spec = P(WORKER_AXIS)
     shmapped = shard_map(fn, mesh=mesh, in_specs=(spec,),
                          out_specs=(spec, P()))
-    return jax.jit(shmapped)
+    return jax.jit(shmapped, donate_argnums=(0,) if donate else ())

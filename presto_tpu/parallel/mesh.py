@@ -25,6 +25,13 @@ def make_mesh(n_devices: Optional[int] = None,
     return Mesh(np.array(devs), (WORKER_AXIS,))
 
 
+def mesh_size(mesh: Optional[Mesh]) -> int:
+    """Worker count of a scheduler mesh (0 when no mesh is configured) —
+    the task count the scheduler pins 1:1 to devices for ICI-fabric
+    stages (parallel/fabric.py resolve_fabric)."""
+    return 0 if mesh is None else mesh.shape[WORKER_AXIS]
+
+
 def row_sharding(mesh: Mesh) -> NamedSharding:
     """Shard dim 0 (rows) across workers."""
     return NamedSharding(mesh, PartitionSpec(WORKER_AXIS))

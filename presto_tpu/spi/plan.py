@@ -89,18 +89,28 @@ class PartitioningScheme:
     handle: str                      # one of the *_DISTRIBUTION constants
     arguments: List[Variable]        # partitioning columns (hash)
     output_layout: List[Variable]
+    # resolved exchange fabric of the remote edge this scheme describes
+    # ("http" | "ici", parallel/fabric.py), annotated post-fragmentation
+    # by the fragmenter/scheduler; None = unannotated (local exchanges,
+    # plans never fragmented).  Emitted in serde only when set so golden
+    # plan JSON and structural keys of unannotated plans are unchanged
+    fabric: Optional[str] = None
 
     def to_dict(self):
-        return {"partitioning": {"handle": self.handle,
-                                 "arguments": [a.to_dict() for a in self.arguments]},
-                "outputLayout": [v.to_dict() for v in self.output_layout]}
+        d = {"partitioning": {"handle": self.handle,
+                              "arguments": [a.to_dict() for a in self.arguments]},
+             "outputLayout": [v.to_dict() for v in self.output_layout]}
+        if self.fabric is not None:
+            d["fabric"] = self.fabric
+        return d
 
     @staticmethod
     def from_dict(d):
         return PartitioningScheme(
             d["partitioning"]["handle"],
             [RowExpression.from_dict(a) for a in d["partitioning"]["arguments"]],
-            [RowExpression.from_dict(v) for v in d["outputLayout"]])
+            [RowExpression.from_dict(v) for v in d["outputLayout"]],
+            d.get("fabric"))
 
 
 # ---------------------------------------------------------------------------

@@ -72,8 +72,11 @@ def _details(node: P.PlanNode) -> str:
         return (f"partitionBy = [{_vars(node.partition_by)}]{order} | "
                 + funcs)
     if isinstance(node, P.ExchangeNode):
+        fabric = ("" if node.partitioning_scheme.fabric is None
+                  else f", fabric = {node.partitioning_scheme.fabric}")
         return (f"type = {node.exchange_type}, scope = {node.scope}, "
-                f"partitioning = {node.partitioning_scheme.handle}")
+                f"partitioning = {node.partitioning_scheme.handle}"
+                f"{fabric}")
     if isinstance(node, P.RemoteSourceNode):
         return f"sourceFragments = {node.source_fragment_ids}"
     if isinstance(node, P.OutputNode):
@@ -165,7 +168,11 @@ def format_subplan(subplan, stats: Optional[Dict[str, dict]] = None) -> str:
 
     def walk(sp, depth: int) -> None:
         f = sp.fragment
-        lines.append(f"Fragment {f.fragment_id} [{f.partitioning}]")
+        scheme = f.output_partitioning_scheme
+        fabric = ("" if getattr(scheme, "fabric", None) is None
+                  else f" fabric={scheme.fabric}")
+        lines.append(f"Fragment {f.fragment_id} [{f.partitioning}]"
+                     f"{fabric}")
         lines.append(format_plan(f.root, stats))
         lines.append("")
         for ch in sp.children:

@@ -519,3 +519,45 @@ def plan_distributed(root: P.OutputNode,
     from ..analysis import validate_subplan
     validate_subplan(sub, "post-fragment", exec_config=exec_config)
     return sub
+
+
+def annotate_exchange_fabrics(subplan: P.SubPlan, exec_config=None,
+                              mesh_size: int = 0,
+                              batch_mode: bool = False) -> P.SubPlan:
+    """Annotate every remote-exchange edge (each child fragment's output
+    partitioning scheme) with its resolved fabric ("http" | "ici",
+    parallel/fabric.py) for the given mesh.  The scheduler re-derives the
+    same resolution when choosing task counts; annotating the plan makes
+    the choice visible to EXPLAIN and checkable by the EXCHANGE_FABRIC
+    validation pass.  A RemoteSourceNode reading several child fragments
+    (union) must see ONE fabric across them — the device reader consumes
+    all-device or nothing — so mixed resolutions demote to http."""
+    from ..parallel.fabric import FABRIC_HTTP, FABRIC_ICI, resolve_fabric
+    requested = getattr(exec_config, "exchange_fabric", None)
+
+    def visit(sp: P.SubPlan) -> None:
+        frag = sp.fragment
+        by_fid = {c.fragment.fragment_id: c for c in sp.children}
+        for node in P.walk_plan(frag.root):
+            if not isinstance(node, P.RemoteSourceNode):
+                continue
+            resolved = []
+            for fid in node.source_fragment_ids:
+                child = by_fid.get(fid)
+                if child is None:
+                    continue
+                scheme = child.fragment.output_partitioning_scheme
+                fabric, _why = resolve_fabric(
+                    scheme.fabric or requested, handle=scheme.handle,
+                    producer_partitioning=child.fragment.partitioning,
+                    consumer_partitioning=frag.partitioning,
+                    mesh_size=mesh_size, batch_mode=batch_mode)
+                resolved.append((scheme, fabric))
+            mixed = len({f for _, f in resolved}) > 1
+            for scheme, fabric in resolved:
+                scheme.fabric = FABRIC_HTTP if mixed else fabric
+        for c in sp.children:
+            visit(c)
+
+    visit(subplan)
+    return subplan

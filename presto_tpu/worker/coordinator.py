@@ -328,6 +328,14 @@ class _QueryExecution:
         self.max_response_bytes = parse_data_size(self.session.get(
             "exchange_max_response_size", cfg.exchange_max_response_bytes))
         self.stats = RuntimeStats()             # root-pull exchange stats
+        # shuffle fabric: session override > config.  The HTTP coordinator
+        # only drives the page wire, so a requested "ici" is honored
+        # inside each worker's local scheduler (if it has a mesh) while
+        # every CROSS-process edge here stays http — tag the stats so
+        # fabric comparisons see which wire this run used
+        self.fabric = str(runner.session.get(
+            "exchange_fabric", cfg.exchange_fabric)).strip().lower()
+        self.stats.add("exchangeFabricHttpQueries", 1)
         self.id_attempt: Dict[str, int] = {}    # lineage -> id generation
         self.budget_used: Dict[str, int] = {}   # lineage -> retries charged
         self.suspects: Set[str] = set()         # workers seen failing

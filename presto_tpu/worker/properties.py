@@ -105,6 +105,19 @@ def execution_config_from_properties(props: Dict[str, str],
     if "exchange.max-buffer-size" in props:
         kw["exchange_max_buffer_bytes"] = parse_data_size(
             props["exchange.max-buffer-size"])
+    if "exchange.fabric" in props:
+        from ..parallel.fabric import FABRICS
+        fabric = props["exchange.fabric"].strip().lower()
+        if fabric not in FABRICS:
+            raise ValueError(
+                f"exchange.fabric must be one of {FABRICS}, got {fabric!r}")
+        kw["exchange_fabric"] = fabric
+    if "exchange.ici-chunk-rows" in props:
+        n = int(props["exchange.ici-chunk-rows"])
+        if n < 1:
+            raise ValueError(
+                f"exchange.ici-chunk-rows must be >= 1, got {n}")
+        kw["ici_chunk_rows"] = n
     if "exchange.max-response-size" in props:
         kw["exchange_max_response_bytes"] = parse_data_size(
             props["exchange.max-response-size"])
@@ -186,6 +199,10 @@ class SystemConfig:
         ("exchange.client-threads", int, 4),
         ("exchange.max-buffer-size", str, "32MB"),
         ("exchange.max-response-size", str, "1MB"),
+        # shuffle fabric selection + ICI chunk granularity
+        # (parallel/fabric.py; exec/scheduler.py _ici_exchange)
+        ("exchange.fabric", str, "auto"),
+        ("exchange.ici-chunk-rows", int, 1 << 12),
         ("announcement-interval-ms", int, 1000),
         ("heartbeat-interval-ms", int, 1000),
         ("async-data-cache-enabled", bool, False),

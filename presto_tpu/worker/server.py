@@ -318,6 +318,48 @@ class _Handler(BaseHTTPRequestHandler):
             "presto_tpu_exchange_buffered_bytes_peak "
             f"{x['buffered_bytes_peak']}",
         ]
+        # per-fabric shuffle section (parallel/fabric.py FABRIC_METRICS):
+        # the http/ici comparison surface — bytes moved per fabric, the
+        # dispatch/compute/wait walls, and the measured overlap fraction
+        from ..parallel.fabric import FABRIC_METRICS
+        fm = FABRIC_METRICS.snapshot()
+        lines += [
+            "# TYPE presto_tpu_exchange_fabric_exchanges_total counter",
+            "# TYPE presto_tpu_exchange_fabric_chunks_total counter",
+            "# TYPE presto_tpu_exchange_fabric_bytes_total counter",
+            "# TYPE presto_tpu_exchange_fabric_host_bytes_total counter",
+            "# TYPE presto_tpu_exchange_fabric_exchange_wall_seconds_total"
+            " counter",
+            "# TYPE presto_tpu_exchange_fabric_compute_wall_seconds_total"
+            " counter",
+            "# TYPE presto_tpu_exchange_fabric_wait_wall_seconds_total"
+            " counter",
+            "# TYPE presto_tpu_exchange_fabric_fallbacks_total counter",
+            "# TYPE presto_tpu_exchange_fabric_overlap_fraction gauge",
+        ]
+        for fabric in sorted(fm):
+            f = fm[fabric]
+            tag = 'fabric="%s"' % fabric
+            lines += [
+                f"presto_tpu_exchange_fabric_exchanges_total{{{tag}}} "
+                f"{f['exchanges']}",
+                f"presto_tpu_exchange_fabric_chunks_total{{{tag}}} "
+                f"{f['chunks']}",
+                f"presto_tpu_exchange_fabric_bytes_total{{{tag}}} "
+                f"{f['bytes_moved']}",
+                f"presto_tpu_exchange_fabric_host_bytes_total{{{tag}}} "
+                f"{f['host_bytes']}",
+                f"presto_tpu_exchange_fabric_exchange_wall_seconds_total"
+                f"{{{tag}}} {f['exchange_wall_s']:.6f}",
+                f"presto_tpu_exchange_fabric_compute_wall_seconds_total"
+                f"{{{tag}}} {f['compute_wall_s']:.6f}",
+                f"presto_tpu_exchange_fabric_wait_wall_seconds_total"
+                f"{{{tag}}} {f['wait_wall_s']:.6f}",
+                f"presto_tpu_exchange_fabric_fallbacks_total{{{tag}}} "
+                f"{f['fallbacks']}",
+                f"presto_tpu_exchange_fabric_overlap_fraction{{{tag}}} "
+                f"{f['overlap_fraction']:.6f}",
+            ]
         # serving tier: canonical plan/executable cache + prepared
         # statements + per-resource-group admission state
         from ..serving import GLOBAL_PLAN_CACHE, SERVING_METRICS

@@ -83,6 +83,13 @@ class TpuTask:
                 "bufferedPages": self.output_pages,
                 "peakTotalMemoryInBytes": self.memory_peak,
                 "state": self.state,
+                # the wire this task's remote-source inputs rode: the
+                # worker protocol pulls pages over HTTP regardless of the
+                # configured preference (ICI engages only inside a
+                # mesh-pinned in-process stage, exec/scheduler.py)
+                "exchangeFabric": "http",
+                "exchangeFabricRequested": getattr(
+                    self.config, "exchange_fabric", "auto"),
                 "runtimeStats": self.stats.to_dict(),
             },
             "pipelines": [{

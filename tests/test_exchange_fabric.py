@@ -1,0 +1,351 @@
+"""Exchange fabric selection as a first-class planner/scheduler concern
+(parallel/fabric.py resolve_fabric; scheduler._plan_fabrics;
+fragmenter.annotate_exchange_fabrics): `exchange.fabric = auto|http|ici`
+picks per-edge between the HTTP page shuffle and the chunked ICI
+all_to_all, EXPLAIN and the EXCHANGE_FABRIC validation check surface the
+choice, and FABRIC_METRICS reports per-fabric bytes/walls/overlap.
+
+Mesh-backed tests run on the 8-device virtual CPU mesh
+(tests/conftest.py sets xla_force_host_platform_device_count=8); the
+end-to-end 8-task executions carry @pytest.mark.slow (the marker
+test_grouped / test_tpcds use for heavy runs) so the smoke tier keeps
+its time budget — `pytest tests/test_exchange_fabric.py` runs them all.
+"""
+import jax
+import pytest
+
+from presto_tpu.exec.pipeline import ExecutionConfig
+from presto_tpu.exec.runner import (DistributedQueryRunner,
+                                    LocalQueryRunner, _assert_rows_equal)
+from presto_tpu.parallel.fabric import (FABRIC_HTTP, FABRIC_ICI,
+                                        FABRIC_METRICS, resolve_fabric)
+
+needs_mesh = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8 (virtual) devices")
+
+GROUPBY = """
+SELECT o.custkey, count(*) AS c, sum(o.totalprice) AS s
+FROM orders o GROUP BY o.custkey
+"""
+
+Q3 = """
+SELECT l.orderkey, sum(l.extendedprice * (1 - l.discount)) AS revenue,
+       o.orderdate, o.shippriority
+FROM customer c, orders o, lineitem l
+WHERE c.mktsegment = 'BUILDING' AND c.custkey = o.custkey
+  AND l.orderkey = o.orderkey
+  AND o.orderdate < DATE '1995-03-15' AND l.shipdate > DATE '1995-03-15'
+GROUP BY l.orderkey, o.orderdate, o.shippriority
+ORDER BY revenue DESC, o.orderdate
+LIMIT 10
+"""
+
+
+def make_mesh():
+    from presto_tpu.parallel.mesh import WORKER_AXIS
+    return jax.sharding.Mesh(jax.devices()[:8], (WORKER_AXIS,))
+
+
+def _runner(fabric="auto", mesh="default", n_tasks=8, **cfg_kw):
+    cfg = ExecutionConfig(batch_rows=1 << 13, join_out_capacity=1 << 15,
+                          exchange_fabric=fabric, **cfg_kw)
+    m = make_mesh() if mesh == "default" else mesh
+    return DistributedQueryRunner("sf0.01", config=cfg, n_tasks=n_tasks,
+                                  mesh=m)
+
+
+def _local():
+    return LocalQueryRunner("sf0.01", config=ExecutionConfig(
+        batch_rows=1 << 13, join_out_capacity=1 << 15))
+
+
+_GROUPBY_EXP = []
+
+
+def groupby_expected():
+    """GROUPBY through the local engine + numpy oracle, computed once
+    for the whole module (four tests compare against it)."""
+    if not _GROUPBY_EXP:
+        _GROUPBY_EXP.append(
+            _local().assert_same_as_reference(GROUPBY, ordered=False))
+    return _GROUPBY_EXP[0]
+
+
+class _IciSpy:
+    """Counts _ici_exchange engagements (device path actually taken)."""
+
+    def __init__(self):
+        self.engaged = 0
+        self.called = 0
+
+    def __enter__(self):
+        from presto_tpu.exec import scheduler as S
+        self._S, self._orig = S, S.InProcessScheduler._ici_exchange
+        spy = self
+
+        def wrapper(sched, stage, task_batches, keys):
+            spy.called += 1
+            ok = spy._orig(sched, stage, task_batches, keys)
+            if ok and stage.device_out is not None:
+                spy.engaged += 1
+            return ok
+        S.InProcessScheduler._ici_exchange = wrapper
+        return self
+
+    def __exit__(self, *exc):
+        self._S.InProcessScheduler._ici_exchange = self._orig
+
+
+# ---------------------------------------------------------------------------
+# resolve_fabric: the shared decision table
+# ---------------------------------------------------------------------------
+
+def test_resolve_fabric_decision_table():
+    def r(req="auto", handle="FIXED_HASH", prod="SOURCE",
+          cons="FIXED_HASH", mesh=8, batch=False):
+        return resolve_fabric(req, handle=handle,
+                              producer_partitioning=prod,
+                              consumer_partitioning=cons,
+                              mesh_size=mesh, batch_mode=batch)
+
+    assert r() == (FABRIC_ICI, "mesh-eligible hash edge")
+    assert r(req="ici")[0] == FABRIC_ICI
+    assert r(req="http") == (FABRIC_HTTP, "requested")
+    # None == auto (un-annotated edge resolved from config default)
+    assert r(req=None)[0] == FABRIC_ICI
+    # ineligibility demotes even an explicit ici request, with a reason
+    for kw in ({"handle": "SINGLE"}, {"handle": "FIXED_BROADCAST"},
+               {"mesh": 0}, {"mesh": 1}, {"batch": True},
+               {"prod": "SINGLE"}, {"cons": "SINGLE"}):
+        fabric, why = r(req="ici", **kw)
+        assert fabric == FABRIC_HTTP, kw
+        assert why and why != "requested", kw
+
+
+# ---------------------------------------------------------------------------
+# scheduler fabric planning (mesh-backed)
+# ---------------------------------------------------------------------------
+
+@needs_mesh
+@pytest.mark.slow
+def test_auto_selection_chooses_mesh_task_count():
+    """With a 3-task runner over an 8-device mesh, _plan_fabrics must
+    CHOOSE 8 tasks for the eligible hashed edge (the generalization over
+    the old n_tasks == mesh_size accident) and the exchange must ride
+    the mesh."""
+    with _IciSpy() as spy:
+        got = _runner(n_tasks=3).execute(GROUPBY)
+    _assert_rows_equal(got, groupby_expected(), ordered=False)
+    assert spy.engaged >= 1, "ICI fabric never engaged"
+
+
+@needs_mesh
+@pytest.mark.slow
+def test_forced_http_disables_ici():
+    with _IciSpy() as spy:
+        got = _runner(fabric="http").execute(GROUPBY)
+    _assert_rows_equal(got, groupby_expected(), ordered=False)
+    assert spy.called == 0, "forced http still took the device path"
+
+
+def test_forced_ici_without_mesh_falls_back():
+    """exchange.fabric=ici with no mesh degrades gracefully to the page
+    shuffle (resolve_fabric: 'no mesh') instead of failing the query."""
+    with _IciSpy() as spy:
+        got = _runner(fabric="ici", mesh=None, n_tasks=2).execute(GROUPBY)
+    _assert_rows_equal(got, groupby_expected(), ordered=False)
+    assert spy.called == 0
+
+
+@needs_mesh
+@pytest.mark.slow
+def test_fabric_differential_stats():
+    """Both fabrics agree on rows; ici moves device bytes with ZERO
+    host bytes and reports a sane chunked overlap fraction, http meters
+    its page bytes — the xchg-bench comparison in miniature."""
+    FABRIC_METRICS.reset()
+    got_ici = _runner().execute(Q3)
+    fi = FABRIC_METRICS.snapshot()["ici"]
+
+    FABRIC_METRICS.reset()
+    got_http = _runner(fabric="http").execute(Q3)
+    fh = FABRIC_METRICS.snapshot()["http"]
+
+    _assert_rows_equal(got_ici, got_http, ordered=True)
+    assert fi["exchanges"] >= 1 and fi["chunks"] >= 1
+    assert fi["bytes_moved"] > 0
+    assert fi["host_bytes"] == 0, "ici fabric staged bytes through host"
+    assert 0.0 <= fi["overlap_fraction"] <= 1.0
+    assert fh["exchanges"] >= 1 and fh["bytes_moved"] > 0
+    assert fh["host_bytes"] == fh["bytes_moved"]
+    # stats parity: the same counters ride QueryResult.runtime_stats
+    rs = got_ici.runtime_stats
+    assert rs.get("exchangeFabricIciBytes", {}).get("sum", 0) > 0
+    assert "exchangeFabricIciChunks" in rs
+
+
+@needs_mesh
+@pytest.mark.slow
+def test_metadata_mismatch_falls_back_to_pages():
+    """When per-task batch metadata disagrees with what the exchange
+    kernel can carry, the stage demotes to the page fabric at runtime:
+    correct rows, fallback metered."""
+    from presto_tpu.exec import scheduler as S
+    orig = S._batch_meta
+    S._batch_meta = lambda b: object()   # never equal across calls
+    FABRIC_METRICS.reset()
+    try:
+        with _IciSpy() as spy:
+            got = _runner().execute(GROUPBY)
+    finally:
+        S._batch_meta = orig
+    _assert_rows_equal(got, groupby_expected(), ordered=False)
+    assert spy.called >= 1 and spy.engaged == 0
+    assert FABRIC_METRICS.snapshot()["ici"]["fallbacks"] >= 1
+    assert got.runtime_stats.get(
+        "exchangeFabricIciFallbacks", {}).get("sum", 0) >= 1
+
+
+@needs_mesh
+@pytest.mark.slow
+def test_failed_sibling_aborts_ici_stage():
+    """A terminally-failing task stops its stage before the collective:
+    the query raises and the ICI exchange is never dispatched with a
+    missing sibling (which would hang or ship garbage)."""
+
+    class Boom(RuntimeError):
+        pass
+
+    def inject(fragment_id, task_index, attempt):
+        if task_index == 1:
+            raise Boom(f"injected failure in fragment {fragment_id}")
+
+    class FaultyRunner(DistributedQueryRunner):
+        def _scheduler_config(self):
+            cfg = super()._scheduler_config()
+            cfg.fault_injector = inject
+            return cfg
+
+    runner = FaultyRunner(
+        "sf0.01", config=ExecutionConfig(batch_rows=1 << 13,
+                                         join_out_capacity=1 << 15),
+        n_tasks=8, mesh=make_mesh())
+    with _IciSpy() as spy:
+        with pytest.raises(Boom):
+            runner.execute(GROUPBY)
+    assert spy.engaged == 0, "ICI exchange ran despite a failed sibling"
+
+
+# ---------------------------------------------------------------------------
+# EXPLAIN + validation surface
+# ---------------------------------------------------------------------------
+
+@needs_mesh
+def test_explain_shows_chosen_fabric():
+    text = _runner().execute("EXPLAIN " + GROUPBY).rows[0][0]
+    assert "fabric=ici" in text, text
+    text = _runner(fabric="http").execute("EXPLAIN " + GROUPBY).rows[0][0]
+    assert "fabric=http" in text and "fabric=ici" not in text, text
+
+
+def test_explain_no_mesh_is_all_http():
+    text = DistributedQueryRunner("sf0.01", n_tasks=2) \
+        .execute("EXPLAIN " + GROUPBY).rows[0][0]
+    assert "fabric=ici" not in text, text
+
+
+def test_validate_check_flags_bad_fabric_annotations():
+    from presto_tpu.analysis.checker import (CHECK_EXCHANGE_FABRIC,
+                                             check_subplan)
+    from presto_tpu.common.types import BigintType
+    from presto_tpu.spi import plan as P
+    from presto_tpu.spi.expr import VariableReferenceExpression as V
+
+    v = V("a", BigintType())
+
+    def subplan_with(fabric, handle=P.FIXED_HASH_DISTRIBUTION,
+                     producer=P.SOURCE_DISTRIBUTION,
+                     consumer=P.FIXED_HASH_DISTRIBUTION):
+        child_root = P.ValuesNode("v0", [v])
+        scheme = P.PartitioningScheme(handle, [v] if
+                                      handle == P.FIXED_HASH_DISTRIBUTION
+                                      else [], [v])
+        scheme.fabric = fabric
+        child = P.SubPlan(P.PlanFragment("1", child_root, producer,
+                                         scheme), [])
+        remote = P.RemoteSourceNode("r0", ["1"], [v])
+        root = P.PlanFragment(
+            "0", remote, consumer,
+            P.PartitioningScheme(P.SINGLE_DISTRIBUTION, [], [v]))
+        return P.SubPlan(root, [child])
+
+    def codes(sub):
+        return {d.code for d in check_subplan(sub)}
+
+    # well-formed annotations pass
+    assert CHECK_EXCHANGE_FABRIC not in codes(subplan_with("http"))
+    assert CHECK_EXCHANGE_FABRIC not in codes(subplan_with(None))
+    assert CHECK_EXCHANGE_FABRIC not in codes(subplan_with("ici"))
+    # unresolved / unknown fabric must not reach execution
+    assert CHECK_EXCHANGE_FABRIC in codes(subplan_with("auto"))
+    assert CHECK_EXCHANGE_FABRIC in codes(subplan_with("warp"))
+    # ici on a non-hash edge
+    assert CHECK_EXCHANGE_FABRIC in codes(
+        subplan_with("ici", handle=P.SINGLE_DISTRIBUTION))
+    # ici endpoints must be multi-taskable
+    assert CHECK_EXCHANGE_FABRIC in codes(
+        subplan_with("ici", producer=P.SINGLE_DISTRIBUTION))
+    assert CHECK_EXCHANGE_FABRIC in codes(
+        subplan_with("ici", consumer=P.SINGLE_DISTRIBUTION))
+
+
+@needs_mesh
+def test_explain_validate_accepts_annotated_plan():
+    """EXPLAIN (TYPE VALIDATE) runs the EXCHANGE_FABRIC check over the
+    fabric-annotated fragmented plan and reports no diagnostics for a
+    plan the runner itself produced."""
+    text = _runner().execute("EXPLAIN (TYPE VALIDATE) " + GROUPBY) \
+        .rows[0][0]
+    assert "EXCHANGE_FABRIC" not in text, text
+
+
+# ---------------------------------------------------------------------------
+# configuration surface
+# ---------------------------------------------------------------------------
+
+def test_exchange_fabric_properties_parsing():
+    from presto_tpu.worker.properties import (
+        SystemConfig, execution_config_from_properties)
+    cfg = execution_config_from_properties(
+        {"exchange.fabric": "ICI", "exchange.ici-chunk-rows": "2048"})
+    assert cfg.exchange_fabric == "ici"
+    assert cfg.ici_chunk_rows == 2048
+    with pytest.raises(ValueError):
+        execution_config_from_properties({"exchange.fabric": "warp"})
+    with pytest.raises(ValueError):
+        execution_config_from_properties(
+            {"exchange.ici-chunk-rows": "0"})
+    sc = SystemConfig({})
+    assert sc.get("exchange.fabric") == "auto"
+    assert sc.get("exchange.ici-chunk-rows") == 1 << 12
+
+
+def test_execution_config_defaults():
+    cfg = ExecutionConfig()
+    assert cfg.exchange_fabric == "auto"
+    assert cfg.ici_chunk_rows >= 1
+
+
+@needs_mesh
+@pytest.mark.slow
+def test_chunk_rows_drive_chunk_count():
+    """Tiny exchange.ici-chunk-rows must split the same shuffle into
+    more collective dispatches (the compute/collective overlap knob)."""
+    FABRIC_METRICS.reset()
+    _runner(ici_chunk_rows=256).execute(GROUPBY)
+    chunks_small = FABRIC_METRICS.snapshot()["ici"]["chunks"]
+
+    FABRIC_METRICS.reset()
+    _runner(ici_chunk_rows=1 << 14).execute(GROUPBY)
+    chunks_big = FABRIC_METRICS.snapshot()["ici"]["chunks"]
+    assert chunks_small > chunks_big >= 1
