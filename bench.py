@@ -480,6 +480,20 @@ def main():
         "chunks_total": sm["chunks_total"],
         "chunks_skipped": sm["chunks_skipped"],
     }
+    # operator-level breakdown from the stats spine: one EXPLAIN ANALYZE
+    # pass (same plan, fused path) and the top-5 operators by wall — where
+    # the headline wall actually went
+    runner.execute("EXPLAIN ANALYZE " + sql.strip())
+    ops = runner.last_operator_stats or {}
+    out["operators"] = [
+        {"planNodeId": nid,
+         "operator": s.get("operatorType") or nid.split(".", 1)[0],
+         "rows": s.get("rows", 0),
+         "wall_ms": round(s.get("wall_s", 0.0) * 1e3, 2),
+         "fused": bool(s.get("fused"))}
+        for nid, s in sorted(ops.items(),
+                             key=lambda kv: kv[1].get("wall_s", 0.0),
+                             reverse=True)[:5]]
     gstats = {k: v for k, v in (result.runtime_stats or {}).items()
               if k.startswith("grouped")}
     if gstats:

@@ -25,6 +25,13 @@ and flags the hazard shapes:
            sync.  Network I/O belongs in the worker layer; the exchange
            client (worker/exchange.py) is the sanctioned home and is
            allow-listed.
+  SYNC006  un-metered wall-clock reads (`time.time()` /
+           `time.perf_counter()` / `time.perf_counter_ns()`) in `exec/`.
+           Every wall-clock sample in the execution layer must feed a
+           stats surface (RuntimeStats, operator stats, driver walls) —
+           ad-hoc timing that goes nowhere rots into dead measurement
+           and hides where walls are ACTUALLY recorded.  Sanctioned
+           metering sites carry `# lint: allow-wall-clock`.
 
 "Device value" is tracked with a deliberately shallow per-scope
 dataflow: names assigned from `jnp.*` / `lax.*` calls (or expressions
@@ -49,18 +56,20 @@ import sys
 import tokenize
 from dataclasses import dataclass
 from pathlib import Path
-from typing import Iterable, List, Set
+from typing import Dict, Iterable, List, Optional, Set
 
 PRAGMA = "lint: allow-host-sync"
+WALL_PRAGMA = "lint: allow-wall-clock"
 
 SYNC_EXPLICIT = "SYNC001"
 SYNC_CAST = "SYNC002"
 SYNC_ASARRAY = "SYNC003"
 SYNC_BRANCH = "SYNC004"
 SYNC_NETWORK = "SYNC005"
+SYNC_WALLCLOCK = "SYNC006"
 
 ALL_LINT_CODES = (SYNC_EXPLICIT, SYNC_CAST, SYNC_ASARRAY, SYNC_BRANCH,
-                  SYNC_NETWORK)
+                  SYNC_NETWORK, SYNC_WALLCLOCK)
 
 # SYNC005 scope: pipeline compute packages where a blocking HTTP round
 # trip would serialise operator execution.  Matching is on path markers,
@@ -75,6 +84,16 @@ _NETWORK_PATH_MARKERS = ("presto_tpu/exec/", "presto_tpu/common/",
 _NETWORK_ALLOWLIST = ("presto_tpu/worker/exchange.py",)
 _NETWORK_CALLS = {"urllib.request.urlopen", "urllib.request.urlretrieve",
                   "request.urlopen", "urlopen", "urlopen_internal"}
+
+# SYNC006 scope: the execution layer proper.  Wall-clock reads there must
+# feed a stats surface (RuntimeStats / operator stats / driver walls);
+# sanctioned metering sites carry `# lint: allow-wall-clock`.  `_time.*`
+# covers the `import time as _time` idiom used by several exec modules.
+_WALL_PATH_MARKER = "presto_tpu/exec/"
+_WALL_CALLS = {"time.time", "_time.time",
+               "time.perf_counter", "_time.perf_counter",
+               "time.perf_counter_ns", "_time.perf_counter_ns",
+               "time.monotonic", "_time.monotonic"}
 
 # Call prefixes whose results live on device.  `jax.` alone is NOT in the
 # list: most of the jax namespace (jit, vmap, tree_util) returns host
@@ -118,13 +137,20 @@ def _dotted(node: ast.AST) -> str:
     return ""
 
 
-def _allowed_lines(source: str) -> Set[int]:
-    """Line numbers carrying the allowlist pragma comment."""
-    allowed: Set[int] = set()
+def _allowed_lines(source: str) -> Dict[str, Set[int]]:
+    """Per-pragma sets of line numbers carrying an allowlist comment.
+
+    The two pragmas are deliberately NOT interchangeable: a host-sync
+    acknowledgement must not silence a wall-clock finding on the same
+    statement (and vice versa), so each code checks only its own set."""
+    allowed: Dict[str, Set[int]] = {PRAGMA: set(), WALL_PRAGMA: set()}
     try:
         for tok in tokenize.generate_tokens(io.StringIO(source).readline):
-            if tok.type == tokenize.COMMENT and PRAGMA in tok.string:
-                allowed.add(tok.start[0])
+            if tok.type != tokenize.COMMENT:
+                continue
+            for pragma, lines in allowed.items():
+                if pragma in tok.string:
+                    lines.add(tok.start[0])
     except tokenize.TokenizeError:
         pass
     return allowed
@@ -135,9 +161,10 @@ class _Linter(ast.NodeVisitor):
     names currently bound to device values (function scopes copy their
     enclosing scope so closures over device arrays stay tracked)."""
 
-    def __init__(self, path: str, allowed: Set[int]):
+    def __init__(self, path: str, allowed: Dict[str, Set[int]]):
         self.path = path
-        self.allowed = allowed
+        self.allowed = allowed.get(PRAGMA, set())
+        self.wall_allowed = allowed.get(WALL_PRAGMA, set())
         self.findings: List[LintFinding] = []
         self._device: List[Set[str]] = [set()]
         import os
@@ -145,12 +172,15 @@ class _Linter(ast.NodeVisitor):
         self._network_scoped = (
             any(m in norm for m in _NETWORK_PATH_MARKERS)
             and not any(norm.endswith(a) for a in _NETWORK_ALLOWLIST))
+        self._wall_scoped = _WALL_PATH_MARKER in norm
 
     # -- reporting --------------------------------------------------------
-    def _flag(self, node: ast.AST, code: str, message: str) -> None:
+    def _flag(self, node: ast.AST, code: str, message: str,
+              allowed: Optional[Set[int]] = None) -> None:
+        allowed = self.allowed if allowed is None else allowed
         first = getattr(node, "lineno", 0)
         last = getattr(node, "end_lineno", first) or first
-        if any(ln in self.allowed for ln in range(first, last + 1)):
+        if any(ln in allowed for ln in range(first, last + 1)):
             return
         self.findings.append(LintFinding(
             self.path, first, getattr(node, "col_offset", 0), code, message))
@@ -287,6 +317,13 @@ class _Linter(ast.NodeVisitor):
                        f"compute module; route it through the worker "
                        f"exchange client (worker/exchange.py) or "
                        f"acknowledge with `# {PRAGMA}`")
+        if self._wall_scoped and name in _WALL_CALLS:
+            self._flag(node, SYNC_WALLCLOCK,
+                       f"{name}() is an un-metered wall-clock read in the "
+                       f"execution layer; feed it into RuntimeStats / "
+                       f"operator stats, or mark the sanctioned metering "
+                       f"site with `# {WALL_PRAGMA}`",
+                       allowed=self.wall_allowed)
         self.generic_visit(node)
 
     def visit_If(self, node: ast.If) -> None:

@@ -41,13 +41,17 @@ class StatementClient:
                  source: str = "presto-tpu-cli",
                  catalog: str = "tpch", schema: str = "sf0.01",
                  session: Optional[Dict[str, str]] = None,
-                 timeout_s: float = 120.0):
+                 timeout_s: float = 120.0, trace_token: str = ""):
         self.base_uri = base_uri.rstrip("/")
         self.user = user
         self.source = source
         self.catalog = catalog
         self.schema = schema
         self.session: Dict[str, str] = dict(session or {})
+        # client-supplied trace token (X-Presto-Trace-Token): replayed on
+        # every request so coordinator and worker logs join on one id; the
+        # coordinator mints one per query when this is empty
+        self.trace_token = trace_token
         # server-side prepared statements, replayed as headers on every
         # request and updated from X-Presto-Added-Prepare /
         # X-Presto-Deallocated-Prepare responses (StatementClientV1's
@@ -67,6 +71,8 @@ class StatementClient:
         if self.session:
             headers["X-Presto-Session"] = ",".join(
                 f"{k}={v}" for k, v in self.session.items())
+        if self.trace_token:
+            headers["X-Presto-Trace-Token"] = self.trace_token
         if self.prepared:
             headers["X-Presto-Prepared-Statement"] = ",".join(
                 f"{quote_plus(k)}={quote_plus(v)}"

@@ -170,10 +170,18 @@ class FusedChain:
     batch footprint stays at the configured batch size.
     """
 
-    def __init__(self, compiler, steps: List[tuple], scan_meta: dict):
+    def __init__(self, compiler, steps: List[tuple], scan_meta: dict,
+                 step_ids: Optional[List[str]] = None,
+                 scan_id: Optional[str] = None):
         self.compiler = compiler
         self.steps = steps
         self.scan_meta = scan_meta
+        # plan-node ids for EXPLAIN ANALYZE row counters: node_ids[0] is
+        # the scan, node_ids[i+1] the node step i came from (None when the
+        # chain was assembled without id tracking, e.g. in older tests)
+        self.step_ids = step_ids or [None] * len(steps)
+        self.scan_id = scan_id
+        self.node_ids = [scan_id] + list(self.step_ids)
         self.cap = scan_meta["cap"]
         # parameterized probe expressions ride the traced aux pytree (last
         # element) so re-executions with different bound constants reuse
@@ -292,7 +300,12 @@ class FusedChain:
         return build_lookup(self.compiler, build_node, keys, for_join)
 
     def make(self, pos, valid, aux, expands: Tuple[int, ...],
-             leaf_cap: int) -> Batch:
+             leaf_cap: int, with_counts: bool = False):
+        """Apply the chain to one scan chunk.  With with_counts=True the
+        return value is (Batch, int64[1+len(steps)]) where counts[0] is
+        the scan's live rows and counts[i+1] the live rows after step i —
+        the device-side OperatorStats row counters EXPLAIN ANALYZE reads
+        (they ride the jitted program's outputs; no host syncs in-loop)."""
         meta = self.scan_meta
         mk = self._leaf_make.get(leaf_cap)
         if mk is None:
@@ -303,6 +316,7 @@ class FusedChain:
         dicts = meta["dicts"]
         batch = Batch({n: Column(v, None, dicts.get(n))
                        for n, v in outs.items()}, live)
+        counts = [jnp.sum(live)] if with_counts else None
         low = self.compiler.lowering
         params = aux[-1] if self.has_params else None
 
@@ -374,6 +388,10 @@ class FusedChain:
                 batch = batch.with_columns(
                     {node.semi_join_output.name: Column(hit, nulls)})
                 ji += 1
+            if with_counts:
+                counts.append(jnp.sum(batch.mask))
+        if with_counts:
+            return batch, jnp.stack(counts).astype(jnp.int64)
         return batch
 
     def _apply_join(self, batch: Batch, node: P.JoinNode, tbl, low) -> Batch:
@@ -523,13 +541,16 @@ def assemble_chain(compiler, node: P.PlanNode) -> Optional[FusedChain]:
     TableScan.  Returns None when the plan shape is not fusible (the caller
     keeps the streaming path)."""
     steps: List[tuple] = []
+    step_ids: List[str] = []
     nd = node
     while True:
         if isinstance(nd, P.FilterNode):
             steps.append(("filter", nd.predicate))
+            step_ids.append(nd.id)
             nd = nd.source
         elif isinstance(nd, P.ProjectNode):
             steps.append(("project", list(nd.assignments.items())))
+            step_ids.append(nd.id)
             nd = nd.source
         elif isinstance(nd, P.ExchangeNode) and not nd.inputs \
                 and len(nd.exchange_sources) == 1:
@@ -538,25 +559,30 @@ def assemble_chain(compiler, node: P.PlanNode) -> Optional[FusedChain]:
             inner = [v.name for v in src.output_variables]
             if outer != inner:
                 steps.append(("rename", list(zip(outer, inner))))
+                step_ids.append(nd.id)
             nd = src
         elif isinstance(nd, P.JoinNode) \
                 and nd.join_type in (P.INNER, P.LEFT) and nd.criteria:
             steps.append(("join", nd))
+            step_ids.append(nd.id)
             nd = nd.left
         elif isinstance(nd, P.SemiJoinNode):
             steps.append(("semi", nd))
+            step_ids.append(nd.id)
             nd = nd.source
         elif isinstance(nd, P.AssignUniqueIdNode):
             # unique ids derive from the scan position (see make), so the
             # decorrelated EXISTS stacks (q21-class) stay in one program
             steps.append(("uid", nd))
+            step_ids.append(nd.id)
             nd = nd.source
         elif isinstance(nd, P.TableScanNode):
             meta = getattr(compiler._compile(nd), "fused_scan", None)
             if meta is None:
                 return None
             steps.reverse()
-            return FusedChain(compiler, steps, meta)
+            step_ids.reverse()
+            return FusedChain(compiler, steps, meta, step_ids, nd.id)
         else:
             return None
 
@@ -639,6 +665,12 @@ def fused_materialize(compiler, node: P.PlanNode,
     from .pipeline import _maybe_compact
     from .memory import batch_bytes
     out = _maybe_compact(run_all(pos_arr, cnt_arr, aux))
+    if compiler.ctx.stats is not None:
+        probe = chain_counts_fn(chain, expands, leaf_cap,
+                                compiler._jit_cache,
+                                ("fmat_counts", node.id, expands))
+        record_chain_stats(compiler.ctx.stats, chain,
+                           probe(pos_arr, cnt_arr, aux), S)
     if cache and _fmat_reserve(compiler, batch_bytes(out)):
         compiler._jit_cache[ckey] = \
             (out, [v.name for v in node.output_variables])
@@ -653,6 +685,51 @@ def _renamed_batch(batch: Batch, names: List[str],
         return batch
     cols = {new: batch.columns[old] for old, new in zip(names, new_names)}
     return Batch(cols, batch.mask)
+
+
+def chain_counts_fn(chain: "FusedChain", expands: Tuple[int, ...],
+                    leaf_cap: int, cache: dict, cache_key):
+    """Cached jitted probe summing make()'s per-step row counters over
+    every scan chunk — for executors whose main program cannot carry the
+    counters in its loop state (sort-agg stacking, runtime-span)."""
+    fn = cache.get(cache_key)
+    if fn is None:
+        @jax.jit
+        def fn(pos_arr, cnt_arr, aux):
+            def body(i, acc):
+                _b, c = chain.make(pos_arr[i], cnt_arr[i], aux, expands,
+                                   leaf_cap, with_counts=True)
+                return acc + c
+            return jax.lax.fori_loop(
+                0, pos_arr.shape[0], body,
+                jnp.zeros(1 + len(chain.steps), dtype=jnp.int64))
+        cache[cache_key] = fn
+    return fn
+
+
+def record_chain_stats(stats, chain: "FusedChain", counts, n_chunks: int,
+                       wall_s: float = 0.0, skip_root: bool = False) -> None:
+    """Fold the device-side chain row counters into the EXPLAIN ANALYZE
+    stats map: one entry per chain plan node, marked fused.  The wall is
+    the WHOLE fused program's — operators compiled into one XLA program
+    share a single dispatch, so per-operator wall does not decompose.
+    skip_root leaves the chain root's rows/wall to the consumer's
+    _instrument wrapper (fused_stream yields through it)."""
+    if stats is None or counts is None:
+        return
+    vals = [int(v) for v in jax.device_get(counts)]  # lint: allow-host-sync
+    root = chain.node_ids[-1] if chain.node_ids else None
+    for nid, rows in zip(chain.node_ids, vals):
+        if nid is None:
+            continue
+        ent = stats.setdefault(
+            nid, {"rows": 0, "wall_s": 0.0, "batches": 0})
+        ent["fused"] = True
+        if skip_root and nid == root:
+            continue        # the consumer's _instrument wrapper owns it
+        ent["rows"] += rows
+        ent["batches"] += n_chunks
+        ent["wall_s"] += wall_s
 
 
 def _join_build_cols(node: P.JoinNode, out_names, build_names):
@@ -676,6 +753,19 @@ def fused_stream(compiler, node: P.PlanNode):
     AssignUniqueId, ...) avoid the per-batch overflow-fetch pattern.
     Returns a Batch iterator or None (caller keeps the classic path)."""
     if compiler.ctx.memory.budget is not None:
+        return None
+    analyzing = compiler.ctx.stats is not None
+    cfg = compiler.ctx.config
+    rs = getattr(compiler.ctx, "runtime_stats", None)
+    if not cfg.fuse_pipelines:
+        if rs is not None:
+            rs.add("fusionDeclinedDisabled", 1)
+        return None
+    if analyzing and cfg.analyze_unfused:
+        # the knob retains the old per-operator streaming profile for
+        # join/semi-join chains too, not just the aggregation door
+        if rs is not None:
+            rs.add("fusionDeclinedAnalyzeUnfused", 1)
         return None
     key = ("fstream", node.id)
     ent = compiler._jit_cache.get(key, False)
@@ -706,7 +796,10 @@ def fused_stream(compiler, node: P.PlanNode):
 
         @jax.jit
         def step(pos, valid, aux):
-            return chain.make(pos, valid, aux, expands, leaf_cap)
+            # under EXPLAIN ANALYZE the per-step row counters ride the
+            # same jitted program as extra outputs (zero host syncs)
+            return chain.make(pos, valid, aux, expands, leaf_cap,
+                              with_counts=analyzing)
         ent = (step, aux, chunks, chain, expands,
                compiler.ctx.params_fingerprint)
         compiler._jit_cache[key] = ent
@@ -737,8 +830,18 @@ def fused_stream(compiler, node: P.PlanNode):
         chunks = chain.chunks_for(expands)
 
     def gen():
-        for pos, cnt in chunks:
-            yield step(jnp.int64(pos), jnp.int64(cnt), aux)
+        acc = None
+        try:
+            for pos, cnt in chunks:
+                out = step(jnp.int64(pos), jnp.int64(cnt), aux)
+                if analyzing:
+                    out, c = out
+                    acc = c if acc is None else acc + c
+                yield out
+        finally:
+            if analyzing:
+                record_chain_stats(compiler.ctx.stats, chain, acc,
+                                   len(chunks), skip_root=True)
     return gen()
 
 

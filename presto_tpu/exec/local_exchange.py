@@ -142,7 +142,7 @@ def background_drain(it: Iterator, wall_out: Optional[list] = None,
     ex.add_producer()
 
     def producer():
-        t0 = time.perf_counter()
+        t0 = time.perf_counter()  # lint: allow-wall-clock
         try:
             for item in it:
                 if not ex.push(item):
@@ -151,7 +151,7 @@ def background_drain(it: Iterator, wall_out: Optional[list] = None,
             ex.push(e)
         finally:
             if wall_out is not None:
-                wall_out[0] = time.perf_counter() - t0
+                wall_out[0] = time.perf_counter() - t0  # lint: allow-wall-clock
             ex.producer_finished()
 
     threading.Thread(target=producer, daemon=True,
@@ -192,7 +192,7 @@ def parallel_drain(sources: List[Callable[[], Iterator]],
                 i = idx_q.get_nowait()
             except queue.Empty:
                 return
-            t0 = time.perf_counter()
+            t0 = time.perf_counter()  # lint: allow-wall-clock
             try:
                 for b in sources[i]():
                     if not ex.push(b):
@@ -201,7 +201,7 @@ def parallel_drain(sources: List[Callable[[], Iterator]],
                 ex.push(e)
                 return
             finally:
-                walls[i] = time.perf_counter() - t0
+                walls[i] = time.perf_counter() - t0  # lint: allow-wall-clock
 
     for _ in range(n_threads):
         ex.add_producer()

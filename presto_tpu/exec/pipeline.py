@@ -140,6 +140,11 @@ class ExecutionConfig:
     # compile scan→filter/project→direct-agg chains into ONE XLA program
     # (fori_loop over split chunks): eliminates per-batch dispatch overhead
     fuse_pipelines: bool = True
+    # EXPLAIN ANALYZE profiles the FUSED execution by default (chains emit
+    # device-side row counters as extra jit outputs); True restores the
+    # old behavior of disabling fusion so every operator streams through
+    # its instrumented BatchSource (session property analyze_unfused)
+    analyze_unfused: bool = False
     # compress exchange pages on the wire (SerializedPage COMPRESSED
     # marker; opt-in like the reference's exchange.compression-enabled —
     # same-host exchanges have no bandwidth to save, cross-host ones do)
@@ -435,27 +440,42 @@ class PlanCompiler:
 
     def _instrument(self, node: P.PlanNode, src: BatchSource) -> BatchSource:
         """EXPLAIN ANALYZE wrapper: cumulative wall time (includes
-        children, like the reference's operator getOutput accounting) and
-        output row counts per plan node."""
+        children, like the reference's operator getOutput accounting),
+        output row counts, and estimated output bytes per plan node."""
         stats = self.ctx.stats
+        # 8 value bytes + 1 null byte per column: an ESTIMATE (dictionary
+        # and lazy columns are cheaper on device), stable across paths so
+        # fused/unfused byte counts compare
+        row_bytes = 9 * max(1, len(node.output_variables))
 
         def gen():
             import time
             ent = stats.setdefault(
                 node.id, {"rows": 0, "wall_s": 0.0, "batches": 0})
+            ent.setdefault("bytes", 0)
+            ent.setdefault("operatorType", type(node).__name__)
             it = src.batches()
             while True:
-                t0 = time.perf_counter()
+                t0 = time.perf_counter()  # lint: allow-wall-clock
                 try:
                     b = next(it)
                 except StopIteration:
-                    ent["wall_s"] += time.perf_counter() - t0
+                    ent["wall_s"] += time.perf_counter() - t0  # lint: allow-wall-clock
                     return
-                ent["wall_s"] += time.perf_counter() - t0
-                ent["rows"] += int(b.mask.sum())
+                ent["wall_s"] += time.perf_counter() - t0  # lint: allow-wall-clock
+                rows = int(b.mask.sum())
+                ent["rows"] += rows
+                ent["bytes"] += rows * row_bytes
                 ent["batches"] += 1
                 yield b
-        return BatchSource(gen, src.names, src.types)
+        out = BatchSource(gen, src.names, src.types)
+        # the fused-chain assembler reads scan metadata off the compiled
+        # source (assemble_chain); the wrapper must not hide it, or
+        # ANALYZE would silently decline fusion at every scan
+        meta = getattr(src, "fused_scan", None)
+        if meta is not None:
+            out.fused_scan = meta
+        return out
 
     # -- leaves -----------------------------------------------------------
     # HBM-resident storage of device-generated columns lives in
@@ -1339,6 +1359,14 @@ class PlanCompiler:
 
         fused_cache: dict = {}
 
+        def _fusion_declined(reason: str) -> None:
+            """The silent fusion refusals become per-scan RuntimeStats
+            counters (fusionDeclined{Reason}), printed by EXPLAIN
+            ANALYZE so an un-fused plan is diagnosable."""
+            rs = self.ctx.runtime_stats
+            if rs is not None:
+                rs.add(f"fusionDeclined{reason}", 1)
+
         def get_fused():
             """Whole-pipeline fusion: when the source is a
             (Filter|Project|Join|SemiJoin)* chain over a device-generated
@@ -1347,22 +1375,34 @@ class PlanCompiler:
             One dispatch per task instead of O(batches × operators) — on
             TPU the per-dispatch round-trip dominates wall-clock for these
             pipelines (all of TPC-H's heavy shapes).  Returns the compiled
-            FusedChain or None; decision is cached."""
+            FusedChain or None; decision is cached.  EXPLAIN ANALYZE runs
+            the fused chain too (per-operator row counters ride the jitted
+            program) unless the analyze_unfused session knob asks for the
+            old streaming profile."""
             if "chain" in fused_cache:
                 return fused_cache["chain"]
             fused_cache["chain"] = None
-            if not cfg.fuse_pipelines or self.ctx.stats is not None:
-                return None   # EXPLAIN ANALYZE wants per-operator stats
+            if not cfg.fuse_pipelines:
+                _fusion_declined("Disabled")
+                return None
+            if self.ctx.stats is not None and cfg.analyze_unfused:
+                _fusion_declined("AnalyzeUnfused")
+                return None
             # masks were already lowered to IF-inputs by _rewrite_agg_masks
             if any(a.distinct for a in node.aggregations.values()):
+                _fusion_declined("DistinctAgg")
                 return None
             if any(s.name in ops.HLL_AGGS for s in specs):
                 # HLL registers live in the scatter-hash table only; the
                 # fused sort path has no register file
+                _fusion_declined("HllAgg")
                 return None
             from .fused import assemble_chain
             chain = assemble_chain(self, src_node)
-            if chain is not None and not chain.chunks:
+            if chain is None:
+                _fusion_declined("PlanShape")
+            elif not chain.chunks:
+                _fusion_declined("NoChunks")
                 chain = None
             fused_cache["chain"] = chain
             return chain
@@ -1376,15 +1416,51 @@ class PlanCompiler:
                     for out, expr in input_exprs2.items()}
 
         def run_fused(chain):
+            """Analyze-aware front door for _run_fused_inner: under
+            EXPLAIN ANALYZE it measures the REAL fused program's wall
+            (block_until_ready on the finalized output) and folds the
+            device-side per-operator row counters into ctx.stats."""
+            analyzing = self.ctx.stats is not None
+            counts_out: dict = {}
+            if not analyzing:
+                return _run_fused_inner(chain, counts_out)
+            import time
+            t0 = time.perf_counter()  # lint: allow-wall-clock
+            out = _run_fused_inner(chain, counts_out)
+            if out is None:
+                return None
+            out = jax.block_until_ready(out)
+            wall = time.perf_counter() - t0  # lint: allow-wall-clock
+            counts = counts_out.get("counts")
+            if counts is None and "probe_args" in counts_out:
+                # modes whose program cannot carry the counters in its
+                # loop state (runtime span, sort-agg): one extra counting
+                # dispatch over the same chain
+                from .fused import chain_counts_fn
+                p_arr, c_arr, p_aux, p_exp, p_cap = counts_out["probe_args"]
+                counts = chain_counts_fn(
+                    chain, p_exp, p_cap, fused_cache,
+                    ("analyze_counts", p_exp))(p_arr, c_arr, p_aux)
+            from .fused import record_chain_stats
+            record_chain_stats(self.ctx.stats, chain, counts,
+                               counts_out.get("n_chunks", 0), wall_s=wall)
+            if self.ctx.runtime_stats is not None:
+                self.ctx.runtime_stats.add("fusedProgramWallNanos",
+                                           wall * 1e9, "NANO")
+            return out
+
+        def _run_fused_inner(chain, counts_out):
             """Execute a fused chain to a finalized output Batch, or None
             to fall back to the streaming executor.  Four modes by group-key
             shape: one-hot grid (G<=64, MXU-friendly), static span (closed
             dictionary domains), runtime span (single integer key — probe
             min/max, then collision-free scatter-direct), hash table."""
+            analyzing = self.ctx.stats is not None
             pool = self.ctx.memory
             if pool.budget is not None:
                 # budgeted execution keeps the streaming path: its build
                 # reservation / grace-spill machinery owns memory discipline
+                _fusion_declined("BudgetedPool")
                 return None
             # build tables are deterministic per plan (generated connectors
             # are immutable; writes clear the runner's plan cache), so prep
@@ -1403,8 +1479,10 @@ class PlanCompiler:
                 try:
                     prep_res = chain.prep()
                 except (NotImplementedError, MemoryExceededError):
+                    _fusion_declined("PrepUnsupported")
                     return None
                 if prep_res is None:
+                    _fusion_declined("PrepFanout")
                     return None
                 fused_cache["prep"] = prep_res
                 fused_cache["prep_fp"] = pfp
@@ -1421,15 +1499,18 @@ class PlanCompiler:
                     lambda p, v: chain.make(p, v, aux, expands, leaf_cap),
                     jnp.int64(0), jnp.int64(1))
             except NotImplementedError:
+                _fusion_declined("ProbeUnsupported")
                 return None
             key_cols = [probe.columns.get(k) for k in key_names]
             if any(c is None for c in key_cols):
+                _fusion_declined("KeyMissing")
                 return None
             key_lazy: Dict[str, Tuple] = {}
             for k, c in zip(key_names, key_cols):
                 if c.lazy is not None:
                     _, tbl, coln, _sf = c.lazy
                     if (tbl, coln) not in catalog.ROWID_DISTINCT:
+                        _fusion_declined("KeyEncoding")
                         return None    # needs host dictionary encoding
                     key_lazy[k] = c.lazy
             key_dicts = {k: c.dictionary
@@ -1440,26 +1521,49 @@ class PlanCompiler:
                                   dtype=jnp.int64)
             cnt_arr = jnp.asarray([c1 for _, c1 in chunks],
                                   dtype=jnp.int64)
+            counts_out["probe_args"] = (pos_arr, cnt_arr, aux, expands,
+                                        leaf_cap)
+            counts_out["n_chunks"] = len(chunks)
 
             def loop(key, update, init_state):
                 """fori_loop over scan chunks; the jitted program is cached
-                under `key` so re-executions of the plan skip retracing."""
-                key = key + (expands,)
+                under `key` so re-executions of the plan skip retracing.
+                Under EXPLAIN ANALYZE the per-operator row counters ride
+                the SAME program as an extra loop-carry output."""
+                key = key + (expands, analyzing)
                 run_all = fused_cache.get(key)
                 if run_all is None:
-                    @jax.jit
-                    def run_all(pos_arr, cnt_arr, state, aux):
-                        def body(i, st):
-                            b = chain.make(pos_arr[i], cnt_arr[i], aux,
-                                           expands, leaf_cap)
-                            return update(st, b)
-                        # chunk count from the traced shape, NOT a closure
-                        # constant: param-aware pruning may change it
-                        # between executions (shape change -> retrace)
-                        return jax.lax.fori_loop(0, pos_arr.shape[0],
-                                                 body, state)
+                    if analyzing:
+                        @jax.jit
+                        def run_all(pos_arr, cnt_arr, state, aux):
+                            def body(i, carry):
+                                st, cnts = carry
+                                b, c = chain.make(
+                                    pos_arr[i], cnt_arr[i], aux, expands,
+                                    leaf_cap, with_counts=True)
+                                return update(st, b), cnts + c
+                            return jax.lax.fori_loop(
+                                0, pos_arr.shape[0], body,
+                                (state, jnp.zeros(1 + len(chain.steps),
+                                                  dtype=jnp.int64)))
+                    else:
+                        @jax.jit
+                        def run_all(pos_arr, cnt_arr, state, aux):
+                            def body(i, st):
+                                b = chain.make(pos_arr[i], cnt_arr[i], aux,
+                                               expands, leaf_cap)
+                                return update(st, b)
+                            # chunk count from the traced shape, NOT a
+                            # closure constant: param-aware pruning may
+                            # change it between executions (shape change
+                            # -> retrace)
+                            return jax.lax.fori_loop(0, pos_arr.shape[0],
+                                                     body, state)
                     fused_cache[key] = run_all
-                return run_all(pos_arr, cnt_arr, init_state, aux)
+                out = run_all(pos_arr, cnt_arr, init_state, aux)
+                if analyzing:
+                    out, counts_out["counts"] = out
+                return out
 
             def stride_codes(b, strides, G):
                 codes = None
@@ -1990,7 +2094,10 @@ class PlanCompiler:
             pool = self.ctx.memory
             fused = get_fused()
             grouped = None
-            if fused is not None:
+            # EXPLAIN ANALYZE keeps the single-program fused path (its
+            # row counters are per plan node); the grouped runner's
+            # per-lifespan walls already land in runtime_stats
+            if fused is not None and self.ctx.stats is None:
                 grouped = fused_cache.get("grouped", False)
                 if grouped is not False and grouped is not None \
                         and fused.build_params \

@@ -79,6 +79,9 @@ class LocalQueryRunner:
         # session-scoped prepared statements (name -> SQL text); the HTTP
         # path passes its header map per call instead
         self._prepared: Dict[str, str] = {}
+        # EXPLAIN ANALYZE side channel: node id -> operator stats from the
+        # most recent analyzed execution (bench / tooling read this)
+        self.last_operator_stats: Optional[dict] = None
 
     def _validation(self):
         """Scope plan validation (presto_tpu/analysis) to this runner's
@@ -254,10 +257,16 @@ class LocalQueryRunner:
         types = [v.type for v in output.outputs]
         # operators add fine-grained counters (grouped bucket walls, ...)
         compiler.ctx.runtime_stats = stats
-        with stats.record_wall("queryExecute"):
-            result = pages_to_result(compiler.run_to_pages(output), names,
-                                     types)
+        from contextlib import nullcontext
+        with (tracer.span("query", sql=sql) if tracer else nullcontext()):
+            with stats.record_wall("queryExecute"):
+                result = pages_to_result(compiler.run_to_pages(output),
+                                         names, types)
         result.runtime_stats = stats.to_dict()
+        # peak MemoryPool reservation, for QueryCompletedEvent enrichment
+        result.peak_memory_bytes = (compiler.ctx.memory.peak
+                                    if compiler.ctx.memory is not None
+                                    else 0)
         if tracer:
             tracer.end_trace("query finished")
         self._release(exe)
@@ -354,21 +363,34 @@ class LocalQueryRunner:
         ExplainAnalyzeOperator).  EXPLAIN (TYPE VALIDATE): run the plan
         checker at every stage and print the diagnostic list."""
         from ..common.types import VarcharType
-        from ..sql.explain import format_plan
+        from ..sql.explain import format_analyze_footer, format_plan
+        from ..utils.runtime_stats import RuntimeStats
         if ast.explain_type == "VALIDATE":
             return self._explain_validate(ast)
         with self._validation():
             output = Planner(default_schema=self.schema,
                              default_catalog=self.catalog) \
                 .plan_query_to_output(ast.query)
-        stats = None
+        stats = rstats = None
         if ast.analyze:
+            # fusion stays ENABLED: the fused chain emits device-side row
+            # counters as extra jit outputs, so this profiles the real
+            # execution path.  analyze_unfused retains the old per-node
+            # interpreted profiling.
             stats = {}
-            ctx = TaskContext(config=self.config, stats=stats)
+            rstats = RuntimeStats()
+            ctx = TaskContext(config=self.config, stats=stats,
+                              runtime_stats=rstats)
             compiler = PlanCompiler(ctx)
-            for _page in compiler.run_to_pages(output):
-                pass
+            with rstats.record_wall("queryExecute"):
+                for _page in compiler.run_to_pages(output):
+                    pass
+            self.last_operator_stats = stats
         text = format_plan(output, stats)
+        if rstats is not None:
+            footer = format_analyze_footer(rstats)
+            if footer:
+                text += "\n\n" + footer
         return QueryResult(["Query Plan"], [VarcharType(max(1, len(text)))],
                            [[text]])
 
@@ -455,8 +477,9 @@ class DistributedQueryRunner(LocalQueryRunner):
     def __init__(self, schema: str = "sf0.01",
                  config: Optional[ExecutionConfig] = None,
                  n_tasks: int = 2, broadcast_threshold: int = 600_000,
-                 catalog: str = "tpch", mesh=None):
-        super().__init__(schema, config, catalog)
+                 catalog: str = "tpch", mesh=None, tracer_provider=None):
+        super().__init__(schema, config, catalog,
+                         tracer_provider=tracer_provider)
         self.n_tasks = n_tasks
         self.broadcast_threshold = broadcast_threshold
         # jax.sharding.Mesh: hashed exchanges between stages whose task
@@ -500,12 +523,14 @@ class DistributedQueryRunner(LocalQueryRunner):
         return FragmenterConfig(
             broadcast_threshold=self.broadcast_threshold)
 
-    def _explain_distributed(self, ast) -> QueryResult:
+    def _explain_distributed(self, ast, sql: str = "") -> QueryResult:
         """EXPLAIN over the fragmented (distributed) plan — the analog of
-        the reference's EXPLAIN (TYPE DISTRIBUTED).  ANALYZE falls back to
-        the fragment text (per-task stats are not merged)."""
+        the reference's EXPLAIN (TYPE DISTRIBUTED).  ANALYZE executes the
+        fragment DAG through the in-process scheduler with per-task
+        operator stats enabled and annotates every fragment from the
+        merged (task-rolled-up) map."""
         from ..common.types import VarcharType
-        from ..sql.explain import format_subplan
+        from ..sql.explain import format_analyze_footer, format_subplan
         from ..sql.fragmenter import plan_distributed
         if ast.explain_type == "VALIDATE":
             return self._explain_validate(ast)
@@ -516,7 +541,31 @@ class DistributedQueryRunner(LocalQueryRunner):
             subplan = plan_distributed(output, self._fragmenter_config(),
                                        exec_config=self.config)
             self._annotate_fabrics(subplan)
-        text = format_subplan(subplan)
+        stats = None
+        footer = ""
+        if ast.analyze:
+            from contextlib import nullcontext
+
+            from .scheduler import InProcessScheduler
+            sched = InProcessScheduler(self._scheduler_config())
+            sched.node_stats = stats = {}
+            # ANALYZE collects per-node stats, so the scheduler can also
+            # emit the full query->fragment->task->operator span hierarchy
+            tracer = self.tracer_provider.new_tracer(sql) \
+                if (self.tracer_provider and sql) else None
+            if tracer is not None:
+                sched.tracer = tracer
+            with (tracer.span("query", sql=sql) if tracer
+                  else nullcontext()):
+                for _page in sched.execute(subplan):
+                    pass
+            if tracer:
+                tracer.end_trace("query finished")
+            self.last_operator_stats = stats
+            footer = format_analyze_footer(sched.stats)
+        text = format_subplan(subplan, stats)
+        if footer:
+            text += "\n\n" + footer
         return QueryResult(["Query Plan"], [VarcharType(max(1, len(text)))],
                            [[text]])
 
@@ -524,18 +573,27 @@ class DistributedQueryRunner(LocalQueryRunner):
         from ..sql import parser as A
         ast = A.parse_sql(sql)
         if isinstance(ast, A.Explain):
-            return self._explain_distributed(ast)
+            return self._explain_distributed(ast, sql=sql)
         if isinstance(ast, (A.CreateTableAs, A.InsertInto, A.DropTable)):
             # writes run single-task through the local pipeline (the
             # reference's scaled-writer distribution is future work)
             return self._execute_ddl(ast)
+        from contextlib import nullcontext
+
         from .scheduler import InProcessScheduler
         subplan, names, types = self.plan_subplan(sql, ast=ast)
         sched = InProcessScheduler(self._scheduler_config())
-        result = pages_to_result(sched.execute(subplan), names, types)
+        tracer = self.tracer_provider.new_tracer(sql) \
+            if self.tracer_provider else None
+        if tracer is not None:
+            sched.tracer = tracer
+        with (tracer.span("query", sql=sql) if tracer else nullcontext()):
+            result = pages_to_result(sched.execute(subplan), names, types)
         # fabric-tagged exchange stats (bytes / walls per fabric) collected
         # while the result drained
         result.runtime_stats = sched.stats.to_dict()
+        if tracer:
+            tracer.end_trace("query finished")
         return result
 
     def _scheduler_config(self):

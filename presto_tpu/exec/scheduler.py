@@ -62,6 +62,26 @@ class SchedulerConfig:
     fault_injector: Optional[Callable] = None
 
 
+def merge_node_stats(dst: Dict[str, dict], src: Dict[str, dict]) -> None:
+    """Merge one task's per-plan-node operator stats into a rollup map —
+    the task -> stage -> coordinator merge semantics (reference
+    OperatorStats.add): additive fields sum, markers (fused /
+    operatorType) are kept from the first task that reported them, and
+    per-driver walls concatenate."""
+    for nid, s in src.items():
+        ent = dst.setdefault(nid, {"rows": 0, "wall_s": 0.0, "batches": 0})
+        for k, v in s.items():
+            if k in ("rows", "batches", "bytes",
+                     "dynamicFilterRowsDropped"):
+                ent[k] = ent.get(k, 0) + v
+            elif k == "wall_s":
+                ent[k] = ent.get(k, 0.0) + v
+            elif k == "driver_walls":
+                ent.setdefault(k, []).extend(v)
+            else:
+                ent.setdefault(k, v)
+
+
 # ---------------------------------------------------------------------------
 # host-side partition hashing (value-based, dictionary-independent)
 # ---------------------------------------------------------------------------
@@ -288,6 +308,7 @@ class InProcessScheduler:
     same stage graph across processes/chips."""
 
     def __init__(self, config: Optional[SchedulerConfig] = None):
+        import threading
         self.config = config or SchedulerConfig()
         from ..utils.runtime_stats import RuntimeStats
         # per-query fabric-tagged exchange stats (bytes moved, dispatch /
@@ -295,6 +316,15 @@ class InProcessScheduler:
         # DistributedQueryRunner — the RuntimeStats face of the same
         # surface FABRIC_METRICS exposes process-wide
         self.stats = RuntimeStats()
+        # EXPLAIN ANALYZE sink: set to {} by the caller to collect the
+        # per-plan-node operator stats of EVERY task, merged across tasks
+        # (rows/bytes/batches/walls summed) — the coordinator-side rollup
+        # the fragment annotations are printed from
+        self.node_stats: Optional[Dict[str, dict]] = None
+        self._stats_lock = threading.Lock()
+        # span-recording tracer (utils/runtime_stats.Tracer); spans open
+        # per fragment and per task under the caller's "query" span
+        self.tracer = None
 
     # -- planning the stage tree -----------------------------------------
     def _build_stages(self, subplan: P.SubPlan) -> StageInfo:
@@ -474,10 +504,15 @@ class InProcessScheduler:
         def run_task(task_index: int):
             """One task's fragment execution; returns (batch-or-None for
             ICI stages, wall seconds)."""
-            t0 = _time.perf_counter()
+            t0 = _time.perf_counter()  # lint: allow-wall-clock
             ctx = TaskContext(config=self.config.exec_config,
                               task_index=task_index,
-                              shared_jits=stage_jits)
+                              shared_jits=stage_jits,
+                              runtime_stats=self.stats)
+            if self.node_stats is not None:
+                # EXPLAIN ANALYZE: per-node operator stats, merged into
+                # the query-level rollup after the task drains
+                ctx.stats = {}
             if grouped_shards:
                 ctx.grouped_shard = (task_index, stage.n_tasks)
             for node_id, splits in scan_splits.items():
@@ -498,9 +533,14 @@ class InProcessScheduler:
             compiler = PlanCompiler(ctx)
             dev_ctx = (jax.default_device(devices[task_index])
                        if pin else contextlib.nullcontext())
+            span_ctx = (self.tracer.span(
+                f"task {frag.fragment_id}.{task_index}",
+                parent=f"fragment {frag.fragment_id}",
+                task_index=task_index)
+                if self.tracer is not None else contextlib.nullcontext())
             out = None
             split_wall, split_bytes = 0.0, 0
-            with dev_ctx:
+            with span_ctx, dev_ctx:
                 if ici:
                     from .pipeline import _compact_concat
                     batches = [b for b in
@@ -513,7 +553,7 @@ class InProcessScheduler:
                                 f"sibling task of stage "
                                 f"{frag.fragment_id} failed")
                         if hashed and stage.n_partitions > 1:
-                            s0 = _time.perf_counter()
+                            s0 = _time.perf_counter()  # lint: allow-wall-clock
                             targets = partition_targets(
                                 page, out_types, key_indices,
                                 stage.n_partitions)
@@ -522,10 +562,28 @@ class InProcessScheduler:
                                                stage.n_partitions)):
                                 if sub is not None:
                                     stage.buffers.add(task_index, p, sub)
-                            split_wall += _time.perf_counter() - s0
+                            split_wall += _time.perf_counter() - s0  # lint: allow-wall-clock
                             split_bytes += _page_bytes(page)
                         else:
                             stage.buffers.add(task_index, 0, page)
+            if self.node_stats is not None and ctx.stats:
+                with self._stats_lock:
+                    merge_node_stats(self.node_stats, ctx.stats)
+            if self.tracer is not None and ctx.stats:
+                # operator spans close out the query->fragment->task->
+                # operator hierarchy; operators stream interleaved so their
+                # intervals don't nest in real time — each span is emitted
+                # at task end carrying its measured wall as an attribute
+                for nid, s in ctx.stats.items():
+                    with self.tracer.span(
+                            f"operator {frag.fragment_id}.{task_index}."
+                            f"{nid}",
+                            parent=f"task {frag.fragment_id}.{task_index}",
+                            plan_node_id=nid,
+                            operator=s.get("operatorType", ""),
+                            rows=s.get("rows", 0),
+                            wall_s=s.get("wall_s", 0.0)):
+                        pass
             if split_bytes or split_wall:
                 # stats parity with the ICI path: the hashed page path IS
                 # the http fabric in-process (its pages move host-side,
@@ -537,7 +595,7 @@ class InProcessScheduler:
                                "BYTE")
                 self.stats.add("exchangeFabricHttpExchangeWallNanos",
                                split_wall * 1e9, "NANO")
-            return out, _time.perf_counter() - t0
+            return out, _time.perf_counter() - t0  # lint: allow-wall-clock
 
         def run_task_retrying(task_index: int):
             """Batch (Presto-on-Spark) mode: a failed task re-runs from
@@ -577,23 +635,32 @@ class InProcessScheduler:
         # its device, so other tasks keep dispatching — stage wall
         # approaches the slowest task, not the sum.  jax.default_device
         # is thread-local, so per-device pinning survives threading.
-        stage_t0 = _time.perf_counter()
+        stage_t0 = _time.perf_counter()  # lint: allow-wall-clock
         # concurrency requires memory isolation: pinned tasks own their
         # device; unpinned tasks share one device, so when a memory
         # budget is configured their independent per-task pools would
         # stack to n_tasks x budget — run those sequentially
         concurrent = stage.n_tasks > 1 and (
             pin or self.config.exec_config.memory_budget_bytes is None)
-        if not concurrent:
-            results = [run_task_retrying(i) for i in range(stage.n_tasks)]
-        else:
-            from concurrent.futures import ThreadPoolExecutor
-            with ThreadPoolExecutor(max_workers=stage.n_tasks) as pool_ex:
-                results = list(pool_ex.map(run_task_retrying,
-                                           range(stage.n_tasks)))
+        frag_span = (self.tracer.span(f"fragment {frag.fragment_id}",
+                                      parent="query",
+                                      n_tasks=stage.n_tasks)
+                     if self.tracer is not None
+                     else contextlib.nullcontext())
+        with frag_span:
+            if not concurrent:
+                results = [run_task_retrying(i)
+                           for i in range(stage.n_tasks)]
+            else:
+                from concurrent.futures import ThreadPoolExecutor
+                with ThreadPoolExecutor(
+                        max_workers=stage.n_tasks) as pool_ex:
+                    results = list(pool_ex.map(run_task_retrying,
+                                               range(stage.n_tasks)))
         task_batches = [r[0] for r in results]
         stage.task_walls = [round(r[1], 4) for r in results]
-        stage.stage_wall = round(_time.perf_counter() - stage_t0, 4)
+        stage.stage_wall = round(
+            _time.perf_counter() - stage_t0, 4)  # lint: allow-wall-clock
         if ici:
             keys = tuple(out_names[i] for i in key_indices)
             if not self._ici_exchange(stage, task_batches, keys):
@@ -662,7 +729,7 @@ class InProcessScheduler:
             if b is not None and _batch_meta(b) != tstruct:
                 return False
 
-        t0 = _time.perf_counter()
+        t0 = _time.perf_counter()  # lint: allow-wall-clock
         # ONE device->host transfer covers every task's live-row count
         # (the _compact_concat idiom) — the only host sync on this path;
         # the old per-task device_get loop serialized n round-trips
@@ -740,7 +807,7 @@ class InProcessScheduler:
                         c.dictionary, c.lazy)
                 stage.device_out[i].append(
                     Batch(ccols, _shard_on(out.mask, devices[i])))
-        wall = _time.perf_counter() - t0
+        wall = _time.perf_counter() - t0  # lint: allow-wall-clock
         FABRIC_METRICS.record("ici", exchanges=1, chunks=n_chunks,
                               bytes_moved=bytes_moved,
                               exchange_wall_s=wall)
@@ -812,25 +879,25 @@ def _device_reader(sources: List[StageInfo], consumer_task: int, rnode,
     names = [v.name for v in rnode.outputs]
 
     def read():
-        drain0 = _time.perf_counter()
+        drain0 = _time.perf_counter()  # lint: allow-wall-clock
         wait = 0.0
         try:
             for src in sources:
                 prod = src.out_names
                 for b in src.device_out[consumer_task] or ():
-                    w0 = _time.perf_counter()
+                    w0 = _time.perf_counter()  # lint: allow-wall-clock
                     while not b.mask.is_ready():
                         if abort is not None and abort.is_set():
                             raise StageAbortedError(
                                 "stage aborted while draining ICI "
                                 "exchange")
                         _time.sleep(0)
-                    wait += _time.perf_counter() - w0
+                    wait += _time.perf_counter() - w0  # lint: allow-wall-clock
                     cols = {names[j]: b.columns[prod[j]]
                             for j in range(len(names))}
                     yield Batch(cols, b.mask)
         finally:
-            drain = _time.perf_counter() - drain0
+            drain = _time.perf_counter() - drain0  # lint: allow-wall-clock
             FABRIC_METRICS.record("ici", compute_wall_s=drain,
                                   wait_wall_s=wait)
             if stats is not None:

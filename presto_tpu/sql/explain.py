@@ -117,6 +117,12 @@ def format_plan(node: P.PlanNode,
             line += (f"  {{rows: {s['rows']:,}, "
                      f"wall: {s['wall_s'] * 1e3:,.1f}ms, "
                      f"batches: {s['batches']}}}")
+            if s.get("bytes"):
+                line += f"  {{bytes≈{s['bytes']:,}}}"
+            if s.get("fused"):
+                # the node ran inside ONE fused XLA program: rows are its
+                # device-side counter; the wall is the whole program's
+                line += "  [fused]"
             if s.get("driver_walls"):
                 # per-driver walls from task_concurrency leaf drains
                 # (local_exchange.parallel_drain): sum(driver walls) -
@@ -139,6 +145,28 @@ def format_plan(node: P.PlanNode,
         fired = ", ".join(f"{k}: {v}"
                           for k, v in sorted(rule_stats.items()))
         lines.append(f"Optimizer rules fired: {{{fired}}}")
+    return "\n".join(lines)
+
+
+def format_analyze_footer(runtime_stats) -> str:
+    """EXPLAIN ANALYZE footer: fusion-declined counters (the reasons a
+    scan chain stayed on the streaming path) and the fused program wall,
+    pulled from the execution's RuntimeStats.  Empty string when nothing
+    was recorded."""
+    if runtime_stats is None:
+        return ""
+    rs = runtime_stats.to_dict() if hasattr(runtime_stats, "to_dict") \
+        else dict(runtime_stats)
+    declined = {k[len("fusionDeclined"):]: int(v["sum"])
+                for k, v in rs.items() if k.startswith("fusionDeclined")}
+    lines: List[str] = []
+    if declined:
+        body = ", ".join(f"{k}: {v}" for k, v in sorted(declined.items()))
+        lines.append(f"Fusion declined: {{{body}}}")
+    fw = rs.get("fusedProgramWallNanos")
+    if fw:
+        lines.append(f"Fused program wall: {fw['sum'] / 1e6:,.1f}ms "
+                     f"over {fw['count']} program(s)")
     return "\n".join(lines)
 
 

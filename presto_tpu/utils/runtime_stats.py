@@ -103,11 +103,29 @@ class TracePoint:
     at: float = field(default_factory=time.time)
 
 
+@dataclass
+class Span:
+    """One named interval in the query's span tree (query -> fragment ->
+    task -> operator).  `parent` is the parent span's name ("" = root)."""
+    name: str
+    parent: str = ""
+    start: float = 0.0
+    end: float = 0.0
+    attributes: Dict[str, object] = field(default_factory=dict)
+
+
 class Tracer:
     """SPI (presto-spi tracing.Tracer analog)."""
 
     def add_point(self, annotation: str) -> None:
         raise NotImplementedError
+
+    @contextmanager
+    def span(self, name: str, parent: str = "", **attributes):
+        """Nested interval recording; no-op in the base/Noop tracers.
+        `parent` names the enclosing span explicitly so spans opened on
+        worker threads (stage tasks) attach to the right parent."""
+        yield name
 
     def end_trace(self, annotation: str = "trace ended") -> None:
         self.add_point(annotation)
@@ -119,20 +137,44 @@ class NoopTracer(Tracer):
 
 
 class SimpleTracer(Tracer):
-    """In-memory recording tracer (tracing/SimpleTracer.java)."""
+    """In-memory recording tracer (tracing/SimpleTracer.java), extended
+    with a span tree for tests/ops."""
 
     def __init__(self, trace_token: str = ""):
         self.trace_token = trace_token
         self.points: List[TracePoint] = []
+        self.spans: List[Span] = []
         self._lock = threading.Lock()
 
     def add_point(self, annotation: str) -> None:
         with self._lock:
             self.points.append(TracePoint(annotation))
 
+    @contextmanager
+    def span(self, name: str, parent: str = "", **attributes):
+        s = Span(name, parent, start=time.time(), attributes=attributes)
+        with self._lock:
+            self.spans.append(s)
+        try:
+            yield name
+        finally:
+            s.end = time.time()
+
     def annotations(self) -> List[str]:
         with self._lock:
             return [p.annotation for p in self.points]
+
+    def span_children(self, parent: str = "") -> List[Span]:
+        with self._lock:
+            return [s for s in self.spans if s.parent == parent]
+
+    def span_tree(self) -> List[dict]:
+        """Nested {name, attributes, children} forest rooted at parent=""."""
+        def build(parent: str) -> List[dict]:
+            return [{"name": s.name, "attributes": dict(s.attributes),
+                     "children": build(s.name)}
+                    for s in self.span_children(parent)]
+        return build("")
 
 
 class TracerProvider:

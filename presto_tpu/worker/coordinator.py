@@ -129,18 +129,29 @@ class HeartbeatFailureDetector:
 class RemoteTask:
     """Client-side handle for one worker task (reference HttpRemoteTask)."""
 
-    def __init__(self, worker_uri: str, task_id: str):
+    def __init__(self, worker_uri: str, task_id: str,
+                 trace_token: str = ""):
         self.worker_uri = worker_uri
         self.task_id = task_id
         self.task_uri = f"{worker_uri}/v1/task/{task_id}"
+        # X-Presto-Trace-Token rides on EVERY coordinator->worker request
+        # for this task (the reference's trace-token propagation on the
+        # task protocol), so worker access logs join to the query trace
+        self.trace_token = trace_token
+
+    def _headers(self) -> dict:
+        from .auth import outbound_headers
+        headers = outbound_headers()
+        if self.trace_token:
+            headers["X-Presto-Trace-Token"] = self.trace_token
+        return headers
 
     def update(self, request: TaskUpdateRequest) -> TaskStatus:
-        from .auth import outbound_headers
         body = json.dumps(request.to_dict()).encode()
         req = urllib.request.Request(
             self.task_uri, data=body, method="POST",
             headers={"Content-Type": "application/json",
-                     **outbound_headers()})
+                     **self._headers()})
         from .auth import urlopen_internal
         with urlopen_internal(req, timeout=30) as resp:
             return TaskStatus.from_dict(json.loads(resp.read()))
@@ -148,19 +159,25 @@ class RemoteTask:
     def status(self, current_state: Optional[str] = None,
                max_wait_ms: int = 1000,
                timeout_s: float = 60.0) -> TaskStatus:
-        from .auth import outbound_headers
         url = f"{self.task_uri}/status?maxWaitMs={max_wait_ms}"
-        req = urllib.request.Request(url, headers=outbound_headers())
+        req = urllib.request.Request(url, headers=self._headers())
         if current_state:
             req.add_header("X-Presto-Current-State", current_state)
         from .auth import urlopen_internal
         with urlopen_internal(req, timeout=timeout_s) as resp:
             return TaskStatus.from_dict(json.loads(resp.read()))
 
+    def info(self, timeout_s: float = 10.0) -> dict:
+        """Full TaskInfo (GET /v1/task/{id}): per-task stats + the plan-node
+        inventory with per-operator stats when the worker collected them."""
+        req = urllib.request.Request(self.task_uri, headers=self._headers())
+        from .auth import urlopen_internal
+        with urlopen_internal(req, timeout=timeout_s) as resp:
+            return json.loads(resp.read())
+
     def cancel(self) -> None:
-        from .auth import outbound_headers
         req = urllib.request.Request(self.task_uri, method="DELETE",
-                                     headers=outbound_headers())
+                                     headers=self._headers())
         try:
             from .auth import urlopen_internal
             urlopen_internal(req, timeout=10).close()
@@ -299,7 +316,8 @@ class _QueryExecution:
     the classify-restart loop (the coordinator analog of presto-spark's
     per-task retry over durable shuffle — here over retained buffers)."""
 
-    def __init__(self, runner: "HttpQueryRunner", root: _Stage, qid: str):
+    def __init__(self, runner: "HttpQueryRunner", root: _Stage, qid: str,
+                 trace_token: str = ""):
         self.runner = runner
         self.root = root
         self.qid = qid
@@ -328,6 +346,18 @@ class _QueryExecution:
         self.max_response_bytes = parse_data_size(self.session.get(
             "exchange_max_response_size", cfg.exchange_max_response_bytes))
         self.stats = RuntimeStats()             # root-pull exchange stats
+        # trace token: honor one handed down by the statement layer (it
+        # minted per-query), else mint from the query id; propagated to
+        # every task via session + X-Presto-Trace-Token headers
+        self.trace_token = str(
+            trace_token or runner.session.get("trace_token")
+            or f"trace-{qid}")
+        self.session.setdefault("trace_token", self.trace_token)
+        # per-operator stats collection is always on for distributed
+        # executions: TaskInfo carries the per-node breakdown that
+        # /v1/query/{id} rolls up (a per-batch dict update on the worker —
+        # the device-side fused counters make it cheap even on hot paths)
+        self.session.setdefault("collect_operator_stats", "true")
         # shuffle fabric: session override > config.  The HTTP coordinator
         # only drives the page wire, so a requested "ici" is honored
         # inside each worker's local scheduler (if it has a mesh) while
@@ -422,7 +452,7 @@ class _QueryExecution:
             + [u for u in live if u not in preferred]
         last_err: Optional[Exception] = None
         for cand in candidates:
-            task = RemoteTask(cand, task_id)
+            task = RemoteTask(cand, task_id, trace_token=self.trace_token)
             try:
                 task.update(req)
             except urllib.error.HTTPError as e:
@@ -591,6 +621,38 @@ class _QueryExecution:
             for ti in sorted(restart[id(stage)]):
                 self._place_task(stage, ti)
 
+    def query_info_snapshot(self) -> dict:
+        """Stage/task/operator breakdown for /v1/query/{id} (the reference
+        QueryInfo.outputStage drill-down): one TaskInfo fetch per current
+        task plus the cross-task per-plan-node operator rollup, keyed the
+        same way the EXPLAIN ANALYZE annotator reads it.  Unreachable
+        workers degrade to a stub entry instead of failing the snapshot."""
+        from ..exec.scheduler import merge_node_stats
+        merged: Dict[str, dict] = {}
+        stages = []
+        for stage in self.stages:
+            tasks = []
+            for task in stage.tasks:
+                if task is None:
+                    continue
+                try:
+                    info = task.info()
+                except (OSError, ValueError):
+                    info = {"taskId": task.task_id, "unreachable": True}
+                for pipe in info.get("pipelines", []):
+                    for op in pipe.get("operators", []):
+                        if op.get("stats"):
+                            merge_node_stats(
+                                merged, {op["planNodeId"]: op["stats"]})
+                tasks.append({"worker": task.worker_uri, **info})
+            stages.append({"stageId": f"{self.qid}.{stage.stage_path}",
+                           "fragmentId": stage.fragment.fragment_id,
+                           "partitioning": stage.fragment.partitioning,
+                           "nTasks": stage.n_tasks,
+                           "tasks": tasks})
+        return {"traceToken": self.trace_token, "stages": stages,
+                "operatorStats": merged}
+
     def close(self) -> None:
         if self._watcher is not None:
             self._watcher.close()
@@ -621,6 +683,10 @@ class HttpQueryRunner(LocalQueryRunner):
         # this runner backs a coordinator's statement endpoint)
         self.tasks_retried = 0
         self.queries_failed = 0
+        # observability side channels: the most recent _QueryExecution
+        # (QueryInfo drill-down) and ANALYZE rollup / snapshot
+        self.last_execution: Optional[_QueryExecution] = None
+        self.last_query_info: Optional[dict] = None
 
     def _live_uris(self) -> List[str]:
         """Schedulable workers (reference NodeScheduler.createNodeSelector
@@ -655,12 +721,89 @@ class HttpQueryRunner(LocalQueryRunner):
             n_tasks = 1
         return _Stage(frag, children, n_tasks, stage_path)
 
+    def _explain_http(self, ast, trace_token: str = "") -> QueryResult:
+        """EXPLAIN over the HTTP-distributed plan.  ANALYZE executes the
+        fragment DAG on the real workers with per-operator stats collection
+        enabled in every task's session, then annotates each fragment from
+        the TaskInfo rollup (the coordinator side of the task -> stage ->
+        coordinator merge)."""
+        from ..common.types import VarcharType
+        from ..sql.explain import format_analyze_footer, format_subplan
+        from ..sql.fragmenter import FragmenterConfig, plan_distributed
+        from ..sql.planner import Planner
+        if ast.explain_type == "VALIDATE":
+            return self._explain_validate(ast)
+        with self._validation():
+            output = Planner(default_schema=self.schema,
+                             default_catalog=self.catalog) \
+                .plan_query_to_output(ast.query)
+            subplan = plan_distributed(
+                output,
+                FragmenterConfig(
+                    broadcast_threshold=self.broadcast_threshold),
+                exec_config=self.config)
+        stats = None
+        footer = ""
+        if ast.analyze:
+            root = self._build_stages(subplan)
+            qid = (f"q{next(_query_counter)}_"
+                   f"{int(time.time() * 1000) % 100000}")
+            saved = self.session
+            self.session = {**self.session,
+                            "collect_operator_stats": "true"}
+            try:
+                execution = _QueryExecution(self, root, qid,
+                                            trace_token=trace_token)
+                self.last_execution = execution
+                try:
+                    execution.run()
+                    snapshot = execution.query_info_snapshot()
+                finally:
+                    self.tasks_retried += execution.retries
+                    execution.close()
+            finally:
+                self.session = saved
+            stats = snapshot["operatorStats"]
+            self.last_operator_stats = stats
+            self.last_query_info = snapshot
+            # footer counters (fusionDeclined*/fusedProgramWallNanos) are
+            # recorded in each TASK's RuntimeStats on its worker: merge
+            # them across tasks, on top of the coordinator's own root-pull
+            # stats
+            merged_rs = execution.stats.to_dict()
+            for st in snapshot["stages"]:
+                for t in st["tasks"]:
+                    src = (t.get("stats") or {}).get("runtimeStats") or {}
+                    for k, v in src.items():
+                        e = merged_rs.get(k)
+                        if e is None:
+                            merged_rs[k] = dict(v)
+                        else:
+                            e["sum"] += v["sum"]
+                            e["count"] += v["count"]
+                            e["min"] = min(e["min"], v["min"])
+                            e["max"] = max(e["max"], v["max"])
+            footer = format_analyze_footer(merged_rs)
+        text = format_subplan(subplan, stats)
+        if footer:
+            text += "\n\n" + footer
+        return QueryResult(["Query Plan"],
+                           [VarcharType(max(1, len(text)))], [[text]])
+
     # -- execution --------------------------------------------------------
-    def execute(self, sql: str) -> QueryResult:
+    def execute(self, sql: str, trace_token: str = "") -> QueryResult:
+        from ..sql import parser as A
+        try:
+            ast = A.parse_sql(sql)
+        except Exception:
+            ast = None
+        if ast is not None and isinstance(ast, A.Explain):
+            return self._explain_http(ast, trace_token=trace_token)
         subplan, names, types = self.plan_subplan(sql)
         root = self._build_stages(subplan)
         qid = f"q{next(_query_counter)}_{int(time.time() * 1000) % 100000}"
-        execution = _QueryExecution(self, root, qid)
+        execution = _QueryExecution(self, root, qid,
+                                    trace_token=trace_token)
         self.last_execution = execution
         try:
             pages = execution.run()

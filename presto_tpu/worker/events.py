@@ -45,6 +45,12 @@ class QueryCompletedEvent:
     queued_time_s: float
     rows: int
     error: Optional[str] = None
+    # rolled-up execution-wide RuntimeStats ({name: {sum, count, min, max}},
+    # the reference QueryCompletedEvent's queryStats.runtimeStats) and the
+    # query's peak MemoryPool reservation — both observability satellites;
+    # defaulted so pre-existing listeners/tests keep constructing the event
+    runtime_stats: Optional[dict] = None
+    peak_memory_bytes: int = 0
 
 
 @dataclass

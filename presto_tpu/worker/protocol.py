@@ -329,6 +329,11 @@ def apply_session_properties(config, session: Dict[str, str]):
             raise ValueError(
                 f"fault_injection_probability must be in [0, 1], got {p}")
         kw["fault_injection_probability"] = p
+    if "analyze_unfused" in session:
+        # EXPLAIN ANALYZE compatibility knob: disable scan-chain fusion so
+        # per-operator stats come from the interpreted streaming path
+        kw["analyze_unfused"] = (
+            str(session["analyze_unfused"]).lower() == "true")
     if "plan_validation" in session:
         mode = str(session["plan_validation"]).strip().lower()
         from ..analysis import VALIDATION_MODES

@@ -389,6 +389,19 @@ class _Handler(BaseHTTPRequestHandler):
             f"presto_tpu_serving_prepared_replans_total "
             f"{sv['preparedReplans']}",
         ]
+        # HBM-resident columnar storage tier (storage/store.py
+        # STORAGE_METRICS), namespaced like the other sections;
+        # resident_bytes is the only point-in-time gauge
+        from ..storage.store import STORAGE_METRICS
+        for k in sorted(STORAGE_METRICS):
+            if k == "resident_bytes":
+                lines.append(f"# TYPE presto_tpu_storage_{k} gauge")
+                lines.append(
+                    f"presto_tpu_storage_{k} {STORAGE_METRICS[k]}")
+            else:
+                lines.append(f"# TYPE presto_tpu_storage_{k}_total counter")
+                lines.append(
+                    f"presto_tpu_storage_{k}_total {STORAGE_METRICS[k]}")
         if s.dispatch is not None:
             lines += [
                 "# TYPE presto_tpu_serving_group_running gauge",
@@ -484,7 +497,8 @@ class _Handler(BaseHTTPRequestHandler):
             session=self._session_headers(),
             catalog=self.headers.get("X-Presto-Catalog", "tpch"),
             schema=self.headers.get("X-Presto-Schema", "sf0.01"),
-            prepared=self._prepared_headers())
+            prepared=self._prepared_headers(),
+            trace_token=self.headers.get("X-Presto-Trace-Token", ""))
         self._send(200, d.queued_response(q, 0, self.server_ref.uri,
                                           wait_s=0.0),
                    headers=self._prepare_headers_out(q))
@@ -538,6 +552,20 @@ class _Handler(BaseHTTPRequestHandler):
             return
         self._send(200, d.list_queries())
 
+    @staticmethod
+    def _process_metrics() -> dict:
+        """Process-wide metric registries, namespaced consistently with
+        the /v1/metrics exposition sections — included in QueryInfo so a
+        single snapshot carries both query- and process-scoped state."""
+        from ..parallel.fabric import FABRIC_METRICS
+        from ..serving import SERVING_METRICS
+        from ..storage.store import STORAGE_METRICS
+        from .exchange import EXCHANGE_METRICS
+        return {"exchange": EXCHANGE_METRICS.snapshot(),
+                "fabric": FABRIC_METRICS.snapshot(),
+                "serving": SERVING_METRICS.snapshot(),
+                "storage": dict(STORAGE_METRICS)}
+
     def do_query_info(self, groups, query):
         d = self._dispatch_mgr()
         if d is None:
@@ -547,13 +575,25 @@ class _Handler(BaseHTTPRequestHandler):
         except KeyError:
             self._send(404, {"error": "unknown query"})
             return
+        # stage/task/operator drill-down: the terminal snapshot captured
+        # by the executor, else a LIVE snapshot from the running
+        # distributed execution matched by trace token
+        extra = q.query_info_extra
+        if extra is None and not q.done.is_set():
+            extra = self.server_ref.live_query_info(q.trace_token)
         self._send(200, {
             "queryId": q.query_id, "query": q.sql, "state": q.state,
+            "traceToken": q.trace_token,
             "queryStats": q.stats(), "session": q.session,
             "resourceGroupId": [q.resource_group],
+            "peakMemoryBytes": q.peak_memory_bytes,
             **({"runtimeStats": q.runtime_stats}
                if q.runtime_stats else {}),
             **({"failureInfo": {"message": q.error}} if q.error else {}),
+            **({"stages": extra.get("stages"),
+                "operatorStats": extra.get("operatorStats")}
+               if extra else {}),
+            "processMetrics": self._process_metrics(),
             "resourceGroups": d.resource_groups.info()})
 
     def do_plan_check(self, groups, query):
@@ -890,8 +930,24 @@ class WorkerServer:
                     stats)
         if not uris:
             result = runner.execute(q.sql, prepared=q.prepared)
+            if q.sql.lstrip().lower().startswith("explain") \
+                    and getattr(runner, "last_operator_stats", None):
+                # EXPLAIN ANALYZE side channel: the per-node operator
+                # stats of THIS analyzed run (the runner attribute is
+                # sticky, so gate on the statement being an EXPLAIN)
+                q.query_info_extra = {
+                    "operatorStats": runner.last_operator_stats}
         else:
-            result = runner.execute(q.sql)
+            result = runner.execute(q.sql, trace_token=q.trace_token)
+            exe = getattr(runner, "last_execution", None)
+            if exe is not None and getattr(exe, "trace_token",
+                                           "") == q.trace_token:
+                try:
+                    # terminal snapshot for the query-history ring: tasks
+                    # stay queryable on workers until TTL eviction
+                    q.query_info_extra = exe.query_info_snapshot()
+                except Exception:  # noqa: BLE001 — snapshot best-effort
+                    pass
         if q.sql.lstrip()[:6].lower() in ("create", "insert") \
                 or q.sql.lstrip()[:4].lower() == "drop":
             with self._runner_lock:
@@ -899,6 +955,24 @@ class WorkerServer:
                     self._close_runner(r)
                 self._runner_cache.clear()
         return result
+
+    def live_query_info(self, trace_token: str) -> Optional[dict]:
+        """Live stage/task/operator snapshot for a RUNNING distributed
+        query, matched to its execution by trace token (the runner cache
+        is shared across queries, so the token is the join key)."""
+        if not trace_token:
+            return None
+        with self._runner_lock:
+            runners = list(self._runner_cache.values())
+        for r in runners:
+            exe = getattr(r, "last_execution", None)
+            if exe is not None and getattr(exe, "trace_token",
+                                           "") == trace_token:
+                try:
+                    return exe.query_info_snapshot()
+                except Exception:  # noqa: BLE001 — snapshot best-effort
+                    return None
+        return None
 
     @staticmethod
     def _close_runner(runner) -> None:

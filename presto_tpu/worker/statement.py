@@ -274,6 +274,12 @@ class ManagedQuery:
     columns: Optional[List[dict]] = None
     rows: Optional[list] = None
     runtime_stats: Optional[dict] = None
+    # observability: the query's trace token (minted at submit or taken
+    # from the client's X-Presto-Trace-Token) and the stage/task/operator
+    # drill-down captured by the executor for /v1/query/{id}
+    trace_token: str = ""
+    query_info_extra: Optional[dict] = None
+    peak_memory_bytes: int = 0
     created_at: float = field(default_factory=time.time)
     started_at: Optional[float] = None
     finished_at: Optional[float] = None
@@ -345,12 +351,20 @@ class DispatchManager:
     def submit(self, sql: str, user: str = "user", source: str = "",
                session: Optional[Dict[str, str]] = None,
                catalog: str = "tpch", schema: str = "sf0.01",
-               prepared: Optional[Dict[str, str]] = None) -> ManagedQuery:
+               prepared: Optional[Dict[str, str]] = None,
+               trace_token: str = "") -> ManagedQuery:
         self._reap_abandoned()
         qid = f"{time.strftime('%Y%m%d_%H%M%S')}_{next(_query_ids):05d}"
         q = ManagedQuery(qid, sql, user, source, dict(session or {}),
                          catalog, schema, prepared=dict(prepared or {}))
         q.resource_group = self.resource_groups.select(user, source)
+        # honor a client-supplied trace token (X-Presto-Trace-Token), else
+        # mint one from the query id.  Kept OFF q.session: the executor's
+        # runner cache is keyed by session items, and a per-query token
+        # there would defeat plan/runner reuse.  The executor hands it to
+        # the distributed runner out-of-band.
+        q.trace_token = (trace_token or q.session.get("trace_token")
+                         or f"trace-{qid}")
         est = (session or {}).get("query_memory_bytes")
         if est is not None:
             try:
@@ -412,6 +426,8 @@ class DispatchManager:
                 q.rows = [[_json_value(v) for v in row]
                           for row in result.rows]
                 q.runtime_stats = getattr(result, "runtime_stats", None)
+                q.peak_memory_bytes = int(
+                    getattr(result, "peak_memory_bytes", 0) or 0)
                 q.added_prepare = getattr(result, "added_prepare", None)
                 q.deallocated_prepare = getattr(
                     result, "deallocated_prepare", None)
@@ -454,7 +470,9 @@ class DispatchManager:
             queued_time_s=(q.started_at or now) - q.created_at,
             rows=(q.rows_served if q._row_iter is not None
                   else len(q.rows or [])),
-            error=error))
+            error=error,
+            runtime_stats=q.runtime_stats,
+            peak_memory_bytes=q.peak_memory_bytes))
         # only a query that held a running slot frees one; cancelling a
         # QUEUED query must not over-admit past hardConcurrencyLimit
         if q._admitted:

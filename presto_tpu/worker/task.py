@@ -56,6 +56,10 @@ class TpuTask:
         self.plan_nodes: List[dict] = []
         from ..utils.runtime_stats import RuntimeStats
         self.stats = RuntimeStats()       # exchange-client walls/bytes etc.
+        # X-Presto-Trace-Token propagated by the coordinator (session key
+        # "trace_token"); echoed back in TaskInfo so a trace id observed at
+        # the coordinator can be joined against worker-side task records
+        self.trace_token = ""
         self._cond = threading.Condition()
         self._thread: Optional[threading.Thread] = None
 
@@ -68,6 +72,7 @@ class TpuTask:
         return {
             "taskId": self.task_id,
             "taskStatus": status.to_dict(),
+            "traceToken": self.trace_token,
             "noMoreSplits": True,
             "stats": {
                 "createTime": self.created_at,
@@ -194,7 +199,19 @@ class TpuTask:
                 retain=cfg.remote_task_retry_attempts > 0,
                 coalesce_target_bytes=cfg.exchange_max_response_bytes)
             ctx = TaskContext(config=cfg, task_index=update.task_index,
-                              memory=MemoryPool(cfg.memory_budget_bytes))
+                              memory=MemoryPool(cfg.memory_budget_bytes),
+                              runtime_stats=self.stats)
+            self.trace_token = update.session.get("trace_token", "")
+            if self.trace_token:
+                print(f"[trace {self.trace_token}] task {self.task_id} "
+                      f"starting")
+            if str(update.session.get(
+                    "collect_operator_stats", "")).lower() == "true":
+                # coordinator-requested per-node operator stats (EXPLAIN
+                # ANALYZE / QueryInfo drill-down): enable the same node-id
+                # keyed stats dict the local ANALYZE path uses; merged into
+                # the TaskInfo plan-node inventory when the task finishes
+                ctx.stats = {}
             from .plan_translation import translate_split
             for source in update.sources:
                 splits = [translate_split(s) for s in source.splits]
@@ -323,6 +340,15 @@ class TpuTask:
                     self.output_bytes += len(data)
                     self.buffers.add(0, data)
             self.memory_peak = ctx.memory.peak
+            if ctx.stats:
+                # attach the collected per-node operator stats to the plan-
+                # node inventory (TaskInfo pipelines[].operators[].stats) so
+                # the coordinator can roll them up across tasks; everything
+                # in the stats dicts is already JSON-safe
+                for op in self.plan_nodes:
+                    s = ctx.stats.get(op["planNodeId"])
+                    if s is not None:
+                        op["stats"] = s
             self.buffers.set_complete()
             self._set_state(FINISHED)
         except Exception as e:

@@ -14,7 +14,8 @@ import pytest
 
 from presto_tpu.analysis.lint import (ALL_LINT_CODES, PRAGMA, SYNC_ASARRAY,
                                       SYNC_BRANCH, SYNC_CAST, SYNC_EXPLICIT,
-                                      SYNC_NETWORK, lint_or_raise, lint_paths,
+                                      SYNC_NETWORK, SYNC_WALLCLOCK,
+                                      WALL_PRAGMA, lint_or_raise, lint_paths,
                                       lint_source)
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -165,6 +166,56 @@ def test_network_pragma_suppresses():
     assert findings == []
 
 
+_WALL_FIXTURE = ("import time\n"
+                 "def drive(batches):\n"
+                 "    t0 = time.perf_counter()\n"
+                 "    n = sum(1 for _ in batches)\n"
+                 "    return n, time.perf_counter() - t0\n")
+
+
+def test_wall_clock_in_exec_flagged():
+    findings = lint_source(_WALL_FIXTURE,
+                           path="presto_tpu/exec/bad_timer.py")
+    assert _codes(findings) == {SYNC_WALLCLOCK}
+    assert len(findings) == 2
+
+
+def test_wall_clock_outside_exec_not_flagged():
+    # the rule is scoped to the execution layer; worker/bench/storage code
+    # times freely
+    for path in ("presto_tpu/worker/task.py", "presto_tpu/storage/store.py",
+                 "bench.py"):
+        assert lint_source(_WALL_FIXTURE, path=path) == []
+
+
+def test_wall_clock_pragma_suppresses():
+    findings = lint_source(
+        "import time\n"
+        "def drive(stats):\n"
+        "    t0 = time.perf_counter()  # lint: allow-wall-clock\n"
+        "    stats.record_wall(time.perf_counter() - t0)"
+        "  # lint: allow-wall-clock\n",
+        path="presto_tpu/exec/scheduler.py")
+    assert findings == []
+
+
+def test_pragmas_are_not_interchangeable():
+    # a host-sync acknowledgement must not silence SYNC006 (and vice
+    # versa): each code checks only its own pragma's line set
+    findings = lint_source(
+        "import time\n"
+        "def f():\n"
+        "    return time.perf_counter()  # lint: allow-host-sync\n",
+        path="presto_tpu/exec/whatever.py")
+    assert _codes(findings) == {SYNC_WALLCLOCK}
+    findings = lint_source(
+        "import jax\n"
+        "def f(x):\n"
+        "    return jax.device_get(x)  # lint: allow-wall-clock\n",
+        path="presto_tpu/exec/whatever.py")
+    assert _codes(findings) == {SYNC_EXPLICIT}
+
+
 # ---------------------------------------------------------------------------
 # precision: host values and metadata must NOT be flagged
 # ---------------------------------------------------------------------------
@@ -237,5 +288,6 @@ def test_lint_routes_through_error_taxonomy(tmp_path):
 
 def test_all_codes_are_exercised_above():
     assert set(ALL_LINT_CODES) == {SYNC_EXPLICIT, SYNC_CAST, SYNC_ASARRAY,
-                                   SYNC_BRANCH, SYNC_NETWORK}
+                                   SYNC_BRANCH, SYNC_NETWORK, SYNC_WALLCLOCK}
     assert PRAGMA == "lint: allow-host-sync"
+    assert WALL_PRAGMA == "lint: allow-wall-clock"
