@@ -13,6 +13,12 @@ vs_baseline = speedup vs the single-threaded numpy reference interpreter
 Env knobs: BENCH_SF (default 10), BENCH_RUNS (default 3),
 BENCH_QUERY (q1|q6|q6z|q3g|xchg|serve).
 
+q1/q6/q6z lines also carry a "scan_kernel" object: best-of-N walls and
+effective_scan_gbps for the same query pinned to scan_kernel=pallas and
+scan_kernel=xla (plus pallas_vs_xla, the xla/pallas wall ratio), so TPU
+rounds measure the fused Pallas scan kernel against the XLA chain and
+the r04 15 GB/s baseline directly.
+
 BENCH_QUERY=serve is the serving-tier benchmark: BENCH_SERVE_CLIENTS
 concurrent statement-protocol clients (default 4) each issuing
 BENCH_SERVE_REQUESTS parameterized EXECUTEs (default 15) over repeated
@@ -480,6 +486,45 @@ def main():
         "chunks_total": sm["chunks_total"],
         "chunks_skipped": sm["chunks_skipped"],
     }
+    # Pallas-vs-XLA scan kernel side-by-side: same plan, same resident
+    # data, only the scan hot-path implementation differs.  Each mode gets
+    # its own warmup + best-of-N so the comparison is compile-free on both
+    # sides; kernel_programs counts fused scan programs that actually took
+    # the Pallas path (0 under xla or when every scan declined), and
+    # declined carries the per-reason counters for ineligible scans.
+    if qname in ("q1", "q6", "q6z"):
+        import dataclasses
+        kcmp = {}
+        for mode in ("pallas", "xla"):
+            kr = LocalQueryRunner(schema=schema, config=dataclasses.replace(
+                runner.config, scan_kernel=mode))
+            kr.execute(sql)           # warmup: compiles this variant
+            kbest = float("inf")
+            for _ in range(runs):
+                t0 = time.perf_counter()
+                kres = kr.execute(sql)
+                kbest = min(kbest, time.perf_counter() - t0)
+            rs = kres.runtime_stats or {}
+            kcmp[mode] = {
+                "wall_s": round(kbest, 4),
+                "rows_per_sec": round(n_rows / kbest, 1),
+                "effective_scan_gbps": round(
+                    n_rows / kbest * col_bytes / 1e9, 2),
+                "kernel_programs": int(
+                    rs.get("kernelScanPrograms", {}).get("sum", 0)),
+                "declined": {
+                    k[len("kernelDeclined"):]: int(v.get("sum", 0))
+                    for k, v in sorted(rs.items())
+                    if k.startswith("kernelDeclined")},
+            }
+        out["scan_kernel"] = {
+            **kcmp,
+            # > 1.0 means the Pallas fused pass beat the XLA chain
+            "pallas_vs_xla": round(
+                kcmp["xla"]["wall_s"] / kcmp["pallas"]["wall_s"], 3)
+            if kcmp["pallas"]["wall_s"] else 0.0,
+        }
+
     # operator-level breakdown from the stats spine: one EXPLAIN ANALYZE
     # pass (same plan, fused path) and the top-5 operators by wall — where
     # the headline wall actually went

@@ -32,6 +32,12 @@ and flags the hazard shapes:
            ad-hoc timing that goes nowhere rots into dead measurement
            and hides where walls are ACTUALLY recorded.  Sanctioned
            metering sites carry `# lint: allow-wall-clock`.
+  KERNEL001  an `interpret=True` literal (keyword or kwargs-dict store)
+           anywhere outside `exec/kernels/shim.py`.  Interpret mode is
+           the CPU test fallback; a stray literal in kernel or call-site
+           code would make a TPU build silently run Pallas kernels in
+           the Python interpreter.  There is NO pragma escape — the shim
+           is the one sanctioned site.
 
 "Device value" is tracked with a deliberately shallow per-scope
 dataflow: names assigned from `jnp.*` / `lax.*` calls (or expressions
@@ -67,9 +73,14 @@ SYNC_ASARRAY = "SYNC003"
 SYNC_BRANCH = "SYNC004"
 SYNC_NETWORK = "SYNC005"
 SYNC_WALLCLOCK = "SYNC006"
+KERNEL_INTERPRET = "KERNEL001"
 
 ALL_LINT_CODES = (SYNC_EXPLICIT, SYNC_CAST, SYNC_ASARRAY, SYNC_BRANCH,
-                  SYNC_NETWORK, SYNC_WALLCLOCK)
+                  SYNC_NETWORK, SYNC_WALLCLOCK, KERNEL_INTERPRET)
+
+# KERNEL001 scope: everywhere.  The shim is the ONE file that may select
+# Pallas interpret mode (it gates on the backend); no pragma overrides.
+_INTERPRET_ALLOWLIST = ("presto_tpu/exec/kernels/shim.py",)
 
 # SYNC005 scope: pipeline compute packages where a blocking HTTP round
 # trip would serialise operator execution.  Matching is on path markers,
@@ -173,6 +184,8 @@ class _Linter(ast.NodeVisitor):
             any(m in norm for m in _NETWORK_PATH_MARKERS)
             and not any(norm.endswith(a) for a in _NETWORK_ALLOWLIST))
         self._wall_scoped = _WALL_PATH_MARKER in norm
+        self._interpret_exempt = any(
+            norm.endswith(a) for a in _INTERPRET_ALLOWLIST)
 
     # -- reporting --------------------------------------------------------
     def _flag(self, node: ast.AST, code: str, message: str,
@@ -248,6 +261,21 @@ class _Linter(ast.NodeVisitor):
 
     # -- bindings ----------------------------------------------------------
     def visit_Assign(self, node: ast.Assign) -> None:
+        if not self._interpret_exempt:
+            # the kwargs-dict store form of the same hazard:
+            # kwargs["interpret"] = True
+            for tgt in node.targets:
+                if (isinstance(tgt, ast.Subscript)
+                        and isinstance(tgt.slice, ast.Constant)
+                        and tgt.slice.value == "interpret"
+                        and isinstance(node.value, ast.Constant)
+                        and node.value.value is True):
+                    self._flag(node, KERNEL_INTERPRET,
+                               "interpret=True outside exec/kernels/shim.py "
+                               "would make TPU builds run Pallas kernels in "
+                               "the Python interpreter; route the call "
+                               "through the shim (no pragma escape)",
+                               allowed=set())
         self.visit(node.value)
         if (isinstance(node.value, ast.Tuple)
                 and len(node.targets) == 1
@@ -324,6 +352,17 @@ class _Linter(ast.NodeVisitor):
                        f"operator stats, or mark the sanctioned metering "
                        f"site with `# {WALL_PRAGMA}`",
                        allowed=self.wall_allowed)
+        if not self._interpret_exempt:
+            for kw in node.keywords:
+                if kw.arg == "interpret" \
+                        and isinstance(kw.value, ast.Constant) \
+                        and kw.value.value is True:
+                    self._flag(kw.value, KERNEL_INTERPRET,
+                               "interpret=True outside exec/kernels/shim.py "
+                               "would make TPU builds run Pallas kernels in "
+                               "the Python interpreter; route the call "
+                               "through the shim (no pragma escape)",
+                               allowed=set())
         self.generic_visit(node)
 
     def visit_If(self, node: ast.If) -> None:

@@ -1,0 +1,20 @@
+"""Hand-written Pallas TPU kernels for the scan hot path.
+
+The fused compiler (exec/fused.py, exec/pipeline.py) stays the planner
+and fallback; this package holds the kernels it can dispatch to when a
+chain is eligible, selected by the `scan.kernel = xla | pallas | auto`
+ExecutionConfig knob.  CPU runs execute the same kernels through Pallas
+interpret mode (kernels/shim.py, the only sanctioned `interpret=True`
+site) so tier-1 tests cover the kernel path.
+"""
+from .scan_kernel import (KERNEL_DECLINE_REASONS, SUBTILE_ROWS,
+                          build_direct_runner, try_direct_scan_kernel)
+from .shim import kernel_interpret
+
+__all__ = [
+    "KERNEL_DECLINE_REASONS",
+    "SUBTILE_ROWS",
+    "build_direct_runner",
+    "try_direct_scan_kernel",
+    "kernel_interpret",
+]

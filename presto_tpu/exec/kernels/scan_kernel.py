@@ -1,0 +1,478 @@
+"""Pallas fused scan kernel: decode -> filter -> prefix-sum compact ->
+partial aggregation in one VMEM-resident grid pass.
+
+The XLA fused chain (exec/fused.py) already collapses scan -> filter ->
+project -> partial-agg into one program, but its aggregation update
+reads the FULL chunk tile: a selective predicate (TPC-H Q6 keeps ~2% of
+rows) still pays the G x cap one-hot grid over every padded row.  This
+kernel is the hand-written hot path the ROADMAP's HBM-gap item calls
+for:
+
+  grid      one step per SURVIVING block-aligned chunk.  The kernel
+            re-grids the scan's split ranges onto cap-aligned blocks
+            (aggregation is order-insensitive, so any partition of the
+            same row set is legal) because Pallas block specs index
+            whole blocks; each grid entry carries its block index plus
+            a [lo, hi) live row range as scalar-prefetch operands.
+            Zone-map pruning runs over THIS grid, so pruned blocks
+            never issue DMAs -- they are simply not in the grid.
+  decode    ResidentColumn blocks stream out of HBM in ENCODED form via
+            block specs (Pallas double-buffers the HBM->VMEM copies
+            across grid steps); dict gather / RLE binary search runs in
+            vector registers -- late materialization with the same
+            semantics as ResidentColumn.slice_decode
+  filter    the chain's own predicate/project expressions, lowered by
+            the SAME exec/lowering.Lowering the XLA chain uses -- the
+            kernel cannot drift from the engine semantics.  Bound
+            parameters (the serving tier parameterizes plan literals)
+            ride as traced scalar inputs, so re-executions with
+            different constants reuse the compiled kernel.
+  compact   a work-efficient Blelloch exclusive prefix sum over the
+            selection mask drives an in-VMEM scatter compaction (no XLA
+            gather round-trip), after which the aggregation update only
+            touches ceil(live/SUBTILE) subtiles instead of the full tile
+  agg       operators.agg_direct_update over compacted subtiles; the
+            packed int64/float64 accumulators live in the kernel's
+            output block across grid steps and feed
+            operators.agg_direct_finalize unchanged
+
+Device-side row counters (scan live rows + live rows after every chain
+step) accumulate in an output block exactly like the XLA chain's
+with_counts path, so EXPLAIN ANALYZE / QueryInfo operator stats stay
+accurate on the kernel path.
+
+Parity contract (tests/test_scan_kernel.py): integer accumulators
+(sums over int64/decimal/date/bool, count, min, max) and the row
+counters are BIT-FOR-BIT identical to the XLA chain -- integer adds
+and min/max are associative, so compaction and re-gridding cannot
+change them.  float64 sum/avg may differ in the last ulp (different
+reduction tree pairings); TPC-H decimals are unscaled int64 on device,
+so the Q1/Q6 money aggregates are exact.
+"""
+from __future__ import annotations
+
+import math
+from typing import Callable, Dict, List, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .. import operators as ops
+from ..batch import Batch, Column
+from . import shim
+
+# Eligibility refusals, surfaced as kernelDeclined{reason} RuntimeStats
+# counters (exec/pipeline.py _kernel_declined) -- the kernel twin of the
+# fusionDeclined{...} family.  "Disabled" and "AggShape" are recorded by
+# the pipeline itself (knob off / no direct-mode aggregation to fuse
+# into); the rest are produced here.
+KERNEL_DECLINE_REASONS = (
+    "Disabled",            # scan.kernel = xla
+    "AggShape",            # aggregation not direct-mode (G<=64) eligible
+    "Backend",             # platform is neither tpu nor cpu-interpret
+    "PlanShape",           # chain has join/semi/uid steps
+    "ColumnsNotResident",  # a scanned column is not HBM-resident encoded
+    "ChunkAlignment",      # encoded arrays cannot tile the block grid
+)
+
+# compacted rows are aggregated in subtiles of this many rows: the
+# G x SUBTILE one-hot grid stays small while a selective filter skips
+# most subtiles entirely (n_sub = ceil(live/SUBTILE) loop trips)
+SUBTILE_ROWS = 2048
+
+
+def _blelloch_exclusive(x):
+    """Work-efficient (Blelloch) exclusive prefix sum of a power-of-two
+    length vector, expressed with reshapes so both the up-sweep and the
+    down-sweep are dense vector ops (no scatter): pairing adjacent
+    elements halves the vector per level, then each level's prefix
+    splits back into (left, left + pair_first)."""
+    cur = x
+    levels = []
+    while cur.shape[0] > 1:
+        levels.append(cur)
+        pairs = cur.reshape(-1, 2)
+        cur = pairs[:, 0] + pairs[:, 1]
+    pref = jnp.zeros_like(cur)
+    for lvl in reversed(levels):
+        pairs = lvl.reshape(-1, 2)
+        left = pref
+        right = pref + pairs[:, 0]
+        pref = jnp.stack([left, right], axis=1).reshape(-1)
+    return pref
+
+
+def _bisect_right(a, v):
+    """searchsorted(a, v, side="right") as a fixed-trip vectorized
+    binary search -- jnp.searchsorted does not lower inside Pallas TPU
+    kernels, and the loop is exact integer arithmetic so interpret and
+    compiled runs agree with the XLA chain's searchsorted decode."""
+    size = a.shape[0]
+    steps = max(1, int(math.ceil(math.log2(size + 1))) + 1)
+    lo = jnp.zeros(v.shape, dtype=jnp.int64)
+    hi = jnp.full(v.shape, size, dtype=jnp.int64)
+    for _ in range(steps):
+        cont = lo < hi
+        mid = (lo + hi) // 2
+        le = a[jnp.clip(mid, 0, size - 1)] <= v
+        lo = jnp.where(cont & le, mid + 1, lo)
+        hi = jnp.where(cont & ~le, mid, hi)
+    return lo
+
+
+class _Runner(NamedTuple):
+    fn: Callable                 # jitted launcher
+    init_i: object               # (Ni, G) int64 accumulator init rows
+    init_f: object               # (max(Nf,1), G) float64 init rows
+    int_names: Tuple[str, ...]   # acc_i row -> agg_direct state key
+    flt_names: Tuple[str, ...]   # acc_f row -> agg_direct state key
+
+
+def _chunk_block(i, bidx, lo, hi):
+    return (bidx[i],)
+
+
+def _whole_1d(i, bidx, lo, hi):
+    return (0,)
+
+
+def _whole_2d(i, bidx, lo, hi):
+    return (0, 0)
+
+
+def _merged_ranges(splits) -> List[Tuple[int, int]]:
+    """The scan's owned row ranges, sorted and coalesced."""
+    out: List[List[int]] = []
+    for s, e in sorted((int(sp.start), int(sp.end)) for sp in splits):
+        if out and s <= out[-1][1]:
+            out[-1][1] = max(out[-1][1], e)
+        else:
+            out.append([s, e])
+    return [(s, e) for s, e in out]
+
+
+def _block_pruned(zone_maps, pushdown, params, pos: int,
+                  count: int) -> bool:
+    """storage/pushdown.prune_chunks' conservative unsatisfiability
+    test for ONE aligned block (the kernel grid differs from the
+    chain's split-relative chunk grid, so pruning re-runs here; the
+    chain already metered ITS grid in chunks_for)."""
+    from ...storage.pushdown import (entry_unsatisfiable,
+                                     resolve_entry_value)
+    for e in pushdown:
+        zm = zone_maps.get(e["column"])
+        if zm is None:
+            continue
+        value = resolve_entry_value(e["value"], params)
+        if value is None:
+            continue
+        bounds = zm.chunk_bounds(pos, count)
+        if bounds is None:
+            continue
+        if entry_unsatisfiable(e["op"], value, *bounds):
+            return True
+    return False
+
+
+def aligned_grid(meta: dict, block_rows: int,
+                 params) -> List[Tuple[int, int, int]]:
+    """(block index, lo, hi) grid entries tiling the scan's split
+    ranges with cap-aligned blocks; [lo, hi) is the block-relative live
+    row range.  A block straddling two disjoint owned ranges yields two
+    entries (grid steps accumulate, so revisiting a block is sound).
+    Zone-map-pruned entries are dropped HERE -- they never reach the
+    grid, so their HBM blocks are never DMA'd."""
+    zone_maps = meta.get("zone_maps") or {}
+    pushdown = meta.get("pushdown") or []
+    entries: List[Tuple[int, int, int]] = []
+    for s, e in _merged_ranges(meta["splits"]):
+        for b in range(s // block_rows, (e - 1) // block_rows + 1):
+            lo = max(s, b * block_rows) - b * block_rows
+            hi = min(e, (b + 1) * block_rows) - b * block_rows
+            if zone_maps and pushdown and _block_pruned(
+                    zone_maps, pushdown, params,
+                    b * block_rows + lo, hi - lo):
+                continue
+            entries.append((b, lo, hi))
+    return entries
+
+
+def build_direct_runner(chain, kinds: Dict[str, str], n_params: int, *,
+                        specs, key_names, strides, G, agg_exprs,
+                        lowering) -> _Runner:
+    """Compile the chain's static shape (column encodings, steps, agg
+    specs) into a jitted Pallas launcher.  `kinds` maps each scan
+    output name to its ResidentColumn encoding; `n_params` is the
+    length of the chain's bound-parameter vector.  The launcher
+    re-traces when the surviving-grid length changes (param pruning);
+    everything else is baked in, mirroring the fused_cache programs of
+    the XLA path."""
+    meta = chain.scan_meta
+    cap = chain.leaf_cap(())
+    steps = chain.steps
+    n_steps = len(steps)
+    dicts = meta["dicts"]
+    colmap = meta["colmap"]
+    names = tuple(colmap)
+
+    template = ops.agg_direct_init(G, specs)
+    int_names = tuple(k for k, v in template.items()
+                      if v.dtype == jnp.int64)
+    flt_names = tuple(k for k, v in template.items()
+                      if v.dtype == jnp.float64)
+    assert len(int_names) + len(flt_names) == len(template)
+    n_i = len(int_names)
+    n_f = len(flt_names)
+    init_i = jnp.stack([template[k] for k in int_names])
+    init_f = (jnp.stack([template[k] for k in flt_names]) if n_f
+              else jnp.zeros((1, G), dtype=jnp.float64))
+
+    def kernel(bidx_ref, lo_ref, hi_ref, *refs):
+        col_refs = refs[:len(refs) - 5 - n_params]
+        param_refs = refs[len(col_refs):len(col_refs) + n_params]
+        init_i_ref, init_f_ref = refs[-5:-3]
+        acc_i_ref, acc_f_ref, counts_ref = refs[-3:]
+        i = pl.program_id(0)
+
+        @pl.when(i == 0)
+        def _init_outputs():
+            acc_i_ref[...] = init_i_ref[...]
+            acc_f_ref[...] = init_f_ref[...]
+            counts_ref[...] = jnp.zeros((1, 1 + n_steps), dtype=jnp.int64)
+
+        pos = bidx_ref[i].astype(jnp.int64) * cap
+        idx0 = jnp.arange(cap, dtype=jnp.int64)
+        live = (idx0 >= lo_ref[i].astype(jnp.int64)) \
+            & (idx0 < hi_ref[i].astype(jnp.int64))
+
+        # -- late decode: ResidentColumn.slice_decode semantics over the
+        # chunk's VMEM blocks, then the scan's dead-row zeroing
+        cols: Dict[str, Column] = {}
+        r = 0
+        for name in names:
+            kind = kinds[name]
+            if kind == "plain":
+                v = col_refs[r][...]
+                r += 1
+            elif kind == "dict":
+                codes = col_refs[r][...]
+                values = col_refs[r + 1][...]
+                r += 2
+                v = values[codes.astype(jnp.int32)]
+            else:                                    # rle
+                run_values = col_refs[r][...]
+                run_starts = col_refs[r + 1][...]
+                r += 2
+                ri = _bisect_right(run_starts, pos + idx0) - 1
+                ri = jnp.clip(ri, 0, run_values.shape[0] - 1)
+                v = run_values[ri]
+            v = jnp.where(live, v, jnp.zeros((), v.dtype))
+            cols[name] = Column(v, None, dicts.get(name))
+        batch = Batch(cols, live)
+
+        # -- the chain's own filter/project/rename steps, lowered by the
+        # engine's Lowering (shared with the XLA chain), with the same
+        # per-step live-row counters chain.make(with_counts=True) emits.
+        # The bound-parameter vector rides along for step expressions
+        # exactly as in FusedChain.make's _pb (aggregation input
+        # expressions see a param-less batch on both paths).
+        params_k = tuple(p[...][0] for p in param_refs)
+
+        def _pb(b):
+            return b.with_params(params_k) if n_params else b
+        counts = [jnp.sum(live)]
+        for step in steps:
+            kind = step[0]
+            if kind == "filter":
+                batch = ops.apply_filter(
+                    batch, lowering.eval(step[1], _pb(batch)))
+            elif kind == "project":
+                pb = _pb(batch)
+                batch = Batch({v2.name: lowering.eval(e, pb)
+                               for v2, e in step[1]}, batch.mask)
+            else:                                    # rename
+                batch = Batch({o: batch.columns[src]
+                               for o, src in step[1]}, batch.mask)
+            counts.append(jnp.sum(batch.mask))
+
+        codes = None
+        for k, stride in zip(key_names, strides):
+            c = batch.columns[k].values.astype(jnp.int64)
+            codes = c * stride if codes is None else codes + c * stride
+        if codes is None:
+            codes = jnp.zeros(cap, dtype=jnp.int64)
+        agg_cols = agg_exprs(batch)
+        mask = batch.mask
+
+        # -- prefix-sum compaction: exclusive scan of the mask gives
+        # each live row its packed slot; dead rows scatter to index cap
+        # and drop.  Downstream aggregation then loops over live
+        # subtiles only.
+        pref = _blelloch_exclusive(mask.astype(jnp.int32))
+        total = pref[cap - 1] + mask[cap - 1].astype(jnp.int32)
+        dest = jnp.where(mask, pref, cap)
+        ccodes = jnp.zeros(cap, dtype=jnp.int64).at[dest].set(
+            codes, mode="drop")
+        cvals: Dict[str, object] = {}
+        cnulls: Dict[str, object] = {}
+        for spec in specs:
+            col = agg_cols.get(spec.output)
+            if col is None:                          # count_star
+                continue
+            cvals[spec.output] = jnp.zeros(
+                cap, dtype=col.values.dtype).at[dest].set(
+                    col.values, mode="drop")
+            if col.nulls is not None:
+                cnulls[spec.output] = jnp.zeros(
+                    cap, dtype=bool).at[dest].set(col.nulls, mode="drop")
+
+        ts = min(cap, SUBTILE_ROWS)
+        n_sub = (total + ts - 1) // ts
+        acc_i = acc_i_ref[...]
+        acc_f = acc_f_ref[...]
+        state = {k: acc_i[j] for j, k in enumerate(int_names)}
+        state.update({k: acc_f[j] for j, k in enumerate(flt_names)})
+        sub_idx = jnp.arange(ts, dtype=jnp.int32)
+
+        def sub(j, st):
+            off = j * ts
+            m = (off + sub_idx) < total
+            sc = jax.lax.dynamic_slice(ccodes, (off,), (ts,))
+            sa: Dict[str, Optional[Column]] = {}
+            for spec in specs:
+                cv = cvals.get(spec.output)
+                if cv is None:
+                    sa[spec.output] = None
+                    continue
+                sv = jax.lax.dynamic_slice(cv, (off,), (ts,))
+                cn = cnulls.get(spec.output)
+                sn = (jax.lax.dynamic_slice(cn, (off,), (ts,))
+                      if cn is not None else None)
+                sa[spec.output] = Column(sv, sn)
+            return ops.agg_direct_update(st, Batch({}, m), sc, sa,
+                                         specs, G)
+        state = jax.lax.fori_loop(0, n_sub, sub, state)
+        acc_i_ref[...] = jnp.stack([state[k] for k in int_names])
+        if n_f:
+            acc_f_ref[...] = jnp.stack([state[k] for k in flt_names])
+        counts_ref[...] = counts_ref[...] + jnp.stack(counts).astype(
+            jnp.int64)[None, :]
+
+    @jax.jit
+    def run(bidx, lo, hi, cached, params, init_i_arg, init_f_arg):
+        flat: List = []
+        in_specs: List = []
+        for name in names:
+            rc = cached[colmap[name]]
+            if rc.kind == "plain":
+                (data,) = rc.arrays
+                flat.append(data)
+                in_specs.append(pl.BlockSpec((cap,), _chunk_block))
+            elif rc.kind == "dict":
+                codes, values = rc.arrays
+                flat += [codes, values]
+                in_specs += [pl.BlockSpec((cap,), _chunk_block),
+                             pl.BlockSpec(values.shape, _whole_1d)]
+            else:                                    # rle
+                run_values, run_starts = rc.arrays
+                flat += [run_values, run_starts]
+                in_specs += [pl.BlockSpec(run_values.shape, _whole_1d),
+                             pl.BlockSpec(run_starts.shape, _whole_1d)]
+        for p in params:
+            flat.append(jnp.asarray(p).reshape(1))
+            in_specs.append(pl.BlockSpec((1,), _whole_1d))
+        flat += [init_i_arg, init_f_arg]
+        in_specs += [pl.BlockSpec(init_i_arg.shape, _whole_2d),
+                     pl.BlockSpec(init_f_arg.shape, _whole_2d)]
+        out_shape = [
+            jax.ShapeDtypeStruct((n_i, G), jnp.int64),
+            jax.ShapeDtypeStruct((max(n_f, 1), G), jnp.float64),
+            jax.ShapeDtypeStruct((1, 1 + n_steps), jnp.int64),
+        ]
+        out_specs = [
+            pl.BlockSpec((n_i, G), _whole_2d),
+            pl.BlockSpec((max(n_f, 1), G), _whole_2d),
+            pl.BlockSpec((1, 1 + n_steps), _whole_2d),
+        ]
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=3,
+            grid=(bidx.shape[0],),
+            in_specs=in_specs,
+            out_specs=out_specs,
+        )
+        return shim.pallas_call(kernel, grid_spec=grid_spec,
+                                out_shape=out_shape)(bidx, lo, hi, *flat)
+
+    return _Runner(run, init_i, init_f, int_names, flt_names)
+
+
+def try_direct_scan_kernel(chain, aux, *, specs, key_names, strides, G,
+                           agg_exprs, lowering, cache, declined,
+                           runtime_stats=None):
+    """Run the fused scan chain through the Pallas kernel when eligible.
+
+    Returns (agg_direct state dict, int64[1 + n_steps] row counters,
+    grid length) on success -- the caller feeds them to
+    agg_direct_finalize and the operator-stats spine exactly like the
+    XLA direct path -- or None after recording one
+    kernelDeclined{reason} counter."""
+    if jax.default_backend() not in ("cpu", "tpu"):
+        declined("Backend")
+        return None
+    if any(s[0] not in ("filter", "project", "rename")
+           for s in chain.steps):
+        declined("PlanShape")
+        return None
+    cap = chain.leaf_cap(())
+    if cap & (cap - 1):
+        # the Blelloch scan pairs elements level by level: power-of-two
+        # tiles only
+        declined("ChunkAlignment")
+        return None
+    cached = aux[0] or {}
+    colmap = chain.scan_meta.get("colmap") or {}
+    if not colmap or any(colmap[n] not in cached for n in colmap):
+        declined("ColumnsNotResident")
+        return None
+    params_fp = chain.compiler.ctx.params_fingerprint
+    grid = aligned_grid(chain.scan_meta, cap, params_fp)
+    if not grid:
+        # everything pruned: the XLA chain keeps one chunk for its
+        # compiled fori_loop, but the kernel can simply return its init
+        # state (the residual filter would kill every row anyway)
+        template = ops.agg_direct_init(G, specs)
+        return (template,
+                jnp.zeros(1 + len(chain.steps), dtype=jnp.int64), 0)
+    # per-row encoded arrays must tile cleanly under the block grid:
+    # every grid block [b*cap, (b+1)*cap) must lie inside the padded
+    # array (store.py pads by the BUILD-time capacity, which can differ
+    # from this chain's chunk capacity)
+    max_block = max(b for b, _lo, _hi in grid)
+    for name in colmap:
+        rc = cached[colmap[name]]
+        if rc.kind in ("plain", "dict") \
+                and rc.arrays[0].shape[0] < (max_block + 1) * cap:
+            declined("ChunkAlignment")
+            return None
+
+    params = tuple(aux[-1]) if chain.has_params else ()
+    key = ("pallas_direct", G, strides, len(params))
+    runner = cache.get(key)
+    if runner is None:
+        kinds = {name: cached[colmap[name]].kind for name in colmap}
+        runner = build_direct_runner(
+            chain, kinds, len(params), specs=specs, key_names=key_names,
+            strides=strides, G=G, agg_exprs=agg_exprs, lowering=lowering)
+        cache[key] = runner
+    bidx = jnp.asarray([b for b, _lo, _hi in grid], dtype=jnp.int32)
+    lo = jnp.asarray([lo_ for _b, lo_, _hi in grid], dtype=jnp.int32)
+    hi = jnp.asarray([hi_ for _b, _lo, hi_ in grid], dtype=jnp.int32)
+    acc_i, acc_f, kcounts = runner.fn(bidx, lo, hi, cached, params,
+                                      runner.init_i, runner.init_f)
+    state = {k: acc_i[j] for j, k in enumerate(runner.int_names)}
+    state.update({k: acc_f[j] for j, k in enumerate(runner.flt_names)})
+    if runtime_stats is not None:
+        runtime_stats.add("kernelScanPrograms", 1)
+    return state, kcounts[0], len(grid)

@@ -254,8 +254,29 @@ class ExecutionConfig:
     # dispatch back-to-back with NO host sync between them, so chunk k+1's
     # all_to_all is in flight while the consumer computes on chunk k.
     # Fixed chunk shapes also mean ONE compiled exchange program reused
-    # across stages (no re-padding to a fresh per-stage global max)
-    ici_chunk_rows: int = 1 << 12
+    # across stages (no re-padding to a fresh per-stage global max).
+    # 0 = auto-tune: the scheduler picks the next run's chunk size from
+    # the observed compute/collective overlap_fraction in FabricMetrics
+    # (parallel/fabric.py IciChunkTuner, multiplicative feedback)
+    ici_chunk_rows: int = 0
+    # -- Pallas scan kernel (exec/kernels) --------------------------------
+    # the fused scan->filter->project->partial-agg hot path: "pallas"
+    # requests the hand-written Pallas kernel (decode + prefix-sum
+    # compaction + subtile aggregation in one VMEM-resident grid pass),
+    # "xla" keeps the jnp fused chain, "auto" picks Pallas exactly when
+    # the backend is a real TPU AND the chain is eligible (direct-mode
+    # agg, resident encoded columns, aligned chunks) — off-TPU the
+    # kernel only runs in interpret-mode emulation, which is never a
+    # performance win, so "auto" declines with Backend and tests pin
+    # "pallas" to exercise it.  Ineligibility is metered per scan as
+    # kernelDeclined{reason} runtime-stats counters.  Config key
+    # scan.kernel / session scan_kernel
+    scan_kernel: str = "auto"
+
+
+# legal scan.kernel / scan_kernel values (worker/properties.py and the
+# session-property validation both check against this)
+SCAN_KERNEL_MODES = ("xla", "pallas", "auto")
 
 
 def tuned_config(**overrides) -> "ExecutionConfig":
@@ -1367,6 +1388,14 @@ class PlanCompiler:
             if rs is not None:
                 rs.add(f"fusionDeclined{reason}", 1)
 
+        def _kernel_declined(reason: str) -> None:
+            """Pallas scan-kernel refusals (exec/kernels), metered like
+            the fusion ones: kernelDeclined{Reason} counters tell EXPLAIN
+            ANALYZE why a fused scan ran the XLA chain instead."""
+            rs = self.ctx.runtime_stats
+            if rs is not None:
+                rs.add(f"kernelDeclined{reason}", 1)
+
         def get_fused():
             """Whole-pipeline fusion: when the source is a
             (Filter|Project|Join|SemiJoin)* chain over a device-generated
@@ -1581,6 +1610,35 @@ class PlanCompiler:
                     if basic else None)
             if info is not None:
                 doms, G, strides, kdts, kdicts = info
+                if cfg.scan_kernel == "xla":
+                    _kernel_declined("Disabled")
+                elif cfg.scan_kernel == "auto" \
+                        and jax.default_backend() != "tpu":
+                    # auto is a performance decision: interpret-mode
+                    # emulation never beats the XLA chain off-TPU
+                    # (scan_kernel=pallas pins the kernel regardless)
+                    _kernel_declined("Backend")
+                else:
+                    # Pallas fused scan kernel (exec/kernels): decode +
+                    # filter + prefix-sum compaction + subtile partial
+                    # agg in one grid pass over the surviving chunks.
+                    # Its accumulator state and row counters are
+                    # agg_direct-shaped, so finalize and the operator
+                    # stats spine are shared with the XLA path below.
+                    from .kernels import try_direct_scan_kernel
+                    kres = try_direct_scan_kernel(
+                        chain, aux, specs=specs,
+                        key_names=key_names, strides=strides, G=G,
+                        agg_exprs=_agg_exprs, lowering=low,
+                        cache=fused_cache, declined=_kernel_declined,
+                        runtime_stats=self.ctx.runtime_stats)
+                    if kres is not None:
+                        state, kcounts, n_blocks = kres
+                        counts_out["counts"] = kcounts
+                        counts_out["n_chunks"] = n_blocks
+                        return ops.agg_direct_finalize(
+                            state, specs, key_names, doms, kdts, kdicts,
+                            force_row=not key_names)
 
                 def update(st, b):
                     return ops.agg_direct_update(
@@ -1591,6 +1649,10 @@ class PlanCompiler:
                 return ops.agg_direct_finalize(
                     state, specs, key_names, doms, kdts, kdicts,
                     force_row=not key_names)
+            elif cfg.scan_kernel != "xla":
+                # the kernel only has a direct-mode aggregation shape:
+                # meter the miss so EXPLAIN ANALYZE explains the XLA run
+                _kernel_declined("AggShape")
 
             # static span: closed dictionary/bool domains beyond the grid
             # limit — combined stride code indexes accumulators directly

@@ -43,9 +43,28 @@ class Table:
                       for k, (v, m) in self.cols.items()}, len(idx))
 
 
-def execute_reference(node: P.PlanNode) -> List[List]:
-    """Run a plan, return rows of python values (Decimal for decimals)."""
-    table = _exec(node)
+# when set (execute_reference(stats=...)), _exec fills it with one
+# entry per plan node id: {"rows", "wall_s", "batches", "operatorType"}
+# — the oracle-side twin of the engine's OperatorStats spine, so
+# differential tests can diff the stats SURFACE, not just result rows
+_ACTIVE_STATS: Optional[Dict[str, dict]] = None
+
+
+def execute_reference(node: P.PlanNode,
+                      stats: Optional[Dict[str, dict]] = None) -> List[List]:
+    """Run a plan, return rows of python values (Decimal for decimals).
+
+    Pass a dict as `stats` to collect per-node operator stats: rows is
+    the node's output cardinality, wall_s its INCLUSIVE interpretation
+    wall (the interpreter recurses, so a node's wall covers its
+    subtree), batches is always 1 (the oracle is single-batch)."""
+    global _ACTIVE_STATS
+    prev = _ACTIVE_STATS
+    _ACTIVE_STATS = stats
+    try:
+        table = _exec(node)
+    finally:
+        _ACTIVE_STATS = prev
     names = [v.name for v in node.output_variables]
     types = [v.type for v in node.output_variables]
     return _to_rows(table, names, types)
@@ -105,7 +124,21 @@ def _exec(node: P.PlanNode) -> Table:
     fn = globals().get("_exec_" + type(node).__name__)
     if fn is None:
         raise NotImplementedError(type(node).__name__)
-    return fn(node)
+    if _ACTIVE_STATS is None:
+        return fn(node)
+    import time
+    t0 = time.perf_counter()  # lint: allow-wall-clock
+    table = fn(node)
+    wall = time.perf_counter() - t0  # lint: allow-wall-clock
+    nid = getattr(node, "id", None)
+    if nid is not None:
+        _ACTIVE_STATS[str(nid)] = {
+            "rows": int(table.n),
+            "wall_s": wall,
+            "batches": 1,
+            "operatorType": type(node).__name__.replace("Node", ""),
+        }
+    return table
 
 
 def _exec_TableScanNode(node: P.TableScanNode) -> Table:

@@ -454,10 +454,17 @@ class LocalQueryRunner:
                            [[text]])
 
     def execute_reference(self, sql: str) -> QueryResult:
-        """Same query through the numpy reference interpreter (the oracle)."""
+        """Same query through the numpy reference interpreter (the oracle).
+
+        Per-node {rows, wall_s, batches} land in
+        `last_reference_operator_stats` keyed by plan-node id, so
+        differential tests can diff the stats surface against the
+        engine's EXPLAIN ANALYZE / QueryInfo counters too."""
         from .reference import execute_reference
         output = self.plan(sql)
-        rows = execute_reference(output)
+        stats: dict = {}
+        rows = execute_reference(output, stats=stats)
+        self.last_reference_operator_stats = stats
         types = [v.type for v in output.outputs]
         return QueryResult(output.column_names, types, rows)
 

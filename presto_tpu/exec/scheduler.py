@@ -738,7 +738,12 @@ class InProcessScheduler:
             [b.mask.sum() for b in present])
         max_live = max((int(c) for c in counts), default=0)
 
-        C = max(1, int(self.config.exec_config.ici_chunk_rows))
+        # explicit exchange.ici-chunk-rows pins the chunk size; the
+        # default (0) asks the tuner, which adapts the NEXT run's size
+        # from this run's observed compute/collective overlap
+        from ..parallel.fabric import ICI_CHUNK_TUNER
+        rows_cfg = int(self.config.exec_config.ici_chunk_rows)
+        C = rows_cfg if rows_cfg >= 1 else ICI_CHUNK_TUNER.chunk_rows()
         n_chunks = max(1, -(-max_live // C))
         B = n_chunks * C
 
@@ -811,6 +816,11 @@ class InProcessScheduler:
         FABRIC_METRICS.record("ici", exchanges=1, chunks=n_chunks,
                               bytes_moved=bytes_moved,
                               exchange_wall_s=wall)
+        if rows_cfg < 1:
+            # auto-tune feedback: the consumer-side walls land in
+            # FABRIC_METRICS as the stage drains, so the fraction seen
+            # here reflects completed exchanges up to this one
+            ICI_CHUNK_TUNER.observe(FABRIC_METRICS.overlap_fraction("ici"))
         self.stats.add("exchangeFabricIciBytes", bytes_moved, "BYTE")
         self.stats.add("exchangeFabricIciChunks", n_chunks)
         self.stats.add("exchangeFabricIciDispatchWallNanos",

@@ -138,3 +138,53 @@ class FabricMetrics:
 
 
 FABRIC_METRICS = FabricMetrics()
+
+
+class IciChunkTuner:
+    """Feedback controller for the chunked ICI exchange granularity.
+
+    When `exchange.ici-chunk-rows` is left unset (ExecutionConfig value
+    0), the scheduler asks this tuner for each run's chunk size and
+    feeds back the observed compute/collective `overlap_fraction` from
+    FABRIC_METRICS after the exchange completes.  Simple multiplicative
+    feedback, clamped:
+
+      overlap < LOW    the consumer spent a large share of its drain
+                       wall BLOCKED on collectives -> halve the chunk:
+                       finer chunks start compute sooner and give the
+                       pipeline more in-flight collectives to hide
+      overlap > HIGH   collectives are already hidden behind compute ->
+                       double the chunk to amortize per-chunk dispatch
+                       (fewer all_to_all launches for the same rows)
+
+    Hysteresis between LOW and HIGH holds the size steady.  Explicit
+    config values bypass the tuner entirely (properties layer rejects
+    explicit values < 1, so 0 is only reachable as the default)."""
+
+    LOW = 0.5
+    HIGH = 0.9
+    MIN_ROWS = 1 << 10
+    MAX_ROWS = 1 << 16
+    DEFAULT_ROWS = 1 << 12
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._rows = self.DEFAULT_ROWS
+
+    def chunk_rows(self) -> int:
+        with self._lock:
+            return self._rows
+
+    def observe(self, overlap_fraction: float) -> None:
+        with self._lock:
+            if overlap_fraction < self.LOW:
+                self._rows = max(self.MIN_ROWS, self._rows // 2)
+            elif overlap_fraction > self.HIGH:
+                self._rows = min(self.MAX_ROWS, self._rows * 2)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._rows = self.DEFAULT_ROWS
+
+
+ICI_CHUNK_TUNER = IciChunkTuner()

@@ -163,6 +163,17 @@ def format_analyze_footer(runtime_stats) -> str:
     if declined:
         body = ", ".join(f"{k}: {v}" for k, v in sorted(declined.items()))
         lines.append(f"Fusion declined: {{{body}}}")
+    # the Pallas scan-kernel twin of the fusion counters: how many fused
+    # scans ran the hand-written kernel, and why the rest stayed on the
+    # XLA chain (exec/kernels KERNEL_DECLINE_REASONS)
+    kdeclined = {k[len("kernelDeclined"):]: int(v["sum"])
+                 for k, v in rs.items() if k.startswith("kernelDeclined")}
+    if kdeclined:
+        body = ", ".join(f"{k}: {v}" for k, v in sorted(kdeclined.items()))
+        lines.append(f"Scan kernel declined: {{{body}}}")
+    kp = rs.get("kernelScanPrograms")
+    if kp:
+        lines.append(f"Pallas scan kernels: {int(kp['sum'])}")
     fw = rs.get("fusedProgramWallNanos")
     if fw:
         lines.append(f"Fused program wall: {fw['sum'] / 1e6:,.1f}ms "

@@ -113,11 +113,22 @@ def execution_config_from_properties(props: Dict[str, str],
                 f"exchange.fabric must be one of {FABRICS}, got {fabric!r}")
         kw["exchange_fabric"] = fabric
     if "exchange.ici-chunk-rows" in props:
+        # an EXPLICIT property pins the chunk size and must be a real
+        # row count; auto-tuning is requested by OMITTING the key (the
+        # ExecutionConfig default of 0)
         n = int(props["exchange.ici-chunk-rows"])
         if n < 1:
             raise ValueError(
                 f"exchange.ici-chunk-rows must be >= 1, got {n}")
         kw["ici_chunk_rows"] = n
+    if "scan.kernel" in props:
+        from ..exec.pipeline import SCAN_KERNEL_MODES
+        mode = props["scan.kernel"].strip().lower()
+        if mode not in SCAN_KERNEL_MODES:
+            raise ValueError(
+                f"scan.kernel must be one of {SCAN_KERNEL_MODES}, "
+                f"got {mode!r}")
+        kw["scan_kernel"] = mode
     if "exchange.max-response-size" in props:
         kw["exchange_max_response_bytes"] = parse_data_size(
             props["exchange.max-response-size"])
@@ -202,7 +213,11 @@ class SystemConfig:
         # shuffle fabric selection + ICI chunk granularity
         # (parallel/fabric.py; exec/scheduler.py _ici_exchange)
         ("exchange.fabric", str, "auto"),
-        ("exchange.ici-chunk-rows", int, 1 << 12),
+        # 0 = auto-tune from the observed compute/collective overlap
+        # (parallel/fabric.py IciChunkTuner); explicit values pin it
+        ("exchange.ici-chunk-rows", int, 0),
+        # Pallas fused scan kernel selection (exec/kernels)
+        ("scan.kernel", str, "auto"),
         ("announcement-interval-ms", int, 1000),
         ("heartbeat-interval-ms", int, 1000),
         ("async-data-cache-enabled", bool, False),

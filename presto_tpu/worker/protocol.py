@@ -344,4 +344,12 @@ def apply_session_properties(config, session: Dict[str, str]):
                 f"plan_validation must be one of {VALIDATION_MODES}, "
                 f"got {mode!r}")
         kw["plan_validation"] = mode
+    if "scan_kernel" in session:
+        mode = str(session["scan_kernel"]).strip().lower()
+        from ..exec.pipeline import SCAN_KERNEL_MODES
+        if mode not in SCAN_KERNEL_MODES:
+            raise ValueError(
+                f"scan_kernel must be one of {SCAN_KERNEL_MODES}, "
+                f"got {mode!r}")
+        kw["scan_kernel"] = mode
     return dataclasses.replace(config, **kw) if kw else config

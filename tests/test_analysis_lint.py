@@ -12,11 +12,11 @@ import sys
 
 import pytest
 
-from presto_tpu.analysis.lint import (ALL_LINT_CODES, PRAGMA, SYNC_ASARRAY,
-                                      SYNC_BRANCH, SYNC_CAST, SYNC_EXPLICIT,
-                                      SYNC_NETWORK, SYNC_WALLCLOCK,
-                                      WALL_PRAGMA, lint_or_raise, lint_paths,
-                                      lint_source)
+from presto_tpu.analysis.lint import (ALL_LINT_CODES, KERNEL_INTERPRET,
+                                      PRAGMA, SYNC_ASARRAY, SYNC_BRANCH,
+                                      SYNC_CAST, SYNC_EXPLICIT, SYNC_NETWORK,
+                                      SYNC_WALLCLOCK, WALL_PRAGMA,
+                                      lint_or_raise, lint_paths, lint_source)
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -286,8 +286,58 @@ def test_lint_routes_through_error_taxonomy(tmp_path):
     lint_or_raise([os.path.join(REPO, "presto_tpu")])  # clean: no raise
 
 
+def test_interpret_literal_flagged_outside_shim():
+    """KERNEL001: an interpret=True literal outside the CPU-fallback shim
+    would make a TPU build silently run Pallas kernels interpreted."""
+    src = ("from jax.experimental import pallas as pl\n"
+           "def f(kernel, spec, shapes):\n"
+           "    return pl.pallas_call(kernel, grid_spec=spec,\n"
+           "                          out_shape=shapes, interpret=True)\n")
+    findings = lint_source(src, "presto_tpu/exec/kernels/scan_kernel.py")
+    assert KERNEL_INTERPRET in _codes(findings)
+    # ...and there is no pragma escape
+    src2 = ("from jax.experimental import pallas as pl\n"
+            "def f(kernel, spec, shapes):\n"
+            "    return pl.pallas_call(\n"
+            "        kernel, grid_spec=spec,  # lint: allow-host-sync\n"
+            "        out_shape=shapes,\n"
+            "        interpret=True)  # lint: allow-wall-clock\n")
+    findings = lint_source(src2, "presto_tpu/exec/kernels/scan_kernel.py")
+    assert KERNEL_INTERPRET in _codes(findings)
+
+
+def test_interpret_kwargs_store_flagged():
+    findings = lint_source(
+        "def f(kwargs):\n"
+        "    kwargs['interpret'] = True\n",
+        "presto_tpu/exec/pipeline.py")
+    assert KERNEL_INTERPRET in _codes(findings)
+
+
+def test_interpret_allowed_in_shim_only():
+    src = ("def pallas_call(kernel, **kwargs):\n"
+           "    kwargs['interpret'] = True\n"
+           "    return kernel(**kwargs)\n")
+    assert lint_source(src, "presto_tpu/exec/kernels/shim.py") == []
+    assert lint_source(src, "presto_tpu/exec/kernels/other.py") != []
+
+
+def test_kernels_package_is_sync_and_wall_scoped():
+    """exec/kernels/ files fall under the SYNC + wall-clock rules (the
+    path markers cover presto_tpu/exec/ recursively)."""
+    findings = lint_source(
+        "import time\n"
+        "import jax.numpy as jnp\n"
+        "def f(x):\n"
+        "    t0 = time.perf_counter()\n"
+        "    return jnp.sum(x).item(), t0\n",
+        "presto_tpu/exec/kernels/scan_kernel.py")
+    assert {SYNC_EXPLICIT, SYNC_WALLCLOCK} <= _codes(findings)
+
+
 def test_all_codes_are_exercised_above():
     assert set(ALL_LINT_CODES) == {SYNC_EXPLICIT, SYNC_CAST, SYNC_ASARRAY,
-                                   SYNC_BRANCH, SYNC_NETWORK, SYNC_WALLCLOCK}
+                                   SYNC_BRANCH, SYNC_NETWORK, SYNC_WALLCLOCK,
+                                   KERNEL_INTERPRET}
     assert PRAGMA == "lint: allow-host-sync"
     assert WALL_PRAGMA == "lint: allow-wall-clock"

@@ -327,13 +327,38 @@ def test_exchange_fabric_properties_parsing():
             {"exchange.ici-chunk-rows": "0"})
     sc = SystemConfig({})
     assert sc.get("exchange.fabric") == "auto"
-    assert sc.get("exchange.ici-chunk-rows") == 1 << 12
+    # default is 0 = auto-tune (parallel/fabric.py IciChunkTuner);
+    # explicit values still must be >= 1 (the ValueError above)
+    assert sc.get("exchange.ici-chunk-rows") == 0
 
 
 def test_execution_config_defaults():
     cfg = ExecutionConfig()
     assert cfg.exchange_fabric == "auto"
-    assert cfg.ici_chunk_rows >= 1
+    assert cfg.ici_chunk_rows == 0  # 0 = tuner-driven
+
+
+def test_ici_chunk_tuner_feedback():
+    """Multiplicative feedback: poor overlap shrinks the chunk (finer
+    pipelining), near-perfect overlap grows it (amortized dispatch),
+    mid-range holds steady, and both directions clamp."""
+    from presto_tpu.parallel.fabric import IciChunkTuner
+    t = IciChunkTuner()
+    assert t.chunk_rows() == IciChunkTuner.DEFAULT_ROWS
+    t.observe(0.1)
+    assert t.chunk_rows() == IciChunkTuner.DEFAULT_ROWS // 2
+    t.observe(0.7)  # hysteresis band: unchanged
+    assert t.chunk_rows() == IciChunkTuner.DEFAULT_ROWS // 2
+    t.observe(0.95)
+    assert t.chunk_rows() == IciChunkTuner.DEFAULT_ROWS
+    for _ in range(30):
+        t.observe(0.0)
+    assert t.chunk_rows() == IciChunkTuner.MIN_ROWS
+    for _ in range(30):
+        t.observe(1.0)
+    assert t.chunk_rows() == IciChunkTuner.MAX_ROWS
+    t.reset()
+    assert t.chunk_rows() == IciChunkTuner.DEFAULT_ROWS
 
 
 @needs_mesh
