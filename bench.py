@@ -100,6 +100,24 @@ WHERE shipdate >= DATE '1994-01-01'
   AND quantity < 24
 """
 
+# high-cardinality grouped Q1 variant: the Q1 aggregate core re-keyed on
+# orderkey % BENCH_Q1G_GROUPS (default 4096), so the scan kernel's
+# grouped modes (span / hashed open addressing) carry the aggregation
+# instead of the direct G<=64 grid; {groups} substituted in main()
+Q1G = """
+SELECT gkey,
+       sum(quantity) AS sum_qty,
+       sum(extendedprice) AS sum_base_price,
+       sum(extendedprice * (1 - discount)) AS sum_disc_price,
+       avg(discount) AS avg_disc,
+       count(*) AS count_order
+FROM (SELECT orderkey % {groups} AS gkey, quantity, extendedprice,
+             discount, shipdate
+      FROM lineitem)
+WHERE shipdate <= DATE '1998-12-01' - INTERVAL '90' DAY
+GROUP BY gkey
+"""
+
 # grouped-eligible: aggregation keyed on the lineitem/orders bucket
 # column, so forced lifespans (BENCH_GROUPED_LIFESPANS >= 2) run the
 # bucket-at-a-time pipeline and expose the prefetch overlap stats
@@ -381,7 +399,10 @@ def main():
     if qname == "serve":
         return bench_serve(runs)
     sf = float(os.environ.get("BENCH_SF", "10"))
-    sql = {"q1": Q1, "q6": Q6, "q6z": Q6, "q3g": Q3G}[qname]
+    sql = {"q1": Q1, "q6": Q6, "q6z": Q6, "q3g": Q3G, "q1g": Q1G}[qname]
+    if qname == "q1g":
+        groups = int(os.environ.get("BENCH_Q1G_GROUPS", "4096"))
+        sql = sql.format(groups=groups)
     if qname == "q6z":
         from presto_tpu.connectors import tpch as _t
         frac = float(os.environ.get("BENCH_Q6Z_FRACTION", "0.02"))
@@ -439,6 +460,7 @@ def main():
         "q6": 4 + 8 + 8 + 8,               # shipdate,disc,price,qty
         "q6z": 4 + 8 + 8 + 8 + 8,          # q6 + orderkey
         "q3g": 8 + 8 + 8 + 4,              # orderkey,price,disc,shipdate
+        "q1g": 8 + 8 + 8 + 8 + 4,          # orderkey,qty,price,disc,shipdate
     }[qname]
     achieved_gbps = rows_per_sec * col_bytes / 1e9
     hbm_peak_gbps = float(os.environ.get("BENCH_HBM_PEAK_GBPS", "819"))
@@ -492,7 +514,7 @@ def main():
     # sides; kernel_programs counts fused scan programs that actually took
     # the Pallas path (0 under xla or when every scan declined), and
     # declined carries the per-reason counters for ineligible scans.
-    if qname in ("q1", "q6", "q6z"):
+    if qname in ("q1", "q6", "q6z", "q1g"):
         import dataclasses
         kcmp = {}
         for mode in ("pallas", "xla"):

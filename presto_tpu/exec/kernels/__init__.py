@@ -7,14 +7,22 @@ ExecutionConfig knob.  CPU runs execute the same kernels through Pallas
 interpret mode (kernels/shim.py, the only sanctioned `interpret=True`
 site) so tier-1 tests cover the kernel path.
 """
-from .scan_kernel import (KERNEL_DECLINE_REASONS, SUBTILE_ROWS,
-                          build_direct_runner, try_direct_scan_kernel)
+from .scan_kernel import (DMA_MODES, KERNEL_DECLINE_REASONS,
+                          KERNEL_HASH_MAX_SLOTS, KERNEL_SPAN_MAX_GROUPS,
+                          SUBTILE_ROWS, build_direct_runner,
+                          try_direct_scan_kernel)
+from .grouped import build_hash_runner, try_grouped_scan_kernel
 from .shim import kernel_interpret
 
 __all__ = [
+    "DMA_MODES",
     "KERNEL_DECLINE_REASONS",
+    "KERNEL_HASH_MAX_SLOTS",
+    "KERNEL_SPAN_MAX_GROUPS",
     "SUBTILE_ROWS",
     "build_direct_runner",
+    "build_hash_runner",
     "try_direct_scan_kernel",
+    "try_grouped_scan_kernel",
     "kernel_interpret",
 ]

@@ -9,18 +9,25 @@ kernel is the hand-written hot path the ROADMAP's HBM-gap item calls
 for:
 
   grid      one step per SURVIVING block-aligned chunk.  The kernel
-            re-grids the scan's split ranges onto cap-aligned blocks
-            (aggregation is order-insensitive, so any partition of the
-            same row set is legal) because Pallas block specs index
-            whole blocks; each grid entry carries its block index plus
-            a [lo, hi) live row range as scalar-prefetch operands.
-            Zone-map pruning runs over THIS grid, so pruned blocks
-            never issue DMAs -- they are simply not in the grid.
-  decode    ResidentColumn blocks stream out of HBM in ENCODED form via
-            block specs (Pallas double-buffers the HBM->VMEM copies
-            across grid steps); dict gather / RLE binary search runs in
-            vector registers -- late materialization with the same
-            semantics as ResidentColumn.slice_decode
+            re-grids the scan's split ranges onto its OWN power-of-two
+            block size (block_rows_for: the pow2 ceiling of the chain's
+            chunk capacity — aggregation is order-insensitive, so any
+            partition of the same row set is legal); each grid entry
+            carries its block index plus a [lo, hi) live row range as
+            scalar-prefetch operands, which also masks short/misaligned
+            chunk tails (the launcher zero-pads encoded arrays up to the
+            grid, so there is no ChunkAlignment decline).  Zone-map
+            pruning runs over THIS grid, so pruned blocks never issue
+            DMAs -- they are simply not in the grid.
+  decode    ResidentColumn blocks stream out of HBM in ENCODED form.
+            `dma = single` uses Pallas block specs (the implicit
+            double-buffering Pallas applies across grid steps);
+            `dma = double` stages the per-row slabs MANUALLY: block k+1's
+            encoded slabs start their pltpu.make_async_copy into the
+            alternate VMEM buffer while block k decodes/aggregates
+            (_stage_slabs).  Dict gather / RLE binary search then runs
+            in vector registers -- late materialization with the same
+            semantics as ResidentColumn.slice_decode.
   filter    the chain's own predicate/project expressions, lowered by
             the SAME exec/lowering.Lowering the XLA chain uses -- the
             kernel cannot drift from the engine semantics.  Bound
@@ -31,10 +38,13 @@ for:
             selection mask drives an in-VMEM scatter compaction (no XLA
             gather round-trip), after which the aggregation update only
             touches ceil(live/SUBTILE) subtiles instead of the full tile
-  agg       operators.agg_direct_update over compacted subtiles; the
+  agg       operators.agg_direct_update (one-hot grid, G<=64) or
+            operators.agg_span_update (packed scatter, grouped span
+            mode -- kernels/grouped.py) over compacted subtiles; the
             packed int64/float64 accumulators live in the kernel's
-            output block across grid steps and feed
-            operators.agg_direct_finalize unchanged
+            output block across grid steps and feed the operators
+            finalize path unchanged.  Hashed grouped shapes build their
+            own kernel in kernels/grouped.py from these helpers.
 
 Device-side row counters (scan live rows + live rows after every chain
 step) accumulate in an output block exactly like the XLA chain's
@@ -65,22 +75,45 @@ from . import shim
 
 # Eligibility refusals, surfaced as kernelDeclined{reason} RuntimeStats
 # counters (exec/pipeline.py _kernel_declined) -- the kernel twin of the
-# fusionDeclined{...} family.  "Disabled" and "AggShape" are recorded by
-# the pipeline itself (knob off / no direct-mode aggregation to fuse
-# into); the rest are produced here.
+# fusionDeclined{...} family.  "Disabled", "AggFunctionShape" and
+# "Backend"(auto) are recorded by the pipeline itself; the rest are
+# produced here / in kernels/grouped.py.
 KERNEL_DECLINE_REASONS = (
-    "Disabled",            # scan.kernel = xla
-    "AggShape",            # aggregation not direct-mode (G<=64) eligible
-    "Backend",             # platform is neither tpu nor cpu-interpret
-    "PlanShape",           # chain has join/semi/uid steps
-    "ColumnsNotResident",  # a scanned column is not HBM-resident encoded
-    "ChunkAlignment",      # encoded arrays cannot tile the block grid
+    "Disabled",              # scan.kernel = xla
+    "AggFunctionShape",      # non-BASIC aggregate functions (moment/corr/
+    #                          percentile/HLL state has no kernel stacks)
+    "AggGroupCardinality",   # group count beyond the VMEM accumulator
+    #                          gates (span > KERNEL_SPAN_MAX_GROUPS and
+    #                          hash estimate/collision > KERNEL_HASH_MAX_SLOTS)
+    "Backend",               # platform is neither tpu nor cpu-interpret
+    "PlanShape",             # chain has join/semi/uid steps
+    "ColumnsNotResident",    # a scanned column is not HBM-resident encoded
+    "ChunkAlignment",        # RETIRED: short/misaligned tails are padded and
+    #                          lane-masked since the grouped-kernel PR; the
+    #                          name stays one release so dashboards keyed on
+    #                          the counter read 0 instead of erroring
 )
 
 # compacted rows are aggregated in subtiles of this many rows: the
 # G x SUBTILE one-hot grid stays small while a selective filter skips
 # most subtiles entirely (n_sub = ceil(live/SUBTILE) loop trips)
 SUBTILE_ROWS = 2048
+# grouped modes scatter instead of building the one-hot grid, so their
+# subtiles can be wider (fewer fori_loop trips over the probe rounds)
+GROUPED_SUBTILE_ROWS = 8192
+
+# VMEM accumulator gates for the grouped modes (kernels/grouped.py).
+# span: G * ~(1 + n_specs * 2) int64/float64 rows must sit in VMEM next
+# to the decoded block; 32K groups * ~10 accumulator rows * 8B = 2.5MB.
+# hash: the open-addressing table carries keyhash/occupied/key values/
+# per-spec accumulators per slot; 64K slots * ~15 arrays * 8B = 7.5MB.
+# Both leave headroom under a 16MB VMEM core budget at 64K-row blocks;
+# truly huge G declines with AggGroupCardinality and runs the XLA chain.
+KERNEL_SPAN_MAX_GROUPS = 1 << 15
+KERNEL_HASH_MAX_SLOTS = 1 << 16
+
+# scan.kernel-dma knob values (ExecutionConfig.scan_kernel_dma)
+DMA_MODES = ("single", "double")
 
 
 def _blelloch_exclusive(x):
@@ -126,8 +159,8 @@ class _Runner(NamedTuple):
     fn: Callable                 # jitted launcher
     init_i: object               # (Ni, G) int64 accumulator init rows
     init_f: object               # (max(Nf,1), G) float64 init rows
-    int_names: Tuple[str, ...]   # acc_i row -> agg_direct state key
-    flt_names: Tuple[str, ...]   # acc_f row -> agg_direct state key
+    int_names: Tuple[str, ...]   # acc_i row -> agg state key
+    flt_names: Tuple[str, ...]   # acc_f row -> agg state key
 
 
 def _chunk_block(i, bidx, lo, hi):
@@ -176,14 +209,26 @@ def _block_pruned(zone_maps, pushdown, params, pos: int,
     return False
 
 
+def block_rows_for(cap: int) -> int:
+    """The kernel's block size for a chain with chunk capacity `cap`:
+    the power-of-two ceiling.  The Blelloch scan pairs elements level by
+    level, so tiles must be pow2; re-gridding is legal because
+    aggregation is order-insensitive, and rows between a split end and
+    the block end are lane-masked via the [lo, hi) scalar-prefetch range
+    (the launcher zero-pads encoded arrays to the grid, so a short last
+    chunk no longer declines with ChunkAlignment)."""
+    return 1 << max(0, int(cap - 1).bit_length())
+
+
 def aligned_grid(meta: dict, block_rows: int,
                  params) -> List[Tuple[int, int, int]]:
     """(block index, lo, hi) grid entries tiling the scan's split
-    ranges with cap-aligned blocks; [lo, hi) is the block-relative live
-    row range.  A block straddling two disjoint owned ranges yields two
-    entries (grid steps accumulate, so revisiting a block is sound).
-    Zone-map-pruned entries are dropped HERE -- they never reach the
-    grid, so their HBM blocks are never DMA'd."""
+    ranges with block_rows-aligned blocks; [lo, hi) is the
+    block-relative live row range.  A block straddling two disjoint
+    owned ranges yields two entries (grid steps accumulate, so
+    revisiting a block is sound).  Zone-map-pruned entries are dropped
+    HERE -- they never reach the grid, so their HBM blocks are never
+    DMA'd."""
     zone_maps = meta.get("zone_maps") or {}
     pushdown = meta.get("pushdown") or []
     entries: List[Tuple[int, int, int]] = []
@@ -199,23 +244,297 @@ def aligned_grid(meta: dict, block_rows: int,
     return entries
 
 
+# ---------------------------------------------------------------------------
+# shared kernel-body helpers (direct + grouped runners)
+# ---------------------------------------------------------------------------
+
+def staged_indices(names, kinds) -> Tuple[int, ...]:
+    """Flat input indices of the PER-ROW encoded arrays (plain data,
+    dict codes) -- the arrays whose blocks stream per grid step and are
+    therefore candidates for manual double-buffered DMA staging.  Whole
+    arrays (dict values, RLE runs) are VMEM-resident block specs in
+    both modes."""
+    idx, r = [], 0
+    for name in names:
+        kind = kinds[name]
+        if kind == "plain":
+            idx.append(r)
+            r += 1
+        elif kind == "dict":
+            idx.append(r)
+            r += 2
+        else:                                        # rle: whole arrays
+            r += 2
+    return tuple(idx)
+
+
+def _stage_slabs(col_refs, staged, scratch, sem, bidx_ref, block_rows):
+    """Manual double-buffered DMA staging of the current grid block's
+    per-row slabs: start block k+1's HBM->VMEM copies into the alternate
+    buffer BEFORE waiting on block k's own, so the next block's copy
+    overlaps this block's decode/aggregate compute (the pallas guide's
+    double-buffering pattern, driven by the scalar-prefetch block index
+    array).  Returns {flat input index: slab} for the current step."""
+    i = pl.program_id(0)
+    n = pl.num_programs(0)
+
+    def copy(slot, step, j):
+        ref = col_refs[staged[j]]
+        return pltpu.make_async_copy(
+            ref.at[pl.ds(bidx_ref[step] * block_rows, block_rows)],
+            scratch[j].at[slot], sem.at[slot, j])
+
+    @pl.when(i == 0)
+    def _warm_up():
+        for j in range(len(staged)):
+            copy(0, 0, j).start()
+
+    @pl.when(i + 1 < n)
+    def _prefetch_next():
+        for j in range(len(staged)):
+            copy((i + 1) % 2, i + 1, j).start()
+
+    slot = i % 2
+    slabs = {}
+    for j in range(len(staged)):
+        copy(slot, i, j).wait()
+        slabs[staged[j]] = scratch[j][slot]
+    return slabs
+
+
+def decode_columns(names, kinds, dicts, col_refs, slabs, pos, idx0,
+                   live) -> Dict[str, Column]:
+    """ResidentColumn.slice_decode semantics over the block's VMEM
+    slabs: plain read, dict gather, RLE binary search, then the scan's
+    dead-row zeroing.  `slabs` overrides col_refs for manually staged
+    per-row arrays (dma = double); empty in single mode."""
+    def read(r):
+        return slabs[r] if r in slabs else col_refs[r][...]
+
+    cols: Dict[str, Column] = {}
+    r = 0
+    for name in names:
+        kind = kinds[name]
+        if kind == "plain":
+            v = read(r)
+            r += 1
+        elif kind == "dict":
+            codes = read(r)
+            values = col_refs[r + 1][...]
+            r += 2
+            v = values[codes.astype(jnp.int32)]
+        else:                                    # rle
+            run_values = col_refs[r][...]
+            run_starts = col_refs[r + 1][...]
+            r += 2
+            ri = _bisect_right(run_starts, pos + idx0) - 1
+            ri = jnp.clip(ri, 0, run_values.shape[0] - 1)
+            v = run_values[ri]
+        v = jnp.where(live, v, jnp.zeros((), v.dtype))
+        cols[name] = Column(v, None, dicts.get(name))
+    return cols
+
+
+def run_chain_steps(batch: Batch, live, steps, lowering, params_k,
+                    n_params):
+    """The chain's own filter/project/rename steps, lowered by the
+    engine's Lowering (shared with the XLA chain), with the same
+    per-step live-row counters chain.make(with_counts=True) emits.
+    The bound-parameter vector rides along for step expressions exactly
+    as in FusedChain.make's _pb (aggregation input expressions see a
+    param-less batch on both paths)."""
+    def _pb(b):
+        return b.with_params(params_k) if n_params else b
+
+    counts = [jnp.sum(live)]
+    for step in steps:
+        kind = step[0]
+        if kind == "filter":
+            batch = ops.apply_filter(
+                batch, lowering.eval(step[1], _pb(batch)))
+        elif kind == "project":
+            pb = _pb(batch)
+            batch = Batch({v2.name: lowering.eval(e, pb)
+                           for v2, e in step[1]}, batch.mask)
+        else:                                    # rename
+            batch = Batch({o: batch.columns[src]
+                           for o, src in step[1]}, batch.mask)
+        counts.append(jnp.sum(batch.mask))
+    return batch, counts
+
+
+def compact_columns(mask, cap, named):
+    """Prefix-sum compaction: exclusive Blelloch scan of the mask gives
+    each live row its packed slot; dead rows scatter to index cap and
+    drop.  `named` is a list of (key, 1-D array) pairs; returns (live
+    total, {key: compacted array}).  Downstream aggregation then loops
+    over live subtiles only."""
+    pref = _blelloch_exclusive(mask.astype(jnp.int32))
+    total = pref[cap - 1] + mask[cap - 1].astype(jnp.int32)
+    dest = jnp.where(mask, pref, cap)
+    out = {k: jnp.zeros(cap, dtype=a.dtype).at[dest].set(a, mode="drop")
+           for k, a in named}
+    return total, out
+
+
+def agg_compaction_entries(specs, agg_cols):
+    """(key, array) compaction entries for the aggregate input columns
+    ("v:" values / "n:" nulls per spec output; count_star has none)."""
+    named = []
+    for spec in specs:
+        col = agg_cols.get(spec.output)
+        if col is None:                          # count_star
+            continue
+        named.append(("v:" + spec.output, col.values))
+        if col.nulls is not None:
+            named.append(("n:" + spec.output, col.nulls))
+    return named
+
+
+def subtile_agg_inputs(compacted, specs, off, ts):
+    """Slice one subtile's aggregate inputs out of the compacted
+    columns (dynamic_slice keeps the loop body shape-static)."""
+    sa: Dict[str, Optional[Column]] = {}
+    for spec in specs:
+        cv = compacted.get("v:" + spec.output)
+        if cv is None:
+            sa[spec.output] = None
+            continue
+        sv = jax.lax.dynamic_slice(cv, (off,), (ts,))
+        cn = compacted.get("n:" + spec.output)
+        sn = (jax.lax.dynamic_slice(cn, (off,), (ts,))
+              if cn is not None else None)
+        sa[spec.output] = Column(sv, sn)
+    return sa
+
+
+def encoded_in_specs(names, kinds, flat, block_rows, staged):
+    """BlockSpecs for the flat encoded-array inputs, in staged_indices
+    order.  Per-row arrays stream per grid block (single mode) or sit in
+    ANY memory space awaiting the kernel's manual DMA (double mode);
+    whole arrays are always whole VMEM blocks."""
+    row_spec = (pl.BlockSpec(memory_space=pltpu.ANY) if staged
+                else pl.BlockSpec((block_rows,), _chunk_block))
+    in_specs: List = []
+    r = 0
+    for name in names:
+        kind = kinds[name]
+        if kind == "plain":
+            in_specs.append(row_spec)
+            r += 1
+        elif kind == "dict":
+            in_specs += [row_spec,
+                         pl.BlockSpec(flat[r + 1].shape, _whole_1d)]
+            r += 2
+        else:                                    # rle
+            in_specs += [pl.BlockSpec(flat[r].shape, _whole_1d),
+                         pl.BlockSpec(flat[r + 1].shape, _whole_1d)]
+            r += 2
+    return in_specs
+
+
+def dma_scratch_shapes(staged, flat, block_rows):
+    """Double-buffer VMEM scratch (2 slots per staged array) plus one
+    (2, n_staged) DMA semaphore array for _stage_slabs."""
+    shapes = [pltpu.VMEM((2, block_rows), flat[r].dtype) for r in staged]
+    shapes.append(pltpu.SemaphoreType.DMA((2, len(staged))))
+    return shapes
+
+
+def chain_eligible(chain, aux, declined):
+    """Gates shared by every kernel mode: backend, chain step shapes,
+    HBM residency.  Returns (cached, colmap) or None after metering one
+    decline."""
+    if jax.default_backend() not in ("cpu", "tpu"):
+        declined("Backend")
+        return None
+    if any(s[0] not in ("filter", "project", "rename")
+           for s in chain.steps):
+        declined("PlanShape")
+        return None
+    cached = aux[0] or {}
+    colmap = chain.scan_meta.get("colmap") or {}
+    if not colmap or any(colmap[n] not in cached for n in colmap):
+        declined("ColumnsNotResident")
+        return None
+    return cached, colmap
+
+
+def gather_encoded_arrays(cached, colmap, names, need, cache):
+    """The flat encoded-array inputs in staged_indices order, with
+    per-row arrays zero-padded up to `need` rows (the grid's last block
+    end) when the store's build-time capacity falls short -- padded
+    lanes are dead by the [lo, hi) mask, so a short tail never declines.
+    Pads are cached per (column, need) and invalidated when the store
+    regenerates the underlying array (LRU eviction)."""
+    flat: List = []
+    for name in names:
+        rc = cached[colmap[name]]
+        arrs = tuple(rc.arrays)
+        if rc.kind in ("plain", "dict") and arrs[0].shape[0] < need:
+            ck = ("kernel_pad", colmap[name], need)
+            hit = cache.get(ck)
+            if hit is None or hit[0] is not arrs[0]:
+                hit = (arrs[0],
+                       jnp.pad(arrs[0], (0, need - arrs[0].shape[0])))
+                cache[ck] = hit
+            arrs = (hit[1],) + arrs[1:]
+        flat += list(arrs)
+    return tuple(flat)
+
+
+def meter_kernel_run(runtime_stats, n_blocks, n_staged, dma) -> None:
+    """One kernelScanPrograms tick per launched kernel; in double-DMA
+    mode also the structural overlap fraction: every staged slab copy
+    after the first block's was issued while the PREVIOUS block
+    computed, so prefetched/staged = (n_blocks-1)/n_blocks of the DMA
+    traffic overlapped compute.  (A wall-clock overlap measure needs the
+    real-TPU re-run the ROADMAP tracks; the structural fraction is
+    deterministic, so tests and dashboards can pin it.)"""
+    if runtime_stats is None:
+        return
+    runtime_stats.add("kernelScanPrograms", 1)
+    if dma == "double" and n_staged and n_blocks:
+        staged_copies = n_blocks * n_staged
+        prefetched = (n_blocks - 1) * n_staged
+        runtime_stats.add("kernelDmaStagedBlocks", staged_copies)
+        runtime_stats.add("kernelDmaPrefetchedBlocks", prefetched)
+        runtime_stats.add("kernelDmaOverlapFraction",
+                          prefetched / staged_copies)
+
+
+# ---------------------------------------------------------------------------
+# direct / span runner (stacked int64+float64 accumulator outputs)
+# ---------------------------------------------------------------------------
+
 def build_direct_runner(chain, kinds: Dict[str, str], n_params: int, *,
                         specs, key_names, strides, G, agg_exprs,
-                        lowering) -> _Runner:
+                        lowering, dma: str = "single",
+                        update_fn=None, subtile: int = None) -> _Runner:
     """Compile the chain's static shape (column encodings, steps, agg
     specs) into a jitted Pallas launcher.  `kinds` maps each scan
     output name to its ResidentColumn encoding; `n_params` is the
     length of the chain's bound-parameter vector.  The launcher
     re-traces when the surviving-grid length changes (param pruning);
     everything else is baked in, mirroring the fused_cache programs of
-    the XLA path."""
+    the XLA path.
+
+    agg_span_init IS agg_direct_init (same state template and dtype
+    split), so the SAME stacked-accumulator kernel serves both the
+    direct mode (update_fn = ops.agg_direct_update, one-hot grid,
+    G<=64) and the grouped span mode (update_fn = ops.agg_span_update,
+    packed scatter, G up to KERNEL_SPAN_MAX_GROUPS)."""
+    update_fn = update_fn or ops.agg_direct_update
+    ts_rows = subtile or SUBTILE_ROWS
     meta = chain.scan_meta
-    cap = chain.leaf_cap(())
+    br = block_rows_for(chain.leaf_cap(()))
     steps = chain.steps
     n_steps = len(steps)
     dicts = meta["dicts"]
     colmap = meta["colmap"]
     names = tuple(colmap)
+    staged = staged_indices(names, kinds) if dma == "double" else ()
+    n_staged = len(staged)
 
     template = ops.agg_direct_init(G, specs)
     int_names = tuple(k for k, v in template.items()
@@ -230,6 +549,10 @@ def build_direct_runner(chain, kinds: Dict[str, str], n_params: int, *,
               else jnp.zeros((1, G), dtype=jnp.float64))
 
     def kernel(bidx_ref, lo_ref, hi_ref, *refs):
+        if n_staged:
+            scratch = refs[-(n_staged + 1):-1]
+            sem = refs[-1]
+            refs = refs[:-(n_staged + 1)]
         col_refs = refs[:len(refs) - 5 - n_params]
         param_refs = refs[len(col_refs):len(col_refs) + n_params]
         init_i_ref, init_f_ref = refs[-5:-3]
@@ -242,93 +565,31 @@ def build_direct_runner(chain, kinds: Dict[str, str], n_params: int, *,
             acc_f_ref[...] = init_f_ref[...]
             counts_ref[...] = jnp.zeros((1, 1 + n_steps), dtype=jnp.int64)
 
-        pos = bidx_ref[i].astype(jnp.int64) * cap
-        idx0 = jnp.arange(cap, dtype=jnp.int64)
+        slabs = (_stage_slabs(col_refs, staged, scratch, sem, bidx_ref,
+                              br) if n_staged else {})
+        pos = bidx_ref[i].astype(jnp.int64) * br
+        idx0 = jnp.arange(br, dtype=jnp.int64)
         live = (idx0 >= lo_ref[i].astype(jnp.int64)) \
             & (idx0 < hi_ref[i].astype(jnp.int64))
 
-        # -- late decode: ResidentColumn.slice_decode semantics over the
-        # chunk's VMEM blocks, then the scan's dead-row zeroing
-        cols: Dict[str, Column] = {}
-        r = 0
-        for name in names:
-            kind = kinds[name]
-            if kind == "plain":
-                v = col_refs[r][...]
-                r += 1
-            elif kind == "dict":
-                codes = col_refs[r][...]
-                values = col_refs[r + 1][...]
-                r += 2
-                v = values[codes.astype(jnp.int32)]
-            else:                                    # rle
-                run_values = col_refs[r][...]
-                run_starts = col_refs[r + 1][...]
-                r += 2
-                ri = _bisect_right(run_starts, pos + idx0) - 1
-                ri = jnp.clip(ri, 0, run_values.shape[0] - 1)
-                v = run_values[ri]
-            v = jnp.where(live, v, jnp.zeros((), v.dtype))
-            cols[name] = Column(v, None, dicts.get(name))
-        batch = Batch(cols, live)
-
-        # -- the chain's own filter/project/rename steps, lowered by the
-        # engine's Lowering (shared with the XLA chain), with the same
-        # per-step live-row counters chain.make(with_counts=True) emits.
-        # The bound-parameter vector rides along for step expressions
-        # exactly as in FusedChain.make's _pb (aggregation input
-        # expressions see a param-less batch on both paths).
+        cols = decode_columns(names, kinds, dicts, col_refs, slabs,
+                              pos, idx0, live)
         params_k = tuple(p[...][0] for p in param_refs)
-
-        def _pb(b):
-            return b.with_params(params_k) if n_params else b
-        counts = [jnp.sum(live)]
-        for step in steps:
-            kind = step[0]
-            if kind == "filter":
-                batch = ops.apply_filter(
-                    batch, lowering.eval(step[1], _pb(batch)))
-            elif kind == "project":
-                pb = _pb(batch)
-                batch = Batch({v2.name: lowering.eval(e, pb)
-                               for v2, e in step[1]}, batch.mask)
-            else:                                    # rename
-                batch = Batch({o: batch.columns[src]
-                               for o, src in step[1]}, batch.mask)
-            counts.append(jnp.sum(batch.mask))
+        batch, counts = run_chain_steps(Batch(cols, live), live, steps,
+                                        lowering, params_k, n_params)
 
         codes = None
         for k, stride in zip(key_names, strides):
             c = batch.columns[k].values.astype(jnp.int64)
             codes = c * stride if codes is None else codes + c * stride
         if codes is None:
-            codes = jnp.zeros(cap, dtype=jnp.int64)
+            codes = jnp.zeros(br, dtype=jnp.int64)
         agg_cols = agg_exprs(batch)
-        mask = batch.mask
+        total, compacted = compact_columns(
+            batch.mask, br,
+            [("codes", codes)] + agg_compaction_entries(specs, agg_cols))
 
-        # -- prefix-sum compaction: exclusive scan of the mask gives
-        # each live row its packed slot; dead rows scatter to index cap
-        # and drop.  Downstream aggregation then loops over live
-        # subtiles only.
-        pref = _blelloch_exclusive(mask.astype(jnp.int32))
-        total = pref[cap - 1] + mask[cap - 1].astype(jnp.int32)
-        dest = jnp.where(mask, pref, cap)
-        ccodes = jnp.zeros(cap, dtype=jnp.int64).at[dest].set(
-            codes, mode="drop")
-        cvals: Dict[str, object] = {}
-        cnulls: Dict[str, object] = {}
-        for spec in specs:
-            col = agg_cols.get(spec.output)
-            if col is None:                          # count_star
-                continue
-            cvals[spec.output] = jnp.zeros(
-                cap, dtype=col.values.dtype).at[dest].set(
-                    col.values, mode="drop")
-            if col.nulls is not None:
-                cnulls[spec.output] = jnp.zeros(
-                    cap, dtype=bool).at[dest].set(col.nulls, mode="drop")
-
-        ts = min(cap, SUBTILE_ROWS)
+        ts = min(br, ts_rows)
         n_sub = (total + ts - 1) // ts
         acc_i = acc_i_ref[...]
         acc_f = acc_f_ref[...]
@@ -339,20 +600,9 @@ def build_direct_runner(chain, kinds: Dict[str, str], n_params: int, *,
         def sub(j, st):
             off = j * ts
             m = (off + sub_idx) < total
-            sc = jax.lax.dynamic_slice(ccodes, (off,), (ts,))
-            sa: Dict[str, Optional[Column]] = {}
-            for spec in specs:
-                cv = cvals.get(spec.output)
-                if cv is None:
-                    sa[spec.output] = None
-                    continue
-                sv = jax.lax.dynamic_slice(cv, (off,), (ts,))
-                cn = cnulls.get(spec.output)
-                sn = (jax.lax.dynamic_slice(cn, (off,), (ts,))
-                      if cn is not None else None)
-                sa[spec.output] = Column(sv, sn)
-            return ops.agg_direct_update(st, Batch({}, m), sc, sa,
-                                         specs, G)
+            sc = jax.lax.dynamic_slice(compacted["codes"], (off,), (ts,))
+            sa = subtile_agg_inputs(compacted, specs, off, ts)
+            return update_fn(st, Batch({}, m), sc, sa, specs, G)
         state = jax.lax.fori_loop(0, n_sub, sub, state)
         acc_i_ref[...] = jnp.stack([state[k] for k in int_names])
         if n_f:
@@ -361,25 +611,9 @@ def build_direct_runner(chain, kinds: Dict[str, str], n_params: int, *,
             jnp.int64)[None, :]
 
     @jax.jit
-    def run(bidx, lo, hi, cached, params, init_i_arg, init_f_arg):
-        flat: List = []
-        in_specs: List = []
-        for name in names:
-            rc = cached[colmap[name]]
-            if rc.kind == "plain":
-                (data,) = rc.arrays
-                flat.append(data)
-                in_specs.append(pl.BlockSpec((cap,), _chunk_block))
-            elif rc.kind == "dict":
-                codes, values = rc.arrays
-                flat += [codes, values]
-                in_specs += [pl.BlockSpec((cap,), _chunk_block),
-                             pl.BlockSpec(values.shape, _whole_1d)]
-            else:                                    # rle
-                run_values, run_starts = rc.arrays
-                flat += [run_values, run_starts]
-                in_specs += [pl.BlockSpec(run_values.shape, _whole_1d),
-                             pl.BlockSpec(run_starts.shape, _whole_1d)]
+    def run(bidx, lo, hi, arrays, params, init_i_arg, init_f_arg):
+        flat = list(arrays)
+        in_specs = encoded_in_specs(names, kinds, flat, br, staged)
         for p in params:
             flat.append(jnp.asarray(p).reshape(1))
             in_specs.append(pl.BlockSpec((1,), _whole_1d))
@@ -396,11 +630,14 @@ def build_direct_runner(chain, kinds: Dict[str, str], n_params: int, *,
             pl.BlockSpec((max(n_f, 1), G), _whole_2d),
             pl.BlockSpec((1, 1 + n_steps), _whole_2d),
         ]
+        scratch_shapes = (dma_scratch_shapes(staged, flat, br)
+                          if n_staged else [])
         grid_spec = pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=3,
             grid=(bidx.shape[0],),
             in_specs=in_specs,
             out_specs=out_specs,
+            scratch_shapes=tuple(scratch_shapes),
         )
         return shim.pallas_call(kernel, grid_spec=grid_spec,
                                 out_shape=out_shape)(bidx, lo, hi, *flat)
@@ -410,7 +647,7 @@ def build_direct_runner(chain, kinds: Dict[str, str], n_params: int, *,
 
 def try_direct_scan_kernel(chain, aux, *, specs, key_names, strides, G,
                            agg_exprs, lowering, cache, declined,
-                           runtime_stats=None):
+                           runtime_stats=None, dma: str = "single"):
     """Run the fused scan chain through the Pallas kernel when eligible.
 
     Returns (agg_direct state dict, int64[1 + n_steps] row counters,
@@ -418,26 +655,13 @@ def try_direct_scan_kernel(chain, aux, *, specs, key_names, strides, G,
     agg_direct_finalize and the operator-stats spine exactly like the
     XLA direct path -- or None after recording one
     kernelDeclined{reason} counter."""
-    if jax.default_backend() not in ("cpu", "tpu"):
-        declined("Backend")
+    elig = chain_eligible(chain, aux, declined)
+    if elig is None:
         return None
-    if any(s[0] not in ("filter", "project", "rename")
-           for s in chain.steps):
-        declined("PlanShape")
-        return None
-    cap = chain.leaf_cap(())
-    if cap & (cap - 1):
-        # the Blelloch scan pairs elements level by level: power-of-two
-        # tiles only
-        declined("ChunkAlignment")
-        return None
-    cached = aux[0] or {}
-    colmap = chain.scan_meta.get("colmap") or {}
-    if not colmap or any(colmap[n] not in cached for n in colmap):
-        declined("ColumnsNotResident")
-        return None
+    cached, colmap = elig
+    br = block_rows_for(chain.leaf_cap(()))
     params_fp = chain.compiler.ctx.params_fingerprint
-    grid = aligned_grid(chain.scan_meta, cap, params_fp)
+    grid = aligned_grid(chain.scan_meta, br, params_fp)
     if not grid:
         # everything pruned: the XLA chain keeps one chunk for its
         # compiled fori_loop, but the kernel can simply return its init
@@ -445,34 +669,29 @@ def try_direct_scan_kernel(chain, aux, *, specs, key_names, strides, G,
         template = ops.agg_direct_init(G, specs)
         return (template,
                 jnp.zeros(1 + len(chain.steps), dtype=jnp.int64), 0)
-    # per-row encoded arrays must tile cleanly under the block grid:
-    # every grid block [b*cap, (b+1)*cap) must lie inside the padded
-    # array (store.py pads by the BUILD-time capacity, which can differ
-    # from this chain's chunk capacity)
+    names = tuple(colmap)
     max_block = max(b for b, _lo, _hi in grid)
-    for name in colmap:
-        rc = cached[colmap[name]]
-        if rc.kind in ("plain", "dict") \
-                and rc.arrays[0].shape[0] < (max_block + 1) * cap:
-            declined("ChunkAlignment")
-            return None
+    flat_arrays = gather_encoded_arrays(cached, colmap, names,
+                                        (max_block + 1) * br, cache)
 
     params = tuple(aux[-1]) if chain.has_params else ()
-    key = ("pallas_direct", G, strides, len(params))
+    key = ("pallas_direct", G, strides, len(params), dma)
     runner = cache.get(key)
     if runner is None:
         kinds = {name: cached[colmap[name]].kind for name in colmap}
         runner = build_direct_runner(
             chain, kinds, len(params), specs=specs, key_names=key_names,
-            strides=strides, G=G, agg_exprs=agg_exprs, lowering=lowering)
+            strides=strides, G=G, agg_exprs=agg_exprs, lowering=lowering,
+            dma=dma)
         cache[key] = runner
     bidx = jnp.asarray([b for b, _lo, _hi in grid], dtype=jnp.int32)
     lo = jnp.asarray([lo_ for _b, lo_, _hi in grid], dtype=jnp.int32)
     hi = jnp.asarray([hi_ for _b, _lo, hi_ in grid], dtype=jnp.int32)
-    acc_i, acc_f, kcounts = runner.fn(bidx, lo, hi, cached, params,
+    acc_i, acc_f, kcounts = runner.fn(bidx, lo, hi, flat_arrays, params,
                                       runner.init_i, runner.init_f)
     state = {k: acc_i[j] for j, k in enumerate(runner.int_names)}
     state.update({k: acc_f[j] for j, k in enumerate(runner.flt_names)})
-    if runtime_stats is not None:
-        runtime_stats.add("kernelScanPrograms", 1)
+    kinds = {name: cached[colmap[name]].kind for name in colmap}
+    meter_kernel_run(runtime_stats, len(grid),
+                     len(staged_indices(names, kinds)), dma)
     return state, kcounts[0], len(grid)

@@ -220,7 +220,10 @@ def _corr_finalize(name, m2x, m2y, cxy, n):
     return cxy / jnp.maximum(nf, 1.0), n < 1
 
 
-EMPTY_SLOT = jnp.uint64(0xFFFFFFFFFFFFFFFF)
+# numpy (not jnp) scalar: it embeds as a jaxpr literal, so kernel code
+# tracing under pallas_call (exec/kernels/grouped.py) can reference it
+# without capturing a device-array constant
+EMPTY_SLOT = np.uint64(0xFFFFFFFFFFFFFFFF)
 PROBE_ROUNDS = 16
 
 

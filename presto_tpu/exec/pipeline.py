@@ -272,11 +272,22 @@ class ExecutionConfig:
     # kernelDeclined{reason} runtime-stats counters.  Config key
     # scan.kernel / session scan_kernel
     scan_kernel: str = "auto"
+    # DMA staging discipline for the kernel's encoded input slabs:
+    # "single" streams each grid block through the BlockSpec pipeline
+    # as before; "double" stages per-row slabs through a manually
+    # double-buffered VMEM scratch (pltpu.make_async_copy) so block
+    # k+1's HBM copy overlaps block k's decode/aggregate compute.  The
+    # achieved prefetch coverage is metered as kernelDmaOverlapFraction.
+    # Config key scan.kernel-dma / session scan_kernel_dma
+    scan_kernel_dma: str = "single"
 
 
 # legal scan.kernel / scan_kernel values (worker/properties.py and the
 # session-property validation both check against this)
 SCAN_KERNEL_MODES = ("xla", "pallas", "auto")
+
+# legal scan.kernel-dma / scan_kernel_dma values
+SCAN_KERNEL_DMA_MODES = ("single", "double")
 
 
 def tuned_config(**overrides) -> "ExecutionConfig":
@@ -1631,7 +1642,8 @@ class PlanCompiler:
                         key_names=key_names, strides=strides, G=G,
                         agg_exprs=_agg_exprs, lowering=low,
                         cache=fused_cache, declined=_kernel_declined,
-                        runtime_stats=self.ctx.runtime_stats)
+                        runtime_stats=self.ctx.runtime_stats,
+                        dma=cfg.scan_kernel_dma)
                     if kres is not None:
                         state, kcounts, n_blocks = kres
                         counts_out["counts"] = kcounts
@@ -1649,10 +1661,42 @@ class PlanCompiler:
                 return ops.agg_direct_finalize(
                     state, specs, key_names, doms, kdts, kdicts,
                     force_row=not key_names)
-            elif cfg.scan_kernel != "xla":
-                # the kernel only has a direct-mode aggregation shape:
-                # meter the miss so EXPLAIN ANALYZE explains the XLA run
-                _kernel_declined("AggShape")
+            elif cfg.scan_kernel == "xla":
+                _kernel_declined("Disabled")
+            elif not basic:
+                # non-basic aggregate functions (stddev/variance, corr,
+                # percentiles, distinct forms) have no in-kernel
+                # accumulator shape — the XLA chain keeps those
+                _kernel_declined("AggFunctionShape")
+            elif cfg.scan_kernel == "auto" \
+                    and jax.default_backend() != "tpu":
+                _kernel_declined("Backend")
+            else:
+                # grouped (G > 64) shapes run in-kernel too: span slot
+                # addressing when the closed key domains fit the VMEM
+                # accumulator gate, hashed open addressing otherwise
+                # (exec/kernels/grouped.py).  A None return has already
+                # metered its kernelDeclined{reason}; the XLA span /
+                # sort / hash paths below take over.
+                from .kernels import (KERNEL_SPAN_MAX_GROUPS,
+                                      try_grouped_scan_kernel)
+                span_info = _direct_mode_info(
+                    key_names, key_cols, gmax=KERNEL_SPAN_MAX_GROUPS)
+                kres = try_grouped_scan_kernel(
+                    chain, aux, specs=specs, key_names=key_names,
+                    key_dtypes=key_dtypes, key_dicts=key_dicts,
+                    key_lazy=key_lazy, span_info=span_info,
+                    est_slots=initial_slots, agg_exprs=_agg_exprs,
+                    lowering=low, cache=fused_cache,
+                    declined=_kernel_declined, pool=pool,
+                    state_bytes=_agg_state_bytes,
+                    runtime_stats=self.ctx.runtime_stats,
+                    dma=cfg.scan_kernel_dma)
+                if kres is not None:
+                    out, kcounts, n_blocks = kres
+                    counts_out["counts"] = kcounts
+                    counts_out["n_chunks"] = n_blocks
+                    return _maybe_compact(out)
 
             # static span: closed dictionary/bool domains beyond the grid
             # limit — combined stride code indexes accumulators directly

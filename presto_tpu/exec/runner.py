@@ -382,9 +382,20 @@ class LocalQueryRunner:
             ctx = TaskContext(config=self.config, stats=stats,
                               runtime_stats=rstats)
             compiler = PlanCompiler(ctx)
+            # local EXPLAIN ANALYZE runs single-driver on this thread:
+            # sample thread CPU at the same driver boundary the
+            # scheduler/worker paths use so the footer's CPU-vs-wall
+            # line is populated here too
+            import time as _t
+            t0 = _t.perf_counter()  # lint: allow-wall-clock
+            c0 = _t.thread_time()
             with rstats.record_wall("queryExecute"):
                 for _page in compiler.run_to_pages(output):
                     pass
+            rstats.add("driverCpuNanos",
+                       (_t.thread_time() - c0) * 1e9, "NANO")
+            rstats.add("driverWallNanos",
+                       (_t.perf_counter() - t0) * 1e9, "NANO")  # lint: allow-wall-clock
             self.last_operator_stats = stats
         text = format_plan(output, stats)
         if rstats is not None:

@@ -505,6 +505,12 @@ class InProcessScheduler:
             """One task's fragment execution; returns (batch-or-None for
             ICI stages, wall seconds)."""
             t0 = _time.perf_counter()  # lint: allow-wall-clock
+            # thread CPU time at the driver boundary: each task runs on
+            # its own thread, so thread_time isolates ITS compute from
+            # the waits (device sync, exchange, sibling contention) that
+            # wall time folds in — the /v1/query and EXPLAIN ANALYZE
+            # CPU-vs-wall attribution
+            c0 = _time.thread_time()
             ctx = TaskContext(config=self.config.exec_config,
                               task_index=task_index,
                               shared_jits=stage_jits,
@@ -595,7 +601,11 @@ class InProcessScheduler:
                                "BYTE")
                 self.stats.add("exchangeFabricHttpExchangeWallNanos",
                                split_wall * 1e9, "NANO")
-            return out, _time.perf_counter() - t0  # lint: allow-wall-clock
+            wall = _time.perf_counter() - t0  # lint: allow-wall-clock
+            self.stats.add("driverCpuNanos",
+                           (_time.thread_time() - c0) * 1e9, "NANO")
+            self.stats.add("driverWallNanos", wall * 1e9, "NANO")
+            return out, wall
 
         def run_task_retrying(task_index: int):
             """Batch (Presto-on-Spark) mode: a failed task re-runs from

@@ -174,10 +174,26 @@ def format_analyze_footer(runtime_stats) -> str:
     kp = rs.get("kernelScanPrograms")
     if kp:
         lines.append(f"Pallas scan kernels: {int(kp['sum'])}")
+    ov = rs.get("kernelDmaOverlapFraction")
+    if ov and ov.get("count"):
+        # scan.kernel-dma = double: fraction of staged block slabs whose
+        # HBM->VMEM copy was issued while the previous block computed
+        lines.append(f"Kernel DMA overlap: "
+                     f"{ov['sum'] / ov['count']:.2f} "
+                     f"(double-buffered, {ov['count']} kernel(s))")
     fw = rs.get("fusedProgramWallNanos")
     if fw:
         lines.append(f"Fused program wall: {fw['sum'] / 1e6:,.1f}ms "
                      f"over {fw['count']} program(s)")
+    cpu = rs.get("driverCpuNanos")
+    wall = rs.get("driverWallNanos")
+    if cpu and wall and wall.get("sum"):
+        # cumulative thread-time vs wall at the driver boundaries: a low
+        # ratio means drivers sat waiting (device, exchange, admission)
+        # rather than computing
+        lines.append(f"Driver CPU/wall: {cpu['sum'] / 1e6:,.1f}ms / "
+                     f"{wall['sum'] / 1e6:,.1f}ms "
+                     f"({cpu['sum'] / wall['sum']:.2f} busy)")
     return "\n".join(lines)
 
 

@@ -632,6 +632,8 @@ class _QueryExecution:
         stages = []
         for stage in self.stages:
             tasks = []
+            stage_cpu = 0
+            stage_wall = 0
             for task in stage.tasks:
                 if task is None:
                     continue
@@ -644,11 +646,20 @@ class _QueryExecution:
                         if op.get("stats"):
                             merge_node_stats(
                                 merged, {op["planNodeId"]: op["stats"]})
+                tstats = info.get("stats", {})
+                stage_cpu += int(tstats.get("totalCpuTimeInNanos", 0))
+                stage_wall += int(tstats.get("driverWallTimeInNanos", 0))
                 tasks.append({"worker": task.worker_uri, **info})
             stages.append({"stageId": f"{self.qid}.{stage.stage_path}",
                            "fragmentId": stage.fragment.fragment_id,
                            "partitioning": stage.fragment.partitioning,
                            "nTasks": stage.n_tasks,
+                           # cumulative driver thread-time vs wall across
+                           # the stage's tasks (the reference StageStats
+                           # totalCpuTime/totalScheduledTime pair): the
+                           # gap is scheduling + device + exchange waits
+                           "cpuTimeInNanos": stage_cpu,
+                           "wallTimeInNanos": stage_wall,
                            "tasks": tasks})
         return {"traceToken": self.trace_token, "stages": stages,
                 "operatorStats": merged}

@@ -83,6 +83,13 @@ class TpuTask:
                     getattr(self, "_drain_wall", [0.0])[0], 4),
                 "elapsedTimeInNanos": int(
                     (_t.time() - self.created_at) * 1e9),
+                # driver thread-time vs driver wall (sampled at the _run
+                # boundaries): the per-stage CPU/wall attribution in
+                # /v1/query/{id} sums these across the stage's tasks
+                "totalCpuTimeInNanos": getattr(
+                    self, "_driver_cpu_nanos", 0),
+                "driverWallTimeInNanos": getattr(
+                    self, "_driver_wall_nanos", 0),
                 "outputPositions": self.output_rows,
                 "outputDataSizeInBytes": self.output_bytes,
                 "bufferedPages": self.output_pages,
@@ -279,6 +286,14 @@ class TpuTask:
                     f"injected task failure (p={p}, task {self.task_id})")
 
     def _run(self, fragment: P.PlanFragment, spec, ctx: TaskContext) -> None:
+        # driver-boundary CPU vs wall: _run IS the task's driver thread,
+        # so thread_time measures its compute and the wall-minus-CPU gap
+        # is time spent waiting (device syncs, buffer backpressure,
+        # exchange pulls) — surfaced as totalCpuTimeInNanos in TaskInfo
+        # and rolled up per stage by the coordinator
+        import time as _t
+        t0 = _t.perf_counter()  # lint: allow-wall-clock
+        c0 = _t.thread_time()
         try:
             self.plan_nodes = [
                 {"planNodeId": n.id, "operatorType": type(n).__name__}
@@ -366,6 +381,14 @@ class TpuTask:
             self.buffers.set_error(
                 f"task {self.task_id} failed [{error_type}]:\n{message}")
             self._set_state(FAILED, message, error_type)
+        finally:
+            wall = _t.perf_counter() - t0  # lint: allow-wall-clock
+            self._driver_cpu_nanos = int((_t.thread_time() - c0) * 1e9)
+            self._driver_wall_nanos = int(wall * 1e9)
+            self.stats.add("driverCpuNanos", self._driver_cpu_nanos,
+                           "NANO")
+            self.stats.add("driverWallNanos", self._driver_wall_nanos,
+                           "NANO")
 
 
 class TaskManager:
