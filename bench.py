@@ -263,6 +263,7 @@ def bench_xchg(runs):
             }
             out["ici_overlap_fraction"] = round(
                 fi["overlap_fraction"], 4)
+        out["process_metrics"] = _process_metrics()
         print(json.dumps(out))
     finally:
         for w in workers:
@@ -360,9 +361,40 @@ def bench_serve(runs):
                     PREPARED_REGISTRY.info()["statements"],
             },
         }
+        out["process_metrics"] = _process_metrics()
         print(json.dumps(out))
     finally:
         server.close()
+
+
+def _process_metrics():
+    """Compact process-metrics snapshot attached to every BENCH_* JSON
+    line — the same registries the telemetry exporter scrapes
+    (presto_tpu/telemetry/otlp.py), so each benchmark record carries the
+    engine state it ran under: fabric byte movement, serving-cache hit
+    rates, storage-cache hit rate, and the scan-kernel counters."""
+    from presto_tpu.exec.kernels.scan_kernel import KERNEL_METRICS
+    from presto_tpu.parallel.fabric import FABRIC_METRICS
+    from presto_tpu.serving import SERVING_METRICS
+    from presto_tpu.storage import STORAGE_METRICS
+    rates = FABRIC_METRICS.byte_rates()
+    fabrics = {
+        f: {"bytes_moved": s["bytes_moved"], "exchanges": s["exchanges"],
+            "bytes_per_sec": round(rates.get(f, 0.0), 1)}
+        for f, s in sorted(FABRIC_METRICS.snapshot().items())
+        if s["exchanges"]}
+    sm = STORAGE_METRICS
+    lookups = sm["cache_hits"] + sm["cache_misses"]
+    k = KERNEL_METRICS.snapshot()
+    return {
+        "fabric": fabrics,
+        "serving": SERVING_METRICS.compact_snapshot(),
+        "storage_cache_hit_rate": round(sm["cache_hits"] / lookups, 4)
+        if lookups else 0.0,
+        "kernel": {"scan_programs": k["scan_programs"],
+                   "declined": k["declined"],
+                   "dma_overlap_fraction": k["dma_overlap_fraction"]},
+    }
 
 
 def _backend_diagnostic(qname, exc):
@@ -578,6 +610,7 @@ def main():
             "overlap_fraction": round(1 - run / (gen + comp), 4)
             if gen + comp else 0.0,
         }
+    out["process_metrics"] = _process_metrics()
     print(json.dumps(out))
 
 

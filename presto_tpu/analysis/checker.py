@@ -186,7 +186,12 @@ class NoDuplicatePlanNodeIds(Check):
         for nid, nodes in by_id.items():
             if len(nodes) < 2:
                 continue
-            keys = {P.structural_key(n) for n in nodes}
+            # canonical_params: the serving tier's parameterizer gives each
+            # literal occurrence its own global slot, so decorrelated deep
+            # copies of one source subtree differ only in slot indices —
+            # still the same plan for the id-sharing contract
+            keys = {P.structural_key(n, canonical_params=True)
+                    for n in nodes}
             if len(keys) > 1:
                 kinds = ", ".join(sorted({_kind(n) for n in nodes}))
                 ctx.add(self.code, nodes[0], kinds,

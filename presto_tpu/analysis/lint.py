@@ -38,6 +38,13 @@ and flags the hazard shapes:
            code would make a TPU build silently run Pallas kernels in
            the Python interpreter.  There is NO pragma escape — the shim
            is the one sanctioned site.
+  TELEM001 an unbounded queue (`queue.Queue()` with no / zero maxsize,
+           or `queue.SimpleQueue()`) in `presto_tpu/telemetry/`.  The
+           telemetry export pipeline sits BESIDE the query path: if its
+           sink stalls, buffering must saturate a bound and drop (with
+           the drop metered) rather than grow until the process OOMs.
+           There is NO pragma escape — pass an explicit positive
+           maxsize.
 
 "Device value" is tracked with a deliberately shallow per-scope
 dataflow: names assigned from `jnp.*` / `lax.*` calls (or expressions
@@ -74,9 +81,11 @@ SYNC_BRANCH = "SYNC004"
 SYNC_NETWORK = "SYNC005"
 SYNC_WALLCLOCK = "SYNC006"
 KERNEL_INTERPRET = "KERNEL001"
+TELEM_UNBOUNDED_QUEUE = "TELEM001"
 
 ALL_LINT_CODES = (SYNC_EXPLICIT, SYNC_CAST, SYNC_ASARRAY, SYNC_BRANCH,
-                  SYNC_NETWORK, SYNC_WALLCLOCK, KERNEL_INTERPRET)
+                  SYNC_NETWORK, SYNC_WALLCLOCK, KERNEL_INTERPRET,
+                  TELEM_UNBOUNDED_QUEUE)
 
 # KERNEL001 scope: everywhere.  The shim is the ONE file that may select
 # Pallas interpret mode (it gates on the backend); no pragma overrides.
@@ -89,10 +98,13 @@ _INTERPRET_ALLOWLIST = ("presto_tpu/exec/kernels/shim.py",)
 _NETWORK_PATH_MARKERS = ("presto_tpu/exec/", "presto_tpu/common/",
                          "presto_tpu/ops/", "presto_tpu/parallel/",
                          "presto_tpu/connectors/", "presto_tpu/storage/",
-                         "presto_tpu/serving/")
+                         "presto_tpu/serving/", "presto_tpu/telemetry/")
 # the worker exchange client is THE sanctioned network home; everything
-# else in the marked packages must stay network-free by construction
-_NETWORK_ALLOWLIST = ("presto_tpu/worker/exchange.py",)
+# else in the marked packages must stay network-free by construction.
+# telemetry/export.py is sanctioned too: its OTLP HTTP POSTs run on the
+# exporter's background flush thread, never the query path.
+_NETWORK_ALLOWLIST = ("presto_tpu/worker/exchange.py",
+                      "presto_tpu/telemetry/export.py")
 _NETWORK_CALLS = {"urllib.request.urlopen", "urllib.request.urlretrieve",
                   "request.urlopen", "urlopen", "urlopen_internal"}
 
@@ -105,6 +117,13 @@ _WALL_CALLS = {"time.time", "_time.time",
                "time.perf_counter", "_time.perf_counter",
                "time.perf_counter_ns", "_time.perf_counter_ns",
                "time.monotonic", "_time.monotonic"}
+
+# TELEM001 scope: the telemetry export package.  A backpressure stall in
+# a sink must hit a bounded queue (metered drop), never unbounded growth.
+_TELEM_PATH_MARKER = "presto_tpu/telemetry/"
+_QUEUE_CALLS = {"queue.Queue", "Queue", "queue.LifoQueue", "LifoQueue",
+                "queue.PriorityQueue", "PriorityQueue"}
+_SIMPLE_QUEUE_CALLS = {"queue.SimpleQueue", "SimpleQueue"}
 
 # Call prefixes whose results live on device.  `jax.` alone is NOT in the
 # list: most of the jax namespace (jit, vmap, tree_util) returns host
@@ -184,6 +203,7 @@ class _Linter(ast.NodeVisitor):
             any(m in norm for m in _NETWORK_PATH_MARKERS)
             and not any(norm.endswith(a) for a in _NETWORK_ALLOWLIST))
         self._wall_scoped = _WALL_PATH_MARKER in norm
+        self._telem_scoped = _TELEM_PATH_MARKER in norm
         self._interpret_exempt = any(
             norm.endswith(a) for a in _INTERPRET_ALLOWLIST)
 
@@ -352,6 +372,8 @@ class _Linter(ast.NodeVisitor):
                        f"operator stats, or mark the sanctioned metering "
                        f"site with `# {WALL_PRAGMA}`",
                        allowed=self.wall_allowed)
+        if self._telem_scoped:
+            self._check_telemetry_queue(node, name)
         if not self._interpret_exempt:
             for kw in node.keywords:
                 if kw.arg == "interpret" \
@@ -364,6 +386,36 @@ class _Linter(ast.NodeVisitor):
                                "through the shim (no pragma escape)",
                                allowed=set())
         self.generic_visit(node)
+
+    def _check_telemetry_queue(self, node: ast.Call, name: str) -> None:
+        """TELEM001: every queue constructed in presto_tpu/telemetry/
+        must carry an explicit nonzero maxsize (queue.Queue treats
+        maxsize<=0 as infinite; SimpleQueue is always unbounded)."""
+        if name in _SIMPLE_QUEUE_CALLS:
+            self._flag(node, TELEM_UNBOUNDED_QUEUE,
+                       f"{name}() is always unbounded; the telemetry "
+                       f"pipeline must use queue.Queue(maxsize=N) so a "
+                       f"stalled sink drops (metered) instead of growing "
+                       f"without bound (no pragma escape)",
+                       allowed=set())
+            return
+        if name not in _QUEUE_CALLS:
+            return
+        def _zeroish(v: ast.AST) -> bool:
+            return isinstance(v, ast.Constant) and not v.value
+        bounded = bool(node.args) and not _zeroish(node.args[0])
+        for kw in node.keywords:
+            if kw.arg == "maxsize":
+                bounded = not _zeroish(kw.value)
+            elif kw.arg is None:
+                bounded = True      # **kwargs: assume the caller bounds it
+        if not bounded:
+            self._flag(node, TELEM_UNBOUNDED_QUEUE,
+                       f"{name}() without a positive maxsize is an "
+                       f"unbounded buffer in the telemetry pipeline; a "
+                       f"stalled sink must drop (metered) at a bound, "
+                       f"not grow until OOM (no pragma escape)",
+                       allowed=set())
 
     def visit_If(self, node: ast.If) -> None:
         if self._is_device(node.test):

@@ -16,7 +16,7 @@ for:
             carries its block index plus a [lo, hi) live row range as
             scalar-prefetch operands, which also masks short/misaligned
             chunk tails (the launcher zero-pads encoded arrays up to the
-            grid, so there is no ChunkAlignment decline).  Zone-map
+            grid, so tail shape never declines the kernel).  Zone-map
             pruning runs over THIS grid, so pruned blocks never issue
             DMAs -- they are simply not in the grid.
   decode    ResidentColumn blocks stream out of HBM in ENCODED form.
@@ -77,7 +77,9 @@ from . import shim
 # counters (exec/pipeline.py _kernel_declined) -- the kernel twin of the
 # fusionDeclined{...} family.  "Disabled", "AggFunctionShape" and
 # "Backend"(auto) are recorded by the pipeline itself; the rest are
-# produced here / in kernels/grouped.py.
+# produced here / in kernels/grouped.py.  ("ChunkAlignment" was held at 0
+# for one release after tail padding landed and is now retired — the
+# launcher pads/lane-masks every tail, so the decline cannot occur.)
 KERNEL_DECLINE_REASONS = (
     "Disabled",              # scan.kernel = xla
     "AggFunctionShape",      # non-BASIC aggregate functions (moment/corr/
@@ -88,10 +90,6 @@ KERNEL_DECLINE_REASONS = (
     "Backend",               # platform is neither tpu nor cpu-interpret
     "PlanShape",             # chain has join/semi/uid steps
     "ColumnsNotResident",    # a scanned column is not HBM-resident encoded
-    "ChunkAlignment",        # RETIRED: short/misaligned tails are padded and
-    #                          lane-masked since the grouped-kernel PR; the
-    #                          name stays one release so dashboards keyed on
-    #                          the counter read 0 instead of erroring
 )
 
 # compacted rows are aggregated in subtiles of this many rows: the
@@ -114,6 +112,46 @@ KERNEL_HASH_MAX_SLOTS = 1 << 16
 
 # scan.kernel-dma knob values (ExecutionConfig.scan_kernel_dma)
 DMA_MODES = ("single", "double")
+
+
+class KernelMetrics:
+    """Process-lifetime roll-up of the per-query kernel counters, so the
+    telemetry scraper (telemetry/otlp.py scrape_metric_points) and
+    /v1/metrics can export kernel engagement without a live query: every
+    kernelDeclined{reason} tick and meter_kernel_run call lands here too."""
+
+    def __init__(self):
+        import threading
+        self._lock = threading.Lock()
+        self.declined: Dict[str, int] = {}
+        self.scan_programs = 0
+        self.dma_staged_blocks = 0
+        self.dma_prefetched_blocks = 0
+
+    def record_declined(self, reason: str) -> None:
+        with self._lock:
+            self.declined[reason] = self.declined.get(reason, 0) + 1
+
+    def record_run(self, n_staged_copies: int, n_prefetched: int) -> None:
+        with self._lock:
+            self.scan_programs += 1
+            self.dma_staged_blocks += n_staged_copies
+            self.dma_prefetched_blocks += n_prefetched
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            staged = self.dma_staged_blocks
+            return {
+                "declined": dict(self.declined),
+                "scan_programs": self.scan_programs,
+                "dma_staged_blocks": staged,
+                "dma_prefetched_blocks": self.dma_prefetched_blocks,
+                "dma_overlap_fraction": (
+                    self.dma_prefetched_blocks / staged if staged else 0.0),
+            }
+
+
+KERNEL_METRICS = KernelMetrics()
 
 
 def _blelloch_exclusive(x):
@@ -216,7 +254,7 @@ def block_rows_for(cap: int) -> int:
     aggregation is order-insensitive, and rows between a split end and
     the block end are lane-masked via the [lo, hi) scalar-prefetch range
     (the launcher zero-pads encoded arrays to the grid, so a short last
-    chunk no longer declines with ChunkAlignment)."""
+    chunk never declines the kernel)."""
     return 1 << max(0, int(cap - 1).bit_length())
 
 
@@ -491,12 +529,15 @@ def meter_kernel_run(runtime_stats, n_blocks, n_staged, dma) -> None:
     traffic overlapped compute.  (A wall-clock overlap measure needs the
     real-TPU re-run the ROADMAP tracks; the structural fraction is
     deterministic, so tests and dashboards can pin it.)"""
-    if runtime_stats is None:
-        return
-    runtime_stats.add("kernelScanPrograms", 1)
+    staged_copies = prefetched = 0
     if dma == "double" and n_staged and n_blocks:
         staged_copies = n_blocks * n_staged
         prefetched = (n_blocks - 1) * n_staged
+    KERNEL_METRICS.record_run(staged_copies, prefetched)
+    if runtime_stats is None:
+        return
+    runtime_stats.add("kernelScanPrograms", 1)
+    if staged_copies:
         runtime_stats.add("kernelDmaStagedBlocks", staged_copies)
         runtime_stats.add("kernelDmaPrefetchedBlocks", prefetched)
         runtime_stats.add("kernelDmaOverlapFraction",

@@ -280,6 +280,14 @@ class ExecutionConfig:
     # achieved prefetch coverage is metered as kernelDmaOverlapFraction.
     # Config key scan.kernel-dma / session scan_kernel_dma
     scan_kernel_dma: str = "single"
+    # -- per-query device profiler capture (telemetry/profiler.py) --------
+    # session property `profile = true` wraps THIS query's execution in
+    # jax.profiler.trace() writing a TensorBoard-loadable trace dir under
+    # profile_dir; the path lands on QueryInfo and the EXPLAIN ANALYZE
+    # footer.  Best-effort: profiler failures never fail the query.
+    profile: bool = False
+    # Config key telemetry.profile-dir; "" disables capture entirely
+    profile_dir: str = "/tmp/presto_tpu_profiles"
 
 
 # legal scan.kernel / scan_kernel values (worker/properties.py and the
@@ -1403,6 +1411,8 @@ class PlanCompiler:
             """Pallas scan-kernel refusals (exec/kernels), metered like
             the fusion ones: kernelDeclined{Reason} counters tell EXPLAIN
             ANALYZE why a fused scan ran the XLA chain instead."""
+            from .kernels.scan_kernel import KERNEL_METRICS
+            KERNEL_METRICS.record_declined(reason)
             rs = self.ctx.runtime_stats
             if rs is not None:
                 rs.add(f"kernelDeclined{reason}", 1)

@@ -28,6 +28,9 @@ class QueryResult:
     # server can answer with X-Presto-Added-Prepare; DEALLOCATE the name
     added_prepare: tuple = None
     deallocated_prepare: str = None
+    # device-profiler capture directory when the `profile` session
+    # property was set (telemetry/profiler.py); None when not captured
+    profile_trace_dir: Optional[str] = None
 
     def sorted_rows(self):
         return sorted(self.rows, key=lambda r: tuple(
@@ -258,10 +261,15 @@ class LocalQueryRunner:
         # operators add fine-grained counters (grouped bucket walls, ...)
         compiler.ctx.runtime_stats = stats
         from contextlib import nullcontext
+
+        from ..telemetry import profile_capture
         with (tracer.span("query", sql=sql) if tracer else nullcontext()):
-            with stats.record_wall("queryExecute"):
-                result = pages_to_result(compiler.run_to_pages(output),
-                                         names, types)
+            with profile_capture(self.config.profile_dir, "query",
+                                 enabled=self.config.profile) as trace_dir:
+                with stats.record_wall("queryExecute"):
+                    result = pages_to_result(
+                        compiler.run_to_pages(output), names, types)
+        result.profile_trace_dir = trace_dir
         result.runtime_stats = stats.to_dict()
         # peak MemoryPool reservation, for QueryCompletedEvent enrichment
         result.peak_memory_bytes = (compiler.ctx.memory.peak
@@ -372,11 +380,13 @@ class LocalQueryRunner:
                              default_catalog=self.catalog) \
                 .plan_query_to_output(ast.query)
         stats = rstats = None
+        trace_dir = None
         if ast.analyze:
             # fusion stays ENABLED: the fused chain emits device-side row
             # counters as extra jit outputs, so this profiles the real
             # execution path.  analyze_unfused retains the old per-node
             # interpreted profiling.
+            from ..telemetry import profile_capture
             stats = {}
             rstats = RuntimeStats()
             ctx = TaskContext(config=self.config, stats=stats,
@@ -389,9 +399,11 @@ class LocalQueryRunner:
             import time as _t
             t0 = _t.perf_counter()  # lint: allow-wall-clock
             c0 = _t.thread_time()
-            with rstats.record_wall("queryExecute"):
-                for _page in compiler.run_to_pages(output):
-                    pass
+            with profile_capture(self.config.profile_dir, "analyze",
+                                 enabled=self.config.profile) as trace_dir:
+                with rstats.record_wall("queryExecute"):
+                    for _page in compiler.run_to_pages(output):
+                        pass
             rstats.add("driverCpuNanos",
                        (_t.thread_time() - c0) * 1e9, "NANO")
             rstats.add("driverWallNanos",
@@ -399,7 +411,7 @@ class LocalQueryRunner:
             self.last_operator_stats = stats
         text = format_plan(output, stats)
         if rstats is not None:
-            footer = format_analyze_footer(rstats)
+            footer = format_analyze_footer(rstats, profile_dir=trace_dir)
             if footer:
                 text += "\n\n" + footer
         return QueryResult(["Query Plan"], [VarcharType(max(1, len(text)))],
@@ -564,6 +576,7 @@ class DistributedQueryRunner(LocalQueryRunner):
         if ast.analyze:
             from contextlib import nullcontext
 
+            from ..telemetry import profile_capture
             from .scheduler import InProcessScheduler
             sched = InProcessScheduler(self._scheduler_config())
             sched.node_stats = stats = {}
@@ -575,12 +588,16 @@ class DistributedQueryRunner(LocalQueryRunner):
                 sched.tracer = tracer
             with (tracer.span("query", sql=sql) if tracer
                   else nullcontext()):
-                for _page in sched.execute(subplan):
-                    pass
+                with profile_capture(self.config.profile_dir, "analyze",
+                                     enabled=self.config.profile) \
+                        as trace_dir:
+                    for _page in sched.execute(subplan):
+                        pass
             if tracer:
                 tracer.end_trace("query finished")
             self.last_operator_stats = stats
-            footer = format_analyze_footer(sched.stats)
+            footer = format_analyze_footer(sched.stats,
+                                           profile_dir=trace_dir)
         text = format_subplan(subplan, stats)
         if footer:
             text += "\n\n" + footer
@@ -598,6 +615,7 @@ class DistributedQueryRunner(LocalQueryRunner):
             return self._execute_ddl(ast)
         from contextlib import nullcontext
 
+        from ..telemetry import profile_capture
         from .scheduler import InProcessScheduler
         subplan, names, types = self.plan_subplan(sql, ast=ast)
         sched = InProcessScheduler(self._scheduler_config())
@@ -606,7 +624,11 @@ class DistributedQueryRunner(LocalQueryRunner):
         if tracer is not None:
             sched.tracer = tracer
         with (tracer.span("query", sql=sql) if tracer else nullcontext()):
-            result = pages_to_result(sched.execute(subplan), names, types)
+            with profile_capture(self.config.profile_dir, "query",
+                                 enabled=self.config.profile) as trace_dir:
+                result = pages_to_result(sched.execute(subplan), names,
+                                         types)
+        result.profile_trace_dir = trace_dir
         # fabric-tagged exchange stats (bytes / walls per fabric) collected
         # while the result drained
         result.runtime_stats = sched.stats.to_dict()

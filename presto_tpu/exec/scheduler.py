@@ -652,9 +652,16 @@ class InProcessScheduler:
         # stack to n_tasks x budget — run those sequentially
         concurrent = stage.n_tasks > 1 and (
             pin or self.config.exec_config.memory_budget_bytes is None)
-        frag_span = (self.tracer.span(f"fragment {frag.fragment_id}",
-                                      parent="query",
-                                      n_tasks=stage.n_tasks)
+        # fabric/partitioning ride on the fragment span so an exported
+        # OTLP trace (telemetry/otlp.py) shows which wire each inter-stage
+        # edge took without joining against EXPLAIN output
+        frag_span = (self.tracer.span(
+            f"fragment {frag.fragment_id}",
+            parent="query",
+            n_tasks=stage.n_tasks,
+            partitioning=str(frag.partitioning),
+            fabric=str(getattr(frag.output_partitioning_scheme,
+                               "fabric", None) or "http"))
                      if self.tracer is not None
                      else contextlib.nullcontext())
         with frag_span:

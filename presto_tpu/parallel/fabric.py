@@ -136,6 +136,17 @@ class FabricMetrics:
                 out[fabric] = d
             return out
 
+    def byte_rates(self) -> dict:
+        """bytes/s through each fabric while it was actually moving data
+        (bytes_moved over exchange wall) — the /v1/cluster analog of the
+        reference ClusterStatsResource input/output byte rates."""
+        with self._lock:
+            out = {}
+            for fabric, m in self._by_fabric.items():
+                wall = m["exchange_wall_s"]
+                out[fabric] = (m["bytes_moved"] / wall) if wall > 0 else 0.0
+            return out
+
 
 FABRIC_METRICS = FabricMetrics()
 

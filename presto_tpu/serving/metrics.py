@@ -54,5 +54,19 @@ class ServingMetrics:
             total = self.plan_cache_hits + self.plan_cache_misses
             return self.plan_cache_hits / total if total else 0.0
 
+    def compact_snapshot(self) -> dict:
+        """The bench/telemetry digest: absolute counters collapse to the
+        two rates that explain a perf trajectory line."""
+        snap = self.snapshot()
+        total = snap["planCacheHits"] + snap["planCacheMisses"]
+        prepared = snap["preparedFastPath"] + snap["preparedReplans"]
+        return {
+            "planCacheHitRate": (snap["planCacheHits"] / total
+                                 if total else 0.0),
+            "preparedFastPathRate": (snap["preparedFastPath"] / prepared
+                                     if prepared else 0.0),
+            "executableBuilds": snap["executableBuilds"],
+        }
+
 
 SERVING_METRICS = ServingMetrics()

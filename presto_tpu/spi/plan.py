@@ -953,7 +953,7 @@ def walk_plan(node: PlanNode):
         yield from walk_plan(s)
 
 
-def structural_key(node: PlanNode) -> str:
+def structural_key(node: PlanNode, canonical_params: bool = False) -> str:
     """Canonical text of a subtree that is identical for structurally
     equal plans regardless of node ids or variable names — node ids are
     blanked and variables renamed by first occurrence in a deterministic
@@ -961,8 +961,26 @@ def structural_key(node: PlanNode) -> str:
     REPLAYED subtrees (scalar-subquery re-plans, decorrelated deep copies)
     whose node ids differ; a false mismatch only costs a cache miss, and
     structural equality implies identical output data (generated connector
-    data is immutable and AssignUniqueId ids are deterministic)."""
+    data is immutable and AssignUniqueId ids are deterministic).
+
+    `canonical_params=True` additionally renames bound-parameter slot
+    indices by first occurrence (both `{"@type": "parameter", "index": N}`
+    expressions and scan-pushdown `["param", N]` markers share one
+    mapping).  The serving tier's parameterizer gives every literal
+    occurrence its own global slot, so decorrelated deep copies of the
+    same source subtree (a CTE referenced by two subqueries) carry
+    different indices while remaining structurally the same plan.  The
+    DUPLICATE_NODE_ID checker compares plans under this mode; execution
+    result caches must NOT — two subtrees bound to different slots of the
+    same execution can carry different values, and params_fingerprint
+    (whole-vector) would not disambiguate them."""
     rename: Dict[str, str] = {}
+    param_rename: Dict[int, int] = {}
+
+    def pidx(i: int) -> int:
+        if i not in param_rename:
+            param_rename[i] = len(param_rename)
+        return param_rename[i]
 
     def canon(x):
         if isinstance(x, dict):
@@ -971,6 +989,10 @@ def structural_key(node: PlanNode) -> str:
                 if nm not in rename:
                     rename[nm] = f"v{len(rename)}"
                 return {"@type": "variable", "name": rename[nm],
+                        "type": x.get("type")}
+            if (canonical_params and x.get("@type") == "parameter"
+                    and isinstance(x.get("index"), int)):
+                return {"@type": "parameter", "index": pidx(x["index"]),
                         "type": x.get("type")}
             out = {}
             for k in sorted(x):
@@ -987,6 +1009,9 @@ def structural_key(node: PlanNode) -> str:
                     out[k] = canon(v)
             return out
         if isinstance(x, list):
+            if (canonical_params and len(x) == 2 and x[0] == "param"
+                    and isinstance(x[1], int)):
+                return ["param", pidx(x[1])]
             return [canon(i) for i in x]
         return x
 

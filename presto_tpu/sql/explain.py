@@ -148,12 +148,15 @@ def format_plan(node: P.PlanNode,
     return "\n".join(lines)
 
 
-def format_analyze_footer(runtime_stats) -> str:
+def format_analyze_footer(runtime_stats, profile_dir: str = None) -> str:
     """EXPLAIN ANALYZE footer: fusion-declined counters (the reasons a
     scan chain stayed on the streaming path) and the fused program wall,
-    pulled from the execution's RuntimeStats.  Empty string when nothing
-    was recorded."""
+    pulled from the execution's RuntimeStats; plus the device-profiler
+    capture directory when the `profile` session property wrapped the
+    run.  Empty string when nothing was recorded."""
     if runtime_stats is None:
+        if profile_dir:
+            return f"Device profile: {profile_dir}"
         return ""
     rs = runtime_stats.to_dict() if hasattr(runtime_stats, "to_dict") \
         else dict(runtime_stats)
@@ -194,6 +197,10 @@ def format_analyze_footer(runtime_stats) -> str:
         lines.append(f"Driver CPU/wall: {cpu['sum'] / 1e6:,.1f}ms / "
                      f"{wall['sum'] / 1e6:,.1f}ms "
                      f"({cpu['sum'] / wall['sum']:.2f} busy)")
+    if profile_dir:
+        # where `jax.profiler.trace` wrote this run's device capture
+        # (open with tensorboard / xprof)
+        lines.append(f"Device profile: {profile_dir}")
     return "\n".join(lines)
 
 

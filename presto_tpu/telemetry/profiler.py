@@ -1,0 +1,68 @@
+"""Per-query device profiler capture.
+
+The `profile` session property wraps ONE query's execution in
+jax.profiler.trace(), writing a TensorBoard-loadable trace directory
+per query — the device-level twin of EXPLAIN ANALYZE: operator stats
+say WHERE the rows went, the profiler trace says what the chip did
+(XLA program timelines, DMA waits, fusion boundaries).  "Accelerating
+Presto with GPUs" locates its wins with exactly this kind of capture.
+
+Hard rule: profiling is best-effort.  A profiler failure (unsupported
+backend, a concurrent capture already holding the singleton profiler
+session, a read-only profile dir) must NEVER fail the query — the
+capture silently degrades to None and the query runs unprofiled.
+"""
+from __future__ import annotations
+
+import os
+import re
+import threading
+import time
+from contextlib import contextmanager
+from typing import Iterator, Optional
+
+# jax.profiler supports one active trace session per process; a second
+# start_trace raises.  Serialize via non-blocking acquire: a query that
+# loses the race runs unprofiled rather than queueing behind another
+# query's capture.
+_capture_lock = threading.Lock()
+
+
+def _safe_dirname(query_id: str) -> str:
+    return re.sub(r"[^A-Za-z0-9_.-]+", "_", query_id) or "query"
+
+
+@contextmanager
+def profile_capture(profile_dir: Optional[str],
+                    query_id: str,
+                    enabled: bool = True,
+                    clock=time.time) -> Iterator[Optional[str]]:
+    """Yield the per-query trace directory being captured, or None when
+    capture is disabled/unavailable.  The yielded path is what QueryInfo
+    and the EXPLAIN ANALYZE footer report."""
+    if not enabled or not profile_dir:
+        yield None
+        return
+    if not _capture_lock.acquire(blocking=False):
+        yield None
+        return
+    trace_dir = os.path.join(
+        profile_dir, f"{_safe_dirname(query_id)}-{int(clock() * 1000)}")
+    started = False
+    try:
+        try:
+            os.makedirs(trace_dir, exist_ok=True)
+            import jax.profiler
+            jax.profiler.start_trace(trace_dir)
+            started = True
+        except Exception:
+            trace_dir = None
+        yield trace_dir if started else None
+    finally:
+        if started:
+            try:
+                import jax.profiler
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+        _capture_lock.release()

@@ -113,6 +113,11 @@ class Span:
     end: float = 0.0
     attributes: Dict[str, object] = field(default_factory=dict)
 
+    def to_dict(self) -> dict:
+        return {"name": self.name, "parent": self.parent,
+                "start": self.start, "end": self.end,
+                "attributes": dict(self.attributes)}
+
 
 class Tracer:
     """SPI (presto-spi tracing.Tracer analog)."""
@@ -196,3 +201,9 @@ class TracerProvider:
     def get_trace(self, trace_token: str) -> Optional[SimpleTracer]:
         with self._lock:
             return self._traces.get(trace_token)
+
+    def pop_trace(self, trace_token: str) -> Optional[SimpleTracer]:
+        """Detach a finished trace (export pipelines take ownership so
+        long-lived providers do not accumulate span trees forever)."""
+        with self._lock:
+            return self._traces.pop(trace_token, None)

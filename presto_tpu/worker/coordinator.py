@@ -756,6 +756,7 @@ class HttpQueryRunner(LocalQueryRunner):
         stats = None
         footer = ""
         if ast.analyze:
+            from ..telemetry import profile_capture
             root = self._build_stages(subplan)
             qid = (f"q{next(_query_counter)}_"
                    f"{int(time.time() * 1000) % 100000}")
@@ -767,7 +768,13 @@ class HttpQueryRunner(LocalQueryRunner):
                                             trace_token=trace_token)
                 self.last_execution = execution
                 try:
-                    execution.run()
+                    # device capture covers only the coordinator's slice
+                    # (root pull + in-process loopback workers); remote
+                    # workers profile their own processes
+                    with profile_capture(self.config.profile_dir, qid,
+                                         enabled=self.config.profile) \
+                            as trace_dir:
+                        execution.run()
                     snapshot = execution.query_info_snapshot()
                 finally:
                     self.tasks_retried += execution.retries
@@ -794,7 +801,8 @@ class HttpQueryRunner(LocalQueryRunner):
                             e["count"] += v["count"]
                             e["min"] = min(e["min"], v["min"])
                             e["max"] = max(e["max"], v["max"])
-            footer = format_analyze_footer(merged_rs)
+            footer = format_analyze_footer(merged_rs,
+                                           profile_dir=trace_dir)
         text = format_subplan(subplan, stats)
         if footer:
             text += "\n\n" + footer

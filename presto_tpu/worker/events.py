@@ -51,6 +51,11 @@ class QueryCompletedEvent:
     # defaulted so pre-existing listeners/tests keep constructing the event
     runtime_stats: Optional[dict] = None
     peak_memory_bytes: int = 0
+    # identity context for downstream consumers (the telemetry history
+    # store keys its durable records on these; the reference event carries
+    # traceToken/resourceGroupId on QueryMetadata/QueryContext)
+    trace_token: str = ""
+    resource_group: str = ""
 
 
 @dataclass
@@ -119,6 +124,14 @@ class EventListenerManager:
 
     def register(self, listener: EventListener) -> None:
         self._listeners.append(listener)
+
+    def unregister(self, listener: EventListener) -> None:
+        """Detach a listener (server shutdown detaches its history
+        bridge so a closed store never sees another event)."""
+        try:
+            self._listeners.remove(listener)
+        except ValueError:
+            pass
 
     def _fire(self, method: str, event) -> None:
         for listener in self._listeners:

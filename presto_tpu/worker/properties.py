@@ -158,6 +158,8 @@ def execution_config_from_properties(props: Dict[str, str],
                 f"task.plan-validation must be one of {VALIDATION_MODES}, "
                 f"got {mode!r}")
         kw["plan_validation"] = mode
+    if "telemetry.profile-dir" in props:
+        kw["profile_dir"] = props["telemetry.profile-dir"]
     return dataclasses.replace(cfg, **kw) if kw else cfg
 
 
@@ -247,6 +249,18 @@ class SystemConfig:
         ("serving.plan-cache-entries", int, 128),
         ("serving.total-concurrency", int, 0),       # 0 = per-group only
         ("serving.admission-headroom-fraction", float, 0.8),
+        # telemetry export pipeline + query history + device profiler
+        # (presto_tpu/telemetry/)
+        ("telemetry.sink", str, "none"),         # none|jsonl|http|collector
+        ("telemetry.path", str, ""),             # jsonl sink spool file
+        ("telemetry.otlp-endpoint", str, ""),    # http sink collector base
+        ("telemetry.flush-interval", str, "200ms"),
+        ("telemetry.queue-bound", int, 256),
+        ("telemetry.metrics-interval", str, "0s"),  # 0 = no self-scrape
+        ("telemetry.history-path", str, ""),     # "" = in-memory history
+        ("telemetry.history-max-count", int, 200),
+        ("telemetry.history-max-age", str, ""),  # "" = no age bound
+        ("telemetry.profile-dir", str, "/tmp/presto_tpu_profiles"),
     ]
 
     def __init__(self, props: Optional[Dict[str, str]] = None):
@@ -330,6 +344,33 @@ def server_kwargs_from_etc(etc_dir: str) -> Tuple[dict, Dict[str, str]]:
                 "serving.admission-headroom-fraction must be in (0, 1], "
                 f"got {f}")
         kwargs["admission_headroom_fraction"] = f
+    # telemetry export + history (presto_tpu/telemetry/)
+    if "telemetry.sink" in props:
+        kwargs["telemetry_sink"] = props["telemetry.sink"]
+    if "telemetry.path" in props:
+        kwargs["telemetry_path"] = props["telemetry.path"]
+    if "telemetry.otlp-endpoint" in props:
+        kwargs["telemetry_endpoint"] = props["telemetry.otlp-endpoint"]
+    if "telemetry.flush-interval" in props:
+        kwargs["telemetry_flush_interval_s"] = parse_duration(
+            props["telemetry.flush-interval"])
+    if "telemetry.queue-bound" in props:
+        n = int(props["telemetry.queue-bound"])
+        if n < 1:
+            raise ValueError(
+                f"telemetry.queue-bound must be >= 1, got {n}")
+        kwargs["telemetry_queue_bound"] = n
+    if "telemetry.metrics-interval" in props:
+        kwargs["telemetry_metrics_interval_s"] = parse_duration(
+            props["telemetry.metrics-interval"])
+    if "telemetry.history-path" in props:
+        kwargs["history_path"] = props["telemetry.history-path"]
+    if "telemetry.history-max-count" in props:
+        kwargs["history_max_count"] = int(
+            props["telemetry.history-max-count"])
+    if props.get("telemetry.history-max-age"):
+        kwargs["history_max_age_s"] = parse_duration(
+            props["telemetry.history-max-age"])
     # base on the server's tuned defaults (WorkerServer.__init__), not the
     # bare ExecutionConfig — file keys override, absence must not detune
     kwargs["config"] = execution_config_from_properties(

@@ -352,4 +352,8 @@ def apply_session_properties(config, session: Dict[str, str]):
                 f"scan_kernel must be one of {SCAN_KERNEL_MODES}, "
                 f"got {mode!r}")
         kw["scan_kernel"] = mode
+    if "profile" in session:
+        # per-query device profiler capture (telemetry/profiler.py):
+        # wraps execution in jax.profiler.trace() under profile_dir
+        kw["profile"] = str(session["profile"]).lower() == "true"
     return dataclasses.replace(config, **kw) if kw else config

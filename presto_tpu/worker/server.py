@@ -54,6 +54,7 @@ _ROUTES = [
         r"/(?P<slug>[^/]+)/\d+$"), "statement_cancel"),
     ("GET", re.compile(r"^/v1/query$"), "query_list"),
     ("GET", re.compile(r"^/v1/query/(?P<qid>[^/]+)$"), "query_info"),
+    ("GET", re.compile(r"^/v1/cluster$"), "cluster"),
     ("POST", re.compile(r"^/v1/plan-check$"), "plan_check"),
     ("GET", re.compile(r"^/ui/?$"), "ui"),
     ("GET", re.compile(r"^/v1/info/state$"), "info_state"),
@@ -402,6 +403,32 @@ class _Handler(BaseHTTPRequestHandler):
                 lines.append(f"# TYPE presto_tpu_storage_{k}_total counter")
                 lines.append(
                     f"presto_tpu_storage_{k}_total {STORAGE_METRICS[k]}")
+        # telemetry export pipeline + history store counters
+        if s.telemetry is not None:
+            tc = s.telemetry.counters()
+            lines += [
+                "# TYPE presto_tpu_telemetry_enqueued_total counter",
+                f"presto_tpu_telemetry_enqueued_total {tc['enqueued']}",
+                "# TYPE presto_tpu_telemetry_exported_total counter",
+                f"presto_tpu_telemetry_exported_total {tc['exported']}",
+                "# TYPE presto_tpu_telemetry_dropped_total counter",
+                "presto_tpu_telemetry_dropped_total "
+                f"{tc['dropped'] + tc['dropped_after_retry']}",
+                "# TYPE presto_tpu_telemetry_retries_total counter",
+                f"presto_tpu_telemetry_retries_total {tc['retries']}",
+                "# TYPE presto_tpu_telemetry_queue_depth gauge",
+                f"presto_tpu_telemetry_queue_depth {tc['queue_depth']}",
+            ]
+        if s.history is not None:
+            hc = s.history.counters()
+            lines += [
+                "# TYPE presto_tpu_history_entries gauge",
+                f"presto_tpu_history_entries {hc['entries']}",
+                "# TYPE presto_tpu_history_recorded_total counter",
+                f"presto_tpu_history_recorded_total {hc['recorded']}",
+                "# TYPE presto_tpu_history_evicted_total counter",
+                f"presto_tpu_history_evicted_total {hc['evicted']}",
+            ]
         if s.dispatch is not None:
             lines += [
                 "# TYPE presto_tpu_serving_group_running gauge",
@@ -547,16 +574,86 @@ class _Handler(BaseHTTPRequestHandler):
         self._send(204)
 
     def do_query_list(self, groups, query):
+        """/v1/query[?state=...]: the live dispatch registry merged with
+        the durable history store — after a coordinator restart the live
+        registry is empty but ?state=FINISHED still lists what the spool
+        reloaded (reference QueryResource list + system.runtime.queries
+        over completed queries)."""
         d = self._dispatch_mgr()
         if d is None:
             return
-        self._send(200, d.list_queries())
+        state = (query.get("state", [None])[0] or "").upper() or None
+        live = d.list_queries()
+        out = [q for q in live if state is None or q["state"] == state]
+        hist = self.server_ref.history
+        if hist is not None:
+            live_ids = {q["queryId"] for q in live}
+            for rec in hist.list(state=state):
+                if rec["queryId"] in live_ids:
+                    continue  # live registry wins (same terminal record)
+                out.append({
+                    "queryId": rec["queryId"],
+                    "state": rec.get("state", "UNKNOWN"),
+                    "query": rec.get("query", ""),
+                    "user": rec.get("user", ""),
+                    "resourceGroup": rec.get("resourceGroup", ""),
+                    **({"errorMessage": rec["errorMessage"]}
+                       if rec.get("errorMessage") else {})})
+        self._send(200, out)
+
+    def do_cluster(self, groups, query):
+        """/v1/cluster (reference ClusterStatsResource): query counts by
+        lifecycle bucket, task/worker totals, reserved memory from the
+        admission gate, and per-fabric shuffle byte rates.  Terminal
+        counts take the durable history store when it is ahead of the
+        (restart-lossy, eviction-bounded) live registry."""
+        s = self.server_ref
+        d = s.dispatch
+        if d is None:
+            self._send(404, {"error": "not a coordinator"})
+            return
+        by_state: Dict[str, int] = {}
+        for q in d.list_queries():
+            by_state[q["state"]] = by_state.get(q["state"], 0) + 1
+        hist_counts = s.history.counts_by_state() if s.history else {}
+        queued = by_state.get("QUEUED", 0)
+        adm = d.resource_groups.info().get("__admission", {})
+        headroom = adm.get("memoryHeadroomBytes")
+        reserved = adm.get("memoryAdmittedBytes", 0)
+        # memory-gated admission parks queries in QUEUED; when the pool
+        # is exhausted those queued queries are blocked-on-memory
+        blocked = queued if (headroom is not None and queued
+                             and reserved >= headroom) else 0
+        c = s.task_manager.counts()
+        from ..parallel.fabric import FABRIC_METRICS
+        self._send(200, {
+            "runningQueries": by_state.get("RUNNING", 0),
+            "queuedQueries": queued,
+            "blockedQueries": blocked,
+            "finishedQueries": max(by_state.get("FINISHED", 0),
+                                   hist_counts.get("FINISHED", 0)),
+            "failedQueries": max(by_state.get("FAILED", 0),
+                                 hist_counts.get("FAILED", 0)),
+            "canceledQueries": max(by_state.get("CANCELED", 0),
+                                   hist_counts.get("CANCELED", 0)),
+            "activeWorkers": len(s.worker_uris()),
+            "runningTasks": c["by_state"].get("RUNNING", 0),
+            "totalTasks": c["created"],
+            "reservedMemoryBytes": reserved,
+            **({"memoryHeadroomBytes": headroom}
+               if headroom is not None else {}),
+            "fabricByteRates": FABRIC_METRICS.byte_rates(),
+            "historyEntries": len(s.history) if s.history else 0,
+            **({"telemetry": s.telemetry.counters()}
+               if s.telemetry else {}),
+        })
 
     @staticmethod
     def _process_metrics() -> dict:
         """Process-wide metric registries, namespaced consistently with
         the /v1/metrics exposition sections — included in QueryInfo so a
         single snapshot carries both query- and process-scoped state."""
+        from ..exec.kernels.scan_kernel import KERNEL_METRICS
         from ..parallel.fabric import FABRIC_METRICS
         from ..serving import SERVING_METRICS
         from ..storage.store import STORAGE_METRICS
@@ -564,7 +661,8 @@ class _Handler(BaseHTTPRequestHandler):
         return {"exchange": EXCHANGE_METRICS.snapshot(),
                 "fabric": FABRIC_METRICS.snapshot(),
                 "serving": SERVING_METRICS.snapshot(),
-                "storage": dict(STORAGE_METRICS)}
+                "storage": dict(STORAGE_METRICS),
+                "kernel": KERNEL_METRICS.snapshot()}
 
     def do_query_info(self, groups, query):
         d = self._dispatch_mgr()
@@ -573,6 +671,13 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             q = d.get(groups["qid"])
         except KeyError:
+            # fall back to the durable history record: terminal queries
+            # outlive the in-memory registry (eviction, restarts)
+            hist = self.server_ref.history
+            rec = hist.get(groups["qid"]) if hist is not None else None
+            if rec is not None:
+                self._send(200, {**rec, "source": "history"})
+                return
             self._send(404, {"error": "unknown query"})
             return
         # stage/task/operator drill-down: the terminal snapshot captured
@@ -587,6 +692,8 @@ class _Handler(BaseHTTPRequestHandler):
             "queryStats": q.stats(), "session": q.session,
             "resourceGroupId": [q.resource_group],
             "peakMemoryBytes": q.peak_memory_bytes,
+            **({"profileTraceDir": q.profile_trace_dir}
+               if q.profile_trace_dir else {}),
             **({"runtimeStats": q.runtime_stats}
                if q.runtime_stats else {}),
             **({"failureInfo": {"message": q.error}} if q.error else {}),
@@ -729,6 +836,24 @@ collapse}}td,th{{border:1px solid #ccc;padding:4px 8px;text-align:left}}
         self._send(200, {"destroyed": True})
 
 
+class _QuerySpanListener:
+    """EventListener bridging terminal queries to the telemetry exporter
+    (a plain class with the listener surface: the manager dispatches by
+    method name)."""
+
+    def __init__(self, server: "WorkerServer"):
+        self._server = server
+
+    def query_created(self, event) -> None:
+        pass
+
+    def task_completed(self, event) -> None:
+        pass
+
+    def query_completed(self, event) -> None:
+        self._server._export_query_spans(event)
+
+
 class WorkerServer:
     """One worker (or coordinator) process node.  With coordinator=True the
     server also hosts the embedded discovery service, like the reference
@@ -753,7 +878,15 @@ class WorkerServer:
                  plan_cache_entries: Optional[int] = None,
                  total_concurrency: Optional[int] = None,
                  admission_headroom_fraction: Optional[float] = None,
-                 admission_memory_pool=None):
+                 admission_memory_pool=None,
+                 telemetry_sink=None, telemetry_path: str = "",
+                 telemetry_endpoint: str = "",
+                 telemetry_flush_interval_s: float = 0.2,
+                 telemetry_queue_bound: int = 256,
+                 telemetry_metrics_interval_s: float = 0.0,
+                 history_path: Optional[str] = None,
+                 history_max_count: int = 200,
+                 history_max_age_s: Optional[float] = None):
         self.environment = environment
         self.coordinator = coordinator
         self.state = "ACTIVE"            # ACTIVE | SHUTTING_DOWN
@@ -826,6 +959,53 @@ class WorkerServer:
                        if admission_headroom_fraction is not None else {}))
             self.dispatch = DispatchManager(self._execute_statement,
                                             resource_groups, events=events)
+
+        # telemetry export pipeline (presto_tpu/telemetry/): bounded-queue
+        # OTLP span/metric export through the configured sink.  The first
+        # server to configure telemetry owns the process exporter slot that
+        # deep execution layers (tasks, coordinator executions) publish
+        # through; test clusters with several in-process servers share it.
+        self.telemetry = None
+        self._owns_process_exporter = False
+        from ..telemetry import (TelemetryExporter, TelemetrySink,
+                                 get_process_exporter, make_sink,
+                                 set_process_exporter)
+        sink = (telemetry_sink if isinstance(telemetry_sink, TelemetrySink)
+                else make_sink(telemetry_sink or "none",
+                               endpoint=telemetry_endpoint,
+                               path=telemetry_path))
+        if sink is not None:
+            self.telemetry = TelemetryExporter(
+                sink, queue_bound=telemetry_queue_bound,
+                flush_interval_s=telemetry_flush_interval_s,
+                metrics_interval_s=telemetry_metrics_interval_s,
+                resource={"service.name": "presto-tpu",
+                          "service.instance.id": self.node_id,
+                          "deployment.environment": environment})
+            if get_process_exporter() is None:
+                set_process_exporter(self.telemetry)
+                self._owns_process_exporter = True
+
+        # query history service (coordinator role): terminal QueryInfo
+        # records, retention-bounded, reloaded from the JSONL spool across
+        # restarts; fed by QueryCompletedEvent through the dispatch event
+        # manager so failures isolate like any other listener
+        self.history = None
+        self._history_listener = None
+        if coordinator:
+            from ..telemetry import HistoryEventListener, QueryHistoryStore
+            self.history = QueryHistoryStore(
+                history_path or None, max_count=history_max_count,
+                max_age_s=history_max_age_s)
+            self._history_listener = HistoryEventListener(
+                self.history, extra_fields=self._history_extra_fields)
+            self.dispatch.events.register(self._history_listener)
+            # coordinator slice of the distributed trace: query +
+            # per-stage fragment spans exported at terminal state (worker
+            # processes export their own task/operator spans under the
+            # same trace-token-derived trace id)
+            self._span_listener = _QuerySpanListener(self)
+            self.dispatch.events.register(self._span_listener)
 
         # system runtime tables (reference system connector /
         # presto_cpp SystemConnector): SQL-queryable server state.  Only
@@ -956,6 +1136,63 @@ class WorkerServer:
                 self._runner_cache.clear()
         return result
 
+    def _history_extra_fields(self, event) -> dict:
+        """Enrich the history record with state the completed event does
+        not carry: the profiler capture dir and the per-stage breakdown
+        summary of a distributed run."""
+        try:
+            q = self.dispatch.get(event.query_id)
+        except KeyError:
+            return {}
+        extra = {}
+        if q.profile_trace_dir:
+            extra["profileTraceDir"] = q.profile_trace_dir
+        stages = (q.query_info_extra or {}).get("stages")
+        if stages:
+            extra["nStages"] = len(stages)
+            extra["nTasks"] = sum(st.get("nTasks", 0) for st in stages)
+        return extra
+
+    def _export_query_spans(self, event) -> None:
+        """Coordinator-side slice of the distributed trace for one
+        terminal query: a `query` root span plus a `fragment {fid}` span
+        per stage, exported under the trace id derived from the query's
+        trace token.  Worker processes export their own `task ...` /
+        `operator ...` spans with `fragment {fid}` parents, so the
+        deterministic (token, name) span ids stitch both slices into ONE
+        OTLP trace with no id handshake."""
+        exp = self.telemetry
+        if exp is None:
+            from ..telemetry import get_process_exporter
+            exp = get_process_exporter()
+        if exp is None or not event.trace_token:
+            return
+        from ..utils.runtime_stats import Span
+        try:
+            q = self.dispatch.get(event.query_id)
+        except KeyError:
+            q = None
+        started = (q.started_at if q is not None and q.started_at
+                   else event.create_time)
+        spans = [Span("query", "", start=started, end=event.end_time,
+                      attributes={"queryId": event.query_id,
+                                  "sql": event.sql, "user": event.user,
+                                  "state": event.state,
+                                  "rows": event.rows})]
+        extra = q.query_info_extra if q is not None else None
+        for st in (extra or {}).get("stages") or []:
+            fid = st.get("fragmentId", st.get("stageId", 0))
+            wall = float(st.get("wallTimeInNanos", 0) or 0) / 1e9
+            spans.append(Span(
+                f"fragment {fid}", "query", start=started,
+                end=(min(event.end_time, started + wall) if wall
+                     else event.end_time),
+                attributes={"nTasks": st.get("nTasks", 0),
+                            "partitioning": st.get("partitioning", "")}))
+        exp.export_spans(event.trace_token, spans,
+                         resource={"presto.role": "coordinator",
+                                   "presto.node_id": self.node_id})
+
     def live_query_info(self, trace_token: str) -> Optional[dict]:
         """Live stage/task/operator snapshot for a RUNNING distributed
         query, matched to its execution by trace token (the runner cache
@@ -1032,6 +1269,19 @@ class WorkerServer:
                     self._close_runner(r)
                 self._runner_cache.clear()
             self.task_manager.cancel_all()
+            if self.dispatch is not None:
+                if self._history_listener is not None:
+                    self.dispatch.events.unregister(self._history_listener)
+                span_listener = getattr(self, "_span_listener", None)
+                if span_listener is not None:
+                    self.dispatch.events.unregister(span_listener)
+            if self.telemetry is not None:
+                from ..telemetry import (get_process_exporter,
+                                         set_process_exporter)
+                if self._owns_process_exporter and \
+                        get_process_exporter() is self.telemetry:
+                    set_process_exporter(None)
+                self.telemetry.close()
         finally:
             # the listener MUST die even if task teardown raised — a
             # leaked serve_forever thread would outlive the sweep

@@ -280,6 +280,9 @@ class ManagedQuery:
     trace_token: str = ""
     query_info_extra: Optional[dict] = None
     peak_memory_bytes: int = 0
+    # per-query device profiler capture dir (telemetry/profiler.py),
+    # surfaced on /v1/query/{id} and the history record
+    profile_trace_dir: Optional[str] = None
     created_at: float = field(default_factory=time.time)
     started_at: Optional[float] = None
     finished_at: Optional[float] = None
@@ -428,6 +431,8 @@ class DispatchManager:
                 q.runtime_stats = getattr(result, "runtime_stats", None)
                 q.peak_memory_bytes = int(
                     getattr(result, "peak_memory_bytes", 0) or 0)
+                q.profile_trace_dir = getattr(
+                    result, "profile_trace_dir", None)
                 q.added_prepare = getattr(result, "added_prepare", None)
                 q.deallocated_prepare = getattr(
                     result, "deallocated_prepare", None)
@@ -472,7 +477,9 @@ class DispatchManager:
                   else len(q.rows or [])),
             error=error,
             runtime_stats=q.runtime_stats,
-            peak_memory_bytes=q.peak_memory_bytes))
+            peak_memory_bytes=q.peak_memory_bytes,
+            trace_token=q.trace_token,
+            resource_group=q.resource_group))
         # only a query that held a running slot frees one; cancelling a
         # QUEUED query must not over-admit past hardConcurrencyLimit
         if q._admitted:

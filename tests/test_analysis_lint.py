@@ -15,8 +15,9 @@ import pytest
 from presto_tpu.analysis.lint import (ALL_LINT_CODES, KERNEL_INTERPRET,
                                       PRAGMA, SYNC_ASARRAY, SYNC_BRANCH,
                                       SYNC_CAST, SYNC_EXPLICIT, SYNC_NETWORK,
-                                      SYNC_WALLCLOCK, WALL_PRAGMA,
-                                      lint_or_raise, lint_paths, lint_source)
+                                      SYNC_WALLCLOCK, TELEM_UNBOUNDED_QUEUE,
+                                      WALL_PRAGMA, lint_or_raise, lint_paths,
+                                      lint_source)
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -335,9 +336,50 @@ def test_kernels_package_is_sync_and_wall_scoped():
     assert {SYNC_EXPLICIT, SYNC_WALLCLOCK} <= _codes(findings)
 
 
+def test_unbounded_queue_in_telemetry_flagged():
+    """TELEM001: queue.Queue() with no / zero maxsize and SimpleQueue()
+    are unbounded buffers; the telemetry package must bound every
+    queue so a stalled sink drops instead of growing until OOM."""
+    src = ("import queue\n"
+           "a = queue.Queue()\n"
+           "b = queue.Queue(maxsize=0)\n"
+           "c = queue.SimpleQueue()\n"
+           "ok1 = queue.Queue(maxsize=256)\n"
+           "ok2 = queue.Queue(128)\n"
+           "ok3 = queue.Queue(maxsize=bound)\n")
+    findings = lint_source(src, "presto_tpu/telemetry/export.py")
+    assert _codes(findings) == {TELEM_UNBOUNDED_QUEUE}
+    assert [f.line for f in findings] == [2, 3, 4]
+
+
+def test_unbounded_queue_outside_telemetry_not_flagged():
+    src = "import queue\nq = queue.Queue()\n"
+    for path in ("presto_tpu/worker/exchange.py",
+                 "presto_tpu/exec/local_exchange.py"):
+        assert lint_source(src, path) == []
+
+
+def test_telemetry_queue_has_no_pragma_escape():
+    findings = lint_source(
+        "import queue\n"
+        "q = queue.Queue()  # lint: allow-host-sync\n",
+        "presto_tpu/telemetry/export.py")
+    assert _codes(findings) == {TELEM_UNBOUNDED_QUEUE}
+
+
+def test_telemetry_network_scoping():
+    """telemetry/ is network-scoped (SYNC005) except export.py, whose
+    OTLP POSTs run on the exporter's background flush thread."""
+    assert lint_source(_NET_FIXTURE,
+                       path="presto_tpu/telemetry/export.py") == []
+    findings = lint_source(_NET_FIXTURE,
+                           path="presto_tpu/telemetry/history.py")
+    assert _codes(findings) == {SYNC_NETWORK}
+
+
 def test_all_codes_are_exercised_above():
     assert set(ALL_LINT_CODES) == {SYNC_EXPLICIT, SYNC_CAST, SYNC_ASARRAY,
                                    SYNC_BRANCH, SYNC_NETWORK, SYNC_WALLCLOCK,
-                                   KERNEL_INTERPRET}
+                                   KERNEL_INTERPRET, TELEM_UNBOUNDED_QUEUE}
     assert PRAGMA == "lint: allow-host-sync"
     assert WALL_PRAGMA == "lint: allow-wall-clock"

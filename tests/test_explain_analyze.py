@@ -177,7 +177,7 @@ def test_query_info_schema_golden(cluster):
 
     # process metrics ride along for a single-snapshot health read
     assert set(info["processMetrics"]) == {"exchange", "fabric",
-                                           "serving", "storage"}
+                                           "serving", "storage", "kernel"}
     assert "resident_bytes" in info["processMetrics"]["storage"]
 
 

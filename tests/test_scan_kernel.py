@@ -292,13 +292,12 @@ def test_decline_columns_not_resident():
 def test_misaligned_chunk_tail_padded():
     # non-power-of-two chunk capacities are padded up to the pow2 block
     # (tail lanes masked dead by the [lo, hi) live window) instead of
-    # declining the whole scan; the RETIRED ChunkAlignment counter must
-    # stay at 0 for one release so dashboards don't break
+    # declining the whole scan; no decline of any kind may fire
     r = LocalQueryRunner("sf0.01", config=ExecutionConfig(
         scan_kernel="pallas", batch_rows=5000))
     res = r.assert_same_as_reference(Q6)
     assert _kernel_programs(res) >= 1, _declined(res)
-    assert _declined(res).get("ChunkAlignment", 0) == 0
+    assert _declined(res) == {}
 
 
 def test_decline_backend_auto_off_tpu():
@@ -318,7 +317,7 @@ def test_decline_reasons_are_closed():
     # closed
     assert set(KERNEL_DECLINE_REASONS) == {
         "Disabled", "AggFunctionShape", "AggGroupCardinality",
-        "Backend", "PlanShape", "ColumnsNotResident", "ChunkAlignment"}
+        "Backend", "PlanShape", "ColumnsNotResident"}
 
 
 # ---------------------------------------------------------------------------
