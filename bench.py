@@ -11,7 +11,7 @@ vs_baseline = speedup vs the single-threaded numpy reference interpreter
               each round so the ratio tracks engine improvements only.
 
 Env knobs: BENCH_SF (default 10), BENCH_RUNS (default 3),
-BENCH_QUERY (q1|q6|q6z|q3g|xchg|serve).
+BENCH_QUERY (q1|q6|q6z|q3g|xchg|serve|spill).
 
 q1/q6/q6z lines also carry a "scan_kernel" object: best-of-N walls and
 effective_scan_gbps for the same query pinned to scan_kernel=pallas and
@@ -52,6 +52,15 @@ run produced grouped runtime stats, the JSON line gains a
 overlap fraction (1 - run / (gen + compute); 0 means fully serial).
 BENCH_QUERY=q3g is the grouped-eligible shape (TPC-H Q3 keyed on
 l_orderkey, the lineitem/orders bucket column).
+
+BENCH_QUERY=spill is the memory-arbitration benchmark: a q18-shaped
+join+agg run once unconstrained (to measure peak pool reservation),
+then re-run under a budget of BENCH_SPILL_BUDGET_FRACTION of that peak
+(default 0.2, i.e. <25%).  The constrained run must return identical
+rows; the JSON line reports spilled bytes (host + disk tiers), spill
+throughput GB/s, the async-eviction overlap fraction, revocation/
+arbitration counts, and wall_ratio = constrained / unconstrained wall
+— the slowdown paid to run a query ~5x bigger than its memory.
 """
 import json
 import os
@@ -270,6 +279,97 @@ def bench_xchg(runs):
             w.close()
 
 
+# q18 core: every order's total quantity via a lineitem<->orders hash
+# join feeding a high-cardinality grouped aggregation — both the join
+# build and the agg state scale with the data, so a small budget forces
+# the arbitrator to revoke the build into the two-tier spill store
+SPILL = """
+SELECT l_orderkey, max(o_totalprice) AS price, sum(l_quantity) AS qty
+FROM lineitem, orders
+WHERE l_orderkey = o_orderkey
+GROUP BY l_orderkey
+ORDER BY qty DESC, l_orderkey
+LIMIT 100
+"""
+
+
+def bench_spill(runs):
+    """Budget-constrained join+agg: measure the cost of running a query
+    whose working set exceeds the memory pool by ~5x."""
+    import dataclasses
+
+    from presto_tpu.exec.memory import MEMORY_METRICS
+    from presto_tpu.exec.pipeline import ExecutionConfig
+    from presto_tpu.exec.runner import LocalQueryRunner, _assert_rows_equal
+
+    sf = float(os.environ.get("BENCH_SF", "0.1"))
+    fraction = float(os.environ.get("BENCH_SPILL_BUDGET_FRACTION", "0.2"))
+    schema = f"sf{sf:g}"
+
+    from presto_tpu.connectors import tpch
+    n_rows = tpch._table_rows("lineitem", sf)
+
+    # moderate batches: the constrained run's agg-state estimate (and so
+    # its re-partition depth / recompile count) scales with batch size
+    base_cfg = ExecutionConfig(batch_rows=1 << 16, spill_enabled=True)
+    free = LocalQueryRunner(schema=schema, config=base_cfg)
+    free.execute(SPILL)                   # warmup: compiles + faults data
+    free_best, free_result = float("inf"), None
+    peak = 0
+    for _ in range(runs):
+        t0 = time.perf_counter()
+        free_result = free.execute(SPILL)
+        free_best = min(free_best, time.perf_counter() - t0)
+        peak = max(peak, free_result.peak_memory_bytes or 0)
+    assert free_result.rows, "benchmark query returned no rows"
+    assert peak > 0, "unconstrained run recorded no peak reservation"
+
+    budget = max(1, int(peak * fraction))
+    constrained = LocalQueryRunner(schema=schema, config=dataclasses.replace(
+        base_cfg, memory_budget_bytes=budget))
+    constrained.execute(SPILL)            # warmup under the budget
+    MEMORY_METRICS.reset()
+    con_best, con_result = float("inf"), None
+    for _ in range(runs):
+        t0 = time.perf_counter()
+        con_result = constrained.execute(SPILL)
+        con_best = min(con_best, time.perf_counter() - t0)
+    _assert_rows_equal(con_result, free_result, ordered=True)
+    m = MEMORY_METRICS.snapshot()
+
+    spilled = m["spilled_bytes"]
+    out = {
+        "metric": f"spill_sf{sf:g}_rows_per_sec",
+        "value": round(n_rows / con_best, 1),
+        "unit": "rows/s",
+        "wall_s": round(con_best, 4),
+        "unconstrained_wall_s": round(free_best, 4),
+        # the headline: the slowdown paid to run under fraction*peak
+        "wall_ratio": round(con_best / free_best, 3),
+        "spill": {
+            "unconstrained_peak_bytes": peak,
+            "budget_bytes": budget,
+            "budget_fraction": fraction,
+            "spilled_bytes": spilled,
+            "disk_spilled_bytes": m["disk_spilled_bytes"],
+            "unspilled_bytes": m["unspilled_bytes"],
+            "spill_throughput_gbps": round(
+                spilled / m["spill_wall_s"] / 1e9, 3)
+            if m["spill_wall_s"] else 0.0,
+            # fraction of device->host eviction hidden behind operator
+            # compute by the double-buffered staging thread
+            "eviction_overlap_fraction": round(
+                m["spill_overlap_fraction"], 4),
+            "revocations": m["revocations"],
+            "revoked_bytes": m["revoked_bytes"],
+            "arbitrations": m["arbitrations"],
+            "arbitration_failures": m["arbitration_failures"],
+        },
+    }
+    out["process_metrics"] = _process_metrics()
+    print(json.dumps(out))
+
+
 SERVE_SHAPES = [
     # (name, template, [value tuples cycled by the clients])
     ("q6p",
@@ -430,6 +530,8 @@ def main():
         return bench_xchg(runs)
     if qname == "serve":
         return bench_serve(runs)
+    if qname == "spill":
+        return bench_spill(runs)
     sf = float(os.environ.get("BENCH_SF", "10"))
     sql = {"q1": Q1, "q6": Q6, "q6z": Q6, "q3g": Q3G, "q1g": Q1G}[qname]
     if qname == "q1g":

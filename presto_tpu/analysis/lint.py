@@ -45,6 +45,17 @@ and flags the hazard shapes:
            the drop metered) rather than grow until the process OOMs.
            There is NO pragma escape — pass an explicit positive
            maxsize.
+  MEM001   an unbounded host-side STAGING collection in `exec/` or
+           `worker/`: a class initializes a staging-named attribute
+           (`*bucket*`, `*page*`, `*staged*`, `*collected*`,
+           `*pending*`, `*chunk*`, `*spill*`) to an empty list/dict but
+           nowhere references the memory-charging API (try_reserve /
+           register_revocable / note_spill / batch_bytes / a memory
+           context).  Host collections that grow with input size are
+           exactly what made PR 2's retained buffers invisible to every
+           pool; new ones must either charge a memory context or carry
+           `# lint: allow-uncharged-staging` on the initializer
+           acknowledging why their growth is bounded elsewhere.
 
 "Device value" is tracked with a deliberately shallow per-scope
 dataflow: names assigned from `jnp.*` / `lax.*` calls (or expressions
@@ -73,6 +84,7 @@ from typing import Dict, Iterable, List, Optional, Set
 
 PRAGMA = "lint: allow-host-sync"
 WALL_PRAGMA = "lint: allow-wall-clock"
+MEM_PRAGMA = "lint: allow-uncharged-staging"
 
 SYNC_EXPLICIT = "SYNC001"
 SYNC_CAST = "SYNC002"
@@ -82,10 +94,11 @@ SYNC_NETWORK = "SYNC005"
 SYNC_WALLCLOCK = "SYNC006"
 KERNEL_INTERPRET = "KERNEL001"
 TELEM_UNBOUNDED_QUEUE = "TELEM001"
+MEM_UNCHARGED_STAGING = "MEM001"
 
 ALL_LINT_CODES = (SYNC_EXPLICIT, SYNC_CAST, SYNC_ASARRAY, SYNC_BRANCH,
                   SYNC_NETWORK, SYNC_WALLCLOCK, KERNEL_INTERPRET,
-                  TELEM_UNBOUNDED_QUEUE)
+                  TELEM_UNBOUNDED_QUEUE, MEM_UNCHARGED_STAGING)
 
 # KERNEL001 scope: everywhere.  The shim is the ONE file that may select
 # Pallas interpret mode (it gates on the backend); no pragma overrides.
@@ -117,6 +130,21 @@ _WALL_CALLS = {"time.time", "_time.time",
                "time.perf_counter", "_time.perf_counter",
                "time.perf_counter_ns", "_time.perf_counter_ns",
                "time.monotonic", "_time.monotonic"}
+
+# MEM001 scope: the packages whose host-side collections stage QUERY
+# data (rows, pages, spill chunks) and therefore grow with input size.
+# Granularity is the CLASS: a class that references any charging marker
+# is assumed to account for its staging somewhere (the lint is a
+# tripwire, not a flow analysis); one that references none must either
+# start charging or acknowledge each initializer with the pragma.
+_MEM_PATH_MARKERS = ("presto_tpu/exec/", "presto_tpu/worker/")
+import re as _re
+_MEM_STAGING_NAME = _re.compile(
+    r"bucket|page|stag|collect|pending|chunk|spill", _re.IGNORECASE)
+_MEM_CHARGE_MARKERS = {"try_reserve", "reserve", "register_revocable",
+                       "note_spill", "batch_bytes", "MemoryContext",
+                       "MemoryPool", "memory_context"}
+_MEM_EMPTY_CTORS = {"list", "dict", "deque", "defaultdict"}
 
 # TELEM001 scope: the telemetry export package.  A backpressure stall in
 # a sink must hit a bounded queue (metered drop), never unbounded growth.
@@ -173,7 +201,8 @@ def _allowed_lines(source: str) -> Dict[str, Set[int]]:
     The two pragmas are deliberately NOT interchangeable: a host-sync
     acknowledgement must not silence a wall-clock finding on the same
     statement (and vice versa), so each code checks only its own set."""
-    allowed: Dict[str, Set[int]] = {PRAGMA: set(), WALL_PRAGMA: set()}
+    allowed: Dict[str, Set[int]] = {PRAGMA: set(), WALL_PRAGMA: set(),
+                                    MEM_PRAGMA: set()}
     try:
         for tok in tokenize.generate_tokens(io.StringIO(source).readline):
             if tok.type != tokenize.COMMENT:
@@ -195,6 +224,7 @@ class _Linter(ast.NodeVisitor):
         self.path = path
         self.allowed = allowed.get(PRAGMA, set())
         self.wall_allowed = allowed.get(WALL_PRAGMA, set())
+        self.mem_allowed = allowed.get(MEM_PRAGMA, set())
         self.findings: List[LintFinding] = []
         self._device: List[Set[str]] = [set()]
         import os
@@ -204,6 +234,7 @@ class _Linter(ast.NodeVisitor):
             and not any(norm.endswith(a) for a in _NETWORK_ALLOWLIST))
         self._wall_scoped = _WALL_PATH_MARKER in norm
         self._telem_scoped = _TELEM_PATH_MARKER in norm
+        self._mem_scoped = any(m in norm for m in _MEM_PATH_MARKERS)
         self._interpret_exempt = any(
             norm.endswith(a) for a in _INTERPRET_ALLOWLIST)
 
@@ -330,6 +361,60 @@ class _Linter(ast.NodeVisitor):
         self._bind(node.target, self._is_device(node.iter))
         for cond in node.ifs:
             self.visit(cond)
+
+    # -- memory accounting (MEM001) ----------------------------------------
+    def _mem_is_empty_collection(self, value: ast.AST) -> bool:
+        if isinstance(value, ast.List) and not value.elts:
+            return True
+        if isinstance(value, ast.Dict) and not value.keys:
+            return True
+        if isinstance(value, ast.Call):
+            name = _dotted(value.func).rsplit(".", 1)[-1]
+            if name not in _MEM_EMPTY_CTORS:
+                return False
+            if name == "deque":
+                # deque(maxlen=N) is bounded: not a staging hazard
+                return not any(kw.arg == "maxlen" for kw in value.keywords)
+            if name == "defaultdict":
+                return True  # defaultdict(list) grows per key: unbounded
+            return not value.args  # list(xs)/dict(xs) copy, not staging
+        return False
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        if self._mem_scoped:
+            mentioned: Set[str] = set()
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Attribute):
+                    mentioned.add(sub.attr)
+                elif isinstance(sub, ast.Name):
+                    mentioned.add(sub.id)
+            if not mentioned & _MEM_CHARGE_MARKERS:
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Assign):
+                        targets, value = sub.targets, sub.value
+                    elif (isinstance(sub, ast.AnnAssign)
+                          and sub.value is not None):
+                        targets, value = [sub.target], sub.value
+                    else:
+                        continue
+                    for tgt in targets:
+                        if not (isinstance(tgt, ast.Attribute)
+                                and isinstance(tgt.value, ast.Name)
+                                and tgt.value.id == "self"):
+                            continue
+                        if not _MEM_STAGING_NAME.search(tgt.attr):
+                            continue
+                        if self._mem_is_empty_collection(value):
+                            self._flag(
+                                sub, MEM_UNCHARGED_STAGING,
+                                f"class {node.name} stages rows in "
+                                f"self.{tgt.attr} but never charges a "
+                                "memory context (no try_reserve/"
+                                "register_revocable/MemoryContext "
+                                "reference); account the bytes or mark "
+                                f"`# {MEM_PRAGMA}`",
+                                allowed=self.mem_allowed)
+        self.generic_visit(node)
 
     # -- hazards -----------------------------------------------------------
     def visit_Call(self, node: ast.Call) -> None:

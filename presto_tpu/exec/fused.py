@@ -616,8 +616,10 @@ def fused_materialize(compiler, node: P.PlanNode,
     generated connector data is immutable and writes clear the plan cache)
     and sort/window inputs.  Returns None when the subtree is not a
     fusible chain (caller streams instead)."""
-    if compiler.ctx.memory.budget is not None:
-        return None     # budgeted runs keep the accounted streaming path
+    if compiler.ctx.memory.limited:
+        return None     # budgeted/limited runs keep the accounted
+        # streaming path (a bare query.max-memory ceiling still needs
+        # the reservations that enforce it)
     # keyed STRUCTURALLY so replayed subtrees (scalar-subquery re-plans,
     # decorrelated copies — fresh node ids, same shape) share one
     # materialization; on a hit from a twin, columns rename positionally.
@@ -752,7 +754,7 @@ def fused_stream(compiler, node: P.PlanNode):
     compilers so chains consumed by non-aggregation operators (window,
     AssignUniqueId, ...) avoid the per-batch overflow-fetch pattern.
     Returns a Batch iterator or None (caller keeps the classic path)."""
-    if compiler.ctx.memory.budget is not None:
+    if compiler.ctx.memory.limited:
         return None
     analyzing = compiler.ctx.stats is not None
     cfg = compiler.ctx.config

@@ -551,7 +551,8 @@ def stage_shards_lifespans(root: P.PlanNode, cfg) -> bool:
     from .lowering import canonical_name
     if not cfg.grouped_lifespan_sharding or not cfg.fuse_pipelines:
         return False
-    if cfg.grouped_lifespans == 1 or cfg.memory_budget_bytes is not None:
+    if cfg.grouped_lifespans == 1 or cfg.memory_budget_bytes is not None \
+            or cfg.memory_max_query_bytes is not None:
         return False
     node = root
     while isinstance(node, _PEELABLE):

@@ -1,24 +1,47 @@
-"""Device-memory accounting and host-RAM spill staging.
+"""Hierarchical device-memory accounting, revocable arbitration, and
+two-tier (host RAM -> LZ4 disk) spill staging.
 
-TPU analogs of the reference's node-level memory machinery:
-- `MemoryPool` mirrors the worker memory pool + hierarchical contexts
-  (presto-main-base/.../memory/MemoryPool.java:46, LocalMemoryManager.java:39,
-  the presto-memory-context AggregatedMemoryContext tree): operators reserve
-  HBM bytes before materializing and either fall back to spilling or fail
-  with the engine's exceeded-limit error.
+TPU analogs of the reference's memory machinery:
+
+- `MemoryPool` mirrors the worker memory pool (MemoryPool.java:46,
+  LocalMemoryManager.java:39) extended with the reference's RESERVED vs
+  REVOCABLE split (MemoryPool.reserveRevocable, QueryContext.java): a
+  revocable reservation names bytes an operator can give back on demand
+  by spilling (hash join build state, aggregation state, retained output
+  buffers).  Under pressure the pool's arbitrator — the analog of
+  MemoryRevokingScheduler.java:60 — revokes the LARGEST revocable holder
+  through its registered spill callback instead of failing the
+  reservation, so `MemoryExceededError` is raised only when nothing
+  revocable remains.
+- `MemoryContext` is the presto-memory-context AggregatedMemoryContext
+  tree (query -> task -> operator): children bubble reservations up to
+  the root, and a root `max_bytes` is the `query.max-memory` limit —
+  exceeding it is the TYPED user error (EXCEEDED_MEMORY_LIMIT, fail
+  fast, never retried), distinct from pool pressure which arbitration
+  and spill recover from.
 - `PartitionedSpillStore` mirrors partitioned spilling
   (.../spiller/GenericPartitioningSpiller.java, FileSingleStreamSpiller.java:59)
-  with one deliberate difference: on a TPU host the natural spill target is
-  host RAM, not disk — it is orders of magnitude larger than HBM and needs
-  no serialization, playing exactly the role local SSD plays for the
-  reference.  Buckets are key-hash partitions; processing one bucket at a
-  time is the reference's grouped-execution Lifespan model
-  (Lifespan.java:30, GroupedExecutionTagger.java) compressed into the
-  operator that spilled.
+  as a TWO-TIER hierarchy: host RAM is the hot spill tier (orders of
+  magnitude larger than HBM, no serialization), and when staged bytes
+  exceed the host budget whole buckets overflow to LZ4-compressed disk
+  files reusing the SerializedPage block serde — the cold tier local SSD
+  plays for the reference.  Buckets are key-hash partitions; processing
+  one bucket at a time is the reference's grouped-execution Lifespan
+  model (Lifespan.java:30) compressed into the operator that spilled.
+  With `async_staging` the device->host eviction runs double-buffered on
+  a background staging thread so revocation overlaps the operator's
+  continuing compute; the overlap fraction (1 - wait/stage) is metered
+  through RuntimeStats alongside spill/unspill bytes and walls.
 """
 from __future__ import annotations
 
-from typing import Dict, Iterator, List, Optional, Tuple
+import os
+import queue as queue_mod
+import struct
+import tempfile
+import threading
+import time
+from typing import Callable, Dict, Iterator, List, Optional, Tuple
 
 import jax.numpy as jnp
 import numpy as np
@@ -26,37 +49,444 @@ import numpy as np
 from .batch import Batch, Column
 from . import operators as ops
 
+NANO = 1_000_000_000
+
 
 class MemoryExceededError(RuntimeError):
-    """Analog of the reference's EXCEEDED_LOCAL_MEMORY_LIMIT error code."""
+    """Analog of the reference's EXCEEDED_LOCAL_MEMORY_LIMIT error code:
+    pool pressure that spill + arbitration could not absorb.  Classified
+    INSUFFICIENT_RESOURCES (retryable) by common/errors.py."""
+
+
+class QueryMemoryLimitExceededError(MemoryExceededError):
+    """The `query.max-memory` limit (reference EXCEEDED_GLOBAL_MEMORY_LIMIT,
+    ClusterMemoryManager.java): the QUERY asked for more than its
+    configured ceiling.  Unlike pool pressure this is the user's to fix
+    (raise the limit or shrink the query), so it fails fast — the
+    [USER_ERROR] tag and `error_type` keep it non-retryable across the
+    string-typed distributed failure chain."""
+
+    error_type = "USER_ERROR"
+    error_code = "EXCEEDED_MEMORY_LIMIT"
+
+    def __init__(self, used: int, requested: int, limit: int,
+                 context: str = ""):
+        super().__init__(
+            f"[USER_ERROR] EXCEEDED_MEMORY_LIMIT: query memory "
+            f"{used} + {requested} bytes exceeds query.max-memory "
+            f"{limit} bytes" + (f" (context {context})" if context else ""))
+        self.used = used
+        self.requested = requested
+        self.limit = limit
+
+
+# ---------------------------------------------------------------------------
+# process-wide memory metrics (the /v1/metrics presto_tpu_memory_* section,
+# same singleton shape as worker/exchange.py's ExchangeMetrics)
+# ---------------------------------------------------------------------------
+
+class MemoryMetrics:
+    _COUNTERS = ("spilled_bytes", "disk_spilled_bytes", "unspilled_bytes",
+                 "spill_wall_s", "spill_wait_wall_s", "unspill_wall_s",
+                 "revocations", "revoked_bytes", "arbitrations",
+                 "arbitration_failures", "over_free", "over_free_bytes",
+                 "query_limit_failures")
+    _GAUGES = ("reserved_bytes", "revocable_bytes")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.reset()
+
+    def reset(self) -> None:
+        with self._lock:
+            for name in self._COUNTERS + self._GAUGES:
+                setattr(self, name, 0)
+
+    def incr(self, name: str, delta=1) -> None:
+        with self._lock:
+            setattr(self, name, getattr(self, name) + delta)
+
+    def gauge(self, name: str, value) -> None:
+        with self._lock:
+            setattr(self, name, value)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            out = {name: getattr(self, name)
+                   for name in self._COUNTERS + self._GAUGES}
+        stage, wait = out["spill_wall_s"], out["spill_wait_wall_s"]
+        out["spill_overlap_fraction"] = (
+            max(0.0, 1.0 - wait / stage) if stage > 0 else 0.0)
+        return out
+
+
+MEMORY_METRICS = MemoryMetrics()
+
+
+# ---------------------------------------------------------------------------
+# revocable holders + the arbitrated pool
+# ---------------------------------------------------------------------------
+
+class RevocableHolder:
+    """One registered revocable reservation (the analog of an operator's
+    revocable LocalMemoryContext + its OperatorContext.requestMemoryRevoking
+    callback).  `revoke_cb() -> bytes freed` must be NON-BLOCKING: a
+    holder that cannot safely spill right now (its device state is
+    mid-probe) declines by returning 0 and the arbitrator moves to the
+    next-largest victim — blocking here is how arbitration deadlocks."""
+
+    def __init__(self, pool: "MemoryPool", name: str,
+                 revoke_cb: Callable[[], int]):
+        self._pool = pool
+        self.name = name
+        self._revoke_cb = revoke_cb
+        self.bytes = 0
+        self.revoke_requested = False
+        self.closed = False
+
+    def try_reserve(self, n: int, arbitrate: bool = True) -> bool:
+        """Revocable reservation with arbitration of OTHER holders.
+        Callers that hold their own operator lock while charging (the
+        output buffers) MUST pass arbitrate=False and self-spill on
+        failure: entering arbitration under an operator lock is the
+        lock-inversion that deadlocks against that operator's own revoke
+        callback."""
+        if not self._pool.try_reserve(n, revocable=True, exclude=self,
+                                      arbitrate=arbitrate):
+            return False
+        self.bytes += n
+        return True
+
+    def free(self, n: int) -> None:
+        n = min(int(n), self.bytes)
+        if n <= 0:
+            return
+        self.bytes -= n
+        self._pool.free(n, revocable=True)
+
+    def close(self) -> None:
+        """Release whatever is still held and unregister."""
+        if self.closed:
+            return
+        self.closed = True
+        self.free(self.bytes)
+        self._pool._unregister(self)
+
+    def _run_revoke(self) -> int:
+        try:
+            freed = int(self._revoke_cb() or 0)
+        except Exception:
+            return 0
+        if freed > 0:
+            self.revoke_requested = False
+        return freed
 
 
 class MemoryPool:
-    """Byte accounting for one task's device materializations.
+    """Byte accounting for device materializations, with the reference's
+    reserved/revocable split and a built-in arbitrator.
 
     budget=None means unlimited (accounting only — peak still tracked and
-    reported in TaskStatus.memoryReservationInBytes)."""
+    reported in TaskStatus.memoryReservationInBytes).  All mutators are
+    thread-safe: the serving tier shares ONE worker pool across
+    concurrently executing queries."""
 
     def __init__(self, budget: Optional[int] = None):
         self.budget = budget
         self.reserved = 0
+        self.revocable = 0
         self.peak = 0
+        # satellite: MemoryPool.free used to clamp an over-free to 0
+        # silently, hiding reservation-accounting leaks — now every clamp
+        # is counted (memoryOverFree) so leaks surface in tests/metrics
+        self.over_free_count = 0
+        self.over_free_bytes = 0
+        self.revocations = 0
+        self.revoked_bytes = 0
+        self.arbitrations = 0
+        self.spilled_bytes = 0        # host-staged by stores under this pool
+        self.disk_spilled_bytes = 0   # overflowed from host RAM to disk
+        self.unspilled_bytes = 0      # read back for bucket processing
+        self._lock = threading.RLock()
+        # one arbitration pass at a time: revoke callbacks run OUTSIDE the
+        # accounting lock (they free into it) but inside this one, so two
+        # starved threads do not revoke the same victim twice
+        self._arb_lock = threading.Lock()
+        self._holders: List[RevocableHolder] = []
 
-    def try_reserve(self, n: int) -> bool:
-        if self.budget is not None and self.reserved + n > self.budget:
+    # -- reservation ------------------------------------------------------
+    @property
+    def total_reserved(self) -> int:
+        """reserved + revocable: the arbitrated accounting the admission
+        gate and /v1/cluster report."""
+        return self.reserved + self.revocable
+
+    @property
+    def limited(self) -> bool:
+        """Duck-types MemoryContext.limited for code handed a bare pool:
+        a pool enforces nothing beyond its budget."""
+        return self.budget is not None
+
+    def _try_locked(self, n: int, revocable: bool) -> bool:
+        with self._lock:
+            if self.budget is not None \
+                    and self.reserved + self.revocable + n > self.budget:
+                return False
+            if revocable:
+                self.revocable += n
+            else:
+                self.reserved += n
+            total = self.reserved + self.revocable
+            if total > self.peak:
+                self.peak = total
+            return True
+
+    def try_reserve(self, n: int, revocable: bool = False,
+                    exclude: Optional[RevocableHolder] = None,
+                    arbitrate: bool = True) -> bool:
+        if self._try_locked(n, revocable):
+            return True
+        if not arbitrate:
             return False
-        self.reserved += n
-        self.peak = max(self.peak, self.reserved)
-        return True
+        return self._arbitrate(n, revocable, exclude)
 
-    def reserve(self, n: int) -> None:
-        if not self.try_reserve(n):
+    def reserve(self, n: int, revocable: bool = False) -> None:
+        if not self.try_reserve(n, revocable=revocable):
             raise MemoryExceededError(
                 f"memory budget exceeded: reserved {self.reserved} "
-                f"+ {n} > {self.budget} bytes")
+                f"(+{self.revocable} revocable) + {n} > {self.budget} "
+                f"bytes and no revocable memory remains")
 
-    def free(self, n: int) -> None:
-        self.reserved = max(0, self.reserved - n)
+    def free(self, n: int, revocable: bool = False) -> None:
+        with self._lock:
+            held = self.revocable if revocable else self.reserved
+            if n > held:
+                # an over-free means some reservation was double-freed (or
+                # freed with the wrong size) — clamp for safety, but COUNT
+                # it so the leak is visible (memoryOverFree in stats)
+                self.over_free_count += 1
+                self.over_free_bytes += n - held
+                MEMORY_METRICS.incr("over_free")
+                MEMORY_METRICS.incr("over_free_bytes", n - held)
+                n = held
+            if revocable:
+                self.revocable -= n
+            else:
+                self.reserved -= n
+
+    # -- revocable holder registry + arbitration --------------------------
+    def register_revocable(self, name: str,
+                           revoke_cb: Callable[[], int]) -> RevocableHolder:
+        h = RevocableHolder(self, name, revoke_cb)
+        with self._lock:
+            self._holders.append(h)
+        return h
+
+    def _unregister(self, holder: RevocableHolder) -> None:
+        with self._lock:
+            try:
+                self._holders.remove(holder)
+            except ValueError:
+                pass
+
+    def _arbitrate(self, n: int, revocable: bool,
+                   exclude: Optional[RevocableHolder]) -> bool:
+        """The MemoryArbitrator: revoke the largest revocable holder (via
+        its spill callback), retry the reservation, repeat until it fits
+        or nothing revocable remains.  Never blocks on a holder: one that
+        declines (returns 0) is skipped for this pass."""
+        self.arbitrations += 1
+        MEMORY_METRICS.incr("arbitrations")
+        declined: set = set()
+        with self._arb_lock:
+            while True:
+                if self._try_locked(n, revocable):
+                    return True
+                with self._lock:
+                    candidates = [h for h in self._holders
+                                  if h is not exclude and not h.closed
+                                  and h.bytes > 0 and id(h) not in declined]
+                if not candidates:
+                    MEMORY_METRICS.incr("arbitration_failures")
+                    return False
+                victim = max(candidates, key=lambda h: h.bytes)
+                victim.revoke_requested = True
+                freed = victim._run_revoke()
+                if freed <= 0:
+                    declined.add(id(victim))
+                else:
+                    self.revocations += 1
+                    self.revoked_bytes += freed
+                    MEMORY_METRICS.incr("revocations")
+                    MEMORY_METRICS.incr("revoked_bytes", freed)
+
+    # -- spill accounting (fed by PartitionedSpillStore) ------------------
+    def note_spill(self, n: int) -> None:
+        with self._lock:
+            self.spilled_bytes += n
+
+    def note_disk_spill(self, n: int) -> None:
+        with self._lock:
+            self.disk_spilled_bytes += n
+
+    def note_unspill(self, n: int) -> None:
+        with self._lock:
+            self.unspilled_bytes += n
+
+    def stats_dict(self) -> dict:
+        with self._lock:
+            return {
+                "reservedBytes": self.reserved,
+                "revocableBytes": self.revocable,
+                "totalReservedBytes": self.reserved + self.revocable,
+                "peakBytes": self.peak,
+                "spilledBytes": self.spilled_bytes,
+                "diskSpilledBytes": self.disk_spilled_bytes,
+                "unspilledBytes": self.unspilled_bytes,
+                "revocations": self.revocations,
+                "revokedBytes": self.revoked_bytes,
+                "arbitrations": self.arbitrations,
+                "memoryOverFree": self.over_free_count,
+                "memoryOverFreeBytes": self.over_free_bytes,
+            }
+
+
+class MemoryContext:
+    """One node of the query -> task -> operator context tree (reference
+    AggregatedMemoryContext / QueryContext.java): reservations bubble up
+    to the root so a query's aggregate usage is enforceable wherever its
+    tasks run.  A root `max_bytes` is the query.max-memory ceiling —
+    REVOCABLE bytes are exempt (matching the reference, where revocable
+    memory does not count against the query limit: it is the engine's to
+    reclaim by spilling, not the query's footprint).
+
+    Duck-types the MemoryPool reservation surface (budget / peak /
+    reserved / try_reserve / reserve / free / register_revocable /
+    note_spill...) so a context slots in wherever TaskContext.memory
+    carried a bare pool."""
+
+    def __init__(self, pool: MemoryPool, name: str = "query",
+                 parent: Optional["MemoryContext"] = None,
+                 max_bytes: Optional[int] = None):
+        self.pool = pool
+        self.name = name
+        self.parent = parent
+        self.max_bytes = max_bytes
+        self.reserved = 0
+        self.revocable = 0
+        self.peak = 0
+
+    def new_child(self, name: str) -> "MemoryContext":
+        return MemoryContext(self.pool, name, parent=self)
+
+    @property
+    def budget(self):
+        return self.pool.budget
+
+    @property
+    def limited(self) -> bool:
+        """True when reservations must be accounted: the pool carries a
+        budget, or this context (or an ancestor) carries a
+        `query.max-memory` ceiling.  The unbudgeted fast paths (fused
+        single-program execution, unreserved build seeding, HBM result
+        caches) key off this rather than `budget` so a bare limit still
+        engages the reservation bookkeeping that enforces it."""
+        if self.pool.budget is not None:
+            return True
+        node = self
+        while node is not None:
+            if node.max_bytes is not None:
+                return True
+            node = node.parent
+        return False
+
+    # -- tree bookkeeping -------------------------------------------------
+    def _check_limit_up(self, n: int) -> None:
+        node = self
+        while node is not None:
+            if node.max_bytes is not None \
+                    and node.reserved + n > node.max_bytes:
+                MEMORY_METRICS.incr("query_limit_failures")
+                raise QueryMemoryLimitExceededError(
+                    node.reserved, n, node.max_bytes, context=node.name)
+            node = node.parent
+
+    def _apply_up(self, n: int, revocable: bool) -> None:
+        node = self
+        while node is not None:
+            if revocable:
+                node.revocable += n
+            else:
+                node.reserved += n
+            total = node.reserved + node.revocable
+            if total > node.peak:
+                node.peak = total
+            node = node.parent
+
+    # -- reservation (pool surface) ---------------------------------------
+    def try_reserve(self, n: int, revocable: bool = False,
+                    exclude: Optional[RevocableHolder] = None,
+                    arbitrate: bool = True) -> bool:
+        with self.pool._lock:
+            if not revocable:
+                self._check_limit_up(n)
+        if not self.pool.try_reserve(n, revocable=revocable,
+                                     exclude=exclude, arbitrate=arbitrate):
+            return False
+        with self.pool._lock:
+            self._apply_up(n, revocable)
+        return True
+
+    def reserve(self, n: int, revocable: bool = False) -> None:
+        if not self.try_reserve(n, revocable=revocable):
+            raise MemoryExceededError(
+                f"memory budget exceeded: reserved {self.pool.reserved} "
+                f"(+{self.pool.revocable} revocable) + {n} > "
+                f"{self.pool.budget} bytes and no revocable memory remains")
+
+    def free(self, n: int, revocable: bool = False) -> None:
+        self.pool.free(n, revocable=revocable)
+        with self.pool._lock:
+            held = self.revocable if revocable else self.reserved
+            self._apply_up(-min(n, held), revocable)
+
+    # -- pass-throughs ----------------------------------------------------
+    def register_revocable(self, name: str,
+                           revoke_cb: Callable[[], int]) -> RevocableHolder:
+        # the holder charges THROUGH this context (so revocable bytes
+        # bubble up the tree) but registers with the root pool, where the
+        # arbitrator looks for victims
+        h = RevocableHolder(self, f"{self.name}/{name}", revoke_cb)
+        with self.pool._lock:
+            self.pool._holders.append(h)
+        return h
+
+    def _unregister(self, holder: RevocableHolder) -> None:
+        self.pool._unregister(holder)
+
+    def note_spill(self, n: int) -> None:
+        self.pool.note_spill(n)
+
+    def note_disk_spill(self, n: int) -> None:
+        self.pool.note_disk_spill(n)
+
+    def note_unspill(self, n: int) -> None:
+        self.pool.note_unspill(n)
+
+    @property
+    def spilled_bytes(self) -> int:
+        return self.pool.spilled_bytes
+
+    @property
+    def total_reserved(self) -> int:
+        return self.reserved + self.revocable
+
+    def stats_dict(self) -> dict:
+        d = self.pool.stats_dict()
+        d["contextReservedBytes"] = self.reserved
+        d["contextRevocableBytes"] = self.revocable
+        d["contextPeakBytes"] = self.peak
+        return d
 
 
 def batch_bytes(batch: Batch) -> int:
@@ -68,7 +498,27 @@ def batch_bytes(batch: Batch) -> int:
     return int(total)
 
 
+# ---------------------------------------------------------------------------
+# two-tier partitioned spill store
+# ---------------------------------------------------------------------------
+
 _SPILL_SALT = 0x511
+
+# staging queue depth 2 = classic double buffering: the operator fills
+# batch k+1 while the staging thread evicts batch k; a third slot would
+# only add host-RAM pressure without more overlap
+_STAGING_DEPTH = 2
+_STAGING_STOP = object()
+
+
+def _np_to_block_view(v: np.ndarray):
+    """View an array as the width-matched signed-int dtype the fixed-width
+    block serde carries (the wire just sees bits); None when the shape or
+    width has no fixed-width encoding."""
+    if v.ndim != 1 or v.dtype.itemsize not in (1, 2, 4, 8) \
+            or v.dtype.kind not in "fuib":
+        return None
+    return v.view(np.dtype(f"i{v.dtype.itemsize}"))
 
 
 class PartitionedSpillStore:
@@ -78,10 +528,28 @@ class PartitionedSpillStore:
     hash(keys) % K; `bucket_batches` re-uploads one bucket as device
     Batches.  The same key columns (and salt) on two stores route equal
     keys to equal bucket indices, which is what the grace hash join and
-    partitioned aggregation rely on."""
+    partitioned aggregation rely on.
+
+    Tiering: staged rows live in host RAM up to `budget_bytes`; past it
+    the largest resident bucket overflows to an LZ4-compressed disk file
+    (one per store, under `spill_path`) via the SerializedPage block
+    serde, chunk order preserved so re-reading is bit-identical to the
+    unspilled run.  Without a disk path the old behavior stands: the
+    host budget raises (spilling must not itself OOM the host).
+
+    `async_staging` moves the device->host transfer + routing onto a
+    double-buffered background thread so eviction overlaps the producing
+    operator's compute; `add` only blocks when both staging slots are
+    busy, and that wait is metered (spillWaitWallNanos) against the
+    thread's stage wall (spillStageWallNanos) to report the overlap
+    fraction.  Chunks are staged strictly FIFO, so routing results are
+    identical to the synchronous path."""
 
     def __init__(self, k: int, salt: int = _SPILL_SALT,
-                 budget_bytes: Optional[int] = None):
+                 budget_bytes: Optional[int] = None,
+                 spill_path: Optional[str] = None,
+                 stats=None, async_staging: bool = False,
+                 pool=None):
         self.k = k
         self.salt = salt
         self.buckets: List[List[Dict[str, Tuple[np.ndarray,
@@ -89,13 +557,104 @@ class PartitionedSpillStore:
             [[] for _ in range(k)]
         self.meta: Dict[str, Tuple] = {}     # column -> (dictionary, lazy)
         self.rows = [0] * k
-        self.bytes = [0] * k
-        self.spilled_bytes = 0
+        self.bytes = [0] * k                 # logical bytes (both tiers)
+        self.host_bytes = [0] * k            # resident host-RAM bytes only
+        self.spilled_bytes = 0               # cumulative staged bytes
+        self.disk_bytes = 0                  # cumulative disk-written bytes
+        self.unspilled_bytes = 0             # cumulative disk re-reads
         # host-RAM ceiling for staged rows: spilling must not itself OOM
         # the host (reference spiller's max-spill-size); None = unlimited
         self.budget_bytes = budget_bytes
+        self.spill_path = spill_path
+        self.stats = stats                   # RuntimeStats sink (optional)
+        self.pool = pool                     # MemoryPool/MemoryContext sink
+        # disk tier state: one append-only file of serialized chunks;
+        # per-bucket ordered record lists keep original chunk order
+        self._disk_file: Optional[str] = None
+        self._disk_records: List[List[Tuple[int, int, list, int]]] = \
+            [[] for _ in range(k)]           # (offset, length, cols, rows)
+        # async staging state
+        self.async_staging = bool(async_staging)
+        self._q: Optional[queue_mod.Queue] = None
+        self._thread: Optional[threading.Thread] = None
+        self._stage_err: Optional[BaseException] = None
+        self._stage_wall = 0.0               # staging-thread eviction wall
+        self._wait_wall = 0.0                # producer blocked on staging
+        self._reported = False
 
+    # -- staging (device -> host, tier 1) ---------------------------------
     def add(self, batch: Batch, key_names: List[str]) -> None:
+        if not self.async_staging:
+            self._stage(batch, list(key_names))
+            return
+        self._raise_staging_error()
+        if self._thread is None:
+            self._q = queue_mod.Queue(maxsize=_STAGING_DEPTH)
+            self._thread = threading.Thread(
+                target=self._staging_loop, name="spill-staging", daemon=True)
+            self._thread.start()
+        t0 = time.perf_counter()  # lint: allow-wall-clock
+        self._q.put((batch, list(key_names)))
+        self._wait_wall += time.perf_counter() - t0  # lint: allow-wall-clock
+
+    def _staging_loop(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is _STAGING_STOP:
+                self._q.task_done()
+                return
+            t0 = time.perf_counter()  # lint: allow-wall-clock
+            try:
+                if self._stage_err is None:
+                    self._stage(*item)
+            except BaseException as e:  # propagated at the next add/drain
+                self._stage_err = e
+            finally:
+                self._stage_wall += \
+                    time.perf_counter() - t0  # lint: allow-wall-clock
+                self._q.task_done()
+
+    def _raise_staging_error(self) -> None:
+        if self._stage_err is not None:
+            err, self._stage_err = self._stage_err, None
+            raise err
+
+    def drain(self) -> None:
+        """Wait for in-flight staging, stop the thread, and report the
+        spill walls + overlap fraction once.  Reads go through here, so
+        every consumer sees fully staged buckets."""
+        if self._thread is not None:
+            t0 = time.perf_counter()  # lint: allow-wall-clock
+            self._q.put(_STAGING_STOP)
+            self._q.join()
+            self._thread.join()
+            self._wait_wall += \
+                time.perf_counter() - t0  # lint: allow-wall-clock
+            self._thread = None
+            self._q = None
+        self._raise_staging_error()
+        self._report_staging()
+
+    def _report_staging(self) -> None:
+        if self._reported or self.spilled_bytes == 0:
+            return
+        self._reported = True
+        MEMORY_METRICS.incr("spill_wall_s", self._stage_wall)
+        MEMORY_METRICS.incr("spill_wait_wall_s", self._wait_wall)
+        if self.stats is not None:
+            self.stats.add("spillBytes", self.spilled_bytes, "BYTE")
+            if self.disk_bytes:
+                self.stats.add("spillDiskBytes", self.disk_bytes, "BYTE")
+            if self._stage_wall > 0:
+                self.stats.add("spillStageWallNanos",
+                               self._stage_wall * NANO, "NANO")
+                self.stats.add("spillWaitWallNanos",
+                               self._wait_wall * NANO, "NANO")
+                self.stats.add(
+                    "spillOverlapFraction",
+                    max(0.0, 1.0 - self._wait_wall / self._stage_wall))
+
+    def _stage(self, batch: Batch, key_names: List[str]) -> None:
         key_cols = [batch.columns[n] for n in key_names]
         h = np.asarray(ops.hash_columns(key_cols, self.salt)) \
             % np.uint64(self.k)
@@ -117,17 +676,114 @@ class PartitionedSpillStore:
             nb = sum(v.nbytes + (0 if m is None else m.nbytes)
                      for v, m in rows.values())
             self.bytes[p] += nb
+            self.host_bytes[p] += nb
             self.spilled_bytes += nb
-            if self.budget_bytes is not None \
-                    and self.spilled_bytes > self.budget_bytes:
+            MEMORY_METRICS.incr("spilled_bytes", nb)
+            if self.pool is not None:
+                self.pool.note_spill(nb)
+        self._enforce_host_budget()
+
+    # -- tier 2: disk overflow --------------------------------------------
+    def _enforce_host_budget(self) -> None:
+        if self.budget_bytes is None:
+            return
+        while sum(self.host_bytes) > self.budget_bytes:
+            p = max(range(self.k), key=lambda i: self.host_bytes[i])
+            if self.host_bytes[p] == 0 or not self._flush_bucket(p):
                 raise MemoryExceededError(
                     f"spill store exceeds host budget "
                     f"{self.budget_bytes} bytes "
-                    f"({self.spilled_bytes} staged)")
+                    f"({sum(self.host_bytes)} staged) and no disk "
+                    f"spill path is configured")
 
+    def _open_disk(self):
+        if self._disk_file is None:
+            d = self.spill_path
+            if d:
+                os.makedirs(d, exist_ok=True)
+            fd, self._disk_file = tempfile.mkstemp(
+                prefix="presto-spill-", suffix=".bin", dir=d or None)
+            os.close(fd)
+        return open(self._disk_file, "ab")
+
+    def _flush_bucket(self, p: int) -> bool:
+        """Move bucket p's resident chunks to the disk file (in chunk
+        order, so a later read is bit-identical to the resident run)."""
+        if self.spill_path is None and self._disk_file is None \
+                and not self._spill_dir_default():
+            return False
+        chunks, self.buckets[p] = self.buckets[p], []
+        freed = self.host_bytes[p]
+        self.host_bytes[p] = 0
+        with self._open_disk() as f:
+            for rows in chunks:
+                offset = f.tell()
+                payload, cols, nrows = _chunk_to_bytes(rows)
+                f.write(payload)
+                self._disk_records[p].append(
+                    (offset, len(payload), cols, nrows))
+                self.disk_bytes += len(payload)
+                MEMORY_METRICS.incr("disk_spilled_bytes", len(payload))
+                if self.pool is not None:
+                    self.pool.note_disk_spill(len(payload))
+        del chunks
+        return freed > 0
+
+    def _spill_dir_default(self) -> bool:
+        """No explicit spill path: overflow into the system temp dir
+        rather than fail — `spill.path` pins the location for real
+        deployments (fast local SSD)."""
+        self.spill_path = tempfile.gettempdir()
+        return True
+
+    def _load_disk_chunks(self, p: int) -> List[dict]:
+        records = self._disk_records[p]
+        if not records:
+            return []
+        t0 = time.perf_counter()  # lint: allow-wall-clock
+        out = []
+        with open(self._disk_file, "rb") as f:
+            for offset, length, cols, nrows in records:
+                f.seek(offset)
+                out.append(_chunk_from_bytes(f.read(length), cols, nrows))
+                self.unspilled_bytes += length
+                if self.pool is not None:
+                    self.pool.note_unspill(length)
+        wall = time.perf_counter() - t0  # lint: allow-wall-clock
+        MEMORY_METRICS.incr("unspilled_bytes",
+                            sum(r[1] for r in records))
+        MEMORY_METRICS.incr("unspill_wall_s", wall)
+        if self.stats is not None:
+            self.stats.add("unspillBytes",
+                           sum(r[1] for r in records), "BYTE")
+            self.stats.add("unspillWallNanos", wall * NANO, "NANO")
+        return out
+
+    def close(self) -> None:
+        """Drop the staging thread and the disk file (idempotent)."""
+        try:
+            self.drain()
+        except Exception:
+            pass
+        if self._disk_file is not None:
+            try:
+                os.unlink(self._disk_file)
+            except OSError:
+                pass
+            self._disk_file = None
+
+    def __del__(self):  # best-effort: stores are operator-scoped
+        try:
+            if self._disk_file is not None:
+                os.unlink(self._disk_file)
+        except Exception:
+            pass
+
+    # -- reads (host -> device) -------------------------------------------
     def bucket_batches(self, p: int, capacity: int) -> Iterator[Batch]:
         """Re-upload bucket p as device Batches of at most `capacity` rows."""
-        chunks = self.buckets[p]
+        self.drain()
+        chunks = self._load_disk_chunks(p) + self.buckets[p]
         if not chunks:
             return
         names = list(chunks[0])
@@ -161,7 +817,62 @@ class PartitionedSpillStore:
             yield Batch(cols, jnp.asarray(mask))
 
     def bucket_rows(self, p: int) -> int:
+        self.drain()
         return self.rows[p]
 
     def bucket_bytes(self, p: int) -> int:
+        self.drain()
         return self.bytes[p]
+
+
+# ---------------------------------------------------------------------------
+# disk-chunk serde (reuses the SerializedPage block framing + LZ4 gate)
+# ---------------------------------------------------------------------------
+
+def _chunk_to_bytes(rows: Dict[str, Tuple[np.ndarray,
+                                          Optional[np.ndarray]]]
+                    ) -> Tuple[bytes, list, int]:
+    """One staged chunk -> length-prefixed JSON column descriptor + an
+    LZ4-compressed SerializedPage.  Values ride as width-matched
+    fixed-width blocks (float64 -> LONG_ARRAY bits, bool -> BYTE_ARRAY);
+    null masks ride as their own BYTE_ARRAY channel so null positions'
+    VALUE bits survive the round trip exactly."""
+    from ..common.page import Page
+    from ..common.block import FixedWidthBlock
+    from ..common import serde
+    blocks, cols = [], []
+    nrows = 0
+    for name in rows:
+        v, m = rows[name]
+        nrows = len(v)
+        iv = _np_to_block_view(v)
+        if iv is None:
+            raise MemoryExceededError(
+                f"column {name!r} dtype {v.dtype}/{v.ndim}d has no "
+                f"fixed-width disk-spill encoding")
+        blocks.append(FixedWidthBlock(iv))
+        cols.append([name, v.dtype.str, m is not None])
+        if m is not None:
+            blocks.append(FixedWidthBlock(m.view(np.int8)))
+    page = serde.serialize_page(Page(blocks, nrows), compress=True,
+                                codec="LZ4")
+    return struct.pack("<i", len(page)) + page, cols, nrows
+
+
+def _chunk_from_bytes(payload: bytes, cols: list, nrows: int
+                      ) -> Dict[str, Tuple[np.ndarray,
+                                           Optional[np.ndarray]]]:
+    from ..common import serde
+    (plen,) = struct.unpack_from("<i", payload, 0)
+    page, _ = serde.deserialize_page(payload[4:4 + plen], codec="LZ4")
+    out: Dict[str, Tuple[np.ndarray, Optional[np.ndarray]]] = {}
+    i = 0
+    for name, dtype_str, has_nulls in cols:
+        values = page.blocks[i].values.view(np.dtype(dtype_str))
+        i += 1
+        nulls = None
+        if has_nulls:
+            nulls = page.blocks[i].values.view(np.bool_)
+            i += 1
+        out[name] = (values, nulls)
+    return out

@@ -21,6 +21,7 @@ from __future__ import annotations
 import dataclasses
 import itertools
 import json
+import threading
 from dataclasses import dataclass, field
 from functools import partial
 from typing import Callable, Dict, Iterator, List, Optional, Tuple
@@ -40,7 +41,8 @@ from .batch import (Batch, Column, batch_to_page, page_to_batch,
                     pages_to_batches)
 from . import operators as ops
 from .lowering import Lowering, canonical_name, expr_has_params
-from .memory import (MemoryExceededError, MemoryPool, PartitionedSpillStore,
+from .memory import (MemoryContext, MemoryExceededError, MemoryPool,
+                     PartitionedSpillStore, QueryMemoryLimitExceededError,
                      batch_bytes)
 
 DEFAULT_CAPACITY = 1 << 20
@@ -134,9 +136,23 @@ class ExecutionConfig:
     memory_budget_bytes: Optional[int] = None   # None = unlimited
     spill_enabled: bool = True
     spill_partitions: int = 8
-    # host-RAM ceiling for spill staging (None = unlimited); enforced by
-    # PartitionedSpillStore so spilling cannot OOM the host
+    # host-RAM ceiling for spill staging (None = unlimited); past it
+    # whole buckets overflow to LZ4-compressed disk files (the second
+    # spill tier) — config key spill.host-budget-bytes
     spill_budget_bytes: Optional[int] = None
+    # directory for tier-2 spill files (config key spill.path); None =
+    # the system temp dir.  Real deployments pin this to fast local SSD
+    spill_path: Optional[str] = None
+    # stage device->host spill transfers on a double-buffered background
+    # thread so eviction overlaps the operator's continuing compute
+    # (spillOverlapFraction meters the achieved overlap); False runs the
+    # old synchronous staging.  Config key spill.async-staging
+    spill_async_staging: bool = True
+    # query-level memory ceiling (reference query.max-memory /
+    # EXCEEDED_MEMORY_LIMIT): exceeding it is a TYPED USER error that
+    # fails fast, unlike pool pressure which spill/arbitration absorb.
+    # Revocable (spillable) reservations are exempt.  None = unlimited
+    memory_max_query_bytes: Optional[int] = None
     # compile scan→filter/project→direct-agg chains into ONE XLA program
     # (fori_loop over split chunks): eliminates per-batch dispatch overhead
     fuse_pipelines: bool = True
@@ -378,10 +394,134 @@ class BatchSource:
         return self._fn()
 
 
+class _RevocableBuildBuffer:
+    """Join build-side staging whose reservation is REVOCABLE: under
+    memory pressure the arbitrator converts the collected device batches
+    into the partitioned host spill store (the grace-join input) via the
+    registered callback instead of the query failing (reference:
+    HashBuilderOperator's revocable memory + MemoryRevokingScheduler).
+
+    Locking discipline — the two rules that keep arbitration deadlock-
+    free: (1) `add` reserves BEFORE taking the buffer lock, because the
+    arbitrator may pick this very holder as its victim while the
+    reservation waits; (2) the revoke callback never blocks — if the
+    buffer is mid-mutation it declines (returns 0) and the arbitrator
+    moves to the next victim."""
+
+    def __init__(self, compiler: "PlanCompiler", keys, spill_enabled: bool):
+        self._compiler = compiler
+        self._pool = compiler.ctx.memory
+        self._keys = list(keys)
+        self._spill_enabled = spill_enabled
+        self._lock = threading.Lock()
+        self._finished = False
+        self.collected: List[Batch] = []
+        self.spill = None
+        self._reserved = 0
+        self._table_bytes = 0
+        self._holder = self._pool.register_revocable(
+            "join-build", self._revoke)
+
+    # -- arbitrator-facing -------------------------------------------------
+    def _revoke(self) -> int:
+        if not self._spill_enabled:
+            return 0
+        if not self._lock.acquire(blocking=False):
+            return 0   # mid-mutation: decline, never block
+        try:
+            if self._finished or not self._reserved:
+                return 0
+            return self._spill_locked()
+        finally:
+            self._lock.release()
+
+    def _spill_locked(self) -> int:
+        freed = self._reserved
+        if self.spill is None:
+            self.spill = self._compiler._new_spill_store()
+        for cb in self.collected:
+            self.spill.add(cb, self._keys)
+        self.collected = []
+        if freed:
+            self._holder.free(freed)
+            self._reserved = 0
+        return freed
+
+    # -- build-loop-facing -------------------------------------------------
+    def add(self, b: Batch) -> None:
+        nb = batch_bytes(b)
+        ok = self.spill is None and self._holder.try_reserve(nb)
+        with self._lock:
+            if ok and self.spill is None:
+                self.collected.append(b)
+                self._reserved += nb
+                return
+            if ok:
+                # revoked between the reservation and the lock: the
+                # batch is headed for the store, give the bytes back
+                self._holder.free(nb)
+            if self.spill is None:
+                if not self._spill_enabled:
+                    raise MemoryExceededError(
+                        f"join build side exceeds memory budget "
+                        f"{self._pool.budget} bytes and spill is disabled")
+                self._spill_locked()
+            self.spill.add(b, self._keys)
+
+    def seed(self, batches: List[Batch]) -> None:
+        """Pre-collected batches with no reservation (the fused
+        materialization path, which only runs unbudgeted)."""
+        with self._lock:
+            self.collected.extend(batches)
+
+    def finish(self):
+        """-> (collected, spill).  Stops revocation: past this point the
+        batches feed the device hash table, which spilling the staging
+        copy cannot shrink — so the bytes stop being revocable and are
+        re-charged as plain user memory (covering the table until
+        close()).  The re-charge is where the `query.max-memory` ceiling
+        fires (typed, fail-fast; reference: revocable memory converts to
+        user memory when HashBuilder finishes revoking); plain pool
+        pressure at the handoff instead converts the build into a grace
+        hash join spill."""
+        with self._lock:
+            if self._reserved and self.spill is None:
+                n = self._reserved
+                self._holder.free(n)
+                self._reserved = 0
+                if self._pool.try_reserve(n):
+                    self._table_bytes = n
+                elif self._spill_enabled:
+                    self._spill_locked()
+                else:
+                    self._finished = True
+                    raise MemoryExceededError(
+                        f"join build table of {n} bytes exceeds memory "
+                        f"budget {self._pool.budget} bytes and spill is "
+                        f"disabled")
+            self._finished = True
+            return self.collected, self.spill
+
+    def close(self) -> None:
+        with self._lock:
+            self._finished = True
+            self._holder.close()   # frees whatever is still reserved
+            if self._table_bytes:
+                self._pool.free(self._table_bytes)
+                self._table_bytes = 0
+            self.collected = []
+            self._reserved = 0
+
+
 class PlanCompiler:
     def __init__(self, ctx: TaskContext):
         if ctx.memory is None:
-            ctx.memory = MemoryPool(ctx.config.memory_budget_bytes)
+            # a fresh query-level context over its own pool: the
+            # query.max-memory ceiling applies even when nobody handed us
+            # a worker-shared pool (LocalQueryRunner, EXPLAIN ANALYZE)
+            ctx.memory = MemoryContext(
+                MemoryPool(ctx.config.memory_budget_bytes), "query",
+                max_bytes=ctx.config.memory_max_query_bytes)
         self.ctx = ctx
         self._sources: Dict[str, BatchSource] = {}
         self.lowering = Lowering()
@@ -402,6 +542,19 @@ class PlanCompiler:
         if ent is None:
             ent = cache.setdefault(key, jax.jit(fn, **kw))
         return ent
+
+    def _new_spill_store(self, salt: Optional[int] = None
+                         ) -> PartitionedSpillStore:
+        """One place wires the two-tier + async-staging spill config into
+        every operator's store, so spill bytes/walls always land in this
+        query's RuntimeStats and memory context."""
+        cfg = self.ctx.config
+        kw = {} if salt is None else {"salt": salt}
+        return PartitionedSpillStore(
+            cfg.spill_partitions, budget_bytes=cfg.spill_budget_bytes,
+            spill_path=cfg.spill_path, stats=self.ctx.runtime_stats,
+            async_staging=cfg.spill_async_staging, pool=self.ctx.memory,
+            **kw)
 
     # -- public -----------------------------------------------------------
     def compile(self, root: P.PlanNode) -> BatchSource:
@@ -569,7 +722,7 @@ class PlanCompiler:
         cfg = self.ctx.config
         cached_cols: Dict[str, object] = {}
         zone_maps: Dict[str, object] = {}
-        if self.ctx.memory.budget is None and dev and cfg.storage_enabled:
+        if not self.ctx.memory.limited and dev and cfg.storage_enabled:
             from ..storage import get_store
             store = get_store(cfg.storage_budget_bytes,
                               cfg.storage_max_column_bytes)
@@ -1507,9 +1660,10 @@ class PlanCompiler:
             min/max, then collision-free scatter-direct), hash table."""
             analyzing = self.ctx.stats is not None
             pool = self.ctx.memory
-            if pool.budget is not None:
-                # budgeted execution keeps the streaming path: its build
-                # reservation / grace-spill machinery owns memory discipline
+            if pool.limited:
+                # budgeted (or query.max-memory-limited) execution keeps
+                # the streaming path: its build reservation / grace-spill
+                # machinery owns memory discipline
                 _fusion_declined("BudgetedPool")
                 return None
             # build tables are deterministic per plan (generated connectors
@@ -1528,6 +1682,8 @@ class PlanCompiler:
             if prep_res is None:
                 try:
                     prep_res = chain.prep()
+                except QueryMemoryLimitExceededError:
+                    raise   # typed user error: fail fast, never fall back
                 except (NotImplementedError, MemoryExceededError):
                     _fusion_declined("PrepUnsupported")
                     return None
@@ -2139,8 +2295,7 @@ class PlanCompiler:
             hash path splits to 4 because its per-KEY state always
             shrinks with more partitions."""
             salt2 = bstore.salt * 33 + 0x9E37
-            sub = PartitionedSpillStore(cfg.spill_partitions, salt2,
-                                        budget_bytes=cfg.spill_budget_bytes)
+            sub = self._new_spill_store(salt2)
             for bb in bstore.bucket_batches(p, cfg.batch_rows):
                 sub.add(bb, list(key_names))
             work.extend((sub, q, depth + 1)
@@ -2152,8 +2307,7 @@ class PlanCompiler:
             (row ids for non-ROWID_DISTINCT columns would split value
             groups across buckets) — shared by the hash-spill and
             sorted-spill paths."""
-            store = PartitionedSpillStore(cfg.spill_partitions,
-                                  budget_bytes=cfg.spill_budget_bytes)
+            store = self._new_spill_store()
             encode_keys = None
             if batches is None:
                 batches = self._compile(src_node).batches()
@@ -2266,7 +2420,19 @@ class PlanCompiler:
                 else:
                     yield run_global_percentile_stream(stream)
                 return
-            if not key_names or pool.try_reserve(est_state_bytes):
+            # grouped aggregation state is registered as a revocable
+            # holder so arbitration/admission see it, but its callback
+            # DECLINES (returns 0): a device hash table mid-scatter cannot
+            # be spilled consistently, so the arbitrator moves on to the
+            # next-largest victim and this operator self-spills below only
+            # when its own reservation misses
+            agg_holder = (pool.register_revocable("agg-state", lambda: 0)
+                          if key_names else None)
+            got = agg_holder is None \
+                or agg_holder.try_reserve(est_state_bytes)
+            if not got:
+                agg_holder.close()
+            if got:
                 try:
                     state, key_dicts, key_lazy, direct = run_retrying()
                     if direct is not None:
@@ -2282,8 +2448,8 @@ class PlanCompiler:
                     yield ops.agg_finalize(state, specs, key_names,
                                            key_dicts, key_lazy)
                 finally:
-                    if key_names:
-                        pool.free(est_state_bytes)
+                    if agg_holder is not None:
+                        agg_holder.close()
                 return
             if not cfg.spill_enabled:
                 raise MemoryExceededError(
@@ -2605,7 +2771,7 @@ class PlanCompiler:
         if b is not None:
             return b
         skey = None
-        if cache and self.ctx.memory.budget is None:
+        if cache and not self.ctx.memory.limited:
             sk = P.structural_key(node)
             skey = ("mat_result", sk, self._splits_fingerprint(node))
             if '"@type": "parameter"' in sk:
@@ -2868,39 +3034,24 @@ class PlanCompiler:
                 if full:
                     yield unmatched_build(build_batch, matched)
 
-            # materialize the build side under the memory budget; on budget
-            # exhaustion switch to a grace hash join (reference: revocable
-            # memory in HashBuilderOperator.java:56 + partitioned spilling)
-            collected, spill = [], None
-            reserved = 0
+            # materialize the build side under the memory budget; the
+            # staging reservation is REVOCABLE — either this loop's own
+            # budget miss or the arbitrator (another operator starving)
+            # converts it into a grace hash join's partitioned host store
+            # (reference: HashBuilderOperator.java:56 revocable memory +
+            # partitioned spilling)
+            buf = _RevocableBuildBuffer(self, build_keys, cfg.spill_enabled)
             try:
                 from .fused import fused_materialize
                 fb = fused_materialize(self, build_src_node, cache=True)
                 if fb is not None:
                     # fused single-program build materialization (only when
                     # memory is unbudgeted, so no reservation bookkeeping)
-                    collected = [fb]
-                build_stream = ([] if fb is not None
-                                else self._compile(build_src_node).batches())
-                for b in build_stream:
-                    nb = batch_bytes(b)
-                    if spill is None and pool.try_reserve(nb):
-                        collected.append(b)
-                        reserved += nb
-                        continue
-                    if spill is None:
-                        if not cfg.spill_enabled:
-                            raise MemoryExceededError(
-                                f"join build side exceeds memory budget "
-                                f"{pool.budget} bytes and spill is disabled")
-                        spill = PartitionedSpillStore(cfg.spill_partitions,
-                                              budget_bytes=cfg.spill_budget_bytes)
-                        for cb in collected:
-                            spill.add(cb, build_keys)
-                        collected = []
-                        pool.free(reserved)
-                        reserved = 0
-                    spill.add(b, build_keys)
+                    buf.seed([fb])
+                else:
+                    for b in self._compile(build_src_node).batches():
+                        buf.add(b)
+                collected, spill = buf.finish()
                 if spill is None:
                     build_batch = (
                         None if not collected else collected[0]
@@ -2950,8 +3101,7 @@ class PlanCompiler:
                 # whose build side still exceeds the budget is RE-partitioned
                 # with a fresh hash salt (recursive grace join); only a
                 # bucket that stops shrinking — single-key skew — fails.
-                probe_store = PartitionedSpillStore(cfg.spill_partitions,
-                                      budget_bytes=cfg.spill_budget_bytes)
+                probe_store = self._new_spill_store()
                 for b in self._compile(probe_src_node).batches():
                     probe_store.add(b, probe_keys)
                 work = [(spill, probe_store, p, 0)
@@ -2983,14 +3133,10 @@ class PlanCompiler:
                                 f"exceeds memory budget {pool.budget} after "
                                 f"{depth} re-partitions (key skew)")
                         salt2 = bstore.salt * 33 + 0x9E37
-                        sub_b = PartitionedSpillStore(
-                            cfg.spill_partitions, salt2,
-                            budget_bytes=cfg.spill_budget_bytes)
+                        sub_b = self._new_spill_store(salt2)
                         for bb in bstore.bucket_batches(p, cfg.batch_rows):
                             sub_b.add(bb, build_keys)
-                        sub_p = PartitionedSpillStore(
-                            cfg.spill_partitions, salt2,
-                            budget_bytes=cfg.spill_budget_bytes)
+                        sub_p = self._new_spill_store(salt2)
                         for pb in pstore.bucket_batches(p, cfg.batch_rows):
                             sub_p.add(pb, probe_keys)
                         work.extend((sub_b, sub_p, q, depth + 1)
@@ -3009,7 +3155,7 @@ class PlanCompiler:
                     finally:
                         pool.free(bucket_bytes)
             finally:
-                pool.free(reserved)
+                buf.close()
         return BatchSource(gen, out_names, out_types)
 
     def _compile_SemiJoinNode(self, node: P.SemiJoinNode) -> BatchSource:

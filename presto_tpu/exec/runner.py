@@ -632,6 +632,9 @@ class DistributedQueryRunner(LocalQueryRunner):
         # fabric-tagged exchange stats (bytes / walls per fabric) collected
         # while the result drained
         result.runtime_stats = sched.stats.to_dict()
+        # query-level context peak (all tasks' reservations bubbled up)
+        result.peak_memory_bytes = (sched.memory.peak
+                                    if sched.memory is not None else 0)
         if tracer:
             tracer.end_trace("query finished")
         return result

@@ -325,6 +325,13 @@ class InProcessScheduler:
         # span-recording tracer (utils/runtime_stats.Tracer); spans open
         # per fragment and per task under the caller's "query" span
         self.tracer = None
+        # query-level memory context (created per execute()): every task
+        # gets a CHILD context over ONE shared arbitrated pool, so the
+        # query's aggregate reservation — and its revocable holders — are
+        # visible in one place.  Budgeted unpinned stages already run
+        # their tasks sequentially, so the shared pool never sees two
+        # tasks' peaks stacked.
+        self.memory: Optional["MemoryContext"] = None
 
     # -- planning the stage tree -----------------------------------------
     def _build_stages(self, subplan: P.SubPlan) -> StageInfo:
@@ -396,6 +403,11 @@ class InProcessScheduler:
 
     # -- execution --------------------------------------------------------
     def execute(self, subplan: P.SubPlan) -> Iterator[Page]:
+        from .memory import MemoryContext, MemoryPool
+        cfg = self.config.exec_config
+        self.memory = MemoryContext(
+            MemoryPool(cfg.memory_budget_bytes), "query",
+            max_bytes=cfg.memory_max_query_bytes)
         root = self._build_stages(subplan)
         self._plan_fabrics(root)
         self._assign_partitions(root, 1)
@@ -511,9 +523,26 @@ class InProcessScheduler:
             # wall time folds in — the /v1/query and EXPLAIN ANALYZE
             # CPU-vs-wall attribution
             c0 = _time.thread_time()
+            # device-pinned concurrent tasks keep PER-TASK pools (each
+            # owns a device, so budgets must not stack in one pool);
+            # everything else charges a child of the query context
+            task_mem = None
+            if self.memory is not None:
+                if pin and stage.n_tasks > 1 \
+                        and self.memory.budget is not None:
+                    from .memory import MemoryContext, MemoryPool
+                    task_mem = MemoryContext(
+                        MemoryPool(self.memory.budget),
+                        f"task/{stage.fragment.fragment_id}.{task_index}",
+                        max_bytes=self.config.exec_config
+                        .memory_max_query_bytes)
+                else:
+                    task_mem = self.memory.new_child(
+                        f"task/{stage.fragment.fragment_id}.{task_index}")
             ctx = TaskContext(config=self.config.exec_config,
                               task_index=task_index,
                               shared_jits=stage_jits,
+                              memory=task_mem,
                               runtime_stats=self.stats)
             if self.node_stats is not None:
                 # EXPLAIN ANALYZE: per-node operator stats, merged into

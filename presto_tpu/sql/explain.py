@@ -197,6 +197,20 @@ def format_analyze_footer(runtime_stats, profile_dir: str = None) -> str:
         lines.append(f"Driver CPU/wall: {cpu['sum'] / 1e6:,.1f}ms / "
                      f"{wall['sum'] / 1e6:,.1f}ms "
                      f"({cpu['sum'] / wall['sum']:.2f} busy)")
+    sp = rs.get("spillBytes")
+    if sp and sp.get("sum"):
+        # two-tier spill: bytes staged to the host tier, the fraction of
+        # device->host eviction that overlapped operator compute (async
+        # staging), and what overflowed on to disk
+        ovf = rs.get("spillOverlapFraction")
+        frac = (ovf["sum"] / ovf["count"]
+                if ovf and ovf.get("count") else 0.0)
+        line = (f"Spilled: {sp['sum'] / (1 << 20):,.1f} MB "
+                f"({frac * 100:.0f}% overlapped)")
+        dk = rs.get("spillDiskBytes")
+        if dk and dk.get("sum"):
+            line += f", {dk['sum'] / (1 << 20):,.1f} MB to disk"
+        lines.append(line)
     if profile_dir:
         # where `jax.profiler.trace` wrote this run's device capture
         # (open with tensorboard / xprof)

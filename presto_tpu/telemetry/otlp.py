@@ -124,7 +124,8 @@ def metrics_to_resource_metrics(points: Iterable[Tuple[str, float, dict]],
 
 def scrape_metric_points() -> List[Tuple[str, float, dict]]:
     """Flatten the process metric registries (exchange, fabric, serving,
-    storage, kernel decline/DMA counters) into OTLP gauge points.  Import
+    storage, kernel decline/DMA counters, memory arbitration/spill) into
+    OTLP gauge points.  Import
     inside the function: the registries live in packages this one must
     not import at module load (telemetry is imported by worker startup)."""
     points: List[Tuple[str, float, dict]] = []
@@ -155,5 +156,9 @@ def scrape_metric_points() -> List[Tuple[str, float, dict]]:
                                {"reason": reason}))
         else:
             points.append((f"presto_tpu.kernel.{k}", float(v), {}))
+
+    from ..exec.memory import MEMORY_METRICS
+    for k, v in MEMORY_METRICS.snapshot().items():
+        points.append((f"presto_tpu.memory.{k}", float(v), {}))
 
     return points

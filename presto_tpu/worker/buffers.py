@@ -10,8 +10,13 @@ complete flag tells the consumer the stream is finished.
 """
 from __future__ import annotations
 
+import os
+import struct
+import tempfile
 import threading
 from typing import List, Optional, Tuple
+
+from ..common.compression import compress, decompress
 
 
 DEFAULT_MAX_BUFFERED_BYTES = 64 << 20
@@ -28,16 +33,34 @@ class PageBuffer:
     a RESTARTED consumer task can replay the stream from token 0 exactly
     — the streaming analog of the batch scheduler's durable shuffle
     files, paid in buffer memory.  Backpressure still counts only
-    UNacknowledged bytes, matching the non-retain threshold behavior."""
+    UNacknowledged bytes, matching the non-retain threshold behavior.
+
+    With a `memory` context the retained (acknowledged) bytes are charged
+    to the owning task as a REVOCABLE reservation — they were previously
+    invisible to every pool — and the arbitrator can reclaim them by
+    spilling the acknowledged prefix to an LZ4-compressed disk file
+    (`revoke_to_disk`); a replaying consumer transparently reads spilled
+    pages back.  The charge uses arbitrate=False + self-spill because it
+    runs under this buffer's own condition lock (see
+    RevocableHolder.try_reserve)."""
 
     def __init__(self, max_buffered_bytes: int = DEFAULT_MAX_BUFFERED_BYTES,
-                 retain: bool = False, coalesce_target_bytes: int = 0):
+                 retain: bool = False, coalesce_target_bytes: int = 0,
+                 memory=None, spill_dir: Optional[str] = None):
         self._pages: List[bytes] = []
         self._base = 0                    # sequence number of _pages[0]
         self._bytes = 0                   # UNacknowledged bytes (backpressure)
         self._max_bytes = max_buffered_bytes
         self._retain = retain
         self._acked = 0                   # retain mode: acknowledge watermark
+        self._memory = memory             # task MemoryContext (or pool)
+        self._holder = None               # lazy revocable registration
+        self._spill_dir = spill_dir
+        self._disk_fd: Optional[int] = None
+        self._disk_path: Optional[str] = None
+        # token t (t < _base) -> (offset, compressed_len, raw_len)
+        self._disk_records: List[Tuple[int, int, int]] = []
+        self._disk_end = 0                # file append offset
         # coalescing (exchange.max-response-size): small serialized pages
         # accumulate in _pending until ~target bytes, then flush as ONE
         # buffer entry so tiny-page stages stop paying a pull round trip
@@ -112,7 +135,15 @@ class PageBuffer:
                     self._flush_pending_locked()
                     end = self._base + len(self._pages)
                 if token < end or self._complete:
-                    pages = self._pages[max(0, token - self._base):]
+                    if self._retain and 0 <= token < self._base:
+                        # replaying consumer asked for pages already
+                        # revoked to disk: read them back transparently
+                        pages = (self._read_spilled_locked(token)
+                                 + self._pages)
+                        first = token
+                    else:
+                        pages = self._pages[max(0, token - self._base):]
+                        first = max(token, self._base)
                     if max_bytes is not None and len(pages) > 1:
                         taken, size = [], 0
                         for p in pages:
@@ -121,7 +152,7 @@ class PageBuffer:
                             taken.append(p)
                             size += len(p)
                         pages = taken
-                    next_token = max(token, self._base) + len(pages)
+                    next_token = first + len(pages)
                     at_end = self._complete and next_token >= end
                     return pages, next_token, at_end
                 import time
@@ -136,12 +167,18 @@ class PageBuffer:
         with self._cond:
             if self._retain:
                 # advance the watermark and release backpressure, but keep
-                # the pages for replay by a retried consumer
-                upto = max(self._acked, min(token, len(self._pages)))
+                # the pages for replay by a retried consumer — now CHARGED
+                # to the task's memory context as revocable bytes
+                upto = max(self._acked,
+                           min(token, self._base + len(self._pages)))
                 if upto > self._acked:
-                    self._bytes -= sum(len(p) for p in
-                                       self._pages[self._acked:upto])
+                    newly = sum(
+                        len(p) for p in
+                        self._pages[self._acked - self._base:
+                                    upto - self._base])
+                    self._bytes -= newly
                     self._acked = upto
+                    self._charge_retained_locked(newly)
                     self._cond.notify_all()
                 return
             drop = max(0, min(token - self._base, len(self._pages)))
@@ -150,6 +187,83 @@ class PageBuffer:
                 self._pages = self._pages[drop:]
                 self._base += drop
                 self._cond.notify_all()  # unblock a backpressured producer
+
+    # -- retained-page memory charge + disk revocation ---------------------
+    def _charge_retained_locked(self, nb: int) -> None:
+        if self._memory is None or nb <= 0:
+            return
+        if self._holder is None:
+            self._holder = self._memory.register_revocable(
+                "output-buffer", self._revoke)
+        if not self._holder.try_reserve(nb, arbitrate=False):
+            # no headroom for the retained pages: give them to disk now
+            # (self-spill) rather than fail a fault-tolerance feature
+            self._spill_acked_locked()
+
+    def _revoke(self) -> int:
+        """Arbitrator callback: spill the acknowledged prefix to disk.
+        Never blocks — if the buffer lock is contended, decline."""
+        if not self._cond.acquire(timeout=0.05):
+            return 0
+        try:
+            return self._spill_acked_locked()
+        finally:
+            self._cond.release()
+
+    def _spill_acked_locked(self) -> int:
+        """Write pages [_base, _acked) as length-prefixed LZ4 records,
+        advance _base, and free their revocable charge.  Returns bytes
+        freed."""
+        n = self._acked - self._base
+        if n <= 0 or self._destroyed:
+            return 0
+        if self._disk_fd is None:
+            d = self._spill_dir or tempfile.gettempdir()
+            os.makedirs(d, exist_ok=True)
+            self._disk_fd, self._disk_path = tempfile.mkstemp(
+                prefix="presto-tpu-buffer-", suffix=".spill", dir=d)
+        freed = 0
+        chunks = []
+        for p in self._pages[:n]:
+            cp = compress("LZ4", p)
+            self._disk_records.append((self._disk_end + 4, len(cp), len(p)))
+            chunks.append(struct.pack("<i", len(cp)) + cp)
+            self._disk_end += 4 + len(cp)
+            freed += len(p)
+        os.pwrite(self._disk_fd, b"".join(chunks),
+                  self._disk_records[-n][0] - 4)
+        self._pages = self._pages[n:]
+        self._base = self._acked
+        if self._holder is not None:
+            self._holder.free(freed)
+        from ..exec.memory import MEMORY_METRICS
+        MEMORY_METRICS.incr("spilled_bytes", freed)
+        MEMORY_METRICS.incr("disk_spilled_bytes", freed)
+        if self._memory is not None:
+            self._memory.note_spill(freed)
+            self._memory.note_disk_spill(freed)
+        return freed
+
+    def _read_spilled_locked(self, token: int) -> List[bytes]:
+        """Replay path: pages [token, _base) back from the spill file."""
+        out = []
+        for off, clen, rawlen in self._disk_records[token:self._base]:
+            out.append(decompress("LZ4", os.pread(self._disk_fd, clen, off),
+                                  rawlen))
+        if out:
+            from ..exec.memory import MEMORY_METRICS
+            MEMORY_METRICS.incr("unspilled_bytes", sum(len(p) for p in out))
+            if self._memory is not None:
+                self._memory.note_unspill(sum(len(p) for p in out))
+        return out
+
+    @property
+    def retained_bytes(self) -> int:
+        return 0 if self._holder is None else self._holder.bytes
+
+    @property
+    def spilled_tokens(self) -> int:
+        return self._base if self._retain else 0
 
     def destroy(self, force: bool = True) -> None:
         # a retained buffer survives the consumer's end-of-stream DELETE
@@ -164,6 +278,17 @@ class PageBuffer:
             self._bytes = 0
             self._complete = True
             self._destroyed = True
+            if self._holder is not None:
+                self._holder.close()   # frees the retained charge
+                self._holder = None
+            if self._disk_fd is not None:
+                try:
+                    os.close(self._disk_fd)
+                    os.unlink(self._disk_path)
+                except OSError:
+                    pass
+                self._disk_fd = None
+                self._disk_records = []
             self._cond.notify_all()
 
 
@@ -172,11 +297,17 @@ class OutputBufferManager:
     buffer p; BROADCAST replicates every page into each consumer's buffer."""
 
     def __init__(self, buffer_type: str, n_buffers: int,
-                 retain: bool = False, coalesce_target_bytes: int = 0):
+                 retain: bool = False, coalesce_target_bytes: int = 0,
+                 memory=None, spill_dir: Optional[str] = None):
         self.buffer_type = buffer_type
         self.buffers = [PageBuffer(retain=retain,
-                                   coalesce_target_bytes=coalesce_target_bytes)
+                                   coalesce_target_bytes=coalesce_target_bytes,
+                                   memory=memory, spill_dir=spill_dir)
                         for _ in range(max(1, n_buffers))]
+
+    @property
+    def retained_bytes(self) -> int:
+        return sum(b.retained_bytes for b in self.buffers)
 
     def add(self, partition: int, page_bytes: bytes) -> None:
         if self.buffer_type == "BROADCAST":

@@ -65,11 +65,24 @@ def execution_config_from_properties(props: Dict[str, str],
     if "query.max-memory-per-node" in props:
         kw["memory_budget_bytes"] = parse_data_size(
             props["query.max-memory-per-node"])
+    if "query.max-memory" in props:
+        kw["memory_max_query_bytes"] = parse_data_size(
+            props["query.max-memory"])
+    if "memory.max-query-bytes" in props:      # byte-count alias
+        kw["memory_max_query_bytes"] = int(props["memory.max-query-bytes"])
     if "experimental.spill-enabled" in props:
         kw["spill_enabled"] = _bool(props["experimental.spill-enabled"])
     if "experimental.spiller-max-used-space" in props:
         kw["spill_budget_bytes"] = parse_data_size(
             props["experimental.spiller-max-used-space"])
+    if "spill.host-budget-bytes" in props:     # byte-count alias
+        kw["spill_budget_bytes"] = int(props["spill.host-budget-bytes"])
+    if props.get("experimental.spiller-spill-path"):
+        kw["spill_path"] = props["experimental.spiller-spill-path"]
+    if props.get("spill.path"):                # short alias
+        kw["spill_path"] = props["spill.path"]
+    if "spill.async-staging" in props:
+        kw["spill_async_staging"] = _bool(props["spill.async-staging"])
     if "exchange.compression-enabled" in props:
         kw["exchange_compression"] = _bool(
             props["exchange.compression-enabled"])
@@ -210,9 +223,14 @@ class SystemConfig:
         ("system-mem-limit-gb", int, 16),
         ("system-mem-pushback-enabled", bool, False),
         ("query.max-memory-per-node", str, ""),
+        ("query.max-memory", str, ""),           # typed EXCEEDED_MEMORY_LIMIT
+        ("memory.max-query-bytes", str, ""),     # byte-count alias of above
         ("experimental.spill-enabled", bool, True),
         ("experimental.spiller-spill-path", str, ""),
         ("experimental.spiller-max-used-space", str, "8GB"),
+        ("spill.path", str, ""),                 # alias of spiller-spill-path
+        ("spill.host-budget-bytes", str, ""),    # alias of max-used-space
+        ("spill.async-staging", bool, True),
         ("exchange.compression-enabled", bool, False),
         ("exchange.compression-codec", str, "LZ4"),
         ("exchange.http-client.request-timeout", str, "10s"),

@@ -271,10 +271,20 @@ def apply_session_properties(config, session: Dict[str, str]):
     if "query_max_memory_per_node" in session:
         kw["memory_budget_bytes"] = parse_data_size(
             session["query_max_memory_per_node"])
+    if "query_max_memory" in session:
+        kw["memory_max_query_bytes"] = parse_data_size(
+            session["query_max_memory"])
     if "spill_enabled" in session:
         kw["spill_enabled"] = str(session["spill_enabled"]).lower() == "true"
     if "spill_partitions" in session:
         kw["spill_partitions"] = int(session["spill_partitions"])
+    if "spill_path" in session:
+        kw["spill_path"] = session["spill_path"] or None
+    if "spill_host_budget_bytes" in session:
+        kw["spill_budget_bytes"] = int(session["spill_host_budget_bytes"])
+    if "spill_async_staging" in session:
+        kw["spill_async_staging"] = (
+            str(session["spill_async_staging"]).lower() == "true")
     if "task_batch_rows" in session:
         kw["batch_rows"] = int(session["task_batch_rows"])
     if "exchange_compression" in session:

@@ -403,6 +403,19 @@ class _Handler(BaseHTTPRequestHandler):
                 lines.append(f"# TYPE presto_tpu_storage_{k}_total counter")
                 lines.append(
                     f"presto_tpu_storage_{k}_total {STORAGE_METRICS[k]}")
+        # memory arbitration + two-tier spill (exec/memory.py): counters
+        # for spilled/unspilled bytes and revocations, gauges for the
+        # live reserved/revocable split and the eviction overlap fraction
+        from ..exec.memory import MEMORY_METRICS
+        mem = MEMORY_METRICS.snapshot()
+        for k in sorted(mem):
+            if k in ("reserved_bytes", "revocable_bytes",
+                     "spill_overlap_fraction"):
+                lines.append(f"# TYPE presto_tpu_memory_{k} gauge")
+                lines.append(f"presto_tpu_memory_{k} {mem[k]}")
+            else:
+                lines.append(f"# TYPE presto_tpu_memory_{k}_total counter")
+                lines.append(f"presto_tpu_memory_{k}_total {mem[k]}")
         # telemetry export pipeline + history store counters
         if s.telemetry is not None:
             tc = s.telemetry.counters()
@@ -619,7 +632,11 @@ class _Handler(BaseHTTPRequestHandler):
         queued = by_state.get("QUEUED", 0)
         adm = d.resource_groups.info().get("__admission", {})
         headroom = adm.get("memoryHeadroomBytes")
-        reserved = adm.get("memoryAdmittedBytes", 0)
+        # the arbitrated pool's LIVE reserved+revocable accounting when it
+        # exceeds the admission-time estimates (same max the gate applies)
+        reserved = max(adm.get("memoryAdmittedBytes", 0),
+                       adm.get("memoryReservedBytes", 0)
+                       + adm.get("memoryRevocableBytes", 0))
         # memory-gated admission parks queries in QUEUED; when the pool
         # is exhausted those queued queries are blocked-on-memory
         blocked = queued if (headroom is not None and queued
@@ -640,6 +657,7 @@ class _Handler(BaseHTTPRequestHandler):
             "runningTasks": c["by_state"].get("RUNNING", 0),
             "totalTasks": c["created"],
             "reservedMemoryBytes": reserved,
+            "revocableMemoryBytes": adm.get("memoryRevocableBytes", 0),
             **({"memoryHeadroomBytes": headroom}
                if headroom is not None else {}),
             "fabricByteRates": FABRIC_METRICS.byte_rates(),
@@ -654,6 +672,7 @@ class _Handler(BaseHTTPRequestHandler):
         the /v1/metrics exposition sections — included in QueryInfo so a
         single snapshot carries both query- and process-scoped state."""
         from ..exec.kernels.scan_kernel import KERNEL_METRICS
+        from ..exec.memory import MEMORY_METRICS
         from ..parallel.fabric import FABRIC_METRICS
         from ..serving import SERVING_METRICS
         from ..storage.store import STORAGE_METRICS
@@ -662,7 +681,8 @@ class _Handler(BaseHTTPRequestHandler):
                 "fabric": FABRIC_METRICS.snapshot(),
                 "serving": SERVING_METRICS.snapshot(),
                 "storage": dict(STORAGE_METRICS),
-                "kernel": KERNEL_METRICS.snapshot()}
+                "kernel": KERNEL_METRICS.snapshot(),
+                "memory": MEMORY_METRICS.snapshot()}
 
     def do_query_info(self, groups, query):
         d = self._dispatch_mgr()

@@ -138,6 +138,18 @@ class ResourceGroupManager:
         est = getattr(query, "memory_estimate", None)
         return est if est is not None else self.query_memory_estimate
 
+    def _mem_used(self) -> int:
+        """The claim admission holds new queries against: the larger of
+        the admission-time estimates and the pool's LIVE arbitrated
+        accounting (reserved + revocable) — a running query whose actual
+        reservations outgrew its estimate shrinks the headroom for
+        everyone else, exactly like the reference ClusterMemoryManager
+        tracking real pool reservation, not estimates."""
+        live = (self.memory_pool.total_reserved
+                if self.memory_pool is not None
+                and hasattr(self.memory_pool, "total_reserved") else 0)
+        return max(self._mem_admitted, live)
+
     def _can_run_locked(self, g: str, est: int) -> bool:
         if len(self._running[g]) >= self.groups[g].hard_concurrency_limit:
             return False
@@ -145,7 +157,7 @@ class ResourceGroupManager:
                 and self._total_running >= self.total_concurrency:
             return False
         cap = self._mem_cap()
-        if cap is not None and self._mem_admitted + est > cap:
+        if cap is not None and self._mem_used() + est > cap:
             return False
         return True
 
@@ -233,11 +245,19 @@ class ResourceGroupManager:
                        "weight": self.groups[n].weight,
                        "virtualTime": self._vtime[n]}
                    for n in self.groups}
+            pool = self.memory_pool
             out["__admission"] = {
                 "totalRunning": self._total_running,
                 "totalConcurrency": self.total_concurrency,
                 "memoryAdmittedBytes": self._mem_admitted,
                 "memoryHeadroomBytes": self._mem_cap(),
+                # live arbitrated accounting (what _can_run_locked gates
+                # on, and what /v1/cluster reports as reservedMemoryBytes)
+                "memoryReservedBytes": (
+                    getattr(pool, "reserved", 0) if pool is not None else 0),
+                "memoryRevocableBytes": (
+                    getattr(pool, "revocable", 0)
+                    if pool is not None else 0),
             }
             return out
 

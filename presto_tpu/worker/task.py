@@ -46,6 +46,7 @@ class TpuTask:
         self.buffers: Optional[OutputBufferManager] = None
         self.done_at: Optional[float] = None
         self.memory_peak = 0
+        self.memory_ctx = None            # task MemoryContext (set by start)
         # TaskInfo stats surface (reference TaskInfo/TaskStats): the
         # coordinator-side aggregation and UI drill-down consume these
         import time as _t
@@ -94,6 +95,21 @@ class TpuTask:
                 "outputDataSizeInBytes": self.output_bytes,
                 "bufferedPages": self.output_pages,
                 "peakTotalMemoryInBytes": self.memory_peak,
+                # arbitrated-pool surface: revocation is observable per
+                # task (spilledBytes > 0 after a revoke/self-spill), and
+                # retained output pages appear as revocable bytes
+                "spilledBytes": (
+                    0 if self.memory_ctx is None
+                    else self.memory_ctx.pool.spilled_bytes),
+                "memoryReservedBytes": (
+                    0 if self.memory_ctx is None
+                    else self.memory_ctx.pool.reserved),
+                "memoryRevocableBytes": (
+                    0 if self.memory_ctx is None
+                    else self.memory_ctx.pool.revocable),
+                "memoryOverFree": (
+                    0 if self.memory_ctx is None
+                    else self.memory_ctx.pool.over_free_count),
                 "state": self.state,
                 # the wire this task's remote-source inputs rode: the
                 # worker protocol pulls pages over HTTP regardless of the
@@ -202,17 +218,28 @@ class TpuTask:
         try:
             fragment = update.fragment()
             spec = update.output_buffers
-            from ..exec.memory import MemoryPool
+            from ..exec.memory import MemoryContext, MemoryPool
             from .protocol import apply_session_properties
             cfg = apply_session_properties(self.config, update.session)
+            # the task's node of the query->task->operator context tree:
+            # the arbitrated pool below it serves both the executor's
+            # operators and the output buffers' retained-page charge, and
+            # a query.max-memory ceiling rides in as max_bytes
+            self.memory_ctx = MemoryContext(
+                MemoryPool(cfg.memory_budget_bytes),
+                f"task/{self.task_id}",
+                max_bytes=cfg.memory_max_query_bytes)
             # retry mode makes buffers replayable: a retried consumer
-            # re-reads from token 0, so acknowledged pages must survive
+            # re-reads from token 0, so acknowledged pages must survive —
+            # charged to this task's context as revocable bytes (spilled
+            # to disk by the arbitrator under pressure)
             self.buffers = OutputBufferManager(
                 spec.type, spec.n_buffers,
                 retain=cfg.remote_task_retry_attempts > 0,
-                coalesce_target_bytes=cfg.exchange_max_response_bytes)
+                coalesce_target_bytes=cfg.exchange_max_response_bytes,
+                memory=self.memory_ctx, spill_dir=cfg.spill_path)
             ctx = TaskContext(config=cfg, task_index=update.task_index,
-                              memory=MemoryPool(cfg.memory_budget_bytes),
+                              memory=self.memory_ctx,
                               runtime_stats=self.stats)
             self.trace_token = update.session.get("trace_token", "")
             if self.trace_token:

@@ -13,6 +13,7 @@ import sys
 import pytest
 
 from presto_tpu.analysis.lint import (ALL_LINT_CODES, KERNEL_INTERPRET,
+                                      MEM_PRAGMA, MEM_UNCHARGED_STAGING,
                                       PRAGMA, SYNC_ASARRAY, SYNC_BRANCH,
                                       SYNC_CAST, SYNC_EXPLICIT, SYNC_NETWORK,
                                       SYNC_WALLCLOCK, TELEM_UNBOUNDED_QUEUE,
@@ -377,9 +378,79 @@ def test_telemetry_network_scoping():
     assert _codes(findings) == {SYNC_NETWORK}
 
 
+_MEM_FIXTURE = ("class BucketStager:\n"
+                "    def __init__(self):\n"
+                "        self.pending_pages = []\n"
+                "        self._chunks: dict = {}\n"
+                "    def add(self, b):\n"
+                "        self.pending_pages.append(b)\n")
+
+
+def test_uncharged_staging_class_flagged():
+    """MEM001: a class in exec//worker/ that stages rows in unbounded
+    host collections but never touches the memory-accounting API is
+    invisible to the arbitrator — exactly the PR 2 retained-buffer
+    leak this rule fossilizes."""
+    findings = lint_source(_MEM_FIXTURE, path="presto_tpu/exec/stager.py")
+    assert _codes(findings) == {MEM_UNCHARGED_STAGING}
+    assert [f.line for f in findings] == [3, 4]
+    findings = lint_source(_MEM_FIXTURE, path="presto_tpu/worker/stager.py")
+    assert _codes(findings) == {MEM_UNCHARGED_STAGING}
+
+
+def test_charged_staging_class_not_flagged():
+    # any reference to the charging API in the class body vouches for it
+    src = _MEM_FIXTURE.replace(
+        "    def add(self, b):\n",
+        "    def add(self, b, ctx):\n"
+        "        ctx.try_reserve(len(b))\n")
+    assert lint_source(src, path="presto_tpu/exec/stager.py") == []
+    src2 = _MEM_FIXTURE.replace(
+        "    def add(self, b):\n",
+        "    def attach(self, memory_context):\n"
+        "        self.memory_context = memory_context\n"
+        "    def add(self, b):\n")
+    assert lint_source(src2, path="presto_tpu/worker/stager.py") == []
+
+
+def test_staging_outside_memory_scope_not_flagged():
+    # the rule is scoped to exec/ and worker/; sql- and storage-layer
+    # collections hold plans and metadata, not row data
+    for path in ("presto_tpu/sql/planner.py",
+                 "presto_tpu/storage/store.py", "bench.py"):
+        assert lint_source(_MEM_FIXTURE, path=path) == []
+
+
+def test_bounded_and_copy_constructors_not_flagged():
+    src = ("import collections\n"
+           "class RingStager:\n"
+           "    def __init__(self, pages):\n"
+           "        self.pending_pages = collections.deque(maxlen=8)\n"
+           "        self.page_copy = list(pages)\n")
+    assert lint_source(src, path="presto_tpu/exec/ring.py") == []
+
+
+def test_uncharged_staging_pragma_suppresses():
+    src = _MEM_FIXTURE.replace(
+        "self.pending_pages = []",
+        "self.pending_pages = []  # lint: allow-uncharged-staging").replace(
+        "self._chunks: dict = {}",
+        "self._chunks: dict = {}  # lint: allow-uncharged-staging")
+    assert lint_source(src, path="presto_tpu/exec/stager.py") == []
+    # ...but the memory pragma is its own line set: a host-sync pragma
+    # does not silence MEM001
+    src2 = _MEM_FIXTURE.replace(
+        "self.pending_pages = []",
+        "self.pending_pages = []  # lint: allow-host-sync")
+    findings = lint_source(src2, path="presto_tpu/exec/stager.py")
+    assert MEM_UNCHARGED_STAGING in _codes(findings)
+
+
 def test_all_codes_are_exercised_above():
     assert set(ALL_LINT_CODES) == {SYNC_EXPLICIT, SYNC_CAST, SYNC_ASARRAY,
                                    SYNC_BRANCH, SYNC_NETWORK, SYNC_WALLCLOCK,
-                                   KERNEL_INTERPRET, TELEM_UNBOUNDED_QUEUE}
+                                   KERNEL_INTERPRET, TELEM_UNBOUNDED_QUEUE,
+                                   MEM_UNCHARGED_STAGING}
     assert PRAGMA == "lint: allow-host-sync"
     assert WALL_PRAGMA == "lint: allow-wall-clock"
+    assert MEM_PRAGMA == "lint: allow-uncharged-staging"

@@ -176,9 +176,10 @@ def test_query_info_schema_golden(cluster):
         assert s["rows"] >= 0 and s["wall_s"] >= 0 and s["batches"] >= 0
 
     # process metrics ride along for a single-snapshot health read
-    assert set(info["processMetrics"]) == {"exchange", "fabric",
-                                           "serving", "storage", "kernel"}
+    assert set(info["processMetrics"]) == {"exchange", "fabric", "serving",
+                                           "storage", "kernel", "memory"}
     assert "resident_bytes" in info["processMetrics"]["storage"]
+    assert "spilled_bytes" in info["processMetrics"]["memory"]
 
 
 def test_metrics_namespace_consistency(cluster):

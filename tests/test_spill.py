@@ -200,6 +200,305 @@ def test_no_reservation_leak_on_failure():
     assert ok.execute("select count(*) from region").rows == [[5]]
 
 
+# ---------------------------------------------------------------------------
+# revocable arbitration: budget < 25% of the measured unconstrained peak
+# ---------------------------------------------------------------------------
+
+# q18 core: lineitem<->orders hash join feeding a high-cardinality
+# grouped aggregation — join build AND agg state scale with the data
+Q18_SHAPE = """
+    select l_orderkey, max(o_totalprice) as price, sum(l_quantity) as qty
+    from lineitem join orders on l_orderkey = o_orderkey
+    group by l_orderkey
+    order by qty desc, l_orderkey limit 100"""
+
+# q95 core: the ws_wh self-join (same order shipped from two warehouses)
+# plus a grouped count — the spill-stressing shape of TPC-DS Q95
+Q95_CORE = """
+    select ws1.ws_order_number, count(*) as pairs
+    from web_sales ws1, web_sales ws2
+    where ws1.ws_order_number = ws2.ws_order_number
+      and ws1.ws_warehouse_sk <> ws2.ws_warehouse_sk
+    group by ws1.ws_order_number
+    order by pairs desc, ws1.ws_order_number limit 100"""
+
+
+def _constrained_vs_free(schema, sql, catalog="tpch", fraction=0.2):
+    """Run unconstrained to measure the peak pool reservation, then re-run
+    under fraction*peak (< the 25%% acceptance bar) and require the exact
+    same rows — and the numpy reference's rows — from the arbitrated run."""
+    import dataclasses
+    base = ExecutionConfig(batch_rows=1 << 14, spill_partitions=4)
+    free = LocalQueryRunner(schema, catalog=catalog, config=base)
+    want = free.execute(sql)
+    peak = want.peak_memory_bytes
+    assert peak and peak > 0, "unconstrained run recorded no peak"
+    budget = max(1, int(peak * fraction))
+    con = LocalQueryRunner(schema, catalog=catalog,
+                           config=dataclasses.replace(
+                               base, memory_budget_bytes=budget))
+    got = con.execute(sql)
+    assert got.rows == want.rows
+    con.assert_same_as_reference(sql, ordered=True)
+    return got
+
+
+def test_q18_shape_quarter_peak_bit_identical():
+    from presto_tpu.exec.memory import MEMORY_METRICS
+    before = MEMORY_METRICS.snapshot()
+    _constrained_vs_free("sf0.01", Q18_SHAPE)
+    after = MEMORY_METRICS.snapshot()
+    # the budget actually forced eviction (not a silently-fitting run)
+    assert after["spilled_bytes"] > before["spilled_bytes"]
+
+
+def test_q95_core_quarter_peak_bit_identical():
+    _constrained_vs_free("sf0.01", Q95_CORE, catalog="tpcds")
+
+
+def test_join_build_revocation_under_cross_pressure():
+    """An in-flight join build holds revocable memory; pressure from a
+    CONCURRENT operator's non-revocable reserve makes the arbitrator
+    revoke it — the build converts to its grace-join spill store instead
+    of the reserve raising — and the build keeps accepting batches."""
+    import jax.numpy as jnp
+
+    from presto_tpu.exec.batch import Batch, Column
+    from presto_tpu.exec.memory import MEMORY_METRICS
+    from presto_tpu.exec.pipeline import (PlanCompiler, TaskContext,
+                                          _RevocableBuildBuffer)
+
+    before = MEMORY_METRICS.snapshot()
+    ctx = TaskContext(config=ExecutionConfig(
+        batch_rows=1 << 12, spill_partitions=4, spill_async_staging=False,
+        memory_budget_bytes=300_000))
+    compiler = PlanCompiler(ctx)
+    pool = ctx.memory
+    n = 4096
+
+    def mk(i):
+        v = jnp.arange(n, dtype=jnp.int64) + i * n
+        return Batch({"k": Column(v)}, jnp.ones(n, dtype=bool))
+
+    buf = _RevocableBuildBuffer(compiler, ["k"], spill_enabled=True)
+    try:
+        buf.add(mk(0))
+        buf.add(mk(1))
+        assert buf._holder.bytes > 0 and buf.spill is None
+        # the other operator's reserve does not fit alongside the build:
+        # arbitration revokes the build rather than raising
+        pool.reserve(250_000)
+        after = MEMORY_METRICS.snapshot()
+        assert after["revocations"] > before["revocations"]
+        assert after["revoked_bytes"] > before["revoked_bytes"]
+        assert buf.spill is not None and buf._holder.bytes == 0
+        # post-revocation adds route to the store; finish hands the
+        # spilled rows to the grace-join path with nothing lost
+        buf.add(mk(2))
+        collected, spill = buf.finish()
+        assert collected == [] and spill is not None
+        assert sum(spill.rows) == 3 * n
+        pool.free(250_000)
+    finally:
+        buf.close()
+    assert pool.reserved == 0 and pool.revocable == 0
+
+
+def test_engine_query_engages_arbitrator():
+    """End-to-end: a budget-constrained join+agg actually drives the
+    arbitration path (the counters move) while staying correct."""
+    from presto_tpu.exec.memory import MEMORY_METRICS
+    before = MEMORY_METRICS.snapshot()
+    r = LocalQueryRunner("sf0.01", config=ExecutionConfig(**TINY))
+    r.assert_same_as_reference("""
+        select l_orderkey, max(o_totalprice), sum(l_quantity)
+        from lineitem join orders on l_orderkey = o_orderkey
+        group by l_orderkey""")
+    after = MEMORY_METRICS.snapshot()
+    assert after["arbitrations"] > before["arbitrations"]
+    assert after["spilled_bytes"] > before["spilled_bytes"]
+
+
+def test_async_staging_reports_nonzero_overlap():
+    """Double-buffered eviction: with operator compute between adds the
+    producer never blocks on the two staging slots, so the overlap
+    fraction (1 - producer wait / staging wall) is positive."""
+    import time
+
+    import jax.numpy as jnp
+
+    from presto_tpu.exec.batch import Batch, Column
+    from presto_tpu.exec.memory import MEMORY_METRICS, PartitionedSpillStore
+
+    before = MEMORY_METRICS.snapshot()
+    store = PartitionedSpillStore(2, async_staging=True)
+    for i in range(6):
+        v = jnp.arange(4096, dtype=jnp.int64) + i
+        store.add(Batch({"k": Column(v)}, jnp.ones(4096, dtype=bool)), ["k"])
+        time.sleep(0.01)          # the "operator compute" between evictions
+    store.drain()
+    after = MEMORY_METRICS.snapshot()
+    stage = after["spill_wall_s"] - before["spill_wall_s"]
+    wait = after["spill_wait_wall_s"] - before["spill_wait_wall_s"]
+    assert after["spilled_bytes"] > before["spilled_bytes"]
+    assert stage > 0
+    assert max(0.0, 1.0 - wait / stage) > 0
+
+
+def test_spill_store_disk_tier_roundtrip(tmp_path):
+    """Past the host budget the largest bucket overflows to LZ4 disk
+    chunks; bucket_batches re-reads them bit-identical, in chunk order."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from presto_tpu.exec.batch import Batch, Column
+    from presto_tpu.exec.memory import PartitionedSpillStore
+
+    store = PartitionedSpillStore(2, budget_bytes=40_000,
+                                  spill_path=str(tmp_path))
+    n = 2048
+    for i in range(8):
+        v = jnp.arange(n, dtype=jnp.int64) + i * n
+        store.add(Batch({"k": Column(v)}, jnp.ones(n, dtype=bool)), ["k"])
+    assert store.disk_bytes > 0, "host budget never overflowed to disk"
+    got = sorted(int(x) for p in range(2)
+                 for b in store.bucket_batches(p, 4096)
+                 for x in np.asarray(b.columns["k"].values)[np.asarray(b.mask)])
+    assert got == list(range(8 * n))
+    assert store.unspilled_bytes > 0
+
+
+def test_query_max_memory_is_typed_user_error():
+    """query.max-memory is the fail-fast USER limit: no arbitration, no
+    spill rescue — the typed EXCEEDED_MEMORY_LIMIT error surfaces even
+    though spill is enabled and the pool itself is unlimited."""
+    from presto_tpu.common.errors import is_retryable
+    from presto_tpu.exec.memory import QueryMemoryLimitExceededError
+    r = LocalQueryRunner("sf0.01", config=ExecutionConfig(
+        batch_rows=1 << 14, memory_max_query_bytes=50_000))
+    with pytest.raises(QueryMemoryLimitExceededError) as ei:
+        r.execute("select l_orderkey, o_orderdate from lineitem "
+                  "join orders on l_orderkey = o_orderkey")
+    assert "EXCEEDED_MEMORY_LIMIT" in str(ei.value)
+    assert not is_retryable(ei.value)
+
+
+def test_pool_over_free_is_counted_not_clamped_silently():
+    """Satellite: MemoryPool.free of more than reserved used to clamp to
+    zero silently; now the mismatch is a counted accounting bug."""
+    from presto_tpu.exec.memory import MEMORY_METRICS
+    before = MEMORY_METRICS.snapshot()
+    p = MemoryPool(budget=1000)
+    assert p.try_reserve(100)
+    p.free(250)
+    assert p.reserved == 0                      # still clamped (no negatives)
+    assert p.over_free_count == 1
+    assert p.over_free_bytes == 150
+    after = MEMORY_METRICS.snapshot()
+    assert after["over_free"] - before["over_free"] == 1
+    assert after["over_free_bytes"] - before["over_free_bytes"] == 150
+
+
+def test_revocable_bytes_exempt_from_query_limit():
+    """Revocable reservations are the engine's to reclaim — they must not
+    count against the user's query.max-memory footprint."""
+    from presto_tpu.exec.memory import MemoryContext
+    ctx = MemoryContext(MemoryPool(), "query", max_bytes=100)
+    h = ctx.register_revocable("build", lambda: 0)
+    assert h.try_reserve(10_000)                # revocable: over the limit, OK
+    with pytest.raises(MemoryExceededError):
+        ctx.reserve(200)                        # reserved: limit enforced
+    h.close()
+
+
+def test_arbitration_stress_tiny_shared_pool_no_deadlock():
+    """Many threads hammer one tiny pool with revocable holders whose
+    callbacks take their own locks (the join-build shape) while others
+    decline (the agg shape): every thread must finish — no deadlock —
+    and the pool must drain back to zero."""
+    import threading
+
+    from presto_tpu.exec.memory import MemoryContext
+
+    root = MemoryContext(MemoryPool(budget=64_000), "query")
+    errors = []
+
+    def worker(idx):
+        try:
+            ctx = root.new_child(f"task/{idx}")
+            for round_no in range(30):
+                state_lock = threading.Lock()
+                state = {"bytes": 0}
+
+                def revoke():
+                    # join-build style: non-blocking self-lock, spill all
+                    if not state_lock.acquire(blocking=False):
+                        return 0
+                    try:
+                        freed = state["bytes"]
+                        state["bytes"] = 0
+                        return freed
+                    finally:
+                        state_lock.release()
+
+                cb = revoke if idx % 2 == 0 else (lambda: 0)
+                h = ctx.register_revocable(f"holder/{idx}", cb)
+                for _ in range(10):
+                    nb = 1000 + idx * 37
+                    if h.try_reserve(nb):
+                        with state_lock:
+                            state["bytes"] += nb
+                    if h.revoke_requested:
+                        with state_lock:
+                            gone = state["bytes"]
+                            state["bytes"] = 0
+                        h.free(gone)
+                h.close()
+        except Exception as e:                  # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not any(t.is_alive() for t in threads), "arbitration deadlocked"
+    assert not errors, errors
+    assert root.pool.reserved == 0
+    assert root.pool.revocable == 0
+
+
+def test_concurrent_constrained_queries_no_deadlock():
+    """Several budgeted queries spilling at once: all complete with
+    reference-correct rows (process-wide metrics locks + per-query
+    arbitration never interlock)."""
+    import threading
+
+    sqls = [
+        "select l_orderkey, o_totalprice from lineitem "
+        "join orders on l_orderkey = o_orderkey where l_quantity > 48",
+        "select l_orderkey, count(*), sum(l_quantity) from lineitem "
+        "group by l_orderkey",
+        "select o_orderstatus, count(*) from orders group by o_orderstatus",
+    ]
+    errors = []
+
+    def run(sql):
+        try:
+            r = LocalQueryRunner("sf0.01", config=ExecutionConfig(**TINY))
+            r.assert_same_as_reference(sql)
+        except Exception as e:
+            errors.append((sql, e))
+
+    threads = [threading.Thread(target=run, args=(s,)) for s in sqls]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300)
+    assert not any(t.is_alive() for t in threads), "concurrent spill hung"
+    assert not errors, errors
+
+
 def test_plan_cache_not_poisoned_and_bounded():
     from presto_tpu.serving import PlanCache
     cache = PlanCache(max_entries=8)
@@ -215,3 +514,105 @@ def test_plan_cache_not_poisoned_and_bounded():
     a = r.execute("select count(*) from nation")
     b = r.execute("select count(*) from nation")
     assert a.rows == b.rows == [[25]]
+
+
+# ---------------------------------------------------------------------------
+# distributed: revocation observability + chaos mid-spill
+# ---------------------------------------------------------------------------
+
+SPILL_CHAOS_SQL = ("select l_orderkey, max(o_totalprice), sum(l_quantity) "
+                   "from lineitem join orders on l_orderkey = o_orderkey "
+                   "group by l_orderkey")
+
+SPILL_SESSION = {"query_max_memory_per_node": "200kB",
+                 "task_batch_rows": "16384",
+                 "spill_partitions": "4"}
+
+
+def _http_metric(uri, name):
+    import urllib.request
+    with urllib.request.urlopen(uri + "/v1/metrics", timeout=5) as r:
+        text = r.read().decode()
+    for line in text.splitlines():
+        if line.startswith(name + " "):
+            return float(line.split()[1])
+    return None
+
+
+def test_revocation_observable_over_http():
+    """Acceptance: a revoked/spilled query is observable end to end — the
+    per-task TaskInfo carries spilledBytes > 0, the EXPLAIN ANALYZE footer
+    prints the Spilled line, and the worker's Prometheus surface exports
+    presto_tpu_memory_spilled_bytes_total."""
+    from presto_tpu.worker.coordinator import HttpQueryRunner
+    from presto_tpu.worker.server import WorkerServer
+
+    w = WorkerServer()
+    try:
+        r = HttpQueryRunner([w.uri], "sf0.01", n_tasks=1,
+                            session=SPILL_SESSION)
+        text = r.execute("EXPLAIN ANALYZE " + SPILL_CHAOS_SQL).rows[0][0]
+        assert "Spilled:" in text
+        info = r.last_query_info
+        assert info is not None
+        task_spilled = sum(
+            t["stats"]["spilledBytes"]
+            for st in info["stages"] for t in st["tasks"])
+        assert task_spilled > 0
+        assert _http_metric(
+            w.uri, "presto_tpu_memory_spilled_bytes_total") > 0
+    finally:
+        w.close()
+
+
+def test_chaos_worker_killed_mid_spill_recovers():
+    """A worker dying AFTER eviction has started (memory-constrained
+    session, every join task spills its build) must not lose or duplicate
+    rows: the coordinator reschedules the dead worker's tasks on the
+    survivors under .rN lineage ids, the retried tasks redo their spill
+    from scratch, and the results match the oracle exactly once."""
+    import threading
+    import time
+
+    from presto_tpu.common.errors import InjectedTaskFailure
+    from presto_tpu.exec.memory import MEMORY_METRICS
+    from presto_tpu.exec.runner import LocalQueryRunner as _LQR
+    from presto_tpu.worker.coordinator import HttpQueryRunner
+    from presto_tpu.worker.server import WorkerServer
+
+    w1, w2, w3 = WorkerServer(), WorkerServer(), WorkerServer()
+    base_spilled = MEMORY_METRICS.snapshot()["spilled_bytes"]
+    killed = threading.Event()
+
+    def kill_once_spilling(task_id):
+        # all three workers share this process, so the process-global
+        # spill counter moving means some sibling task is mid-eviction;
+        # wait (bounded) for that moment, then die under this task start
+        if killed.is_set():
+            return
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            if MEMORY_METRICS.snapshot()["spilled_bytes"] > base_spilled:
+                killed.set()
+                threading.Thread(target=w2.close, daemon=True).start()
+                raise InjectedTaskFailure(
+                    f"chaos: worker dying mid-spill under task {task_id}")
+            time.sleep(0.005)
+
+    w2.task_manager.fault_injector = kill_once_spilling
+    try:
+        r = HttpQueryRunner(
+            [w1.uri, w2.uri, w3.uri], "sf0.01", n_tasks=2,
+            session={**SPILL_SESSION,
+                     "exchange_max_error_duration": "5s"})
+        got = r.execute(SPILL_CHAOS_SQL)
+        assert killed.is_set(), "chaos hook never fired mid-spill"
+        assert r.tasks_retried >= 1
+        retried = sum(w.task_manager.tasks_retried for w in (w1, w3))
+        assert retried >= 1
+        want = _LQR("sf0.01").execute(SPILL_CHAOS_SQL)
+        from presto_tpu.exec.runner import _assert_rows_equal
+        _assert_rows_equal(got, want, False)
+    finally:
+        for w in (w1, w2, w3):
+            w.close()

@@ -105,9 +105,10 @@ def test_scrape_covers_every_registry():
     names = {n for n, _v, _a in scrape_metric_points()}
     for prefix in ("presto_tpu.exchange.", "presto_tpu.exchange_fabric.",
                    "presto_tpu.serving.", "presto_tpu.storage.",
-                   "presto_tpu.kernel."):
+                   "presto_tpu.kernel.", "presto_tpu.memory."):
         assert any(n.startswith(prefix) for n in names), prefix
     assert "presto_tpu.kernel.scan_programs" in names
+    assert "presto_tpu.memory.spilled_bytes" in names
 
 
 def test_make_sink_dispatch(tmp_path):
