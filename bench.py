@@ -11,13 +11,17 @@ vs_baseline = speedup vs the single-threaded numpy reference interpreter
               each round so the ratio tracks engine improvements only.
 
 Env knobs: BENCH_SF (default 10), BENCH_RUNS (default 3),
-BENCH_QUERY (q1|q6|q6z|q3g|xchg|serve|spill).
+BENCH_QUERY (q1|q6|q6z|q3g|q3k|xchg|serve|spill).
 
-q1/q6/q6z lines also carry a "scan_kernel" object: best-of-N walls and
-effective_scan_gbps for the same query pinned to scan_kernel=pallas and
-scan_kernel=xla (plus pallas_vs_xla, the xla/pallas wall ratio), so TPU
-rounds measure the fused Pallas scan kernel against the XLA chain and
-the r04 15 GB/s baseline directly.
+q1/q6/q6z/q1g/q3k lines also carry a "scan_kernel" object: best-of-N
+walls and effective_scan_gbps for the same query pinned to
+scan_kernel=pallas and scan_kernel=xla (plus pallas_vs_xla, the
+xla/pallas wall ratio), so TPU rounds measure the fused Pallas scan
+kernel against the XLA chain and the r04 15 GB/s baseline directly.
+BENCH_QUERY=q3k is the Q3-shaped probe-join+agg: the orders build
+table rides inside the scan kernel launch (kernels/join.py), so the
+pinned comparison covers the in-kernel join probe alongside the
+scan/agg-only shapes.
 
 BENCH_QUERY=serve is the serving-tier benchmark: BENCH_SERVE_CLIENTS
 concurrent statement-protocol clients (default 4) each issuing
@@ -137,6 +141,20 @@ WHERE l_orderkey = o_orderkey AND o_orderdate < DATE '1995-03-15'
   AND l_shipdate > DATE '1995-03-15'
 GROUP BY l_orderkey
 ORDER BY revenue DESC LIMIT 10
+"""
+
+# join-kernel eligible: the same Q3 probe chain (filtered orders build,
+# lineitem probe side) WITHOUT the order/limit tail, grouped on the
+# bucket key — BENCH_QUERY=q3k pins the pallas-vs-xla scan_kernel
+# comparison on it so the real-TPU re-measure covers the in-kernel join
+# probe (kernels/join.py) end to end
+Q3K = """
+SELECT l_orderkey, sum(l_extendedprice * (1 - l_discount)) AS revenue,
+       count(*) AS cnt
+FROM orders, lineitem
+WHERE l_orderkey = o_orderkey AND o_orderdate < DATE '1995-03-15'
+  AND l_shipdate > DATE '1995-03-15'
+GROUP BY l_orderkey
 """
 
 
@@ -533,7 +551,8 @@ def main():
     if qname == "spill":
         return bench_spill(runs)
     sf = float(os.environ.get("BENCH_SF", "10"))
-    sql = {"q1": Q1, "q6": Q6, "q6z": Q6, "q3g": Q3G, "q1g": Q1G}[qname]
+    sql = {"q1": Q1, "q6": Q6, "q6z": Q6, "q3g": Q3G, "q1g": Q1G,
+           "q3k": Q3K}[qname]
     if qname == "q1g":
         groups = int(os.environ.get("BENCH_Q1G_GROUPS", "4096"))
         sql = sql.format(groups=groups)
@@ -595,6 +614,7 @@ def main():
         "q6z": 4 + 8 + 8 + 8 + 8,          # q6 + orderkey
         "q3g": 8 + 8 + 8 + 4,              # orderkey,price,disc,shipdate
         "q1g": 8 + 8 + 8 + 8 + 4,          # orderkey,qty,price,disc,shipdate
+        "q3k": 8 + 8 + 8 + 4,              # orderkey,price,disc,shipdate
     }[qname]
     achieved_gbps = rows_per_sec * col_bytes / 1e9
     hbm_peak_gbps = float(os.environ.get("BENCH_HBM_PEAK_GBPS", "819"))
@@ -648,7 +668,7 @@ def main():
     # sides; kernel_programs counts fused scan programs that actually took
     # the Pallas path (0 under xla or when every scan declined), and
     # declined carries the per-reason counters for ineligible scans.
-    if qname in ("q1", "q6", "q6z", "q1g"):
+    if qname in ("q1", "q6", "q6z", "q1g", "q3k"):
         import dataclasses
         kcmp = {}
         for mode in ("pallas", "xla"):

@@ -62,14 +62,18 @@ from .scan_kernel import (GROUPED_SUBTILE_ROWS, KERNEL_HASH_MAX_SLOTS,
 
 def build_hash_runner(chain, kinds: Dict[str, str], n_params: int, *,
                       specs, key_names, key_dtypes, num_slots, salt=0,
-                      agg_exprs, lowering, dma: str = "single"):
+                      agg_exprs, lowering, dma: str = "single",
+                      join_plan=None):
     """Jitted Pallas launcher for the hashed grouped mode: the
     open-addressing accumulator table of ops.agg_init/agg_update lives
     in the kernel's per-entry output blocks (grid steps accumulate into
     block 0), updated subtile-by-subtile over the compacted rows with
     the SAME probe/scatter code the XLA chain runs -- the kernel cannot
     drift from the engine's slot semantics.  Returns (launcher,
-    entry_names)."""
+    entry_names).  `join_plan` lowers fanout-1 join/semi probe steps
+    in-kernel exactly as in build_direct_runner (kernels/join.py)."""
+    from .join import join_appliers
+    n_join = len(join_plan.arrays) if join_plan is not None else 0
     meta = chain.scan_meta
     br = block_rows_for(chain.leaf_cap(()))
     steps = chain.steps
@@ -96,8 +100,10 @@ def build_hash_runner(chain, kinds: Dict[str, str], n_params: int, *,
             scratch = refs[-(n_staged + 1):-1]
             sem = refs[-1]
             refs = refs[:-(n_staged + 1)]
-        col_refs = refs[:len(refs) - n_entries - 1 - n_params]
-        param_refs = refs[len(col_refs):len(col_refs) + n_params]
+        col_refs = refs[:len(refs) - n_entries - 1 - n_params - n_join]
+        join_refs = refs[len(col_refs):len(col_refs) + n_join]
+        param_refs = refs[len(col_refs) + n_join:
+                          len(col_refs) + n_join + n_params]
         state_refs = refs[-(n_entries + 1):-1]
         counts_ref = refs[-1]
         i = pl.program_id(0)
@@ -119,8 +125,12 @@ def build_hash_runner(chain, kinds: Dict[str, str], n_params: int, *,
         cols = decode_columns(names, kinds, dicts, col_refs, slabs,
                               pos, idx0, live)
         params_k = tuple(p[...][0] for p in param_refs)
+        appliers = (join_appliers(join_plan,
+                                  [r[...] for r in join_refs])
+                    if n_join else None)
         batch, counts = run_chain_steps(Batch(cols, live), live, steps,
-                                        lowering, params_k, n_params)
+                                        lowering, params_k, n_params,
+                                        appliers)
 
         # compact the group-key columns alongside the aggregate inputs:
         # the hash update probes on VALUES, so the keys ride the same
@@ -166,9 +176,12 @@ def build_hash_runner(chain, kinds: Dict[str, str], n_params: int, *,
             jnp.int64)[None, :]
 
     @jax.jit
-    def run(bidx, lo, hi, arrays, params):
+    def run(bidx, lo, hi, arrays, jarrays, params):
         flat = list(arrays)
         in_specs = encoded_in_specs(names, kinds, flat, br, staged)
+        for a in jarrays:
+            flat.append(a)
+            in_specs.append(pl.BlockSpec(a.shape, _whole_1d))
         for p in params:
             flat.append(jnp.asarray(p).reshape(1))
             in_specs.append(pl.BlockSpec((1,), _whole_1d))
@@ -201,7 +214,7 @@ def try_grouped_scan_kernel(chain, aux, *, specs, key_names, key_dtypes,
                             key_dicts, key_lazy, span_info, est_slots,
                             agg_exprs, lowering, cache, declined, pool,
                             state_bytes, runtime_stats=None,
-                            dma: str = "single"):
+                            dma: str = "single", expands=()):
     """Run a grouped (G > 64) aggregation chain through the Pallas
     kernel when eligible: span mode when `span_info` (the caller's
     _direct_mode_info at gmax=KERNEL_SPAN_MAX_GROUPS) is set, hashed
@@ -211,11 +224,22 @@ def try_grouped_scan_kernel(chain, aux, *, specs, key_names, key_dtypes,
     take over.  The AggGroupCardinality capacity gate covers: a group
     estimate over KERNEL_HASH_MAX_SLOTS, a failed accumulator memory
     reservation, and a runtime probe overflow (each of which means the
-    group population is too large for a VMEM-resident table)."""
-    elig = chain_eligible(chain, aux, declined)
+    group population is too large for a VMEM-resident table).
+
+    Chains with fanout-1 join/semi steps (Q3/Q18 shapes) lower their
+    probes in-kernel; `expands` is prep()'s per-join fanout tuple, and
+    the build operand bytes are charged to `pool` non-revocably for
+    each launch (kernels/join.py)."""
+    from .join import (KERNEL_JOIN_MAX_BUILD_BYTES, plan_join_layout,
+                       reserve_build_operands)
+    elig = chain_eligible(chain, aux, declined, allow_joins=True)
     if elig is None:
         return None
     cached, colmap = elig
+    jplan = plan_join_layout(chain.steps, aux, expands, declined,
+                             max_bytes=KERNEL_JOIN_MAX_BUILD_BYTES)
+    if jplan is None:
+        return None
     names = tuple(colmap)
     br = block_rows_for(chain.leaf_cap(()))
     n_steps = len(chain.steps)
@@ -241,7 +265,8 @@ def try_grouped_scan_kernel(chain, aux, *, specs, key_names, key_dtypes,
                 max_block = max(b for b, _lo, _hi in grid)
                 flat_arrays = gather_encoded_arrays(
                     cached, colmap, names, (max_block + 1) * br, cache)
-                key = ("pallas_span", G, strides, len(params), dma)
+                key = ("pallas_span", G, strides, len(params), dma,
+                       jplan.sig)
                 runner = cache.get(key)
                 if runner is None:
                     runner = build_direct_runner(
@@ -249,7 +274,8 @@ def try_grouped_scan_kernel(chain, aux, *, specs, key_names, key_dtypes,
                         key_names=key_names, strides=strides, G=G,
                         agg_exprs=agg_exprs, lowering=lowering, dma=dma,
                         update_fn=ops.agg_span_update,
-                        subtile=GROUPED_SUBTILE_ROWS)
+                        subtile=GROUPED_SUBTILE_ROWS,
+                        join_plan=jplan if jplan.steps else None)
                     cache[key] = runner
                 bidx = jnp.asarray([b for b, _, _ in grid],
                                    dtype=jnp.int32)
@@ -257,9 +283,16 @@ def try_grouped_scan_kernel(chain, aux, *, specs, key_names, key_dtypes,
                                  dtype=jnp.int32)
                 hi = jnp.asarray([h for _, _, h in grid],
                                  dtype=jnp.int32)
-                acc_i, acc_f, kc = runner.fn(
-                    bidx, lo, hi, flat_arrays, params,
-                    runner.init_i, runner.init_f)
+                if not reserve_build_operands(pool, jplan.nbytes):
+                    declined("JoinBuildSize")
+                    return None
+                try:
+                    acc_i, acc_f, kc = runner.fn(
+                        bidx, lo, hi, flat_arrays, jplan.arrays, params,
+                        runner.init_i, runner.init_f)
+                finally:
+                    if jplan.nbytes:
+                        pool.free(jplan.nbytes)
                 state = {k: acc_i[j]
                          for j, k in enumerate(runner.int_names)}
                 state.update({k: acc_f[j]
@@ -315,17 +348,27 @@ def try_grouped_scan_kernel(chain, aux, *, specs, key_names, key_dtypes,
             return None
         try:
             key = ("pallas_hash", num_slots, salt, tuple(key_names),
-                   tuple(str(d) for d in key_dtypes), len(params), dma)
+                   tuple(str(d) for d in key_dtypes), len(params), dma,
+                   jplan.sig)
             hit = cache.get(key)
             if hit is None:
                 hit = build_hash_runner(
                     chain, kinds, len(params), specs=specs,
                     key_names=key_names, key_dtypes=key_dtypes,
                     num_slots=num_slots, salt=salt, agg_exprs=agg_exprs,
-                    lowering=lowering, dma=dma)
+                    lowering=lowering, dma=dma,
+                    join_plan=jplan if jplan.steps else None)
                 cache[key] = hit
             run, entry_names = hit
-            outs = run(bidx, lo, hi, flat_arrays, params)
+            if not reserve_build_operands(pool, jplan.nbytes):
+                declined("JoinBuildSize")
+                return None
+            try:
+                outs = run(bidx, lo, hi, flat_arrays, jplan.arrays,
+                           params)
+            finally:
+                if jplan.nbytes:
+                    pool.free(jplan.nbytes)
             state = {}
             for name, v in zip(entry_names, outs[:-1]):
                 state[name] = v[0] if name == "__collision" else v

@@ -1,0 +1,277 @@
+"""In-kernel join probe lowering for the Pallas fused scan kernel.
+
+exec/fused.py already compiles probe-side FK->PK join chains into one
+XLA program, but each probe still materializes gathered build columns
+as a full chunk-width page between chain steps.  This module lowers the
+two fanout-1 probe forms into the scan kernel BODY so
+decode -> filter -> probe(-> probe...) -> compact -> agg runs in a
+single PrefetchScalarGridSpec launch:
+
+  * DirectTable (fused.probe_direct / ops.direct_lookup): dense integer
+    PK; the probe is one int32 gather against the whole-block
+    VMEM-resident slot array.
+  * hash-sorted ops.BuildTable (fused.probe_unique): multi-column or
+    sparse keys; searchsorted becomes the fixed-trip _bisect_left below
+    (jnp.searchsorted does not lower inside Pallas TPU kernels; the
+    loop is exact integer arithmetic, so it cannot drift from the XLA
+    chain's side="left" search).
+
+plan_join_layout inspects the chain's join/semi steps ONCE per launch
+and flattens every build operand (slot/hash arrays, gathered build
+columns, the semi null-key flag) into a positional array list; the scan
+kernel passes them as whole-1D VMEM blocks and join_appliers rebuilds
+per-step closures over the in-kernel refs.  Build operands therefore
+live across the entire grid without ever being re-materialized as a
+probe output page.
+
+Gates (kernelDeclined reasons, scan_kernel.KERNEL_DECLINE_REASONS):
+  JoinShape      fanout-k expansion joins (expands[ji] > 1), residual
+                 ON filters, non-INNER/LEFT forms, deferred build
+                 slots, and dictionary/lazy build columns (their
+                 decode state lives outside the kernel)
+  JoinBuildSize  flattened operand bytes over
+                 KERNEL_JOIN_MAX_BUILD_BYTES, or the MemoryContext
+                 reservation failed (kernels hold a live device
+                 reference, so the bytes are charged NON-revocable:
+                 arbitration may revoke others to admit them but can
+                 never spill the build mid-launch)
+
+Parity contract: the applier math is copied operation-for-operation
+from ops.direct_lookup / fused.probe_unique / FusedChain._apply_join /
+the semi branch of FusedChain.make, so hit masks, gathered values and
+three-valued semi markers are bit-identical to the XLA chain.
+"""
+from __future__ import annotations
+
+import math
+from typing import NamedTuple, Tuple
+
+import jax.numpy as jnp
+
+from ...spi import plan as P
+from .. import operators as ops
+from ..batch import Batch, Column
+
+# cap on the flattened build-operand bytes a single kernel launch may
+# pin in VMEM next to the decoded block (dim tables for the Q3/Q18/Q95
+# shapes are far below this; a fact-sized build declines and runs the
+# XLA chain, which pages through HBM instead)
+KERNEL_JOIN_MAX_BUILD_BYTES = 1 << 22
+
+
+def _bisect_left(a, v):
+    """searchsorted(a, v, side="left") as a fixed-trip vectorized
+    binary search — the side="left" twin of scan_kernel._bisect_right,
+    matching fused.probe_unique's jnp.searchsorted exactly."""
+    size = a.shape[0]
+    steps = max(1, int(math.ceil(math.log2(size + 1))) + 1)
+    lo = jnp.zeros(v.shape, dtype=jnp.int64)
+    hi = jnp.full(v.shape, size, dtype=jnp.int64)
+    for _ in range(steps):
+        cont = lo < hi
+        mid = (lo + hi) // 2
+        lt = a[jnp.clip(mid, 0, size - 1)] < v
+        lo = jnp.where(cont & lt, mid + 1, lo)
+        hi = jnp.where(cont & ~lt, mid, hi)
+    return lo
+
+
+class JoinStepPlan(NamedTuple):
+    si: int                            # chain step index
+    kind: str                          # "join" | "semi"
+    table: str                         # "direct" | "unique"
+    is_left: bool                      # LEFT join (null-extend misses)
+    probe_keys: Tuple[str, ...]        # probe-side key column names
+    out_name: str                      # semi marker output ("" for join)
+    gcols: Tuple[Tuple[str, bool], ...]  # (build column, has_nulls)
+    arr_count: int                     # flat operands this step consumes
+
+
+class JoinPlan(NamedTuple):
+    steps: Tuple[JoinStepPlan, ...]
+    arrays: tuple                      # flat device operands, step order
+    sig: tuple                         # hashable layout key (runner cache)
+    nbytes: int                        # flattened operand bytes
+
+
+def plan_join_layout(steps, aux, expands, declined, max_bytes=None):
+    """Flatten the chain's join/semi build tables into a kernel operand
+    layout.  `steps`/`aux`/`expands` use FusedChain.prep's layout
+    (aux[0] = scan cache, aux[ji + 1] per join-ish step).  Returns a
+    JoinPlan (empty when the chain has no join/semi steps) or None
+    after metering one decline."""
+    from ..fused import DirectTable, _join_build_cols
+    jsteps = []
+    arrays = []
+    sig = []
+    nbytes = 0
+    ji = 0
+    for si, step in enumerate(steps):
+        kind = step[0]
+        if kind not in ("join", "semi"):
+            continue
+        node = step[1]
+        ent = aux[ji + 1]
+        fanout = expands[ji]
+        ji += 1
+        if fanout != 1:
+            # fanout-k expansion changes the chunk capacity mid-chain;
+            # the kernel's fixed block geometry cannot follow it
+            declined("JoinShape")
+            return None
+        is_left = False
+        out_name = ""
+        if kind == "semi":
+            tbl, bhn = ent
+            probe_keys = (node.source_join_variable.name,)
+            gcols: Tuple[Tuple[str, bool], ...] = ()
+            out_name = node.semi_join_output.name
+        else:
+            tbl = ent
+            if node.filter is not None \
+                    or node.join_type not in (P.INNER, P.LEFT):
+                declined("JoinShape")
+                return None
+            is_left = node.join_type == P.LEFT
+            probe_keys = tuple(l.name for l, _r in node.criteria)
+            build_names = {v.name for v in node.right.output_variables}
+            out_names = [v.name for v in node.outputs]
+            gspec = []
+            for n in _join_build_cols(node, out_names, build_names):
+                c = tbl.columns[n]
+                if c.dictionary is not None or c.lazy is not None:
+                    declined("JoinShape")
+                    return None
+                gspec.append((n, c.nulls is not None))
+            gcols = tuple(gspec)
+        if isinstance(tbl, DirectTable):
+            table_kind = "direct"
+            step_arrays = [tbl.slots,
+                           jnp.asarray(tbl.base, jnp.int64).reshape(1)]
+        elif isinstance(tbl, ops.BuildTable):
+            table_kind = "unique"
+            step_arrays = [tbl.keyhash_sorted, tbl.perm]
+        else:
+            # deferred build slot (grouped-lifespan execution) or an
+            # unknown table form
+            declined("JoinShape")
+            return None
+        for n, has_nulls in gcols:
+            c = tbl.columns[n]
+            step_arrays.append(c.values)
+            if has_nulls:
+                step_arrays.append(c.nulls)
+        if kind == "semi":
+            step_arrays.append(jnp.asarray(bhn, bool).reshape(1))
+        nbytes += sum(int(a.size) * a.dtype.itemsize for a in step_arrays)
+        jsteps.append(JoinStepPlan(si, kind, table_kind, is_left,
+                                   probe_keys, out_name, gcols,
+                                   len(step_arrays)))
+        sig.append((si, kind, table_kind, is_left, probe_keys, out_name,
+                    gcols))
+        arrays += step_arrays
+    if jsteps and max_bytes is not None and nbytes > max_bytes:
+        declined("JoinBuildSize")
+        return None
+    return JoinPlan(tuple(jsteps), tuple(arrays), tuple(sig), nbytes)
+
+
+def reserve_build_operands(pool, nbytes: int) -> bool:
+    """Charge the kernel's build operands to the owning operator's
+    MemoryContext as NON-revocable (revocation-exempt) reserved bytes:
+    the launched kernel holds a live device reference, so arbitration
+    may revoke OTHER revocable holders to admit the reservation but
+    must never spill the build itself mid-launch.  The caller frees the
+    same byte count after the launch."""
+    if pool is None or not nbytes:
+        return True
+    return pool.try_reserve(nbytes)
+
+
+def _make_applier(sp: JoinStepPlan, arrs):
+    """One chain-step replacement closure over the step's in-kernel
+    operand arrays (scan_kernel.run_chain_steps `appliers`)."""
+    if sp.table == "direct":
+        slots, base = arrs[0], arrs[1]
+
+        def probe(batch):
+            # ops.direct_lookup over the VMEM-resident slot array
+            col = batch.columns[sp.probe_keys[0]]
+            v = col.values.astype(jnp.int64)
+            size = slots.shape[0]
+            k = v - base[0]
+            inb = (k >= 0) & (k < size)
+            slot = slots[jnp.clip(k, 0, size - 1).astype(jnp.int32)]
+            hit = inb & (slot >= 0)
+            if col.nulls is not None:
+                hit = hit & ~col.nulls
+            return hit, jnp.where(hit, slot, 0)
+    else:
+        khs, perm = arrs[0], arrs[1]
+
+        def probe(batch):
+            # fused.probe_unique with the fixed-trip bisect standing in
+            # for jnp.searchsorted(side="left")
+            cols = [batch.columns[k] for k in sp.probe_keys]
+            kh = ops._orderable_hash(ops.hash_columns(cols))
+            nb = perm.shape[0]
+            lo = jnp.clip(_bisect_left(khs, kh).astype(jnp.int32),
+                          0, nb - 1)
+            hit = khs[lo] == kh
+            for c in cols:
+                if c.nulls is not None:
+                    hit = hit & ~c.nulls
+            return hit, jnp.where(hit, perm[lo], 0)
+
+    if sp.kind == "semi":
+        bhn = arrs[2]
+
+        def semi_applier(batch):
+            hit, _ = probe(batch)
+            # three-valued marker: NULL probe key, or miss against a
+            # build side that contained NULL (FusedChain.make semantics)
+            nulls = ~hit & bhn[0]
+            pn = batch.columns[sp.probe_keys[0]].nulls
+            if pn is not None:
+                nulls = nulls | pn
+            return batch.with_columns({sp.out_name: Column(hit, nulls)})
+        return semi_applier
+
+    gathered = []
+    i = 2
+    for name, has_nulls in sp.gcols:
+        gv = arrs[i]
+        i += 1
+        gn = None
+        if has_nulls:
+            gn = arrs[i]
+            i += 1
+        gathered.append((name, gv, gn))
+
+    def join_applier(batch):
+        hit, bidx = probe(batch)
+        cols = dict(batch.columns)
+        for name, gv, gn in gathered:
+            vals = gv[bidx]
+            nulls = gn[bidx] if gn is not None else None
+            if sp.is_left:
+                # null-extend build columns on misses; probe rows stay
+                miss = ~hit
+                nulls = (nulls | miss) if nulls is not None else miss
+            cols[name] = Column(vals, nulls)
+        if sp.is_left:
+            return Batch(cols, batch.mask)
+        return Batch(cols, batch.mask & hit)
+    return join_applier
+
+
+def join_appliers(plan: JoinPlan, arrs):
+    """{step index: applier} closures over the flat in-kernel operand
+    arrays (the kernel body reads each join ref whole and passes the
+    list here, in plan.arrays order)."""
+    appliers = {}
+    off = 0
+    for sp in plan.steps:
+        appliers[sp.si] = _make_applier(sp, arrs[off:off + sp.arr_count])
+        off += sp.arr_count
+    return appliers

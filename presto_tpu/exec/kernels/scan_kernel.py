@@ -88,8 +88,22 @@ KERNEL_DECLINE_REASONS = (
     #                          gates (span > KERNEL_SPAN_MAX_GROUPS and
     #                          hash estimate/collision > KERNEL_HASH_MAX_SLOTS)
     "Backend",               # platform is neither tpu nor cpu-interpret
-    "PlanShape",             # chain has join/semi/uid steps
+    "PlanShape",             # chain has uid steps (position-keyed unique
+    #                          ids need the XLA chain's expansion layout)
     "ColumnsNotResident",    # a scanned column is not HBM-resident encoded
+    "JoinShape",             # fanout-k expansion join, residual ON filter,
+    #                          or a non-INNER/LEFT fused join form
+    #                          (kernels/join.py plan_join_layout)
+    "JoinBuildSize",         # build-table operand bytes over
+    #                          KERNEL_JOIN_MAX_BUILD_BYTES, or the
+    #                          MemoryContext reservation failed
+    "WindowFunctionShape",   # window function / frame / float accumulation
+    #                          outside the prefix-sum kernel's repertoire
+    #                          (kernels/window.py)
+    "WindowKeyShape",        # late-materialized (lazy) partition/order/arg
+    #                          column: peer detection needs decoded values
+    "WindowInputSize",       # padded sort run over KERNEL_WINDOW_MAX_BYTES
+    #                          (whole input must sit in VMEM at once)
 )
 
 # compacted rows are aggregated in subtiles of this many rows: the
@@ -125,6 +139,7 @@ class KernelMetrics:
         self._lock = threading.Lock()
         self.declined: Dict[str, int] = {}
         self.scan_programs = 0
+        self.window_programs = 0
         self.dma_staged_blocks = 0
         self.dma_prefetched_blocks = 0
 
@@ -138,12 +153,17 @@ class KernelMetrics:
             self.dma_staged_blocks += n_staged_copies
             self.dma_prefetched_blocks += n_prefetched
 
+    def record_window_run(self) -> None:
+        with self._lock:
+            self.window_programs += 1
+
     def snapshot(self) -> dict:
         with self._lock:
             staged = self.dma_staged_blocks
             return {
                 "declined": dict(self.declined),
                 "scan_programs": self.scan_programs,
+                "window_programs": self.window_programs,
                 "dma_staged_blocks": staged,
                 "dma_prefetched_blocks": self.dma_prefetched_blocks,
                 "dma_overlap_fraction": (
@@ -374,20 +394,25 @@ def decode_columns(names, kinds, dicts, col_refs, slabs, pos, idx0,
 
 
 def run_chain_steps(batch: Batch, live, steps, lowering, params_k,
-                    n_params):
+                    n_params, appliers=None):
     """The chain's own filter/project/rename steps, lowered by the
     engine's Lowering (shared with the XLA chain), with the same
     per-step live-row counters chain.make(with_counts=True) emits.
     The bound-parameter vector rides along for step expressions exactly
     as in FusedChain.make's _pb (aggregation input expressions see a
-    param-less batch on both paths)."""
+    param-less batch on both paths).  `appliers` maps a step index to an
+    in-kernel replacement closure (the join/semi probe appliers from
+    kernels/join.py, which read the VMEM-resident build operands
+    directly) -- every other step kind still lowers here."""
     def _pb(b):
         return b.with_params(params_k) if n_params else b
 
     counts = [jnp.sum(live)]
-    for step in steps:
+    for si, step in enumerate(steps):
         kind = step[0]
-        if kind == "filter":
+        if appliers is not None and si in appliers:
+            batch = appliers[si](batch)
+        elif kind == "filter":
             batch = ops.apply_filter(
                 batch, lowering.eval(step[1], _pb(batch)))
         elif kind == "project":
@@ -479,15 +504,19 @@ def dma_scratch_shapes(staged, flat, block_rows):
     return shapes
 
 
-def chain_eligible(chain, aux, declined):
+def chain_eligible(chain, aux, declined, allow_joins: bool = False):
     """Gates shared by every kernel mode: backend, chain step shapes,
     HBM residency.  Returns (cached, colmap) or None after metering one
-    decline."""
+    decline.  `allow_joins` admits join/semi probe steps (the caller
+    must then lower them via kernels/join.py plan_join_layout, which
+    applies its own Join* gates); uid steps always decline -- their
+    position-keyed ids need the XLA chain's expansion layout."""
+    allowed = (("filter", "project", "rename", "join", "semi")
+               if allow_joins else ("filter", "project", "rename"))
     if jax.default_backend() not in ("cpu", "tpu"):
         declined("Backend")
         return None
-    if any(s[0] not in ("filter", "project", "rename")
-           for s in chain.steps):
+    if any(s[0] not in allowed for s in chain.steps):
         declined("PlanShape")
         return None
     cached = aux[0] or {}
@@ -551,7 +580,8 @@ def meter_kernel_run(runtime_stats, n_blocks, n_staged, dma) -> None:
 def build_direct_runner(chain, kinds: Dict[str, str], n_params: int, *,
                         specs, key_names, strides, G, agg_exprs,
                         lowering, dma: str = "single",
-                        update_fn=None, subtile: int = None) -> _Runner:
+                        update_fn=None, subtile: int = None,
+                        join_plan=None) -> _Runner:
     """Compile the chain's static shape (column encodings, steps, agg
     specs) into a jitted Pallas launcher.  `kinds` maps each scan
     output name to its ResidentColumn encoding; `n_params` is the
@@ -564,9 +594,17 @@ def build_direct_runner(chain, kinds: Dict[str, str], n_params: int, *,
     split), so the SAME stacked-accumulator kernel serves both the
     direct mode (update_fn = ops.agg_direct_update, one-hot grid,
     G<=64) and the grouped span mode (update_fn = ops.agg_span_update,
-    packed scatter, G up to KERNEL_SPAN_MAX_GROUPS)."""
+    packed scatter, G up to KERNEL_SPAN_MAX_GROUPS).
+
+    `join_plan` (kernels/join.py JoinPlan) lowers the chain's fanout-1
+    join/semi probe steps into the kernel body: its flat build operands
+    ride as whole-1D VMEM inputs between the encoded columns and the
+    bound parameters, and run_chain_steps swaps the matching steps for
+    the plan's probe appliers."""
+    from .join import join_appliers
     update_fn = update_fn or ops.agg_direct_update
     ts_rows = subtile or SUBTILE_ROWS
+    n_join = len(join_plan.arrays) if join_plan is not None else 0
     meta = chain.scan_meta
     br = block_rows_for(chain.leaf_cap(()))
     steps = chain.steps
@@ -594,8 +632,10 @@ def build_direct_runner(chain, kinds: Dict[str, str], n_params: int, *,
             scratch = refs[-(n_staged + 1):-1]
             sem = refs[-1]
             refs = refs[:-(n_staged + 1)]
-        col_refs = refs[:len(refs) - 5 - n_params]
-        param_refs = refs[len(col_refs):len(col_refs) + n_params]
+        col_refs = refs[:len(refs) - 5 - n_params - n_join]
+        join_refs = refs[len(col_refs):len(col_refs) + n_join]
+        param_refs = refs[len(col_refs) + n_join:
+                          len(col_refs) + n_join + n_params]
         init_i_ref, init_f_ref = refs[-5:-3]
         acc_i_ref, acc_f_ref, counts_ref = refs[-3:]
         i = pl.program_id(0)
@@ -616,8 +656,12 @@ def build_direct_runner(chain, kinds: Dict[str, str], n_params: int, *,
         cols = decode_columns(names, kinds, dicts, col_refs, slabs,
                               pos, idx0, live)
         params_k = tuple(p[...][0] for p in param_refs)
+        appliers = (join_appliers(join_plan,
+                                  [r[...] for r in join_refs])
+                    if n_join else None)
         batch, counts = run_chain_steps(Batch(cols, live), live, steps,
-                                        lowering, params_k, n_params)
+                                        lowering, params_k, n_params,
+                                        appliers)
 
         codes = None
         for k, stride in zip(key_names, strides):
@@ -652,9 +696,13 @@ def build_direct_runner(chain, kinds: Dict[str, str], n_params: int, *,
             jnp.int64)[None, :]
 
     @jax.jit
-    def run(bidx, lo, hi, arrays, params, init_i_arg, init_f_arg):
+    def run(bidx, lo, hi, arrays, jarrays, params, init_i_arg,
+            init_f_arg):
         flat = list(arrays)
         in_specs = encoded_in_specs(names, kinds, flat, br, staged)
+        for a in jarrays:
+            flat.append(a)
+            in_specs.append(pl.BlockSpec(a.shape, _whole_1d))
         for p in params:
             flat.append(jnp.asarray(p).reshape(1))
             in_specs.append(pl.BlockSpec((1,), _whole_1d))
@@ -688,18 +736,30 @@ def build_direct_runner(chain, kinds: Dict[str, str], n_params: int, *,
 
 def try_direct_scan_kernel(chain, aux, *, specs, key_names, strides, G,
                            agg_exprs, lowering, cache, declined,
-                           runtime_stats=None, dma: str = "single"):
+                           runtime_stats=None, dma: str = "single",
+                           expands=(), pool=None):
     """Run the fused scan chain through the Pallas kernel when eligible.
 
     Returns (agg_direct state dict, int64[1 + n_steps] row counters,
     grid length) on success -- the caller feeds them to
     agg_direct_finalize and the operator-stats spine exactly like the
     XLA direct path -- or None after recording one
-    kernelDeclined{reason} counter."""
-    elig = chain_eligible(chain, aux, declined)
+    kernelDeclined{reason} counter.
+
+    Chains with fanout-1 join/semi steps lower their probes in-kernel
+    (kernels/join.py); `expands` is prep()'s per-join fanout tuple and
+    `pool` the owning operator's MemoryContext, charged the build
+    operand bytes non-revocably for the launch's duration."""
+    from .join import (KERNEL_JOIN_MAX_BUILD_BYTES, plan_join_layout,
+                       reserve_build_operands)
+    elig = chain_eligible(chain, aux, declined, allow_joins=True)
     if elig is None:
         return None
     cached, colmap = elig
+    jplan = plan_join_layout(chain.steps, aux, expands, declined,
+                             max_bytes=KERNEL_JOIN_MAX_BUILD_BYTES)
+    if jplan is None:
+        return None
     br = block_rows_for(chain.leaf_cap(()))
     params_fp = chain.compiler.ctx.params_fingerprint
     grid = aligned_grid(chain.scan_meta, br, params_fp)
@@ -716,20 +776,28 @@ def try_direct_scan_kernel(chain, aux, *, specs, key_names, strides, G,
                                         (max_block + 1) * br, cache)
 
     params = tuple(aux[-1]) if chain.has_params else ()
-    key = ("pallas_direct", G, strides, len(params), dma)
+    key = ("pallas_direct", G, strides, len(params), dma, jplan.sig)
     runner = cache.get(key)
     if runner is None:
         kinds = {name: cached[colmap[name]].kind for name in colmap}
         runner = build_direct_runner(
             chain, kinds, len(params), specs=specs, key_names=key_names,
             strides=strides, G=G, agg_exprs=agg_exprs, lowering=lowering,
-            dma=dma)
+            dma=dma, join_plan=jplan if jplan.steps else None)
         cache[key] = runner
+    if not reserve_build_operands(pool, jplan.nbytes):
+        declined("JoinBuildSize")
+        return None
     bidx = jnp.asarray([b for b, _lo, _hi in grid], dtype=jnp.int32)
     lo = jnp.asarray([lo_ for _b, lo_, _hi in grid], dtype=jnp.int32)
     hi = jnp.asarray([hi_ for _b, _lo, hi_ in grid], dtype=jnp.int32)
-    acc_i, acc_f, kcounts = runner.fn(bidx, lo, hi, flat_arrays, params,
-                                      runner.init_i, runner.init_f)
+    try:
+        acc_i, acc_f, kcounts = runner.fn(bidx, lo, hi, flat_arrays,
+                                          jplan.arrays, params,
+                                          runner.init_i, runner.init_f)
+    finally:
+        if pool is not None and jplan.nbytes:
+            pool.free(jplan.nbytes)
     state = {k: acc_i[j] for j, k in enumerate(runner.int_names)}
     state.update({k: acc_f[j] for j, k in enumerate(runner.flt_names)})
     kinds = {name: cached[colmap[name]].kind for name in colmap}

@@ -1336,6 +1336,35 @@ class PlanCompiler:
                     encode.append(k)
             if encode:
                 merged = _encode_lazy_keys(merged, encode)
+            cfg = self.ctx.config
+
+            def _declined(reason: str) -> None:
+                from .kernels.scan_kernel import KERNEL_METRICS
+                KERNEL_METRICS.record_declined(reason)
+                rs = self.ctx.runtime_stats
+                if rs is not None:
+                    rs.add(f"kernelDeclined{reason}", 1)
+
+            if cfg.scan_kernel == "xla":
+                _declined("Disabled")
+            elif cfg.scan_kernel == "auto" \
+                    and jax.default_backend() != "tpu":
+                # same policy as the scan kernel gate: auto never pays
+                # interpret-mode emulation off-TPU
+                _declined("Backend")
+            else:
+                # Pallas prefix-scan window kernel (exec/kernels/window):
+                # segments + running aggregates in one VMEM-resident
+                # launch over the sorted run.  None -> metered decline,
+                # fall through to the XLA segmented scans.
+                from .kernels import try_window_kernel
+                kres = try_window_kernel(
+                    merged, part_names, orderings, specs,
+                    declined=_declined,
+                    runtime_stats=self.ctx.runtime_stats)
+                if kres is not None:
+                    yield kres
+                    return
             yield _jits()[2](merged, part_names, orderings, specs)
         return BatchSource(gen, out_names, out_types)
 
@@ -1809,7 +1838,8 @@ class PlanCompiler:
                         agg_exprs=_agg_exprs, lowering=low,
                         cache=fused_cache, declined=_kernel_declined,
                         runtime_stats=self.ctx.runtime_stats,
-                        dma=cfg.scan_kernel_dma)
+                        dma=cfg.scan_kernel_dma,
+                        expands=expands, pool=pool)
                     if kres is not None:
                         state, kcounts, n_blocks = kres
                         counts_out["counts"] = kcounts
@@ -1857,7 +1887,7 @@ class PlanCompiler:
                     declined=_kernel_declined, pool=pool,
                     state_bytes=_agg_state_bytes,
                     runtime_stats=self.ctx.runtime_stats,
-                    dma=cfg.scan_kernel_dma)
+                    dma=cfg.scan_kernel_dma, expands=expands)
                 if kres is not None:
                     out, kcounts, n_blocks = kres
                     counts_out["counts"] = kcounts

@@ -177,6 +177,9 @@ def format_analyze_footer(runtime_stats, profile_dir: str = None) -> str:
     kp = rs.get("kernelScanPrograms")
     if kp:
         lines.append(f"Pallas scan kernels: {int(kp['sum'])}")
+    kw = rs.get("kernelWindowPrograms")
+    if kw:
+        lines.append(f"Pallas window kernels: {int(kw['sum'])}")
     ov = rs.get("kernelDmaOverlapFraction")
     if ov and ov.get("count"):
         # scan.kernel-dma = double: fraction of staged block slabs whose

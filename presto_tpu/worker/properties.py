@@ -244,7 +244,9 @@ class SystemConfig:
         # 0 = auto-tune from the observed compute/collective overlap
         # (parallel/fabric.py IciChunkTuner); explicit values pin it
         ("exchange.ici-chunk-rows", int, 0),
-        # Pallas fused scan kernel selection (exec/kernels)
+        # Pallas fused scan kernel selection (exec/kernels): also
+        # gates the in-kernel join probe (kernels/join.py) and the
+        # prefix-scan window kernel (kernels/window.py)
         ("scan.kernel", str, "auto"),
         # kernel block staging: single (BlockSpec streaming) or double
         # (manually double-buffered make_async_copy prefetch)

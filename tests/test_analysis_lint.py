@@ -337,6 +337,29 @@ def test_kernels_package_is_sync_and_wall_scoped():
     assert {SYNC_EXPLICIT, SYNC_WALLCLOCK} <= _codes(findings)
 
 
+@pytest.mark.parametrize("path", [
+    "presto_tpu/exec/kernels/join.py",
+    "presto_tpu/exec/kernels/window.py",
+])
+def test_new_kernel_files_fall_under_kernel_rules(path):
+    """The PR 16 kernel files (in-kernel join probe, prefix-sum window
+    aggregation) sit under the same KERNEL001 + SYNC + wall-clock scope
+    as scan_kernel.py — an interpret literal or a host sync added there
+    must fail tier-1 exactly like in the original kernel."""
+    src = ("from jax.experimental import pallas as pl\n"
+           "def f(kernel, shapes):\n"
+           "    return pl.pallas_call(kernel, out_shape=shapes,\n"
+           "                          interpret=True)\n")
+    assert KERNEL_INTERPRET in _codes(lint_source(src, path))
+    src2 = ("import time\n"
+            "import jax.numpy as jnp\n"
+            "def f(x):\n"
+            "    t0 = time.perf_counter()\n"
+            "    return jnp.sum(x).item(), t0\n")
+    assert {SYNC_EXPLICIT, SYNC_WALLCLOCK} <= _codes(
+        lint_source(src2, path))
+
+
 def test_unbounded_queue_in_telemetry_flagged():
     """TELEM001: queue.Queue() with no / zero maxsize and SimpleQueue()
     are unbounded buffers; the telemetry package must bound every

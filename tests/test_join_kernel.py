@@ -1,0 +1,278 @@
+"""In-kernel join probe (presto_tpu/exec/kernels/join.py): engagement
+and parity vs the XLA fused chain and the numpy reference oracle,
+randomized fuzz across encodings x predicates x NULL probe keys x
+fanout, the Join* decline gates, and the MemoryContext reservation
+discipline for build-table operands.
+
+Build operands ride the scan kernel launch as whole-block VMEM
+operands; the applier math is copied operation-for-operation from
+ops.direct_lookup / fused.probe_unique, so every comparison here is
+exact equality, not approximate."""
+import numpy as np
+import pytest
+
+from presto_tpu.exec.pipeline import ExecutionConfig
+from presto_tpu.exec.runner import LocalQueryRunner, _assert_rows_equal
+
+
+def _kernel_programs(res) -> int:
+    return int((res.runtime_stats or {}).get(
+        "kernelScanPrograms", {}).get("sum", 0))
+
+
+def _declined(res) -> dict:
+    return {k[len("kernelDeclined"):]: int(v.get("sum", 0))
+            for k, v in (res.runtime_stats or {}).items()
+            if k.startswith("kernelDeclined")}
+
+
+@pytest.fixture(scope="module")
+def pallas():
+    return LocalQueryRunner(
+        "sf0.01", config=ExecutionConfig(scan_kernel="pallas"))
+
+
+@pytest.fixture(scope="module")
+def xla():
+    return LocalQueryRunner(
+        "sf0.01", config=ExecutionConfig(scan_kernel="xla"))
+
+
+Q3_SHAPE = """
+    select o_orderkey, sum(l_extendedprice * (1 - l_discount)) as revenue,
+           count(*) as cnt
+    from lineitem, orders
+    where l_orderkey = o_orderkey
+      and o_orderdate < date '1995-03-15'
+      and l_shipdate > date '1995-03-15'
+    group by o_orderkey
+"""
+
+Q18_SHAPE = """
+    select l_orderkey, max(o_totalprice) as price, sum(l_quantity) as qty
+    from lineitem, orders
+    where l_orderkey = o_orderkey
+    group by l_orderkey
+"""
+
+
+# ---------------------------------------------------------------------------
+# engagement: the probe chain actually lowers into the kernel
+# ---------------------------------------------------------------------------
+
+def test_q3_shape_join_kernel_engages(pallas, xla):
+    # the acceptance shape: decode -> filter -> probe -> compact -> agg
+    # in one launch, bit-identical to the XLA chain and the oracle
+    pres = pallas.execute(Q3_SHAPE)
+    assert _kernel_programs(pres) >= 1, _declined(pres)
+    assert not _declined(pres)
+    xres = xla.execute(Q3_SHAPE)
+    assert _kernel_programs(xres) == 0
+    _assert_rows_equal(pres, xres, ordered=False)
+    _assert_rows_equal(pres, pallas.execute_reference(Q3_SHAPE),
+                       ordered=False)
+
+
+def test_q18_shape_join_kernel_engages(pallas, xla):
+    pres = pallas.execute(Q18_SHAPE)
+    assert _kernel_programs(pres) >= 1, _declined(pres)
+    xres = xla.execute(Q18_SHAPE)
+    _assert_rows_equal(pres, xres, ordered=False)
+    _assert_rows_equal(pres, pallas.execute_reference(Q18_SHAPE),
+                       ordered=False)
+
+
+def test_semi_join_in_kernel(pallas, xla):
+    # IN-subquery lowers to a semi step; the three-valued marker
+    # (NULL build side / NULL probe key) is computed in-kernel
+    sql = ("select count(*) from lineitem "
+           "where l_orderkey in (select o_orderkey from orders "
+           "where o_orderdate < date '1995-01-01')")
+    pres = pallas.execute(sql)
+    assert _kernel_programs(pres) >= 1, _declined(pres)
+    _assert_rows_equal(pres, xla.execute(sql), ordered=False)
+    _assert_rows_equal(pres, pallas.execute_reference(sql), ordered=False)
+
+
+def test_multi_probe_chain_in_kernel(pallas, xla):
+    # probe -> probe: two build tables resident in one launch
+    sql = ("select count(*), sum(l_quantity) from lineitem, orders, "
+           "customer where l_orderkey = o_orderkey "
+           "and o_custkey = c_custkey and c_nationkey < 10")
+    pres = pallas.execute(sql)
+    xres = xla.execute(sql)
+    _assert_rows_equal(pres, xres, ordered=False)
+    _assert_rows_equal(pres, pallas.execute_reference(sql), ordered=False)
+    assert _kernel_programs(pres) >= 1, _declined(pres)
+
+
+# ---------------------------------------------------------------------------
+# randomized parity fuzz: encodings x predicates x NULL probe keys x
+# join forms, pallas vs xla vs numpy oracle
+# ---------------------------------------------------------------------------
+
+_JOIN_AGGS = ["count(*)", "sum(l_quantity)", "sum(l_extendedprice)",
+              "max(o_totalprice)", "min(l_quantity)", "avg(l_discount)"]
+
+
+def _join_fuzz_sql(seed: int) -> str:
+    rng = np.random.default_rng(seed)
+    conj = ["l_orderkey = o_orderkey",
+            f"l_quantity < {int(rng.integers(10, 45))}"]
+    if rng.integers(2):
+        y = int(rng.integers(1992, 1998))
+        conj.append(f"l_shipdate >= date '{y}-01-01'")
+    if rng.integers(2):
+        # build-side filter: the probe runs against a sparse key domain
+        y = int(rng.integers(1993, 1998))
+        conj.append(f"o_orderdate < date '{y}-06-01'")
+    if rng.integers(2):
+        # RLE probe-key column + zone pruning under the kernel grid
+        conj.append(f"l_orderkey < {int(rng.integers(1000, 30_000))}")
+    n_aggs = int(rng.integers(2, 4))
+    aggs = [_JOIN_AGGS[i] for i in rng.choice(len(_JOIN_AGGS), n_aggs,
+                                              replace=False)]
+    group = ["", "o_orderkey", "l_returnflag"][int(rng.integers(3))]
+    sql = (f"select {group + ', ' if group else ''}{', '.join(aggs)} "
+           f"from lineitem, orders where {' and '.join(conj)}")
+    if group:
+        sql += f" group by {group}"
+    return sql
+
+
+@pytest.mark.parametrize("seed", [21, 22, 23, 24, 25])
+def test_join_parity_fuzz(pallas, xla, seed):
+    sql = _join_fuzz_sql(seed)
+    pres = pallas.execute(sql)
+    xres = xla.execute(sql)
+    _assert_rows_equal(pres, xres, ordered=False)
+    assert _kernel_programs(pres) >= 1, (sql, _declined(pres))
+    assert _kernel_programs(xres) == 0
+    _assert_rows_equal(pres, pallas.execute_reference(sql), ordered=False)
+
+
+def test_null_probe_keys_parity(pallas, xla):
+    # NULL probe keys never match (reference LookupJoinOperator); the
+    # in-kernel probe must apply the probe-side null mask to the hit
+    sql = ("select count(*) from "
+           "(select case when l_orderkey % 3 = 0 then null "
+           "else l_orderkey end as k, l_quantity from lineitem) "
+           "join orders on k = o_orderkey where l_quantity < 30")
+    pres = pallas.execute(sql)
+    xres = xla.execute(sql)
+    _assert_rows_equal(pres, xres, ordered=False)
+    _assert_rows_equal(pres, pallas.execute_reference(sql), ordered=False)
+
+
+def test_semi_null_probe_keys_parity(pallas, xla):
+    # three-valued IN: NULL probe keys mark NULL, filtered to false
+    sql = ("select count(*) from "
+           "(select case when custkey % 3 = 0 then null "
+           "else custkey end as k from orders) "
+           "where k in (select custkey from customer "
+           "where nationkey < 10)")
+    pres = pallas.execute(sql)
+    xres = xla.execute(sql)
+    _assert_rows_equal(pres, xres, ordered=False)
+    _assert_rows_equal(pres, pallas.execute_reference(sql), ordered=False)
+
+
+# ---------------------------------------------------------------------------
+# Join* decline gates: ineligible shapes meter, never mis-run
+# ---------------------------------------------------------------------------
+
+def test_fanout_join_declines_join_shape(pallas, xla):
+    # customer |x| orders on custkey expands rows (fanout-k): the
+    # kernel's fixed block geometry cannot follow the expansion, so the
+    # chain declines JoinShape and the XLA fused chain runs it
+    sql = ("select c_mktsegment, count(*) from customer, orders "
+           "where c_custkey = o_custkey group by c_mktsegment")
+    pres = pallas.execute(sql)
+    assert _declined(pres).get("JoinShape", 0) >= 1
+    _assert_rows_equal(pres, xla.execute(sql), ordered=False)
+    _assert_rows_equal(pres, pallas.execute_reference(sql), ordered=False)
+
+
+def test_residual_on_filter_declines_join_shape():
+    # a residual ON predicate (beyond the equi-criteria) stays with the
+    # XLA chain's post-probe filter
+    from presto_tpu.exec.kernels.join import plan_join_layout
+
+    class _Node:
+        filter = object()           # residual ON condition present
+    reasons = []
+    plan = plan_join_layout([("join", _Node())], (None, object()), (1,),
+                            reasons.append)
+    assert plan is None and reasons == ["JoinShape"]
+
+
+def test_build_size_gate_declines(pallas, monkeypatch):
+    # shrink the operand-byte cap so the orders build overflows it: the
+    # launch declines JoinBuildSize and the XLA chain takes over
+    from presto_tpu.exec.kernels import join as jk
+    monkeypatch.setattr(jk, "KERNEL_JOIN_MAX_BUILD_BYTES", 64)
+    r = LocalQueryRunner("sf0.01", config=ExecutionConfig(
+        scan_kernel="pallas"))
+    res = r.execute(Q3_SHAPE)
+    assert _kernel_programs(res) == 0
+    assert _declined(res).get("JoinBuildSize", 0) >= 1
+    _assert_rows_equal(res, pallas.execute(Q3_SHAPE), ordered=False)
+
+
+# ---------------------------------------------------------------------------
+# MemoryContext reservation: build operands are charged revocation-
+# exempt, and arbitration still works around them
+# ---------------------------------------------------------------------------
+
+def test_build_operands_reserve_revocation_exempt():
+    # a revocable holder fills the budget; admitting the build operands
+    # must arbitrate (revoke the holder), and the admitted reservation
+    # itself must be exempt from later revocation passes
+    from presto_tpu.exec.kernels.join import reserve_build_operands
+    from presto_tpu.exec.memory import MemoryPool
+
+    pool = MemoryPool(budget=1000)
+    state = {"held": 800}
+
+    def revoke() -> int:
+        freed, state["held"] = state["held"], 0
+        h.free(freed)
+        return freed
+
+    h = pool.register_revocable("agg/state", revoke)
+    assert h.try_reserve(800)
+    # 800/1000 held revocably: the 400-byte build cannot fit without
+    # arbitration, and MUST NOT fail
+    assert reserve_build_operands(pool, 400)
+    assert pool.revocations >= 1
+    assert pool.reserved >= 400          # non-revocable = exempt
+    # a later arbitration pass finds nothing revocable to take from the
+    # build: requesting more than the remaining headroom now fails
+    # instead of spilling the in-flight kernel operands
+    assert not pool.try_reserve(700)
+    pool.free(400)
+    assert reserve_build_operands(None, 123)      # poolless runners
+    assert reserve_build_operands(pool, 0)        # empty join plan
+
+
+def test_constrained_q18_shape_arbitrates_with_join_kernel():
+    # engine-level: the same q18 shape once with the join kernel engaged
+    # (unconstrained) and once under a tight budget — the budgeted run
+    # keeps the streaming build/spill discipline (fusion declines
+    # BudgetedPool, so no kernel) yet returns identical rows, and the
+    # arbitration counters prove the pool actually worked for it
+    from presto_tpu.exec.memory import MEMORY_METRICS
+    free = LocalQueryRunner("sf0.01", config=ExecutionConfig(
+        scan_kernel="pallas"))
+    fres = free.execute(Q18_SHAPE)
+    assert _kernel_programs(fres) >= 1, _declined(fres)
+    peak = fres.peak_memory_bytes or 0
+    assert peak > 0
+    MEMORY_METRICS.reset()
+    constrained = LocalQueryRunner("sf0.01", config=ExecutionConfig(
+        scan_kernel="pallas", spill_enabled=True,
+        memory_budget_bytes=max(1, peak // 4)))
+    cres = constrained.execute(Q18_SHAPE)
+    _assert_rows_equal(cres, fres, ordered=False)
+    m = MEMORY_METRICS.snapshot()
+    assert m["arbitrations"] + m["revocations"] >= 1

@@ -271,14 +271,29 @@ def test_decline_agg_group_cardinality(monkeypatch):
     assert _declined(res).get("AggGroupCardinality", 0) >= 1
 
 
-def test_decline_plan_shape(pallas):
-    # fused join step in the chain: the kernel only handles
-    # filter/project/rename between scan and partial agg
+def test_join_chain_runs_in_kernel(pallas):
+    # PR 16: probe-side joins lower into the kernel body
+    # (kernels/join.py) instead of declining as PlanShape — the shape
+    # that used to be this file's PlanShape fixture now engages
     res = pallas.assert_same_as_reference(
         "select count(*) from lineitem, orders "
         "where l_orderkey = o_orderkey")
-    assert _kernel_programs(res) == 0
-    assert _declined(res).get("PlanShape", 0) >= 1
+    assert _kernel_programs(res) >= 1, _declined(res)
+    assert not _declined(res)
+
+
+def test_decline_plan_shape():
+    # uid steps (count(distinct)-style rewrites) stay outside the
+    # kernel's step vocabulary even with joins allowed
+    from presto_tpu.exec.kernels.scan_kernel import chain_eligible
+
+    class _Chain:
+        steps = [("uid", None)]
+        scan_meta: dict = {}
+    reasons = []
+    assert not chain_eligible(_Chain(), (None,), reasons.append,
+                              allow_joins=True)
+    assert reasons == ["PlanShape"]
 
 
 def test_decline_columns_not_resident():
@@ -317,7 +332,9 @@ def test_decline_reasons_are_closed():
     # closed
     assert set(KERNEL_DECLINE_REASONS) == {
         "Disabled", "AggFunctionShape", "AggGroupCardinality",
-        "Backend", "PlanShape", "ColumnsNotResident"}
+        "Backend", "PlanShape", "ColumnsNotResident",
+        "JoinShape", "JoinBuildSize",
+        "WindowFunctionShape", "WindowKeyShape", "WindowInputSize"}
 
 
 # ---------------------------------------------------------------------------
