@@ -11,7 +11,7 @@ vs_baseline = speedup vs the single-threaded numpy reference interpreter
               each round so the ratio tracks engine improvements only.
 
 Env knobs: BENCH_SF (default 10), BENCH_RUNS (default 3),
-BENCH_QUERY (q1|q6|q6z|q3g|q3k|xchg|serve|spill).
+BENCH_QUERY (q1|q6|q6z|q3g|q3k|xchg|serve|spill|ft).
 
 q1/q6/q6z/q1g/q3k lines also carry a "scan_kernel" object: best-of-N
 walls and effective_scan_gbps for the same query pinned to
@@ -65,6 +65,17 @@ rows; the JSON line reports spilled bytes (host + disk tiers), spill
 throughput GB/s, the async-eviction overlap fraction, revocation/
 arbitration counts, and wall_ratio = constrained / unconstrained wall
 — the slowdown paid to run a query ~5x bigger than its memory.
+
+BENCH_QUERY=ft is the fault-tolerance cost benchmark: the q18-shaped
+join+agg through a loopback HTTP cluster (BENCH_FT_WORKERS workers,
+default 2; BENCH_FT_TASKS tasks per stage, default 4) run side by side
+under retry-policy=query (streamed exchange) and retry-policy=task
+(every stage output durably spooled through the two-tier LZ4 spool
+before the producer acks).  Both runs must return identical rows; the
+JSON line reports wall_ratio = task / query wall — the steady-state
+price of durability — plus spooled pages/bytes, the spool compression
+ratio, bytes flushed to the disk tier, and spool_throughput_gbps (raw
+bytes through the staging path per second spent staging).
 """
 import json
 import os
@@ -388,6 +399,86 @@ def bench_spill(runs):
     print(json.dumps(out))
 
 
+def bench_ft(runs):
+    """Fault-tolerance cost benchmark: the q18-shaped join+agg through a
+    real loopback HTTP cluster under retry-policy=query (direct streamed
+    exchange, a failure restarts the ancestor cascade) vs
+    retry-policy=task (every stage output durably spooled, a failure
+    restarts one task).  No fault is injected — this measures the
+    steady-state price of durability: wall_ratio = task / query wall,
+    plus spooled bytes and the spool staging throughput."""
+    sf = float(os.environ.get("BENCH_SF", "0.1"))
+    n_workers = int(os.environ.get("BENCH_FT_WORKERS", "2"))
+    n_tasks = int(os.environ.get("BENCH_FT_TASKS", "4"))
+
+    from presto_tpu.connectors import tpch
+    from presto_tpu.exec.runner import _assert_rows_equal
+    from presto_tpu.worker.coordinator import HttpQueryRunner
+    from presto_tpu.worker.spooling import SPOOL_METRICS
+    from presto_tpu.worker.server import WorkerServer
+
+    schema = f"sf{sf:g}"
+    n_rows = tpch._table_rows("lineitem", sf)
+    workers = [WorkerServer() for _ in range(n_workers)]
+    try:
+        uris = [w.uri for w in workers]
+
+        base = HttpQueryRunner(uris, schema, n_tasks=n_tasks,
+                               session={"retry_policy": "query"})
+        base.execute(SPILL)               # warmup: compiles + faults data
+        base_best, base_result = float("inf"), None
+        for _ in range(runs):
+            t0 = time.perf_counter()
+            base_result = base.execute(SPILL)
+            base_best = min(base_best, time.perf_counter() - t0)
+        assert base_result.rows, "benchmark query returned no rows"
+
+        ft = HttpQueryRunner(uris, schema, n_tasks=n_tasks,
+                             session={"retry_policy": "task"})
+        ft.execute(SPILL)                 # warmup under the spool path
+        SPOOL_METRICS.reset()
+        ft_best, ft_result = float("inf"), None
+        for _ in range(runs):
+            t0 = time.perf_counter()
+            ft_result = ft.execute(SPILL)
+            ft_best = min(ft_best, time.perf_counter() - t0)
+        _assert_rows_equal(ft_result, base_result, ordered=True)
+        s = SPOOL_METRICS.snapshot()
+
+        out = {
+            "metric": f"ft_sf{sf:g}_rows_per_sec",
+            "value": round(n_rows / ft_best, 1),
+            "unit": "rows/s",
+            "wall_s": round(ft_best, 4),
+            "query_policy_wall_s": round(base_best, 4),
+            # the headline: the steady-state price of durable spooling
+            "wall_ratio": round(ft_best / base_best, 3),
+            "spool": {
+                "workers": n_workers,
+                "tasks_per_stage": n_tasks,
+                "timed_runs": runs,
+                "spooled_pages": s["spooled_pages"],
+                "spooled_bytes": s["spooled_bytes"],
+                "spooled_raw_bytes": s["spooled_raw_bytes"],
+                "compression_ratio": round(
+                    s["spooled_raw_bytes"] / s["spooled_bytes"], 3)
+                if s["spooled_bytes"] else 0.0,
+                "disk_bytes": s["disk_bytes"],
+                "flushes": s["flushes"],
+                "read_pages": s["read_pages"],
+                "read_bytes": s["read_bytes"],
+                "spool_throughput_gbps": round(
+                    s["spooled_raw_bytes"] / s["spool_wall_s"] / 1e9, 3)
+                if s["spool_wall_s"] else 0.0,
+            },
+        }
+        out["process_metrics"] = _process_metrics()
+        print(json.dumps(out))
+    finally:
+        for w in workers:
+            w.close()
+
+
 SERVE_SHAPES = [
     # (name, template, [value tuples cycled by the clients])
     ("q6p",
@@ -550,6 +641,8 @@ def main():
         return bench_serve(runs)
     if qname == "spill":
         return bench_spill(runs)
+    if qname == "ft":
+        return bench_ft(runs)
     sf = float(os.environ.get("BENCH_SF", "10"))
     sql = {"q1": Q1, "q6": Q6, "q6z": Q6, "q3g": Q3G, "q1g": Q1G,
            "q3k": Q3K}[qname]

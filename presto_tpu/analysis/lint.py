@@ -45,6 +45,14 @@ and flags the hazard shapes:
            the drop metered) rather than grow until the process OOMs.
            There is NO pragma escape — pass an explicit positive
            maxsize.
+  NET001   a blocking `urllib` request in the worker or telemetry layer
+           (`worker/`, `telemetry/`) without an explicit `timeout=`
+           keyword.  The fault-tolerant control plane (task updates,
+           exchange pulls, heartbeats, graceful drain) depends on every
+           HTTP call having a bounded wait: one default-timeout socket
+           to a dead peer wedges its calling thread forever and turns a
+           single worker loss into a hung query.  Sites that bound the
+           wait elsewhere carry `# lint: allow-no-timeout`.
   MEM001   an unbounded host-side STAGING collection in `exec/` or
            `worker/`: a class initializes a staging-named attribute
            (`*bucket*`, `*page*`, `*staged*`, `*collected*`,
@@ -85,6 +93,7 @@ from typing import Dict, Iterable, List, Optional, Set
 PRAGMA = "lint: allow-host-sync"
 WALL_PRAGMA = "lint: allow-wall-clock"
 MEM_PRAGMA = "lint: allow-uncharged-staging"
+NET_PRAGMA = "lint: allow-no-timeout"
 
 SYNC_EXPLICIT = "SYNC001"
 SYNC_CAST = "SYNC002"
@@ -95,10 +104,12 @@ SYNC_WALLCLOCK = "SYNC006"
 KERNEL_INTERPRET = "KERNEL001"
 TELEM_UNBOUNDED_QUEUE = "TELEM001"
 MEM_UNCHARGED_STAGING = "MEM001"
+NET_NO_TIMEOUT = "NET001"
 
 ALL_LINT_CODES = (SYNC_EXPLICIT, SYNC_CAST, SYNC_ASARRAY, SYNC_BRANCH,
                   SYNC_NETWORK, SYNC_WALLCLOCK, KERNEL_INTERPRET,
-                  TELEM_UNBOUNDED_QUEUE, MEM_UNCHARGED_STAGING)
+                  TELEM_UNBOUNDED_QUEUE, MEM_UNCHARGED_STAGING,
+                  NET_NO_TIMEOUT)
 
 # KERNEL001 scope: everywhere.  The shim is the ONE file that may select
 # Pallas interpret mode (it gates on the backend); no pragma overrides.
@@ -120,6 +131,12 @@ _NETWORK_ALLOWLIST = ("presto_tpu/worker/exchange.py",
                       "presto_tpu/telemetry/export.py")
 _NETWORK_CALLS = {"urllib.request.urlopen", "urllib.request.urlretrieve",
                   "request.urlopen", "urlopen", "urlopen_internal"}
+
+# NET001 scope: the layers that talk HTTP on purpose.  Every blocking
+# urllib request there must pass an explicit `timeout=` keyword — a
+# default-timeout socket to a dead peer wedges its thread forever, which
+# is exactly the failure mode the fault-tolerant mode exists to survive.
+_NET_TIMEOUT_PATH_MARKERS = ("presto_tpu/worker/", "presto_tpu/telemetry/")
 
 # SYNC006 scope: the execution layer proper.  Wall-clock reads there must
 # feed a stats surface (RuntimeStats / operator stats / driver walls);
@@ -202,7 +219,7 @@ def _allowed_lines(source: str) -> Dict[str, Set[int]]:
     acknowledgement must not silence a wall-clock finding on the same
     statement (and vice versa), so each code checks only its own set."""
     allowed: Dict[str, Set[int]] = {PRAGMA: set(), WALL_PRAGMA: set(),
-                                    MEM_PRAGMA: set()}
+                                    MEM_PRAGMA: set(), NET_PRAGMA: set()}
     try:
         for tok in tokenize.generate_tokens(io.StringIO(source).readline):
             if tok.type != tokenize.COMMENT:
@@ -225,6 +242,7 @@ class _Linter(ast.NodeVisitor):
         self.allowed = allowed.get(PRAGMA, set())
         self.wall_allowed = allowed.get(WALL_PRAGMA, set())
         self.mem_allowed = allowed.get(MEM_PRAGMA, set())
+        self.net_allowed = allowed.get(NET_PRAGMA, set())
         self.findings: List[LintFinding] = []
         self._device: List[Set[str]] = [set()]
         import os
@@ -233,6 +251,8 @@ class _Linter(ast.NodeVisitor):
             any(m in norm for m in _NETWORK_PATH_MARKERS)
             and not any(norm.endswith(a) for a in _NETWORK_ALLOWLIST))
         self._wall_scoped = _WALL_PATH_MARKER in norm
+        self._net_timeout_scoped = any(
+            m in norm for m in _NET_TIMEOUT_PATH_MARKERS)
         self._telem_scoped = _TELEM_PATH_MARKER in norm
         self._mem_scoped = any(m in norm for m in _MEM_PATH_MARKERS)
         self._interpret_exempt = any(
@@ -450,6 +470,19 @@ class _Linter(ast.NodeVisitor):
                        f"compute module; route it through the worker "
                        f"exchange client (worker/exchange.py) or "
                        f"acknowledge with `# {PRAGMA}`")
+        if self._net_timeout_scoped and name in _NETWORK_CALLS:
+            # an explicit timeout= keyword (or a **kwargs splat the
+            # caller is trusted to bound) is the compliance signal;
+            # positional timeouts don't read as deliberate at review
+            bounded = any(kw.arg == "timeout" or kw.arg is None
+                          for kw in node.keywords)
+            if not bounded:
+                self._flag(node, NET_NO_TIMEOUT,
+                           f"{name}() without an explicit timeout= can "
+                           f"block its thread forever on a dead peer; "
+                           f"pass timeout= or mark the site with "
+                           f"`# {NET_PRAGMA}`",
+                           allowed=self.net_allowed)
         if self._wall_scoped and name in _WALL_CALLS:
             self._flag(node, SYNC_WALLCLOCK,
                        f"{name}() is an un-metered wall-clock read in the "

@@ -109,6 +109,46 @@ class ExchangeLostError(PrestoQueryError):
         self.last_token = last_token
 
 
+class QueryDeadlineExceededError(PrestoUserError):
+    """`query.max-execution-time` elapsed (reference EXCEEDED_TIME_LIMIT,
+    QueryTracker.enforceTimeLimits): the query ran past its configured
+    wall budget.  A deadline is the user's constraint, so this fails fast
+    — the [USER_ERROR] tag and `error_type` keep it non-retryable across
+    the string-typed distributed failure chain, exactly like the memory
+    limit's EXCEEDED_MEMORY_LIMIT."""
+
+    error_code = "EXCEEDED_TIME_LIMIT"
+
+    def __init__(self, elapsed_s: float, limit_s: float, context: str = ""):
+        super().__init__(
+            f"[USER_ERROR] EXCEEDED_TIME_LIMIT: query exceeded "
+            f"query.max-execution-time {limit_s:g}s "
+            f"(ran {elapsed_s:.3f}s)"
+            + (f" (context {context})" if context else ""))
+        self.elapsed_s = elapsed_s
+        self.limit_s = limit_s
+
+
+class PoisonSplitError(PrestoUserError):
+    """A split whose task failed with the SAME internal error signature on
+    two distinct workers is deterministic, not infrastructure: burning the
+    rest of the retry budget would reproduce it (the presto-spark
+    ErrorClassifier's 'consistent failure' fast-fail).  Quarantine the
+    split and fail the query with its identity in the tag."""
+
+    error_code = "POISON_SPLIT"
+
+    def __init__(self, lineage: str, workers, signature: str = ""):
+        ws = ", ".join(sorted(workers))
+        super().__init__(
+            f"[USER_ERROR] POISON_SPLIT: task {lineage} quarantined after "
+            f"failing with the same internal error on {len(set(workers))} "
+            f"distinct workers ({ws})"
+            + (f": {signature}" if signature else ""))
+        self.lineage = lineage
+        self.workers = set(workers)
+
+
 class RemoteTaskError(PrestoQueryError):
     """A producer task reported failure through its buffer (HTTP 500 on a
     results pull).  The error type is parsed from the producer's tagged

@@ -230,6 +230,36 @@ class ExecutionConfig:
     # as an X-Presto-Max-Size cap, so tiny-page stages stop paying a
     # request round trip per page
     exchange_max_response_bytes: int = 1 << 20
+    # retry policy (reference retry-policy=QUERY|TASK, fault-tolerant
+    # execution over a spooled exchange): "query" keeps the streaming
+    # restart-with-ancestors behavior over retained in-memory buffers;
+    # "task" spools every stage's output pages durably through
+    # worker/spooling.py (host-RAM staging -> LZ4 block files under
+    # spool.path/spill.path, charged revocable, retained past task
+    # completion) so a failed task is retried ALONE on a surviving
+    # worker with no ancestor-stage restart.  Config key retry-policy /
+    # session retry_policy
+    retry_policy: str = "query"
+    # durable spool directory under retry-policy=task (config key
+    # spool.path); None falls back to spill_path, then the system temp
+    # dir.  Spool block files survive a graceful worker exit
+    spool_path: Optional[str] = None
+    # host-RAM ceiling for spool staging per task; past it (or under
+    # memory-pool revocation) staged pages overflow to the LZ4 block
+    # file.  Config key spool.staging-budget-bytes
+    spool_staging_budget_bytes: int = 16 << 20
+    # query wall-clock budget (reference query.max-execution-time /
+    # QueryTracker.enforceTimeLimits): the coordinator mints the typed
+    # non-retryable EXCEEDED_TIME_LIMIT user error when it elapses and
+    # forwards each task's remaining budget via the
+    # X-Presto-Task-Deadline header, which the TaskManager reaper and
+    # the pipeline drain loops enforce.  0 = no deadline
+    query_max_execution_time_s: float = 0.0
+    # coordinator worker-loss trigger on heartbeat AGE (config key
+    # failure-detector.heartbeat-timeout): a worker whose last
+    # successful probe is older than this is dropped from scheduling
+    # even if its transport streak has not tripped.  0 = streak-only
+    failure_detector_heartbeat_timeout_s: float = 0.0
     # chaos hook: probability a task fails at start.  The roll is
     # deterministic per task id, so a retry (new attempt id) rolls
     # independently and chaos tests replay exactly
@@ -312,6 +342,10 @@ SCAN_KERNEL_MODES = ("xla", "pallas", "auto")
 
 # legal scan.kernel-dma / scan_kernel_dma values
 SCAN_KERNEL_DMA_MODES = ("single", "double")
+
+# legal retry-policy / retry_policy values (worker/properties.py and the
+# session-property validation both check against this)
+RETRY_POLICY_MODES = ("query", "task")
 
 
 def tuned_config(**overrides) -> "ExecutionConfig":

@@ -214,6 +214,12 @@ def format_analyze_footer(runtime_stats, profile_dir: str = None) -> str:
         if dk and dk.get("sum"):
             line += f", {dk['sum'] / (1 << 20):,.1f} MB to disk"
         lines.append(line)
+    sb = rs.get("spoolBytes")
+    if sb and sb.get("sum"):
+        # retry-policy=task: raw page bytes durably staged through the
+        # spooled exchange before the producers acknowledged them
+        lines.append(f"Spooled: {sb['sum'] / (1 << 20):,.1f} MB "
+                     f"across {sb['count']} task(s)")
     if profile_dir:
         # where `jax.profiler.trace` wrote this run's device capture
         # (open with tensorboard / xprof)

@@ -42,11 +42,26 @@ class PageBuffer:
     (`revoke_to_disk`); a replaying consumer transparently reads spilled
     pages back.  The charge uses arbitrate=False + self-spill because it
     runs under this buffer's own condition lock (see
-    RevocableHolder.try_reserve)."""
+    RevocableHolder.try_reserve).
+
+    With a `spool` (retry-policy=task: worker/spooling.TaskSpool) the
+    buffer stores NOTHING itself: every page is durably staged in the
+    spool before add() returns (the producer's acknowledgement point),
+    gets replay token-indexed from the spool, and the consumer's
+    end-of-stream DELETE only marks the stream consumed — the spool
+    outlives both the task and this buffer, released by destroy_all().
+    Durability decouples producer and consumer lifetimes, so spool mode
+    has no consumer backpressure: resident bytes are bounded by the
+    spool's revocable staging budget and its disk tier instead."""
 
     def __init__(self, max_buffered_bytes: int = DEFAULT_MAX_BUFFERED_BYTES,
                  retain: bool = False, coalesce_target_bytes: int = 0,
-                 memory=None, spill_dir: Optional[str] = None):
+                 memory=None, spill_dir: Optional[str] = None,
+                 spool=None, buffer_id: int = 0):
+        self._spool = spool
+        self._buffer_id = buffer_id
+        self._spool_count = 0             # pages appended to the spool
+        self._client_released = False     # consumer DELETE seen (drain gate)
         self._pages: List[bytes] = []
         self._base = 0                    # sequence number of _pages[0]
         self._bytes = 0                   # UNacknowledged bytes (backpressure)
@@ -75,21 +90,33 @@ class PageBuffer:
         self._error: Optional[str] = None
         self._cond = threading.Condition()
 
+    def _store_locked(self, data: bytes) -> None:
+        if self._spool is not None:
+            self._spool.append(self._buffer_id, data)  # durable before return
+            self._spool_count += 1
+        else:
+            self._pages.append(data)
+
+    def _end_locked(self) -> int:
+        return (self._spool_count if self._spool is not None
+                else self._base + len(self._pages))
+
     def _flush_pending_locked(self) -> None:
         if self._pending:
-            self._pages.append(b"".join(self._pending))
+            self._store_locked(b"".join(self._pending))
             self._pending = []
             self._pending_bytes = 0
             self._cond.notify_all()
 
     def add(self, page_bytes: bytes) -> None:
         with self._cond:
-            while (self._bytes >= self._max_bytes
+            while (self._spool is None and self._bytes >= self._max_bytes
                    and not self._destroyed and self._error is None):
                 self._cond.wait(1.0)
             if self._destroyed:
                 return
-            self._bytes += len(page_bytes)  # pending counts for backpressure
+            if self._spool is None:
+                self._bytes += len(page_bytes)  # pending counts toward limit
             if self._coalesce_target > 0:
                 self._pending.append(page_bytes)
                 self._pending_bytes += len(page_bytes)
@@ -100,7 +127,7 @@ class PageBuffer:
                     # demand-flushes rather than sleeping out its maxWait
                     self._cond.notify_all()
             else:
-                self._pages.append(page_bytes)
+                self._store_locked(page_bytes)
                 self._cond.notify_all()
 
     def set_complete(self) -> None:
@@ -128,13 +155,28 @@ class PageBuffer:
             while True:
                 if self._error is not None:
                     raise BufferError(self._error)
-                end = self._base + len(self._pages)
+                end = self._end_locked()
                 if token >= end and self._pending:
                     # the consumer caught up to the coalescer: flush the
                     # partial batch rather than make it wait for more data
                     self._flush_pending_locked()
-                    end = self._base + len(self._pages)
+                    end = self._end_locked()
                 if token < end or self._complete:
+                    if self._spool is not None:
+                        # token-indexed replay straight from the durable
+                        # spool (RAM-staged or disk, tier-transparent)
+                        pages, size, t = [], 0, max(0, token)
+                        while t < end:
+                            p = self._spool.read(self._buffer_id, t)
+                            if (pages and max_bytes is not None
+                                    and size + len(p) > max_bytes):
+                                break
+                            pages.append(p)
+                            size += len(p)
+                            t += 1
+                        next_token = t if pages else token
+                        at_end = self._complete and next_token >= end
+                        return pages, next_token, at_end
                     if self._retain and 0 <= token < self._base:
                         # replaying consumer asked for pages already
                         # revoked to disk: read them back transparently
@@ -165,6 +207,12 @@ class PageBuffer:
 
     def acknowledge(self, token: int) -> None:
         with self._cond:
+            if self._spool is not None:
+                # spooled pages are never freed by acks (a retried consumer
+                # replays from 0); just track consumption for the drain gate
+                self._acked = max(self._acked, min(token, self._spool_count))
+                self._cond.notify_all()
+                return
             if self._retain:
                 # advance the watermark and release backpressure, but keep
                 # the pages for replay by a retried consumer — now CHARGED
@@ -265,12 +313,31 @@ class PageBuffer:
     def spilled_tokens(self) -> int:
         return self._base if self._retain else 0
 
-    def destroy(self, force: bool = True) -> None:
-        # a retained buffer survives the consumer's end-of-stream DELETE
-        # (a retried consumer may still need to replay it); only task
-        # teardown (cancel/evict -> destroy_all) reclaims it
+    @property
+    def consumed(self) -> bool:
+        """True once the consumer is definitively done with this stream:
+        acked (or DELETEd) through end-of-stream, errored, or destroyed.
+        The graceful-drain gate — a SHUTTING_DOWN worker may only exit
+        after every buffer it produced has been consumed."""
         with self._cond:
-            if self._retain and not force:
+            if (self._destroyed or self._error is not None
+                    or self._client_released):
+                return True
+            if not self._complete:
+                return False
+            if self._retain or self._spool is not None:
+                return self._acked >= self._end_locked()
+            return not self._pages and not self._pending
+
+    def destroy(self, force: bool = True) -> None:
+        # a retained/spooled buffer survives the consumer's end-of-stream
+        # DELETE (a retried consumer may still need to replay it); only
+        # task teardown (cancel/evict -> destroy_all) reclaims it.  The
+        # DELETE still marks the stream consumed for the drain gate.
+        with self._cond:
+            if not force and (self._retain or self._spool is not None):
+                self._client_released = True
+                self._cond.notify_all()
                 return
             self._pages = []
             self._pending = []
@@ -298,16 +365,32 @@ class OutputBufferManager:
 
     def __init__(self, buffer_type: str, n_buffers: int,
                  retain: bool = False, coalesce_target_bytes: int = 0,
-                 memory=None, spill_dir: Optional[str] = None):
+                 memory=None, spill_dir: Optional[str] = None, spool=None):
         self.buffer_type = buffer_type
+        self.spool = spool                # shared TaskSpool (or None)
         self.buffers = [PageBuffer(retain=retain,
                                    coalesce_target_bytes=coalesce_target_bytes,
-                                   memory=memory, spill_dir=spill_dir)
-                        for _ in range(max(1, n_buffers))]
+                                   memory=memory, spill_dir=spill_dir,
+                                   spool=spool, buffer_id=i)
+                        for i in range(max(1, n_buffers))]
 
     @property
     def retained_bytes(self) -> int:
         return sum(b.retained_bytes for b in self.buffers)
+
+    @property
+    def spooled_bytes(self) -> int:
+        """Cumulative raw bytes durably spooled (TaskInfo spooledBytes)."""
+        return 0 if self.spool is None else self.spool.spooled_bytes
+
+    def flush_spool(self) -> int:
+        """Graceful drain: force the spool's staged pages onto disk so the
+        output survives this process exiting."""
+        return 0 if self.spool is None else self.spool.flush()
+
+    def all_consumed(self) -> bool:
+        """Every buffer acked/DELETEd through end-of-stream (drain gate)."""
+        return all(b.consumed for b in self.buffers)
 
     def add(self, partition: int, page_bytes: bytes) -> None:
         if self.buffer_type == "BROADCAST":
@@ -339,3 +422,5 @@ class OutputBufferManager:
     def destroy_all(self) -> None:
         for b in self.buffers:
             b.destroy(force=True)
+        if self.spool is not None:
+            self.spool.close()

@@ -33,10 +33,11 @@ import urllib.error
 import urllib.request
 from typing import Dict, List, Optional, Set, Tuple
 
-from ..common.errors import (PrestoQueryError, PrestoUserError,
-                             ExchangeLostError, RemoteTaskError,
-                             WorkerLostError, is_retryable_type,
-                             parse_error_type)
+from ..common.errors import (INTERNAL_ERROR, PrestoQueryError,
+                             PrestoUserError, ExchangeLostError,
+                             PoisonSplitError, QueryDeadlineExceededError,
+                             RemoteTaskError, WorkerLostError,
+                             is_retryable_type, parse_error_type)
 from ..connectors import catalog, tpch
 from ..exec.pipeline import ExecutionConfig
 from ..exec.runner import LocalQueryRunner, QueryResult, pages_to_result
@@ -51,6 +52,29 @@ _query_counter = itertools.count()
 
 _RETRY_SUFFIX = re.compile(r"\.r\d+$")
 _RESULT_LOCATIONS = re.compile(r"/v1/task/([^/\s]+)/results/")
+_SOURCE_LOCATIONS = re.compile(r"(https?://[^/\s\"\\]+)/v1/task/([^/\s\"\\]+)/results/")
+_SIG_JUNK_LINE = re.compile(r"[\"'}\\\s]+")
+
+
+def _failure_signature(message: str) -> str:
+    """Canonical signature for an INTERNAL failure.  The same root cause
+    can be observed directly (the failed task's own traceback in a status
+    event) or through any number of consumer exchange wrappers, each of
+    which JSON-escapes the quoted producer error one level deeper.
+    Collapse the escape layers, then take the deepest meaningful line —
+    the root exception — with digits masked so ports, attempt counters
+    and line numbers don't fragment the signature."""
+    text = message or ""
+    for _ in range(8):  # escape depth doubles per wrapper; 8 is plenty
+        collapsed = text.replace("\\\\", "\\")
+        if collapsed == text:
+            break
+        text = collapsed
+    text = text.replace("\\r", "").replace("\\n", "\n").replace('\\"', '"')
+    lines = [ln.strip() for ln in text.splitlines()]
+    lines = [ln for ln in lines if ln and not _SIG_JUNK_LINE.fullmatch(ln)]
+    last = lines[-1] if lines else ""
+    return re.sub(r"\d+", "#", last)[:200]
 
 
 class HeartbeatFailureDetector:
@@ -59,13 +83,25 @@ class HeartbeatFailureDetector:
     DiscoveryNodeManager.refreshNodesInternal): each worker's
     /v1/info/state is polled on an interval; a node failing `threshold`
     consecutive probes — or reporting SHUTTING_DOWN — is dropped from
-    scheduling until it responds ACTIVE again."""
+    scheduling until it responds ACTIVE again.
+
+    `heartbeat_timeout_s` adds an absolute-age trigger on top of the
+    consecutive-miss streak (failure-detector.heartbeat-timeout): a
+    worker whose last successful heartbeat is older than the timeout is
+    failed even if individual probes are still timing out slowly enough
+    to not build a streak."""
 
     def __init__(self, worker_uris: List[str], interval_s: float = 0.5,
-                 threshold: int = 3):
+                 threshold: int = 3,
+                 heartbeat_timeout_s: Optional[float] = None):
         self.worker_uris = list(worker_uris)
         self.threshold = threshold
+        self.heartbeat_timeout_s = heartbeat_timeout_s or None
         self._streak = {u: 0 for u in self.worker_uris}
+        # last SUCCESSFUL probe per worker (monotonic); seeded now so a
+        # worker that never answers still ages out of scheduling
+        now = time.monotonic()
+        self._last_seen = {u: now for u in self.worker_uris}
         self._draining = set()
         self._lock = threading.Lock()
         self._stop = threading.Event()
@@ -96,29 +132,46 @@ class HeartbeatFailureDetector:
                     self._streak[uri] += 1
                 else:
                     self._streak[uri] = 0
+                    self._last_seen[uri] = time.monotonic()
                     if state == "SHUTTING_DOWN":
                         self._draining.add(uri)
                     else:
                         self._draining.discard(uri)
             self._stop.wait(interval_s)
 
+    def heartbeat_age_s(self, uri: str) -> float:
+        """Seconds since the worker last answered a probe."""
+        with self._lock:
+            return time.monotonic() - self._last_seen.get(
+                uri, time.monotonic())
+
+    def _failed_locked(self, uri: str) -> bool:
+        if self._streak[uri] >= self.threshold:
+            return True
+        return (self.heartbeat_timeout_s is not None
+                and time.monotonic() - self._last_seen[uri]
+                > self.heartbeat_timeout_s)
+
     def alive(self) -> List[str]:
         with self._lock:
             return [u for u in self.worker_uris
-                    if self._streak[u] < self.threshold
+                    if not self._failed_locked(u)
                     and u not in self._draining]
 
     def failed(self) -> List[str]:
         with self._lock:
             return [u for u in self.worker_uris
-                    if self._streak[u] >= self.threshold]
+                    if self._failed_locked(u)]
 
     def snapshot(self) -> Dict[str, dict]:
         """Per-worker probe state for /v1/status and /v1/metrics."""
         with self._lock:
+            now = time.monotonic()
             return {u: {"streak": self._streak[u],
                         "draining": u in self._draining,
-                        "alive": (self._streak[u] < self.threshold
+                        "heartbeatAgeSeconds": round(
+                            now - self._last_seen[u], 3),
+                        "alive": (not self._failed_locked(u)
                                   and u not in self._draining)}
                     for u in self.worker_uris}
 
@@ -146,12 +199,17 @@ class RemoteTask:
             headers["X-Presto-Trace-Token"] = self.trace_token
         return headers
 
-    def update(self, request: TaskUpdateRequest) -> TaskStatus:
+    def update(self, request: TaskUpdateRequest,
+               deadline_ms: Optional[float] = None) -> TaskStatus:
         body = json.dumps(request.to_dict()).encode()
+        headers = {"Content-Type": "application/json", **self._headers()}
+        if deadline_ms is not None:
+            # the query's REMAINING wall budget at dispatch (relative ms,
+            # so no coordinator<->worker clock agreement is needed): the
+            # worker arms a local monotonic deadline from it
+            headers["X-Presto-Task-Deadline"] = str(int(deadline_ms))
         req = urllib.request.Request(
-            self.task_uri, data=body, method="POST",
-            headers={"Content-Type": "application/json",
-                     **self._headers()})
+            self.task_uri, data=body, method="POST", headers=headers)
         from .auth import urlopen_internal
         with urlopen_internal(req, timeout=30) as resp:
             return TaskStatus.from_dict(json.loads(resp.read()))
@@ -334,6 +392,27 @@ class _QueryExecution:
             # consumer can replay its inputs from token 0
             self.session.setdefault("remote_task_retry_attempts",
                                     str(self.max_attempts))
+        # retry-policy=task (fault-tolerant execution): workers spool every
+        # stage's output durably and a failed task restarts ALONE — the
+        # policy rides to workers in the session so their tasks build
+        # TaskSpools and their exchange consumers park on producer loss
+        self.retry_policy = str(runner.session.get(
+            "retry_policy",
+            getattr(cfg, "retry_policy", "query"))).strip().lower()
+        self.session.setdefault("retry_policy", self.retry_policy)
+        # query.max-execution-time -> a coordinator-local monotonic
+        # deadline; 0 disables.  Minted HERE as the typed non-retryable
+        # EXCEEDED_TIME_LIMIT user error; the remaining budget is also
+        # forwarded per task via X-Presto-Task-Deadline
+        self.deadline_limit_s = parse_duration(self.session.get(
+            "query_max_execution_time",
+            getattr(cfg, "query_max_execution_time_s", 0.0)))
+        self.started_at = time.monotonic()
+        self.deadline = (self.started_at + self.deadline_limit_s
+                         if self.deadline_limit_s > 0 else None)
+        # poison-split quarantine: (lineage, normalized INTERNAL error
+        # signature) -> distinct workers it failed on
+        self.failure_workers: Dict[Tuple[str, str], Set[str]] = {}
         self.codec = str(self.session.get(
             "exchange_compression_codec",
             cfg.exchange_compression_codec)).upper()
@@ -454,7 +533,7 @@ class _QueryExecution:
         for cand in candidates:
             task = RemoteTask(cand, task_id, trace_token=self.trace_token)
             try:
-                task.update(req)
+                task.update(req, deadline_ms=self._deadline_ms())
             except urllib.error.HTTPError as e:
                 if e.code != 503:
                     raise
@@ -508,10 +587,26 @@ class _QueryExecution:
                 client.close()
                 self._watcher.close()
 
+    def _deadline_ms(self) -> Optional[float]:
+        """Remaining wall budget in ms for X-Presto-Task-Deadline."""
+        if self.deadline is None:
+            return None
+        return max(0.0, (self.deadline - time.monotonic()) * 1000.0)
+
+    def _check_deadline(self) -> None:
+        if self.deadline is not None and time.monotonic() > self.deadline:
+            raise QueryDeadlineExceededError(
+                time.monotonic() - self.started_at, self.deadline_limit_s,
+                context=f"query {self.qid}")
+
     def _raise_pending_failures(self) -> None:
         """should_abort hook for the root pull: unwind as soon as the
         watcher has seen ANY task fail, instead of discovering it after
-        all pages are drained."""
+        all pages are drained.  Also where the query deadline is minted —
+        the hook runs every root pull round, so EXCEEDED_TIME_LIMIT
+        surfaces within one round of the budget elapsing (and, being a
+        typed USER_ERROR, is never retried)."""
+        self._check_deadline()
         events = self._watcher.events() if self._watcher else []
         if events:
             raise _FailureSignal(events)
@@ -543,6 +638,10 @@ class _QueryExecution:
                 raise PrestoUserError(
                     f"query failed [{exc.error_type}]: {exc}") from exc
             self._add_culprit(failed, str(exc), exc.location)
+            if exc.error_type == INTERNAL_ERROR:
+                worker = exc.location.split("/v1/task/", 1)[0]
+                for lin in failed:
+                    self._note_internal_failure(lin, worker, str(exc))
         elif isinstance(exc, ExchangeLostError):
             worker = exc.location.split("/v1/task/", 1)[0]
             self.suspects.add(worker)
@@ -560,6 +659,11 @@ class _QueryExecution:
                             f"{ev['message']}") from exc
                     self._add_culprit(failed, ev.get("message", ""),
                                       ev["task_id"])
+                    if et == INTERNAL_ERROR:
+                        self._note_internal_failure(
+                            self._lineage_of_task(ev["task_id"]),
+                            ev.get("worker_uri", ""),
+                            ev.get("message", ""))
                 else:  # task_lost / worker_lost
                     self.suspects.add(ev["worker_uri"])
                     lin = self._lineage_of_task(ev["task_id"])
@@ -578,11 +682,39 @@ class _QueryExecution:
         if lin is not None:
             failed.add(lin)
 
+    def _note_internal_failure(self, lineage: Optional[str], worker: str,
+                               message: str) -> None:
+        """Poison-split quarantine bookkeeping: the same INTERNAL error
+        signature for the same task lineage on >= 2 DISTINCT workers is
+        deterministic, not infrastructure — fail fast with the split
+        identity instead of burning the remaining attempt budget."""
+        # A consumer observing its producer's failure quotes the producer's
+        # buffer location; the DEEPEST quoted location names the true
+        # culprit AND the worker that hosted it (the caller only knows the
+        # outermost wrapper's worker, which is the wrong attribution).
+        for wkr, tid in reversed(_SOURCE_LOCATIONS.findall(message or "")):
+            lin = self._lineage_of_task(tid)
+            if lin is not None:
+                lineage, worker = lin, wkr
+                break
+        if not lineage or not worker:
+            return
+        sig = _failure_signature(message)
+        key = (lineage, sig)
+        workers = self.failure_workers.setdefault(key, set())
+        workers.add(worker)
+        if len(workers) >= 2:
+            raise PoisonSplitError(lineage, workers, sig)
+
     def _restart(self, lineages: Set[str], cause: Exception) -> None:
-        """Restart every failed lineage plus ALL tasks of every ancestor
-        stage (consumer locations are baked into TaskSources, so a new
-        producer attempt invalidates its consumers; the root's restart
-        resets the collected output — exactly-once).  Only the originally
+        """Restart every failed lineage.  Under retry-policy=query the
+        restart set also covers ALL tasks of every ancestor stage
+        (consumer locations are baked into TaskSources, so a new producer
+        attempt invalidates its consumers; the root's restart resets the
+        collected output — exactly-once).  Under retry-policy=task the
+        failed lineage restarts ALONE: its output replays from the durable
+        spool and surviving consumers get their source locations refreshed
+        in place, so no ancestor stage re-runs.  Only the originally
         failed lineages are charged against the attempt budget."""
         if self.max_attempts <= 0:
             raise PrestoQueryError(
@@ -600,6 +732,8 @@ class _QueryExecution:
         for lin in lineages:
             stage, ti = self.lineage_index[lin]
             restart.setdefault(id(stage), set()).add(ti)
+            if self.retry_policy == "task":
+                continue  # spooled output: no ancestor cascade
             anc = stage.parent
             while anc is not None:
                 restart[id(anc)] = set(range(anc.n_tasks))
@@ -620,6 +754,35 @@ class _QueryExecution:
                 continue
             for ti in sorted(restart[id(stage)]):
                 self._place_task(stage, ti)
+        if self.retry_policy == "task":
+            self._refresh_consumers(restart, stage_by_id)
+
+    def _refresh_consumers(self, restarted: Dict[int, Set[int]],
+                           stage_by_id: Dict[int, _Stage]) -> None:
+        """retry-policy=task: each SURVIVING consumer of a restarted
+        producer gets a fragment-less task update carrying refreshed
+        source locations, so its live exchange pulls redirect to the
+        replacement attempt's buffers mid-stream (consumers that were
+        themselves restarted already baked in the new locations)."""
+        parents: Dict[int, _Stage] = {}
+        for sid in restarted:
+            parent = stage_by_id[sid].parent
+            if parent is not None:
+                parents[id(parent)] = parent
+        for pid, parent in parents.items():
+            replaced = restarted.get(pid, set())
+            for ti, task in enumerate(parent.tasks):
+                if task is None or ti in replaced:
+                    continue
+                req = TaskUpdateRequest(
+                    task.task_id, ti, None,
+                    self._make_sources(parent, ti), parent.spec,
+                    session=self.session)
+                try:
+                    task.update(req, deadline_ms=self._deadline_ms())
+                except (urllib.error.URLError, urllib.error.HTTPError,
+                        TimeoutError, OSError):
+                    pass  # the watcher surfaces a truly dead consumer
 
     def query_info_snapshot(self) -> dict:
         """Stage/task/operator breakdown for /v1/query/{id} (the reference

@@ -38,14 +38,16 @@ from __future__ import annotations
 import collections
 import queue
 import random
+import re
 import struct
 import threading
 import time
 import urllib.error
 import urllib.request
-from typing import Callable, Iterator, List, Optional
+from typing import Callable, Dict, Iterator, List, Optional
 
-from ..common.errors import ExchangeLostError, RemoteTaskError
+from ..common.errors import (ExchangeLostError, RemoteTaskError,
+                             is_retryable_type, parse_error_type)
 from ..common.page import Page
 from ..common.serde import DEFAULT_CODEC, deserialize_page, deserialize_pages
 
@@ -69,6 +71,30 @@ class ExchangeAbortedError(RuntimeError):
 class _Stop(BaseException):
     """Internal puller-thread unwind on client close (BaseException so it
     cannot be swallowed by a broad `except Exception`)."""
+
+
+class _Relocate(BaseException):
+    """Internal puller unwind when a location was superseded by a task
+    retry (update_locations): the puller re-resolves the location and
+    resumes the SAME stream at its delivered token."""
+
+    def __init__(self, location: str):
+        self.location = location
+
+
+# buffer identity inside a results location:
+# http://host:port/v1/task/{taskId}/results/{bufferId}
+_LOCATION_KEY = re.compile(r"/v1/task/([^/\s]+)/results/(\d+)")
+_RETRY_SUFFIX = re.compile(r"\.r\d+$")
+
+
+def _location_key(location: str):
+    """(base task lineage, buffer id) — stable across retry attempts, so
+    an old attempt's location matches its replacement's."""
+    m = _LOCATION_KEY.search(location)
+    if not m:
+        return location
+    return _RETRY_SUFFIX.sub("", m.group(1)), m.group(2)
 
 
 def _request(url: str, method: str = "GET",
@@ -157,14 +183,27 @@ def _pull_rounds(location: str,
                  max_response_bytes: Optional[int] = None,
                  acknowledge: Optional[Callable[[str], None]] = None,
                  on_round: Optional[Callable[[float], None]] = None,
+                 start_token: int = 0,
+                 park_on_failure: bool = False,
+                 on_token: Optional[Callable[[int], None]] = None,
                  ) -> Iterator[bytes]:
     """The per-location protocol loop, yielding each non-empty response
     BODY (one or more concatenated SerializedPages).  Handles token
     resume, the budgeted jittered backoff, acknowledges (via the
     `acknowledge` callback when given, else inline best-effort), and the
     final DELETE.  `sleep` is injectable so a closing client can interrupt
-    a backoff wait."""
-    token = 0
+    a backoff wait.
+
+    `start_token` resumes a relocated stream mid-way (task retry under
+    retry-policy=task: the replacement attempt replays the same durable
+    spool, so tokens line up).  With `park_on_failure` a RETRYABLE
+    producer failure (500 with a retryable [ERROR_TYPE], 404/410 task
+    loss) downgrades to the budgeted backoff instead of raising — the
+    coordinator will replace the producer and redirect this pull, so the
+    consumer survives the producer's death (fault-tolerant mode's
+    decoupled lifetimes).  Non-retryable producer errors still propagate
+    immediately."""
+    token = start_token
     error_since: Optional[float] = None
     attempt = 0
     extra = ({"X-Presto-Max-Size": str(int(max_response_bytes))}
@@ -188,6 +227,13 @@ def _pull_rounds(location: str,
         except urllib.error.HTTPError as e:
             detail = e.read().decode(errors="replace")
             if e.code in (404, 410):
+                if park_on_failure:
+                    # the producer attempt is gone but a replacement is
+                    # coming: wait (budgeted) for the redirect
+                    error_since, attempt = _backoff(
+                        location, token, error_since, attempt,
+                        max_error_duration_s, e, sleep=sleep)
+                    continue
                 # the producer task is GONE (worker restarted and lost its
                 # task registry): not transient — the task must be rebuilt
                 raise ExchangeLostError(
@@ -196,6 +242,15 @@ def _pull_rounds(location: str,
                     f"token {token}: producer task lost") from e
             if e.code == 503:
                 # draining/overloaded producer: transient, budgeted retry
+                error_since, attempt = _backoff(
+                    location, token, error_since, attempt,
+                    max_error_duration_s, e, sleep=sleep)
+                continue
+            if (park_on_failure
+                    and is_retryable_type(parse_error_type(detail))):
+                # retryable producer failure under retry-policy=task: the
+                # coordinator retries THAT task alone; this consumer parks
+                # and resumes against the replacement attempt
                 error_since, attempt = _backoff(
                     location, token, error_since, attempt,
                     max_error_duration_s, e, sleep=sleep)
@@ -223,6 +278,8 @@ def _pull_rounds(location: str,
                 except (urllib.error.URLError, TimeoutError, OSError):
                     pass  # acknowledge is an optimization; pull re-fetches
             token = next_token
+            if on_token is not None:
+                on_token(next_token)
         if complete:
             try:
                 _request(location, method="DELETE").close()
@@ -294,10 +351,16 @@ class ExchangeClient:
                  client_threads: int = DEFAULT_CLIENT_THREADS,
                  max_buffer_bytes: int = DEFAULT_MAX_BUFFER_BYTES,
                  max_response_bytes: int = DEFAULT_MAX_RESPONSE_BYTES,
-                 stats=None):
+                 stats=None, park_on_failure: bool = False):
         self._codec = codec
         self._max_error_s = max_error_duration_s
         self._should_abort = should_abort
+        self._park = park_on_failure
+        # task-retry redirection (update_locations): old location -> new,
+        # plus the delivered-token high-water mark per live location so a
+        # redirected pull resumes instead of replaying delivered pages
+        self._redirect: Dict[str, str] = {}
+        self._loc_tokens: Dict[str, int] = {}
         self._max_buffer = max(1, int(max_buffer_bytes))
         self._max_response = int(max_response_bytes) or None
         self._stats = stats               # utils.runtime_stats.RuntimeStats
@@ -318,8 +381,9 @@ class ExchangeClient:
         self._uncompressed = 0
         self._t0 = time.perf_counter()
         self._location_q: "queue.SimpleQueue" = queue.SimpleQueue()
+        self._known = set(locations)      # every location we may pull
         for loc in locations:
-            self._location_q.put(loc)
+            self._location_q.put((loc, 0))
         self._ack_q: "queue.SimpleQueue" = queue.SimpleQueue()
         self._threads: List[threading.Thread] = []
         if locations:
@@ -339,6 +403,41 @@ class ExchangeClient:
         if self._should_abort is not None:
             self._should_abort()
 
+    def _abort_check_loc(self, location: str) -> None:
+        """Per-location round check: close/error/abort as usual, plus the
+        relocation signal — a superseded location unwinds its puller so it
+        can resume against the replacement attempt."""
+        self._abort_check()
+        with self._cond:
+            if location in self._redirect:
+                raise _Relocate(location)
+
+    def _resolve_location(self, location: str) -> str:
+        """Follow the redirect chain to the newest attempt's location."""
+        with self._cond:
+            seen = set()
+            while location in self._redirect and location not in seen:
+                seen.add(location)
+                location = self._redirect[location]
+            return location
+
+    def update_locations(self, new_locations: List[str]) -> None:
+        """Coordinator task-retry: map every known location whose (base
+        lineage, buffer id) matches a replacement onto the new attempt's
+        location.  Live pullers unwind via _Relocate at their next round
+        and resume the stream at its delivered token; queued locations
+        resolve at dequeue.  No-op for locations already current."""
+        with self._cond:
+            if self._closed:
+                return
+            by_key = {_location_key(loc): loc for loc in new_locations}
+            for old in list(self._known):
+                new = by_key.get(_location_key(old))
+                if new is not None and new != old:
+                    self._redirect[old] = new
+                    self._known.add(new)
+            self._cond.notify_all()
+
     def _sleep(self, delay: float) -> None:
         if self._stop_event.wait(delay):
             raise _Stop()
@@ -348,23 +447,45 @@ class ExchangeClient:
             self._pull_wall += wall_s
         EXCHANGE_METRICS.on_response(wall_s)
 
+    def _note_token(self, location: str, token: int) -> None:
+        with self._cond:
+            self._loc_tokens[location] = token
+
     def _puller(self) -> None:
         """Drain locations off the shared queue (cap: client_threads
         pullers active at once) until none remain; each location resumes
-        from its own token with its own backoff budget."""
+        from its own token with its own backoff budget.  A relocation
+        (task retry) unwinds the location's pull and resumes the same
+        stream against the replacement attempt at its delivered token."""
         try:
             while True:
                 try:
-                    loc = self._location_q.get_nowait()
+                    loc, tok = self._location_q.get_nowait()
                 except queue.Empty:
                     return
-                for body in _pull_rounds(
-                        loc, max_error_duration_s=self._max_error_s,
-                        should_abort=self._abort_check, sleep=self._sleep,
-                        max_response_bytes=self._max_response,
-                        acknowledge=self._ack_q.put,
-                        on_round=self._on_round):
-                    self._decode_and_offer(body)
+                while True:
+                    loc = self._resolve_location(loc)
+                    self._note_token(loc, tok)
+                    try:
+                        for body in _pull_rounds(
+                                loc,
+                                max_error_duration_s=self._max_error_s,
+                                should_abort=lambda l=loc:
+                                    self._abort_check_loc(l),
+                                sleep=self._sleep,
+                                max_response_bytes=self._max_response,
+                                acknowledge=self._ack_q.put,
+                                on_round=self._on_round,
+                                start_token=tok,
+                                park_on_failure=self._park,
+                                on_token=lambda t, l=loc:
+                                    self._note_token(l, t)):
+                            self._decode_and_offer(body)
+                        break                    # stream complete
+                    except _Relocate:
+                        with self._cond:
+                            tok = self._loc_tokens.get(loc, tok)
+                        continue                 # resume on new attempt
                 with self._cond:
                     self._remaining -= 1
                     if self._remaining <= 0:
@@ -502,18 +623,29 @@ def remote_page_reader(locations: List[str], codec: str = DEFAULT_CODEC,
                        client_threads: int = DEFAULT_CLIENT_THREADS,
                        max_buffer_bytes: int = DEFAULT_MAX_BUFFER_BYTES,
                        max_response_bytes: int = DEFAULT_MAX_RESPONSE_BYTES,
-                       stats=None):
+                       stats=None, park_on_failure: bool = False,
+                       on_client: Optional[Callable] = None):
     """A TaskContext.remote_pages callable: pages from every upstream task
     feeding one RemoteSourceNode, pulled concurrently through an
     ExchangeClient.  `should_abort` raises to stop the pull early (worker
     tasks pass their own terminal-state check so a doomed query's remote
-    sources stop instead of draining to completion)."""
+    sources stop instead of draining to completion).
+
+    `locations` is held BY REFERENCE: a caller may mutate the list in
+    place (task-retry redirection) and a later read() picks up the new
+    locations.  `on_client` observes every client created so live pulls
+    can be redirected too (ExchangeClient.update_locations);
+    `park_on_failure` is the fault-tolerant consumer behavior (see
+    _pull_rounds)."""
     def read() -> Iterator[Page]:
         client = ExchangeClient(
-            locations, codec=codec,
+            list(locations), codec=codec,
             max_error_duration_s=max_error_duration_s,
             should_abort=should_abort, client_threads=client_threads,
             max_buffer_bytes=max_buffer_bytes,
-            max_response_bytes=max_response_bytes, stats=stats)
+            max_response_bytes=max_response_bytes, stats=stats,
+            park_on_failure=park_on_failure)
+        if on_client is not None:
+            on_client(client)
         yield from client.pages()        # pages() closes the client
     return read

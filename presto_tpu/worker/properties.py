@@ -173,6 +173,25 @@ def execution_config_from_properties(props: Dict[str, str],
         kw["plan_validation"] = mode
     if "telemetry.profile-dir" in props:
         kw["profile_dir"] = props["telemetry.profile-dir"]
+    if "retry-policy" in props:
+        from ..exec.pipeline import RETRY_POLICY_MODES
+        mode = props["retry-policy"].strip().lower()
+        if mode not in RETRY_POLICY_MODES:
+            raise ValueError(
+                f"retry-policy must be one of {RETRY_POLICY_MODES}, "
+                f"got {mode!r}")
+        kw["retry_policy"] = mode
+    if "query.max-execution-time" in props:
+        kw["query_max_execution_time_s"] = parse_duration(
+            props["query.max-execution-time"])
+    if props.get("spool.path"):
+        kw["spool_path"] = props["spool.path"]
+    if "spool.staging-budget-bytes" in props:
+        kw["spool_staging_budget_bytes"] = parse_data_size(
+            props["spool.staging-budget-bytes"])
+    if "failure-detector.heartbeat-timeout" in props:
+        kw["failure_detector_heartbeat_timeout_s"] = parse_duration(
+            props["failure-detector.heartbeat-timeout"])
     return dataclasses.replace(cfg, **kw) if kw else cfg
 
 
@@ -216,6 +235,13 @@ class SystemConfig:
         ("task.grouped-prefetch-depth", int, 1),
         ("task.grouped-lifespan-sharding", bool, True),
         ("task.remote-task-retry-attempts", int, 2),
+        # fault-tolerant execution: task-granular retry over the durable
+        # spooled exchange (worker/spooling.py)
+        ("retry-policy", str, "query"),          # query | task
+        ("query.max-execution-time", str, ""),   # "" = unbounded
+        ("spool.path", str, ""),                 # "" = spill.path
+        ("spool.staging-budget-bytes", str, "16MB"),
+        ("failure-detector.heartbeat-timeout", str, ""),  # "" = streak only
         ("task.fault-injection-probability", float, 0.0),
         ("task.plan-validation", str, "on"),
         ("shutdown-onset-sec", int, 10),

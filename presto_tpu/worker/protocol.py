@@ -319,6 +319,23 @@ def apply_session_properties(config, session: Dict[str, str]):
     if "exchange_max_error_duration" in session:
         kw["exchange_max_error_duration_s"] = parse_duration(
             session["exchange_max_error_duration"])
+    if "retry_policy" in session:
+        mode = str(session["retry_policy"]).strip().lower()
+        from ..exec.pipeline import RETRY_POLICY_MODES
+        if mode not in RETRY_POLICY_MODES:
+            raise ValueError(
+                f"retry_policy must be one of {RETRY_POLICY_MODES}, "
+                f"got {mode!r}")
+        kw["retry_policy"] = mode
+    if "query_max_execution_time" in session:
+        kw["query_max_execution_time_s"] = parse_duration(
+            session["query_max_execution_time"])
+    # durable-spool knobs (retry-policy=task; fall back to spill.path)
+    if "spool_path" in session:
+        kw["spool_path"] = session["spool_path"] or None
+    if "spool_staging_budget_bytes" in session:
+        kw["spool_staging_budget_bytes"] = parse_data_size(
+            session["spool_staging_budget_bytes"])
     # concurrent exchange client knobs (reference exchange.client-threads /
     # exchange.max-buffer-size / exchange.max-response-size)
     if "exchange_client_threads" in session:

@@ -287,6 +287,17 @@ class _Handler(BaseHTTPRequestHandler):
                     'presto_tpu_worker_alive{worker="%s",draining="%s"} %d'
                     % (uri, str(w["draining"]).lower(),
                        1 if w["alive"] else 0))
+        # durable spooled-exchange section (worker/spooling.py): bytes
+        # staged/flushed by fault-tolerant (retry-policy=task) executions
+        from .spooling import SPOOL_METRICS
+        sp = SPOOL_METRICS.snapshot()
+        for k in sorted(sp):
+            if k == "staged_bytes":
+                lines.append(f"# TYPE presto_tpu_spool_{k} gauge")
+                lines.append(f"presto_tpu_spool_{k} {sp[k]}")
+            else:
+                lines.append(f"# TYPE presto_tpu_spool_{k}_total counter")
+                lines.append(f"presto_tpu_spool_{k}_total {sp[k]}")
         # exchange-client section: process-wide (one worker per process in
         # a real deployment; in-process test clusters aggregate, so tests
         # reset() the singleton before asserting)
@@ -661,6 +672,8 @@ class _Handler(BaseHTTPRequestHandler):
             **({"memoryHeadroomBytes": headroom}
                if headroom is not None else {}),
             "fabricByteRates": FABRIC_METRICS.byte_rates(),
+            **({"workers": s.failure_detector.snapshot()}
+               if s.failure_detector else {}),
             "historyEntries": len(s.history) if s.history else 0,
             **({"telemetry": s.telemetry.counters()}
                if s.telemetry else {}),
@@ -789,7 +802,18 @@ collapse}}td,th{{border:1px solid #ccc;padding:4px 8px;text-align:left}}
             update = from_reference_update(groups["task"], body)
         else:
             update = TaskUpdateRequest.from_dict(body)
-        status = self.server_ref.task_manager.create_or_update(update)
+        # X-Presto-Task-Deadline carries the query's REMAINING execution
+        # budget in ms (no cross-node clock sync needed): the TaskManager
+        # reaper and the pipeline drain loop both enforce it
+        deadline_ms = None
+        raw_deadline = self.headers.get("X-Presto-Task-Deadline")
+        if raw_deadline:
+            try:
+                deadline_ms = float(raw_deadline)
+            except ValueError:
+                deadline_ms = None
+        status = self.server_ref.task_manager.create_or_update(
+            update, deadline_ms=deadline_ms)
         from .thrift import task_status_to_thrift
         self._send_negotiated(200, status.to_dict(),
                               thrift_encoder=task_status_to_thrift)
@@ -1101,7 +1125,11 @@ class WorkerServer:
                 if uris:
                     from .coordinator import (HeartbeatFailureDetector,
                                               HttpQueryRunner)
-                    det = HeartbeatFailureDetector(list(uris))
+                    det = HeartbeatFailureDetector(
+                        list(uris),
+                        heartbeat_timeout_s=(
+                            cfg.failure_detector_heartbeat_timeout_s
+                            or None))
                     runner = HttpQueryRunner(list(uris), schema=q.schema,
                                              config=cfg, session=q.session,
                                              failure_detector=det,
@@ -1270,6 +1298,18 @@ class WorkerServer:
             while time.time() < deadline:
                 counts = self.task_manager.counts()["by_state"]
                 if not any(s in ("RUNNING", "PLANNED") for s in counts):
+                    break
+                time.sleep(0.1)
+            # spooled output (retry-policy=task) outlives task completion:
+            # make it durable, then keep serving /results until every
+            # consumer has drained it (final DELETE or acked-to-end), so
+            # in-flight queries finish with zero failures before we exit
+            try:
+                self.task_manager.flush_spools()
+            except Exception:  # noqa: BLE001 — drain is best-effort
+                pass
+            while time.time() < deadline:
+                if self.task_manager.all_output_consumed():
                     break
                 time.sleep(0.1)
             self.close()

@@ -15,8 +15,8 @@ import threading
 import traceback
 from typing import Callable, Dict, List, Optional
 
-from ..common.errors import (INTERNAL_ERROR, InjectedTaskFailure,
-                             classify_exception)
+from ..common.errors import (INTERNAL_ERROR, USER_ERROR, InjectedTaskFailure,
+                             QueryDeadlineExceededError, classify_exception)
 from ..common.serde import serialize_page
 from ..connectors import catalog, tpch
 from ..exec.pipeline import (ExecutionConfig, PlanCompiler, TaskContext,
@@ -61,6 +61,17 @@ class TpuTask:
         # "trace_token"); echoed back in TaskInfo so a trace id observed at
         # the coordinator can be joined against worker-side task records
         self.trace_token = ""
+        # X-Presto-Task-Deadline: the query's remaining wall budget at
+        # dispatch time, converted to a worker-local monotonic deadline
+        # (relative ms avoids any coordinator<->worker clock agreement);
+        # enforced by the _run page loop and the TaskManager reaper
+        self._deadline: Optional[float] = None
+        self._deadline_budget_s = 0.0
+        # remote-source locations by plan node, shared BY REFERENCE with
+        # this task's exchange readers so a coordinator task-retry can
+        # redirect live pulls to the replacement attempt's buffers
+        self._remote_locations: Dict[str, List[str]] = {}
+        self._remote_clients: Dict[str, list] = {}
         self._cond = threading.Condition()
         self._thread: Optional[threading.Thread] = None
 
@@ -101,6 +112,11 @@ class TpuTask:
                 "spilledBytes": (
                     0 if self.memory_ctx is None
                     else self.memory_ctx.pool.spilled_bytes),
+                # fault-tolerant mode: raw bytes durably staged through the
+                # task's output spool (0 under retry-policy=query)
+                "spooledBytes": (
+                    0 if self.buffers is None
+                    else self.buffers.spooled_bytes),
                 "memoryReservedBytes": (
                     0 if self.memory_ctx is None
                     else self.memory_ctx.pool.reserved),
@@ -203,6 +219,42 @@ class TpuTask:
                 f"task {self.task_id} failed [{error_type}]: {message}")
         self._set_state(FAILED, message, error_type)
 
+    # -- deadline (X-Presto-Task-Deadline) --------------------------------
+    def set_deadline(self, remaining_ms: float) -> None:
+        """Arm the task's wall deadline from the header's REMAINING budget
+        (the coordinator forwards what's left of query.max-execution-time
+        at dispatch; monotonic-local, no clock sync needed)."""
+        import time
+        self._deadline = time.monotonic() + max(0.0, remaining_ms) / 1000.0
+        self._deadline_budget_s = max(0.0, remaining_ms) / 1000.0
+
+    def deadline_exceeded(self) -> bool:
+        import time
+        return (self._deadline is not None
+                and time.monotonic() > self._deadline
+                and self.state not in DONE_STATES)
+
+    def _check_deadline(self) -> None:
+        """Raise the typed non-retryable time-limit error past deadline
+        (called from the _run page loop so device work stops promptly)."""
+        import time
+        if self._deadline is not None and time.monotonic() > self._deadline:
+            over = time.monotonic() - self._deadline
+            raise QueryDeadlineExceededError(
+                self._deadline_budget_s + over, self._deadline_budget_s,
+                context=f"task {self.task_id}")
+
+    def fail_deadline(self) -> None:
+        """Reaper-side enforcement: a stuck (or executor-less) task past
+        its deadline fails with the same typed user error."""
+        import time
+        over = (time.monotonic() - self._deadline
+                if self._deadline is not None else 0.0)
+        err = QueryDeadlineExceededError(
+            self._deadline_budget_s + max(0.0, over),
+            self._deadline_budget_s, context=f"task {self.task_id}")
+        self.fail(str(err), USER_ERROR)
+
     def _exchange_abort(self) -> None:
         """should_abort hook for this task's exchange clients: once the
         task is terminal (FAILED sibling propagated, canceled, finished)
@@ -212,6 +264,31 @@ class TpuTask:
             raise ExchangeAbortedError(
                 f"task {self.task_id} is {self.state}; aborting exchange "
                 f"pull")
+
+    def update_remote_sources(self, sources) -> None:
+        """Fragment-less task update (coordinator task-retry under
+        retry-policy=task): a failed PRODUCER was replaced by a new
+        attempt, so this consumer's exchange pulls must redirect to the
+        replacement's buffer locations.  The stored location lists are
+        mutated IN PLACE (fresh clients pick them up) and every live
+        client is told to relocate, resuming each stream at its delivered
+        token — exactly-once because the spool replays deterministically
+        from 0."""
+        from .plan_translation import translate_split
+        for source in sources:
+            old = self._remote_locations.get(source.plan_node_id)
+            if old is None:
+                continue
+            splits = [translate_split(s) for s in source.splits]
+            new_locs = [s["location"] for s in splits if s.get("remote")]
+            if not new_locs:
+                continue
+            old[:] = new_locs
+            for client in self._remote_clients.get(source.plan_node_id, []):
+                try:
+                    client.update_locations(new_locs)
+                except Exception:
+                    pass  # a closed client has nothing to redirect
 
     # -- execution ----------------------------------------------------------
     def start(self, update: TaskUpdateRequest) -> None:
@@ -232,12 +309,25 @@ class TpuTask:
             # retry mode makes buffers replayable: a retried consumer
             # re-reads from token 0, so acknowledged pages must survive —
             # charged to this task's context as revocable bytes (spilled
-            # to disk by the arbitrator under pressure)
+            # to disk by the arbitrator under pressure).  retry-policy=task
+            # goes further: output pages are DURABLY spooled (host-RAM
+            # staging -> LZ4 block file) and retained past task completion,
+            # so a failed task retries alone — no ancestor restart — and a
+            # draining worker's output survives its exit
+            spool = None
+            if getattr(cfg, "retry_policy", "query") == "task":
+                from .spooling import TaskSpool
+                spool = TaskSpool(
+                    self.task_id, spec.n_buffers,
+                    spool_dir=cfg.spool_path or cfg.spill_path,
+                    memory=self.memory_ctx,
+                    staging_budget_bytes=cfg.spool_staging_budget_bytes)
             self.buffers = OutputBufferManager(
                 spec.type, spec.n_buffers,
-                retain=cfg.remote_task_retry_attempts > 0,
+                retain=spool is None and cfg.remote_task_retry_attempts > 0,
                 coalesce_target_bytes=cfg.exchange_max_response_bytes,
-                memory=self.memory_ctx, spill_dir=cfg.spill_path)
+                memory=self.memory_ctx, spill_dir=cfg.spill_path,
+                spool=spool)
             ctx = TaskContext(config=cfg, task_index=update.task_index,
                               memory=self.memory_ctx,
                               runtime_stats=self.stats)
@@ -260,8 +350,14 @@ class TpuTask:
                 if remote:
                     # should_abort: a sibling failure (or cancel) puts this
                     # task in a terminal state, and the exchange pull must
-                    # stop with it instead of draining a doomed query
-                    ctx.remote_pages[source.plan_node_id] = \
+                    # stop with it instead of draining a doomed query.
+                    # The location list is kept (by reference) and every
+                    # client created is registered, so a coordinator task
+                    # retry can redirect live pulls mid-stream
+                    # (update_remote_sources).
+                    self._remote_locations[source.plan_node_id] = remote
+                    nid = source.plan_node_id
+                    ctx.remote_pages[nid] = \
                         remote_page_reader(
                             remote, codec=cfg.exchange_compression_codec,
                             max_error_duration_s=
@@ -271,7 +367,13 @@ class TpuTask:
                             max_buffer_bytes=cfg.exchange_max_buffer_bytes,
                             max_response_bytes=
                             cfg.exchange_max_response_bytes,
-                            stats=self.stats)
+                            stats=self.stats,
+                            park_on_failure=(
+                                getattr(cfg, "retry_policy", "query")
+                                == "task"),
+                            on_client=lambda c, n=nid: (
+                                self._remote_clients.setdefault(
+                                    n, []).append(c)))
                 if conn:
                     ctx.splits[source.plan_node_id] = [
                         catalog.TableSplit.from_dict(s) for s in conn]
@@ -361,6 +463,7 @@ class TpuTask:
                 self._drain_wall = drain_wall
             for page in pages:
                 self.memory_peak = ctx.memory.peak
+                self._check_deadline()
                 if self.state in DONE_STATES:
                     # deterministic shutdown of the drain pipeline (the
                     # generator's close() stops background producers)
@@ -398,6 +501,10 @@ class TpuTask:
                     if s is not None:
                         op["stats"] = s
             self.buffers.set_complete()
+            if self.buffers.spooled_bytes:
+                # EXPLAIN ANALYZE footer + coordinator roll-up surface
+                self.stats.add("spoolBytes", self.buffers.spooled_bytes,
+                               "BYTE")
             self._set_state(FINISHED)
         except Exception as e:
             # tag the failure with its reference error type so consumers
@@ -518,6 +625,28 @@ class TaskManager:
     def evict_terminal(self) -> None:
         with self._lock:
             self._evict_locked()
+            overdue = [t for t in self.tasks.values()
+                       if t.deadline_exceeded()]
+        for t in overdue:
+            # reaper-side deadline enforcement: even a task whose executor
+            # is stuck (device sync, backpressure) fails its deadline
+            t.fail_deadline()
+
+    def flush_spools(self) -> int:
+        """Graceful drain: force every task's spool staging to disk so
+        spooled output survives this worker's exit."""
+        with self._lock:
+            tasks = list(self.tasks.values())
+        return sum(t.buffers.flush_spool() for t in tasks
+                   if t.buffers is not None)
+
+    def all_output_consumed(self) -> bool:
+        """Drain gate: every COMPLETE task output stream has been acked or
+        released by its consumer (tasks still running don't count yet)."""
+        with self._lock:
+            tasks = list(self.tasks.values())
+        return all(t.buffers.all_consumed() for t in tasks
+                   if t.buffers is not None)
 
     def start_reaper(self, interval_s: Optional[float] = None) -> None:
         """Periodic terminal-task eviction (reference PeriodicTaskManager):
@@ -540,12 +669,21 @@ class TaskManager:
             self._reaper_stop.set()
             self._reaper_stop = None
 
-    def create_or_update(self, update: TaskUpdateRequest) -> TaskStatus:
+    def create_or_update(self, update: TaskUpdateRequest,
+                         deadline_ms: Optional[float] = None) -> TaskStatus:
         import re
         with self._lock:
             self._evict_locked()
             task = self.tasks.get(update.task_id)
             if task is None:
+                if not update.fragment_b64 and update.sources:
+                    # source-refresh for a task we don't know (it already
+                    # finished and was evicted): answer with a terminal
+                    # stub instead of stranding a PLANNED zombie in the
+                    # registry
+                    return TaskStatus(update.task_id, CANCELED, 0,
+                                      f"{self.base_uri}/v1/task/"
+                                      f"{update.task_id}", [])
                 self.tasks_created += 1
                 if re.search(r"\.r\d+$", update.task_id):
                     # coordinator retry lineage suffix (taskId.rATTEMPT)
@@ -557,8 +695,14 @@ class TaskManager:
                 fresh = True
             else:
                 fresh = False
+        if deadline_ms is not None:
+            task.set_deadline(deadline_ms)
         if fresh and update.fragment_b64:
             task.start(update)
+        elif not fresh and update.sources:
+            # coordinator task-retry: redirect this consumer's exchange
+            # pulls to the replacement producer attempt's locations
+            task.update_remote_sources(update.sources)
         return task.status()
 
     def get(self, task_id: str) -> TpuTask:

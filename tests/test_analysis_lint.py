@@ -14,8 +14,9 @@ import pytest
 
 from presto_tpu.analysis.lint import (ALL_LINT_CODES, KERNEL_INTERPRET,
                                       MEM_PRAGMA, MEM_UNCHARGED_STAGING,
-                                      PRAGMA, SYNC_ASARRAY, SYNC_BRANCH,
-                                      SYNC_CAST, SYNC_EXPLICIT, SYNC_NETWORK,
+                                      NET_NO_TIMEOUT, NET_PRAGMA, PRAGMA,
+                                      SYNC_ASARRAY, SYNC_BRANCH, SYNC_CAST,
+                                      SYNC_EXPLICIT, SYNC_NETWORK,
                                       SYNC_WALLCLOCK, TELEM_UNBOUNDED_QUEUE,
                                       WALL_PRAGMA, lint_or_raise, lint_paths,
                                       lint_source)
@@ -137,11 +138,14 @@ def test_network_call_in_compute_module_flagged():
 
 def test_network_call_outside_compute_paths_not_flagged():
     # worker-layer code (incl. the sanctioned exchange client) may do
-    # blocking HTTP; the lint scopes SYNC005 to pipeline compute packages
+    # blocking HTTP; the lint scopes SYNC005 to pipeline compute packages.
+    # NET001 still applies there (the fixture omits timeout=) — assert
+    # only that SYNC005 stays out of the worker layer.
     for path in ("presto_tpu/worker/exchange.py",
-                 "presto_tpu/worker/coordinator.py",
-                 "tools/fetch.py"):
-        assert lint_source(_NET_FIXTURE, path=path) == []
+                 "presto_tpu/worker/coordinator.py"):
+        assert _codes(lint_source(_NET_FIXTURE, path=path)) == \
+            {NET_NO_TIMEOUT}
+    assert lint_source(_NET_FIXTURE, path="tools/fetch.py") == []
 
 
 def test_network_parse_and_error_usage_not_flagged():
@@ -393,12 +397,63 @@ def test_telemetry_queue_has_no_pragma_escape():
 
 def test_telemetry_network_scoping():
     """telemetry/ is network-scoped (SYNC005) except export.py, whose
-    OTLP POSTs run on the exporter's background flush thread."""
-    assert lint_source(_NET_FIXTURE,
-                       path="presto_tpu/telemetry/export.py") == []
+    OTLP POSTs run on the exporter's background flush thread.  NET001
+    (missing timeout=) applies to the whole package, export.py
+    included — a flush thread wedged on a dead collector never drains."""
+    findings = lint_source(_NET_FIXTURE,
+                           path="presto_tpu/telemetry/export.py")
+    assert _codes(findings) == {NET_NO_TIMEOUT}
     findings = lint_source(_NET_FIXTURE,
                            path="presto_tpu/telemetry/history.py")
-    assert _codes(findings) == {SYNC_NETWORK}
+    assert _codes(findings) == {SYNC_NETWORK, NET_NO_TIMEOUT}
+
+
+def test_urllib_without_timeout_in_worker_flagged():
+    """NET001: a urllib request in worker/ or telemetry/ without an
+    explicit timeout= can block its thread forever on a dead peer —
+    the exact hang the fault-tolerant mode exists to survive."""
+    findings = lint_source(_NET_FIXTURE,
+                           path="presto_tpu/worker/server.py")
+    assert _codes(findings) == {NET_NO_TIMEOUT}
+    # urlopen_internal (worker/auth.py wrapper) is held to the same rule
+    findings = lint_source(
+        "from .auth import urlopen_internal\n"
+        "def probe(req):\n"
+        "    return urlopen_internal(req)\n",
+        path="presto_tpu/worker/coordinator.py")
+    assert _codes(findings) == {NET_NO_TIMEOUT}
+
+
+def test_urllib_with_timeout_not_flagged():
+    src = ("import urllib.request\n"
+           "def fetch(url):\n"
+           "    return urllib.request.urlopen(url, timeout=5).read()\n")
+    assert lint_source(src, path="presto_tpu/worker/server.py") == []
+    # a **kwargs splat is trusted to carry the caller's bound
+    src2 = ("import urllib.request\n"
+            "def fetch(url, **kw):\n"
+            "    return urllib.request.urlopen(url, **kw).read()\n")
+    assert lint_source(src2, path="presto_tpu/worker/server.py") == []
+
+
+def test_urllib_timeout_scope_and_pragma():
+    # the rule is scoped to worker/ + telemetry/; elsewhere urllib calls
+    # answer only to SYNC005's compute-module scoping
+    assert lint_source(_NET_FIXTURE, path="presto_tpu/sql/planner.py") == []
+    suppressed = lint_source(
+        "import urllib.request\n"
+        "def fetch(url):\n"
+        "    return urllib.request.urlopen(url)  # lint: allow-no-timeout\n",
+        path="presto_tpu/worker/server.py")
+    assert suppressed == []
+    # ...and the net pragma is its own line set: a host-sync pragma does
+    # not silence NET001
+    findings = lint_source(
+        "import urllib.request\n"
+        "def fetch(url):\n"
+        "    return urllib.request.urlopen(url)  # lint: allow-host-sync\n",
+        path="presto_tpu/worker/server.py")
+    assert _codes(findings) == {NET_NO_TIMEOUT}
 
 
 _MEM_FIXTURE = ("class BucketStager:\n"
@@ -473,7 +528,8 @@ def test_all_codes_are_exercised_above():
     assert set(ALL_LINT_CODES) == {SYNC_EXPLICIT, SYNC_CAST, SYNC_ASARRAY,
                                    SYNC_BRANCH, SYNC_NETWORK, SYNC_WALLCLOCK,
                                    KERNEL_INTERPRET, TELEM_UNBOUNDED_QUEUE,
-                                   MEM_UNCHARGED_STAGING}
+                                   MEM_UNCHARGED_STAGING, NET_NO_TIMEOUT}
     assert PRAGMA == "lint: allow-host-sync"
     assert WALL_PRAGMA == "lint: allow-wall-clock"
     assert MEM_PRAGMA == "lint: allow-uncharged-staging"
+    assert NET_PRAGMA == "lint: allow-no-timeout"

@@ -856,6 +856,256 @@ def test_exchange_runtime_stats_surfaced():
         w.close()
 
 
+# ---------------------------------------------------------------------------
+# fault-tolerant execution mode (retry-policy=task): durable spooled
+# exchange, task-granular retry, graceful decommission, query deadlines
+# ---------------------------------------------------------------------------
+
+_RETRY_SUFFIX_RX = None
+
+
+def _base_lineage(task_id):
+    import re
+    global _RETRY_SUFFIX_RX
+    if _RETRY_SUFFIX_RX is None:
+        _RETRY_SUFFIX_RX = re.compile(r"\.r\d+$")
+    return _RETRY_SUFFIX_RX.sub("", task_id)
+
+
+def test_chaos_task_retry_policy_retries_only_failed_task():
+    """Tentpole: under retry-policy=task a transient task failure retries
+    ONLY the failed lineage — ancestors' spooled output replays, so no
+    ancestor stage gets a .rN re-run — and rows stay oracle-exact."""
+    from presto_tpu.common.errors import InjectedTaskFailure
+    from presto_tpu.worker.coordinator import HttpQueryRunner
+    from presto_tpu.worker.server import WorkerServer
+    from presto_tpu.worker.spooling import SPOOL_METRICS
+
+    w1, w2 = WorkerServer(), WorkerServer()
+    flaked = []
+
+    def flaky_once(task_id):
+        if not flaked:
+            flaked.append(task_id)
+            raise InjectedTaskFailure(f"chaos: flaky task {task_id}")
+
+    w1.task_manager.fault_injector = flaky_once
+    w2.task_manager.fault_injector = flaky_once
+    SPOOL_METRICS.reset()
+    try:
+        r = HttpQueryRunner([w1.uri, w2.uri], "sf0.01", n_tasks=2,
+                            session={"retry_policy": "task"})
+        got = r.execute(CHAOS_SQL)
+        _assert_same(got, CHAOS_SQL)
+        assert len(flaked) == 1
+        assert r.tasks_retried >= 1
+        exe = r.last_execution
+        failed_lineage = _base_lineage(flaked[0])
+        # ONLY the failed lineage was charged against the attempt budget
+        assert dict(exe.budget_used) == {failed_lineage: 1}
+        # ...and every .rN attempt anywhere in the cluster belongs to it:
+        # no ancestor stage was restarted
+        retry_ids = [t.task_id for t in exe.all_tasks
+                     if _base_lineage(t.task_id) != t.task_id]
+        assert retry_ids, "no retry attempt was placed"
+        assert {_base_lineage(t) for t in retry_ids} == {failed_lineage}
+        # the durable spool actually carried stage output
+        snap = SPOOL_METRICS.snapshot()
+        assert snap["spooled_pages"] > 0 and snap["spooled_bytes"] > 0
+        assert _metric(w1.uri, "presto_tpu_spool_spooled_bytes_total") > 0
+    finally:
+        w1.close()
+        w2.close()
+
+
+def test_chaos_worker_killed_task_policy_no_ancestor_rerun():
+    """Tentpole acceptance: kill a worker mid-query under
+    retry-policy=task.  Recovery re-runs only the lineages that were
+    placed on the dead worker (their consumers redirect to the
+    replacements' spooled buffers) and the rows are oracle-exact."""
+    import threading
+    from presto_tpu.common.errors import InjectedTaskFailure
+    from presto_tpu.worker.coordinator import HttpQueryRunner
+    from presto_tpu.worker.server import WorkerServer
+
+    w1, w2, w3 = WorkerServer(), WorkerServer(), WorkerServer()
+    killed = threading.Event()
+
+    def kill_on_first_task(task_id):
+        if not killed.is_set():
+            killed.set()
+            threading.Thread(target=w2.close, daemon=True).start()
+            raise InjectedTaskFailure(
+                f"chaos: worker dying under task {task_id}")
+
+    w2.task_manager.fault_injector = kill_on_first_task
+    try:
+        r = HttpQueryRunner(
+            [w1.uri, w2.uri, w3.uri], "sf0.01", n_tasks=2,
+            session={"retry_policy": "task",
+                     "exchange_max_error_duration": "10s"})
+        got = r.execute(CHAOS_SQL)
+        _assert_same(got, CHAOS_SQL)
+        assert killed.is_set(), "chaos hook never fired"
+        assert r.tasks_retried >= 1
+        exe = r.last_execution
+        dead_lineages = {_base_lineage(t.task_id) for t in exe.all_tasks
+                         if t.worker_uri == w2.uri}
+        # every charged lineage and every .rN attempt traces back to a
+        # task that was on the dead worker: survivors never re-ran
+        assert set(exe.budget_used) <= dead_lineages
+        retried = {_base_lineage(t.task_id) for t in exe.all_tasks
+                   if _base_lineage(t.task_id) != t.task_id}
+        assert retried and retried <= dead_lineages
+        for t in exe.all_tasks:
+            if _base_lineage(t.task_id) != t.task_id:
+                assert t.worker_uri != w2.uri  # retries land on survivors
+    finally:
+        for w in (w1, w2, w3):
+            w.close()
+
+
+def test_chaos_graceful_drain_zero_failures():
+    """PUT /v1/info/state SHUTTING_DOWN on a worker while queries are in
+    flight: every query completes with oracle-exact rows (its spooled
+    output survives until consumed), the scheduler stops placing tasks on
+    the draining worker, and the process exits cleanly."""
+    import threading
+    import time
+    import urllib.request
+    from presto_tpu.worker.auth import outbound_headers
+    from presto_tpu.worker.coordinator import (HeartbeatFailureDetector,
+                                               HttpQueryRunner)
+    from presto_tpu.worker.server import WorkerServer
+
+    w1, w2, w3 = WorkerServer(), WorkerServer(), WorkerServer()
+    uris = [w1.uri, w2.uri, w3.uri]
+    det = HeartbeatFailureDetector(uris, interval_s=0.1)
+    session = {"retry_policy": "task"}
+    runners = [HttpQueryRunner(uris, "sf0.01", n_tasks=2,
+                               failure_detector=det, session=session)
+               for _ in range(2)]
+    results, errors = [], []
+
+    def run_one(runner):
+        try:
+            results.append(runner.execute(CHAOS_SQL))
+        except Exception as e:  # noqa: BLE001 — the test asserts on it
+            errors.append(e)
+
+    try:
+        # warm both runners so tasks have landed on every worker and the
+        # pipelines are compiled before the chaos window opens
+        for r in runners:
+            _assert_same(r.execute(CHAOS_SQL), CHAOS_SQL)
+        threads = [threading.Thread(target=run_one, args=(r,))
+                   for r in runners]
+        for t in threads:
+            t.start()
+        time.sleep(0.1)                    # queries are mid-flight
+        req = urllib.request.Request(
+            w3.uri + "/v1/info/state", data=b'"SHUTTING_DOWN"',
+            method="PUT", headers={"Content-Type": "application/json",
+                                   **outbound_headers()})
+        urllib.request.urlopen(req, timeout=5).close()
+        for t in threads:
+            t.join(timeout=120)
+        assert not any(t.is_alive() for t in threads)
+        assert not errors, errors          # zero query failures
+        assert len(results) == 2
+        for got in results:
+            _assert_same(got, CHAOS_SQL)
+        # the detector observes the drain and excludes w3 from placement
+        deadline = time.time() + 5
+        while time.time() < deadline and \
+                det.snapshot()[w3.uri]["draining"] is not True:
+            time.sleep(0.05)
+        assert det.snapshot()[w3.uri]["draining"] is True
+        created_before = w3.task_manager.counts()["created"]
+        _assert_same(runners[0].execute(CHAOS_SQL), CHAOS_SQL)
+        assert w3.task_manager.counts()["created"] == created_before, \
+            "draining worker was given new tasks"
+        # drained output is consumed, so the server exits on its own
+        deadline = time.time() + 45
+        while time.time() < deadline and not w3._closed:
+            time.sleep(0.2)
+        assert w3._closed, "graceful drain never completed"
+    finally:
+        det.close()
+        for w in (w1, w2, w3):
+            w.close()
+
+
+def test_chaos_query_deadline_typed_error_no_retry():
+    """query.max-execution-time mints a typed, NON-retryable
+    EXCEEDED_TIME_LIMIT user error at the coordinator: no task retry is
+    attempted anywhere and the failure surfaces promptly."""
+    import time
+    from presto_tpu.common.errors import (PrestoUserError,
+                                          QueryDeadlineExceededError)
+    from presto_tpu.worker.coordinator import HttpQueryRunner
+    from presto_tpu.worker.server import WorkerServer
+
+    w = WorkerServer()
+    try:
+        r = HttpQueryRunner(
+            [w.uri], "sf0.01", n_tasks=2,
+            session={"query_max_execution_time": "50ms"})
+        t0 = time.monotonic()
+        with pytest.raises(QueryDeadlineExceededError,
+                           match="EXCEEDED_TIME_LIMIT"):
+            r.execute(CHAOS_SQL)
+        elapsed = time.monotonic() - t0
+        assert elapsed < 15.0, elapsed     # enforced, not TTL'd out
+        assert r.tasks_retried == 0
+        assert w.task_manager.tasks_retried == 0
+        # typed USER_ERROR: the classifier must never call this retryable
+        from presto_tpu.common.errors import is_retryable
+        assert issubclass(QueryDeadlineExceededError, PrestoUserError)
+        assert not is_retryable(QueryDeadlineExceededError(1.0, 0.05))
+    finally:
+        w.close()
+
+
+def test_chaos_poison_split_quarantined():
+    """A split that fails with the SAME internal error signature on two
+    distinct workers is poison: the query fails fast with the split
+    identity in the typed error instead of burning the whole attempt
+    budget re-running a crasher."""
+    from presto_tpu.common.errors import (InjectedTaskFailure,
+                                          PoisonSplitError)
+    from presto_tpu.worker.coordinator import HttpQueryRunner
+    from presto_tpu.worker.server import WorkerServer
+
+    w1, w2 = WorkerServer(), WorkerServer()
+    target = []
+
+    def poison(task_id):
+        base = _base_lineage(task_id)
+        if not target:
+            target.append(base)
+        if base == target[0]:
+            raise InjectedTaskFailure("chaos: poison split crash")
+
+    w1.task_manager.fault_injector = poison
+    w2.task_manager.fault_injector = poison
+    try:
+        r = HttpQueryRunner(
+            [w1.uri, w2.uri], "sf0.01", n_tasks=2,
+            session={"remote_task_retry_attempts": "4"})
+        with pytest.raises(PoisonSplitError, match="POISON_SPLIT") as ei:
+            r.execute(CHAOS_SQL)
+        # the split identity is in the message, and quarantine fired well
+        # inside the 4-attempt budget (one charge, then two distinct
+        # workers had seen the signature)
+        assert target[0] in str(ei.value)
+        exe = r.last_execution
+        assert exe.budget_used.get(target[0], 0) <= 2
+    finally:
+        w1.close()
+        w2.close()
+
+
 def test_producer_coalesces_small_pages_per_response():
     """Producer-side exchange.max-response-size: many tiny pages come back
     in few coalesced pull rounds, but an X-Presto-Max-Size cap well below
