@@ -1,0 +1,344 @@
+"""Rank-ordered lock wrappers with a dev-mode validation harness.
+
+The worker has grown six thread families (exchange pullers, spill
+staging, telemetry flush, the heartbeat failure detector, the task
+reaper, spool flush callbacks) whose locks nest: an arbitration pass
+walks revoke callbacks into buffer conditions into the memory pool; a
+task eviction walks the task-manager lock into buffer destruction.
+The classic way such a graph deadlocks is an UNDECLARED edge — two
+subsystems each correct in isolation, acquired in opposite orders by
+two threads.
+
+`OrderedLock` / `OrderedCondition` make the order DECLARED: every lock
+carries a rank, and the process-wide rank map (documented in the
+README's static-analysis section) is the one sanctioned acquisition
+order — a thread may only acquire ranks strictly greater than any it
+already holds.  The discipline is free in production: when validation
+is off, acquire/release delegate straight to the underlying primitive.
+Under `debug.lock-validation=on` (worker property, or the
+`lock_validation` session override) every acquisition is checked
+against the calling thread's held stack, a rank inversion raises a
+typed `LockOrderError` at the exact acquisition site (instead of a
+silent deadlock hours later), and hold time / contention are metered
+into `LOCK_METRICS` — surfaced at /v1/metrics as `presto_tpu_lock_*`
+so a chaos run doubles as a lock-discipline check.
+
+The static half lives in `analysis/concurrency.py`: LOCK004 extracts
+the nested-`with` lock-order graph from source and fails CI on a cycle
+or a rank-inverting edge, so most inversions never reach runtime.
+
+Rank map (gaps left for future subsystems; reentrant locks noted):
+
+    10  dispatch-manager        worker/statement.py DispatchManager
+    12  resource-groups         worker/statement.py ResourceGroupManager
+    14  task-manager            worker/task.py      TaskManager
+    16  task-state              worker/task.py      TpuTask (condition)
+    18  exchange-client         worker/exchange.py  ExchangeClient (cond)
+    20  memory-arbitrator       exec/memory.py      MemoryPool._arb_lock
+    30  output-buffer           worker/buffers.py   PageBuffer (condition)
+    32  task-spool              worker/spooling.py  TaskSpool (reentrant)
+    40  memory-pool             exec/memory.py      MemoryPool (reentrant)
+    50  serving-cache           serving/cache.py    PlanCache
+    60  query-history           telemetry/history.py QueryHistoryStore
+    70  telemetry-exporter      telemetry/export.py TelemetryExporter
+    72  telemetry-idle          telemetry/export.py TelemetryExporter._idle
+    74  telemetry-sink          telemetry/export.py Collector/Jsonl sinks
+    80  failure-detector        worker/coordinator.py HeartbeatFailureDetector
+    82  status-watcher          worker/coordinator.py _StatusWatcher
+    100 metrics-registry        every process-wide metrics singleton (leaf)
+
+`LOCK_METRICS` itself uses a raw `threading.Lock` and is never wrapped:
+the meter must not recurse into itself.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional, Tuple
+
+__all__ = [
+    "LockOrderError", "OrderedLock", "OrderedCondition", "LOCK_METRICS",
+    "LockMetrics", "set_validation", "validation_enabled",
+    "validation_scope",
+]
+
+
+class LockOrderError(RuntimeError):
+    """A thread acquired a lower- or equal-ranked lock while holding a
+    higher one: the declared acquisition order was inverted.  Raised at
+    the acquisition site (under debug.lock-validation=on) instead of
+    letting the inversion mature into a silent cross-thread deadlock.
+    Classified INTERNAL_ERROR by common/errors.py — a lock inversion is
+    a worker bug, never the user's query."""
+
+    error_type = "INTERNAL_ERROR"
+    error_code = "LOCK_ORDER_VIOLATION"
+
+    def __init__(self, acquiring: "OrderedLock", holding: "OrderedLock"):
+        super().__init__(
+            f"[INTERNAL_ERROR] LOCK_ORDER_VIOLATION: acquiring "
+            f"'{acquiring.name}' (rank {acquiring.rank}) while holding "
+            f"'{holding.name}' (rank {holding.rank}); ranks must be "
+            f"strictly increasing along any acquisition chain")
+        self.acquiring = acquiring.name
+        self.holding = holding.name
+
+
+class LockMetrics:
+    """Process-wide lock validation counters (the /v1/metrics
+    presto_tpu_lock_* section, same singleton shape as SpoolMetrics).
+    Raw threading.Lock on purpose: the meter is below every rank and
+    must never recurse into the ordered-lock machinery it measures."""
+
+    _COUNTERS = ("acquisitions", "contended", "contention_wall_s",
+                 "hold_wall_s", "violations")
+    _GAUGES = ()
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.reset()
+
+    def reset(self) -> None:
+        with self._lock:  # lint: guarded-by(_lock)
+            for name in self._COUNTERS + self._GAUGES:
+                setattr(self, name, 0)
+
+    def incr(self, name: str, delta=1) -> None:
+        with self._lock:
+            setattr(self, name, getattr(self, name) + delta)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {name: getattr(self, name)
+                    for name in self._COUNTERS + self._GAUGES}
+
+
+LOCK_METRICS = LockMetrics()
+
+
+# ---------------------------------------------------------------------------
+# validation switch: a process-global base flag (worker property) plus a
+# COUNTING scope overlay (session override) so concurrent tasks compose —
+# the flag is process-global rather than thread-local because the locks
+# it validates are shared across threads.
+# ---------------------------------------------------------------------------
+
+_STATE_LOCK = threading.Lock()
+_BASE_ON = False
+_SCOPES = 0
+# Derived fast-path flag; reads are racy-but-atomic by design: a toggle
+# concurrent with an acquisition may miss validating that one acquisition,
+# which is fine for a dev-mode tripwire.
+_ENABLED = False
+
+
+def _recompute_locked() -> None:
+    global _ENABLED
+    _ENABLED = _BASE_ON or _SCOPES > 0
+
+
+def set_validation(on: bool) -> None:
+    """Set the process base flag (the `debug.lock-validation` worker
+    property).  Scoped session overrides stack on top of it."""
+    global _BASE_ON
+    with _STATE_LOCK:
+        _BASE_ON = bool(on)
+        _recompute_locked()
+
+
+def validation_enabled() -> bool:
+    return _ENABLED
+
+
+class _ValidationScope:
+    """Counting context manager: validation stays on while ANY scope is
+    live, so two concurrent tasks with the session override don't turn
+    each other's checking off on exit."""
+
+    def __enter__(self):
+        global _SCOPES
+        with _STATE_LOCK:
+            _SCOPES += 1
+            _recompute_locked()
+        return self
+
+    def __exit__(self, *exc):
+        global _SCOPES
+        with _STATE_LOCK:
+            _SCOPES = max(0, _SCOPES - 1)
+            _recompute_locked()
+        return False
+
+
+def validation_scope() -> _ValidationScope:
+    """Session-scoped enable (the `lock_validation` session property):
+    `with validation_scope(): ...` validates for the duration."""
+    return _ValidationScope()
+
+
+# per-thread stack of (lock, t_acquired) in acquisition order
+_TLS = threading.local()
+
+
+def _held() -> List[Tuple["OrderedLock", float]]:
+    stack = getattr(_TLS, "stack", None)
+    if stack is None:
+        stack = _TLS.stack = []
+    return stack
+
+
+class OrderedLock:
+    """A named, ranked mutex.
+
+    Pass-through when validation is off: `acquire`/`release` delegate
+    straight to the wrapped `threading.Lock` (or `RLock` when
+    `reentrant=True`) with no bookkeeping.  Under validation each
+    acquisition is checked against the calling thread's held stack —
+    acquiring rank r while holding rank >= r raises `LockOrderError`
+    (reentrant re-acquisition of the SAME lock is exempt) — and
+    contention + hold walls are metered into LOCK_METRICS.
+
+    Implements the `_is_owned` / `_release_save` / `_acquire_restore`
+    protocol so `OrderedCondition` (and `threading.Condition`) can wrap
+    it directly.
+    """
+
+    def __init__(self, name: str, rank: int, reentrant: bool = False):
+        self.name = name
+        self.rank = int(rank)
+        self.reentrant = bool(reentrant)
+        self._lock = threading.RLock() if reentrant else threading.Lock()
+
+    def __repr__(self) -> str:
+        return f"OrderedLock({self.name!r}, rank={self.rank})"
+
+    # -- validation bookkeeping --------------------------------------------
+    def _check_order_and_mark(self) -> None:
+        """Rank check BEFORE touching the underlying lock, so a raise
+        leaves no state behind."""
+        stack = _held()
+        if any(entry[0] is self for entry in stack):
+            if self.reentrant:
+                return          # same-lock re-acquisition: always legal
+            LOCK_METRICS.incr("violations")
+            raise LockOrderError(self, self)
+        if stack:
+            top = max(stack, key=lambda e: e[0].rank)[0]
+            if top.rank >= self.rank:
+                LOCK_METRICS.incr("violations")
+                raise LockOrderError(self, top)
+
+    def _push(self) -> None:
+        _held().append((self, time.perf_counter()))
+
+    def _pop(self) -> Optional[float]:
+        """Pop this lock's most recent stack entry; None if absent
+        (acquired while validation was off)."""
+        stack = getattr(_TLS, "stack", None)
+        if not stack:
+            return None
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i][0] is self:
+                return stack.pop(i)[1]
+        return None
+
+    # -- lock protocol -------------------------------------------------------
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        if not _ENABLED:
+            return self._lock.acquire(blocking, timeout)
+        self._check_order_and_mark()
+        got = self._lock.acquire(False)
+        if not got:
+            if not blocking:
+                return False
+            LOCK_METRICS.incr("contended")
+            t0 = time.perf_counter()
+            got = self._lock.acquire(True, timeout)
+            LOCK_METRICS.incr("contention_wall_s",
+                              time.perf_counter() - t0)
+            if not got:
+                return False
+        LOCK_METRICS.incr("acquisitions")
+        self._push()
+        return True
+
+    def release(self) -> None:
+        # Always reconcile the held stack (a leaked entry from an
+        # acquire made while validation was on must not pin the stack
+        # after a mid-flight toggle); the scan is bounded by held-lock
+        # depth, which is single digits.
+        t0 = self._pop()
+        if t0 is not None:
+            LOCK_METRICS.incr("hold_wall_s", time.perf_counter() - t0)
+        self._lock.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def locked(self) -> bool:
+        inner = getattr(self._lock, "locked", None)
+        if inner is not None:
+            return bool(inner())
+        # RLock grows .locked() only in 3.14; _is_owned covers the
+        # common "am I inside my own with-block" probe before that
+        owned = getattr(self._lock, "_is_owned", None)
+        return bool(owned()) if owned is not None else False
+
+    # -- condition-variable protocol (threading.Condition delegation) -------
+    def _is_owned(self) -> bool:
+        inner = getattr(self._lock, "_is_owned", None)
+        if inner is not None:
+            return inner()
+        if self._lock.acquire(False):
+            self._lock.release()
+            return False
+        return True
+
+    def _release_save(self):
+        # A wait() releases the lock: drop the held-stack entry so locks
+        # taken while waiting are checked against the true held set.
+        t0 = self._pop()
+        if t0 is not None:
+            LOCK_METRICS.incr("hold_wall_s", time.perf_counter() - t0)
+        inner = getattr(self._lock, "_release_save", None)
+        if inner is not None:
+            return inner()
+        self._lock.release()
+        return None
+
+    def _acquire_restore(self, state) -> None:
+        # Re-entry after a wait(): the rank was already validated at the
+        # original acquisition, so restore without re-checking (waking
+        # while a sibling thread holds an unrelated lock is not an
+        # inversion by THIS thread).
+        inner = getattr(self._lock, "_acquire_restore", None)
+        if inner is not None:
+            inner(state)
+        else:
+            self._lock.acquire()
+        if _ENABLED:
+            self._push()
+
+
+class OrderedCondition(threading.Condition):
+    """`threading.Condition` over an `OrderedLock`: `with cond:` obeys
+    the rank discipline and `wait()` correctly drops/restores the held
+    stack entry through the `_release_save`/`_acquire_restore` hooks.
+    Reentrant by default, matching `threading.Condition()`'s RLock."""
+
+    def __init__(self, name: str, rank: int, reentrant: bool = True):
+        self.ordered_lock = OrderedLock(name, rank, reentrant=reentrant)
+        super().__init__(self.ordered_lock)
+
+    @property
+    def name(self) -> str:
+        return self.ordered_lock.name
+
+    @property
+    def rank(self) -> int:
+        return self.ordered_lock.rank

@@ -46,6 +46,7 @@ from typing import Callable, Dict, Iterator, List, Optional, Tuple
 import jax.numpy as jnp
 import numpy as np
 
+from ..common.locks import OrderedLock
 from .batch import Batch, Column
 from . import operators as ops
 
@@ -94,7 +95,9 @@ class MemoryMetrics:
     _GAUGES = ("reserved_bytes", "revocable_bytes")
 
     def __init__(self):
-        self._lock = threading.Lock()
+        # rank 100: metrics registries are LEAF locks, bumped from every
+        # thread family while any other lock may be held
+        self._lock = OrderedLock("metrics:memory", 100)  # lint: guarded-by(_lock)
         self.reset()
 
     def reset(self) -> None:
@@ -207,11 +210,15 @@ class MemoryPool:
         self.spilled_bytes = 0        # host-staged by stores under this pool
         self.disk_spilled_bytes = 0   # overflowed from host RAM to disk
         self.unspilled_bytes = 0      # read back for bucket processing
-        self._lock = threading.RLock()
+        # reentrant: MemoryContext composes multi-step updates under it
+        self._lock = OrderedLock(
+            "memory-pool", 40, reentrant=True)  # lint: guarded-by(_lock)
         # one arbitration pass at a time: revoke callbacks run OUTSIDE the
         # accounting lock (they free into it) but inside this one, so two
-        # starved threads do not revoke the same victim twice
-        self._arb_lock = threading.Lock()
+        # starved threads do not revoke the same victim twice.  Rank 20 <
+        # buffer/spool/pool: the arbitrator is the OUTERMOST lock of the
+        # revocation chain.
+        self._arb_lock = OrderedLock("memory-arbitrator", 20)
         self._holders: List[RevocableHolder] = []
 
     # -- reservation ------------------------------------------------------
@@ -295,7 +302,8 @@ class MemoryPool:
         its spill callback), retry the reservation, repeat until it fits
         or nothing revocable remains.  Never blocks on a holder: one that
         declines (returns 0) is skipped for this pass."""
-        self.arbitrations += 1
+        with self._lock:
+            self.arbitrations += 1
         MEMORY_METRICS.incr("arbitrations")
         declined: set = set()
         with self._arb_lock:
@@ -315,8 +323,9 @@ class MemoryPool:
                 if freed <= 0:
                     declined.add(id(victim))
                 else:
-                    self.revocations += 1
-                    self.revoked_bytes += freed
+                    with self._lock:
+                        self.revocations += 1
+                        self.revoked_bytes += freed
                     MEMORY_METRICS.incr("revocations")
                     MEMORY_METRICS.incr("revoked_bytes", freed)
 
@@ -509,6 +518,10 @@ _SPILL_SALT = 0x511
 # only add host-RAM pressure without more overlap
 _STAGING_DEPTH = 2
 _STAGING_STOP = object()
+# every wait in the staging drain path is bounded so a wedged staging
+# thread can never hang a query abort or a worker decommission
+_STAGING_POLL_S = 0.5
+_STAGING_DRAIN_TIMEOUT_S = 60.0
 
 
 def _np_to_block_view(v: np.ndarray):
@@ -599,7 +612,12 @@ class PartitionedSpillStore:
 
     def _staging_loop(self) -> None:
         while True:
-            item = self._q.get()
+            try:
+                # bounded pull: the loop re-checks rather than parking
+                # forever, so a lost stop token can't wedge the thread
+                item = self._q.get(timeout=_STAGING_POLL_S)
+            except queue_mod.Empty:
+                continue
             if item is _STAGING_STOP:
                 self._q.task_done()
                 return
@@ -626,12 +644,20 @@ class PartitionedSpillStore:
         if self._thread is not None:
             t0 = time.perf_counter()  # lint: allow-wall-clock
             self._q.put(_STAGING_STOP)
-            self._q.join()
-            self._thread.join()
+            # the stop token is staged FIFO behind every queued chunk, so
+            # thread exit implies all prior items finished; join with a
+            # bound (NOT q.join(), which has no timeout) so a wedged
+            # staging thread fails the query instead of hanging drain
+            self._thread.join(timeout=_STAGING_DRAIN_TIMEOUT_S)
+            wedged = self._thread.is_alive()
             self._wait_wall += \
                 time.perf_counter() - t0  # lint: allow-wall-clock
             self._thread = None
             self._q = None
+            if wedged and self._stage_err is None:
+                self._stage_err = RuntimeError(
+                    f"spill staging thread failed to drain within "
+                    f"{_STAGING_DRAIN_TIMEOUT_S}s")
         self._raise_staging_error()
         self._report_staging()
 

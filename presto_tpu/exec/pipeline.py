@@ -270,6 +270,12 @@ class ExecutionConfig:
     # optimizer-rule firing; "off" disables.  Violations raise the
     # non-retryable PLAN_VALIDATION error
     plan_validation: str = "on"
+    # runtime lock-order validation (common/locks.py, the dynamic half of
+    # analysis/concurrency.py): task driver threads record per-thread
+    # acquisition stacks, raise LockOrderError on rank inversion, and
+    # meter hold/contention into /v1/metrics presto_tpu_lock_*.  Worker
+    # property debug.lock-validation; session key lock_validation
+    lock_validation: bool = False
     # -- HBM-resident columnar storage (presto_tpu/storage) ---------------
     # scans materialize device-generated columns once per process into an
     # encoded resident cache with zone maps; False = regenerate per chunk

@@ -25,8 +25,9 @@ runtime selection, and validation cannot drift.
 """
 from __future__ import annotations
 
-import threading
 from typing import Optional, Tuple
+
+from ..common.locks import OrderedLock
 
 FABRIC_AUTO = "auto"
 FABRIC_HTTP = "http"
@@ -96,7 +97,8 @@ class FabricMetrics:
                "fallbacks")
 
     def __init__(self):
-        self._lock = threading.Lock()
+        # rank 100: metrics registries are leaf locks
+        self._lock = OrderedLock("metrics:fabric", 100)  # lint: guarded-by(_lock)
         self.reset()
 
     def reset(self) -> None:
@@ -179,7 +181,7 @@ class IciChunkTuner:
     DEFAULT_ROWS = 1 << 12
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = OrderedLock("metrics:ici-tuner", 100)  # lint: guarded-by(_lock)
         self._rows = self.DEFAULT_ROWS
 
     def chunk_rows(self) -> int:

@@ -22,10 +22,10 @@ the key.  Eviction is LRU by last checkout/insert.
 """
 from __future__ import annotations
 
-import threading
 from collections import OrderedDict
 from typing import List, Optional, Tuple
 
+from ..common.locks import OrderedLock
 from .metrics import SERVING_METRICS
 
 DEFAULT_PLAN_CACHE_ENTRIES = 128
@@ -43,7 +43,9 @@ class _Entry:
 
 class PlanCache:
     def __init__(self, max_entries: int = DEFAULT_PLAN_CACHE_ENTRIES):
-        self._lock = threading.Lock()
+        # rank 50: SERVING_METRICS (a rank-100 registry) is bumped while
+        # this is held; nothing engine-side nests inside it
+        self._lock = OrderedLock("serving-cache", 50)  # lint: guarded-by(_lock)
         self._entries: "OrderedDict[str, _Entry]" = OrderedDict()
         self.max_entries = max_entries
         self.hits = 0

@@ -9,12 +9,13 @@ acceptance gate asserts it does NOT move on a warm repeated shape).
 """
 from __future__ import annotations
 
-import threading
+from ..common.locks import OrderedLock
 
 
 class ServingMetrics:
     def __init__(self):
-        self._lock = threading.Lock()
+        # rank 100: metrics registries are leaf locks
+        self._lock = OrderedLock("metrics:serving", 100)  # lint: guarded-by(_lock)
         self.reset()
 
     def reset(self) -> None:

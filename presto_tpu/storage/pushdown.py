@@ -242,6 +242,6 @@ def prune_chunks(chunks: List[Tuple[int, int]], zone_maps: Dict,
     if not kept and chunks:
         kept = [chunks[0]]
     skipped = len(chunks) - len(kept)
-    STORAGE_METRICS["chunks_total"] += len(chunks)
-    STORAGE_METRICS["chunks_skipped"] += skipped
+    STORAGE_METRICS.incr("chunks_total", len(chunks))
+    STORAGE_METRICS.incr("chunks_skipped", skipped)
     return kept, skipped

@@ -33,6 +33,7 @@ from typing import Dict, Optional
 import jax
 import jax.numpy as jnp
 
+from ..common.locks import OrderedLock
 from ..exec.memory import MemoryPool
 from .encodings import (ResidentColumn, ZoneMaps, build_zone_maps,
                         encode_column)
@@ -52,19 +53,69 @@ HOST_STATS_ROWS = 1 << 20
 # chunks_total/chunks_skipped are bumped by pushdown.prune_chunks every
 # time a chunk list is enumerated, so the skip FRACTION stays exact even
 # though repeated enumerations inflate both counters proportionally
-STORAGE_METRICS: Dict[str, int] = {}
+_STORAGE_COUNTERS = ("cache_hits", "cache_misses", "columns_built",
+                     "build_rejected", "evictions", "resident_bytes",
+                     "encoded_bytes", "plain_bytes",
+                     "chunks_total", "chunks_skipped")
+
+
+class StorageMetrics:
+    """Locked storage-counter registry.  Replaces the bare module dict:
+    concurrent scan threads bumping `d[k] += 1` lose increments, and
+    /v1/metrics could read a half-updated view mid-build.  Keeps the
+    dict-like read surface (`m[k]`, `sorted(m)`, `dict(m)`, `.items()`)
+    the existing consumers and tests use."""
+
+    def __init__(self):
+        # rank 100: metrics registries are leaf locks
+        self._lock = OrderedLock("metrics:storage", 100)  # lint: guarded-by(_lock)
+        self._values: Dict[str, int] = {k: 0 for k in _STORAGE_COUNTERS}
+
+    def reset(self) -> None:
+        with self._lock:
+            for k in _STORAGE_COUNTERS:
+                self._values[k] = 0
+
+    def incr(self, name: str, delta: int = 1) -> None:
+        with self._lock:
+            self._values[name] += delta
+
+    def __getitem__(self, name: str) -> int:
+        with self._lock:
+            return self._values[name]
+
+    def __setitem__(self, name: str, value: int) -> None:
+        with self._lock:
+            self._values[name] = value
+
+    def __contains__(self, name: str) -> bool:
+        with self._lock:
+            return name in self._values
+
+    def __iter__(self):
+        return iter(self.keys())
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._values)
+
+    def keys(self):
+        with self._lock:
+            return list(self._values)
+
+    def items(self):
+        return self.snapshot().items()
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._values)
+
+
+STORAGE_METRICS = StorageMetrics()
 
 
 def reset_storage_metrics() -> None:
-    STORAGE_METRICS.update({
-        "cache_hits": 0, "cache_misses": 0, "columns_built": 0,
-        "build_rejected": 0, "evictions": 0, "resident_bytes": 0,
-        "encoded_bytes": 0, "plain_bytes": 0,
-        "chunks_total": 0, "chunks_skipped": 0,
-    })
-
-
-reset_storage_metrics()
+    STORAGE_METRICS.reset()
 
 
 class ResidentEntry:
@@ -100,15 +151,15 @@ class ResidentStore:
         if ent is not None:
             if ent.pad >= pad:
                 self.entries.move_to_end(key)
-                STORAGE_METRICS["cache_hits"] += 1
+                STORAGE_METRICS.incr("cache_hits")
                 return ent
             # built under a smaller batch capacity: rebuild with the
             # larger tail padding (chunk slices must never clamp)
             self._evict(key)
-        STORAGE_METRICS["cache_misses"] += 1
+        STORAGE_METRICS.incr("cache_misses")
         itemsize = 4 if as_i32 else 8
         if (n_rows + pad) * itemsize > self.max_column_bytes:
-            STORAGE_METRICS["build_rejected"] += 1
+            STORAGE_METRICS.incr("build_rejected")
             return None
         arr = _build_full(cid, table, colname, sf, n_rows, pad, as_i32)
         from ..connectors import device_gen
@@ -127,21 +178,21 @@ class ResidentStore:
         ent = ResidentEntry(col, zones, pad)
         while not self.pool.try_reserve(ent.nbytes):
             if not self.entries:
-                STORAGE_METRICS["build_rejected"] += 1
+                STORAGE_METRICS.incr("build_rejected")
                 return None
             oldest = next(iter(self.entries))
             self._evict(oldest)
         self.entries[key] = ent
-        STORAGE_METRICS["columns_built"] += 1
-        STORAGE_METRICS["encoded_bytes"] += ent.nbytes
-        STORAGE_METRICS["plain_bytes"] += col.logical_nbytes
+        STORAGE_METRICS.incr("columns_built")
+        STORAGE_METRICS.incr("encoded_bytes", ent.nbytes)
+        STORAGE_METRICS.incr("plain_bytes", col.logical_nbytes)
         STORAGE_METRICS["resident_bytes"] = self.pool.reserved
         return ent
 
     def _evict(self, key: tuple) -> None:
         ent = self.entries.pop(key)
         self.pool.free(ent.nbytes)
-        STORAGE_METRICS["evictions"] += 1
+        STORAGE_METRICS.incr("evictions")
         STORAGE_METRICS["resident_bytes"] = self.pool.reserved
 
     def clear(self) -> None:

@@ -25,6 +25,7 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional
 
+from ..common.locks import OrderedCondition, OrderedLock
 from .otlp import (metrics_to_resource_metrics, scrape_metric_points,
                    spans_to_resource_spans)
 
@@ -50,8 +51,9 @@ class CollectorSink(TelemetrySink):
     which spans, which metric names)."""
 
     def __init__(self):
+        # rank 74: sink locks are taken by the flush thread holding nothing
+        self._lock = OrderedLock("telemetry-sink", 74)  # lint: guarded-by(_lock)
         self.payloads: List[dict] = []
-        self._lock = threading.Lock()
 
     def export(self, payload: dict) -> None:
         with self._lock:
@@ -87,7 +89,7 @@ class JsonlFileSink(TelemetrySink):
 
     def __init__(self, path: str):
         self.path = path
-        self._lock = threading.Lock()
+        self._lock = OrderedLock("telemetry-sink", 74)  # lint: guarded-by(_lock)
 
     def export(self, payload: dict) -> None:
         line = json.dumps(payload, default=str)
@@ -165,18 +167,20 @@ class TelemetryExporter:
         self.resource = dict(resource or {})
         # counters (exported via counters() into /v1/metrics)
         self._clock = 0
-        self.enqueued = 0
-        self.exported = 0
-        self.dropped = 0            # queue full: payload never entered
-        self.dropped_after_retry = 0  # sink dead past the error budget
-        self.retries = 0
-        self.export_errors = 0
-        self.flushes = 0
-        self._lock = threading.Lock()
+        self.enqueued = 0                # lint: guarded-by(_lock)
+        self.exported = 0                # lint: guarded-by(_lock)
+        self.dropped = 0                 # lint: guarded-by(_lock)
+        self.dropped_after_retry = 0     # lint: guarded-by(_lock)
+        self.retries = 0                 # lint: guarded-by(_lock)
+        self.export_errors = 0           # lint: guarded-by(_lock)
+        self.flushes = 0                 # lint: guarded-by(_lock)
+        # rank 70: counter lock; the idle condition (72) is never held
+        # while taking it, and neither nests into engine locks
+        self._lock = OrderedLock("telemetry-exporter", 70)
         self._stop = threading.Event()
         self._wake = threading.Event()
-        self._idle = threading.Condition()
-        self._in_flight = 0
+        self._idle = OrderedCondition("telemetry-idle", 72)
+        self._in_flight = 0              # lint: guarded-by(_idle)
         self._thread = threading.Thread(
             target=self._flush_loop, name="telemetry-flush", daemon=True)
         self._thread.start()

@@ -13,11 +13,11 @@ from __future__ import annotations
 
 import json
 import os
-import threading
 import time
 from collections import OrderedDict
 from typing import Dict, List, Optional
 
+from ..common.locks import OrderedLock
 from ..worker.events import EventListener
 
 
@@ -34,7 +34,8 @@ class QueryHistoryStore:
         self.max_count = max_count
         self.max_age_s = max_age_s
         self._clock = clock
-        self._lock = threading.Lock()
+        # rank 60: held across the spool file I/O, never nests deeper
+        self._lock = OrderedLock("query-history", 60)  # lint: guarded-by(_lock)
         self._entries: "OrderedDict[str, dict]" = OrderedDict()
         self._appended_since_compact = 0
         self.loaded = 0          # records reloaded from the spool
@@ -49,23 +50,26 @@ class QueryHistoryStore:
     def _load(self) -> None:
         if not os.path.exists(self.path):
             return
-        with open(self.path) as f:
-            for line in f:
-                line = line.strip()
-                if not line:
-                    continue
-                try:
-                    rec = json.loads(line)
-                    qid = rec["queryId"]
-                except Exception:
-                    self.load_errors += 1
-                    continue
-                # later lines win: a re-recorded query id supersedes
-                self._entries.pop(qid, None)
-                self._entries[qid] = rec
-                self.loaded += 1
-        self._evict_locked()
-        self._compact_locked()
+        # locked even though only __init__ calls it: subclasses / reload
+        # paths must not mutate _entries while readers hold the lock
+        with self._lock:
+            with open(self.path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                        qid = rec["queryId"]
+                    except Exception:
+                        self.load_errors += 1
+                        continue
+                    # later lines win: a re-recorded id supersedes
+                    self._entries.pop(qid, None)
+                    self._entries[qid] = rec
+                    self.loaded += 1
+            self._evict_locked()
+            self._compact_locked()
 
     def _compact_locked(self) -> None:
         if not self.path:

@@ -13,10 +13,10 @@ from __future__ import annotations
 import os
 import struct
 import tempfile
-import threading
 from typing import List, Optional, Tuple
 
 from ..common.compression import compress, decompress
+from ..common.locks import OrderedCondition
 
 
 DEFAULT_MAX_BUFFERED_BYTES = 64 << 20
@@ -88,7 +88,11 @@ class PageBuffer:
         self._complete = False
         self._destroyed = False
         self._error: Optional[str] = None
-        self._cond = threading.Condition()
+        # rank 30: nests INTO the task spool (32) on _store_locked and
+        # the memory pool (40) on the retained-page charge; acquired
+        # UNDER the arbitrator (20) in _revoke
+        self._cond = OrderedCondition(
+            "output-buffer", 30)  # lint: guarded-by(_cond)
 
     def _store_locked(self, data: bytes) -> None:
         if self._spool is not None:

@@ -33,6 +33,7 @@ import urllib.error
 import urllib.request
 from typing import Dict, List, Optional, Set, Tuple
 
+from ..common.locks import OrderedLock
 from ..common.errors import (INTERNAL_ERROR, PrestoQueryError,
                              PrestoUserError, ExchangeLostError,
                              PoisonSplitError, QueryDeadlineExceededError,
@@ -103,7 +104,8 @@ class HeartbeatFailureDetector:
         now = time.monotonic()
         self._last_seen = {u: now for u in self.worker_uris}
         self._draining = set()
-        self._lock = threading.Lock()
+        # rank 80: prober bookkeeping only — never nests into engine locks
+        self._lock = OrderedLock("failure-detector", 80)  # lint: guarded-by(_lock)
         self._stop = threading.Event()
         # one prober per worker: a hung node must not delay detection of
         # the others (the reference probes asynchronously per service)
@@ -295,7 +297,8 @@ class _StatusWatcher:
                  interval_s: float = 0.15):
         self._exec = execution
         self._stop = threading.Event()
-        self._lock = threading.Lock()
+        # rank 82: event-list lock, leaf-like (only above the registries)
+        self._lock = OrderedLock("status-watcher", 82)  # lint: guarded-by(_lock)
         self._events: List[dict] = []
         self._streaks: Dict[str, int] = {}
         self._done: Set[str] = set()
@@ -346,7 +349,8 @@ class _StatusWatcher:
                         ValueError):
                     self._bump_streak(task)
                 else:
-                    self._streaks[task.worker_uri] = 0
+                    with self._lock:
+                        self._streaks[task.worker_uri] = 0
                     if st.state == FAILED:
                         msg = st.failures[0] if st.failures else "unknown"
                         self._emit(kind="failed", task_id=task.task_id,
@@ -357,8 +361,9 @@ class _StatusWatcher:
             self._stop.wait(interval_s)
 
     def _bump_streak(self, task: RemoteTask) -> None:
-        n = self._streaks.get(task.worker_uri, 0) + 1
-        self._streaks[task.worker_uri] = n
+        with self._lock:
+            n = self._streaks.get(task.worker_uri, 0) + 1
+            self._streaks[task.worker_uri] = n
         if n >= self.TRANSPORT_STREAK:
             self._emit(kind="worker_lost", task_id=task.task_id,
                        worker_uri=task.worker_uri,

@@ -48,6 +48,7 @@ from typing import Callable, Dict, Iterator, List, Optional
 
 from ..common.errors import (ExchangeLostError, RemoteTaskError,
                              is_retryable_type, parse_error_type)
+from ..common.locks import OrderedCondition, OrderedLock
 from ..common.page import Page
 from ..common.serde import DEFAULT_CODEC, deserialize_page, deserialize_pages
 
@@ -114,7 +115,8 @@ class ExchangeMetrics:
     its peak proves backpressure actually bounded resident bytes."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        # rank 100: metrics registries are leaf locks
+        self._lock = OrderedLock("metrics:exchange", 100)  # lint: guarded-by(_lock)
         self.reset()
 
     def reset(self) -> None:
@@ -364,7 +366,10 @@ class ExchangeClient:
         self._max_buffer = max(1, int(max_buffer_bytes))
         self._max_response = int(max_response_bytes) or None
         self._stats = stats               # utils.runtime_stats.RuntimeStats
-        self._cond = threading.Condition()
+        # rank 18: the exchange buffer lock nests only into the metrics
+        # leaves; pullers and the consumer hold nothing above it
+        self._cond = OrderedCondition(
+            "exchange-client", 18)  # lint: guarded-by(_cond)
         self._queue: "collections.deque" = collections.deque()
         self._buffered = 0
         self._buffered_peak = 0
@@ -538,9 +543,16 @@ class ExchangeClient:
 
     def _ack_loop(self) -> None:
         """Fire-and-forget acknowledges: frees producer buffer memory off
-        the pull critical path (the reference sends these async too)."""
+        the pull critical path (the reference sends these async too).
+        The pull is BOUNDED so a lost wake token (close() racing the
+        queue) can never wedge the thread past the stop flag."""
         while True:
-            url = self._ack_q.get()
+            try:
+                url = self._ack_q.get(timeout=0.5)
+            except queue.Empty:
+                if self._closed or self._stop_event.is_set():
+                    return
+                continue
             if url is None or self._closed:
                 return
             try:

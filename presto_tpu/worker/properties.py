@@ -171,6 +171,8 @@ def execution_config_from_properties(props: Dict[str, str],
                 f"task.plan-validation must be one of {VALIDATION_MODES}, "
                 f"got {mode!r}")
         kw["plan_validation"] = mode
+    if "debug.lock-validation" in props:
+        kw["lock_validation"] = _bool(props["debug.lock-validation"])
     if "telemetry.profile-dir" in props:
         kw["profile_dir"] = props["telemetry.profile-dir"]
     if "retry-policy" in props:
@@ -244,6 +246,9 @@ class SystemConfig:
         ("failure-detector.heartbeat-timeout", str, ""),  # "" = streak only
         ("task.fault-injection-probability", float, 0.0),
         ("task.plan-validation", str, "on"),
+        # runtime lock-order validation (common/locks.py): worker-wide
+        # base flag; sessions compose per-query scopes on top
+        ("debug.lock-validation", bool, False),
         ("shutdown-onset-sec", int, 10),
         ("system-memory-gb", int, 16),               # HBM per chip
         ("system-mem-limit-gb", int, 16),

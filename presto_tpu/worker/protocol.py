@@ -371,6 +371,13 @@ def apply_session_properties(config, session: Dict[str, str]):
                 f"plan_validation must be one of {VALIDATION_MODES}, "
                 f"got {mode!r}")
         kw["plan_validation"] = mode
+    if "lock_validation" in session:
+        mode = str(session["lock_validation"]).strip().lower()
+        if mode not in ("on", "off", "true", "false"):
+            raise ValueError(
+                "lock_validation must be one of on/off/true/false, "
+                f"got {mode!r}")
+        kw["lock_validation"] = mode in ("on", "true")
     if "scan_kernel" in session:
         mode = str(session["scan_kernel"]).strip().lower()
         from ..exec.pipeline import SCAN_KERNEL_MODES

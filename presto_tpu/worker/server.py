@@ -414,6 +414,27 @@ class _Handler(BaseHTTPRequestHandler):
                 lines.append(f"# TYPE presto_tpu_storage_{k}_total counter")
                 lines.append(
                     f"presto_tpu_storage_{k}_total {STORAGE_METRICS[k]}")
+        # lock-order validation + contention metering (common/locks.py):
+        # populated when debug.lock-validation (or a session's
+        # lock_validation override) armed the OrderedLock bookkeeping
+        from ..common.locks import LOCK_METRICS, validation_enabled
+        lk = LOCK_METRICS.snapshot()
+        lines += [
+            "# TYPE presto_tpu_lock_validation_enabled gauge",
+            f"presto_tpu_lock_validation_enabled "
+            f"{1 if validation_enabled() else 0}",
+            "# TYPE presto_tpu_lock_acquisitions_total counter",
+            f"presto_tpu_lock_acquisitions_total {lk['acquisitions']}",
+            "# TYPE presto_tpu_lock_contended_total counter",
+            f"presto_tpu_lock_contended_total {lk['contended']}",
+            "# TYPE presto_tpu_lock_contention_wall_seconds_total counter",
+            f"presto_tpu_lock_contention_wall_seconds_total "
+            f"{lk['contention_wall_s']}",
+            "# TYPE presto_tpu_lock_hold_wall_seconds_total counter",
+            f"presto_tpu_lock_hold_wall_seconds_total {lk['hold_wall_s']}",
+            "# TYPE presto_tpu_lock_order_violations_total counter",
+            f"presto_tpu_lock_order_violations_total {lk['violations']}",
+        ]
         # memory arbitration + two-tier spill (exec/memory.py): counters
         # for spilled/unspilled bytes and revocations, gauges for the
         # live reserved/revocable split and the eviction overlap fraction
@@ -938,6 +959,11 @@ class WorkerServer:
         self.discovery_lock = threading.Lock()
         self.started_at = time.time()
         self.exec_config = config or tuned_config()
+        if getattr(self.exec_config, "lock_validation", False):
+            # debug.lock-validation=on arms the worker-wide base flag;
+            # per-query session overrides compose scopes on top of it
+            from ..common.locks import set_validation
+            set_validation(True)
 
         handler = type("Handler", (_Handler,), {"server_ref": self})
         self.httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
@@ -1342,6 +1368,11 @@ class WorkerServer:
                         get_process_exporter() is self.telemetry:
                     set_process_exporter(None)
                 self.telemetry.close()
+            if getattr(self.exec_config, "lock_validation", False):
+                # disarm the base flag this server armed at init (session
+                # scopes are counted separately and unwind on their own)
+                from ..common.locks import set_validation
+                set_validation(False)
         finally:
             # the listener MUST die even if task teardown raised — a
             # leaked serve_forever thread would outlive the sweep

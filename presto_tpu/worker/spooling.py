@@ -35,11 +35,11 @@ import os
 import re
 import struct
 import tempfile
-import threading
 import time
 from typing import Dict, List, Optional
 
 from ..common.compression import compress, decompress
+from ..common.locks import OrderedLock
 
 DEFAULT_STAGING_BUDGET_BYTES = 16 << 20
 
@@ -56,7 +56,8 @@ class SpoolMetrics:
     _GAUGES = ("staged_bytes",)
 
     def __init__(self):
-        self._lock = threading.Lock()
+        # rank 100: metrics registries are leaf locks
+        self._lock = OrderedLock("metrics:spool", 100)  # lint: guarded-by(_lock)
         self.reset()
 
     def reset(self) -> None:
@@ -94,7 +95,10 @@ class TaskSpool:
         self._dir = spool_dir or tempfile.gettempdir()
         self._memory = memory
         self._budget = max(0, int(staging_budget_bytes))
-        self._lock = threading.RLock()
+        # reentrant: append -> _charge_locked -> _flush_locked re-enters;
+        # rank 32 sits between the output buffer (30) and the pool (40)
+        self._lock = OrderedLock(
+            "task-spool", 32, reentrant=True)  # lint: guarded-by(_lock)
         # token t of buffer b -> [raw_len, compressed_len, ram|None, offset]
         self._records: Dict[int, List[list]] = \
             {b: [] for b in range(max(1, n_buffers))}

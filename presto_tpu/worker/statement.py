@@ -32,6 +32,8 @@ from dataclasses import dataclass, field
 from decimal import Decimal
 from typing import Callable, Dict, List, Optional
 
+from ..common.locks import OrderedLock
+
 QUEUED = "QUEUED"
 RUNNING = "RUNNING"
 FINISHED = "FINISHED"
@@ -120,7 +122,9 @@ class ResourceGroupManager:
         self._vtime: Dict[str, float] = {n: 0.0 for n in self.groups}
         self._total_running = 0
         self._mem_admitted = 0
-        self._lock = threading.Lock()
+        # rank 12: admission reads the memory pool's gauges but never
+        # acquires its lock; sits between dispatch (10) and tasks (14)
+        self._lock = OrderedLock("resource-groups", 12)  # lint: guarded-by(_lock)
 
     def select(self, user: str, source: str) -> str:
         for s in self.selectors:
@@ -353,7 +357,9 @@ class DispatchManager:
         self.resource_groups = resource_groups or ResourceGroupManager()
         self.events = events or EventListenerManager()
         self._queries: Dict[str, ManagedQuery] = {}
-        self._lock = threading.Lock()
+        # rank 10: the outermost lock in the intake path — held only for
+        # registry mutation, released before admission (12) or task work
+        self._lock = OrderedLock("dispatch-manager", 10)  # lint: guarded-by(_lock)
 
     # -- intake -----------------------------------------------------------
     # a streaming query whose client stopped polling is canceled so its

@@ -17,6 +17,7 @@ from typing import Callable, Dict, List, Optional
 
 from ..common.errors import (INTERNAL_ERROR, USER_ERROR, InjectedTaskFailure,
                              QueryDeadlineExceededError, classify_exception)
+from ..common.locks import OrderedCondition, OrderedLock, validation_scope
 from ..common.serde import serialize_page
 from ..connectors import catalog, tpch
 from ..exec.pipeline import (ExecutionConfig, PlanCompiler, TaskContext,
@@ -39,12 +40,12 @@ class TpuTask:
         self.config = config
         self.events = events
         self.manager = manager
-        self.state = PLANNED
-        self.version = 0
-        self.failures: List[str] = []
-        self.error_type = ""              # reference ErrorType of failure[0]
+        self.state = PLANNED              # lint: guarded-by(_cond)
+        self.version = 0                  # lint: guarded-by(_cond)
+        self.failures: List[str] = []     # lint: guarded-by(_cond)
+        self.error_type = ""              # lint: guarded-by(_cond)
         self.buffers: Optional[OutputBufferManager] = None
-        self.done_at: Optional[float] = None
+        self.done_at: Optional[float] = None  # lint: guarded-by(_cond)
         self.memory_peak = 0
         self.memory_ctx = None            # task MemoryContext (set by start)
         # TaskInfo stats surface (reference TaskInfo/TaskStats): the
@@ -72,7 +73,10 @@ class TpuTask:
         # redirect live pulls to the replacement attempt's buffers
         self._remote_locations: Dict[str, List[str]] = {}
         self._remote_clients: Dict[str, list] = {}
-        self._cond = threading.Condition()
+        # rank 16: above the task manager (14), below every data-plane
+        # lock; _set_state never nests (events and the manager counter
+        # fire after release)
+        self._cond = OrderedCondition("task-state", 16)
         self._thread: Optional[threading.Thread] = None
 
     def info(self) -> dict:
@@ -164,7 +168,11 @@ class TpuTask:
                 self.done_at = time.monotonic()
             self._cond.notify_all()
         if state == FAILED and self.manager is not None:
-            self.manager.tasks_failed += 1  # lifetime counter (metrics)
+            # lifetime counter: incremented under the MANAGER's lock (this
+            # used to be a bare cross-object `+= 1` racing every executor
+            # thread), and only after _cond is released — task-state (16)
+            # never nests into task-manager (14)
+            self.manager.note_task_failed()
         if state in DONE_STATES and self.events is not None:
             # task-level terminal event from the WORKER path (reference
             # QueryMonitor per-task stats; listener isolation inside the
@@ -415,6 +423,18 @@ class TpuTask:
                     f"injected task failure (p={p}, task {self.task_id})")
 
     def _run(self, fragment: P.PlanFragment, spec, ctx: TaskContext) -> None:
+        # debug.lock-validation=on (worker property or lock_validation
+        # session override): every OrderedLock acquisition made while this
+        # task executes — by ANY thread, the flag is process-global and
+        # counting so concurrent scoped tasks compose — is checked against
+        # the declared rank order and metered into presto_tpu_lock_*
+        if getattr(ctx.config, "lock_validation", False):
+            with validation_scope():
+                return self._run_impl(fragment, spec, ctx)
+        return self._run_impl(fragment, spec, ctx)
+
+    def _run_impl(self, fragment: P.PlanFragment, spec,
+                  ctx: TaskContext) -> None:
         # driver-boundary CPU vs wall: _run IS the task's driver thread,
         # so thread_time measures its compute and the wall-minus-CPU gap
         # is time spent waiting (device syncs, buffer backpressure,
@@ -589,11 +609,13 @@ class TaskManager:
         self.base_uri = base_uri
         self.config = config or tuned_config()
         self.events = events
-        self.tasks: Dict[str, TpuTask] = {}
-        self._lock = threading.Lock()
-        self.tasks_created = 0
-        self.tasks_failed = 0     # lifetime, survives eviction (metrics)
-        self.tasks_retried = 0    # coordinator retry attempts seen (.rN ids)
+        # rank 14: held across _evict_locked -> buffers.destroy_all, which
+        # takes buffer conditions (30) and the spool (32) underneath
+        self._lock = OrderedLock("task-manager", 14)
+        self.tasks: Dict[str, TpuTask] = {}       # lint: guarded-by(_lock)
+        self.tasks_created = 0                    # lint: guarded-by(_lock)
+        self.tasks_failed = 0                     # lint: guarded-by(_lock)
+        self.tasks_retried = 0                    # lint: guarded-by(_lock)
         # chaos hook: fault_injector(task_id) raises to fail the task at
         # start (the worker mirror of SchedulerConfig.fault_injector)
         self.fault_injector: Optional[Callable[[str], None]] = None
@@ -611,6 +633,13 @@ class TaskManager:
                     "memory_peak": mem_peak,
                     "failed": self.tasks_failed,
                     "retried": self.tasks_retried}
+
+    def note_task_failed(self) -> None:
+        """Lifetime failure counter, bumped by tasks entering FAILED.
+        Taken under the manager lock: executor threads from many tasks
+        race on it, and a bare `+= 1` loses increments."""
+        with self._lock:
+            self.tasks_failed += 1
 
     def _evict_locked(self) -> None:
         import time

@@ -1,0 +1,492 @@
+"""Thread-safety analysis conformance (tier-1): the shipped tree is
+clean under the class-granular concurrency pass, every LOCK code fires
+on a violating fixture and stays quiet on the compliant twin, and the
+runtime half (common/locks.py OrderedLock rank validation + contention
+metering) enforces at execution time exactly what LOCK004 proves
+statically.
+
+The static and dynamic halves are one feature: the checker extracts the
+lock-order graph the OrderedLock ranks declare, and
+``debug.lock-validation=on`` raises LockOrderError on any inversion the
+checker would have flagged.
+"""
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+from presto_tpu.analysis.concurrency import (ALL_CONCURRENCY_CODES,
+                                             LOCK_BLOCKING_HELD,
+                                             LOCK_IN_CALLBACK, LOCK_ORDER,
+                                             LOCK_UNGUARDED, check_or_raise,
+                                             check_paths, check_source)
+from presto_tpu.analysis.lint import ALL_LINT_CODES
+from presto_tpu.common.errors import PlanValidationError
+from presto_tpu.common.locks import (LOCK_METRICS, LockOrderError,
+                                     OrderedCondition, OrderedLock,
+                                     set_validation, validation_enabled,
+                                     validation_scope)
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _codes(findings):
+    return {f.code for f in findings}
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 gate: shipped tree is clean
+# ---------------------------------------------------------------------------
+
+def test_shipped_tree_is_clean():
+    findings = check_paths([str(REPO / "presto_tpu")])
+    assert findings == [], "\n".join(str(f) for f in findings)
+
+
+def test_module_entry_point_exit_codes(tmp_path):
+    clean = subprocess.run(
+        [sys.executable, "-m", "presto_tpu.analysis.concurrency",
+         "presto_tpu"],
+        cwd=REPO, capture_output=True, text=True)
+    assert clean.returncode == 0, clean.stdout + clean.stderr
+
+    fixture = tmp_path / "bad.py"
+    fixture.write_text(
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.n = 0  # lint: guarded-by(_lock)\n"
+        "    def bump(self):\n"
+        "        self.n += 1\n")
+    bad = subprocess.run(
+        [sys.executable, "-m", "presto_tpu.analysis.concurrency",
+         str(fixture)],
+        cwd=REPO, capture_output=True, text=True)
+    assert bad.returncode == 1
+    assert "LOCK001" in bad.stdout
+
+
+def test_check_or_raise_routes_through_error_taxonomy(tmp_path):
+    fixture = tmp_path / "bad.py"
+    fixture.write_text(
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.n = 0  # lint: guarded-by(_lock)\n"
+        "    def bump(self):\n"
+        "        self.n += 1\n")
+    with pytest.raises(PlanValidationError):
+        check_or_raise([str(fixture)])
+
+
+# ---------------------------------------------------------------------------
+# closed vocabulary: the combined static-analysis code set
+# ---------------------------------------------------------------------------
+
+def test_concurrency_codes_are_closed_vocabulary():
+    assert ALL_CONCURRENCY_CODES == ("LOCK001", "LOCK002", "LOCK003",
+                                     "LOCK004")
+    # lint and concurrency share one diagnostic namespace: no overlap,
+    # and every code is unique across the combined vocabulary
+    combined = tuple(ALL_LINT_CODES) + tuple(ALL_CONCURRENCY_CODES)
+    assert len(set(combined)) == len(combined)
+    assert set(ALL_LINT_CODES).isdisjoint(ALL_CONCURRENCY_CODES)
+
+
+# ---------------------------------------------------------------------------
+# LOCK001: guarded attribute written outside its lock
+# ---------------------------------------------------------------------------
+
+def test_unguarded_write_flagged():
+    findings = check_source(
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.count = 0  # lint: guarded-by(_lock)\n"
+        "    def bump(self):\n"
+        "        self.count += 1\n")
+    assert _codes(findings) == {LOCK_UNGUARDED}
+
+
+def test_guarded_write_compliant():
+    findings = check_source(
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.count = 0  # lint: guarded-by(_lock)\n"
+        "    def bump(self):\n"
+        "        with self._lock:\n"
+        "            self.count += 1\n")
+    assert findings == []
+
+
+def test_class_form_guard_covers_all_writes():
+    findings = check_source(
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()  # lint: guarded-by(_lock)\n"
+        "        self.a = 0\n"
+        "        self.b = 0\n"
+        "    def bump(self):\n"
+        "        self.a += 1\n"
+        "    def ok(self):\n"
+        "        with self._lock:\n"
+        "            self.b += 1\n")
+    assert len(findings) == 1
+    assert findings[0].code == LOCK_UNGUARDED
+    assert "C.a" in findings[0].message
+
+
+def test_locked_suffix_and_pragma_exempt():
+    findings = check_source(
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.count = 0  # lint: guarded-by(_lock)\n"
+        "    def _bump_locked(self):\n"
+        "        self.count += 1\n"
+        "    def seed(self):\n"
+        "        self.count = 0  # lint: allow-unguarded\n")
+    assert findings == []
+
+
+def test_single_lock_inference_flags_unguarded_write():
+    """No annotation at all: one lock attr + an attribute written both
+    under and outside it infers the guard."""
+    findings = check_source(
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.n = 0\n"
+        "    def add(self):\n"
+        "        with self._lock:\n"
+        "            self.n += 1\n"
+        "    def racy(self):\n"
+        "        self.n += 1\n")
+    assert _codes(findings) == {LOCK_UNGUARDED}
+
+
+# ---------------------------------------------------------------------------
+# LOCK002: blocking call under a held lock
+# ---------------------------------------------------------------------------
+
+def test_untimed_queue_get_under_lock_flagged():
+    findings = check_source(
+        "import queue\n"
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._q = queue.Queue()\n"
+        "    def pull(self):\n"
+        "        with self._lock:\n"
+        "            return self._q.get()\n")
+    assert _codes(findings) == {LOCK_BLOCKING_HELD}
+
+
+def test_timed_queue_get_under_lock_compliant():
+    findings = check_source(
+        "import queue\n"
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._q = queue.Queue()\n"
+        "    def pull(self):\n"
+        "        with self._lock:\n"
+        "            return self._q.get(timeout=0.5)\n")
+    assert findings == []
+
+
+def test_urlopen_and_device_sync_under_lock_flagged():
+    findings = check_source(
+        "import threading\n"
+        "import urllib.request\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    def fetch(self, req, x):\n"
+        "        with self._lock:\n"
+        "            urllib.request.urlopen(req, timeout=5)\n"
+        "            return x.block_until_ready()\n")
+    assert [f.code for f in findings] == [LOCK_BLOCKING_HELD,
+                                          LOCK_BLOCKING_HELD]
+
+
+def test_condition_wait_on_held_condition_is_exempt():
+    """`cond.wait()` ON the held condition is the sanctioned CV shape."""
+    findings = check_source(
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._cond = threading.Condition()\n"
+        "    def park(self):\n"
+        "        with self._cond:\n"
+        "            self._cond.wait()\n")
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# LOCK003: lock acquisition in a non-blocking callback
+# ---------------------------------------------------------------------------
+
+def test_with_lock_in_registered_revoke_callback_flagged():
+    findings = check_source(
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self, memory):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._holder = memory.register_revocable(\n"
+        "            'spool', self._revoke)\n"
+        "    def _revoke(self):\n"
+        "        with self._lock:\n"
+        "            return 0\n")
+    assert _codes(findings) == {LOCK_IN_CALLBACK}
+
+
+def test_timed_decline_in_callback_compliant():
+    findings = check_source(
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self, memory):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._holder = memory.register_revocable(\n"
+        "            'spool', self._revoke)\n"
+        "    def _revoke(self):\n"
+        "        if not self._lock.acquire(timeout=0.05):\n"
+        "            return 0\n"
+        "        try:\n"
+        "            return 1\n"
+        "        finally:\n"
+        "            self._lock.release()\n")
+    assert findings == []
+
+
+def test_nonblocking_probe_in_callback_compliant():
+    """acquire(blocking=False) is a bounded probe (the pipeline.py
+    _RevocableBuildBuffer shape)."""
+    findings = check_source(
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self, memory):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._holder = memory.register_revocable(\n"
+        "            'x', self._revoke)\n"
+        "    def _revoke(self):\n"
+        "        if not self._lock.acquire(blocking=False):\n"
+        "            return 0\n"
+        "        try:\n"
+        "            return 1\n"
+        "        finally:\n"
+        "            self._lock.release()\n")
+    assert findings == []
+
+
+def test_pragma_marked_callback_flagged_without_registration():
+    findings = check_source(
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    def on_event(self):  # lint: non-blocking-callback\n"
+        "        with self._lock:\n"
+        "            return 0\n")
+    assert _codes(findings) == {LOCK_IN_CALLBACK}
+
+
+# ---------------------------------------------------------------------------
+# LOCK004: lock-order cycles / rank inversions
+# ---------------------------------------------------------------------------
+
+def test_rank_inversion_flagged():
+    findings = check_source(
+        "from presto_tpu.common.locks import OrderedLock\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._outer = OrderedLock('outer', 20)\n"
+        "        self._inner = OrderedLock('inner', 10)\n"
+        "    def run(self):\n"
+        "        with self._outer:\n"
+        "            with self._inner:\n"
+        "                pass\n")
+    assert _codes(findings) == {LOCK_ORDER}
+
+
+def test_increasing_ranks_compliant():
+    findings = check_source(
+        "from presto_tpu.common.locks import OrderedLock\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._outer = OrderedLock('outer', 10)\n"
+        "        self._inner = OrderedLock('inner', 20)\n"
+        "    def run(self):\n"
+        "        with self._outer:\n"
+        "            with self._inner:\n"
+        "                pass\n")
+    assert findings == []
+
+
+def test_cross_class_cycle_flagged():
+    """A->B in one class, B->A in another: the edges only conflict in the
+    globally combined graph."""
+    findings = check_source(
+        "import threading\n"
+        "from presto_tpu.common.locks import OrderedLock\n"
+        "class P:\n"
+        "    def __init__(self):\n"
+        "        self._a = OrderedLock('shared-a', 10)\n"
+        "        self._b = OrderedLock('shared-b', 10)\n"
+        "    def run(self):\n"
+        "        with self._a:\n"
+        "            with self._b:\n"
+        "                pass\n"
+        "class Q:\n"
+        "    def __init__(self):\n"
+        "        self._b = OrderedLock('shared-b', 10)\n"
+        "        self._a = OrderedLock('shared-a', 10)\n"
+        "    def run(self):\n"
+        "        with self._b:\n"
+        "            with self._a:\n"
+        "                pass\n")
+    assert LOCK_ORDER in _codes(findings)
+
+
+def test_nonreentrant_self_nesting_flagged():
+    findings = check_source(
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    def outer(self):\n"
+        "        with self._lock:\n"
+        "            with self._lock:\n"
+        "                pass\n")
+    assert _codes(findings) == {LOCK_ORDER}
+
+
+# ---------------------------------------------------------------------------
+# runtime half: OrderedLock validation + metering (common/locks.py)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def _validation_off_after():
+    yield
+    set_validation(False)
+    LOCK_METRICS.reset()
+
+
+def test_rank_inversion_raises_under_validation(_validation_off_after):
+    outer = OrderedLock("t-outer", 20)
+    inner = OrderedLock("t-inner", 10)
+    LOCK_METRICS.reset()
+    set_validation(True)
+    with pytest.raises(LockOrderError) as ei:
+        with outer:
+            with inner:
+                pass
+    msg = str(ei.value)
+    assert "t-inner" in msg and "t-outer" in msg
+    assert "LOCK_ORDER_VIOLATION" in msg
+    assert LOCK_METRICS.snapshot()["violations"] == 1
+    # the raise happened BEFORE the inner lock was touched
+    assert not inner.locked()
+    assert not outer.locked()
+
+
+def test_pass_through_when_validation_off(_validation_off_after):
+    """The same seeded inversion is silent with validation off: zero
+    bookkeeping on the production fast path."""
+    outer = OrderedLock("t-outer2", 20)
+    inner = OrderedLock("t-inner2", 10)
+    LOCK_METRICS.reset()
+    with outer:
+        with inner:
+            pass  # wrong order, nobody watching
+    snap = LOCK_METRICS.snapshot()
+    assert snap["violations"] == 0
+    assert snap["acquisitions"] == 0
+
+
+def test_validation_scope_composes(_validation_off_after):
+    assert not validation_enabled()
+    with validation_scope():
+        assert validation_enabled()
+        with validation_scope():
+            assert validation_enabled()
+        assert validation_enabled()
+    assert not validation_enabled()
+
+
+def test_reentrant_reacquisition_legal(_validation_off_after):
+    lock = OrderedLock("t-reent", 30, reentrant=True)
+    set_validation(True)
+    with lock:
+        with lock:
+            assert lock.locked()
+
+
+def test_ordered_condition_wait_drops_and_restores(_validation_off_after):
+    """Condition.wait() releases the lock: a waiter must not poison its
+    own thread's rank stack, and the notifier (taking the same rank-30
+    lock) must pass."""
+    cond = OrderedCondition("t-cond", 30)
+    set_validation(True)
+    hits = []
+
+    def waiter():
+        with cond:
+            while not hits:
+                cond.wait(timeout=1.0)
+            hits.append("woke")
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.05)
+    with cond:
+        hits.append("notified")
+        cond.notify_all()
+    t.join(timeout=5)
+    assert not t.is_alive()
+    assert hits == ["notified", "woke"]
+    assert LOCK_METRICS.snapshot()["violations"] == 0
+
+
+def test_contention_counters_move_under_8_threads(_validation_off_after):
+    """8 threads hammering one OrderedLock: acquisitions account for
+    every entry, and holding the lock across real work forces contended
+    acquisitions + contention wall to register."""
+    LOCK_METRICS.reset()
+    set_validation(True)
+    lock = OrderedLock("t-contend", 10)
+    n_threads, n_iters = 8, 25
+    state = {"n": 0}
+
+    def worker():
+        for _ in range(n_iters):
+            with lock:
+                v = state["n"]
+                time.sleep(0.0002)  # hold long enough to collide
+                state["n"] = v + 1
+
+    threads = [threading.Thread(target=worker) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert state["n"] == n_threads * n_iters  # the lock actually excludes
+    snap = LOCK_METRICS.snapshot()
+    assert snap["acquisitions"] >= n_threads * n_iters
+    assert snap["contended"] > 0
+    assert snap["contention_wall_s"] > 0
+    assert snap["hold_wall_s"] > 0
+    assert snap["violations"] == 0

@@ -57,6 +57,26 @@ def test_module_entry_point_exit_codes(tmp_path):
     assert "SYNC001" in bad.stdout
 
 
+def test_ci_entry_point_exits_clean(tmp_path):
+    """`python -m presto_tpu.analysis.ci` is the single gate CI runs:
+    lint + concurrency + a PlanChecker sweep, exit 0 on a clean tree and
+    a JSON report with the expected shape."""
+    import json
+    report_path = tmp_path / "report.json"
+    proc = subprocess.run(
+        [sys.executable, "-m", "presto_tpu.analysis.ci",
+         "--max-plans", "3", "--json", str(report_path)],
+        cwd=REPO, capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    report = json.loads(report_path.read_text())
+    assert report["clean"] is True
+    assert report["total_findings"] == 0
+    assert report["files_scanned"] > 0
+    assert report["plan_sweep"]["queries"] == 3
+    assert report["lint"]["findings"] == []
+    assert report["concurrency"]["findings"] == []
+
+
 # ---------------------------------------------------------------------------
 # hazard shapes
 # ---------------------------------------------------------------------------

@@ -266,7 +266,22 @@ CHAOS_SQL = ("select o_orderstatus, count(*), sum(o_totalprice) "
              "group by o_orderstatus")
 
 
-def test_chaos_worker_killed_mid_query_recovers():
+@pytest.fixture
+def lock_validation():
+    """Chaos runs double as runtime lock-order validation runs: the
+    lock_validation=on session property (exec/pipeline.py) makes every
+    task driver thread record its OrderedLock acquisition stack
+    (common/locks.py), and the fixture requires the whole run — retries,
+    worker death, drains and all — to finish with ZERO rank inversions."""
+    from presto_tpu.common.locks import LOCK_METRICS
+    before = LOCK_METRICS.snapshot()["violations"]
+    yield
+    after = LOCK_METRICS.snapshot()["violations"]
+    assert after == before, \
+        f"{after - before} lock-order violation(s) during chaos run"
+
+
+def test_chaos_worker_killed_mid_query_recovers(lock_validation):
     """Kill a worker the moment it starts running a task: the coordinator
     must classify the loss as retryable, reschedule the lost lineages onto
     the survivors, and still return oracle-correct rows exactly once."""
@@ -289,7 +304,8 @@ def test_chaos_worker_killed_mid_query_recovers():
     try:
         r = HttpQueryRunner(
             [w1.uri, w2.uri, w3.uri], "sf0.01", n_tasks=2,
-            session={"exchange_max_error_duration": "5s"})
+            session={"exchange_max_error_duration": "5s",
+                     "lock_validation": "on"})
         got = r.execute(CHAOS_SQL)
         _assert_same(got, CHAOS_SQL)
         assert killed.is_set(), "chaos hook never fired"
@@ -305,7 +321,7 @@ def test_chaos_worker_killed_mid_query_recovers():
             w.close()
 
 
-def test_chaos_injected_failure_exactly_once():
+def test_chaos_injected_failure_exactly_once(lock_validation):
     """A transient (retryable) injected task failure: the query output must
     match the oracle exactly — no dropped and no duplicated pages — and the
     failure/retry counters must be visible in /v1/metrics."""
@@ -324,7 +340,8 @@ def test_chaos_injected_failure_exactly_once():
     w1.task_manager.fault_injector = flaky_once
     w2.task_manager.fault_injector = flaky_once
     try:
-        r = HttpQueryRunner([w1.uri, w2.uri], "sf0.01", n_tasks=2)
+        r = HttpQueryRunner([w1.uri, w2.uri], "sf0.01", n_tasks=2,
+                            session={"lock_validation": "on"})
         got = r.execute(CHAOS_SQL)
         _assert_same(got, CHAOS_SQL)
         assert len(flaked) == 1
@@ -339,7 +356,7 @@ def test_chaos_injected_failure_exactly_once():
         w2.close()
 
 
-def test_chaos_user_error_fails_fast_without_retry():
+def test_chaos_user_error_fails_fast_without_retry(lock_validation):
     """A USER_ERROR-shaped failure must fail the query immediately: no task
     retry attempts anywhere, and the typed error survives the HTTP hop."""
     from presto_tpu.common.errors import PrestoUserError
@@ -355,7 +372,8 @@ def test_chaos_user_error_fails_fast_without_retry():
 
     w.task_manager.fault_injector = user_bug
     try:
-        r = HttpQueryRunner([w.uri], "sf0.01", n_tasks=1)
+        r = HttpQueryRunner([w.uri], "sf0.01", n_tasks=1,
+                            session={"lock_validation": "on"})
         with pytest.raises(PrestoUserError):
             r.execute("select count(*) from nation")
         assert r.tasks_retried == 0
@@ -365,7 +383,7 @@ def test_chaos_user_error_fails_fast_without_retry():
         w.close()
 
 
-def test_chaos_retry_budget_exhausts():
+def test_chaos_retry_budget_exhausts(lock_validation):
     """A permanently failing task consumes its attempt budget and then
     fails the query with a typed error instead of retrying forever."""
     from presto_tpu.common.errors import (InjectedTaskFailure,
@@ -384,7 +402,8 @@ def test_chaos_retry_budget_exhausts():
     try:
         r = HttpQueryRunner(
             [w.uri], "sf0.01", n_tasks=1,
-            session={"remote_task_retry_attempts": "1"})
+            session={"remote_task_retry_attempts": "1",
+                     "lock_validation": "on"})
         with pytest.raises(PrestoQueryError, match="retry attempt"):
             r.execute("select count(*) from region")
         # at least one budgeted retry reached the worker, and no lineage
@@ -402,7 +421,7 @@ def test_chaos_retry_budget_exhausts():
         w.close()
 
 
-def test_probabilistic_fault_injection_session_property():
+def test_probabilistic_fault_injection_session_property(lock_validation):
     """fault_injection_probability=1.0 via session property trips the
     deterministic sha256 roll on every attempt; with retry disabled the
     query fails on the first injected fault."""
@@ -415,7 +434,8 @@ def test_probabilistic_fault_injection_session_property():
         r = HttpQueryRunner(
             [w.uri], "sf0.01", n_tasks=1,
             session={"fault_injection_probability": "1.0",
-                     "remote_task_retry_attempts": "0"})
+                     "remote_task_retry_attempts": "0",
+                     "lock_validation": "on"})
         with pytest.raises(PrestoQueryError):
             r.execute("select count(*) from region")
         assert w.task_manager.tasks_failed >= 1
@@ -774,7 +794,7 @@ def test_failed_task_aborts_worker_remote_source_promptly():
         srv.close()
 
 
-def test_chaos_worker_kill_exactly_once_with_four_producers():
+def test_chaos_worker_kill_exactly_once_with_four_producers(lock_validation):
     """Worker death mid-pull with >= 4 upstream producers per consumer:
     the concurrent client + retained-buffer replay must still deliver
     oracle-correct rows exactly once."""
@@ -797,7 +817,8 @@ def test_chaos_worker_kill_exactly_once_with_four_producers():
     try:
         r = HttpQueryRunner(
             [w1.uri, w2.uri, w3.uri], "sf0.01", n_tasks=4,
-            session={"exchange_max_error_duration": "5s"})
+            session={"exchange_max_error_duration": "5s",
+                     "lock_validation": "on"})
         got = r.execute(CHAOS_SQL)
         _assert_same(got, CHAOS_SQL)
         assert killed.is_set(), "chaos hook never fired"
@@ -872,7 +893,7 @@ def _base_lineage(task_id):
     return _RETRY_SUFFIX_RX.sub("", task_id)
 
 
-def test_chaos_task_retry_policy_retries_only_failed_task():
+def test_chaos_task_retry_policy_retries_only_failed_task(lock_validation):
     """Tentpole: under retry-policy=task a transient task failure retries
     ONLY the failed lineage — ancestors' spooled output replays, so no
     ancestor stage gets a .rN re-run — and rows stay oracle-exact."""
@@ -894,7 +915,8 @@ def test_chaos_task_retry_policy_retries_only_failed_task():
     SPOOL_METRICS.reset()
     try:
         r = HttpQueryRunner([w1.uri, w2.uri], "sf0.01", n_tasks=2,
-                            session={"retry_policy": "task"})
+                            session={"retry_policy": "task",
+                                     "lock_validation": "on"})
         got = r.execute(CHAOS_SQL)
         _assert_same(got, CHAOS_SQL)
         assert len(flaked) == 1
@@ -918,7 +940,7 @@ def test_chaos_task_retry_policy_retries_only_failed_task():
         w2.close()
 
 
-def test_chaos_worker_killed_task_policy_no_ancestor_rerun():
+def test_chaos_worker_killed_task_policy_no_ancestor_rerun(lock_validation):
     """Tentpole acceptance: kill a worker mid-query under
     retry-policy=task.  Recovery re-runs only the lineages that were
     placed on the dead worker (their consumers redirect to the
@@ -943,7 +965,8 @@ def test_chaos_worker_killed_task_policy_no_ancestor_rerun():
         r = HttpQueryRunner(
             [w1.uri, w2.uri, w3.uri], "sf0.01", n_tasks=2,
             session={"retry_policy": "task",
-                     "exchange_max_error_duration": "10s"})
+                     "exchange_max_error_duration": "10s",
+                     "lock_validation": "on"})
         got = r.execute(CHAOS_SQL)
         _assert_same(got, CHAOS_SQL)
         assert killed.is_set(), "chaos hook never fired"
@@ -965,7 +988,7 @@ def test_chaos_worker_killed_task_policy_no_ancestor_rerun():
             w.close()
 
 
-def test_chaos_graceful_drain_zero_failures():
+def test_chaos_graceful_drain_zero_failures(lock_validation):
     """PUT /v1/info/state SHUTTING_DOWN on a worker while queries are in
     flight: every query completes with oracle-exact rows (its spooled
     output survives until consumed), the scheduler stops placing tasks on
@@ -981,7 +1004,7 @@ def test_chaos_graceful_drain_zero_failures():
     w1, w2, w3 = WorkerServer(), WorkerServer(), WorkerServer()
     uris = [w1.uri, w2.uri, w3.uri]
     det = HeartbeatFailureDetector(uris, interval_s=0.1)
-    session = {"retry_policy": "task"}
+    session = {"retry_policy": "task", "lock_validation": "on"}
     runners = [HttpQueryRunner(uris, "sf0.01", n_tasks=2,
                                failure_detector=det, session=session)
                for _ in range(2)]
@@ -1036,7 +1059,7 @@ def test_chaos_graceful_drain_zero_failures():
             w.close()
 
 
-def test_chaos_query_deadline_typed_error_no_retry():
+def test_chaos_query_deadline_typed_error_no_retry(lock_validation):
     """query.max-execution-time mints a typed, NON-retryable
     EXCEEDED_TIME_LIMIT user error at the coordinator: no task retry is
     attempted anywhere and the failure surfaces promptly."""
@@ -1050,7 +1073,8 @@ def test_chaos_query_deadline_typed_error_no_retry():
     try:
         r = HttpQueryRunner(
             [w.uri], "sf0.01", n_tasks=2,
-            session={"query_max_execution_time": "50ms"})
+            session={"query_max_execution_time": "50ms",
+                     "lock_validation": "on"})
         t0 = time.monotonic()
         with pytest.raises(QueryDeadlineExceededError,
                            match="EXCEEDED_TIME_LIMIT"):
@@ -1067,7 +1091,7 @@ def test_chaos_query_deadline_typed_error_no_retry():
         w.close()
 
 
-def test_chaos_poison_split_quarantined():
+def test_chaos_poison_split_quarantined(lock_validation):
     """A split that fails with the SAME internal error signature on two
     distinct workers is poison: the query fails fast with the split
     identity in the typed error instead of burning the whole attempt
@@ -1092,7 +1116,8 @@ def test_chaos_poison_split_quarantined():
     try:
         r = HttpQueryRunner(
             [w1.uri, w2.uri], "sf0.01", n_tasks=2,
-            session={"remote_task_retry_attempts": "4"})
+            session={"remote_task_retry_attempts": "4",
+                     "lock_validation": "on"})
         with pytest.raises(PoisonSplitError, match="POISON_SPLIT") as ei:
             r.execute(CHAOS_SQL)
         # the split identity is in the message, and quarantine fired well
