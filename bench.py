@@ -11,7 +11,7 @@ vs_baseline = speedup vs the single-threaded numpy reference interpreter
               each round so the ratio tracks engine improvements only.
 
 Env knobs: BENCH_SF (default 10), BENCH_RUNS (default 3),
-BENCH_QUERY (q1|q6|q6z|q3g|q3k|xchg|serve|spill|ft).
+BENCH_QUERY (q1|q6|q6z|q3g|q3k|xchg|serve|spill|ft|aqe).
 
 q1/q6/q6z/q1g/q3k lines also carry a "scan_kernel" object: best-of-N
 walls and effective_scan_gbps for the same query pinned to
@@ -76,6 +76,16 @@ JSON line reports wall_ratio = task / query wall — the steady-state
 price of durability — plus spooled pages/bytes, the spool compression
 ratio, bytes flushed to the disk tier, and spool_throughput_gbps (raw
 bytes through the staging path per second spent staging).
+
+BENCH_QUERY=aqe is the adaptive-execution benchmark: a Q19-shaped
+selective join (the orders build side cut to BENCH_AQE_FRACTION of its
+key domain, default 0.2%) through the multi-task scheduler with runtime
+dynamic filters + cardinality-driven exchange decisions ON vs OFF.  All
+runs — off, on, and the zero wait-timeout fallback — must match the
+numpy reference oracle row for row; the JSON line reports the
+dynamic-only zone-map chunk_prune_fraction, rows scanned with/without
+runtime filters, the adaptive exchange decisions taken (broadcast
+flips / side swaps / kept), and wall_ratio = adaptive-on / adaptive-off.
 """
 import json
 import os
@@ -479,6 +489,129 @@ def bench_ft(runs):
             w.close()
 
 
+# Q19-shaped selective join: the orders build side collapses to a tiny
+# fraction of its key domain, lineitem is laid out in orderkey order —
+# so the runtime dynamic filter's [min, max] lands on the zone maps and
+# prunes almost every probe-side chunk that static planning had to scan.
+# The `o_orderkey + 0` spelling is deliberate: the arithmetic hides the
+# range from the stats calculator (UNKNOWN_FILTER_COEFFICIENT), so the
+# PLANNED build stays near the full orders table while the OBSERVED
+# build collapses to ~cutoff rows — exactly the >=10x gap the runtime
+# partitioned->broadcast exchange flip exists to exploit
+AQE = """
+SELECT sum(l_extendedprice * (1 - l_discount)) AS revenue, count(*) AS cnt
+FROM lineitem, orders
+WHERE l_orderkey = o_orderkey AND o_orderkey + 0 < {cutoff}
+"""
+
+
+def bench_aqe(runs):
+    """Adaptive-query-execution benchmark: the selective join through the
+    multi-task scheduler with runtime dynamic filters + cardinality-driven
+    exchange decisions ON vs OFF.  All runs (off, on, and the zero
+    wait-timeout fallback) must return rows identical to the numpy
+    reference oracle; the JSON line reports the dynamic-only prune
+    fraction, rows scanned with/without runtime filters, the adaptive
+    exchange decisions taken, and the on/off wall ratio."""
+    import dataclasses
+
+    from presto_tpu.connectors import tpch
+    from presto_tpu.exec.adaptive import (ADAPTIVE_METRICS,
+                                          reset_adaptive_metrics)
+    from presto_tpu.exec.pipeline import ExecutionConfig
+    from presto_tpu.exec.runner import (DistributedQueryRunner,
+                                        _assert_rows_equal)
+
+    sf = float(os.environ.get("BENCH_SF", "0.1"))
+    frac = float(os.environ.get("BENCH_AQE_FRACTION", "0.002"))
+    n_tasks = int(os.environ.get("BENCH_AQE_TASKS", "2"))
+    # plan-time threshold BELOW the (opaque-predicate-inflated) build
+    # estimate of ~0.9x orders, so the join plans partitioned — and the
+    # runtime flip to broadcast (observed rows >= 10x below plan) is the
+    # adaptive path's call to make
+    thresh = int(os.environ.get("BENCH_AQE_BROADCAST_THRESHOLD", "5000"))
+    schema = f"sf{sf:g}"
+    n_rows = tpch._table_rows("lineitem", sf)
+    cutoff = max(2, int(tpch._table_rows("orders", sf) * frac))
+    sql = AQE.format(cutoff=cutoff)
+
+    # zones finer than scan chunks: the default 64k-row zones collapse a
+    # small-SF table into one zone, leaving nothing for the dynamic
+    # filter's bounds to discriminate
+    base = ExecutionConfig(batch_rows=1 << 16, storage_zone_rows=8192)
+
+    def timed(cfg):
+        runner = DistributedQueryRunner(schema, config=cfg,
+                                        n_tasks=n_tasks,
+                                        broadcast_threshold=thresh)
+        runner.execute(sql)                  # warmup: compiles
+        reset_adaptive_metrics()
+        best, result = float("inf"), None
+        for _ in range(runs):
+            t0 = time.perf_counter()
+            result = runner.execute(sql)
+            best = min(best, time.perf_counter() - t0)
+        return runner, best, result, ADAPTIVE_METRICS.snapshot()
+
+    off_cfg = dataclasses.replace(base, dynamic_filtering=False,
+                                  adaptive_exchange=False)
+    off_runner, off_best, off_result, _ = timed(off_cfg)
+    oracle = off_runner.execute_reference(sql)
+    _assert_rows_equal(off_result, oracle, ordered=False)
+
+    _on_runner, on_best, on_result, m = timed(base)
+    _assert_rows_equal(on_result, oracle, ordered=False)
+
+    # wait-timeout fallback: scans that would miss their filter proceed
+    # unfiltered after a 0s wait — rows must STILL match the oracle
+    fb_cfg = dataclasses.replace(base,
+                                 dynamic_filtering_wait_timeout_s=0.0)
+    _fb_runner, _fb_best, fb_result, _ = timed(fb_cfg)
+    _assert_rows_equal(fb_result, oracle, ordered=False)
+
+    rows_in = m["filter_rows_in"]
+    pruned = m["filter_rows_pruned"]
+    scanned_without = n_rows * runs
+    assert m["filter_chunks_skipped"] > 0 or pruned > 0, \
+        "adaptive run applied no dynamic pruning"
+    assert m["exchange_broadcast_flips"] > 0, \
+        "planned-partitioned join did not flip to broadcast at runtime"
+    out = {
+        "metric": f"aqe_sf{sf:g}_wall_ratio",
+        "value": round(on_best / off_best, 4) if off_best else None,
+        "unit": "adaptive_on/off wall",
+        "wall_on_s": round(on_best, 4),
+        "wall_off_s": round(off_best, 4),
+        "lineitem_rows": n_rows,
+        "cutoff": cutoff,
+        "timed_runs": runs,
+        "dynamic_filters": {
+            "collected": m["filters_collected"],
+            "applied": m["filters_applied"],
+            "chunks_skipped": m["filter_chunks_skipped"],
+            "rows_scanned_without_filters": scanned_without,
+            "rows_scanned_with_filters": rows_in,
+            # fraction of probe-side rows never read: dynamic-only
+            # zone-map chunk pruning (no static predicate on lineitem)
+            "chunk_prune_fraction": round(
+                1 - rows_in / scanned_without, 4) if scanned_without
+            else 0.0,
+            # of the rows that WERE read, what the traced row filter cut
+            "row_prune_fraction": round(pruned / rows_in, 4)
+            if rows_in else 0.0,
+            "wait_timeouts": m["filter_wait_timeouts"],
+            "late_arrivals": m["filter_late_arrivals"],
+        },
+        "adaptive_exchange": {
+            "broadcast_flips": m["exchange_broadcast_flips"],
+            "side_swaps": m["exchange_side_swaps"],
+            "kept": m["exchange_kept"],
+        },
+    }
+    out["process_metrics"] = _process_metrics()
+    print(json.dumps(out))
+
+
 SERVE_SHAPES = [
     # (name, template, [value tuples cycled by the clients])
     ("q6p",
@@ -643,6 +776,8 @@ def main():
         return bench_spill(runs)
     if qname == "ft":
         return bench_ft(runs)
+    if qname == "aqe":
+        return bench_aqe(runs)
     sf = float(os.environ.get("BENCH_SF", "10"))
     sql = {"q1": Q1, "q6": Q6, "q6z": Q6, "q3g": Q3G, "q1g": Q1G,
            "q3k": Q3K}[qname]

@@ -368,11 +368,16 @@ class ValidateDependencies(Check):
         self._require_exprs(ctx, node, path, both, [node.filter],
                             "join filter")
         self._require(ctx, node, path, both, node.outputs, "join output")
-        for probe_name in node.dynamic_filters:
-            if probe_name not in lscope:
+        # the receiving side is the NON-PRESERVED one: probe (left) for
+        # INNER, build (right) for LEFT — see plan_dynamic_filters
+        recv_scope, recv_side = ((rscope, "build (right)")
+                                 if node.join_type == P.LEFT
+                                 else (lscope, "probe (left)"))
+        for recv_name in node.dynamic_filters:
+            if recv_name not in recv_scope:
                 ctx.add(self.code, node, path,
-                        f"dynamic filter probe column {probe_name!r} is "
-                        f"not produced by the probe (left) side")
+                        f"dynamic filter receiving column {recv_name!r} is "
+                        f"not produced by the {recv_side} side")
 
     def _visit_SemiJoinNode(self, node, path, ctx):
         sscope = self._produced(node.source)
@@ -820,9 +825,31 @@ class ValidateScanPushdown(Check):
 
         walk(root, "", None)
 
+    # bound -> the op plan_runtime_filter_pushdown pairs it with
+    _DYN_OPS = {"min": "gte", "max": "lte", "set": "eq"}
+
+    def _dyn_entry_ok(self, e, scan) -> bool:
+        """A runtime-filter marker entry re-derives from the scan's own
+        dynamic-filter annotation instead of a parent FilterNode: the
+        join that produced the filter id supplies the residual exactness,
+        so the entry only needs a matching (id, column, bound-op) triple
+        among scan.runtime_filters."""
+        from ..storage.pushdown import is_dyn_marker
+        val = e.get("value")
+        if not is_dyn_marker(val):
+            return False
+        _tag, fid, bound = val
+        if e.get("op") != self._DYN_OPS.get(bound):
+            return False
+        return any(rf.get("id") == fid
+                   and rf.get("column") == e.get("column")
+                   for rf in getattr(scan, "runtime_filters", None) or [])
+
     def _check_scan(self, scan, path, parent, ctx):
-        from ..storage.pushdown import PUSHDOWN_OPS, extract_pushdown
+        from ..storage.pushdown import (PUSHDOWN_OPS, extract_pushdown,
+                                        is_dyn_marker)
         assigned = {c.name for c in scan.assignments.values()}
+        static = []
         for e in scan.pushdown:
             col = e.get("column") if isinstance(e, dict) else None
             op = e.get("op") if isinstance(e, dict) else None
@@ -837,6 +864,14 @@ class ValidateScanPushdown(Check):
                         f"pushed-down predicate on {col!r} has op {op!r} "
                         f"(not range/equality-shaped: {PUSHDOWN_OPS})")
                 continue
+            if isinstance(e, dict) and is_dyn_marker(val):
+                if not self._dyn_entry_ok(e, scan):
+                    ctx.add(self.code, scan, path,
+                            f"runtime-filter marker {e!r} does not "
+                            f"re-derive from the scan's dynamic-filter "
+                            f"annotation (runtime_filters)")
+                continue        # dyn marker, resolved at prune time
+            static.append(e)
             if isinstance(val, (list, tuple)) and len(val) == 2 \
                     and val[0] == "param" and isinstance(val[1], int) \
                     and not isinstance(val[1], bool) and val[1] >= 0:
@@ -845,9 +880,11 @@ class ValidateScanPushdown(Check):
                 ctx.add(self.code, scan, path,
                         f"pushed-down predicate on {col!r} has "
                         f"non-numeric literal {val!r}")
+        if not static:
+            return              # only runtime-filter markers: no residual
         if not isinstance(parent, P.FilterNode):
             ctx.add(self.code, scan, path,
-                    f"scan claims {len(scan.pushdown)} pushed-down "
+                    f"scan claims {len(static)} pushed-down "
                     f"predicate(s) but its parent is "
                     f"{_kind(parent) if parent is not None else 'the root'}"
                     f", not a Filter — the residual filter that makes "
@@ -855,7 +892,7 @@ class ValidateScanPushdown(Check):
             return
         var_to_col = {v.name: c.name for v, c in scan.assignments.items()}
         derivable = extract_pushdown(parent.predicate, var_to_col)
-        for e in scan.pushdown:
+        for e in static:
             if isinstance(e, dict) and e not in derivable:
                 ctx.add(self.code, scan, path,
                         f"pushed-down predicate {e!r} does not appear "

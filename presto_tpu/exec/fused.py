@@ -215,7 +215,8 @@ class FusedChain:
         self.total_rows = sum(n for _, n in self.chunks)
         self._leaf_make: Dict[int, Callable] = {}
 
-    def chunks_for(self, expands: Tuple[int, ...]) -> List[Tuple[int, int]]:
+    def chunks_for(self, expands: Tuple[int, ...],
+                   meter: bool = False) -> List[Tuple[int, int]]:
         kprod = 1
         for k in expands:
             kprod *= k
@@ -236,8 +237,28 @@ class FusedChain:
             # that bake chunk counts into cached programs must recompute
             # this list per execution when self.params_pushdown is set
             from ..storage import prune_chunks
+            dyn = self.scan_meta.get("dyn_summaries")
+            detail: dict = {}
             chunks, _skipped = prune_chunks(
-                chunks, zm, pd, self.compiler.ctx.params_fingerprint)
+                chunks, zm, pd, self.compiler.ctx.params_fingerprint,
+                dyn() if dyn is not None else None, detail=detail)
+            if meter and detail.get("dyn_engaged"):
+                # fused chains never reach the streaming scan's row-level
+                # runtime filter, so chunk pruning IS the application
+                # here — meter it once per execution (callers pass
+                # meter=True only on their final pre-drain recompute)
+                from .adaptive import ADAPTIVE_METRICS
+                ADAPTIVE_METRICS.incr("filters_applied")
+                ADAPTIVE_METRICS.incr("filter_rows_in", detail["rows_in"])
+                ADAPTIVE_METRICS.incr("filter_rows_pruned",
+                                      detail["dyn_rows_pruned"])
+                ADAPTIVE_METRICS.incr("filter_chunks_skipped",
+                                      detail["dyn_chunks_pruned"])
+                rs = self.compiler.ctx.runtime_stats
+                if rs is not None:
+                    rs.add("dynamicFilterRowsIn", detail["rows_in"])
+                    rs.add("dynamicFilterRowsPruned",
+                           detail["dyn_rows_pruned"])
         return chunks
 
     def leaf_cap(self, expands: Tuple[int, ...]) -> int:
@@ -653,7 +674,7 @@ def fused_materialize(compiler, node: P.PlanNode,
         return None
     aux, expands, _deferred = prep_res
     leaf_cap = chain.leaf_cap(expands)
-    chunks = chain.chunks_for(expands)
+    chunks = chain.chunks_for(expands, meter=True)
     S = len(chunks)
     try:
         jax.eval_shape(lambda p, v: chain.make(p, v, aux, expands, leaf_cap),
@@ -838,7 +859,7 @@ def fused_stream(compiler, node: P.PlanNode):
     if chain.has_params:
         aux = aux[:-1] + (compiler.ctx.params,)
     if chain.params_pushdown:
-        chunks = chain.chunks_for(expands)
+        chunks = chain.chunks_for(expands, meter=True)
 
     def gen():
         acc = None

@@ -340,6 +340,36 @@ class ExecutionConfig:
     profile: bool = False
     # Config key telemetry.profile-dir; "" disables capture entirely
     profile_dir: str = "/tmp/presto_tpu_profiles"
+    # -- adaptive query execution (exec/adaptive.py) ----------------------
+    # master switch for runtime dynamic filters (config key
+    # optimizer.dynamic-filtering / session dynamic_filtering): completed
+    # build-side stages publish key-domain summaries that prune
+    # downstream scans at the zone-map level and through a traced row
+    # filter (bounds ride as jit args — no recompile on arrival);
+    # False = intra-task probe-side narrowing only
+    dynamic_filtering: bool = True
+    # bounded wall a remote scan task waits for an expected summary
+    # before proceeding unfiltered (dynamic-filtering.wait-timeout); a
+    # late or lost filter costs pruning opportunity, never a deadlock
+    dynamic_filtering_wait_timeout_s: float = 0.5
+    # distinct-value cap for exact set summaries
+    # (dynamic-filtering.max-distinct-values); past it a summary carries
+    # min/max bounds only
+    dynamic_filtering_max_distinct: int = 256
+    # re-decide broadcast-vs-partitioned exchange (and INNER join sides)
+    # at stage boundaries from OBSERVED build cardinality (config key
+    # adaptive.exchange / session adaptive_exchange)
+    adaptive_exchange: bool = True
+    # seed task counts, agg slot sizing, and admission memory estimates
+    # from matching query-history records keyed on the canonical plan
+    # template (adaptive.history-sizing / session adaptive_history_sizing)
+    adaptive_history_sizing: bool = False
+    # observed group count from a prior run of the same plan template
+    # (set by the runner's history-sizing pass, never by hand): when
+    # present it REPLACES the optimizer's group estimate for aggregation
+    # table sizing.  A dataclass field so the plan-cache config
+    # fingerprint re-keys compiled plans on a changed hint.
+    history_agg_groups: Optional[int] = None
 
 
 # legal scan.kernel / scan_kernel values (worker/properties.py and the
@@ -406,6 +436,13 @@ class TaskContext:
     # cached subtree contains parameter leaves
     params: Optional[Tuple] = None
     params_fingerprint: Optional[Tuple] = None
+    # runtime dynamic-filter summaries delivered by the scheduler (or
+    # the worker task-update channel): filter id -> DynamicFilterSummary
+    # wire dict (exec/adaptive.py).  The dict object is SHARED and
+    # mutated in place on delivery; scans read it lazily at split drain
+    # time, so a summary landing before a split's chunk list resolves
+    # still prunes (late binding, no recompile)
+    dynamic_filters: Dict[str, dict] = field(default_factory=dict)
 
 
 def _var_types(variables) -> List[Type]:
@@ -781,6 +818,17 @@ class PlanCompiler:
         # down (plan_scan_pushdown) — the parent FilterNode still runs,
         # so pruning only has to be conservative, not exact
         pushdown = [dict(e) for e in getattr(node, "pushdown", ())]
+        # runtime dynamic filters this scan may consume
+        # (plan_runtime_filter_pushdown); summaries land in
+        # ctx.dynamic_filters and are read LAZILY at drain time
+        runtime_filters = ([dict(e) for e in
+                            getattr(node, "runtime_filters", ())]
+                           if cfg.dynamic_filtering else [])
+
+        def dyn_summaries():
+            if not runtime_filters:
+                return None
+            return self.ctx.dynamic_filters or None
 
         def make_factory(cap2):
             """Pure scan kernel at an arbitrary chunk capacity (fused join
@@ -831,8 +879,73 @@ class PlanCompiler:
                 # conservative unsatisfiability rules
                 from ..storage import prune_chunks
                 out, _skipped = prune_chunks(out, zone_maps, pushdown,
-                                             self.ctx.params_fingerprint)
+                                             self.ctx.params_fingerprint,
+                                             dyn_summaries(),
+                                             keep_one=False)
             return out
+
+        # traced row-level runtime filter: summary bounds ride the jitted
+        # step as SCALAR ARGUMENTS (the PR 7 parameterization idiom), so
+        # one compiled program serves every bound and a summary arriving
+        # between splits engages without a recompile.  Only plain integer
+        # device columns qualify — dict codes and lazy row ids are not in
+        # stored key units.  A dropped row is one the annotated join
+        # would drop anyway (plan_runtime_filter_pushdown's guarantee).
+        rf_cols = []
+        if runtime_filters:
+            for e in runtime_filters:
+                for v, ch in node.assignments.items():
+                    if ch.name == e["column"]:
+                        rf_cols.append((e["id"], v.name))
+
+        def make_rf_step(name):
+            def _step(batch, lo, hi):
+                c = batch.columns[name]
+                keep = batch.mask & (c.values >= lo) & (c.values <= hi)
+                return batch.with_mask(keep), keep.sum(), batch.mask.sum()
+            return self.shared_jit((node.id, "rf", name), _step)
+
+        def apply_runtime_filters(batches):
+            engaged = False
+            rows_in = rows_out = None
+            for b in batches:
+                dyn = dyn_summaries()
+                if dyn:
+                    for fid, vname in rf_cols:
+                        s = dyn.get(fid)
+                        if not (isinstance(s, dict)
+                                and isinstance(s.get("min"), int)
+                                and isinstance(s.get("max"), int)):
+                            continue
+                        c = b.columns.get(vname)
+                        if c is None or c.dictionary is not None \
+                                or c.lazy is not None \
+                                or not jnp.issubdtype(c.values.dtype,
+                                                      jnp.integer):
+                            continue
+                        step = make_rf_step(vname)
+                        b, kept, inn = step(b, jnp.asarray(
+                            s["min"], c.values.dtype),
+                            jnp.asarray(s["max"], c.values.dtype))
+                        if not engaged:
+                            engaged = True
+                            from .adaptive import ADAPTIVE_METRICS
+                            ADAPTIVE_METRICS.incr("filters_applied")
+                        rows_in = inn if rows_in is None else rows_in + inn
+                        rows_out = (kept if rows_out is None
+                                    else rows_out + kept)
+                yield b
+            if engaged and rows_in is not None:
+                inn, out = jax.device_get(  # lint: allow-host-sync
+                    (rows_in, rows_out))
+                from .adaptive import ADAPTIVE_METRICS
+                ADAPTIVE_METRICS.incr("filter_rows_in", int(inn))
+                ADAPTIVE_METRICS.incr("filter_rows_pruned",
+                                      int(inn) - int(out))
+                rs = self.ctx.runtime_stats
+                if rs is not None:
+                    rs.add("dynamicFilterRowsIn", int(inn))
+                    rs.add("dynamicFilterRowsPruned", int(inn) - int(out))
 
         def split_gen(split):
                 for pos, n in split_chunks(split):
@@ -909,7 +1022,10 @@ class PlanCompiler:
                 return
             for split in splits:
                 yield from split_gen(split)
-        src = BatchSource(gen, names, types)
+
+        def gen_filtered():
+            yield from apply_runtime_filters(gen())
+        src = BatchSource(gen_filtered if rf_cols else gen, names, types)
         if not host and all(kind == "gen" for _n, _c, kind in dev):
             # whole-pipeline fusion metadata (see _fuse_scan_chain): the scan
             # is a pure jax function of (pos, valid) — an aggregation above a
@@ -928,6 +1044,9 @@ class PlanCompiler:
                 # host-side stats keyed by connector column name, matched
                 # against the scan's pushed-down conjuncts
                 "zone_maps": zone_maps, "pushdown": pushdown,
+                # runtime dynamic-filter summaries, read lazily so fused
+                # chunk pruning sees filters that arrive pre-drain
+                "dyn_summaries": dyn_summaries,
             }
         return src
 
@@ -1768,7 +1887,7 @@ class PlanCompiler:
                 # (traced argument: no retrace)
                 aux = aux[:-1] + (self.ctx.params,)
             leaf_cap = chain.leaf_cap(expands)
-            chunks = chain.chunks_for(expands)
+            chunks = chain.chunks_for(expands, meter=True)
             try:
                 probe = jax.eval_shape(
                     lambda p, v: chain.make(p, v, aux, expands, leaf_cap),
@@ -2201,7 +2320,16 @@ class PlanCompiler:
         # aggregate started at 4096 slots, the q21 shape).  ~2x headroom
         # for probing; clamped so a wild overestimate cannot blow HBM.
         initial_slots = cfg.agg_slots
-        if key_names:
+        if key_names and cfg.history_agg_groups:
+            # history-based sizing (adaptive.history-sizing): the OBSERVED
+            # group count from a prior run of this plan template beats any
+            # estimate, and — being a measurement, not a guess — may size
+            # BELOW agg_slots too (floored so a tiny group count cannot
+            # degenerate the probe sequence)
+            hist_based = 1 << max(0, (int(2 * cfg.history_agg_groups)
+                                      - 1).bit_length())
+            initial_slots = max(256, min(hist_based, 1 << 20))
+        elif key_names:
             try:
                 from ..sql.stats import StatsCalculator
                 est_groups = StatsCalculator().rows(node)
@@ -2956,7 +3084,11 @@ class PlanCompiler:
         df_cache: dict = {}
 
         def make_dynamic_filter(build_batch):
-            if not node.dynamic_filters or build_batch is None:
+            # INNER only: LEFT joins carry dynamic_filters keyed by their
+            # BUILD variables (the probe is preserved and must never be
+            # narrowed — see plan_dynamic_filters' direction convention)
+            if node.join_type != P.INNER or not node.dynamic_filters \
+                    or build_batch is None:
                 return None
             pairs = [(l.name, r.name) for l, r in node.criteria]
             numeric = [(ln, rn) for ln, rn in pairs

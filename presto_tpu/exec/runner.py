@@ -7,6 +7,7 @@ planner correctness tests and as the execution core the worker shell drives.
 """
 from __future__ import annotations
 
+import itertools
 from dataclasses import dataclass
 from typing import Dict, List, Optional
 
@@ -37,6 +38,17 @@ class QueryResult:
             (v is None, str(type(v)), v) for v in r))
 
 
+def plan_template_digest(template_sk) -> str:
+    """Stable short digest of a parameterized plan's structural key — the
+    join key between a query-history record ("planTemplate") and a later
+    run of the same canonical plan (adaptive.history-sizing)."""
+    import hashlib
+    return hashlib.sha256(repr(template_sk).encode()).hexdigest()[:16]
+
+
+_history_qid = itertools.count()
+
+
 def pages_to_result(pages, names, types) -> "QueryResult":
     """Decode host pages into a QueryResult row list."""
     rows: List[List] = []
@@ -65,12 +77,18 @@ class LocalQueryRunner:
     def __init__(self, schema: str = "sf0.01",
                  config: Optional[ExecutionConfig] = None,
                  catalog: str = "tpch", tracer_provider=None,
-                 plan_cache=None):
+                 plan_cache=None, history=None):
         from ..serving import GLOBAL_PLAN_CACHE
         self.schema = schema
         self.catalog = catalog
         self.tracer_provider = tracer_provider   # utils.runtime_stats
         self.config = config or tuned_config()
+        # optional telemetry.history.QueryHistoryStore: successful runs
+        # record template-keyed observations, and — when the
+        # adaptive.history-sizing knob is on — a repeat of the same plan
+        # template seeds its aggregation-table size from the record
+        self.history = history
+        self._last_template_digest: Optional[str] = None
         # canonical plan/executable cache (presto_tpu/serving): keyed by
         # catalog + schema + config fingerprint + the structural key of
         # the PARAMETERIZED pre-optimizer plan, so re-executions with
@@ -119,7 +137,12 @@ class LocalQueryRunner:
         # the template in place) — it must match what the prepared fast
         # path re-derives from its recorded template_key
         template_sk = P.structural_key(pp.template)
-        key = cache_key_from_parts(template_sk, self.config, self.catalog,
+        self._last_template_digest = plan_template_digest(template_sk)
+        # adaptive.history-sizing: the effective config may carry the
+        # prior run's observed group count — a fingerprinted field, so
+        # the cache key below re-keys on a changed hint
+        cfg = self._history_sized_config()
+        key = cache_key_from_parts(template_sk, cfg, self.catalog,
                                    self.schema)
         hit = self.plan_cache.checkout(key)
         if hit is not None:
@@ -128,14 +151,14 @@ class LocalQueryRunner:
                 # pooled compilers all checked out by concurrent
                 # executions: rebuild one from the cached template —
                 # parse/plan/optimize were still skipped
-                compiler = PlanCompiler(TaskContext(config=self.config))
+                compiler = PlanCompiler(TaskContext(config=cfg))
                 SERVING_METRICS.incr("executable_builds")
             exe = _Execution(output, compiler, key, False,
                              list(slot_types))
         else:
             with stats.record_wall("queryOptimize"), self._validation():
                 output = Planner.optimize_output(pp.template)
-            compiler = PlanCompiler(TaskContext(config=self.config))
+            compiler = PlanCompiler(TaskContext(config=cfg))
             SERVING_METRICS.incr("executable_builds")
             exe = _Execution(output, compiler, key, True,
                              [s.type for s in pp.slots])
@@ -166,6 +189,67 @@ class LocalQueryRunner:
                                    exe.compiler)
         else:
             self.plan_cache.checkin(exe.key, exe.compiler)
+
+    # -- history-based sizing (adaptive.history-sizing) -------------------
+
+    def _history_record(self) -> Optional[dict]:
+        if (self.history is None or self._last_template_digest is None
+                or not self.config.adaptive_history_sizing):
+            return None
+        return self.history.find_by_template(self._last_template_digest)
+
+    def _history_sized_config(self) -> ExecutionConfig:
+        """A prior FINISHED run of the same plan template seeds the
+        aggregation table size: the observed group count replaces the
+        optimizer's estimate (exec/pipeline.py initial_slots)."""
+        rec = self._history_record()
+        groups = (rec or {}).get("aggGroups")
+        if not groups:
+            return self.config
+        import dataclasses
+
+        from .adaptive import ADAPTIVE_METRICS
+        ADAPTIVE_METRICS.incr("history_sized_queries")
+        return dataclasses.replace(self.config,
+                                   history_agg_groups=int(groups))
+
+    def _record_history(self, result: QueryResult, root,
+                        subplan=None) -> None:
+        """Record one template-keyed observation after a successful run.
+        aggGroups is recorded only when the output chain is
+        Output -> (Project|Sort)* -> grouped Aggregation, where the
+        result row count IS the observed group count."""
+        if self.history is None or self._last_template_digest is None:
+            return
+        from ..spi import plan as P
+        node = getattr(root, "source", None)
+        while isinstance(node, (P.ProjectNode, P.SortNode,
+                                P.RemoteSourceNode)):
+            if isinstance(node, P.RemoteSourceNode):
+                # distributed: the chain continues in the (sole) child
+                # fragment feeding this gather edge
+                if subplan is None or len(node.source_fragment_ids) != 1:
+                    break
+                by_id = {c.fragment.fragment_id: c
+                         for c in subplan.children}
+                child = by_id.get(node.source_fragment_ids[0])
+                if child is None:
+                    break
+                subplan, node = child, child.fragment.root
+            else:
+                node = node.source
+        rec = {"queryId": f"run-{next(_history_qid)}",
+               "state": "FINISHED",
+               "planTemplate": self._last_template_digest,
+               "rows": len(result.rows),
+               "peakMemoryBytes": getattr(result, "peak_memory_bytes",
+                                          0) or 0}
+        if isinstance(node, P.AggregationNode) and node.grouping_keys:
+            rec["aggGroups"] = len(result.rows)
+        try:
+            self.history.record(rec)
+        except Exception:   # noqa: BLE001 — history is advisory
+            pass
 
     # -- prepared statements ----------------------------------------------
 
@@ -198,6 +282,8 @@ class LocalQueryRunner:
             except BindError:
                 values = None
             if values is not None:
+                self._last_template_digest = \
+                    plan_template_digest(fast.template_key)
                 key = cache_key_from_parts(fast.template_key, self.config,
                                            self.catalog, self.schema)
                 hit = self.plan_cache.checkout(key)
@@ -278,6 +364,7 @@ class LocalQueryRunner:
         if tracer:
             tracer.end_trace("query finished")
         self._release(exe)
+        self._record_history(result, output)
         return result
 
     def execute_streaming(self, sql: str,
@@ -507,14 +594,18 @@ class DistributedQueryRunner(LocalQueryRunner):
     def __init__(self, schema: str = "sf0.01",
                  config: Optional[ExecutionConfig] = None,
                  n_tasks: int = 2, broadcast_threshold: int = 600_000,
-                 catalog: str = "tpch", mesh=None, tracer_provider=None):
+                 catalog: str = "tpch", mesh=None, tracer_provider=None,
+                 history=None):
         super().__init__(schema, config, catalog,
-                         tracer_provider=tracer_provider)
+                         tracer_provider=tracer_provider, history=history)
         self.n_tasks = n_tasks
         self.broadcast_threshold = broadcast_threshold
         # jax.sharding.Mesh: hashed exchanges between stages whose task
         # count equals the mesh size run as ICI all_to_all collectives
         self.mesh = mesh
+        # history-seeded hash-stage task count for the CURRENT query
+        # (adaptive.history-sizing); None means use n_tasks
+        self._history_tasks: Optional[int] = None
 
     # materialized exchanges can't stay device-resident; overridden by
     # BatchQueryRunner so fabric resolution demotes its edges to http
@@ -617,17 +708,23 @@ class DistributedQueryRunner(LocalQueryRunner):
 
         from ..telemetry import profile_capture
         from .scheduler import InProcessScheduler
-        subplan, names, types = self.plan_subplan(sql, ast=ast)
-        sched = InProcessScheduler(self._scheduler_config())
-        tracer = self.tracer_provider.new_tracer(sql) \
-            if self.tracer_provider else None
-        if tracer is not None:
-            sched.tracer = tracer
-        with (tracer.span("query", sql=sql) if tracer else nullcontext()):
-            with profile_capture(self.config.profile_dir, "query",
-                                 enabled=self.config.profile) as trace_dir:
-                result = pages_to_result(sched.execute(subplan), names,
-                                         types)
+        restore = self._apply_history_sizing(ast)
+        try:
+            subplan, names, types = self.plan_subplan(sql, ast=ast)
+            sched = InProcessScheduler(self._scheduler_config())
+            tracer = self.tracer_provider.new_tracer(sql) \
+                if self.tracer_provider else None
+            if tracer is not None:
+                sched.tracer = tracer
+            with (tracer.span("query", sql=sql) if tracer
+                  else nullcontext()):
+                with profile_capture(self.config.profile_dir, "query",
+                                     enabled=self.config.profile) \
+                        as trace_dir:
+                    result = pages_to_result(sched.execute(subplan),
+                                             names, types)
+        finally:
+            restore()
         result.profile_trace_dir = trace_dir
         # fabric-tagged exchange stats (bytes / walls per fabric) collected
         # while the result drained
@@ -637,13 +734,65 @@ class DistributedQueryRunner(LocalQueryRunner):
                                     if sched.memory is not None else 0)
         if tracer:
             tracer.end_trace("query finished")
+        self._record_history(result, subplan.fragment.root, subplan=subplan)
         return result
+
+    def _apply_history_sizing(self, ast):
+        """adaptive.history-sizing (distributed): parameterize the plan
+        to its template digest; when a prior FINISHED run matches, seed
+        the aggregation-table hint (config, consumed by every task's
+        compiler) and the hash-stage task count from what that run
+        observed.  Returns a restore callback for the per-query state."""
+        self._last_template_digest = None
+        if self.history is None:
+            return lambda: None
+        from ..spi import plan as P
+        from ..sql.canonical import parameterize
+        try:
+            with self._validation():
+                unopt = Planner(default_schema=self.schema,
+                                default_catalog=self.catalog) \
+                    .plan_query_unoptimized(ast)
+            self._last_template_digest = plan_template_digest(
+                P.structural_key(parameterize(unopt).template))
+        except Exception:   # noqa: BLE001 — sizing is advisory
+            return lambda: None
+        rec = self._history_record()
+        if rec is None:
+            return lambda: None
+        import dataclasses
+
+        from .adaptive import ADAPTIVE_METRICS
+        saved_cfg, saved_tasks = self.config, self._history_tasks
+        changed = False
+        groups = rec.get("aggGroups")
+        if groups:
+            self.config = dataclasses.replace(
+                self.config, history_agg_groups=int(groups))
+            changed = True
+        rows = rec.get("rows")
+        if rows is not None:
+            # one hash task per ~500k observed output rows: a repeat of
+            # a small query skips the fan-out cost the planned
+            # parallelism assumed (never raised above n_tasks)
+            seeded = max(1, min(self.n_tasks, -(-int(rows) // 500_000)))
+            if seeded != self.n_tasks:
+                self._history_tasks = seeded
+                changed = True
+        if changed:
+            ADAPTIVE_METRICS.incr("history_sized_queries")
+
+        def restore():
+            self.config, self._history_tasks = saved_cfg, saved_tasks
+        return restore
 
     def _scheduler_config(self):
         from .scheduler import SchedulerConfig
         return SchedulerConfig(
             exec_config=self.config, source_tasks=self.n_tasks,
-            hash_tasks=self.n_tasks, mesh=self.mesh)
+            hash_tasks=self._history_tasks or self.n_tasks,
+            mesh=self.mesh,
+            broadcast_threshold=self.broadcast_threshold)
 
 
 class BatchQueryRunner(DistributedQueryRunner):

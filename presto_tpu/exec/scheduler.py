@@ -26,6 +26,10 @@ from ..common.page import Page
 from ..common.types import (CharType, Type, VarcharType)
 from ..connectors import catalog, tpch
 from ..spi import plan as P
+from .adaptive import (AdaptiveState, DynamicFilterCollector,
+                       DynamicFilterSummary, ExchangeDecision,
+                       decide_exchange, decide_side_swap,
+                       summaries_to_runtime, summarize_key_column)
 from .pipeline import ExecutionConfig, PlanCompiler, TaskContext
 
 
@@ -36,6 +40,10 @@ class SchedulerConfig:
     source_tasks: int = 2
     # tasks per FIXED_HASH intermediate stage
     hash_tasks: int = 2
+    # broadcast row budget for runtime partitioned->broadcast flips
+    # (exec/adaptive.decide_exchange) — mirrors the fragmenter's
+    # plan-time FragmenterConfig.broadcast_threshold
+    broadcast_threshold: int = 600_000
     # jax.sharding.Mesh over parallel.mesh.WORKER_AXIS: when set and a
     # hashed stage's task count equals the mesh size, tasks are pinned
     # 1:1 to mesh devices and the hash exchange runs as a jitted
@@ -219,6 +227,10 @@ class OutputBuffers:
 
     def __init__(self, n_tasks: int, n_partitions: int, broadcast: bool):
         self.broadcast = broadcast
+        # runtime partitioned->broadcast flip (InProcessScheduler.
+        # _adapt_exchanges): every consumer reads the UNION of the hash
+        # partitions — the full producer output — instead of its slice
+        self.read_all = False
         self.pages: List[Dict[int, List[Page]]] = [
             {p: [] for p in range(max(1, n_partitions))}
             for _ in range(n_tasks)]
@@ -267,8 +279,13 @@ class OutputBuffers:
                 parts[p] = _FilePages(path, len(pages))
 
     def pages_for_consumer(self, consumer_task: int) -> List[Page]:
-        part = 0 if self.broadcast else consumer_task
         out: List[Page] = []
+        if self.read_all:
+            for task_pages in self.pages:
+                for part in sorted(task_pages):
+                    out.extend(task_pages[part])
+            return out
+        part = 0 if self.broadcast else consumer_task
         for task_pages in self.pages:
             out.extend(task_pages.get(part, ()))
         return out
@@ -332,6 +349,14 @@ class InProcessScheduler:
         # their tasks sequentially, so the shared pool never sees two
         # tasks' peaks stacked.
         self.memory: Optional["MemoryContext"] = None
+        # adaptive execution: the per-query dynamic-filter collector plus
+        # the exchange-decision log (exec/adaptive.py).  _dyn_filters is
+        # the SHARED wire-form map handed to every TaskContext — scans
+        # read it lazily, so summaries collected from a finished build
+        # stage prune scans of later stages without any recompile.
+        self.adaptive = AdaptiveState(DynamicFilterCollector(
+            self.config.exec_config.dynamic_filtering_max_distinct))
+        self._dyn_filters: Dict[str, dict] = {}
 
     # -- planning the stage tree -----------------------------------------
     def _build_stages(self, subplan: P.SubPlan) -> StageInfo:
@@ -430,9 +455,105 @@ class InProcessScheduler:
             base = self.config.temp_dir
         return os.path.join(base, f"stage_{fragment_id}")
 
+    # -- adaptive exchange strategy ---------------------------------------
+    def _observed_rows(self, side, child_by_fid) -> Optional[int]:
+        """Rows a completed child stage actually produced behind one join
+        side, or None when they cannot be counted without device syncs /
+        file reads (ICI device output, batch-mode shuffle files) or the
+        side is not a direct remote source."""
+        while isinstance(side, P.FilterNode):
+            side = side.source
+        if not isinstance(side, P.RemoteSourceNode):
+            return None
+        total = 0
+        for fid in side.source_fragment_ids:
+            ch = child_by_fid.get(fid)
+            if ch is None or ch.buffers is None \
+                    or ch.device_out is not None:
+                return None
+            for task_pages in ch.buffers.pages:
+                for pages in task_pages.values():
+                    if not isinstance(pages, list):
+                        return None
+                    total += sum(p.position_count for p in pages)
+        return total
+
+    def _adapt_exchanges(self, stage: StageInfo) -> None:
+        """Re-decide exchange strategy at the stage boundary, AFTER the
+        producer stages ran but BEFORE this consumer stage launches —
+        the point where observed cardinality is free and the decision is
+        still cheap to change (reference: adaptive join reordering /
+        runtime broadcast in Presto-on-Spark's adaptive mode).
+
+        Two moves, both plan mutations on the consumer fragment only:
+
+        - INNER side swap: when the observed build is far larger than
+          the observed probe, build the probe instead (same hash, same
+          partition alignment — only the roles flip).
+        - partitioned -> broadcast: when the observed build undershoots
+          the planner's estimate by ADAPTIVE_RATIO and fits the
+          broadcast threshold, every consumer task reads the UNION of
+          the build's hash partitions (OutputBuffers.read_all) so the
+          downstream join sees the full build side; the probe stays
+          partitioned, so no output row duplicates.  FULL joins are
+          excluded — their unmatched-build emission would duplicate
+          across tasks."""
+        if not self.config.exec_config.adaptive_exchange:
+            return
+        child_by_fid = {c.fragment.fragment_id: c
+                        for c in stage.children}
+        for node in P.walk_plan(stage.fragment.root):
+            if not isinstance(node, P.JoinNode) \
+                    or node.distribution != P.PARTITIONED \
+                    or node.join_type not in (P.INNER, P.LEFT):
+                continue
+            observed_b = self._observed_rows(node.right, child_by_fid)
+            observed_p = self._observed_rows(node.left, child_by_fid)
+            acted = False
+            if node.join_type == P.INNER and observed_b is not None \
+                    and observed_p is not None \
+                    and decide_side_swap(observed_p, observed_b):
+                node.left, node.right = node.right, node.left
+                node.criteria = [(r, l) for l, r in node.criteria]
+                detail = (f"planned build {observed_b} rows >= 2x "
+                          f"probe {observed_p}; sides swapped")
+                observed_p, observed_b = observed_b, observed_p
+                self.adaptive.record(ExchangeDecision(
+                    node.id, "swap_sides", node.planned_build_rows,
+                    observed_b, detail))
+                self.stats.add("adaptiveSideSwaps", 1)
+                acted = True
+            if observed_b is not None and decide_exchange(
+                    node.planned_build_rows, observed_b,
+                    self.config.broadcast_threshold):
+                side = node.right
+                while isinstance(side, P.FilterNode):
+                    side = side.source
+                for fid in side.source_fragment_ids:
+                    child_by_fid[fid].buffers.read_all = True
+                node.distribution = P.REPLICATED
+                self.adaptive.record(ExchangeDecision(
+                    node.id, "broadcast", node.planned_build_rows,
+                    observed_b,
+                    f"observed {observed_b} rows vs planned "
+                    f"{node.planned_build_rows}"))
+                self.stats.add("adaptiveExchangeFlips", 1)
+                acted = True
+            if not acted and observed_b is not None:
+                self.adaptive.record(ExchangeDecision(
+                    node.id, "keep", node.planned_build_rows, observed_b))
+
     def _run_stage(self, stage: StageInfo) -> None:
-        for child in stage.children:
+        # dynamic-filter producers run before sibling consumers: stage
+        # execution here is sequential bottom-up, so finishing the build
+        # side first means its summaries are already collected when the
+        # probe-side scan stage launches (the HTTP runtime instead waits
+        # the bounded dynamic-filtering.wait-timeout — worker/task.py)
+        for child in sorted(
+                stage.children,
+                key=lambda c: not c.fragment.dynamic_filter_sources):
             self._run_stage(child)
+        self._adapt_exchanges(stage)
         frag = stage.fragment
         scheme = frag.output_partitioning_scheme
         out_names = [v.name for v in frag.root.output_variables]
@@ -440,6 +561,18 @@ class InProcessScheduler:
         key_indices = [out_names.index(a.name) for a in scheme.arguments]
         hashed = scheme.handle == P.FIXED_HASH_DISTRIBUTION
         stage.out_names = out_names
+
+        # producer-side dynamic-filter summarization: the fragmenter
+        # marked which of this fragment's output columns feed downstream
+        # filters (PlanFragment.dynamic_filter_sources); each task folds
+        # its output pages into one summary per filter id as they stream
+        max_distinct = \
+            self.config.exec_config.dynamic_filtering_max_distinct
+        dyn_idx: List[Tuple[int, str]] = (
+            [(out_names.index(col), fid)
+             for col, fid in frag.dynamic_filter_sources.items()
+             if col in out_names]
+            if self.config.exec_config.dynamic_filtering else [])
 
         # fabric resolution happened in _plan_fabrics (SURVEY.md §5.8:
         # intra-pod hash exchange rides ICI; gather / broadcast /
@@ -543,7 +676,8 @@ class InProcessScheduler:
                               task_index=task_index,
                               shared_jits=stage_jits,
                               memory=task_mem,
-                              runtime_stats=self.stats)
+                              runtime_stats=self.stats,
+                              dynamic_filters=self._dyn_filters)
             if self.node_stats is not None:
                 # EXPLAIN ANALYZE: per-node operator stats, merged into
                 # the query-level rollup after the task drains
@@ -575,8 +709,13 @@ class InProcessScheduler:
                 if self.tracer is not None else contextlib.nullcontext())
             out = None
             split_wall, split_bytes = 0.0, 0
+            task_sums: Dict[str, object] = {}
             with span_ctx, dev_ctx:
                 if ici:
+                    # device path: output stays device-resident; a host
+                    # summarization sync here would serialize the async
+                    # exchange dispatch, so ICI edges publish nothing
+                    # (absent summary == unknown == prune nothing)
                     from .pipeline import _compact_concat
                     batches = [b for b in
                                compiler.run_to_batches(frag.root)]
@@ -587,6 +726,12 @@ class InProcessScheduler:
                             raise StageAbortedError(
                                 f"sibling task of stage "
                                 f"{frag.fragment_id} failed")
+                        for j, fid in dyn_idx:
+                            s = _summarize_page_block(
+                                fid, page.blocks[j], max_distinct)
+                            prev = task_sums.get(fid)
+                            task_sums[fid] = s if prev is None \
+                                else prev.merge(s, max_distinct)
                         if hashed and stage.n_partitions > 1:
                             s0 = _time.perf_counter()  # lint: allow-wall-clock
                             targets = partition_targets(
@@ -601,6 +746,17 @@ class InProcessScheduler:
                             split_bytes += _page_bytes(page)
                         else:
                             stage.buffers.add(task_index, 0, page)
+            if dyn_idx and not ici:
+                # a task that produced no pages still publishes EMPTY
+                # summaries — a zero-row build side legitimately prunes
+                # every downstream chunk (min>max convention), which is
+                # different from "never heard back" (prunes nothing)
+                for _j, fid in dyn_idx:
+                    if fid not in task_sums:
+                        task_sums[fid] = DynamicFilterSummary(
+                            fid, row_count=0)
+                for s in task_sums.values():
+                    self.adaptive.collector.publish(s)
             if self.node_stats is not None and ctx.stats:
                 with self._stats_lock:
                     merge_node_stats(self.node_stats, ctx.stats)
@@ -707,6 +863,19 @@ class InProcessScheduler:
         stage.task_walls = [round(r[1], 4) for r in results]
         stage.stage_wall = round(
             _time.perf_counter() - stage_t0, 4)  # lint: allow-wall-clock
+        if dyn_idx and not ici:
+            # the stage is complete, so each filter's merged summary is
+            # final: expose it to every LATER stage's tasks through the
+            # shared wire-form map (late binding — scans read it at
+            # split drain time)
+            ready = {}
+            for _j, fid in dyn_idx:
+                s = self.adaptive.collector.get(fid)
+                if s is not None:
+                    ready[fid] = s
+            if ready:
+                self._dyn_filters.update(summaries_to_runtime(ready))
+                self.stats.add("dynamicFiltersCollected", len(ready))
         if ici:
             keys = tuple(out_names[i] for i in key_indices)
             if not self._ici_exchange(stage, task_batches, keys):
@@ -888,6 +1057,23 @@ class InProcessScheduler:
                     split_page(page, targets, stage.n_partitions)):
                 if sub is not None:
                     stage.buffers.add(task_index, p, sub)
+
+
+def _summarize_page_block(fid: str, block: Block,
+                          max_distinct: int) -> DynamicFilterSummary:
+    """Dynamic-filter summary over one output page column (host blocks).
+    Variable-width (string) keys publish the row count only: zone maps
+    hold stored-unit ints, but a zero-row build side still prunes
+    everything downstream via the empty-summary convention."""
+    flat = decode_to_flat(block)
+    if isinstance(flat, FixedWidthBlock):
+        mask = ~flat.null_mask() if flat.may_have_null else None
+        return summarize_key_column(fid, flat.values, mask, max_distinct)
+    n = len(flat.offsets) - 1 if isinstance(flat, VariableWidthBlock) \
+        else 0
+    if getattr(flat, "nulls", None) is not None:
+        n = int(n - np.count_nonzero(flat.nulls))
+    return DynamicFilterSummary(fid, row_count=max(0, n))
 
 
 def _batch_meta(b) -> tuple:

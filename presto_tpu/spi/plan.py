@@ -169,6 +169,12 @@ class TableScanNode(PlanNode):
     # zone-map chunk skipping; the filter itself stays in the plan.
     # Validated by analysis/checker.py (SCAN_PUSHDOWN).
     pushdown: List[dict] = field(default_factory=list)
+    # runtime dynamic filters this scan may consume, planned by
+    # sql/optimizer.plan_runtime_filter_pushdown:
+    # [{"id": filter_id, "column": column_name}, ...].  Each entry also
+    # appends ["dyn", id, bound] marker rows to `pushdown`, resolved at
+    # prune time from summaries a completed build stage published.
+    runtime_filters: List[dict] = field(default_factory=list)
 
     @property
     def output_variables(self):
@@ -182,6 +188,8 @@ class TableScanNode(PlanNode):
         if self.pushdown:
             # emitted only when present: golden plan JSON stays stable
             d["pushdown"] = [dict(e) for e in self.pushdown]
+        if self.runtime_filters:
+            d["runtimeFilters"] = [dict(e) for e in self.runtime_filters]
         return d
 
     @classmethod
@@ -190,7 +198,8 @@ class TableScanNode(PlanNode):
                    _vars_from_dict(d["outputVariables"]),
                    {RowExpression.from_dict(e["variable"]): ColumnHandle.from_dict(e["column"])
                     for e in d["assignments"]},
-                   [dict(e) for e in d.get("pushdown", [])])
+                   [dict(e) for e in d.get("pushdown", [])],
+                   [dict(e) for e in d.get("runtimeFilters", [])])
 
 
 @_node
@@ -319,10 +328,17 @@ class JoinNode(PlanNode):
     outputs: List[Variable]
     filter: Optional[RowExpression] = None
     distribution: Optional[str] = None  # PARTITIONED / REPLICATED
-    # dynamic filter id per probe key (reference JoinNode.dynamicFilters /
-    # DynamicFilterSourceOperator): the executor narrows the probe side to
-    # the build side's key domain before probing
+    # dynamic filter id per RECEIVING key variable (reference
+    # JoinNode.dynamicFilters / DynamicFilterSourceOperator).  Direction
+    # depends on join type — the filter may only drop rows from a
+    # NON-PRESERVED side: INNER keys are probe (left) variables narrowed
+    # by the build domain; LEFT keys are build (right) variables narrowed
+    # by the probe domain (the probe is preserved and must never shrink).
     dynamic_filters: Dict[str, str] = field(default_factory=dict)
+    # the fragmenter's build-side row estimate at exchange-decision time;
+    # exec/adaptive.decide_exchange compares it against the observed
+    # count at the stage boundary
+    planned_build_rows: Optional[int] = None
 
     @property
     def sources(self):
@@ -333,14 +349,17 @@ class JoinNode(PlanNode):
         return self.outputs
 
     def _to_dict(self):
-        return {"type": self.join_type, "left": self.left.to_dict(),
-                "right": self.right.to_dict(),
-                "criteria": [{"left": l.to_dict(), "right": r.to_dict()}
-                             for l, r in self.criteria],
-                "outputVariables": _vars_to_dict(self.outputs),
-                "filter": self.filter.to_dict() if self.filter else None,
-                "distributionType": self.distribution,
-                "dynamicFilters": dict(self.dynamic_filters)}
+        d = {"type": self.join_type, "left": self.left.to_dict(),
+             "right": self.right.to_dict(),
+             "criteria": [{"left": l.to_dict(), "right": r.to_dict()}
+                          for l, r in self.criteria],
+             "outputVariables": _vars_to_dict(self.outputs),
+             "filter": self.filter.to_dict() if self.filter else None,
+             "distributionType": self.distribution,
+             "dynamicFilters": dict(self.dynamic_filters)}
+        if self.planned_build_rows is not None:
+            d["plannedBuildRows"] = self.planned_build_rows
+        return d
 
     @classmethod
     def _from_dict(cls, d):
@@ -351,7 +370,8 @@ class JoinNode(PlanNode):
                    _vars_from_dict(d["outputVariables"]),
                    RowExpression.from_dict(d["filter"]) if d.get("filter") else None,
                    d.get("distributionType"),
-                   d.get("dynamicFilters", {}))
+                   d.get("dynamicFilters", {}),
+                   d.get("plannedBuildRows"))
 
 
 @_node
@@ -362,6 +382,10 @@ class SemiJoinNode(PlanNode):
     source_join_variable: Variable
     filtering_source_join_variable: Variable
     semi_join_output: Variable
+    # dynamic filter id keyed by the SOURCE join variable, set only when
+    # the membership marker is consumed as a positive filter conjunct
+    # (so source rows outside the filtering-source domain are droppable)
+    dynamic_filters: Dict[str, str] = field(default_factory=dict)
 
     @property
     def sources(self):
@@ -372,11 +396,15 @@ class SemiJoinNode(PlanNode):
         return self.source.output_variables + [self.semi_join_output]
 
     def _to_dict(self):
-        return {"source": self.source.to_dict(),
-                "filteringSource": self.filtering_source.to_dict(),
-                "sourceJoinVariable": self.source_join_variable.to_dict(),
-                "filteringSourceJoinVariable": self.filtering_source_join_variable.to_dict(),
-                "semiJoinOutput": self.semi_join_output.to_dict()}
+        d = {"source": self.source.to_dict(),
+             "filteringSource": self.filtering_source.to_dict(),
+             "sourceJoinVariable": self.source_join_variable.to_dict(),
+             "filteringSourceJoinVariable": self.filtering_source_join_variable.to_dict(),
+             "semiJoinOutput": self.semi_join_output.to_dict()}
+        if self.dynamic_filters:
+            # emitted only when present: golden plan JSON stays stable
+            d["dynamicFilters"] = dict(self.dynamic_filters)
+        return d
 
     @classmethod
     def _from_dict(cls, d):
@@ -384,7 +412,8 @@ class SemiJoinNode(PlanNode):
                    PlanNode.from_dict(d["filteringSource"]),
                    RowExpression.from_dict(d["sourceJoinVariable"]),
                    RowExpression.from_dict(d["filteringSourceJoinVariable"]),
-                   RowExpression.from_dict(d["semiJoinOutput"]))
+                   RowExpression.from_dict(d["semiJoinOutput"]),
+                   d.get("dynamicFilters", {}))
 
 
 # Exchange (reference sql/planner/plan/ExchangeNode.java)
@@ -853,19 +882,27 @@ class PlanFragment:
     output_partitioning_scheme: PartitioningScheme
     # table-scan node ids in this fragment that receive splits
     partitioned_sources: List[str] = field(default_factory=list)
+    # output column name -> dynamic filter id: this fragment's output is
+    # a dynamic-filter SOURCE, so its tasks summarize the named column's
+    # domain on completion (sql/fragmenter.plan_dynamic_filter_sources)
+    dynamic_filter_sources: Dict[str, str] = field(default_factory=dict)
 
     def to_dict(self):
-        return {"id": self.fragment_id, "root": self.root.to_dict(),
-                "partitioning": self.partitioning,
-                "outputPartitioningScheme": self.output_partitioning_scheme.to_dict(),
-                "partitionedSources": self.partitioned_sources}
+        d = {"id": self.fragment_id, "root": self.root.to_dict(),
+             "partitioning": self.partitioning,
+             "outputPartitioningScheme": self.output_partitioning_scheme.to_dict(),
+             "partitionedSources": self.partitioned_sources}
+        if self.dynamic_filter_sources:
+            d["dynamicFilterSources"] = dict(self.dynamic_filter_sources)
+        return d
 
     @staticmethod
     def from_dict(d):
         return PlanFragment(
             d["id"], PlanNode.from_dict(d["root"]), d["partitioning"],
             PartitioningScheme.from_dict(d["outputPartitioningScheme"]),
-            d.get("partitionedSources", []))
+            d.get("partitionedSources", []),
+            d.get("dynamicFilterSources", {}))
 
 
 @_node
@@ -1005,6 +1042,11 @@ def structural_key(node: PlanNode, canonical_params: bool = False) -> str:
                     # blanked like node ids — two decorrelated copies
                     # differing only in filter numbering are the same plan
                     out[k] = sorted(rename.get(n, n) for n in v)
+                elif k == "runtimeFilters" and isinstance(v, list):
+                    # filter ids blanked like node ids; columns are
+                    # physical names, kept as-is
+                    out[k] = sorted(
+                        (e.get("column"), "") for e in v if isinstance(e, dict))
                 else:
                     out[k] = canon(v)
             return out
@@ -1012,6 +1054,10 @@ def structural_key(node: PlanNode, canonical_params: bool = False) -> str:
             if (canonical_params and len(x) == 2 and x[0] == "param"
                     and isinstance(x[1], int)):
                 return ["param", pidx(x[1])]
+            if len(x) == 3 and x[0] == "dyn":
+                # runtime-filter pushdown marker: the planner-counter
+                # filter id is blanked like node ids
+                return ["dyn", "", x[2]]
             return [canon(i) for i in x]
         return x
 

@@ -220,6 +220,30 @@ def format_analyze_footer(runtime_stats, profile_dir: str = None) -> str:
         # spooled exchange before the producers acknowledged them
         lines.append(f"Spooled: {sb['sum'] / (1 << 20):,.1f} MB "
                      f"across {sb['count']} task(s)")
+    dfc = rs.get("dynamicFiltersCollected")
+    dfi = rs.get("dynamicFilterRowsIn")
+    if dfc or dfi:
+        # runtime dynamic filters: how many build-side domains arrived,
+        # how many scans applied one, and the fraction of scanned rows
+        # the applied filters removed before the join
+        collected = int(dfc["sum"]) if dfc else 0
+        applied = int(dfi["count"]) if dfi else 0
+        rows_in = int(dfi["sum"]) if dfi else 0
+        dfp = rs.get("dynamicFilterRowsPruned")
+        pruned = int(dfp["sum"]) if dfp else 0
+        pct = 100.0 * pruned / rows_in if rows_in else 0.0
+        lines.append(f"Dynamic filters: {collected} collected, "
+                     f"{applied} applied, {pct:.1f}% rows pruned")
+    flips = rs.get("adaptiveExchangeFlips")
+    swaps = rs.get("adaptiveSideSwaps")
+    if (flips and flips.get("sum")) or (swaps and swaps.get("sum")):
+        # cardinality-driven exchange re-decisions made at stage
+        # boundaries from OBSERVED build-side rows (adaptive.exchange)
+        lines.append(f"Adaptive decisions: "
+                     f"{int(flips['sum']) if flips else 0} "
+                     f"exchange(s) flipped to broadcast, "
+                     f"{int(swaps['sum']) if swaps else 0} "
+                     f"join side swap(s)")
     if profile_dir:
         # where `jax.profiler.trace` wrote this run's device capture
         # (open with tensorboard / xprof)

@@ -311,6 +311,10 @@ class ExchangeInserter:
             left, right = right, left
             lest, rest = rest, lest
 
+        # record the planner's build-side assumption so the scheduler can
+        # compare it against observed rows at the stage boundary and flip
+        # the exchange strategy (exec/adaptive.decide_exchange)
+        node.planned_build_rows = int(rest) if rest is not None else None
         broadcast = (rest is not None
                      and rest <= self.config.broadcast_threshold
                      and node.join_type in (P.INNER, P.LEFT))
@@ -506,6 +510,81 @@ class Fragmenter:
         return node
 
 
+def annotate_dynamic_filter_sources(subplan: P.SubPlan) -> P.SubPlan:
+    """Stamp `PlanFragment.dynamic_filter_sources` (producer output column
+    name -> dynamic filter id) on every child fragment whose output feeds
+    the SOURCE side of an annotated join in its consumer fragment.
+
+    The optimizer's `plan_dynamic_filters` keys `dynamic_filters` by the
+    RECEIVING variable; the summarized domain comes from the opposite
+    side (INNER: build/right, LEFT: probe/left, semi: filtering source).
+    When fragmentation cut that side behind a RemoteSourceNode, the
+    producing stage is where the key column's min/max/value-set summary
+    must be built (exec/adaptive.summarize_key_column) — this pass tells
+    each producer WHICH of its output columns feed filters, so the
+    scheduler / worker tasks summarize them as pages stream out."""
+    def source_sides(node) -> List[Tuple[P.PlanNode, str, str]]:
+        """(source subtree, source variable name, filter id) triples.
+
+        For INNER joins the receiving var may sit on EITHER side — the
+        exchange inserter's build-side swap flips criteria after the
+        optimizer annotated — and both directions are sound (neither
+        side is preserved).  LEFT joins receive on the build (right)
+        side only; semi joins on the probe source."""
+        out: List[Tuple[P.PlanNode, str, str]] = []
+        if isinstance(node, P.JoinNode) and node.dynamic_filters:
+            for l, r in node.criteria:
+                if l.name in node.dynamic_filters \
+                        and node.join_type == P.INNER:
+                    out.append((node.right, r.name,
+                                node.dynamic_filters[l.name]))
+                elif r.name in node.dynamic_filters:
+                    out.append((node.left, l.name,
+                                node.dynamic_filters[r.name]))
+        elif isinstance(node, P.SemiJoinNode) \
+                and getattr(node, "dynamic_filters", None):
+            skey = node.source_join_variable.name
+            if skey in node.dynamic_filters:
+                out.append((node.filtering_source,
+                            node.filtering_source_join_variable.name,
+                            node.dynamic_filters[skey]))
+        return out
+
+    def side_remote(side) -> Optional[P.RemoteSourceNode]:
+        """The RemoteSourceNode feeding a join side, if the fragment cut
+        landed directly there (the common shape: repartition/broadcast
+        exchanges become fragment boundaries)."""
+        while isinstance(side, P.FilterNode):
+            side = side.source
+        return side if isinstance(side, P.RemoteSourceNode) else None
+
+    def visit(sp: P.SubPlan) -> None:
+        by_fid = {c.fragment.fragment_id: c for c in sp.children}
+        for node in P.walk_plan(sp.fragment.root):
+            for side, var_name, fid in source_sides(node):
+                remote = side_remote(side)
+                if remote is None:
+                    continue
+                out_names = [v.name for v in remote.outputs]
+                if var_name not in out_names:
+                    continue
+                j = out_names.index(var_name)
+                for cfid in remote.source_fragment_ids:
+                    child = by_fid.get(cfid)
+                    if child is None:
+                        continue
+                    layout = child.fragment.output_partitioning_scheme \
+                        .output_layout
+                    if j < len(layout):
+                        child.fragment.dynamic_filter_sources[
+                            layout[j].name] = fid
+        for c in sp.children:
+            visit(c)
+
+    visit(subplan)
+    return subplan
+
+
 def plan_distributed(root: P.OutputNode,
                      config: Optional[FragmenterConfig] = None,
                      exec_config=None) -> P.SubPlan:
@@ -516,6 +595,7 @@ def plan_distributed(root: P.OutputNode,
     default ExecutionConfig."""
     rewritten = ExchangeInserter(config).rewrite(root)
     sub = Fragmenter().fragment(rewritten)
+    annotate_dynamic_filter_sources(sub)
     from ..analysis import validate_subplan
     validate_subplan(sub, "post-fragment", exec_config=exec_config)
     return sub

@@ -177,19 +177,158 @@ def prune_unused_outputs(root: P.PlanNode) -> P.PlanNode:
 
 
 def plan_dynamic_filters(root: P.PlanNode) -> P.PlanNode:
-    """Annotate inner hash joins with dynamic filters (reference
-    DynamicFilterSourceOperator + LocalDynamicFilter planning): each
-    equi-join key gets a filter id; at execution the build side's key
-    domain (min/max) narrows the probe stream before the probe
-    (exec/pipeline.py probe_stream)."""
+    """Annotate joins with dynamic filters (reference
+    DynamicFilterSourceOperator + LocalDynamicFilter planning).  Keys of
+    `dynamic_filters` are the RECEIVING variables — the side whose rows
+    the filter may drop — and a filter may only ever shrink a
+    NON-PRESERVED side:
+
+    - INNER: the probe (left) receives the build (right) key domain;
+      applied intra-task before the probe step AND cross-stage as
+      runtime scan pushdown (plan_runtime_filter_pushdown).
+    - LEFT: the probe is preserved (unmatched rows survive
+      null-extended), so it must NEVER be filtered — but the build side
+      is not preserved: build rows no probe key can match produce
+      nothing, so the probe key domain may prune BUILD scans.  RIGHT
+      joins were normalized to LEFT-with-swapped-sides by the planner
+      before this pass, so they take this path with the original probe
+      side receiving.
+    - FULL: both sides preserved; no filter is safe.
+    - SemiJoinNode: the source receives the filtering-source domain,
+      but ONLY when the membership marker is consumed as a bare
+      positive filter conjunct — then a source row outside the domain
+      would get marker NULL/false and be dropped by that filter anyway.
+      Under negation (NOT IN) the marker's false/NULL rows are the ones
+      that SURVIVE, so dropping them early would be wrong.
+    """
+    from ..spi.expr import VariableReferenceExpression
+    from ..storage.pushdown import split_conjuncts
+
+    positive_markers = set()
+    for node in P.walk_plan(root):
+        if isinstance(node, P.FilterNode):
+            for c in split_conjuncts(node.predicate):
+                if isinstance(c, VariableReferenceExpression):
+                    positive_markers.add(c.name)
+
     n = 0
     for node in P.walk_plan(root):
-        if isinstance(node, P.JoinNode) and node.criteria \
-                and node.join_type == P.INNER:
+        if isinstance(node, P.JoinNode) and node.criteria:
+            if node.join_type == P.INNER:
+                node.dynamic_filters = {
+                    l.name: f"df_{n}_{i}"
+                    for i, (l, _r) in enumerate(node.criteria)}
+                n += 1
+            elif node.join_type == P.LEFT:
+                node.dynamic_filters = {
+                    r.name: f"df_{n}_{i}"
+                    for i, (_l, r) in enumerate(node.criteria)}
+                n += 1
+        elif isinstance(node, P.SemiJoinNode) \
+                and node.semi_join_output.name in positive_markers:
             node.dynamic_filters = {
-                l.name: f"df_{n}_{i}"
-                for i, (l, _r) in enumerate(node.criteria)}
+                node.source_join_variable.name: f"df_{n}_0"}
             n += 1
+    return root
+
+
+def _runtime_filter_pairs(node):
+    """(receiving var name, source var name, fid, receiving subtree)
+    tuples for one annotated node, honoring the direction convention
+    documented on plan_dynamic_filters."""
+    out = []
+    if isinstance(node, P.JoinNode):
+        for i, (l, r) in enumerate(node.criteria):
+            if node.join_type == P.INNER and l.name in node.dynamic_filters:
+                out.append((l.name, r.name,
+                            node.dynamic_filters[l.name], node.left))
+            elif node.join_type == P.LEFT \
+                    and r.name in node.dynamic_filters:
+                out.append((r.name, l.name,
+                            node.dynamic_filters[r.name], node.right))
+    elif isinstance(node, P.SemiJoinNode):
+        sv = node.source_join_variable.name
+        if sv in node.dynamic_filters:
+            out.append((sv, node.filtering_source_join_variable.name,
+                        node.dynamic_filters[sv], node.source))
+    return out
+
+
+def plan_runtime_filter_pushdown(root: P.PlanNode) -> P.PlanNode:
+    """Push each dynamic filter's receiving key down to its table scans
+    as RUNTIME pushdown (the cross-stage half of dynamic filtering,
+    reference analog DynamicFilterService + TupleDomain pushdown).
+
+    Each reachable scan gets a `runtime_filters` annotation plus
+    ``["dyn", fid, min|max|set]`` marker entries in `pushdown`, resolved
+    at prune time from the summary a completed filter-source stage
+    published (exec/adaptive.py).  Unresolved markers keep every chunk,
+    so annotation is always safe to plan; correctness only requires that
+    every row dropped at the scan would have been dropped by the
+    annotated join anyway.  That holds when the path from scan to join
+    is strictly row-preserving-or-narrowing for the traced key — bare
+    Project renames and Filters.  Anything else (aggregations, limits,
+    sorts, unions) stops the descent, and a scan whose node id appears
+    more than once in the plan (decorrelated shared subtree — the
+    pipeline compiler memoizes by id) is never annotated: another
+    consumer outside the join could observe the missing rows."""
+    from collections import Counter
+    from ..spi.expr import VariableReferenceExpression
+
+    occurrences: Counter = Counter()
+
+    def count(node):
+        occurrences[node.id] += 1
+        for s in node.sources:
+            count(s)
+    count(root)
+
+    def trace(node, var_name, out):
+        if isinstance(node, P.TableScanNode):
+            if occurrences[node.id] != 1:
+                return
+            for v, col in node.assignments.items():
+                if v.name == var_name:
+                    out.append((node, col.name))
+            return
+        if isinstance(node, P.ProjectNode):
+            e = next((e for v, e in node.assignments.items()
+                      if v.name == var_name), None)
+            if isinstance(e, VariableReferenceExpression):
+                trace(node.source, e.name, out)
+            return
+        if isinstance(node, P.FilterNode):
+            trace(node.source, var_name, out)
+            return
+        if isinstance(node, P.ExchangeNode):
+            # inputs[i][j] feeds output_layout[j] from source i
+            layout = node.partitioning_scheme.output_layout
+            idx = next((j for j, v in enumerate(layout)
+                        if v.name == var_name), None)
+            if idx is None:
+                return
+            for i, src in enumerate(node.exchange_sources):
+                row = node.inputs[i] if i < len(node.inputs) else None
+                trace(src, row[idx].name if row else var_name, out)
+            return
+        # conservative stop: any other node may change which rows exist
+        # (aggregation, limit) or carry the variable non-positionally
+
+    for node in P.walk_plan(root):
+        if not getattr(node, "dynamic_filters", None):
+            continue
+        for recv, _src, fid, subtree in _runtime_filter_pairs(node):
+            scans = []
+            trace(subtree, recv, scans)
+            for scan, col in scans:
+                if any(e.get("id") == fid and e.get("column") == col
+                       for e in scan.runtime_filters):
+                    continue
+                scan.runtime_filters.append({"id": fid, "column": col})
+                scan.pushdown.extend((
+                    {"column": col, "op": "gte", "value": ["dyn", fid, "min"]},
+                    {"column": col, "op": "lte", "value": ["dyn", fid, "max"]},
+                    {"column": col, "op": "eq", "value": ["dyn", fid, "set"]}))
     return root
 
 
@@ -302,5 +441,6 @@ def optimize(root: P.PlanNode) -> P.PlanNode:
     root = prune_unused_outputs(root)
     root = plan_dynamic_filters(root)
     root = plan_scan_pushdown(root)
+    root = plan_runtime_filter_pushdown(root)
     root.rule_stats = rule_stats
     return root

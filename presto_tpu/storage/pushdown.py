@@ -173,9 +173,17 @@ def entry_unsatisfiable(op: str, value, zmin, zmax) -> bool:
 
     Empty zones carry reduction-identity bounds (zmin > zmax), which is
     unsatisfiable for every op — correct, since a zone with no values
-    has no row that can pass."""
+    has no row that can pass.
+
+    A TUPLE value with op "eq" is IN-set semantics (a runtime dynamic
+    filter's exact small-domain value set): satisfiable as long as any
+    member falls inside the zone range."""
     if zmin > zmax:
         return True
+    if isinstance(value, tuple):
+        if op != "eq":
+            return False
+        return all(v < zmin or v > zmax for v in value)
     if op == "eq":
         return value < zmin or value > zmax
     if op == "lt":
@@ -189,10 +197,20 @@ def entry_unsatisfiable(op: str, value, zmin, zmax) -> bool:
     return False
 
 
-def resolve_entry_value(value, params):
+def is_dyn_marker(value) -> bool:
+    """``["dyn", filter_id, "min"|"max"|"set"]`` runtime-filter marker
+    (sql/optimizer.plan_runtime_filter_pushdown)."""
+    return isinstance(value, (list, tuple)) and len(value) == 3 \
+        and value[0] == "dyn"
+
+
+def resolve_entry_value(value, params, dynamic: Optional[Dict] = None):
     """A pushdown entry's comparison value for pruning: plain numbers
     pass through; ``["param", index]`` markers resolve from the
-    execution's parameter fingerprint (device-unit host scalars).
+    execution's parameter fingerprint (device-unit host scalars);
+    ``["dyn", fid, bound]`` runtime-filter markers resolve from the
+    collected summaries in `dynamic` (fid -> DynamicFilterSummary wire
+    dict) — "min"/"max" give ints, "set" gives the exact value tuple.
     Returns None when the marker cannot be resolved — the caller must
     then keep the chunk (conservatism over cleverness)."""
     if isinstance(value, (list, tuple)):
@@ -201,47 +219,94 @@ def resolve_entry_value(value, params):
             v = params[value[1]]
             if not isinstance(v, bool) and isinstance(v, (int, float)):
                 return v
+        if is_dyn_marker(value) and dynamic is not None:
+            s = dynamic.get(value[1])
+            if isinstance(s, dict) and int(s.get("rowCount", 0)) > 0:
+                bound = value[2]
+                if bound in ("min", "max"):
+                    v = s.get(bound)
+                    return v if isinstance(v, int) \
+                        and not isinstance(v, bool) else None
+                if bound == "set" and s.get("values") is not None:
+                    return tuple(s["values"])
         return None
     return value
 
 
 def prune_chunks(chunks: List[Tuple[int, int]], zone_maps: Dict,
-                 pushdown: List[dict], params: Optional[Tuple] = None):
+                 pushdown: List[dict], params: Optional[Tuple] = None,
+                 dynamic: Optional[Dict] = None,
+                 detail: Optional[dict] = None,
+                 keep_one: bool = True):
     """Drop chunks no pushed-down conjunct combination can satisfy.
 
     Returns (kept_chunks, skipped_count).  A conjunction skips a chunk
     when ANY single conjunct is unsatisfiable over the chunk's
-    aggregated zone bounds.  At least one chunk is always kept: fused
-    consumers bake len(chunks) into compiled fori_loop programs and a
-    zero-chunk scan would leave them nothing to fold over (the residual
-    filter turns the survivor into zero rows anyway).
+    aggregated zone bounds.  With `keep_one` (the default) at least one
+    chunk is always kept: fused consumers bake len(chunks) into
+    compiled fori_loop programs and a zero-chunk scan would leave them
+    nothing to fold over (the residual filter turns the survivor into
+    zero rows anyway).  Streaming scans that prune split-by-split pass
+    keep_one=False — an empty split simply yields no batches, and the
+    per-call floor would otherwise make a single-chunk split immune to
+    pruning.
 
-    `params` is the execution's host-side parameter fingerprint; entries
-    whose value is a ``["param", index]`` marker resolve against it and
-    prune nothing when it is absent.
-    """
+    `params` is the execution's host-side parameter fingerprint;
+    `dynamic` the runtime dynamic-filter summaries (fid -> wire dict).
+    Marker entries resolve against them and prune nothing when absent.
+    Static entries order before dyn markers in planned pushdown lists,
+    so a chunk skip attributed to a dyn entry is one static pushdown
+    could NOT have made — counted separately (the adaptive registry's
+    `filter_chunks_skipped`).
+
+    `detail`, when given, is filled with {"dyn_engaged": did any dyn
+    marker resolve, "rows_in": total rows considered, "dyn_rows_pruned":
+    rows in dyn-attributed skipped chunks} — callers that own per-
+    execution metering (fused chains bypass the row-level runtime
+    filter) read it instead of re-deriving attribution."""
     from .store import STORAGE_METRICS
     kept: List[Tuple[int, int]] = []
+    dyn_skipped: List[Tuple[int, int]] = []
+    dyn_engaged = False
     for pos, count in chunks:
-        skip = False
+        skip = skip_dyn = False
         for e in pushdown:
             zm = zone_maps.get(e["column"])
             if zm is None:
                 continue
-            value = resolve_entry_value(e["value"], params)
+            value = resolve_entry_value(e["value"], params, dynamic)
             if value is None:
                 continue
+            if is_dyn_marker(e["value"]):
+                dyn_engaged = True
             bounds = zm.chunk_bounds(pos, count)
             if bounds is None:
                 continue
             if entry_unsatisfiable(e["op"], value, *bounds):
                 skip = True
+                skip_dyn = is_dyn_marker(e["value"])
                 break
         if not skip:
             kept.append((pos, count))
-    if not kept and chunks:
+        elif skip_dyn:
+            dyn_skipped.append((pos, count))
+    if not kept and chunks and keep_one:
         kept = [chunks[0]]
+        if chunks[0] in dyn_skipped:
+            dyn_skipped.remove(chunks[0])
     skipped = len(chunks) - len(kept)
     STORAGE_METRICS.incr("chunks_total", len(chunks))
     STORAGE_METRICS.incr("chunks_skipped", skipped)
+    if dyn_skipped and detail is None:
+        # callers that pass `detail` own adaptive metering themselves
+        # (fused chains recompute chunk lists more than once per
+        # execution and must count each skip exactly once)
+        from ..exec.adaptive import ADAPTIVE_METRICS
+        ADAPTIVE_METRICS.incr("filter_chunks_skipped",
+                              min(len(dyn_skipped), skipped))
+    if detail is not None:
+        detail["dyn_engaged"] = dyn_engaged
+        detail["rows_in"] = sum(c for _, c in chunks)
+        detail["dyn_chunks_pruned"] = len(dyn_skipped)
+        detail["dyn_rows_pruned"] = sum(c for _, c in dyn_skipped)
     return kept, skipped

@@ -149,12 +149,15 @@ class ResidentStore:
         key = (cid, table, colname, float(sf), bool(as_i32))
         ent = self.entries.get(key)
         if ent is not None:
-            if ent.pad >= pad:
+            if ent.pad >= pad and ent.zones.zone_rows <= zone_rows:
                 self.entries.move_to_end(key)
                 STORAGE_METRICS.incr("cache_hits")
                 return ent
-            # built under a smaller batch capacity: rebuild with the
-            # larger tail padding (chunk slices must never clamp)
+            # built under a smaller batch capacity (chunk slices must
+            # never clamp) or coarser zone maps (a session asking for
+            # finer storage_zone_rows must actually get the pruning
+            # granularity it asked for): rebuild.  A finer-than-requested
+            # cached entry is kept — extra zones only sharpen pruning.
             self._evict(key)
         STORAGE_METRICS.incr("cache_misses")
         itemsize = 4 if as_i32 else 8

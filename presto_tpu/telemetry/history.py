@@ -134,6 +134,25 @@ class QueryHistoryStore:
             recs = [r for r in recs if r.get("state") == state]
         return recs
 
+    def find_by_template(self, template_key: str,
+                         state: Optional[str] = "FINISHED"
+                         ) -> Optional[dict]:
+        """Newest record whose "planTemplate" matches — the lookup behind
+        history-based sizing (exec/runner.py): a repeat run of the same
+        canonical plan template seeds its task counts / aggregation slots
+        / admission estimate from what the last run actually observed."""
+        if not template_key:
+            return None
+        with self._lock:
+            self._evict_locked()
+            for rec in reversed(self._entries.values()):
+                if rec.get("planTemplate") != template_key:
+                    continue
+                if state and rec.get("state") != state:
+                    continue
+                return dict(rec)
+        return None
+
     def counts_by_state(self) -> Dict[str, int]:
         with self._lock:
             self._evict_locked()

@@ -161,4 +161,8 @@ def scrape_metric_points() -> List[Tuple[str, float, dict]]:
     for k, v in MEMORY_METRICS.snapshot().items():
         points.append((f"presto_tpu.memory.{k}", float(v), {}))
 
+    from ..exec.adaptive import ADAPTIVE_METRICS
+    for k, v in ADAPTIVE_METRICS.snapshot().items():
+        points.append((f"presto_tpu.adaptive.{k}", float(v), {}))
+
     return points

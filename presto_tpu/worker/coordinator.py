@@ -40,6 +40,7 @@ from ..common.errors import (INTERNAL_ERROR, PrestoQueryError,
                              RemoteTaskError, WorkerLostError,
                              is_retryable_type, parse_error_type)
 from ..connectors import catalog, tpch
+from ..exec.adaptive import DynamicFilterCollector, DynamicFilterSummary
 from ..exec.pipeline import ExecutionConfig
 from ..exec.runner import LocalQueryRunner, QueryResult, pages_to_result
 from ..spi import plan as P
@@ -374,6 +375,125 @@ class _StatusWatcher:
         self._stop.set()
 
 
+class _DynamicFilterPump:
+    """Coordinator-side dynamic-filter distribution (the analog of the
+    reference DynamicFilterService): build-stage tasks summarize their
+    dynamic-filter key domains into TaskInfo ("dynamicFilterSummaries");
+    this pump polls those infos, merges the per-task partials per filter
+    id once EVERY task of every producing stage has reported, and pushes
+    the merged domains to the downstream scan tasks via fragment-less
+    task updates.  Consumer tasks wait a bounded
+    dynamic-filtering.wait-timeout then proceed unfiltered, so a slow or
+    dead producer degrades to the unfiltered plan instead of stalling —
+    a late delivery after the wait is ignored (and metered) worker-side."""
+
+    def __init__(self, execution: "_QueryExecution",
+                 interval_s: float = 0.1):
+        self._exec = execution
+        cfg = execution.runner.config
+        max_distinct = int(execution.session.get(
+            "dynamic_filtering_max_distinct_values",
+            cfg.dynamic_filtering_max_distinct))
+        self._collector = DynamicFilterCollector(max_distinct)
+        # fid -> producing stages (several source fragments can feed the
+        # same filter id); a filter is ready only when ALL have reported
+        self._producers: Dict[str, List[_Stage]] = {}
+        # consumer stages paired with the filter ids their scans await
+        self._consumers: List[Tuple[_Stage, Set[str]]] = []
+        for stage in execution.stages:
+            for fid in stage.fragment.dynamic_filter_sources.values():
+                self._producers.setdefault(fid, []).append(stage)
+            fids = {e["id"] for node in P.walk_plan(stage.fragment.root)
+                    if isinstance(node, P.TableScanNode)
+                    for e in getattr(node, "runtime_filters", None) or []}
+            if fids:
+                self._consumers.append((stage, fids))
+        self._stage_done: Set[int] = set()
+        self._ready: Dict[str, dict] = {}    # fid -> merged wire dict
+        self._pushed: Set[Tuple[str, frozenset]] = set()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop,
+                                        args=(interval_s,),
+                                        name="dynamic-filter-pump",
+                                        daemon=True)
+        if self._producers and self._consumers:
+            self._thread.start()
+
+    def _collect(self) -> None:
+        """Merge summaries from producer stages whose tasks ALL report."""
+        for stages in self._producers.values():
+            for stage in stages:
+                if id(stage) in self._stage_done:
+                    continue
+                want = set(stage.fragment.dynamic_filter_sources.values())
+                partials: List[Dict[str, dict]] = []
+                for task in stage.tasks:
+                    if task is None:
+                        break
+                    try:
+                        info = task.info(timeout_s=2.0)
+                    except (OSError, ValueError):
+                        break
+                    sums = info.get("dynamicFilterSummaries") or {}
+                    if not want <= set(sums):
+                        break  # task still running (or retried attempt)
+                    partials.append(sums)
+                else:
+                    for sums in partials:
+                        for fid in want:
+                            self._collector.publish(
+                                DynamicFilterSummary.from_dict(sums[fid]))
+                    self._stage_done.add(id(stage))
+        for fid, stages in self._producers.items():
+            if fid not in self._ready and all(
+                    id(s) in self._stage_done for s in stages):
+                self._ready[fid] = self._collector.get(fid).to_dict()
+                self._exec.stats.add("dynamicFiltersCollected", 1)
+
+    def _push(self) -> None:
+        """Deliver ready filters to every live consumer task exactly once
+        per (task attempt, filter set); a restarted attempt has a new task
+        id, so it is re-delivered automatically."""
+        for stage, fids in self._consumers:
+            have = {f: self._ready[f] for f in fids if f in self._ready}
+            if not have:
+                continue
+            for ti, task in enumerate(stage.tasks):
+                if task is None:
+                    continue
+                key = (task.task_id, frozenset(have))
+                if key in self._pushed:
+                    continue
+                req = TaskUpdateRequest(
+                    task.task_id, ti, None, [], stage.spec,
+                    session=self._exec.session, dynamic_filters=have)
+                try:
+                    task.update(req,
+                                deadline_ms=self._exec._deadline_ms())
+                except (urllib.error.URLError, urllib.error.HTTPError,
+                        TimeoutError, OSError):
+                    pass  # consumer proceeds unfiltered after its wait
+                else:
+                    self._pushed.add(key)
+
+    def _loop(self, interval_s: float) -> None:
+        while not self._stop.is_set():
+            self._collect()
+            self._push()
+            if len(self._ready) == len(self._producers):
+                # everything collected; keep pushing only for restarts
+                if all((t.task_id, frozenset(
+                        {f: self._ready[f] for f in fids
+                         if f in self._ready})) in self._pushed
+                       for stage, fids in self._consumers
+                       for t in stage.tasks if t is not None):
+                    return
+            self._stop.wait(interval_s)
+
+    def close(self) -> None:
+        self._stop.set()
+
+
 class _QueryExecution:
     """One query's distributed run: scheduling, the failure watcher, and
     the classify-restart loop (the coordinator analog of presto-spark's
@@ -457,6 +577,11 @@ class _QueryExecution:
         self.all_tasks: List[RemoteTask] = []   # every attempt, for cleanup
         self.lineage_index: Dict[str, Tuple[_Stage, int]] = {}
         self._watcher: Optional[_StatusWatcher] = None
+        self._df_pump: Optional[_DynamicFilterPump] = None
+        self.dynamic_filtering = str(self.session.get(
+            "dynamic_filtering",
+            getattr(cfg, "dynamic_filtering", True))).strip().lower() \
+            in ("true", "1")
 
     # -- identity ---------------------------------------------------------
     def lineage(self, stage: _Stage, ti: int) -> str:
@@ -565,6 +690,8 @@ class _QueryExecution:
     # -- the retry loop ---------------------------------------------------
     def run(self) -> List:
         self.schedule_all()
+        if self.dynamic_filtering and self._df_pump is None:
+            self._df_pump = _DynamicFilterPump(self)
         while True:
             self._watcher = _StatusWatcher(self)
             # one concurrent client over every root-task buffer (reference
@@ -835,6 +962,8 @@ class _QueryExecution:
     def close(self) -> None:
         if self._watcher is not None:
             self._watcher.close()
+        if self._df_pump is not None:
+            self._df_pump.close()
         for t in self.all_tasks:
             t.cancel()
 

@@ -79,6 +79,11 @@ class TaskUpdateRequest:
     # reference TaskUpdateRequest.tableWriteInfo (presto_protocol_core.h:726):
     # the writer target a TableWriterNode in the fragment commits into
     table_write_info: Optional[dict] = None
+    # runtime dynamic-filter summaries pushed by the coordinator once the
+    # build-side stage completes (filter id -> DynamicFilterSummary wire
+    # dict, exec/adaptive.py) — the analog of the reference coordinator's
+    # DynamicFilterService fan-out to waiting scan tasks
+    dynamic_filters: Optional[Dict[str, dict]] = None
 
     @staticmethod
     def make(task_id: str, task_index: int, fragment: P.PlanFragment,
@@ -107,6 +112,8 @@ class TaskUpdateRequest:
                "session": self.session}
         if self.table_write_info is not None:
             out["tableWriteInfo"] = self.table_write_info
+        if self.dynamic_filters is not None:
+            out["dynamicFilters"] = self.dynamic_filters
         return out
 
     @staticmethod
@@ -115,7 +122,8 @@ class TaskUpdateRequest:
             d["taskId"], d.get("taskIndex", 0), d.get("fragment"),
             [TaskSource.from_dict(s) for s in d.get("sources", [])],
             OutputBuffersSpec.from_dict(d["outputBuffers"]),
-            d.get("session", {}), d.get("tableWriteInfo"))
+            d.get("session", {}), d.get("tableWriteInfo"),
+            d.get("dynamicFilters"))
 
 
 def from_reference_update(task_id: str, d: dict) -> "TaskUpdateRequest":
@@ -390,4 +398,28 @@ def apply_session_properties(config, session: Dict[str, str]):
         # per-query device profiler capture (telemetry/profiler.py):
         # wraps execution in jax.profiler.trace() under profile_dir
         kw["profile"] = str(session["profile"]).lower() == "true"
+    # adaptive execution knobs (reference enable_dynamic_filtering /
+    # dynamic-filtering.* session properties)
+    if "dynamic_filtering" in session:
+        kw["dynamic_filtering"] = (
+            str(session["dynamic_filtering"]).lower() == "true")
+    if "dynamic_filtering_wait_timeout" in session:
+        kw["dynamic_filtering_wait_timeout_s"] = parse_duration(
+            session["dynamic_filtering_wait_timeout"])
+    if "dynamic_filtering_max_distinct_values" in session:
+        kw["dynamic_filtering_max_distinct"] = int(
+            session["dynamic_filtering_max_distinct_values"])
+    if "adaptive_exchange" in session:
+        kw["adaptive_exchange"] = (
+            str(session["adaptive_exchange"]).lower() == "true")
+    if "adaptive_history_sizing" in session:
+        kw["adaptive_history_sizing"] = (
+            str(session["adaptive_history_sizing"]).lower() == "true")
+    if "storage_zone_rows" in session:
+        # zone-map granularity: dynamic-filter pruning needs zones finer
+        # than the scanned table to discriminate chunks at small scale
+        n = int(session["storage_zone_rows"])
+        if n < 1:
+            raise ValueError(f"storage_zone_rows must be >= 1, got {n}")
+        kw["storage_zone_rows"] = n
     return dataclasses.replace(config, **kw) if kw else config

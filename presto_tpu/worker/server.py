@@ -414,6 +414,13 @@ class _Handler(BaseHTTPRequestHandler):
                 lines.append(f"# TYPE presto_tpu_storage_{k}_total counter")
                 lines.append(
                     f"presto_tpu_storage_{k}_total {STORAGE_METRICS[k]}")
+        # adaptive-execution counters (exec/adaptive.py ADAPTIVE_METRICS):
+        # dynamic-filter collection/application/pruning plus the runtime
+        # exchange-strategy decisions; all monotonic counters
+        from ..exec.adaptive import ADAPTIVE_METRICS
+        for k, v in sorted(ADAPTIVE_METRICS.snapshot().items()):
+            lines.append(f"# TYPE presto_tpu_adaptive_{k}_total counter")
+            lines.append(f"presto_tpu_adaptive_{k}_total {v}")
         # lock-order validation + contention metering (common/locks.py):
         # populated when debug.lock-validation (or a session's
         # lock_validation override) armed the OrderedLock bookkeeping
@@ -705,6 +712,7 @@ class _Handler(BaseHTTPRequestHandler):
         """Process-wide metric registries, namespaced consistently with
         the /v1/metrics exposition sections — included in QueryInfo so a
         single snapshot carries both query- and process-scoped state."""
+        from ..exec.adaptive import ADAPTIVE_METRICS
         from ..exec.kernels.scan_kernel import KERNEL_METRICS
         from ..exec.memory import MEMORY_METRICS
         from ..parallel.fabric import FABRIC_METRICS
@@ -716,7 +724,8 @@ class _Handler(BaseHTTPRequestHandler):
                 "serving": SERVING_METRICS.snapshot(),
                 "storage": dict(STORAGE_METRICS),
                 "kernel": KERNEL_METRICS.snapshot(),
-                "memory": MEMORY_METRICS.snapshot()}
+                "memory": MEMORY_METRICS.snapshot(),
+                "adaptive": ADAPTIVE_METRICS.snapshot()}
 
     def do_query_info(self, groups, query):
         d = self._dispatch_mgr()
@@ -1070,6 +1079,10 @@ class WorkerServer:
             self._history_listener = HistoryEventListener(
                 self.history, extra_fields=self._history_extra_fields)
             self.dispatch.events.register(self._history_listener)
+            # admission-time history sizing (adaptive.history-sizing):
+            # the dispatch manager consults the same store for a repeat
+            # query's observed peak memory
+            self.dispatch.history = self.history
             # coordinator slice of the distributed trace: query +
             # per-stage fragment spans exported at terminal state (worker
             # processes export their own task/operator spans under the

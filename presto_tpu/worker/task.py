@@ -73,6 +73,14 @@ class TpuTask:
         # redirect live pulls to the replacement attempt's buffers
         self._remote_locations: Dict[str, List[str]] = {}
         self._remote_clients: Dict[str, list] = {}
+        # runtime dynamic filters (exec/adaptive.py): summaries RECEIVED
+        # from the coordinator (filter id -> wire dict, shared by
+        # reference with the TaskContext so late deliveries still prune
+        # splits not yet drained) and summaries PRODUCED by this task's
+        # own output (published through TaskInfo for collection)
+        self.dynamic_filters: Dict[str, dict] = {}  # lint: guarded-by(_cond)
+        self.dynamic_filter_summaries: Dict[str, dict] = {}
+        self._df_wait_done = False        # lint: guarded-by(_cond)
         # rank 16: above the task manager (14), below every data-plane
         # lock; _set_state never nests (events and the manager counter
         # fire after release)
@@ -90,6 +98,11 @@ class TpuTask:
             "taskStatus": status.to_dict(),
             "traceToken": self.trace_token,
             "noMoreSplits": True,
+            # build-side dynamic-filter summaries this task produced
+            # (fragment.dynamic_filter_sources); the coordinator merges
+            # them across the stage's tasks and pushes the result to the
+            # downstream scan tasks (worker/coordinator.py)
+            "dynamicFilterSummaries": dict(self.dynamic_filter_summaries),
             "stats": {
                 "createTime": self.created_at,
                 # drain-pipeline wall when task_concurrency > 1: serialize
@@ -273,6 +286,53 @@ class TpuTask:
                 f"task {self.task_id} is {self.state}; aborting exchange "
                 f"pull")
 
+    def deliver_dynamic_filters(self, filters: Dict[str, dict]) -> None:
+        """Coordinator push of collected build-side summaries.  The dict
+        handed to this task's TaskContext is SHARED and updated in place,
+        so a summary landing while the task runs still prunes splits not
+        yet drained (late binding, no recompile).  One arriving after the
+        bounded pre-start wait already expired is metered as a late
+        arrival — never an error (the scan simply ran unfiltered)."""
+        from ..exec.adaptive import ADAPTIVE_METRICS
+        with self._cond:
+            self.dynamic_filters.update(filters)
+            late = self._df_wait_done
+            self._cond.notify_all()
+        if late:
+            ADAPTIVE_METRICS.incr("filter_late_arrivals", len(filters))
+
+    def _await_dynamic_filters(self, fragment: P.PlanFragment,
+                               ctx: TaskContext) -> None:
+        """Bounded pre-execution wait for the dynamic filters this
+        fragment's scans are annotated to consume
+        (dynamic-filtering.wait-timeout; reference
+        DynamicFilterService#blockUntilDynamicFilter).  On timeout the
+        scan proceeds unfiltered — pruning is advisory, so waiting
+        forever for a filter that may never arrive (killed build worker)
+        would trade availability for nothing."""
+        import time
+        from ..exec.adaptive import ADAPTIVE_METRICS
+        expected = set()
+        if ctx.config.dynamic_filtering:
+            for n in P.walk_plan(fragment.root):
+                if isinstance(n, P.TableScanNode):
+                    for e in getattr(n, "runtime_filters", ()) or ():
+                        expected.add(e["id"])
+        timed_out = False
+        deadline = time.monotonic() + max(
+            0.0, ctx.config.dynamic_filtering_wait_timeout_s)
+        with self._cond:
+            while expected - set(self.dynamic_filters) \
+                    and self.state not in DONE_STATES:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    timed_out = True
+                    break
+                self._cond.wait(remaining)
+            self._df_wait_done = True
+        if timed_out:
+            ADAPTIVE_METRICS.incr("filter_wait_timeouts")
+
     def update_remote_sources(self, sources) -> None:
         """Fragment-less task update (coordinator task-retry under
         retry-policy=task): a failed PRODUCER was replaced by a new
@@ -336,9 +396,14 @@ class TpuTask:
                 coalesce_target_bytes=cfg.exchange_max_response_bytes,
                 memory=self.memory_ctx, spill_dir=cfg.spill_path,
                 spool=spool)
+            if update.dynamic_filters:
+                # summaries known at dispatch time (build stage already
+                # finished) ride the create request — no wait needed
+                self.dynamic_filters.update(update.dynamic_filters)
             ctx = TaskContext(config=cfg, task_index=update.task_index,
                               memory=self.memory_ctx,
-                              runtime_stats=self.stats)
+                              runtime_stats=self.stats,
+                              dynamic_filters=self.dynamic_filters)
             self.trace_token = update.session.get("trace_token", "")
             if self.trace_token:
                 print(f"[trace {self.trace_token}] task {self.task_id} "
@@ -468,6 +533,18 @@ class TpuTask:
             n_parts = len(self.buffers.buffers)
             partitioned = (spec.type == "PARTITIONED" and n_parts > 1
                            and key_indices)
+            # bounded wait for runtime dynamic filters BEFORE the drain
+            # starts, so the scan's first split resolution already sees
+            # them; producer-side summarization setup mirrors the
+            # in-process scheduler (exec/scheduler._summarize_page_block)
+            self._await_dynamic_filters(fragment, ctx)
+            from ..exec.scheduler import _summarize_page_block
+            dyn_max = ctx.config.dynamic_filtering_max_distinct
+            dyn_idx = ([(out_names.index(c), fid)
+                        for c, fid in fragment.dynamic_filter_sources.items()
+                        if c in out_names]
+                       if ctx.config.dynamic_filtering else [])
+            task_sums: Dict[str, object] = {}
             compiler = PlanCompiler(ctx)
             pages = compiler.run_to_pages(fragment.root)
             if ctx.config.task_concurrency > 1:
@@ -491,6 +568,11 @@ class TpuTask:
                         pages.close()
                     return
                 self.output_rows += page.position_count
+                for j, fid in dyn_idx:
+                    s = _summarize_page_block(fid, page.blocks[j], dyn_max)
+                    prev = task_sums.get(fid)
+                    task_sums[fid] = s if prev is None \
+                        else prev.merge(s, dyn_max)
                 compress = ctx.config.exchange_compression
                 codec = ctx.config.exchange_compression_codec
                 if partitioned:
@@ -511,6 +593,17 @@ class TpuTask:
                     self.output_bytes += len(data)
                     self.buffers.add(0, data)
             self.memory_peak = ctx.memory.peak
+            if dyn_idx:
+                # a task with no output still publishes EMPTY summaries:
+                # a zero-row build side legitimately prunes every
+                # downstream chunk, unlike an absent summary (unknown)
+                from ..exec.adaptive import DynamicFilterSummary
+                for _j, fid in dyn_idx:
+                    if fid not in task_sums:
+                        task_sums[fid] = DynamicFilterSummary(
+                            fid, row_count=0)
+                self.dynamic_filter_summaries = {
+                    fid: s.to_dict() for fid, s in task_sums.items()}
             if ctx.stats:
                 # attach the collected per-node operator stats to the plan-
                 # node inventory (TaskInfo pipelines[].operators[].stats) so
@@ -728,10 +821,16 @@ class TaskManager:
             task.set_deadline(deadline_ms)
         if fresh and update.fragment_b64:
             task.start(update)
-        elif not fresh and update.sources:
-            # coordinator task-retry: redirect this consumer's exchange
-            # pulls to the replacement producer attempt's locations
-            task.update_remote_sources(update.sources)
+        elif not fresh:
+            if update.sources:
+                # coordinator task-retry: redirect this consumer's
+                # exchange pulls to the replacement attempt's locations
+                task.update_remote_sources(update.sources)
+            if update.dynamic_filters:
+                # coordinator push of collected build-side summaries to
+                # a task created before they existed (it may be waiting
+                # on them, running unfiltered, or already done)
+                task.deliver_dynamic_filters(update.dynamic_filters)
         return task.status()
 
     def get(self, task_id: str) -> TpuTask:

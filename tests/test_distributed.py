@@ -443,6 +443,147 @@ def test_probabilistic_fault_injection_session_property(lock_validation):
         w.close()
 
 
+# ---------------------------------------------------------------------------
+# adaptive execution under chaos (dynamic filters are advisory, never load-
+# bearing: every failure mode must degrade to "scan ran unfiltered", with
+# rows still oracle-exact)
+# ---------------------------------------------------------------------------
+
+# `+ 0` keeps the predicate opaque to the stats calculator; zones finer
+# than the table (storage_zone_rows) give the runtime filter chunks to prune
+AQE_CHAOS_SQL = ("select sum(l_extendedprice), count(*) "
+                 "from lineitem, orders "
+                 "where l_orderkey = o_orderkey and o_orderkey + 0 < 30")
+
+AQE_SESSION = {"lock_validation": "on", "storage_zone_rows": "4096"}
+
+
+def _build_stage_paths(r, sql):
+    """Task-id stage-path markers ('0_0' style) of every fragment that is
+    a dynamic-filter SOURCE (the build stages)."""
+    sub, _, _ = r.plan_subplan(sql)
+    out = []
+
+    def walk(sp, path):
+        if sp.fragment.dynamic_filter_sources:
+            out.append(path.replace(".", "_"))
+        for i, c in enumerate(sp.children):
+            walk(c, f"{path}.{i}")
+
+    walk(sub, "0")
+    return out
+
+
+def test_chaos_build_worker_killed_scans_fall_back_unfiltered(
+        lock_validation):
+    """Kill the worker running the dynamic-filter BUILD task before it can
+    summarize: downstream scans wait out dynamic-filtering.wait-timeout,
+    proceed unfiltered, and the (retried) query still returns oracle-exact
+    rows — losing the filter may cost pruning, never correctness."""
+    import threading
+    from presto_tpu.common.errors import InjectedTaskFailure
+    from presto_tpu.exec.adaptive import ADAPTIVE_METRICS
+    from presto_tpu.worker.coordinator import HttpQueryRunner
+    from presto_tpu.worker.server import WorkerServer
+
+    workers = [WorkerServer() for _ in range(3)]
+    killed = threading.Event()
+    before = ADAPTIVE_METRICS.snapshot()
+    try:
+        r = HttpQueryRunner(
+            [w.uri for w in workers], "sf0.01", n_tasks=2,
+            session={**AQE_SESSION,
+                     "dynamic_filtering_wait_timeout": "50ms",
+                     "exchange_max_error_duration": "5s"})
+        build_paths = _build_stage_paths(r, AQE_CHAOS_SQL)
+        assert build_paths, "test premise broken: no dynamic-filter source"
+
+        def kill_build(w):
+            def injector(task_id):
+                if killed.is_set():
+                    return
+                if any(f".{p}." in task_id for p in build_paths):
+                    killed.set()
+                    threading.Thread(target=w.close, daemon=True).start()
+                    raise InjectedTaskFailure(
+                        f"chaos: build worker dying under {task_id}")
+            return injector
+
+        for w in workers:
+            w.task_manager.fault_injector = kill_build(w)
+        got = r.execute(AQE_CHAOS_SQL)
+        _assert_same(got, AQE_CHAOS_SQL)
+        assert killed.is_set(), "chaos hook never saw a build task"
+        assert r.tasks_retried >= 1
+        after = ADAPTIVE_METRICS.snapshot()
+        # probe scans started while the build was dying: the bounded wait
+        # expired and they ran unfiltered (workers share this process, so
+        # the registry sees their counters)
+        assert after["filter_wait_timeouts"] > before["filter_wait_timeouts"]
+    finally:
+        for w in workers:
+            w.close()
+
+
+def test_chaos_late_dynamic_filter_is_ignored_not_fatal(lock_validation):
+    """A summary pushed AFTER a task's wait expired (or after the task
+    finished entirely) is metered as a late arrival and otherwise ignored:
+    the coordinator pump racing task completion must never fail a query."""
+    from presto_tpu.exec.adaptive import ADAPTIVE_METRICS
+    from presto_tpu.worker.coordinator import HttpQueryRunner
+    from presto_tpu.worker.server import WorkerServer
+
+    w = WorkerServer()
+    try:
+        r = HttpQueryRunner([w.uri], "sf0.01", n_tasks=1,
+                            session=dict(AQE_SESSION))
+        got = r.execute(AQE_CHAOS_SQL)
+        _assert_same(got, AQE_CHAOS_SQL)
+        tasks = list(w.task_manager.tasks.values())
+        assert tasks, "finished tasks already evicted"
+        before = ADAPTIVE_METRICS.snapshot()["filter_late_arrivals"]
+        tasks[0].deliver_dynamic_filters(
+            {"df_late": {"filterId": "df_late", "rowCount": 1,
+                         "min": 1, "max": 1}})
+        after = ADAPTIVE_METRICS.snapshot()["filter_late_arrivals"]
+        assert after == before + 1
+    finally:
+        w.close()
+
+
+def test_chaos_lock_validation_over_adaptive_paths(lock_validation):
+    """The new coordinator<->task surfaces (summary collection polls,
+    TaskUpdateRequest filter pushes, task-side waits) run under
+    lock_validation=on: oracle-exact rows, filters demonstrably collected
+    AND applied, zero lock-order violations (fixture)."""
+    from presto_tpu.exec.adaptive import ADAPTIVE_METRICS
+    from presto_tpu.worker.coordinator import HttpQueryRunner
+    from presto_tpu.worker.server import WorkerServer
+
+    w1, w2 = WorkerServer(), WorkerServer()
+    before = ADAPTIVE_METRICS.snapshot()
+    try:
+        r = HttpQueryRunner([w1.uri, w2.uri], "sf0.01", n_tasks=2,
+                            session=dict(AQE_SESSION))
+        got = r.execute(AQE_CHAOS_SQL)
+        _assert_same(got, AQE_CHAOS_SQL)
+        after = ADAPTIVE_METRICS.snapshot()
+        assert after["filters_collected"] > before["filters_collected"]
+        assert after["filters_applied"] > before["filters_applied"]
+        # a summary landing before task creation prunes whole chunks; one
+        # landing mid-scan prunes rows — either way something was dropped
+        pruned = (after["filter_rows_pruned"] - before["filter_rows_pruned"]
+                  + after["filter_chunks_skipped"]
+                  - before["filter_chunks_skipped"])
+        assert pruned > 0
+        # the loopback workers also export the registry as prometheus text
+        assert _metric(w1.uri,
+                       "presto_tpu_adaptive_filters_applied_total") >= 1
+    finally:
+        w1.close()
+        w2.close()
+
+
 def test_task_manager_abort_hook_and_counters():
     from presto_tpu.worker.protocol import (OutputBuffersSpec,
                                             TaskUpdateRequest)

@@ -177,9 +177,11 @@ def test_query_info_schema_golden(cluster):
 
     # process metrics ride along for a single-snapshot health read
     assert set(info["processMetrics"]) == {"exchange", "fabric", "serving",
-                                           "storage", "kernel", "memory"}
+                                           "storage", "kernel", "memory",
+                                           "adaptive"}
     assert "resident_bytes" in info["processMetrics"]["storage"]
     assert "spilled_bytes" in info["processMetrics"]["memory"]
+    assert "filters_applied" in info["processMetrics"]["adaptive"]
 
 
 def test_metrics_namespace_consistency(cluster):
