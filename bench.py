@@ -626,87 +626,216 @@ SERVE_SHAPES = [
 ]
 
 
+def _serve_warmup(server, schema, rows_check=True):
+    """One compile per shape; every shape's template registered on the
+    returned results map so client threads replay it via headers."""
+    from presto_tpu.client import StatementClient
+    warm = StatementClient(server.uri, schema=schema)
+    first_ms = {}
+    for name, template, values in SERVE_SHAPES:
+        warm.prepared[name] = template
+        t0 = time.perf_counter()
+        r = warm.execute(f"EXECUTE {name} USING {', '.join(values[0])}")
+        first_ms[name] = (time.perf_counter() - t0) * 1000
+        if rows_check:
+            assert r.rows, f"warmup {name} returned no rows"
+    return first_ms
+
+
+def _serve_load(server, schema, n_clients, per_client):
+    """The measured phase: N client threads replaying the shape mix.
+    Returns (sorted latencies seconds, wall seconds)."""
+    import threading
+    from presto_tpu.client import StatementClient
+    latencies, lat_lock = [], threading.Lock()
+
+    def client_loop(cid):
+        c = StatementClient(server.uri, schema=schema,
+                            source=f"bench-{cid}")
+        c.prepared = {n: t for n, t, _ in SERVE_SHAPES}
+        mine = []
+        for i in range(per_client):
+            name, _t, values = SERVE_SHAPES[(cid + i) % len(SERVE_SHAPES)]
+            vals = values[(cid * per_client + i) % len(values)]
+            t0 = time.perf_counter()
+            r = c.execute(f"EXECUTE {name} USING {', '.join(vals)}")
+            mine.append(time.perf_counter() - t0)
+            assert r.rows, "serve query returned no rows"
+        with lat_lock:
+            latencies.extend(mine)
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=client_loop, args=(i,))
+               for i in range(n_clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    latencies.sort()
+    return latencies, wall
+
+
+def _serve_pass_stats(latencies, wall):
+    n = len(latencies)
+    return {
+        "requests": n,
+        "qps": round(n / wall, 2),
+        "p50_latency_ms": round(latencies[n // 2] * 1000, 2),
+        "p99_latency_ms": round(
+            latencies[min(n - 1, int(n * 0.99))] * 1000, 2),
+    }
+
+
+def _reset_serving_process_state():
+    """Approximate a process restart for the warm-restart phase: drop
+    every in-memory serving artifact (plan cache, prepared registry,
+    fragment jits) so the next boot re-derives them — from the persistent
+    compilation cache + sidecar when configured, from scratch when not."""
+    from presto_tpu.serving import (FRAGMENT_JIT_CACHE, GLOBAL_PLAN_CACHE,
+                                    PREPARED_REGISTRY, SERVING_METRICS)
+    GLOBAL_PLAN_CACHE.invalidate_all()
+    PREPARED_REGISTRY.clear()
+    FRAGMENT_JIT_CACHE.invalidate_all()
+    SERVING_METRICS.reset()
+
+
 def bench_serve(runs):
     """Serving-tier benchmark: N concurrent clients hammering repeated
-    parameterized shapes through the statement protocol.  The canonical
-    plan cache + prepared fast path should absorb everything after the
-    warmup (plan_cache_hit_rate >= 0.9), leaving execution as the cost."""
+    parameterized shapes through the statement protocol.
+
+    Three phases, one JSON line:
+      batched / unbatched — the same load with the micro-batcher on vs
+        off (serving.max-batch-size=1), side by side: p50/p99/QPS, the
+        batch-occupancy histogram, and device-launch count vs query
+        count (launches = queries - launches_saved).
+      warm_restart — boot a server with the persistent compilation cache
+        + plan-cache sidecar, serve, 'restart' (drop all in-memory
+        serving state), boot again: the replayed boot should leave
+        serving traffic with ZERO template recompiles, and the first
+        query after reload far below the cold first query."""
     sf = float(os.environ.get("BENCH_SF", "0.1"))
-    n_clients = int(os.environ.get("BENCH_SERVE_CLIENTS", "4"))
+    n_clients = int(os.environ.get("BENCH_SERVE_CLIENTS", "8"))
     per_client = int(os.environ.get("BENCH_SERVE_REQUESTS", "15"))
 
-    import threading
+    import shutil
+    import tempfile
 
-    from presto_tpu.client import StatementClient
     from presto_tpu.serving import (GLOBAL_PLAN_CACHE, PREPARED_REGISTRY,
                                     SERVING_METRICS)
     from presto_tpu.worker.server import WorkerServer
 
     schema = f"sf{sf:g}"
-    server = WorkerServer(coordinator=True)
+
+    # -- pass 1: batching OFF (the baseline) ------------------------------
+    server = WorkerServer(coordinator=True, max_batch_size=1)
     try:
-        warm = StatementClient(server.uri, schema=schema)
-        for name, template, values in SERVE_SHAPES:
-            warm.prepared[name] = template
-            for vals in values[:1]:     # one compile per shape
-                warm.execute(f"EXECUTE {name} USING {', '.join(vals)}")
+        _serve_warmup(server, schema)
         SERVING_METRICS.reset()
-
-        latencies, lat_lock = [], threading.Lock()
-
-        def client_loop(cid):
-            c = StatementClient(server.uri, schema=schema,
-                                source=f"bench-{cid}")
-            c.prepared = {n: t for n, t, _ in SERVE_SHAPES}
-            mine = []
-            for i in range(per_client):
-                name, _t, values = SERVE_SHAPES[(cid + i) % len(SERVE_SHAPES)]
-                vals = values[(cid * per_client + i) % len(values)]
-                t0 = time.perf_counter()
-                r = c.execute(f"EXECUTE {name} USING {', '.join(vals)}")
-                mine.append(time.perf_counter() - t0)
-                assert r.rows, "serve query returned no rows"
-            with lat_lock:
-                latencies.extend(mine)
-
-        t0 = time.perf_counter()
-        threads = [threading.Thread(target=client_loop, args=(i,))
-                   for i in range(n_clients)]
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join()
-        wall = time.perf_counter() - t0
-
-        latencies.sort()
-        n = len(latencies)
-        sv = SERVING_METRICS.snapshot()
-        out = {
-            "metric": f"serve_sf{sf:g}_qps",
-            "value": round(n / wall, 2),
-            "unit": "queries/s",
-            "wall_s": round(wall, 4),
-            "serve": {
-                "clients": n_clients,
-                "requests": n,
-                "p50_latency_ms": round(latencies[n // 2] * 1000, 2),
-                "p99_latency_ms": round(
-                    latencies[min(n - 1, int(n * 0.99))] * 1000, 2),
-                "plan_cache_hit_rate": round(SERVING_METRICS.hit_rate(), 4),
-                "plan_cache_hits": sv["planCacheHits"],
-                "plan_cache_misses": sv["planCacheMisses"],
-                "executable_builds": sv["executableBuilds"],
-                "prepared_fast_path": sv["preparedFastPath"],
-                "prepared_replans": sv["preparedReplans"],
-                "plan_cache_entries": GLOBAL_PLAN_CACHE.info()["entries"],
-                "prepared_statements":
-                    PREPARED_REGISTRY.info()["statements"],
-            },
-        }
-        out["process_metrics"] = _process_metrics()
-        print(json.dumps(out))
+        lat_off, wall_off = _serve_load(server, schema, n_clients,
+                                        per_client)
+        unbatched = _serve_pass_stats(lat_off, wall_off)
     finally:
         server.close()
+
+    # -- pass 2: batching ON (same process, caches equally warm) ----------
+    server = WorkerServer(coordinator=True)
+    try:
+        _serve_warmup(server, schema)
+        # compile the vmapped batch widths OUTSIDE the measured phase:
+        # two concurrent bursts let the adaptive batcher form (and trace)
+        # the pow2 widths the measured load will hit
+        for _ in range(2):
+            _serve_load(server, schema, n_clients, 2)
+        SERVING_METRICS.reset()
+        lat_on, wall_on = _serve_load(server, schema, n_clients,
+                                      per_client)
+        sv = SERVING_METRICS.snapshot()
+        batched = _serve_pass_stats(lat_on, wall_on)
+        batched.update({
+            "queries": batched["requests"],
+            "device_launches":
+                batched["requests"] - sv["servingBatchLaunchesSaved"],
+            "batches": sv["servingBatches"],
+            "batched_queries": sv["servingBatchQueries"],
+            "launches_saved": sv["servingBatchLaunchesSaved"],
+            "fallbacks": sv["servingBatchFallbacks"],
+            "occupancy_histogram": sv["servingBatchOccupancy"],
+            "padded_lanes": sv["servingBatchPaddedLanes"],
+            "demux_ms": round(sv["servingBatchDemuxNanos"] / 1e6, 2),
+        })
+    finally:
+        server.close()
+
+    # -- pass 3: warm restart through the persistent caches ---------------
+    persist_dir = tempfile.mkdtemp(prefix="presto_tpu_serve_bench_")
+    warm_restart = {}
+    try:
+        kw = {"compilation_cache_dir": f"{persist_dir}/xla",
+              "plan_cache_path": f"{persist_dir}/plans.jsonl"}
+        _reset_serving_process_state()
+        t0 = time.perf_counter()
+        server = WorkerServer(coordinator=True, **kw)
+        try:
+            cold_first = _serve_warmup(server, schema)
+            cold_boot_s = time.perf_counter() - t0
+        finally:
+            server.close()
+
+        _reset_serving_process_state()          # the 'restart'
+        t0 = time.perf_counter()
+        server = WorkerServer(coordinator=True, **kw)   # replays sidecar
+        try:
+            boot_s = time.perf_counter() - t0
+            SERVING_METRICS.reset()
+            warm_first = _serve_warmup(server, schema)
+            sv2 = SERVING_METRICS.snapshot()
+            warm_restart = {
+                "cold_first_query_ms": round(
+                    max(cold_first.values()), 2),
+                "cold_boot_s": round(cold_boot_s, 3),
+                "warm_boot_s": round(boot_s, 3),
+                "warm_first_query_ms": round(
+                    max(warm_first.values()), 2),
+                # the acceptance signal: serving traffic after the
+                # replayed boot plans nothing from scratch
+                "recompiles_after_reload":
+                    sv2["planCacheMisses"] + sv2["preparedReplans"],
+            }
+        finally:
+            server.close()
+    finally:
+        shutil.rmtree(persist_dir, ignore_errors=True)
+
+    out = {
+        "metric": f"serve_sf{sf:g}_qps",
+        "value": batched["qps"],
+        "unit": "queries/s",
+        "wall_s": round(wall_on, 4),
+        "serve": {
+            "clients": n_clients,
+            "requests": batched["requests"],
+            "p50_latency_ms": batched["p50_latency_ms"],
+            "p99_latency_ms": batched["p99_latency_ms"],
+            "batched": batched,
+            "unbatched": unbatched,
+            "qps_speedup": round(
+                batched["qps"] / unbatched["qps"], 2)
+            if unbatched["qps"] else None,
+            "warm_restart": warm_restart,
+            "plan_cache_hit_rate": round(SERVING_METRICS.hit_rate(), 4),
+            "plan_cache_hits": sv["planCacheHits"],
+            "plan_cache_misses": sv["planCacheMisses"],
+            "executable_builds": sv["executableBuilds"],
+            "prepared_fast_path": sv["preparedFastPath"],
+            "prepared_replans": sv["preparedReplans"],
+            "plan_cache_entries": GLOBAL_PLAN_CACHE.info()["entries"],
+            "prepared_statements":
+                PREPARED_REGISTRY.info()["statements"],
+        },
+    }
+    out["process_metrics"] = _process_metrics()
+    print(json.dumps(out))
 
 
 def _process_metrics():

@@ -8,6 +8,7 @@ the column type signatures (decimals -> Decimal)."""
 from __future__ import annotations
 
 import json
+import re
 import time
 import urllib.error
 import urllib.request
@@ -102,7 +103,27 @@ class StatementClient:
         return json.loads(body) if body else {}
 
     def execute(self, sql: str) -> StatementResult:
-        """Submit and poll to completion (the CLI's blocking path)."""
+        """Submit and poll to completion (the CLI's blocking path).
+
+        A coordinator restart empties the server-side prepared-statement
+        registry; when the server rejects a statement over a template
+        this client still holds, the template is re-PREPAREd from the
+        local copy and the statement replayed ONCE, transparently — the
+        dbapi layer and long-lived CLI sessions survive a rolling
+        coordinator restart without re-preparing by hand."""
+        try:
+            return self._execute_once(sql)
+        except QueryError as e:
+            m = re.search(r"prepared statement '(\w+)' does not exist",
+                          str(e))
+            if m is None or m.group(1) not in self.prepared:
+                raise
+            name = m.group(1)
+            self._execute_once(f"prepare {name} from "
+                               f"{self.prepared[name]}")
+            return self._execute_once(sql)
+
+    def _execute_once(self, sql: str) -> StatementResult:
         resp = self._request(f"{self.base_uri}/v1/statement", "POST",
                              sql.encode())
         result = StatementResult(resp.get("id", ""))

@@ -370,6 +370,14 @@ class ExecutionConfig:
     # table sizing.  A dataclass field so the plan-cache config
     # fingerprint re-keys compiled plans on a changed hint.
     history_agg_groups: Optional[int] = None
+    # -- serving plane (presto_tpu/serving) -------------------------------
+    # share jitted scan/filter/project step callables across DIFFERENT
+    # plans by subtree structural key (serving/fragments.py): queries
+    # sharing a scan→filter→agg subchain reuse one compiled artifact.
+    # Only engages for local compilers (task-scoped shared-jit caches
+    # keep their node-id keys); a fingerprinted field, so flipping it
+    # re-keys the canonical plan cache
+    fragment_share: bool = True
 
 
 # legal scan.kernel / scan_kernel values (worker/properties.py and the
@@ -590,6 +598,21 @@ class _RevocableBuildBuffer:
             self._reserved = 0
 
 
+def _fragment_batch_sig(batch: Batch) -> tuple:
+    """Hashable digest of the first-batch column structure a step's
+    expression resolution depends on (laziness, dictionary presence,
+    dtypes) — part of the fragment_jit cache key, so structurally equal
+    subtrees whose resolution would differ never share a callable.
+    Shape is deliberately EXCLUDED: jax.jit retraces per aval."""
+    out = []
+    for n in sorted(batch.columns):
+        c = batch.columns[n]
+        out.append((n, str(c.values.dtype), c.values.ndim,
+                    None if c.dictionary is None else len(c.dictionary),
+                    c.lazy, c.nulls is not None, c.lengths is not None))
+    return tuple(out)
+
+
 class PlanCompiler:
     def __init__(self, ctx: TaskContext):
         if ctx.memory is None:
@@ -619,6 +642,29 @@ class PlanCompiler:
         if ent is None:
             ent = cache.setdefault(key, jax.jit(fn, **kw))
         return ent
+
+    def fragment_jit(self, node, purpose: str, fn, extra=(), **kw):
+        """Fragment-level executable sharing (serving/fragments.py):
+        jitted step callables for linear scan/filter/project fragments
+        are cached PROCESS-GLOBALLY on the subtree's structural key, so
+        two different plans sharing a scan→filter subchain share one
+        compiled artifact.  Falls back to shared_jit whenever a stage
+        cache is installed (distributed tasks) or the fragment_share
+        knob is off.  `extra` must carry every host constant the traced
+        closure bakes in beyond (subtree, config) — chunk capacity,
+        first-batch laziness/dictionary signature — since a false share
+        would execute the wrong program, while a missed share only costs
+        one retrace."""
+        cfg = self.ctx.config
+        if self.ctx.shared_jits is not None or not cfg.fragment_share:
+            return self.shared_jit((node.id, purpose) + tuple(extra), fn,
+                                   **kw)
+        from ..serving.fragments import FRAGMENT_JIT_CACHE
+        from ..sql.canonical import config_fingerprint
+        key = (purpose, P.structural_key(node), tuple(extra),
+               config_fingerprint(cfg))
+        return FRAGMENT_JIT_CACHE.get_or_build(
+            key, lambda: jax.jit(fn, **kw))
 
     def _new_spill_store(self, salt: Optional[int] = None
                          ) -> PartitionedSpillStore:
@@ -864,7 +910,14 @@ class PlanCompiler:
             return make
 
         make = make_factory(cap)
-        dev_make = self.shared_jit((node.id, "scan_make", cap), make)
+        # the scan kernel is a pure function of (table identity incl.
+        # scale factor — all inside the node's structural key — chunk
+        # capacity, config); resident columns ride as an argument pytree,
+        # so plans sharing this scan share one compiled program.  The
+        # ACTUAL output variable names are baked into the closure but
+        # canonicalized away by the structural key, so they join the key
+        dev_make = self.fragment_jit(node, "scan_make", make,
+                                     extra=(cap, tuple(names)))
 
         def split_chunks(split):
             out = []
@@ -1180,19 +1233,22 @@ class PlanCompiler:
                 return
             if "step" not in cache:
                 (pred,), hoisted = hoister.resolve(first)
+                sig = _fragment_batch_sig(first)
                 if expr_has_params(pred):
                     # bound parameters ride as an explicit jit argument so
                     # the trace is reused across constant bindings
                     def pstep(batch, params, _pred=pred):
                         return ops.apply_filter(
                             batch, low.eval(_pred, batch.with_params(params)))
-                    jitted = self.shared_jit((node.id, "filter"), pstep)
+                    jitted = self.fragment_jit(node, "filter_p", pstep,
+                                               extra=(sig,))
                     cache["step"] = \
                         lambda b, _j=jitted: _j(b, self.ctx.params)
                 else:
                     def step(batch, _pred=pred):
                         return ops.apply_filter(batch, low.eval(_pred, batch))
-                    cache["step"] = self.shared_jit((node.id, "filter"), step)
+                    cache["step"] = self.fragment_jit(node, "filter", step,
+                                                      extra=(sig,))
                 cache["hoisted"] = hoisted
             step, hoisted = cache["step"], cache["hoisted"]
             for b in itertools.chain([first], it):
@@ -1215,13 +1271,15 @@ class PlanCompiler:
                 return
             if "step" not in cache:
                 exprs, hoisted = hoister.resolve(first)
+                sig = _fragment_batch_sig(first)
                 if any(expr_has_params(e) for e in exprs):
                     def pstep(batch, params, _exprs=exprs):
                         pb = batch.with_params(params)
                         cols = {v.name: low.eval(e, pb)
                                 for (v, _), e in zip(items, _exprs)}
                         return Batch(cols, batch.mask)
-                    jitted = self.shared_jit((node.id, "project"), pstep)
+                    jitted = self.fragment_jit(node, "project_p", pstep,
+                                               extra=(sig, tuple(names)))
                     cache["step"] = \
                         lambda b, _j=jitted: _j(b, self.ctx.params)
                 else:
@@ -1229,7 +1287,8 @@ class PlanCompiler:
                         cols = {v.name: low.eval(e, batch)
                                 for (v, _), e in zip(items, _exprs)}
                         return Batch(cols, batch.mask)
-                    cache["step"] = self.shared_jit((node.id, "project"), step)
+                    cache["step"] = self.fragment_jit(
+                        node, "project", step, extra=(sig, tuple(names)))
                 cache["hoisted"] = hoisted
             step, hoisted = cache["step"], cache["hoisted"]
             for b in itertools.chain([first], it):

@@ -305,6 +305,114 @@ class LocalQueryRunner:
                               bound_params=list(ast.values),
                               record_fast=ps.record_fast_path)
 
+    # -- micro-batched execution ------------------------------------------
+
+    def execute_prepared_batch(self, sqls: List[str], prepared=None
+                               ) -> Optional[List[Optional[QueryResult]]]:
+        """Execute N concurrent EXECUTE..USING statements that share one
+        prepared template as ONE device launch (serving/batched.py).
+
+        `prepared` is a name->text map, or a list of such maps aligned
+        with `sqls` (the HTTP path carries per-request header maps).
+        Returns a list aligned with `sqls` — QueryResult for every lane
+        served by the batched drain, None for lanes the caller must run
+        sequentially (bind errors, arity mismatches: their solo run
+        raises the right per-query error) — or None when no batch was
+        possible at all (cold template, ineligible plan shape, cache
+        miss).  Every returned lane's rows are bit-identical to a solo
+        run: the vmapped program replays the sequential fused path's
+        exact update sequence per lane."""
+        from ..serving import PREPARED_REGISTRY, SERVING_METRICS
+        from ..serving.batched import batched_runner_for, disable_for
+        from ..sql import parser as A
+        from ..sql.canonical import (BindError, cache_key_from_parts,
+                                     device_params, literal_value)
+        if len(sqls) < 2:
+            return None
+        pmaps = (list(prepared) if isinstance(prepared, (list, tuple))
+                 else [prepared] * len(sqls))
+        text = None
+        asts = []
+        try:
+            for s, pm in zip(sqls, pmaps):
+                ast = A.parse_sql(s)
+                if not isinstance(ast, A.ExecuteStmt):
+                    return None
+                t = self._prepared_text(ast.name, pm)
+                if text is None:
+                    text = t
+                elif t != text:
+                    return None     # mixed templates: not one batch
+                asts.append(ast)
+        except Exception:   # noqa: BLE001 — unknown name etc: sequential
+            return None
+        ps = PREPARED_REGISTRY.get_or_parse(text)
+        fast = ps.fast
+        if fast is None:
+            return None             # cold: a solo run records the path
+        values_by_lane: List[Optional[list]] = [None] * len(sqls)
+        for i, ast in enumerate(asts):
+            if len(ast.values) != ps.param_count:
+                continue            # isolated arity error -> solo run
+            try:
+                raw = [literal_value(v) for v in ast.values]
+                values_by_lane[i] = fast.bind(raw)
+            except BindError:
+                continue            # isolated bind error -> solo run
+        lanes = [i for i, v in enumerate(values_by_lane) if v is not None]
+        if len(lanes) < 2:
+            return None
+        key = cache_key_from_parts(fast.template_key, self.config,
+                                   self.catalog, self.schema)
+        hit = self.plan_cache.checkout(key)
+        if hit is None:
+            return None
+        output, slot_types, compiler = hit
+        if compiler is None:
+            compiler = PlanCompiler(TaskContext(config=self.config))
+            SERVING_METRICS.incr("executable_builds")
+        exe = _Execution(output, compiler, key, False, list(slot_types))
+        if not exe.slot_types:
+            self.plan_cache.checkin(key, compiler)
+            return None
+        self._bind(exe, values_by_lane[lanes[0]])
+        runner = batched_runner_for(compiler, output)
+        if runner is None:
+            self.plan_cache.checkin(key, compiler)
+            return None
+        dev_list = [device_params(values_by_lane[i], exe.slot_types)[0]
+                    for i in lanes]
+        try:
+            pages, launch_ns, demux_ns = runner.run(dev_list)
+        except Exception:   # noqa: BLE001 — whole drain failed: the
+            # compiler may be poisoned (not returned to the pool) and the
+            # template is pinned sequential; every lane re-runs solo
+            disable_for(compiler)
+            return None
+        self._last_template_digest = plan_template_digest(
+            fast.template_key)
+        names = output.column_names
+        types = [v.type for v in output.outputs]
+        results: List[Optional[QueryResult]] = [None] * len(sqls)
+        width = 1 << max(0, len(lanes) - 1).bit_length()
+        for j, i in enumerate(lanes):
+            res = pages_to_result([pages[j]], names, types)
+            res.peak_memory_bytes = (compiler.ctx.memory.peak
+                                     if compiler.ctx.memory is not None
+                                     else 0)
+            res.runtime_stats = {
+                "servingBatchOccupancy": {"sum": len(lanes), "unit": "NONE"},
+                "servingBatchLaunchNanos": {"sum": launch_ns,
+                                            "unit": "NANO"},
+            }
+            results[i] = res
+            SERVING_METRICS.incr("prepared_fast_path")
+            self._record_history(res, output)
+        self._release(exe)
+        SERVING_METRICS.record_batch(len(lanes), demux_ns,
+                                     padded_lanes=width - len(lanes))
+        return results
+
     # -- execution --------------------------------------------------------
 
     def execute(self, sql: str, prepared: Optional[Dict[str, str]] = None
@@ -448,9 +556,10 @@ class LocalQueryRunner:
         """DDL changed table contents: every cached plan/executable (and
         every recorded prepared fast path, whose template keys assume the
         old tables) may be stale."""
-        from ..serving import PREPARED_REGISTRY
+        from ..serving import FRAGMENT_JIT_CACHE, PREPARED_REGISTRY
         self.plan_cache.invalidate_all()
         PREPARED_REGISTRY.invalidate_fast_paths()
+        FRAGMENT_JIT_CACHE.invalidate_all()
 
     def _explain(self, ast) -> QueryResult:
         """EXPLAIN: plan text.  EXPLAIN ANALYZE: execute with per-node

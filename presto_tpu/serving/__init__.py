@@ -8,11 +8,26 @@ The pieces:
   serving/prepared.py  PREPARE/EXECUTE registry + the skip-parse-and-plan
                      fast path
   serving/metrics.py process-wide counters for /v1/metrics and /v1/status
+  serving/batching.py  micro-batcher: concurrent same-template EXECUTEs
+                     collapse into one device launch
+  serving/batched.py vmapped per-template executor behind the batcher
+  serving/persist.py durable sidecar + JAX compilation cache: restart
+                     warm-starts without recompiling
+  serving/fragments.py  structural-key jit sharing across plans
   worker/statement.py  weighted fair-share + memory-headroom admission
+
+serving/batched.py imports exec.pipeline, so it is NOT imported here —
+exec.pipeline lazily imports serving.fragments, and an eager import
+would make that a cycle.  Import it as `presto_tpu.serving.batched`.
 """
+from .batching import MicroBatcher
 from .cache import GLOBAL_PLAN_CACHE, PlanCache
+from .fragments import FRAGMENT_JIT_CACHE, FragmentJitCache
 from .metrics import SERVING_METRICS
+from .persist import PlanCacheSidecar, enable_compilation_cache
 from .prepared import PREPARED_REGISTRY, PreparedRegistry
 
 __all__ = ["GLOBAL_PLAN_CACHE", "PlanCache", "SERVING_METRICS",
-           "PREPARED_REGISTRY", "PreparedRegistry"]
+           "PREPARED_REGISTRY", "PreparedRegistry", "MicroBatcher",
+           "FRAGMENT_JIT_CACHE", "FragmentJitCache", "PlanCacheSidecar",
+           "enable_compilation_cache"]

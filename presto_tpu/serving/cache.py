@@ -22,6 +22,7 @@ the key.  Eviction is LRU by last checkout/insert.
 """
 from __future__ import annotations
 
+import time
 from collections import OrderedDict
 from typing import List, Optional, Tuple
 
@@ -33,12 +34,14 @@ _POOL_PER_ENTRY = 4             # compilers retained per entry
 
 
 class _Entry:
-    __slots__ = ("template", "slot_types", "pool")
+    __slots__ = ("template", "slot_types", "pool", "out", "out_peak")
 
     def __init__(self, template, slot_types):
         self.template = template          # optimized OutputNode
         self.slot_types = slot_types      # parameter slot types, in order
         self.pool: List[object] = []      # idle PlanCompiler instances
+        self.out = 0                      # compilers currently checked out
+        self.out_peak = 0                 # high-water concurrent checkouts
 
 
 class PlanCache:
@@ -52,6 +55,7 @@ class PlanCache:
         self.misses = 0
         self.evictions = 0
         self.invalidations = 0
+        self.pool_exhausted = 0
 
     # -- configuration ----------------------------------------------------
     def set_max_entries(self, n: int) -> None:
@@ -63,7 +67,12 @@ class PlanCache:
     def checkout(self, key: str) -> Optional[Tuple[object, list, object]]:
         """Hit -> (optimized template, slot types, compiler-or-None); the
         compiler, when present, is exclusively owned until checkin()."""
+        t0 = time.perf_counter_ns()  # lint: allow-wall-clock
         with self._lock:
+            # lock-acquisition wall = how long concurrent executions
+            # queued behind the cache (the "checkout wait" of a
+            # contended serving plane)
+            wait = time.perf_counter_ns() - t0  # lint: allow-wall-clock
             ent = self._entries.get(key)
             if ent is None:
                 self.misses += 1
@@ -72,7 +81,21 @@ class PlanCache:
             self._entries.move_to_end(key)
             self.hits += 1
             SERVING_METRICS.incr("plan_cache_hits")
+            SERVING_METRICS.incr("compiler_checkouts")
+            if wait:
+                SERVING_METRICS.incr("compiler_checkout_wait_nanos", wait)
             compiler = ent.pool.pop() if ent.pool else None
+            ent.out += 1
+            if ent.out > ent.out_peak:
+                ent.out_peak = ent.out
+            SERVING_METRICS.max_update("compiler_checkout_depth_peak",
+                                       ent.out)
+            if compiler is None:
+                # exhausted pool: the caller rebuilds a compiler — that
+                # fallback used to be silent; now it is the contention
+                # signal the admission layer can watch
+                self.pool_exhausted += 1
+                SERVING_METRICS.incr("compiler_pool_exhausted")
             return ent.template, ent.slot_types, compiler
 
     def insert(self, key: str, template, slot_types, compiler) -> None:
@@ -93,8 +116,13 @@ class PlanCache:
         resurrect a dead key)."""
         with self._lock:
             ent = self._entries.get(key)
-            if ent is not None and compiler is not None \
-                    and len(ent.pool) < _POOL_PER_ENTRY:
+            if ent is None:
+                return
+            # the checkout is over whether or not the compiler survives
+            # (pool-full drops still end the exclusive ownership window)
+            if ent.out > 0:
+                ent.out -= 1
+            if compiler is not None and len(ent.pool) < _POOL_PER_ENTRY:
                 ent.pool.append(compiler)
 
     def contains(self, key: str) -> bool:
@@ -129,6 +157,11 @@ class PlanCache:
                 "misses": self.misses,
                 "evictions": self.evictions,
                 "invalidations": self.invalidations,
+                "poolExhausted": self.pool_exhausted,
+                "checkedOut": sum(e.out for e in self._entries.values()),
+                "checkoutDepthPeak": max(
+                    (e.out_peak for e in self._entries.values()),
+                    default=0),
             }
 
 

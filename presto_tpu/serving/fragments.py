@@ -1,0 +1,77 @@
+"""Fragment-level executable sharing.
+
+The canonical PlanCache shares compiled state between executions of the
+SAME whole plan.  Queries that differ above a common scan→filter→agg
+subchain still recompile every jitted step from scratch, because the
+per-compiler jit caches key on plan-node ids.  This module is the
+process-global complement: jitted step callables keyed on the
+STRUCTURAL key of the subtree they compile (`spi.plan.structural_key` —
+node ids blanked, variables renamed) plus the execution-config
+fingerprint, so two different plans sharing a fragment share one
+compiled artifact.  `PlanCompiler.fragment_jit` (exec/pipeline.py)
+routes the scan/filter/project step sites here when the
+`fragment_share` config knob is on and the compiler is not running
+under a task-scoped shared-jit cache (distributed tasks keep their
+node-id keyed cache: their fragments are already deduplicated by the
+fragmenter).
+
+Safety: a cached callable is a PURE function of its traced arguments —
+bound parameters, scan chunk positions, HBM-resident columns all ride
+as arguments — plus host constants fully determined by (subtree
+structural key, config fingerprint, first-batch signature), which is
+exactly the cache key.  jax.jit's own per-aval retracing handles shape
+and dtype drift between sharers.  DDL clears the cache alongside the
+plan cache (runner._invalidate_plans): generated-connector fragments
+are immutable, but a dropped-and-recreated stored table must not
+resurrect callables probed against the old data's encodings.
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Callable
+
+from ..common.locks import OrderedLock
+from .metrics import SERVING_METRICS
+
+DEFAULT_FRAGMENT_ENTRIES = 512
+
+
+class FragmentJitCache:
+    def __init__(self, max_entries: int = DEFAULT_FRAGMENT_ENTRIES):
+        # rank 95: SERVING_METRICS (100) is bumped while held; taken from
+        # inside compiler step construction with no serving lock held
+        self._lock = OrderedLock("serving-fragments", 95)  # lint: guarded-by(_lock)
+        self._entries: "OrderedDict[tuple, object]" = OrderedDict()
+        self.max_entries = int(max_entries)
+
+    def get_or_build(self, key: tuple, build: Callable):
+        """Return the cached jitted callable for `key`, building (and
+        LRU-inserting) it on first sight.  Building under the lock is
+        fine: jax.jit is lazy — tracing and compilation happen at first
+        CALL, outside this lock."""
+        with self._lock:
+            fn = self._entries.get(key)
+            if fn is not None:
+                self._entries.move_to_end(key)
+                SERVING_METRICS.incr("fragment_jit_hits")
+                return fn
+            fn = build()
+            self._entries[key] = fn
+            SERVING_METRICS.incr("fragment_jit_misses")
+            while len(self._entries) > self.max_entries:
+                self._entries.popitem(last=False)
+            return fn
+
+    def invalidate_all(self) -> int:
+        with self._lock:
+            n = len(self._entries)
+            self._entries.clear()
+            return n
+
+    def info(self) -> dict:
+        with self._lock:
+            return {"entries": len(self._entries),
+                    "maxEntries": self.max_entries}
+
+
+FRAGMENT_JIT_CACHE = FragmentJitCache()

@@ -32,10 +32,46 @@ class ServingMetrics:
             self.prepared_registered = 0
             self.prepared_fast_path = 0     # EXECUTE skipped parse+plan
             self.prepared_replans = 0       # EXECUTE took the full pipeline
+            # micro-batched EXECUTE..USING (serving/batching.py): one
+            # batched drain = ONE device launch serving `occupancy`
+            # queries; launches saved = batch_queries - batches
+            self.serving_batches = 0
+            self.serving_batch_queries = 0
+            self.serving_batch_fallbacks = 0   # joined a group, ran solo
+            self.serving_batch_demux_nanos = 0
+            self.serving_batch_padded_lanes = 0
+            self.serving_batch_occupancy: dict = {}   # str(n) -> count
+            # PlanCache compiler-pool contention (serving/cache.py): an
+            # exhausted pool silently rebuilds a compiler — meter it
+            self.compiler_checkouts = 0
+            self.compiler_pool_exhausted = 0
+            self.compiler_checkout_wait_nanos = 0
+            self.compiler_checkout_depth_peak = 0
+            # fragment-level jit sharing (serving/fragments.py)
+            self.fragment_jit_hits = 0
+            self.fragment_jit_misses = 0
 
     def incr(self, name: str, delta: int = 1) -> None:
         with self._lock:
             setattr(self, name, getattr(self, name) + delta)
+
+    def max_update(self, name: str, value: int) -> None:
+        """Monotonic high-water counter (checkout depth peaks)."""
+        with self._lock:
+            if value > getattr(self, name):
+                setattr(self, name, value)
+
+    def record_batch(self, occupancy: int, demux_nanos: int,
+                     padded_lanes: int = 0) -> None:
+        """One batched drain: `occupancy` real queries in one launch."""
+        with self._lock:
+            self.serving_batches += 1
+            self.serving_batch_queries += occupancy
+            self.serving_batch_demux_nanos += int(demux_nanos)
+            self.serving_batch_padded_lanes += padded_lanes
+            k = str(occupancy)
+            self.serving_batch_occupancy[k] = \
+                self.serving_batch_occupancy.get(k, 0) + 1
 
     def snapshot(self) -> dict:
         with self._lock:
@@ -48,6 +84,22 @@ class ServingMetrics:
                 "preparedRegistered": self.prepared_registered,
                 "preparedFastPath": self.prepared_fast_path,
                 "preparedReplans": self.prepared_replans,
+                "servingBatches": self.serving_batches,
+                "servingBatchQueries": self.serving_batch_queries,
+                "servingBatchLaunchesSaved": (self.serving_batch_queries
+                                              - self.serving_batches),
+                "servingBatchFallbacks": self.serving_batch_fallbacks,
+                "servingBatchDemuxNanos": self.serving_batch_demux_nanos,
+                "servingBatchPaddedLanes": self.serving_batch_padded_lanes,
+                "servingBatchOccupancy": dict(self.serving_batch_occupancy),
+                "compilerCheckouts": self.compiler_checkouts,
+                "compilerPoolExhausted": self.compiler_pool_exhausted,
+                "compilerCheckoutWaitNanos":
+                    self.compiler_checkout_wait_nanos,
+                "compilerCheckoutDepthPeak":
+                    self.compiler_checkout_depth_peak,
+                "fragmentJitHits": self.fragment_jit_hits,
+                "fragmentJitMisses": self.fragment_jit_misses,
             }
 
     def hit_rate(self) -> float:
@@ -67,6 +119,9 @@ class ServingMetrics:
             "preparedFastPathRate": (snap["preparedFastPath"] / prepared
                                      if prepared else 0.0),
             "executableBuilds": snap["executableBuilds"],
+            "servingBatches": snap["servingBatches"],
+            "servingBatchLaunchesSaved": snap["servingBatchLaunchesSaved"],
+            "compilerPoolExhausted": snap["compilerPoolExhausted"],
         }
 
 

@@ -244,6 +244,21 @@ def format_analyze_footer(runtime_stats, profile_dir: str = None) -> str:
                      f"exchange(s) flipped to broadcast, "
                      f"{int(swaps['sum']) if swaps else 0} "
                      f"join side swap(s)")
+    # serving-plane micro-batching: process-wide counters (the batcher
+    # lives above any single execution, so per-run RuntimeStats cannot
+    # carry them); shown only once batches have actually formed
+    try:
+        from ..serving import SERVING_METRICS
+        sv = SERVING_METRICS.snapshot()
+        if sv.get("servingBatches"):
+            occ = (sv["servingBatchQueries"] / sv["servingBatches"])
+            lines.append(
+                f"Serving micro-batches: {sv['servingBatches']} "
+                f"({occ:.1f} avg occupancy, "
+                f"{sv['servingBatchLaunchesSaved']} launch(es) saved, "
+                f"demux {sv['servingBatchDemuxNanos'] / 1e6:,.1f}ms)")
+    except Exception:   # noqa: BLE001 — footer is advisory
+        pass
     if profile_dir:
         # where `jax.profiler.trace` wrote this run's device capture
         # (open with tensorboard / xprof)

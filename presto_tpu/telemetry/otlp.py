@@ -142,7 +142,13 @@ def scrape_metric_points() -> List[Tuple[str, float, dict]]:
 
     from ..serving.metrics import SERVING_METRICS
     for k, v in SERVING_METRICS.snapshot().items():
-        points.append((f"presto_tpu.serving.{k}", float(v), {}))
+        if isinstance(v, dict):
+            # servingBatchOccupancy histogram: lanes-per-drain -> count
+            for occupancy, n in v.items():
+                points.append((f"presto_tpu.serving.{k}", float(n),
+                               {"occupancy": str(occupancy)}))
+        else:
+            points.append((f"presto_tpu.serving.{k}", float(v), {}))
 
     from ..storage.store import STORAGE_METRICS
     for k, v in STORAGE_METRICS.items():

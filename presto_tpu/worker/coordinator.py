@@ -929,6 +929,7 @@ class _QueryExecution:
             tasks = []
             stage_cpu = 0
             stage_wall = 0
+            stage_peak = 0
             for task in stage.tasks:
                 if task is None:
                     continue
@@ -944,6 +945,8 @@ class _QueryExecution:
                 tstats = info.get("stats", {})
                 stage_cpu += int(tstats.get("totalCpuTimeInNanos", 0))
                 stage_wall += int(tstats.get("driverWallTimeInNanos", 0))
+                stage_peak += int(
+                    tstats.get("peakTotalMemoryInBytes", 0) or 0)
                 tasks.append({"worker": task.worker_uri, **info})
             stages.append({"stageId": f"{self.qid}.{stage.stage_path}",
                            "fragmentId": stage.fragment.fragment_id,
@@ -955,9 +958,28 @@ class _QueryExecution:
                            # gap is scheduling + device + exchange waits
                            "cpuTimeInNanos": stage_cpu,
                            "wallTimeInNanos": stage_wall,
+                           "peakMemoryBytes": stage_peak,
                            "tasks": tasks})
         return {"traceToken": self.trace_token, "stages": stages,
+                "peakMemoryBytes": sum(st.get("peakMemoryBytes", 0)
+                                       for st in stages),
                 "operatorStats": merged}
+
+    def peak_memory_bytes(self) -> int:
+        """Cluster-wide peak: the sum of per-task memory-pool peaks
+        (reference peakTotalMemoryReservation).  Fetched task-by-task
+        AFTER the drain, so admission history seeding records what the
+        distributed run actually reserved instead of 0."""
+        total = 0
+        for t in self.all_tasks:
+            if t is None:
+                continue
+            try:
+                stats = t.info(timeout_s=5).get("stats") or {}
+                total += int(stats.get("peakTotalMemoryInBytes", 0) or 0)
+            except (OSError, ValueError):
+                continue
+        return total
 
     def close(self) -> None:
         if self._watcher is not None:
@@ -1125,6 +1147,13 @@ class HttpQueryRunner(LocalQueryRunner):
             pages = execution.run()
             result = pages_to_result(iter(pages), names, types)
             result.runtime_stats = execution.stats.to_dict()
+            try:
+                # per-task memory-pool peaks roll into the result so the
+                # QueryCompletedEvent / history record carries a real
+                # peak for adaptive admission seeding (was always 0)
+                result.peak_memory_bytes = execution.peak_memory_bytes()
+            except Exception:   # noqa: BLE001 — stats are best-effort
+                pass
             return result
         except Exception:
             self.queries_failed += 1

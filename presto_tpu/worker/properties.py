@@ -300,6 +300,14 @@ class SystemConfig:
         ("serving.plan-cache-entries", int, 128),
         ("serving.total-concurrency", int, 0),       # 0 = per-group only
         ("serving.admission-headroom-fraction", float, 0.8),
+        # micro-batched point-query execution (serving/batching.py):
+        # concurrent same-template EXECUTEs collapse into one launch
+        ("serving.batch-window-ms", float, 3.0),
+        ("serving.max-batch-size", int, 16),         # 1 = batching off
+        # persistent executable cache (serving/persist.py): XLA
+        # compilation cache dir + plan-cache sidecar for warm restarts
+        ("serving.compilation-cache-dir", str, ""),
+        ("serving.plan-cache-path", str, ""),
         # telemetry export pipeline + query history + device profiler
         # (presto_tpu/telemetry/)
         ("telemetry.sink", str, "none"),         # none|jsonl|http|collector
@@ -395,6 +403,23 @@ def server_kwargs_from_etc(etc_dir: str) -> Tuple[dict, Dict[str, str]]:
                 "serving.admission-headroom-fraction must be in (0, 1], "
                 f"got {f}")
         kwargs["admission_headroom_fraction"] = f
+    if "serving.batch-window-ms" in props:
+        w = float(props["serving.batch-window-ms"])
+        if w < 0:
+            raise ValueError(
+                f"serving.batch-window-ms must be >= 0, got {w}")
+        kwargs["batch_window_ms"] = w
+    if "serving.max-batch-size" in props:
+        n = int(props["serving.max-batch-size"])
+        if n < 1:
+            raise ValueError(
+                f"serving.max-batch-size must be >= 1, got {n}")
+        kwargs["max_batch_size"] = n
+    if props.get("serving.compilation-cache-dir"):
+        kwargs["compilation_cache_dir"] = \
+            props["serving.compilation-cache-dir"]
+    if props.get("serving.plan-cache-path"):
+        kwargs["plan_cache_path"] = props["serving.plan-cache-path"]
     # telemetry export + history (presto_tpu/telemetry/)
     if "telemetry.sink" in props:
         kwargs["telemetry_sink"] = props["telemetry.sink"]

@@ -400,6 +400,42 @@ class _Handler(BaseHTTPRequestHandler):
             "# TYPE presto_tpu_serving_prepared_replans_total counter",
             f"presto_tpu_serving_prepared_replans_total "
             f"{sv['preparedReplans']}",
+            # compiler-pool contention (serving/cache.py checkout)
+            "# TYPE presto_tpu_serving_compiler_checkouts_total counter",
+            "presto_tpu_serving_compiler_checkouts_total "
+            f"{sv['compilerCheckouts']}",
+            "# TYPE presto_tpu_serving_compiler_pool_exhausted_total counter",
+            "presto_tpu_serving_compiler_pool_exhausted_total "
+            f"{sv['compilerPoolExhausted']}",
+            "# TYPE presto_tpu_serving_compiler_checkout_wait_seconds_total"
+            " counter",
+            "presto_tpu_serving_compiler_checkout_wait_seconds_total "
+            f"{sv['compilerCheckoutWaitNanos'] / 1e9:.6f}",
+            "# TYPE presto_tpu_serving_compiler_checkout_depth_peak gauge",
+            "presto_tpu_serving_compiler_checkout_depth_peak "
+            f"{sv['compilerCheckoutDepthPeak']}",
+            # micro-batched point queries (serving/batching.py)
+            "# TYPE presto_tpu_serving_batch_batches_total counter",
+            f"presto_tpu_serving_batch_batches_total {sv['servingBatches']}",
+            "# TYPE presto_tpu_serving_batch_queries_total counter",
+            "presto_tpu_serving_batch_queries_total "
+            f"{sv['servingBatchQueries']}",
+            "# TYPE presto_tpu_serving_batch_launches_saved_total counter",
+            "presto_tpu_serving_batch_launches_saved_total "
+            f"{sv['servingBatchLaunchesSaved']}",
+            "# TYPE presto_tpu_serving_batch_fallbacks_total counter",
+            "presto_tpu_serving_batch_fallbacks_total "
+            f"{sv['servingBatchFallbacks']}",
+            "# TYPE presto_tpu_serving_batch_demux_seconds_total counter",
+            "presto_tpu_serving_batch_demux_seconds_total "
+            f"{sv['servingBatchDemuxNanos'] / 1e9:.6f}",
+            # fragment-level executable sharing (serving/fragments.py)
+            "# TYPE presto_tpu_serving_fragment_jit_hits_total counter",
+            "presto_tpu_serving_fragment_jit_hits_total "
+            f"{sv['fragmentJitHits']}",
+            "# TYPE presto_tpu_serving_fragment_jit_misses_total counter",
+            "presto_tpu_serving_fragment_jit_misses_total "
+            f"{sv['fragmentJitMisses']}",
         ]
         # HBM-resident columnar storage tier (storage/store.py
         # STORAGE_METRICS), namespaced like the other sections;
@@ -953,6 +989,10 @@ class WorkerServer:
                  total_concurrency: Optional[int] = None,
                  admission_headroom_fraction: Optional[float] = None,
                  admission_memory_pool=None,
+                 batch_window_ms: float = 3.0,
+                 max_batch_size: int = 16,
+                 compilation_cache_dir: Optional[str] = None,
+                 plan_cache_path: Optional[str] = None,
                  telemetry_sink=None, telemetry_path: str = "",
                  telemetry_endpoint: str = "",
                  telemetry_flush_interval_s: float = 0.2,
@@ -1019,15 +1059,33 @@ class WorkerServer:
         # attached lazily when the first distributed statement runs
         self.failure_detector = None
 
+        # persistent executable cache (serving/persist.py): point JAX's
+        # compilation cache at disk BEFORE anything compiles, so every
+        # jitted step this process builds is reloadable after a restart
+        if compilation_cache_dir:
+            from ..serving import enable_compilation_cache
+            enable_compilation_cache(compilation_cache_dir)
+
         # coordinator role: client statement intake (worker/statement.py)
         self.dispatch = None
         self._runner_cache: Dict = {}
         self._runner_lock = threading.Lock()
+        self._batcher = None
+        self._sidecar = None
         if coordinator:
             from .statement import DispatchManager, ResourceGroupManager
             if plan_cache_entries is not None:
                 from ..serving import GLOBAL_PLAN_CACHE
                 GLOBAL_PLAN_CACHE.set_max_entries(plan_cache_entries)
+            # micro-batched point queries: concurrent same-template
+            # EXECUTEs collapse into one device launch (max_batch_size=1
+            # disables the window entirely)
+            from ..serving import MicroBatcher
+            self._batcher = MicroBatcher(window_ms=batch_window_ms,
+                                         max_batch=max_batch_size)
+            if plan_cache_path:
+                from ..serving import PlanCacheSidecar
+                self._sidecar = PlanCacheSidecar(plan_cache_path)
             if resource_groups is None and (
                     total_concurrency is not None
                     or admission_memory_pool is not None):
@@ -1103,6 +1161,12 @@ class WorkerServer:
                                         SystemTablesConnector(self))
             self._registered_system = True
 
+        # warm restart: replay recorded exemplars BEFORE the listener
+        # opens — the recompile cost lands at boot, not on the first
+        # client (and mostly loads from the persistent compilation cache)
+        if self._sidecar is not None:
+            self._warm_start_replay()
+
         self._serve_thread = threading.Thread(
             target=self.httpd.serve_forever, name=f"http-{self.port}",
             daemon=True)
@@ -1144,20 +1208,15 @@ class WorkerServer:
             return [a["services"][0]["properties"]["http"]
                     for a in (self.discovery or {}).values()]
 
-    def _execute_statement(self, q):
-        """DispatchManager executor: run a managed query over the discovered
-        workers (HttpQueryRunner) or in-process when none are announced —
-        the same fallback a single-node reference deployment makes
-        (coordinator with node-scheduler.include-coordinator=true).
-
-        Runners are cached per (workers, schema, catalog, session) so
-        repeated statements reuse the plan cache and warm jitted pipelines;
-        DDL invalidates the cache (it may change any catalog's tables)."""
+    def _runner_for(self, schema, catalog, session):
+        """Get-or-build the cached query runner for one (workers, schema,
+        catalog, session) combination.  Runners are cached so repeated
+        statements reuse the plan cache and warm jitted pipelines; DDL
+        invalidates the cache (it may change any catalog's tables)."""
         from .protocol import apply_session_properties
-        cfg = apply_session_properties(self.exec_config, q.session)
+        cfg = apply_session_properties(self.exec_config, session)
         uris = tuple(sorted(u for u in self.worker_uris() if u != self.uri))
-        key = (uris, q.schema, q.catalog,
-               tuple(sorted(q.session.items())))
+        key = (uris, schema, catalog, tuple(sorted(session.items())))
         with self._runner_lock:
             runner = self._runner_cache.get(key)
             if runner is None:
@@ -1169,20 +1228,106 @@ class WorkerServer:
                         heartbeat_timeout_s=(
                             cfg.failure_detector_heartbeat_timeout_s
                             or None))
-                    runner = HttpQueryRunner(list(uris), schema=q.schema,
-                                             config=cfg, session=q.session,
+                    runner = HttpQueryRunner(list(uris), schema=schema,
+                                             config=cfg, session=session,
                                              failure_detector=det,
-                                             catalog=q.catalog)
+                                             catalog=catalog)
                     self.failure_detector = det
                 else:
                     from ..exec.runner import LocalQueryRunner
-                    runner = LocalQueryRunner(q.schema, config=cfg,
-                                              catalog=q.catalog)
+                    runner = LocalQueryRunner(schema, config=cfg,
+                                              catalog=catalog)
                 self._runner_cache[key] = runner
                 while len(self._runner_cache) > 16:
                     old = self._runner_cache.pop(
                         next(iter(self._runner_cache)))
                     self._close_runner(old)
+        return runner, uris
+
+    @staticmethod
+    def _batch_template_text(runner, q) -> Optional[str]:
+        """The prepared-template text behind an EXECUTE..USING statement,
+        or None when the statement is not batchable traffic.  The text is
+        the micro-batch group key: requests resolve to the same key only
+        when a single canonical plan serves them."""
+        m = re.match(r"\s*execute\s+([A-Za-z_][A-Za-z0-9_]*)\s+using\b",
+                     q.sql, re.IGNORECASE)
+        if m is None:
+            return None
+        name = m.group(1)
+        return ((q.prepared or {}).get(name)
+                or getattr(runner, "_prepared", {}).get(name))
+
+    def _execute_statement(self, q):
+        """DispatchManager executor: run a managed query over the discovered
+        workers (HttpQueryRunner) or in-process when none are announced —
+        the same fallback a single-node reference deployment makes
+        (coordinator with node-scheduler.include-coordinator=true).
+
+        Single-node EXECUTE..USING traffic first passes the micro-batcher:
+        requests against the same template that land inside one batching
+        window run as ONE device launch (exec/runner.py
+        execute_prepared_batch); everything else — and every lane the
+        batched drain declines — takes `_run_single`, the unchanged
+        sequential path."""
+        runner, uris = self._runner_for(q.schema, q.catalog, q.session)
+        result = None
+        served = False
+        if (not uris and self._batcher is not None
+                and self._batcher.enabled
+                and hasattr(runner, "execute_prepared_batch")):
+            text = self._batch_template_text(runner, q)
+            if text is not None:
+                result = self._batcher.run(
+                    (id(runner), text), q,
+                    lambda items: runner.execute_prepared_batch(
+                        [it.sql for it in items],
+                        prepared=[it.prepared for it in items]),
+                    lambda item: self._run_single(runner, uris, item))
+                served = True
+        if not served:
+            result = self._run_single(runner, uris, q)
+        if self._sidecar is not None:
+            self._record_sidecar(q)
+        return result
+
+    def _record_sidecar(self, q) -> None:
+        """Persist a warm-start exemplar for a successfully served
+        statement (PlanCacheSidecar dedups per template)."""
+        head = q.sql.lstrip().split(None, 1)
+        word = head[0].lower() if head else ""
+        if word not in ("select", "with", "prepare", "execute"):
+            return
+        try:
+            self._sidecar.record(q.sql, q.prepared, q.catalog, q.schema,
+                                 q.session)
+        except Exception:   # noqa: BLE001 — persistence is advisory
+            pass
+
+    def _warm_start_replay(self) -> int:
+        """Replay the sidecar's recorded exemplars through the same runner
+        path that serves traffic: each replay re-registers its prepared
+        statement, re-records the skip-parse fast path, and re-inserts the
+        canonical PlanCache entry — whose jitted steps load from the
+        persistent compilation cache instead of recompiling.  Runs before
+        the HTTP listener starts, so the first client request after a
+        restart is already a warm hit."""
+        n = 0
+        for rec in self._sidecar.load():
+            try:
+                runner, uris = self._runner_for(
+                    rec["schema"], rec["catalog"],
+                    rec.get("session") or {})
+                if uris:
+                    continue    # warm start serves the single-node plane
+                runner.execute(rec["sql"],
+                               prepared=rec.get("prepared") or {})
+                n += 1
+            except Exception:   # noqa: BLE001 — a stale exemplar (dropped
+                continue        # table, bad session) must not block boot
+        return n
+
+    def _run_single(self, runner, uris, q):
         if not uris and hasattr(runner, "execute_streaming"):
             # single-node SELECTs stream chunk-by-chunk: the coordinator
             # never materializes the full result (reference Query.java
@@ -1221,6 +1366,9 @@ class WorkerServer:
                 for r in self._runner_cache.values():
                     self._close_runner(r)
                 self._runner_cache.clear()
+            if self._sidecar is not None:
+                # a replayed exemplar would re-plan against changed tables
+                self._sidecar.clear()
         return result
 
     def _history_extra_fields(self, event) -> dict:
